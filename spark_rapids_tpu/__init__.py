@@ -1,0 +1,10 @@
+"""spark_rapids_tpu: TPU-native SQL plan acceleration framework.
+
+A ground-up re-design of the RAPIDS Accelerator for Apache Spark
+(reference: ravitestgit/spark-rapids) for TPU hardware: Spark-style physical plans
+execute as fused, jit-compiled XLA columnar programs over device batches, with
+tiered HBM->host->disk spill, a device-admission semaphore, mesh-sharded
+distributed execution via jax collectives, and a CPU (pyarrow) engine for
+fallback + result-comparison testing.
+"""
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn, null_column
+from spark_rapids_tpu.columnar.batch import DeviceBatch

@@ -1,0 +1,183 @@
+"""Device batch and host<->device movement.
+
+Reference analogs:
+- ``ColumnarBatch`` of GpuColumnVectors (GpuColumnVector.java:40 area);
+- ``GpuColumnarBatchBuilder`` (GpuColumnVector.java:41) which builds on host then
+  uploads — here ``DeviceBatch.from_arrow`` stages through numpy and uploads once;
+- ``HostColumnarToGpu.scala:222`` (host ColumnarBatch -> device) and
+  ``GpuColumnarToRowExec.scala:35`` (device -> host rows) — ``to_arrow`` is the
+  download path.
+
+A DeviceBatch is columns padded to a common *capacity* (power-of-two bucket) with a
+host-side ``num_rows``; padding rows are invalid. Static shapes are what lets XLA
+reuse one compiled program per (schema, capacity) instead of recompiling per batch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import DeviceColumn, null_column
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+
+DEFAULT_STRING_MAX_BYTES = 256
+
+
+@dataclass(frozen=True)
+class DeviceBatch:
+    schema: Schema
+    columns: Tuple[DeviceColumn, ...]
+    num_rows: int
+
+    def __post_init__(self):
+        caps = {c.capacity for c in self.columns}
+        if len(caps) > 1:
+            raise ValueError(f"mixed capacities in batch: {caps}")
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket_capacity(self.num_rows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def device_size_bytes(self) -> int:
+        return sum(c.device_size_bytes for c in self.columns)
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    def with_columns(self, schema: Schema, columns: Sequence[DeviceColumn],
+                     num_rows: Optional[int] = None) -> "DeviceBatch":
+        return DeviceBatch(schema, tuple(columns),
+                           self.num_rows if num_rows is None else num_rows)
+
+    # ------------------------------------------------------------------ arrow I/O
+    @staticmethod
+    def from_arrow(table: pa.Table, string_max_bytes: int = DEFAULT_STRING_MAX_BYTES,
+                   bucketed: bool = True, device: Any = None) -> "DeviceBatch":
+        """Host arrow table -> device batch (single upload per buffer)."""
+        table = table.combine_chunks()
+        schema = Schema.from_pa(table.schema)
+        n = table.num_rows
+        cap = bucket_capacity(n, bucketed)
+        cols: List[DeviceColumn] = []
+        for i, f in enumerate(schema):
+            arr = table.column(i).combine_chunks()
+            if isinstance(arr, pa.ChunkedArray):
+                arr = (arr.chunk(0) if arr.num_chunks == 1
+                       else pa.concat_arrays(arr.chunks))
+            cols.append(_arrow_to_device(f.dtype, arr, cap, string_max_bytes, device))
+        return DeviceBatch(schema, tuple(cols), n)
+
+    def to_arrow(self) -> pa.Table:
+        """Download to a host arrow table (GpuColumnarToRow analog)."""
+        n = self.num_rows
+        arrays: List[pa.Array] = []
+        for f, col in zip(self.schema, self.columns):
+            arrays.append(_device_to_arrow(f.dtype, col, n))
+        return pa.Table.from_arrays(arrays, schema=self.schema.to_pa())
+
+    # ------------------------------------------------------------------ helpers
+    @staticmethod
+    def empty(schema: Schema, string_max_bytes: int = DEFAULT_STRING_MAX_BYTES,
+              capacity: int = 0) -> "DeviceBatch":
+        cap = max(capacity, 1)
+        cols = tuple(null_column(f.dtype, cap, string_max_bytes) for f in schema)
+        return DeviceBatch(schema, cols, 0)
+
+
+def _arrow_to_device(dtype: DType, arr: pa.Array, capacity: int,
+                     string_max_bytes: int, device: Any) -> DeviceColumn:
+    n = len(arr)
+    validity = _arrow_validity(arr)
+    if dtype is DType.STRING:
+        sarr = arr.cast(pa.string()) if not pa.types.is_string(arr.type) else arr
+        mat, lengths = _strings_to_matrix(sarr, string_max_bytes)
+        return DeviceColumn.from_numpy(dtype, mat, validity, capacity,
+                                       string_max_bytes, lengths, device)
+    if dtype is DType.TIMESTAMP:
+        np_data = np.asarray(arr.cast(pa.int64()).fill_null(0))
+    elif dtype is DType.DATE:
+        np_data = np.asarray(arr.cast(pa.int32()).fill_null(0))
+    elif dtype is DType.BOOLEAN:
+        np_data = np.asarray(arr.fill_null(False))
+    else:
+        np_data = np.asarray(arr.fill_null(0))
+    np_data = np_data.astype(dtype.np_dtype(), copy=False)
+    return DeviceColumn.from_numpy(dtype, np_data, validity, capacity, device=device)
+
+
+def _arrow_validity(arr: pa.Array) -> np.ndarray:
+    if arr.null_count == 0:
+        return np.ones(len(arr), dtype=np.bool_)
+    import pyarrow.compute as pc
+    return np.asarray(pc.is_valid(arr))
+
+
+def _strings_to_matrix(arr: pa.StringArray, max_bytes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Arrow (offsets, bytes) -> fixed-width byte matrix + lengths.
+
+    Vectorized: the concatenated UTF-8 payload is row-major in arrow, so a boolean
+    ragged mask scatters it into the matrix in one numpy op.
+    """
+    n = len(arr)
+    if n == 0:
+        return np.zeros((0, max_bytes), np.uint8), np.zeros(0, np.int32)
+    arr = arr.fill_null("")
+    offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32,
+                            count=n + 1, offset=arr.offset * 4)
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if lengths.max(initial=0) > max_bytes:
+        raise ValueError(
+            f"string of {lengths.max()} bytes exceeds device string width {max_bytes} "
+            f"(spark.rapids.tpu.sql.string.maxBytes)")
+    data_buf = arr.buffers()[2]
+    payload = (np.frombuffer(data_buf, dtype=np.uint8,
+                             count=int(offsets[-1]) - int(offsets[0]),
+                             offset=int(offsets[0]))
+               if data_buf is not None else np.zeros(0, np.uint8))
+    mat = np.zeros((n, max_bytes), dtype=np.uint8)
+    mask = np.arange(max_bytes, dtype=np.int32)[None, :] < lengths[:, None]
+    mat[mask] = payload
+    return mat, lengths
+
+
+def _device_to_arrow(dtype: DType, col: DeviceColumn, num_rows: int) -> pa.Array:
+    data, validity, lengths = col.to_numpy(num_rows)
+    mask = ~validity  # arrow mask semantics: True = null
+    if dtype is DType.STRING:
+        sel = np.arange(int(lengths.max()) if num_rows else 0)[None, :] < lengths[:, None]
+        payload = data[:, :sel.shape[1]][sel] if num_rows else np.zeros(0, np.uint8)
+        offsets = np.zeros(num_rows + 1, dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        return pa.StringArray.from_buffers(
+            num_rows,
+            pa.py_buffer(offsets.tobytes()),
+            pa.py_buffer(payload.tobytes()),
+            pa.py_buffer(np.packbits(validity, bitorder="little").tobytes()),
+            int(mask.sum()))
+    null_count = int(mask.sum())
+    validity_buf = (None if null_count == 0
+                    else pa.py_buffer(np.packbits(validity, bitorder="little").tobytes()))
+    if dtype is DType.BOOLEAN:
+        data_buf = pa.py_buffer(np.packbits(data, bitorder="little").tobytes())
+    else:
+        data_buf = pa.py_buffer(np.ascontiguousarray(data).tobytes())
+    storage_type = {DType.TIMESTAMP: pa.int64(), DType.DATE: pa.int32()}.get(
+        dtype, dtype.pa_type())
+    out = pa.Array.from_buffers(storage_type, num_rows, [validity_buf, data_buf],
+                                null_count)
+    return out.cast(dtype.pa_type()) if storage_type != dtype.pa_type() else out

@@ -1,0 +1,189 @@
+"""SQL type system and its mapping onto device (jax) and host (pyarrow) types.
+
+Covers the v0-supported type set of the reference (GpuOverrides.isSupportedType,
+GpuOverrides.scala:389 — boolean, byte, short, int, long, float, double, string, date,
+timestamp; no decimal/array/map/struct/calendar in v0). Dates are int32 days since
+epoch, timestamps int64 microseconds since epoch UTC, matching Spark's Catalyst
+physical representation.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+class DType(enum.Enum):
+    BOOLEAN = "boolean"
+    BYTE = "byte"
+    SHORT = "short"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    STRING = "string"
+    DATE = "date"
+    TIMESTAMP = "timestamp"
+    NULL = "null"
+
+    # ---- classification ---------------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self in _NUMERIC
+
+    @property
+    def is_integral(self) -> bool:
+        return self in _INTEGRAL
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (DType.FLOAT, DType.DOUBLE)
+
+    @property
+    def is_string(self) -> bool:
+        return self is DType.STRING
+
+    @property
+    def is_datetime(self) -> bool:
+        return self in (DType.DATE, DType.TIMESTAMP)
+
+    # ---- device representation ---------------------------------------------------
+    def np_dtype(self) -> np.dtype:
+        """Numpy/jax element dtype of the device data buffer."""
+        return _NP[self]
+
+    def element_size(self) -> int:
+        if self is DType.STRING:
+            raise ValueError("string has no fixed element size; see DeviceColumn")
+        return np.dtype(_NP[self]).itemsize
+
+    # ---- host (arrow) representation ---------------------------------------------
+    def pa_type(self) -> pa.DataType:
+        return _PA[self]
+
+    @staticmethod
+    def from_pa(t: pa.DataType) -> "DType":
+        for dt, pat in _PA.items():
+            if pat.equals(t):
+                return dt
+        if pa.types.is_large_string(t):
+            return DType.STRING
+        if pa.types.is_timestamp(t):
+            return DType.TIMESTAMP
+        raise TypeError(f"unsupported arrow type {t} (reference also gates types at "
+                        f"GpuOverrides.isSupportedType)")
+
+    @staticmethod
+    def common_numeric(a: "DType", b: "DType") -> "DType":
+        """Numeric widening like Catalyst's binary-op type coercion."""
+        order = [DType.BYTE, DType.SHORT, DType.INT, DType.LONG, DType.FLOAT, DType.DOUBLE]
+        if a not in order or b not in order:
+            raise TypeError(f"no common numeric type for {a} and {b}")
+        return order[max(order.index(a), order.index(b))]
+
+
+_NUMERIC = {DType.BYTE, DType.SHORT, DType.INT, DType.LONG, DType.FLOAT, DType.DOUBLE}
+_INTEGRAL = {DType.BYTE, DType.SHORT, DType.INT, DType.LONG}
+
+_NP = {
+    DType.BOOLEAN: np.dtype(np.bool_),
+    DType.BYTE: np.dtype(np.int8),
+    DType.SHORT: np.dtype(np.int16),
+    DType.INT: np.dtype(np.int32),
+    DType.LONG: np.dtype(np.int64),
+    DType.FLOAT: np.dtype(np.float32),
+    DType.DOUBLE: np.dtype(np.float64),
+    DType.STRING: np.dtype(np.uint8),   # byte-matrix payload
+    DType.DATE: np.dtype(np.int32),     # days since epoch
+    DType.TIMESTAMP: np.dtype(np.int64),  # microseconds since epoch UTC
+    DType.NULL: np.dtype(np.int8),
+}
+
+_PA = {
+    DType.BOOLEAN: pa.bool_(),
+    DType.BYTE: pa.int8(),
+    DType.SHORT: pa.int16(),
+    DType.INT: pa.int32(),
+    DType.LONG: pa.int64(),
+    DType.FLOAT: pa.float32(),
+    DType.DOUBLE: pa.float64(),
+    DType.STRING: pa.string(),
+    DType.DATE: pa.date32(),
+    DType.TIMESTAMP: pa.timestamp("us", tz="UTC"),
+    DType.NULL: pa.null(),
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DType
+    nullable: bool = True
+
+    def __repr__(self) -> str:
+        return f"{self.name}:{self.dtype.value}{'' if self.nullable else '!'}"
+
+
+class Schema:
+    """Ordered, name-addressable field list (StructType analog)."""
+
+    def __init__(self, fields: Sequence[Field]):
+        self.fields: Tuple[Field, ...] = tuple(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        if len(self._index) != len(self.fields):
+            raise ValueError(f"duplicate field names in {self.fields}")
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+    def index_of(self, name: str) -> int:
+        if name not in self._index:
+            raise KeyError(f"no field {name!r} in {self}")
+        return self._index[name]
+
+    def field(self, name: str) -> Field:
+        return self.fields[self.index_of(name)]
+
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def to_pa(self) -> pa.Schema:
+        return pa.schema([pa.field(f.name, f.dtype.pa_type(), f.nullable)
+                          for f in self.fields])
+
+    @staticmethod
+    def from_pa(s: pa.Schema) -> "Schema":
+        return Schema([Field(f.name, DType.from_pa(f.type), f.nullable) for f in s])
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"
+
+
+def bucket_capacity(num_rows: int, bucketed: bool = True, minimum: int = 128) -> int:
+    """Row capacity for a device batch.
+
+    Power-of-two bucketing keeps the set of distinct array shapes small so XLA
+    compilation caches hit across batches — the TPU replacement for cuDF's
+    exact-sized device buffers (recompiling per batch size would dominate runtime).
+    """
+    if not bucketed:
+        return max(num_rows, 1)
+    cap = minimum
+    while cap < num_rows:
+        cap <<= 1
+    return cap

@@ -1,0 +1,393 @@
+"""Typed configuration system for the TPU accelerator.
+
+TPU-native analog of the reference's ``RapidsConf`` builder DSL
+(reference: sql-plugin RapidsConf.scala:235 ``conf(key)``, ~60 ``spark.rapids.*`` keys,
+doc generation at RapidsConf.scala:641).
+
+Every tunable in the framework is declared here with a type, default, and doc string.
+``TpuConf`` is an immutable snapshot of key->value overrides layered over the defaults;
+``generate_docs()`` emits the markdown configuration reference (analog of docs/configs.md).
+
+Per-rule enable keys (``spark.rapids.tpu.sql.expression.<Name>`` etc.) are derived
+dynamically by the rule registry (see plan/overrides.py), mirroring
+GpuOverrides.scala:126 ``ReplacementRule.confKey``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+_PREFIX = "spark.rapids.tpu"
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    """One declared configuration key (analog of ConfEntry, RapidsConf.scala)."""
+
+    key: str
+    conf_type: type
+    default: Any
+    doc: str
+    internal: bool = False
+    checker: Optional[Callable[[Any], Optional[str]]] = None
+
+    def convert(self, raw: Any) -> Any:
+        if raw is None:
+            return None
+        if self.conf_type is bool:
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).strip().lower() in ("true", "1", "yes", "on")
+        if self.conf_type is int:
+            return int(str(raw), 0) if isinstance(raw, str) else int(raw)
+        if self.conf_type is float:
+            return float(raw)
+        return str(raw)
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _conf(key: str, conf_type: type, default: Any, doc: str,
+          internal: bool = False,
+          checker: Optional[Callable[[Any], Optional[str]]] = None) -> ConfEntry:
+    full = key if key.startswith(_PREFIX) else f"{_PREFIX}.{key}"
+    entry = ConfEntry(full, conf_type, default, doc, internal, checker)
+    with _REG_LOCK:
+        if full in _REGISTRY:
+            raise ValueError(f"duplicate conf key {full}")
+        _REGISTRY[full] = entry
+    return entry
+
+
+def _positive(name: str) -> Callable[[Any], Optional[str]]:
+    def check(v: Any) -> Optional[str]:
+        return None if v > 0 else f"{name} must be > 0, got {v}"
+    return check
+
+
+def _fraction(name: str) -> Callable[[Any], Optional[str]]:
+    def check(v: Any) -> Optional[str]:
+        return None if 0.0 < v <= 1.0 else f"{name} must be in (0, 1], got {v}"
+    return check
+
+
+# --------------------------------------------------------------------------------------
+# General / plan-rewrite keys (analog of spark.rapids.sql.* in RapidsConf.scala)
+# --------------------------------------------------------------------------------------
+SQL_ENABLED = _conf(
+    "sql.enabled", bool, True,
+    "Enable (true) or disable (false) TPU acceleration of Spark SQL plans. When disabled "
+    "every operator runs on the CPU engine (analog of spark.rapids.sql.enabled).")
+
+EXPLAIN = _conf(
+    "sql.explain", str, "NONE",
+    "Explain why parts of a query were or were not placed on the TPU. Values: NONE, "
+    "NOT_ON_TPU (print only fallback reasons), ALL (analog of spark.rapids.sql.explain).")
+
+INCOMPATIBLE_OPS = _conf(
+    "sql.incompatibleOps.enabled", bool, False,
+    "Enable operators that produce results slightly different from Spark's CPU semantics "
+    "(e.g. float-sum ordering). Analog of spark.rapids.sql.incompatibleOps.enabled.")
+
+HAS_NANS = _conf(
+    "sql.hasNans", bool, True,
+    "Assume floating point columns may contain NaN; some ops (joins/aggregates on float "
+    "keys) fall back when true. Analog of spark.rapids.sql.hasNans.")
+
+ENABLE_FLOAT_AGG = _conf(
+    "sql.variableFloatAgg.enabled", bool, False,
+    "Allow float/double aggregations whose result can vary with evaluation order "
+    "(parallel reductions). Analog of spark.rapids.sql.variableFloatAgg.enabled.")
+
+IMPROVED_FLOAT_OPS = _conf(
+    "sql.improvedFloatOps.enabled", bool, False,
+    "Enable float ops (e.g. string cast of floats) that do not match Spark bit-for-bit.")
+
+ENABLE_CAST_FLOAT_TO_STRING = _conf(
+    "sql.castFloatToString.enabled", bool, False,
+    "Cast float/double to string on the TPU; formatting may differ from Java in corner "
+    "cases. Analog of spark.rapids.sql.castFloatToString.enabled.")
+
+ENABLE_CAST_STRING_TO_FLOAT = _conf(
+    "sql.castStringToFloat.enabled", bool, False,
+    "Cast string to float/double on the TPU; some edge-case literals differ from Java.")
+
+ENABLE_CAST_STRING_TO_TS = _conf(
+    "sql.castStringToTimestamp.enabled", bool, False,
+    "Cast string to timestamp on the TPU (UTC only).")
+
+ENABLE_CAST_FLOAT_TO_INT = _conf(
+    "sql.castFloatToIntegralTypes.enabled", bool, False,
+    "Cast float/double to integral types with Spark 3.1+ ANSI-overflow semantics.")
+
+TEST_CONF = _conf(
+    "sql.test.enabled", bool, False,
+    "Test-mode: assert every supported operator actually ran on the TPU "
+    "(analog of spark.rapids.sql.test.enabled).", internal=True)
+
+TEST_ALLOWED_NONTPU = _conf(
+    "sql.test.allowedNonTpu", str, "",
+    "Comma-separated class names permitted to stay on CPU in test-mode.", internal=True)
+
+MAX_READER_BATCH_SIZE_ROWS = _conf(
+    "sql.reader.batchSizeRows", int, 2147483647,
+    "Soft cap on rows per batch produced by scans "
+    "(analog of spark.rapids.sql.reader.batchSizeRows).", checker=_positive("batchSizeRows"))
+
+MAX_READER_BATCH_SIZE_BYTES = _conf(
+    "sql.reader.batchSizeBytes", int, 2147483647,
+    "Soft cap on bytes per batch produced by scans "
+    "(analog of spark.rapids.sql.reader.batchSizeBytes).", checker=_positive("batchSizeBytes"))
+
+TPU_BATCH_SIZE_BYTES = _conf(
+    "sql.batchSizeBytes", int, 1 << 31,
+    "Target size for coalesced batches flowing between TPU operators (analog of "
+    "spark.rapids.sql.batchSizeBytes; default 2 GiB).", checker=_positive("batchSizeBytes"))
+
+BATCH_CAPACITY_BUCKETS = _conf(
+    "sql.batch.capacityBuckets", bool, True,
+    "Pad device batches to power-of-two row-capacity buckets so XLA re-uses compiled "
+    "programs across batches (TPU-specific: static shapes avoid recompilation).")
+
+STRING_MAX_BYTES = _conf(
+    "sql.string.maxBytes", int, 256,
+    "Fixed per-row byte width of device string columns. Device strings are stored as a "
+    "[rows, maxBytes] uint8 matrix plus a length vector (TPU-friendly layout); rows longer "
+    "than this fall back to CPU.", checker=_positive("string.maxBytes"))
+
+REPLACE_SORT_MERGE_JOIN = _conf(
+    "sql.replaceSortMergeJoin.enabled", bool, True,
+    "Replace CPU sort-merge joins with TPU shuffled-hash joins, dropping the sorts "
+    "(analog of spark.rapids.sql.replaceSortMergeJoin.enabled).")
+
+ENABLE_TOTAL_ORDER_SORT = _conf(
+    "sql.allowIncompatUTF8Strings", bool, False,
+    "Treat device string ordering (raw byte order) as compatible with Spark's UTF-8 "
+    "string ordering for sorts and comparisons.")
+
+UDF_COMPILER_ENABLED = _conf(
+    "sql.udfCompiler.enabled", bool, False,
+    "Compile Python row UDFs into columnar expression trees so they ride the normal "
+    "acceleration path (analog of spark.rapids.sql.udfCompiler.enabled).")
+
+# --------------------------------------------------------------------------------------
+# Memory / scheduling (analog of spark.rapids.memory.*)
+# --------------------------------------------------------------------------------------
+CONCURRENT_TPU_TASKS = _conf(
+    "sql.concurrentTpuTasks", int, 2,
+    "Number of tasks that may hold the TPU concurrently; the device-admission semaphore "
+    "blocks the rest (analog of spark.rapids.sql.concurrentGpuTasks).",
+    checker=_positive("concurrentTpuTasks"))
+
+DEVICE_POOL_FRACTION = _conf(
+    "memory.tpu.allocFraction", float, 0.9,
+    "Fraction of available HBM the buffer arena may occupy "
+    "(analog of spark.rapids.memory.gpu.allocFraction).", checker=_fraction("allocFraction"))
+
+DEVICE_POOL_BYTES = _conf(
+    "memory.tpu.poolSizeBytes", int, 0,
+    "Explicit HBM arena size in bytes; 0 means derive from allocFraction and the "
+    "detected device memory.")
+
+HOST_SPILL_STORAGE_SIZE = _conf(
+    "memory.host.spillStorageSize", int, 1 << 30,
+    "Bytes of host memory used to hold batches spilled from HBM "
+    "(analog of spark.rapids.memory.host.spillStorageSize).",
+    checker=_positive("spillStorageSize"))
+
+PAGEABLE_POOL_SIZE = _conf(
+    "memory.host.pageablePool.size", int, 1 << 30,
+    "Size of the host staging pool used for device<->host transfers.")
+
+MEMORY_DEBUG = _conf(
+    "memory.tpu.debug", bool, False,
+    "Log allocator activity for leak hunting (analog of spark.rapids.memory.gpu.debug).")
+
+UNSPILL_ENABLED = _conf(
+    "memory.tpu.unspill.enabled", bool, False,
+    "Promote spilled buffers back to HBM when re-referenced.")
+
+# --------------------------------------------------------------------------------------
+# Shuffle (analog of spark.rapids.shuffle.*)
+# --------------------------------------------------------------------------------------
+SHUFFLE_TRANSPORT_CLASS = _conf(
+    "shuffle.transport.class", str,
+    "spark_rapids_tpu.shuffle.transport.LocalShuffleTransport",
+    "Fully qualified class of the shuffle transport. The ICI transport moves batches "
+    "device-to-device over the mesh interconnect; Local moves them through host memory "
+    "(analog of spark.rapids.shuffle.transport.class selecting the UCX transport).")
+
+SHUFFLE_MAX_INFLIGHT_BYTES = _conf(
+    "shuffle.maxReceiveInflightBytes", int, 1 << 30,
+    "Per-client cap on bytes of shuffle data in flight "
+    "(analog of spark.rapids.shuffle.ucx.maxReceiveInflightBytes).")
+
+SHUFFLE_BOUNCE_BUFFER_SIZE = _conf(
+    "shuffle.bounceBuffers.size", int, 4 << 20,
+    "Size of each bounce buffer used to stage shuffle sends/receives.")
+
+SHUFFLE_BOUNCE_BUFFER_COUNT = _conf(
+    "shuffle.bounceBuffers.count", int, 32,
+    "Number of bounce buffers per direction.")
+
+SHUFFLE_COMPRESSION_CODEC = _conf(
+    "shuffle.compression.codec", str, "none",
+    "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), zstd "
+    "(analog of spark.rapids.shuffle.compression.codec).")
+
+SHUFFLE_PARTITIONING_MAX_CPU_BATCH = _conf(
+    "shuffle.partitioning.maxCpuBatchSize", int, 1 << 31,
+    "Batches above this size are partitioned on device.", internal=True)
+
+# --------------------------------------------------------------------------------------
+# I/O formats (analog of spark.rapids.sql.format.*)
+# --------------------------------------------------------------------------------------
+PARQUET_ENABLED = _conf(
+    "sql.format.parquet.enabled", bool, True,
+    "Enable TPU parquet scan/write as a whole.")
+PARQUET_READ_ENABLED = _conf(
+    "sql.format.parquet.read.enabled", bool, True, "Enable TPU parquet scans.")
+PARQUET_WRITE_ENABLED = _conf(
+    "sql.format.parquet.write.enabled", bool, True, "Enable TPU parquet writes.")
+PARQUET_DEBUG_DUMP_PREFIX = _conf(
+    "sql.parquet.debug.dumpPrefix", str, "",
+    "If set, dump the host-staged parquet data for each scan to this path prefix.")
+ORC_ENABLED = _conf(
+    "sql.format.orc.enabled", bool, True, "Enable TPU ORC scan/write as a whole.")
+ORC_READ_ENABLED = _conf(
+    "sql.format.orc.read.enabled", bool, True, "Enable TPU ORC scans.")
+ORC_WRITE_ENABLED = _conf(
+    "sql.format.orc.write.enabled", bool, True, "Enable TPU ORC writes.")
+CSV_ENABLED = _conf(
+    "sql.format.csv.enabled", bool, True, "Enable TPU CSV scanning as a whole.")
+CSV_READ_ENABLED = _conf(
+    "sql.format.csv.read.enabled", bool, True, "Enable TPU CSV scans.")
+
+# --------------------------------------------------------------------------------------
+# Mesh / distributed execution (TPU-specific; no direct reference analog — replaces
+# the executor-per-GPU model with SPMD over a jax.sharding.Mesh)
+# --------------------------------------------------------------------------------------
+MESH_DATA_AXIS = _conf(
+    "mesh.dataAxis", str, "data",
+    "Name of the mesh axis batches are partitioned over for distributed execution.")
+
+MESH_SHAPE = _conf(
+    "mesh.shape", str, "",
+    "Comma-separated mesh shape, e.g. '8' or '4,2'. Empty means one axis over all "
+    "visible devices.")
+
+METRICS_ENABLED = _conf(
+    "metrics.enabled", bool, True,
+    "Collect per-operator metrics (rows, batches, op time) — analog of SQLMetrics.")
+
+TRACE_ENABLED = _conf(
+    "trace.enabled", bool, False,
+    "Emit named jax.profiler ranges per operator (analog of NVTX ranges).")
+
+
+class TpuConf:
+    """Immutable snapshot of configuration overrides (analog of RapidsConf)."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {}
+        if overrides:
+            for key, raw in overrides.items():
+                entry = _REGISTRY.get(key)
+                if entry is None:
+                    # Unknown keys under our prefix are kept for dynamic per-rule
+                    # enable keys; anything else is ignored like Spark does.
+                    self._values[key] = raw
+                    continue
+                val = entry.convert(raw)
+                if entry.checker is not None:
+                    err = entry.checker(val)
+                    if err:
+                        raise ValueError(f"{key}: {err}")
+                self._values[key] = val
+
+    def get(self, entry: ConfEntry) -> Any:
+        if entry.key in self._values:
+            return self._values[entry.key]
+        return entry.default
+
+    def get_raw(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def is_rule_enabled(self, conf_key: str, default: bool = True) -> bool:
+        raw = self._values.get(conf_key)
+        if raw is None:
+            return default
+        return str(raw).strip().lower() in ("true", "1", "yes", "on")
+
+    def with_overrides(self, extra: Dict[str, Any]) -> "TpuConf":
+        merged = dict(self._values)
+        merged.update(extra)
+        return TpuConf(merged)
+
+    # Convenience properties for hot keys -------------------------------------------------
+    @property
+    def sql_enabled(self) -> bool: return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str: return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_bytes(self) -> int: return self.get(TPU_BATCH_SIZE_BYTES)
+
+    @property
+    def string_max_bytes(self) -> int: return self.get(STRING_MAX_BYTES)
+
+    @property
+    def is_test_enabled(self) -> bool: return self.get(TEST_CONF)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+
+
+def all_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def generate_docs(include_internal: bool = False) -> str:
+    """Emit the markdown configuration reference (analog of RapidsConf.help(),
+    RapidsConf.scala:641 -> docs/configs.md)."""
+    lines = [
+        "# TPU Accelerator Configuration",
+        "",
+        "All configs are set like ordinary Spark confs. Generated by "
+        "`python -m spark_rapids_tpu.config`.",
+        "",
+        "| Name | Description | Default |",
+        "|---|---|---|",
+    ]
+    for entry in all_entries():
+        if entry.internal and not include_internal:
+            continue
+        lines.append(f"| {entry.key} | {entry.doc} | {entry.default} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def from_environ() -> TpuConf:
+    """Build a TpuConf from SPARK_RAPIDS_TPU_* environment variables (key dots -> _)."""
+    overrides: Dict[str, Any] = {}
+    for env_key, val in os.environ.items():
+        if env_key.startswith("SPARK_RAPIDS_TPU_"):
+            key = _PREFIX + "." + env_key[len("SPARK_RAPIDS_TPU_"):].lower().replace("_", ".")
+            overrides[key] = val
+    return TpuConf(overrides)
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    text = generate_docs()
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+    else:
+        print(text)

@@ -1,0 +1,25 @@
+"""Central jax runtime setup.
+
+Every module that touches jax imports it through here so process-wide settings are
+applied exactly once:
+
+- ``jax_enable_x64``: Spark's LONG/DOUBLE semantics require true 64-bit arithmetic;
+  jax's default 32-bit mode silently truncates. On TPU, int64 is natively supported
+  and float64 is compiler-emulated — correctness first, with an opt-in
+  ``variableFloatAgg``-style downgrade path for perf-critical double math later.
+"""
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402,F401
+
+
+def default_device():
+    return jax.devices()[0]
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,64 @@
+"""Result-comparison harness.
+
+Analog of the reference's integration-test comparison machinery:
+- ``integration_tests/src/main/python/asserts.py`` ``_assert_equal`` (deep CPU-vs-GPU
+  result compare with NaN-equality and approximate floats);
+- ``tests/.../SparkQueryCompareTestSuite.scala:655`` ``compareResults`` (sort-before-
+  compare, float tolerance knobs).
+
+Used both by unit tests and by the CPU-vs-TPU compare fixtures.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+def _normalize(table: pa.Table) -> pa.Table:
+    return table.combine_chunks()
+
+
+def _sort_table(table: pa.Table) -> pa.Table:
+    keys = [(name, "ascending") for name in table.column_names]
+    return table.sort_by(keys)
+
+
+def _values_equal(a: Any, b: Any, approx: Optional[float]) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if approx is not None:
+            if a == b:
+                return True
+            denom = max(abs(a), abs(b))
+            return denom != 0 and abs(a - b) / denom <= approx
+        return a == b
+    return a == b
+
+
+def assert_tables_equal(expected: pa.Table, actual: pa.Table,
+                        ignore_order: bool = False,
+                        approx_float: Optional[float] = None) -> None:
+    """Deep-compare two arrow tables, NaN == NaN, optional unordered/approx modes."""
+    expected = _normalize(expected)
+    actual = _normalize(actual)
+    assert expected.schema.equals(actual.schema), (
+        f"schema mismatch:\nexpected {expected.schema}\nactual   {actual.schema}")
+    assert expected.num_rows == actual.num_rows, (
+        f"row count mismatch: expected {expected.num_rows}, actual {actual.num_rows}")
+    if ignore_order and expected.num_rows > 1:
+        # NaN-safe unordered compare: sorting with NaN/null works in arrow
+        # (nulls last, NaN after numbers), so sorted tables line up row-wise.
+        expected = _sort_table(expected)
+        actual = _sort_table(actual)
+    for name in expected.column_names:
+        ecol = expected.column(name).to_pylist()
+        acol = actual.column(name).to_pylist()
+        for i, (e, a) in enumerate(zip(ecol, acol)):
+            assert _values_equal(e, a, approx_float), (
+                f"column {name!r} row {i}: expected {e!r}, actual {a!r}")

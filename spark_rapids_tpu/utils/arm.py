@@ -1,0 +1,79 @@
+"""Resource-lifetime helpers.
+
+Analog of the reference's ``Arm`` trait (Arm.scala: ``withResource``/``closeOnExcept``)
+and the ref-counted buffer conventions in RapidsBufferStore.scala:253. JAX arrays are
+garbage collected, but spillable buffers, host staging memory, and shuffle handles need
+deterministic close/refcount semantics, which these helpers provide.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterable, Iterator
+
+
+@contextlib.contextmanager
+def closing_on_except(resource: Any) -> Iterator[Any]:
+    """Close ``resource`` only if the body raises (analog of Arm.closeOnExcept)."""
+    try:
+        yield resource
+    except BaseException:
+        with contextlib.suppress(Exception):
+            resource.close()
+        raise
+
+
+def close_all(resources: Iterable[Any]) -> None:
+    first_err = None
+    for r in resources:
+        try:
+            if r is not None:
+                r.close()
+        except Exception as e:  # noqa: BLE001 - collect and re-raise first
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+
+
+class Retainable:
+    """Ref-counted resource. Subclasses override ``_on_release``.
+
+    Mirrors the acquire/release discipline of RapidsBuffer (RapidsBuffer.scala:61):
+    constructed with refcount 1; ``retain`` bumps; ``close`` drops; the final drop
+    triggers ``_on_release``. Double-close raises.
+    """
+
+    def __init__(self) -> None:
+        self._refcount = 1
+        self._lock = threading.Lock()
+
+    def retain(self) -> "Retainable":
+        with self._lock:
+            if self._refcount <= 0:
+                raise ValueError(f"retain() after close: {self!r}")
+            self._refcount += 1
+        return self
+
+    @property
+    def refcount(self) -> int:
+        with self._lock:
+            return self._refcount
+
+    def close(self) -> None:
+        with self._lock:
+            if self._refcount <= 0:
+                raise ValueError(f"double close: {self!r}")
+            self._refcount -= 1
+            release = self._refcount == 0
+        if release:
+            self._on_release()
+
+    def _on_release(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    def __enter__(self) -> "Retainable":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

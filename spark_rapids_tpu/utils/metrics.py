@@ -1,0 +1,101 @@
+"""Per-operator metrics and named trace ranges.
+
+Analog of the reference's SQLMetrics wiring (GpuExec.scala:28-52 GpuMetricNames:
+numOutputRows, numOutputBatches, totalTime, peakDevMemory, bufferTime, ...) and the
+NVTX named ranges (NvtxWithMetrics.scala:44). On TPU the tracing backend is
+``jax.profiler.TraceAnnotation``; ranges stay tied to an operator metric exactly like
+NvtxWithMetrics ties a range to a SQLMetric.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+# Standard metric names (GpuMetricNames analog, GpuExec.scala:28-52)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+TOTAL_TIME = "totalTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+BUFFER_TIME = "bufferTime"
+DECODE_TIME = "tpuDecodeTime"
+
+
+class Metric:
+    __slots__ = ("name", "unit", "_value", "_lock")
+
+    def __init__(self, name: str, unit: str = "sum"):
+        self.name = name
+        self.unit = unit
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: int) -> None:
+        with self._lock:
+            self._value += v
+
+    def set_max(self, v: int) -> None:
+        with self._lock:
+            self._value = max(self._value, v)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def __repr__(self) -> str:
+        return f"Metric({self.name}={self.value})"
+
+
+class MetricSet:
+    """Mutable bag of metrics owned by one physical operator instance."""
+
+    def __init__(self, *names: str):
+        self._metrics: Dict[str, Metric] = {n: Metric(n) for n in names}
+
+    def metric(self, name: str) -> Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = Metric(name)
+            self._metrics[name] = m
+        return m
+
+    def __getitem__(self, name: str) -> Metric:
+        return self.metric(name)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {n: m.value for n, m in self._metrics.items()}
+
+
+class NamedRange:
+    """Timed, profiler-visible range tied to a metric (NvtxWithMetrics analog).
+
+    Adds elapsed nanoseconds to ``metric`` on exit and, when tracing is enabled,
+    shows up as a named range in the XLA/TensorBoard profile.
+    """
+
+    def __init__(self, name: str, metric: Optional[Metric] = None, trace: bool = False):
+        self._name = name
+        self._metric = metric
+        self._trace = trace
+        self._ctx = None
+        self._t0 = 0
+
+    def __enter__(self) -> "NamedRange":
+        if self._trace:
+            try:
+                import jax.profiler
+                self._ctx = jax.profiler.TraceAnnotation(self._name)
+                self._ctx.__enter__()
+            except Exception:
+                self._ctx = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._metric is not None:
+            self._metric.add(time.perf_counter_ns() - self._t0)
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)

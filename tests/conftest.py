@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto 8 virtual CPU devices.
+
+Tests must not require the real TPU chip; multi-device sharding logic is exercised
+on a virtual CPU mesh (mirrors how the driver dry-runs multichip compilation).
+This must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs

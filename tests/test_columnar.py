@@ -1,0 +1,73 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import DType, DeviceBatch, Schema, bucket_capacity
+from spark_rapids_tpu.testing import assert_tables_equal
+
+
+def make_table():
+    return pa.table({
+        "i": pa.array([1, 2, None, 4, 5], type=pa.int32()),
+        "l": pa.array([10, None, 30, 40, 50], type=pa.int64()),
+        "d": pa.array([1.5, 2.5, 3.5, None, float("nan")], type=pa.float64()),
+        "b": pa.array([True, False, None, True, False], type=pa.bool_()),
+        "s": pa.array(["foo", "", None, "hello world", "zz"], type=pa.string()),
+        "dt": pa.array([0, 1, 18262, None, -1], type=pa.date32()),
+        "ts": pa.array([0, 1_000_000, None, 86_400_000_000, -5],
+                       type=pa.timestamp("us", tz="UTC")),
+    })
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 128
+    assert bucket_capacity(128) == 128
+    assert bucket_capacity(129) == 256
+    assert bucket_capacity(1000) == 1024
+    assert bucket_capacity(1000, bucketed=False) == 1000
+
+
+def test_arrow_roundtrip_preserves_everything():
+    t = make_table()
+    batch = DeviceBatch.from_arrow(t, string_max_bytes=32)
+    assert batch.num_rows == 5
+    assert batch.capacity == 128  # bucketed
+    back = batch.to_arrow()
+    assert_tables_equal(t, back)
+
+
+def test_empty_table_roundtrip():
+    t = make_table().slice(0, 0)
+    batch = DeviceBatch.from_arrow(t)
+    assert batch.num_rows == 0
+    assert batch.to_arrow().equals(t)
+
+
+def test_unicode_strings_roundtrip():
+    t = pa.table({"s": pa.array(["héllo", "日本語", "", None, "a" * 31])})
+    batch = DeviceBatch.from_arrow(t, string_max_bytes=32)
+    assert batch.to_arrow().equals(t)
+
+
+def test_string_too_wide_raises():
+    t = pa.table({"s": pa.array(["x" * 300])})
+    with pytest.raises(ValueError, match="maxBytes"):
+        DeviceBatch.from_arrow(t, string_max_bytes=256)
+
+
+def test_schema_mapping():
+    t = make_table()
+    s = Schema.from_pa(t.schema)
+    assert s.field("i").dtype == DType.INT
+    assert s.field("s").dtype == DType.STRING
+    assert s.field("dt").dtype == DType.DATE
+    assert s.field("ts").dtype == DType.TIMESTAMP
+    assert s.to_pa().equals(t.schema)
+
+
+def test_padding_rows_are_invalid():
+    t = make_table()
+    batch = DeviceBatch.from_arrow(t, string_max_bytes=32)
+    for col in batch.columns:
+        validity = np.asarray(col.validity)
+        assert not validity[batch.num_rows:].any()

@@ -1,0 +1,43 @@
+import pytest
+
+from spark_rapids_tpu import config
+from spark_rapids_tpu.config import TpuConf
+
+
+def test_defaults():
+    conf = TpuConf()
+    assert conf.sql_enabled is True
+    assert conf.explain == "NONE"
+    assert conf.batch_size_bytes == 1 << 31
+    assert conf.concurrent_tpu_tasks == 2
+
+
+def test_overrides_and_conversion():
+    conf = TpuConf({
+        "spark.rapids.tpu.sql.enabled": "false",
+        "spark.rapids.tpu.sql.explain": "NOT_ON_TPU",
+        "spark.rapids.tpu.sql.concurrentTpuTasks": "4",
+    })
+    assert conf.sql_enabled is False
+    assert conf.explain == "NOT_ON_TPU"
+    assert conf.concurrent_tpu_tasks == 4
+
+
+def test_checker_rejects_bad_values():
+    with pytest.raises(ValueError):
+        TpuConf({"spark.rapids.tpu.sql.concurrentTpuTasks": "0"})
+    with pytest.raises(ValueError):
+        TpuConf({"spark.rapids.tpu.memory.tpu.allocFraction": "1.5"})
+
+
+def test_rule_enable_keys_pass_through():
+    conf = TpuConf({"spark.rapids.tpu.sql.expression.Add": "false"})
+    assert conf.is_rule_enabled("spark.rapids.tpu.sql.expression.Add") is False
+    assert conf.is_rule_enabled("spark.rapids.tpu.sql.expression.Subtract") is True
+
+
+def test_doc_generation_covers_all_public_keys():
+    docs = config.generate_docs()
+    for entry in config.all_entries():
+        if not entry.internal:
+            assert entry.key in docs
