@@ -84,6 +84,26 @@ class DType(enum.Enum):
             raise TypeError(f"no common numeric type for {a} and {b}")
         return order[max(order.index(a), order.index(b))]
 
+    @staticmethod
+    def common_type(a: "DType", b: "DType") -> "DType":
+        """Catalyst-style least common type for multi-branch expressions
+        (coalesce/if/case-when/least/greatest): NULL yields the other side,
+        equal types pass through, numerics widen; anything else is an error."""
+        if a == b:
+            return a
+        if a is DType.NULL:
+            return b
+        if b is DType.NULL:
+            return a
+        return DType.common_numeric(a, b)
+
+    @staticmethod
+    def common_type_all(dtypes: Sequence["DType"]) -> "DType":
+        out = dtypes[0]
+        for dt in dtypes[1:]:
+            out = DType.common_type(out, dt)
+        return out
+
 
 _NUMERIC = {DType.BYTE, DType.SHORT, DType.INT, DType.LONG, DType.FLOAT, DType.DOUBLE}
 _INTEGRAL = {DType.BYTE, DType.SHORT, DType.INT, DType.LONG}

@@ -1,0 +1,83 @@
+"""Host-side batch in the same columnar layout as DeviceBatch, backed by numpy.
+
+Used by the CPU engine (fallback execution + compare-testing oracle) and as the
+staging representation for spill/shuffle serialization — the analog of
+RapidsHostColumnVector in the reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import (_arrow_validity, _device_to_arrow,
+                                             _strings_to_matrix)
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
+
+
+@dataclass(frozen=True)
+class HostColumn:
+    dtype: DType
+    data: np.ndarray
+    validity: np.ndarray
+    lengths: Optional[np.ndarray] = None
+
+    def to_numpy(self, num_rows: int):
+        return (self.data[:num_rows], self.validity[:num_rows],
+                self.lengths[:num_rows] if self.lengths is not None else None)
+
+    @property
+    def nbytes(self) -> int:
+        total = self.data.nbytes + self.validity.nbytes
+        if self.lengths is not None:
+            total += self.lengths.nbytes
+        return total
+
+
+@dataclass(frozen=True)
+class HostBatch:
+    schema: Schema
+    columns: Tuple[HostColumn, ...]
+    num_rows: int
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(c.nbytes for c in self.columns)
+
+    @staticmethod
+    def from_arrow(table: pa.Table, string_max_bytes: int = 256) -> "HostBatch":
+        table = table.combine_chunks()
+        schema = Schema.from_pa(table.schema)
+        cols: List[HostColumn] = []
+        for i, f in enumerate(schema):
+            arr = table.column(i)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = (arr.chunk(0) if arr.num_chunks == 1
+                       else pa.concat_arrays(arr.chunks))
+            validity = _arrow_validity(arr)
+            if f.dtype is DType.STRING:
+                mat, lengths = _strings_to_matrix(arr, string_max_bytes)
+                cols.append(HostColumn(f.dtype, mat, validity, lengths))
+                continue
+            if f.dtype is DType.TIMESTAMP:
+                data = np.asarray(arr.cast(pa.int64()).fill_null(0))
+            elif f.dtype is DType.DATE:
+                data = np.asarray(arr.cast(pa.int32()).fill_null(0))
+            elif f.dtype is DType.BOOLEAN:
+                data = np.asarray(arr.fill_null(False))
+            else:
+                data = np.asarray(arr.fill_null(0))
+            cols.append(HostColumn(f.dtype, data.astype(f.dtype.np_dtype(),
+                                                        copy=False), validity))
+        return HostBatch(schema, tuple(cols), table.num_rows)
+
+    def to_arrow(self) -> pa.Table:
+        arrays = [_device_to_arrow(f.dtype, c, self.num_rows)
+                  for f, c in zip(self.schema, self.columns)]
+        return pa.Table.from_arrays(arrays, schema=self.schema.to_pa())

@@ -1,0 +1,37 @@
+"""Expression layer: ~80 Spark-compatible columnar expressions (growing toward the
+reference's ~150), each evaluable eagerly on CPU (numpy) or traced into a fused
+XLA program on TPU."""
+from spark_rapids_tpu.exprs.core import (BoundReference, ColV, EvalCtx, Expression,
+                                         UnresolvedAttribute, bind_expression)
+from spark_rapids_tpu.exprs.literals import Literal
+from spark_rapids_tpu.exprs.arithmetic import (Abs, Add, Divide, Greatest,
+                                               IntegralDivide, Least, Multiply,
+                                               Pmod, Remainder, Subtract, UnaryMinus,
+                                               UnaryPositive)
+from spark_rapids_tpu.exprs.predicates import (And, EqualNullSafe, EqualTo,
+                                               GreaterThan, GreaterThanOrEqual, In,
+                                               LessThan, LessThanOrEqual, Not,
+                                               NotEqual, Or)
+from spark_rapids_tpu.exprs.nulls import (AtLeastNNonNulls, Coalesce, IsNan, IsNotNull,
+                                          IsNull, NaNvl)
+from spark_rapids_tpu.exprs.conditional import CaseWhen, If
+from spark_rapids_tpu.exprs.math import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos,
+                                         Cosh, Exp, Expm1, Floor, Log, Log1p, Log2,
+                                         Log10, Pow, Rint, Round, Signum, Sin, Sinh,
+                                         Sqrt, Tan, Tanh, ToDegrees, ToRadians)
+from spark_rapids_tpu.exprs.bitwise import (BitwiseAnd, BitwiseNot, BitwiseOr,
+                                            BitwiseXor, ShiftLeft, ShiftRight,
+                                            ShiftRightUnsigned)
+from spark_rapids_tpu.exprs.cast import Cast, can_cast_on_device
+from spark_rapids_tpu.exprs.strings import (Concat, Contains, EndsWith, Length, Like,
+                                            Lower, StartsWith, StringTrim, Substring,
+                                            Upper)
+from spark_rapids_tpu.exprs.datetime import (DateAdd, DateDiff, DateSub, DayOfMonth,
+                                             DayOfWeek, DayOfYear, Hour, LastDay,
+                                             Minute, Month, Quarter, Second, Year)
+from spark_rapids_tpu.exprs.aggregates import (AggregateFunction, Average, Count,
+                                               First, Last, Max, Min, Sum)
+from spark_rapids_tpu.exprs.misc import (Alias, KnownFloatingPointNormalized,
+                                         MonotonicallyIncreasingID,
+                                         NormalizeNaNAndZero, Rand, SortOrder,
+                                         SparkPartitionID)

@@ -1,0 +1,222 @@
+"""Aggregate functions (reference: AggregateFunctions.scala, 502 LoC —
+Count/Max/Min/Sum/Average/First/Last with cudf aggs).
+
+TPU design: an aggregate declares *buffer specs* — (projection of the input row,
+reduction kind) pairs. The hash-aggregate exec evaluates the projections, then
+applies the reduction per group via jax segment ops; the SAME reduction kind merges
+partial buffers across batches/partitions (Spark's update/merge symmetry), so
+Partial/PartialMerge/Final modes and distributed tree-reduction all reuse one
+kernel path.
+
+Reduction kinds: sum, min, max, first, last. Null handling: inputs are projected to
+(neutral value, 0/1 valid flag); a group's result is null iff no valid input
+reached it (Spark ignores nulls in aggs; count never returns null).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+
+
+@dataclass(frozen=True)
+class BufferSpec:
+    """One aggregation buffer: projected dtype + reduction kind.
+
+    ``ignore_nulls`` only matters for first/last: when True the reduction picks the
+    first/last *valid* row of the group; when False it picks the first/last row
+    outright (which may be null)."""
+    dtype: DType
+    kind: str  # sum | min | max | first | last
+    ignore_nulls: bool = False
+
+
+class AggregateFunction(Expression):
+    """Base for declarative aggregate functions. Not row-evaluable."""
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0] if self.children else None
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        raise TypeError(f"{type(self).__name__} must be evaluated by an aggregate exec")
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        raise NotImplementedError
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        """Input row -> per-buffer update values (pre-reduction)."""
+        raise NotImplementedError
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        """Reduced buffers -> final result column."""
+        raise NotImplementedError
+
+
+def _sum_dtype(dt: DType) -> DType:
+    if dt.is_floating:
+        return DType.DOUBLE
+    if dt.is_integral:
+        return DType.LONG
+    raise TypeError(f"sum of {dt}")
+
+
+@dataclass(frozen=True)
+class Sum(AggregateFunction):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return _sum_dtype(self.c.dtype())
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(self.dtype(), "sum")]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        v = self.c.eval(ctx)
+        dt = self.dtype()
+        data = ctx.xp.where(v.validity, v.data, 0).astype(dt.np_dtype())
+        return [ColV(dt, data, v.validity)]
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        return buffers[0]
+
+
+@dataclass(frozen=True)
+class Count(AggregateFunction):
+    """count(expr) — non-null count; count(1)/count(*) via Literal child."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.LONG
+
+    def nullable(self) -> bool:
+        return False
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(DType.LONG, "sum")]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        v = self.c.eval(ctx)
+        xp = ctx.xp
+        ones = v.validity.astype(np.int64)
+        if v.is_scalar:
+            ones = xp.broadcast_to(ones, (ctx.capacity,))
+        return [ColV(DType.LONG, ones, xp.ones_like(ones, dtype=bool))]
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        b = buffers[0]
+        # count is 0, not null, for all-null groups
+        return ColV(DType.LONG, b.data, xp.ones_like(b.validity, dtype=bool))
+
+
+class _MinMax(AggregateFunction):
+    kind = ""
+
+    def dtype(self) -> DType:
+        return self.c.dtype()
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(self.dtype(), self.kind)]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        v = self.c.eval(ctx)
+        xp = ctx.xp
+        neutral = _reduce_neutral(self.kind, v.dtype)
+        data = xp.where(v.validity, v.data, neutral)
+        return [ColV(v.dtype, data, v.validity)]
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        return buffers[0]
+
+
+@dataclass(frozen=True)
+class Min(_MinMax):
+    c: Expression
+    kind = "min"
+
+
+@dataclass(frozen=True)
+class Max(_MinMax):
+    c: Expression
+    kind = "max"
+
+
+@dataclass(frozen=True)
+class Average(AggregateFunction):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(DType.DOUBLE, "sum"), BufferSpec(DType.LONG, "sum")]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        v = self.c.eval(ctx)
+        xp = ctx.xp
+        s = xp.where(v.validity, v.data, 0).astype(np.float64)
+        n = v.validity.astype(np.int64)
+        ones = xp.ones_like(n, dtype=bool)
+        return [ColV(DType.DOUBLE, s, v.validity), ColV(DType.LONG, n, ones)]
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        s, n = buffers
+        cnt = n.data
+        safe = xp.where(cnt == 0, 1, cnt)
+        data = s.data / safe
+        valid = cnt > 0
+        return ColV(DType.DOUBLE, data, valid)
+
+
+class _FirstLast(AggregateFunction):
+    kind = ""
+
+    def dtype(self) -> DType:
+        return self.c.dtype()
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(self.dtype(), self.kind, self.ignore_nulls)]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        return [self.c.eval(ctx)]
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        return buffers[0]
+
+
+@dataclass(frozen=True)
+class First(_FirstLast):
+    c: Expression
+    ignore_nulls: bool = False
+    kind = "first"
+
+
+@dataclass(frozen=True)
+class Last(_FirstLast):
+    c: Expression
+    ignore_nulls: bool = False
+    kind = "last"
+
+
+def _reduce_neutral(kind: str, dt: DType):
+    """Neutral element substituted for null inputs before reduction."""
+    npdt = dt.np_dtype()
+    if kind == "sum":
+        return np.asarray(0, dtype=npdt)
+    if kind == "min":
+        if dt.is_floating:
+            return np.asarray(np.inf, dtype=npdt)
+        if dt is DType.BOOLEAN:
+            return True
+        return np.iinfo(npdt).max
+    if kind == "max":
+        if dt.is_floating:
+            return np.asarray(-np.inf, dtype=npdt)
+        if dt is DType.BOOLEAN:
+            return False
+        return np.iinfo(npdt).min
+    raise ValueError(kind)

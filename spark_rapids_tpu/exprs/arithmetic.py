@@ -1,0 +1,239 @@
+"""Arithmetic expressions (reference: arithmetic.scala, 227 LoC).
+
+Spark (non-ANSI) semantics encoded here:
+- integral add/sub/mul wrap like Java two's complement;
+- Divide always produces DOUBLE and returns NULL when the divisor is 0 (Spark's
+  Divide nulls out division by zero even for doubles — it never emits Inf from /0);
+- IntegralDivide (`div`) produces LONG, NULL on /0, truncating toward zero like Java;
+- Remainder/Pmod are NULL on /0; Remainder sign follows the dividend (Java %).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import (BinaryExpression, ColV, EvalCtx, Expression,
+                                         UnaryExpression, and_validity, cast_operands)
+
+
+@dataclass(frozen=True)
+class Add(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return _wrapping(ctx, lambda: l.data + r.data)
+
+
+@dataclass(frozen=True)
+class Subtract(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return _wrapping(ctx, lambda: l.data - r.data)
+
+
+@dataclass(frozen=True)
+class Multiply(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return _wrapping(ctx, lambda: l.data * r.data)
+
+
+def _wrapping(ctx: EvalCtx, fn):
+    """Java ints wrap on overflow; numpy warns, jnp just wraps."""
+    if ctx.is_tracing:
+        return fn()
+    with np.errstate(over="ignore"):
+        return fn()
+
+
+class _DivisorNullingBinary(BinaryExpression):
+    """Base for ops that are NULL when the divisor is zero."""
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        l, r = cast_operands(ctx, l, r, self.operand_dtype())
+        zero = r.data == 0
+        validity = xp.logical_and(and_validity(xp, l, r), xp.logical_not(zero))
+        data = self.do_div(ctx, l, r, zero)
+        return ColV(self.dtype(), data, validity,
+                    is_scalar=l.is_scalar and r.is_scalar)
+
+    def do_div(self, ctx: EvalCtx, l: ColV, r: ColV, zero):
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Divide(_DivisorNullingBinary):
+    l: Expression
+    r: Expression
+
+    def operand_dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def do_div(self, ctx: EvalCtx, l: ColV, r: ColV, zero):
+        xp = ctx.xp
+        safe = xp.where(zero, xp.asarray(1.0, dtype=r.data.dtype), r.data)
+        return l.data / safe
+
+
+@dataclass(frozen=True)
+class IntegralDivide(_DivisorNullingBinary):
+    l: Expression
+    r: Expression
+
+    def operand_dtype(self) -> DType:
+        return DType.LONG
+
+    def dtype(self) -> DType:
+        return DType.LONG
+
+    def do_div(self, ctx: EvalCtx, l: ColV, r: ColV, zero):
+        xp = ctx.xp
+        safe = xp.where(zero, xp.asarray(1, dtype=r.data.dtype), r.data)
+        # Java integer division truncates toward zero; // floors. Fix up.
+        q = l.data // safe
+        rem = l.data - q * safe
+        trunc_fix = xp.logical_and(rem != 0, (l.data < 0) != (safe < 0))
+        return (q + trunc_fix.astype(q.dtype)).astype(np.int64)
+
+
+@dataclass(frozen=True)
+class Remainder(_DivisorNullingBinary):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_div(self, ctx: EvalCtx, l: ColV, r: ColV, zero):
+        xp = ctx.xp
+        one = xp.asarray(1, dtype=r.data.dtype)
+        safe = xp.where(zero, one, r.data)
+        if self.operand_dtype().is_floating:
+            # Java % (fmod): sign follows dividend
+            return _wrapping(ctx, lambda: xp.fmod(l.data, safe))
+        m = _wrapping(ctx, lambda: xp.mod(l.data, safe))
+        # numpy mod floors (sign follows divisor); Java % truncates. Fix up.
+        fix = xp.logical_and(m != 0, (l.data < 0) != (safe < 0))
+        return m - xp.where(fix, safe, xp.asarray(0, dtype=safe.dtype))
+
+
+@dataclass(frozen=True)
+class Pmod(_DivisorNullingBinary):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_div(self, ctx: EvalCtx, l: ColV, r: ColV, zero):
+        xp = ctx.xp
+        one = xp.asarray(1, dtype=r.data.dtype)
+        safe = xp.where(zero, one, r.data)
+        if self.operand_dtype().is_floating:
+            m = xp.fmod(l.data, safe)
+            return xp.where(m < 0, xp.fmod(m + safe, safe), m)
+        m = xp.mod(xp.mod(l.data, safe) + safe, safe)
+        return m
+
+
+@dataclass(frozen=True)
+class UnaryMinus(UnaryExpression):
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        return _wrapping(ctx, lambda: -child.data)
+
+
+@dataclass(frozen=True)
+class UnaryPositive(UnaryExpression):
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        return child.data
+
+
+@dataclass(frozen=True)
+class Abs(UnaryExpression):
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        return _wrapping(ctx, lambda: ctx.xp.abs(child.data))
+
+
+@dataclass(frozen=True)
+class Least(Expression):
+    exprs: tuple
+
+    def dtype(self) -> DType:
+        return DType.common_type_all([e.dtype() for e in self.exprs])
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        return _least_greatest(self, ctx, greatest=False)
+
+
+@dataclass(frozen=True)
+class Greatest(Expression):
+    exprs: tuple
+
+    def dtype(self) -> DType:
+        return DType.common_type_all([e.dtype() for e in self.exprs])
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        return _least_greatest(self, ctx, greatest=True)
+
+
+def _least_greatest(node, ctx: EvalCtx, greatest: bool) -> ColV:
+    """Spark least/greatest skip nulls; NaN is greater than any other value."""
+    from spark_rapids_tpu.exprs.core import widen
+    xp = ctx.xp
+    dt = node.dtype()
+    vals = [widen(ctx, e.eval(ctx), dt) for e in node.exprs]
+    out_data = None
+    out_valid = None
+    for v in vals:
+        if out_data is None:
+            out_data, out_valid = v.data, v.validity
+            continue
+        if greatest:
+            better = xp.logical_or(_nan_gt(xp, v.data, out_data),
+                                   xp.logical_not(out_valid))
+        else:
+            better = xp.logical_or(_nan_gt(xp, out_data, v.data),
+                                   xp.logical_not(out_valid))
+        take = xp.logical_and(v.validity, better)
+        out_data = xp.where(take, v.data, out_data)
+        out_valid = xp.logical_or(out_valid, v.validity)
+    return ColV(dt, out_data, out_valid,
+                is_scalar=all(v.is_scalar for v in vals))
+
+
+def _nan_gt(xp, a, b):
+    """a > b with NaN treated as greater than everything (Spark ordering)."""
+    if np.issubdtype(np.asarray(a).dtype if xp is np else a.dtype, np.floating):
+        a_nan = xp.isnan(a)
+        b_nan = xp.isnan(b)
+        return xp.logical_or(xp.logical_and(a_nan, xp.logical_not(b_nan)),
+                             xp.logical_and(xp.logical_not(b_nan), a > b))
+    return a > b

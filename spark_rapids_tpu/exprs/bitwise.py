@@ -1,0 +1,104 @@
+"""Bitwise expressions (reference: bitwise.scala, 145 LoC). Java semantics:
+shifts mask the shift amount by the width (x << 33 == x << 1 for int)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import (BinaryExpression, ColV, EvalCtx, Expression,
+                                         UnaryExpression)
+
+
+@dataclass(frozen=True)
+class BitwiseAnd(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return l.data & r.data
+
+
+@dataclass(frozen=True)
+class BitwiseOr(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return l.data | r.data
+
+
+@dataclass(frozen=True)
+class BitwiseXor(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return self.operand_dtype()
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return l.data ^ r.data
+
+
+@dataclass(frozen=True)
+class BitwiseNot(UnaryExpression):
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        return ~child.data
+
+
+class _Shift(Expression):
+    def dtype(self) -> DType:
+        return self.children[0].dtype()
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        width = v.dtype.element_size() * 8
+        amount = (s.data & (width - 1)).astype(v.data.dtype)
+        data = self.do_shift(xp, v.data, amount, width)
+        valid = xp.logical_and(v.validity, s.validity)
+        return ColV(v.dtype, data, valid, is_scalar=v.is_scalar and s.is_scalar)
+
+    def do_shift(self, xp, d, amount, width):
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class ShiftLeft(_Shift):
+    l: Expression
+    r: Expression
+
+    def do_shift(self, xp, d, amount, width):
+        return xp.left_shift(d, amount)
+
+
+@dataclass(frozen=True)
+class ShiftRight(_Shift):
+    """Arithmetic (sign-extending) right shift."""
+    l: Expression
+    r: Expression
+
+    def do_shift(self, xp, d, amount, width):
+        return xp.right_shift(d, amount)
+
+
+@dataclass(frozen=True)
+class ShiftRightUnsigned(_Shift):
+    """Logical right shift (>>> in Java): zero-fill."""
+    l: Expression
+    r: Expression
+
+    def do_shift(self, xp, d, amount, width):
+        unsigned = {32: np.uint32, 64: np.uint64}[width]
+        shifted = xp.right_shift(d.astype(unsigned), amount.astype(unsigned))
+        return shifted.astype(d.dtype)

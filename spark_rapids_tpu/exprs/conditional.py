@@ -1,0 +1,86 @@
+"""Conditional expressions: If / CaseWhen (reference: conditionalExpressions.scala,
+251 LoC — if/case-when via cudf ifElse; here a where-chain fused by XLA)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression, widen
+
+
+def _select(ctx: EvalCtx, cond, t: ColV, f: ColV, dt: DType) -> ColV:
+    """cond ? t : f with validity selection. cond is a plain bool array."""
+    xp = ctx.xp
+    t = widen(ctx, t, dt)
+    f = widen(ctx, f, dt)
+    if dt is DType.STRING:
+        from spark_rapids_tpu.exprs.strings import _as_column
+        if getattr(cond, "ndim", 0) != 0:  # column-shaped condition
+            t = _as_column(xp, t, ctx.capacity)
+            f = _as_column(xp, f, ctx.capacity)
+        cnd = cond[..., None] if t.data.ndim == 2 else cond
+        data = xp.where(cnd, t.data, f.data)
+        lengths = xp.where(cond, t.lengths, f.lengths)
+        valid = xp.where(cond, t.validity, f.validity)
+        return ColV(dt, data, valid, lengths)
+    data = xp.where(cond, t.data, f.data)
+    valid = xp.where(cond, t.validity, f.validity)
+    return ColV(dt, data, valid)
+
+
+@dataclass(frozen=True)
+class If(Expression):
+    pred: Expression
+    t: Expression
+    f: Expression
+
+    def dtype(self) -> DType:
+        return DType.common_type(self.t.dtype(), self.f.dtype())
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        p = self.pred.eval(ctx)
+        cond = xp.logical_and(p.data, p.validity)  # null predicate -> else branch
+        return _select(ctx, cond, self.t.eval(ctx), self.f.eval(ctx), self.dtype())
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expression):
+    """branches: ((cond, value), ...); else_value optional (null if absent)."""
+    branches: Tuple  # of (Expression, Expression)
+    else_value: Optional[Expression] = None
+
+    def dtype(self) -> DType:
+        dtypes = [v.dtype() for _, v in self.branches]
+        if self.else_value is not None:
+            dtypes.append(self.else_value.dtype())
+        return DType.common_type_all(dtypes)
+
+    @property
+    def children(self) -> Tuple[Expression, ...]:
+        out = []
+        for c, v in self.branches:
+            out.extend([c, v])
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return tuple(out)
+
+    def map_children(self, fn) -> "CaseWhen":
+        branches = tuple((fn(c), fn(v)) for c, v in self.branches)
+        ev = fn(self.else_value) if self.else_value is not None else None
+        return CaseWhen(branches, ev)
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        dt = self.dtype()
+        from spark_rapids_tpu.exprs.literals import Literal
+        else_expr = self.else_value or Literal(None, dt)
+        out = widen(ctx, else_expr.eval(ctx), dt)
+        # fold right-to-left so earlier branches win
+        for cond_e, val_e in reversed(self.branches):
+            p = cond_e.eval(ctx)
+            cond = xp.logical_and(p.data, p.validity)
+            v = val_e.eval(ctx)
+            out = _select(ctx, cond, v, out, dt)
+        return out

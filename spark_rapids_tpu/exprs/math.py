@@ -1,0 +1,179 @@
+"""Math expressions (reference: mathExpressions.scala, 378 LoC).
+
+Spark semantics: unary math works in DOUBLE; log/log2/log10 return NULL for
+inputs <= 0 (log1p for <= -1); sqrt of negative is NaN (stays valid);
+asin/acos out of [-1,1] is NaN. round uses HALF_UP on the decimal value.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import (BinaryExpression, ColV, EvalCtx, Expression,
+                                         UnaryExpression)
+
+
+class _DoubleUnary(UnaryExpression):
+    """Unary math op evaluated in double."""
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        c = self.child.eval(ctx)
+        d = c.data.astype(np.float64) if c.dtype != DType.DOUBLE else c.data
+        data = self.fn(ctx.xp, d)
+        validity = self.valid_fn(ctx.xp, d, c.validity)
+        return ColV(DType.DOUBLE, data, validity, is_scalar=c.is_scalar)
+
+    def fn(self, xp, d):
+        raise NotImplementedError
+
+    def valid_fn(self, xp, d, validity):
+        return validity
+
+
+def _double_unary(name: str, fn, valid_fn=None):
+    @dataclass(frozen=True)
+    class _Op(_DoubleUnary):
+        c: Expression
+        __qualname__ = name
+
+        def fn(self, xp, d):
+            return fn(xp, d)
+
+        def valid_fn(self, xp, d, validity):
+            if valid_fn is None:
+                return validity
+            return xp.logical_and(validity, valid_fn(xp, d))
+
+        def sql_name(self) -> str:
+            return name
+    _Op.__name__ = name
+    return _Op
+
+
+Sqrt = _double_unary("Sqrt", lambda xp, d: xp.sqrt(xp.abs(d)) * xp.where(d < 0, xp.nan, 1.0))
+Cbrt = _double_unary("Cbrt", lambda xp, d: xp.cbrt(d))
+Exp = _double_unary("Exp", lambda xp, d: xp.exp(d))
+Expm1 = _double_unary("Expm1", lambda xp, d: xp.expm1(d))
+Log = _double_unary("Log", lambda xp, d: xp.log(xp.where(d <= 0, 1.0, d)),
+                    valid_fn=lambda xp, d: d > 0)
+Log2 = _double_unary("Log2", lambda xp, d: xp.log2(xp.where(d <= 0, 1.0, d)),
+                     valid_fn=lambda xp, d: d > 0)
+Log10 = _double_unary("Log10", lambda xp, d: xp.log10(xp.where(d <= 0, 1.0, d)),
+                      valid_fn=lambda xp, d: d > 0)
+Log1p = _double_unary("Log1p", lambda xp, d: xp.log1p(xp.where(d <= -1, 0.0, d)),
+                      valid_fn=lambda xp, d: d > -1)
+Sin = _double_unary("Sin", lambda xp, d: xp.sin(d))
+Cos = _double_unary("Cos", lambda xp, d: xp.cos(d))
+Tan = _double_unary("Tan", lambda xp, d: xp.tan(d))
+Asin = _double_unary("Asin", lambda xp, d: xp.arcsin(d))
+Acos = _double_unary("Acos", lambda xp, d: xp.arccos(d))
+Atan = _double_unary("Atan", lambda xp, d: xp.arctan(d))
+Sinh = _double_unary("Sinh", lambda xp, d: xp.sinh(d))
+Cosh = _double_unary("Cosh", lambda xp, d: xp.cosh(d))
+Tanh = _double_unary("Tanh", lambda xp, d: xp.tanh(d))
+ToDegrees = _double_unary("ToDegrees", lambda xp, d: xp.degrees(d))
+ToRadians = _double_unary("ToRadians", lambda xp, d: xp.radians(d))
+
+
+@dataclass(frozen=True)
+class Signum(_DoubleUnary):
+    c: Expression
+
+    def fn(self, xp, d):
+        return xp.sign(d)
+
+
+@dataclass(frozen=True)
+class Floor(UnaryExpression):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.LONG if self.child.dtype().is_floating else self.child.dtype()
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        if not child.dtype.is_floating:
+            return child.data
+        return ctx.xp.floor(child.data).astype(np.int64)
+
+
+@dataclass(frozen=True)
+class Ceil(UnaryExpression):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.LONG if self.child.dtype().is_floating else self.child.dtype()
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        if not child.dtype.is_floating:
+            return child.data
+        return ctx.xp.ceil(child.data).astype(np.int64)
+
+
+@dataclass(frozen=True)
+class Rint(_DoubleUnary):
+    """rint: round half to even, stays double (Java Math.rint)."""
+    c: Expression
+
+    def fn(self, xp, d):
+        return xp.round(d)
+
+
+@dataclass(frozen=True)
+class Pow(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def operand_dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return ctx.xp.power(l.data, r.data)
+
+
+@dataclass(frozen=True)
+class Atan2(BinaryExpression):
+    l: Expression
+    r: Expression
+
+    def operand_dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        return ctx.xp.arctan2(l.data, r.data)
+
+
+@dataclass(frozen=True)
+class Round(Expression):
+    """round(x, scale): HALF_UP rounding (Spark BigDecimal.ROUND_HALF_UP)."""
+    c: Expression
+    scale: int = 0
+
+    def dtype(self) -> DType:
+        return self.c.dtype()
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)
+        if v.dtype.is_integral and self.scale >= 0:
+            return v
+        factor = float(10 ** self.scale)
+        scaled = v.data.astype(np.float64) * factor
+        # HALF_UP: away from zero on .5 (numpy round is half-to-even)
+        rounded = xp.sign(scaled) * xp.floor(xp.abs(scaled) + 0.5)
+        data = rounded / factor
+        if v.dtype.is_integral:
+            data = data.astype(v.dtype.np_dtype())
+        elif v.dtype is DType.FLOAT:
+            data = data.astype(np.float32)
+        return ColV(v.dtype, data, v.validity, is_scalar=v.is_scalar)

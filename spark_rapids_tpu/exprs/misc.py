@@ -1,0 +1,147 @@
+"""Named expressions, sort ordering, and id/random expressions.
+
+Reference analogs: namedExpressions.scala (Alias), GpuSortExec's SortOrder,
+GpuSparkPartitionID.scala:58, GpuMonotonicallyIncreasingID.scala:75,
+GpuRandomExpressions.scala (Rand with per-batch seeded RNG),
+NormalizeFloatingNumbers.scala / constraintExpressions.scala.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression, UnaryExpression
+
+
+@dataclass(frozen=True)
+class Alias(Expression):
+    c: Expression
+    name: str
+
+    def dtype(self) -> DType:
+        return self.c.dtype()
+
+    def nullable(self) -> bool:
+        return self.c.nullable()
+
+    @property
+    def name_hint(self) -> str:
+        return self.name
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        return self.c.eval(ctx)
+
+    def __str__(self) -> str:
+        return f"{self.c} AS {self.name}"
+
+
+@dataclass(frozen=True)
+class SortOrder(Expression):
+    """Sort key spec: ascending/descending + null ordering. Not row-evaluable as a
+    value; consumed by sort/window/range-partition execs."""
+    child: Expression
+    ascending: bool = True
+    nulls_first: bool = True
+
+    @staticmethod
+    def asc(e: Expression) -> "SortOrder":
+        return SortOrder(e, True, True)
+
+    @staticmethod
+    def desc(e: Expression) -> "SortOrder":
+        return SortOrder(e, False, False)
+
+    def dtype(self) -> DType:
+        return self.child.dtype()
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        return self.child.eval(ctx)
+
+
+@dataclass(frozen=True)
+class SparkPartitionID(Expression):
+    """Partition ordinal, injected by the exec at runtime via ctx attribute."""
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        pid = getattr(ctx, "partition_id", 0)
+        data = xp.full((ctx.capacity,), pid, dtype=np.int32)
+        return ColV(DType.INT, data, xp.ones_like(data, dtype=bool))
+
+
+@dataclass(frozen=True)
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) + row offset within partition."""
+
+    def dtype(self) -> DType:
+        return DType.LONG
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        pid = getattr(ctx, "partition_id", 0)
+        base = getattr(ctx, "row_offset", 0)
+        data = (np.int64(pid) << np.int64(33)) + base + xp.arange(
+            ctx.capacity, dtype=np.int64)
+        return ColV(DType.LONG, data, xp.ones_like(data, dtype=bool))
+
+
+@dataclass(frozen=True)
+class Rand(Expression):
+    """rand(seed): per-batch threefry stream; XORSHIFT in the reference.
+
+    Deterministic per (seed, partition, batch) like Spark's per-partition seeding,
+    but uses jax's counter-based PRNG — the TPU-idiomatic way to get reproducible
+    parallel streams.
+    """
+    seed: int = 0
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        pid = getattr(ctx, "partition_id", 0)
+        batch_no = getattr(ctx, "batch_ordinal", 0)
+        if ctx.is_tracing:
+            import jax
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(self.seed), pid), batch_no)
+            data = jax.random.uniform(key, (ctx.capacity,), dtype=np.float64)
+        else:
+            rng = np.random.default_rng((self.seed, pid, batch_no))
+            data = rng.random(ctx.capacity)
+        return ColV(DType.DOUBLE, data, xp.ones((ctx.capacity,), dtype=bool))
+
+
+@dataclass(frozen=True)
+class KnownFloatingPointNormalized(UnaryExpression):
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        return child.data
+
+
+@dataclass(frozen=True)
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN bit patterns and -0.0 -> +0.0 (pre-grouping/join)."""
+    c: Expression
+
+    def do_columnar(self, ctx: EvalCtx, child: ColV):
+        xp = ctx.xp
+        d = child.data
+        d = xp.where(xp.isnan(d), xp.asarray(np.nan, dtype=d.dtype), d)
+        return xp.where(d == 0, xp.asarray(0.0, dtype=d.dtype), d)

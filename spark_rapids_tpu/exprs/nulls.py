@@ -1,0 +1,142 @@
+"""Null-handling expressions (reference: nullExpressions.scala, 297 LoC)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression, widen
+
+
+@dataclass(frozen=True)
+class IsNull(Expression):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        data = ctx.xp.logical_not(v.validity)
+        return ColV(DType.BOOLEAN, data, ctx.xp.ones_like(data, dtype=bool),
+                    is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class IsNotNull(Expression):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        return ColV(DType.BOOLEAN, v.validity,
+                    ctx.xp.ones_like(v.validity, dtype=bool), is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class IsNan(Expression):
+    """Spark: isnan(null) = false, never null."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)
+        data = xp.logical_and(xp.isnan(v.data), v.validity)
+        return ColV(DType.BOOLEAN, data, xp.ones_like(data, dtype=bool),
+                    is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class Coalesce(Expression):
+    exprs: Tuple
+
+    def dtype(self) -> DType:
+        return DType.common_type_all([e.dtype() for e in self.exprs])
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        dt = self.dtype()
+        out = None
+        for e in self.exprs:
+            v = widen(ctx, e.eval(ctx), dt)
+            if out is None:
+                out = v
+                continue
+            take_new = xp.logical_and(xp.logical_not(out.validity), v.validity)
+            if dt is DType.STRING:
+                tn = take_new[..., None] if hasattr(take_new, "ndim") and v.data.ndim == 2 else take_new
+                data = xp.where(tn, v.data, out.data)
+                lengths = xp.where(take_new, v.lengths, out.lengths)
+                out = ColV(dt, data, xp.logical_or(out.validity, v.validity), lengths)
+            else:
+                data = xp.where(take_new, v.data, out.data)
+                out = ColV(dt, data, xp.logical_or(out.validity, v.validity))
+        return out
+
+
+@dataclass(frozen=True)
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN, else a. Null-intolerant per branch."""
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return DType.common_numeric(self.l.dtype(), self.r.dtype())
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        dt = self.dtype()
+        a = self.l.eval(ctx)
+        b = self.r.eval(ctx)
+        ad = a.data.astype(dt.np_dtype())
+        bd = b.data.astype(dt.np_dtype())
+        use_b = xp.isnan(ad)
+        data = xp.where(use_b, bd, ad)
+        # null-intolerant on the left: a NULL left slot (whose garbage data may be
+        # NaN) must stay NULL, never substitute b
+        valid = xp.logical_and(a.validity,
+                               xp.where(use_b, b.validity, True))
+        return ColV(dt, data, valid, is_scalar=a.is_scalar and b.is_scalar)
+
+
+@dataclass(frozen=True)
+class AtLeastNNonNulls(Expression):
+    """Used by dropna: true when >= n of the children are non-null (and non-NaN
+    for floats), never null."""
+    n: int
+    exprs: Tuple
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        count = None
+        for e in self.exprs:
+            v = e.eval(ctx)
+            ok = v.validity
+            if v.dtype.is_floating:
+                ok = xp.logical_and(ok, xp.logical_not(xp.isnan(v.data)))
+            c = ok.astype(np.int32)
+            count = c if count is None else count + c
+        data = count >= self.n
+        return ColV(DType.BOOLEAN, data, xp.ones_like(data, dtype=bool))

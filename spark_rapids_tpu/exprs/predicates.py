@@ -1,0 +1,231 @@
+"""Predicate expressions (reference: predicates.scala, 631 LoC).
+
+Spark semantics: comparisons are null-intolerant; AND/OR use Kleene three-valued
+logic (false AND null = false, true OR null = true). Spark's documented float
+semantics (see Spark SQL "NaN Semantics"): NaN = NaN returns true, and NaN sorts
+greater than every other value — so float comparisons here special-case NaN
+rather than using raw IEEE compares.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import (BinaryExpression, ColV, EvalCtx, Expression,
+                                         and_validity, cast_operands)
+from spark_rapids_tpu.ops import strings as sk
+
+
+def _is_float(v: ColV) -> bool:
+    return v.dtype.is_floating
+
+
+class _Comparison(BinaryExpression):
+    op: str = ""
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def do_columnar(self, ctx: EvalCtx, l: ColV, r: ColV):
+        xp = ctx.xp
+        if l.dtype is DType.STRING:
+            return sk.string_compare(xp, self.op, l.data, l.lengths, r.data, r.lengths)
+        a, b = l.data, r.data
+        if _is_float(l):
+            return _float_compare(xp, self.op, a, b)
+        return {"eq": lambda: a == b, "ne": lambda: a != b,
+                "lt": lambda: a < b, "le": lambda: a <= b,
+                "gt": lambda: a > b, "ge": lambda: a >= b}[self.op]()
+
+
+def _float_compare(xp, op, a, b):
+    """Spark double ordering: NaN == NaN true; NaN greater than everything."""
+    an, bn = xp.isnan(a), xp.isnan(b)
+    both_nan = xp.logical_and(an, bn)
+    if op == "eq":
+        return xp.logical_or(both_nan, a == b)
+    if op == "ne":
+        return xp.logical_not(xp.logical_or(both_nan, a == b))
+    if op == "lt":
+        return xp.logical_or(xp.logical_and(xp.logical_not(an), bn), a < b)
+    if op == "le":
+        return xp.logical_or(bn, a <= b)
+    if op == "gt":
+        return xp.logical_or(xp.logical_and(an, xp.logical_not(bn)), a > b)
+    if op == "ge":
+        return xp.logical_or(an, a >= b)
+    raise ValueError(op)
+
+
+@dataclass(frozen=True)
+class EqualTo(_Comparison):
+    l: Expression
+    r: Expression
+    op = "eq"
+
+
+@dataclass(frozen=True)
+class NotEqual(_Comparison):
+    l: Expression
+    r: Expression
+    op = "ne"
+
+
+@dataclass(frozen=True)
+class LessThan(_Comparison):
+    l: Expression
+    r: Expression
+    op = "lt"
+
+
+@dataclass(frozen=True)
+class LessThanOrEqual(_Comparison):
+    l: Expression
+    r: Expression
+    op = "le"
+
+
+@dataclass(frozen=True)
+class GreaterThan(_Comparison):
+    l: Expression
+    r: Expression
+    op = "gt"
+
+
+@dataclass(frozen=True)
+class GreaterThanOrEqual(_Comparison):
+    l: Expression
+    r: Expression
+    op = "ge"
+
+
+@dataclass(frozen=True)
+class EqualNullSafe(BinaryExpression):
+    """<=> : nulls compare equal; never returns null."""
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        l, r = cast_operands(ctx, l, r, self.operand_dtype())
+        if l.dtype is DType.STRING:
+            eq = sk.string_eq(xp, l.data, l.lengths, r.data, r.lengths)
+        elif _is_float(l):
+            eq = _float_compare(xp, "eq", l.data, r.data)
+        else:
+            eq = l.data == r.data
+        both_null = xp.logical_and(xp.logical_not(l.validity),
+                                   xp.logical_not(r.validity))
+        both_valid = xp.logical_and(l.validity, r.validity)
+        data = xp.logical_or(both_null, xp.logical_and(both_valid, eq))
+        valid = xp.ones_like(data, dtype=bool) if hasattr(data, "shape") else True
+        return ColV(DType.BOOLEAN, data, valid,
+                    is_scalar=l.is_scalar and r.is_scalar)
+
+
+@dataclass(frozen=True)
+class Not(Expression):
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        return ColV(DType.BOOLEAN, ctx.xp.logical_not(v.data), v.validity,
+                    is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class And(Expression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        l = self.l.eval(ctx)
+        r = self.r.eval(ctx)
+        res_false = xp.logical_or(
+            xp.logical_and(l.validity, xp.logical_not(l.data)),
+            xp.logical_and(r.validity, xp.logical_not(r.data)))
+        valid = xp.logical_or(xp.logical_and(l.validity, r.validity), res_false)
+        data = xp.logical_and(xp.logical_and(l.data, r.data),
+                              xp.logical_not(res_false))
+        return ColV(DType.BOOLEAN, data, valid,
+                    is_scalar=l.is_scalar and r.is_scalar)
+
+
+@dataclass(frozen=True)
+class Or(Expression):
+    l: Expression
+    r: Expression
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        l = self.l.eval(ctx)
+        r = self.r.eval(ctx)
+        res_true = xp.logical_or(xp.logical_and(l.validity, l.data),
+                                 xp.logical_and(r.validity, r.data))
+        valid = xp.logical_or(xp.logical_and(l.validity, r.validity), res_true)
+        data = xp.logical_or(l.data, r.data)
+        return ColV(DType.BOOLEAN, data, valid,
+                    is_scalar=l.is_scalar and r.is_scalar)
+
+
+@dataclass(frozen=True)
+class In(Expression):
+    """value IN (literals...) — reference: GpuInSet.scala:98.
+
+    Spark: true if match; null if no match and (value is null or list has null);
+    false otherwise.
+    """
+    value: Expression
+    items: Tuple  # of Literal
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.value.eval(ctx)
+        found = None
+        has_null_item = False
+        for lit in self.items:
+            lv = lit.eval(ctx)
+            if lit.value is None:
+                has_null_item = True
+                continue
+            lv_cast, v_cast = lv, v
+            if v.dtype != lv.dtype and v.dtype.is_numeric and lv.dtype.is_numeric:
+                common = DType.common_numeric(v.dtype, lv.dtype)
+                v_cast = ColV(common, v.data.astype(common.np_dtype()), v.validity)
+                lv_cast = ColV(common, lv.data.astype(common.np_dtype()), lv.validity)
+            if v.dtype is DType.STRING:
+                eq = sk.string_eq(xp, v_cast.data, v.lengths, lv.data, lv.lengths)
+            elif v.dtype.is_floating:
+                eq = _float_compare(xp, "eq", v_cast.data, lv_cast.data)
+            else:
+                eq = v_cast.data == lv_cast.data
+            found = eq if found is None else xp.logical_or(found, eq)
+        if found is None:
+            found = xp.zeros_like(v.validity, dtype=bool)
+        valid = xp.logical_and(v.validity,
+                               xp.logical_or(found, not has_null_item))
+        return ColV(DType.BOOLEAN, found, valid, is_scalar=v.is_scalar)

@@ -1,0 +1,250 @@
+"""String function expressions (reference: stringFunctions.scala, 862 LoC).
+
+All operate on the fixed-width byte-matrix layout via ops/strings kernels.
+Upper/Lower are ASCII-only on device (non-ASCII bytes pass through unchanged);
+full-unicode case mapping falls back to CPU, mirroring the reference's
+incompat gating of cuDF's case ops.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression, UnaryExpression
+from spark_rapids_tpu.exprs.literals import Literal
+from spark_rapids_tpu.ops import strings as sk
+
+
+def _as_column(xp, v: ColV, capacity: int) -> ColV:
+    """Broadcast a scalar string ColV to a column of the given capacity."""
+    if not v.is_scalar:
+        return v
+    W = v.data.shape[-1]
+    data = xp.broadcast_to(v.data[None, :], (capacity, W))
+    lengths = xp.broadcast_to(xp.reshape(v.lengths, (1,)), (capacity,))
+    validity = xp.broadcast_to(xp.reshape(v.validity, (1,)), (capacity,))
+    return ColV(DType.STRING, data, lengths=lengths, validity=validity)
+
+
+@dataclass(frozen=True)
+class Upper(UnaryExpression):
+    c: Expression
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        return ColV(DType.STRING, sk.upper_ascii(ctx.xp, v.data), v.validity,
+                    v.lengths, is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class Lower(UnaryExpression):
+    c: Expression
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        return ColV(DType.STRING, sk.lower_ascii(ctx.xp, v.data), v.validity,
+                    v.lengths, is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class Length(Expression):
+    """Character (not byte) length, like Spark's length()."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        data = sk.char_lengths(ctx.xp, v.data, v.lengths)
+        return ColV(DType.INT, data, v.validity, is_scalar=v.is_scalar)
+
+
+class _ConstPatternPredicate(Expression):
+    """Base for StartsWith/EndsWith/Contains with a literal pattern (the reference
+    also requires literal patterns for these — GpuOverrides string rules)."""
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    @property
+    def pattern(self) -> bytes:
+        lit = self.children[1]
+        if not isinstance(lit, Literal) or lit.value is None:
+            raise TypeError(f"{type(self).__name__} requires a non-null literal pattern")
+        return str(lit.value).encode("utf-8")
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        col = _as_column(xp, v, ctx.capacity)
+        W = col.data.shape[-1]
+        data = self.do_match(xp, col, W)
+        return ColV(DType.BOOLEAN, data, col.validity)
+
+    def do_match(self, xp, col: ColV, W: int):
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class StartsWith(_ConstPatternPredicate):
+    c: Expression
+    p: Expression
+
+    def do_match(self, xp, col, W):
+        return sk.starts_with(xp, col.data, col.lengths, self.pattern, W)
+
+
+@dataclass(frozen=True)
+class EndsWith(_ConstPatternPredicate):
+    c: Expression
+    p: Expression
+
+    def do_match(self, xp, col, W):
+        return sk.ends_with(xp, col.data, col.lengths, self.pattern, W)
+
+
+@dataclass(frozen=True)
+class Contains(_ConstPatternPredicate):
+    c: Expression
+    p: Expression
+
+    def do_match(self, xp, col, W):
+        return sk.contains(xp, col.data, col.lengths, self.pattern, W)
+
+
+@dataclass(frozen=True)
+class Like(_ConstPatternPredicate):
+    r"""SQL LIKE with literal pattern. Device path supports patterns that reduce to
+    anchored/substring matches: 'abc', 'abc%', '%abc', '%abc%' (no '_', no inner
+    '%'); everything else is tagged for CPU fallback by the plan layer."""
+    c: Expression
+    p: Expression
+    escape: str = "\\"
+
+    @staticmethod
+    def classify(pattern: str) -> Optional[Tuple[str, str]]:
+        """Return (kind, needle) where kind in {exact, prefix, suffix, contains},
+        or None if the pattern needs a real regex engine."""
+        if "_" in pattern:
+            return None
+        body = pattern.strip("%")
+        if "%" in body or "\\" in body:
+            return None
+        starts = pattern.startswith("%")
+        ends = pattern.endswith("%")
+        if starts and ends:
+            return ("contains", body)
+        if ends:
+            return ("prefix", body)
+        if starts:
+            return ("suffix", body)
+        return ("exact", body)
+
+    def do_match(self, xp, col, W):
+        pat = self.pattern.decode("utf-8")
+        kind_needle = Like.classify(pat)
+        if kind_needle is None:
+            raise NotImplementedError(f"LIKE pattern {pat!r} needs regex; CPU fallback")
+        kind, needle = kind_needle
+        nb = needle.encode("utf-8")
+        if kind == "contains":
+            return sk.contains(xp, col.data, col.lengths, nb, W)
+        if kind == "prefix":
+            return sk.starts_with(xp, col.data, col.lengths, nb, W)
+        if kind == "suffix":
+            return sk.ends_with(xp, col.data, col.lengths, nb, W)
+        eq_len = col.lengths == len(nb)
+        return xp.logical_and(
+            sk.starts_with(xp, col.data, col.lengths, nb, W), eq_len)
+
+
+@dataclass(frozen=True)
+class Substring(Expression):
+    """substring(str, pos, len) with Spark 1-based/negative-pos semantics, on
+    *character* positions (byte positions only when the column is pure ASCII is
+    not assumed: we compute byte offsets from char offsets vectorized)."""
+    c: Expression
+    pos: Expression
+    length: Expression
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        pos = self.pos.eval(ctx)
+        ln = self.length.eval(ctx)
+        W = v.data.shape[-1]
+        nchars = sk.char_lengths(xp, v.data, v.lengths)
+        p = pos.data.astype(np.int32)
+        l = xp.maximum(ln.data.astype(np.int32), 0)
+        # Spark: pos 1-based; 0 behaves like 1; negative counts from the end.
+        start_char = xp.where(p > 0, p - 1,
+                              xp.where(p == 0, 0, xp.maximum(nchars + p, 0)))
+        start_char = xp.minimum(start_char, nchars)
+        end_char = xp.minimum(start_char + l, nchars)
+        # char index -> byte offset: count non-continuation bytes cumulatively
+        in_range = np.arange(W, dtype=np.int32)[None, :] < v.lengths[:, None]
+        is_start = xp.logical_and((v.data & 0xC0) != 0x80, in_range)
+        char_idx = xp.cumsum(is_start.astype(np.int32), axis=-1)  # 1-based char no.
+        # byte offset of char k = first position where char_idx == k+1
+        def char_to_byte(k):
+            # number of bytes before char k = count of positions with char_idx <= k
+            return xp.sum(xp.logical_and(in_range, char_idx <= k[:, None]),
+                          axis=-1).astype(np.int32)
+        start_b = char_to_byte(start_char)
+        end_b = char_to_byte(end_char)
+        data, lengths = sk.substring(xp, v.data, v.lengths, start_b,
+                                     end_b - start_b, W)
+        validity = xp.logical_and(v.validity,
+                                  xp.logical_and(pos.validity, ln.validity))
+        return ColV(DType.STRING, data, validity, lengths)
+
+
+@dataclass(frozen=True)
+class Concat(Expression):
+    """concat(...): null if any input is null (Spark semantics)."""
+    exprs: Tuple
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        vals = [_as_column(xp, e.eval(ctx), ctx.capacity) for e in self.exprs]
+        out = vals[0]
+        W = ctx.string_max_bytes
+        for v in vals[1:]:
+            data, lengths = sk.concat2(xp, out.data, out.lengths, v.data, v.lengths, W)
+            validity = xp.logical_and(out.validity, v.validity)
+            out = ColV(DType.STRING, data, validity, lengths)
+        return out
+
+
+@dataclass(frozen=True)
+class StringTrim(Expression):
+    """trim(str): strip ASCII spaces from both ends (Spark trims ' ' only)."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        W = v.data.shape[-1]
+        pos = np.arange(W, dtype=np.int32)[None, :]
+        in_range = pos < v.lengths[:, None]
+        non_space = xp.logical_and(v.data != 32, in_range)
+        any_ns = xp.any(non_space, axis=-1)
+        first = xp.argmax(non_space, axis=-1).astype(np.int32)
+        last = (W - 1 - xp.argmax(non_space[:, ::-1], axis=-1)).astype(np.int32)
+        start = xp.where(any_ns, first, 0)
+        new_len = xp.where(any_ns, last - first + 1, 0)
+        data, lengths = sk.substring(xp, v.data, v.lengths, start, new_len, W)
+        return ColV(DType.STRING, data, v.validity, lengths)

@@ -12,6 +12,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon TPU plugin (registered by sitecustomize via PYTHONPATH) force-sets
+# jax_platforms to "axon,cpu" at interpreter start, overriding the env var, and
+# initializing its remote client hangs when the chip tunnel is busy. Tests are
+# CPU-only: pin the config back to cpu before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402

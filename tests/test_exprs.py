@@ -1,0 +1,339 @@
+"""Expression semantics tests: golden Spark behaviors + CPU (numpy eager) vs
+device (jitted XLA) parity — the analog of the reference's ProjectExprSuite and
+the pytest arithmetic/cmp/logic/conditionals/string/date_time files."""
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import DeviceBatch
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.execs.evaluator import eval_exprs_device, eval_exprs_host
+from spark_rapids_tpu.exprs import (Abs, Add, Alias, And, AtLeastNNonNulls, CaseWhen,
+                                    Cast, Ceil, Coalesce, Concat, Contains, DateAdd,
+                                    DateDiff, DayOfMonth, DayOfWeek, Divide, EndsWith,
+                                    EqualNullSafe, EqualTo, Floor, GreaterThan, Hour,
+                                    If, In, IntegralDivide, IsNan, IsNotNull, IsNull,
+                                    LastDay, Length, LessThan, Like, Literal, Log,
+                                    Lower, Month, Multiply, NaNvl, Not, Or, Pmod, Pow,
+                                    Remainder, ShiftLeft, ShiftRightUnsigned, Sqrt,
+                                    StartsWith, StringTrim, Substring, Subtract,
+                                    UnaryMinus, Upper, Year, bind_expression)
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.testing import assert_tables_equal
+
+col = lambda n: __import__("spark_rapids_tpu.exprs", fromlist=["UnresolvedAttribute"]).UnresolvedAttribute(n)
+lit = Literal.of
+
+
+def run_both(table: pa.Table, *exprs, smax=64):
+    """Evaluate exprs on CPU and device; assert identical; return CPU result."""
+    from spark_rapids_tpu.columnar.dtypes import Schema
+    schema = Schema.from_pa(table.schema)
+    bound = tuple(bind_expression(e, schema) for e in exprs)
+    hb = HostBatch.from_arrow(table, smax)
+    cpu = eval_exprs_host(bound, hb, smax).to_arrow()
+    db = DeviceBatch.from_arrow(table, smax)
+    dev = eval_exprs_device(bound, db, smax).to_arrow()
+    assert_tables_equal(cpu, dev)
+    return cpu
+
+
+def vals(t: pa.Table, i: int = 0):
+    return t.column(i).to_pylist()
+
+
+def test_arithmetic_null_propagation():
+    t = pa.table({"a": pa.array([1, None, 3], type=pa.int32()),
+                  "b": pa.array([10, 20, None], type=pa.int32())})
+    out = run_both(t, Add(col("a"), col("b")), Subtract(col("a"), col("b")),
+                   Multiply(col("a"), col("b")))
+    assert vals(out, 0) == [11, None, None]
+    assert vals(out, 1) == [-9, None, None]
+    assert vals(out, 2) == [10, None, None]
+
+
+def test_int_overflow_wraps_like_java():
+    t = pa.table({"a": pa.array([2**31 - 1, -2**31], type=pa.int32())})
+    out = run_both(t, Add(col("a"), lit(1)), Subtract(col("a"), lit(1)))
+    assert vals(out, 0) == [-2**31, -2**31 + 1]
+    assert vals(out, 1) == [2**31 - 2, 2**31 - 1]
+
+
+def test_divide_semantics():
+    t = pa.table({"a": pa.array([7, 7, -7, None], type=pa.int32()),
+                  "b": pa.array([2, 0, 2, 2], type=pa.int32())})
+    out = run_both(t, Divide(col("a"), col("b")),
+                   IntegralDivide(col("a"), col("b")),
+                   Remainder(col("a"), col("b")),
+                   Pmod(col("a"), col("b")))
+    assert vals(out, 0) == [3.5, None, -3.5, None]   # x/0 -> null, double result
+    assert vals(out, 1) == [3, None, -3, None]       # trunc toward zero
+    assert vals(out, 2) == [1, None, -1, None]       # Java % sign
+    assert vals(out, 3) == [1, None, 1, None]        # pmod non-negative
+
+
+def test_double_divide_by_zero_is_null():
+    t = pa.table({"a": pa.array([1.0, -1.0, 0.0], type=pa.float64())})
+    out = run_both(t, Divide(col("a"), lit(0.0)))
+    assert vals(out) == [None, None, None]
+
+
+def test_remainder_float():
+    t = pa.table({"a": pa.array([7.5, -7.5], type=pa.float64())})
+    out = run_both(t, Remainder(col("a"), lit(2.0)))
+    assert vals(out) == [1.5, -1.5]
+
+
+def test_comparisons_and_nan():
+    nan = float("nan")
+    t = pa.table({"a": pa.array([1.0, nan, 2.0, None], type=pa.float64()),
+                  "b": pa.array([1.0, nan, nan, 1.0], type=pa.float64())})
+    out = run_both(t, EqualTo(col("a"), col("b")), LessThan(col("a"), col("b")),
+                   GreaterThan(col("a"), col("b")))
+    assert vals(out, 0) == [True, True, False, None]   # NaN = NaN is true
+    assert vals(out, 1) == [False, False, True, None]  # NaN greater than all
+    assert vals(out, 2) == [False, False, False, None]
+
+
+def test_kleene_and_or():
+    t = pa.table({"a": pa.array([True, True, False, None, None]),
+                  "b": pa.array([None, False, None, None, True])})
+    out = run_both(t, And(col("a"), col("b")), Or(col("a"), col("b")))
+    assert vals(out, 0) == [None, False, False, None, None]
+    assert vals(out, 1) == [True, True, None, None, True]
+
+
+def test_null_predicates():
+    t = pa.table({"a": pa.array([1.0, None, float("nan")], type=pa.float64())})
+    out = run_both(t, IsNull(col("a")), IsNotNull(col("a")), IsNan(col("a")))
+    assert vals(out, 0) == [False, True, False]
+    assert vals(out, 1) == [True, False, True]
+    assert vals(out, 2) == [False, False, True]  # isnan(null) = false
+
+
+def test_equal_null_safe():
+    t = pa.table({"a": pa.array([1, None, None], type=pa.int64()),
+                  "b": pa.array([1, 1, None], type=pa.int64())})
+    out = run_both(t, EqualNullSafe(col("a"), col("b")))
+    assert vals(out) == [True, False, True]
+
+
+def test_in_semantics():
+    t = pa.table({"a": pa.array([1, 2, None], type=pa.int32())})
+    out = run_both(t, In(col("a"), (lit(1), lit(5))),
+                   In(col("a"), (lit(1), Literal(None, DType.INT))))
+    assert vals(out, 0) == [True, False, None]
+    assert vals(out, 1) == [True, None, None]  # null in list: non-match -> null
+
+
+def test_conditional():
+    t = pa.table({"a": pa.array([1, 5, None], type=pa.int32())})
+    out = run_both(
+        t,
+        If(GreaterThan(col("a"), lit(2)), lit(100), lit(-100)),
+        CaseWhen(((EqualTo(col("a"), lit(1)), lit(10)),
+                  (EqualTo(col("a"), lit(5)), lit(50))), lit(0)),
+        CaseWhen(((EqualTo(col("a"), lit(1)), lit(10)),), None))
+    assert vals(out, 0) == [-100, 100, -100]  # null pred -> else
+    assert vals(out, 1) == [10, 50, 0]
+    assert vals(out, 2) == [10, None, None]
+
+
+def test_coalesce_nanvl():
+    t = pa.table({"a": pa.array([None, 2.0, float("nan")], type=pa.float64()),
+                  "b": pa.array([1.0, None, 7.0], type=pa.float64())})
+    out = run_both(t, Coalesce((col("a"), col("b"))), NaNvl(col("a"), col("b")),
+                   AtLeastNNonNulls(1, (col("a"),)))
+    cv = vals(out, 0)
+    assert cv[0] == 1.0 and cv[1] == 2.0 and np.isnan(cv[2])  # NaN is non-null
+    nv = vals(out, 1)
+    assert nv[0] is None and nv[1] == 2.0 and nv[2] == 7.0
+    assert vals(out, 2) == [False, True, False]  # NaN doesn't count
+
+
+def test_math_golden():
+    t = pa.table({"a": pa.array([4.0, -1.0, 0.0], type=pa.float64())})
+    out = run_both(t, Sqrt(col("a")), Log(col("a")), Pow(col("a"), lit(2.0)))
+    sq = vals(out, 0)
+    assert sq[0] == 2.0 and np.isnan(sq[1]) and sq[2] == 0.0
+    assert vals(out, 1) == [np.log(4.0), None, None]  # log(<=0) -> null
+    assert vals(out, 2) == [16.0, 1.0, 0.0]
+
+
+def test_floor_ceil_to_long():
+    t = pa.table({"a": pa.array([1.5, -1.5, 2.0], type=pa.float64())})
+    out = run_both(t, Floor(col("a")), Ceil(col("a")))
+    assert out.schema.field(0).type == pa.int64()
+    assert vals(out, 0) == [1, -2, 2]
+    assert vals(out, 1) == [2, -1, 2]
+
+
+def test_unary_minus_abs():
+    t = pa.table({"a": pa.array([5, -5, None], type=pa.int32())})
+    out = run_both(t, UnaryMinus(col("a")), Abs(col("a")))
+    assert vals(out, 0) == [-5, 5, None]
+    assert vals(out, 1) == [5, 5, None]
+
+
+def test_bitwise_shifts():
+    t = pa.table({"a": pa.array([1, -8], type=pa.int32())})
+    out = run_both(t, ShiftLeft(col("a"), lit(33)),   # Java masks: << 1
+                   ShiftRightUnsigned(col("a"), lit(1)))
+    assert vals(out, 0) == [2, -16]
+    assert vals(out, 1) == [0, 2147483644]
+
+
+def test_cast_matrix():
+    t = pa.table({"d": pa.array([1.9, -1.9, float("nan"), 1e10], type=pa.float64()),
+                  "l": pa.array([2**35 + 7, -1, 300, None], type=pa.int64())})
+    out = run_both(t, Cast(col("d"), DType.INT), Cast(col("l"), DType.INT),
+                   Cast(col("l"), DType.BYTE), Cast(col("d"), DType.BOOLEAN))
+    assert vals(out, 0) == [1, -1, 0, 2**31 - 1]      # trunc, NaN->0, saturate
+    assert vals(out, 1) == [7, -1, 300, None]          # long->int wraps low bits
+    assert vals(out, 2) == [7, -1, 44, None]           # wrap to byte
+    assert vals(out, 3) == [True, True, True, True]    # != 0 (NaN != 0)
+
+
+def test_cast_int_to_string():
+    t = pa.table({"l": pa.array([0, -1, 123456789012345, -2**63, None],
+                                type=pa.int64())})
+    out = run_both(t, Cast(col("l"), DType.STRING))
+    assert vals(out) == ["0", "-1", "123456789012345", "-9223372036854775808", None]
+
+
+def test_cast_bool_to_string():
+    t = pa.table({"b": pa.array([True, False, None])})
+    out = run_both(t, Cast(col("b"), DType.STRING))
+    assert vals(out) == ["true", "false", None]
+
+
+def test_cast_datetime():
+    t = pa.table({"ts": pa.array([86_400_000_000 + 3_600_000_000, -1],
+                                 type=pa.timestamp("us", tz="UTC"))})
+    out = run_both(t, Cast(col("ts"), DType.DATE), Cast(col("ts"), DType.LONG))
+    assert vals(out, 0) == [datetime.date(1970, 1, 2), datetime.date(1969, 12, 31)]
+    assert vals(out, 1) == [90000, -1]  # floor seconds
+
+
+def test_string_predicates():
+    t = pa.table({"s": pa.array(["hello world", "Hello", "", None, "say hell no"])})
+    out = run_both(t, StartsWith(col("s"), lit("hell")),
+                   EndsWith(col("s"), lit("o")),
+                   Contains(col("s"), lit("hell")))
+    assert vals(out, 0) == [True, False, False, None, False]
+    assert vals(out, 1) == [False, True, False, None, True]
+    assert vals(out, 2) == [True, False, False, None, True]
+
+
+def test_string_compare_ordering():
+    t = pa.table({"a": pa.array(["apple", "b", "", "abc"]),
+                  "b": pa.array(["apricot", "a", "a", "abc"])})
+    out = run_both(t, LessThan(col("a"), col("b")), EqualTo(col("a"), col("b")))
+    assert vals(out, 0) == [True, False, True, False]
+    assert vals(out, 1) == [False, False, False, True]
+
+
+def test_upper_lower_length():
+    t = pa.table({"s": pa.array(["MiXeD", "héllo", None])})
+    out = run_both(t, Upper(col("s")), Lower(col("s")), Length(col("s")))
+    assert vals(out, 0) == ["MIXED", "HéLLO", None]  # ascii-only case map
+    assert vals(out, 1) == ["mixed", "héllo", None]
+    assert vals(out, 2) == [5, 5, None]  # char length, not bytes
+
+
+def test_substring_spark_semantics():
+    t = pa.table({"s": pa.array(["hello", "héllo", "ab"])})
+    out = run_both(t, Substring(col("s"), lit(2), lit(3)),
+                   Substring(col("s"), lit(-2), lit(2)),
+                   Substring(col("s"), lit(0), lit(2)))
+    assert vals(out, 0) == ["ell", "éll", "b"]
+    assert vals(out, 1) == ["lo", "lo", "ab"]
+    assert vals(out, 2) == ["he", "hé", "ab"]  # pos 0 behaves like 1
+
+
+def test_concat_trim():
+    t = pa.table({"a": pa.array(["foo", None, "  pad  "]),
+                  "b": pa.array(["bar", "x", "y"])})
+    out = run_both(t, Concat((col("a"), col("b"))), StringTrim(col("a")))
+    assert vals(out, 0) == ["foobar", None, "  pad  y"]
+    assert vals(out, 1) == ["foo", None, "pad"]
+
+
+def test_like_patterns():
+    t = pa.table({"s": pa.array(["hello", "help", "shell", "hell"])})
+    out = run_both(t, Like(col("s"), lit("hell%")), Like(col("s"), lit("%ell")),
+                   Like(col("s"), lit("%ell%")), Like(col("s"), lit("hell")))
+    assert vals(out, 0) == [True, False, False, True]
+    assert vals(out, 1) == [False, False, True, True]
+    assert vals(out, 2) == [True, False, True, True]
+    assert vals(out, 3) == [False, False, False, True]
+
+
+def test_datetime_parts():
+    t = pa.table({"d": pa.array([datetime.date(2020, 2, 29), datetime.date(1969, 12, 31),
+                                 datetime.date(1600, 3, 1)], type=pa.date32())})
+    out = run_both(t, Year(col("d")), Month(col("d")), DayOfMonth(col("d")),
+                   DayOfWeek(col("d")), LastDay(col("d")))
+    assert vals(out, 0) == [2020, 1969, 1600]
+    assert vals(out, 1) == [2, 12, 3]
+    assert vals(out, 2) == [29, 31, 1]
+    assert vals(out, 3) == [7, 4, 4]  # sat, wed, wed (1=sunday..7=saturday)
+    assert vals(out, 4) == [datetime.date(2020, 2, 29), datetime.date(1969, 12, 31),
+                            datetime.date(1600, 3, 31)]
+
+
+def test_date_arith_and_hour():
+    t = pa.table({"d": pa.array([datetime.date(2020, 1, 31)], type=pa.date32()),
+                  "ts": pa.array([3_600_000_000 * 30 + 123], type=pa.timestamp("us", tz="UTC"))})
+    out = run_both(t, DateAdd(col("d"), lit(1)), DateDiff(col("d"), lit(datetime.date(2020, 1, 1))),
+                   Hour(col("ts")))
+    assert vals(out, 0) == [datetime.date(2020, 2, 1)]
+    assert vals(out, 1) == [30]
+    assert vals(out, 2) == [6]  # 30h mod 24
+
+
+def test_alias_not():
+    t = pa.table({"a": pa.array([True, False, None])})
+    out = run_both(t, Alias(Not(col("a")), "neg"))
+    assert out.column_names == ["neg"]
+    assert vals(out) == [False, True, None]
+
+
+def test_if_with_string_literal_branches():
+    # regression: scalar string branches must broadcast against a column condition
+    t = pa.table({"a": pa.array([1, 5, None], type=pa.int32())})
+    out = run_both(t, If(GreaterThan(col("a"), lit(2)), lit("big"), lit("small")),
+                   CaseWhen(((IsNull(col("a")), lit("none")),), lit("some")))
+    assert vals(out, 0) == ["small", "big", "small"]
+    assert vals(out, 1) == ["some", "some", "none"]
+
+
+def test_coalesce_widens_and_null_literal():
+    # regression (code review): coalesce must widen to the common type and accept
+    # a NULL-typed first operand
+    t = pa.table({"a": pa.array([None, 7], type=pa.int32())})
+    out = run_both(t, Coalesce((col("a"), lit(2**40))),
+                   Coalesce((Literal(None, DType.NULL), col("a"))))
+    assert vals(out, 0) == [2**40, 7]
+    assert vals(out, 1) == [None, 7]
+
+
+def test_nanvl_null_left_stays_null():
+    # regression (code review): NaNvl is null-intolerant on the left even when the
+    # invalid slot's garbage data is NaN
+    t = pa.table({"a": pa.array([None, float("nan")], type=pa.float64()),
+                  "b": pa.array([float("nan"), 1.0], type=pa.float64())})
+    out = run_both(t, NaNvl(Add(col("a"), col("b")), lit(9.0)))
+    assert vals(out) == [None, 9.0]
+
+
+def test_if_null_branch():
+    t = pa.table({"a": pa.array([1, 5], type=pa.int32())})
+    out = run_both(t, If(GreaterThan(col("a"), lit(2)), Literal(None, DType.NULL),
+                         col("a")),
+                   CaseWhen(((GreaterThan(col("a"), lit(2)),
+                              Literal(None, DType.NULL)),), col("a")))
+    assert vals(out, 0) == [1, None]
+    assert vals(out, 1) == [1, None]
