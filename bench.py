@@ -1,0 +1,54 @@
+"""Benchmark entry point (driver contract: prints ONE JSON line).
+
+Runs TPC-H Q1 over generated lineitem data end-to-end (host staging -> device
+upload -> fused filter+aggregate+sort on TPU -> download) and compares against
+the CPU engine (eager numpy, the stand-in for CPU Spark — the reference's
+baseline in its 4x-typical-speedup claim, docs/FAQ.md:66).
+"""
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    scale = float(os.environ.get("BENCH_SCALE", "0.05"))  # 300k rows default
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
+    from spark_rapids_tpu.api import TpuSession
+
+    table = gen_lineitem(scale=scale, seed=42)
+    n_rows = table.num_rows
+
+    tpu_sess = TpuSession(BENCH_CONF)
+    cpu_sess = TpuSession({**BENCH_CONF, "spark.rapids.tpu.sql.enabled": "false"})
+
+    # warmup (compile)
+    tpu_result = q1(tpu_sess.create_dataframe(table)).collect()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        q1(tpu_sess.create_dataframe(table)).collect()
+    tpu_time = (time.perf_counter() - t0) / iters
+
+    t0 = time.perf_counter()
+    cpu_result = q1(cpu_sess.create_dataframe(table)).collect()
+    cpu_time = time.perf_counter() - t0
+
+    # sanity: same group count
+    assert tpu_result.num_rows == cpu_result.num_rows, (
+        f"result mismatch: {tpu_result.num_rows} vs {cpu_result.num_rows}")
+
+    tpu_rps = n_rows / tpu_time
+    cpu_rps = n_rows / cpu_time
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(tpu_rps),
+        "unit": "rows/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

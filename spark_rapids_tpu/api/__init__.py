@@ -1,0 +1,3 @@
+from spark_rapids_tpu.api.column import Column
+from spark_rapids_tpu.api.dataframe import DataFrame, GroupedData, TpuSession
+from spark_rapids_tpu.api import functions

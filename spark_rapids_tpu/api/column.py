@@ -1,0 +1,91 @@
+"""Column wrapper: the user-facing expression builder (pyspark-Column-style API
+over the expression layer)."""
+from __future__ import annotations
+
+from typing import Any, Union
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs import (Add, Alias, And, BitwiseAnd, BitwiseOr,
+                                    BitwiseXor, Cast, Contains, Divide, EndsWith,
+                                    EqualNullSafe, EqualTo, Expression, GreaterThan,
+                                    GreaterThanOrEqual, In, IsNan, IsNotNull, IsNull,
+                                    LessThan, LessThanOrEqual, Like, Literal,
+                                    Multiply, Not, NotEqual, Or, Pmod, Remainder,
+                                    SortOrder, StartsWith, Subtract, UnaryMinus,
+                                    UnresolvedAttribute)
+
+
+def _expr(v: Any) -> Expression:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    return Literal.of(v)
+
+
+class Column:
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic ------------------------------------------------------------
+    def __add__(self, o): return Column(Add(self.expr, _expr(o)))
+    def __radd__(self, o): return Column(Add(_expr(o), self.expr))
+    def __sub__(self, o): return Column(Subtract(self.expr, _expr(o)))
+    def __rsub__(self, o): return Column(Subtract(_expr(o), self.expr))
+    def __mul__(self, o): return Column(Multiply(self.expr, _expr(o)))
+    def __rmul__(self, o): return Column(Multiply(_expr(o), self.expr))
+    def __truediv__(self, o): return Column(Divide(self.expr, _expr(o)))
+    def __rtruediv__(self, o): return Column(Divide(_expr(o), self.expr))
+    def __mod__(self, o): return Column(Remainder(self.expr, _expr(o)))
+    def __neg__(self): return Column(UnaryMinus(self.expr))
+
+    # comparisons -----------------------------------------------------------
+    def __eq__(self, o): return Column(EqualTo(self.expr, _expr(o)))  # type: ignore[override]
+    def __ne__(self, o): return Column(NotEqual(self.expr, _expr(o)))  # type: ignore[override]
+    def __lt__(self, o): return Column(LessThan(self.expr, _expr(o)))
+    def __le__(self, o): return Column(LessThanOrEqual(self.expr, _expr(o)))
+    def __gt__(self, o): return Column(GreaterThan(self.expr, _expr(o)))
+    def __ge__(self, o): return Column(GreaterThanOrEqual(self.expr, _expr(o)))
+    def eqNullSafe(self, o): return Column(EqualNullSafe(self.expr, _expr(o)))
+
+    # boolean ---------------------------------------------------------------
+    def __and__(self, o): return Column(And(self.expr, _expr(o)))
+    def __or__(self, o): return Column(Or(self.expr, _expr(o)))
+    def __invert__(self): return Column(Not(self.expr))
+
+    # bitwise ---------------------------------------------------------------
+    def bitwiseAND(self, o): return Column(BitwiseAnd(self.expr, _expr(o)))
+    def bitwiseOR(self, o): return Column(BitwiseOr(self.expr, _expr(o)))
+    def bitwiseXOR(self, o): return Column(BitwiseXor(self.expr, _expr(o)))
+
+    # null / misc -----------------------------------------------------------
+    def isNull(self): return Column(IsNull(self.expr))
+    def isNotNull(self): return Column(IsNotNull(self.expr))
+    def isNaN(self): return Column(IsNan(self.expr))
+    def isin(self, *vals):
+        return Column(In(self.expr, tuple(Literal.of(v) for v in vals)))
+
+    # strings ---------------------------------------------------------------
+    def startswith(self, p): return Column(StartsWith(self.expr, _expr(p)))
+    def endswith(self, p): return Column(EndsWith(self.expr, _expr(p)))
+    def contains(self, p): return Column(Contains(self.expr, _expr(p)))
+    def like(self, p): return Column(Like(self.expr, _expr(p)))
+
+    # naming / casting ------------------------------------------------------
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    def cast(self, to: Union[str, DType]) -> "Column":
+        dt = DType(to) if isinstance(to, str) else to
+        return Column(Cast(self.expr, dt))
+
+    # ordering --------------------------------------------------------------
+    def asc(self): return Column(SortOrder(self.expr, True, True))
+    def asc_nulls_last(self): return Column(SortOrder(self.expr, True, False))
+    def desc(self): return Column(SortOrder(self.expr, False, False))
+    def desc_nulls_first(self): return Column(SortOrder(self.expr, False, True))
+
+    def __repr__(self):
+        return f"Column<{self.expr}>"
+
+    __hash__ = None  # type: ignore[assignment]

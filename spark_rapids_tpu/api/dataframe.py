@@ -1,0 +1,266 @@
+"""DataFrame + session frontend.
+
+The user-facing API a Spark user lands on: DataFrames build logical plans; an
+action (collect/count/to_pandas) plans the CPU physical plan, runs TpuOverrides
+to rewrite supported subtrees onto the TPU, and executes. ``explain()`` surfaces
+the will-run/fallback report like spark.rapids.sql.explain.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu.api.column import Column, _expr
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.exprs import Alias, SortOrder, UnresolvedAttribute
+from spark_rapids_tpu.plan import logical as lp
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.planner import plan_physical
+
+
+def _to_expr(c: Union[str, Column]):
+    return UnresolvedAttribute(c) if isinstance(c, str) else c.expr
+
+
+class DataFrame:
+    def __init__(self, logical: lp.LogicalPlan, session: "TpuSession"):
+        self._plan = logical
+        self.session = session
+
+    # ---- transformations -----------------------------------------------------
+    def select(self, *cols: Union[str, Column]) -> "DataFrame":
+        exprs = tuple(_to_expr(c) for c in cols)
+        return DataFrame(lp.Project(exprs, self._plan), self.session)
+
+    def withColumn(self, name: str, c: Column) -> "DataFrame":
+        # a replaced column keeps its position (pyspark semantics)
+        exprs = []
+        replaced = False
+        for f in self._plan.schema():
+            if f.name == name:
+                exprs.append(Alias(c.expr, name))
+                replaced = True
+            else:
+                exprs.append(UnresolvedAttribute(f.name))
+        if not replaced:
+            exprs.append(Alias(c.expr, name))
+        return DataFrame(lp.Project(tuple(exprs), self._plan), self.session)
+
+    def filter(self, cond: Column) -> "DataFrame":
+        return DataFrame(lp.Filter(cond.expr, self._plan), self.session)
+
+    where = filter
+
+    def groupBy(self, *cols: Union[str, Column]) -> "GroupedData":
+        return GroupedData(self, tuple(_to_expr(c) for c in cols))
+
+    def agg(self, *cols: Column) -> "DataFrame":
+        return GroupedData(self, ()).agg(*cols)
+
+    def sort(self, *cols: Union[str, Column]) -> "DataFrame":
+        orders = []
+        for c in cols:
+            e = _to_expr(c)
+            orders.append(e if isinstance(e, SortOrder) else SortOrder(e, True, True))
+        return DataFrame(lp.Sort(tuple(orders), self._plan), self.session)
+
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(lp.Limit(n, self._plan), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(lp.Union(self._plan, other._plan), self.session)
+
+    unionAll = union
+
+    def join(self, other: "DataFrame", on: Union[str, List[str]],
+             how: str = "inner") -> "DataFrame":
+        keys = [on] if isinstance(on, str) else list(on)
+        lkeys = tuple(UnresolvedAttribute(k) for k in keys)
+        rkeys = tuple(UnresolvedAttribute(k) for k in keys)
+        return DataFrame(lp.Join(self._plan, other._plan, how, lkeys, rkeys),
+                         self.session)
+
+    def repartition(self, n: int, *cols: Union[str, Column]) -> "DataFrame":
+        return DataFrame(
+            lp.Repartition(n, self._plan, tuple(_to_expr(c) for c in cols)),
+            self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [UnresolvedAttribute(f.name) for f in self._plan.schema()
+                if f.name not in names]
+        return DataFrame(lp.Project(tuple(keep), self._plan), self.session)
+
+    # ---- actions -------------------------------------------------------------
+    def _executed_plan(self) -> PhysicalExec:
+        cpu_plan = plan_physical(self._plan, self.session.conf)
+        overrides = TpuOverrides(self.session.conf)
+        final = overrides.apply(cpu_plan)
+        self.session.last_explain = overrides.last_explain
+        self.session.last_plan = final
+        return final
+
+    def collect(self) -> pa.Table:
+        final = self._executed_plan()
+        ctx = ExecContext(self.session.conf)
+        tables = [b.to_arrow() for b in final.execute(ctx)]
+        schema = self._plan.schema().to_pa()
+        if not tables:
+            return schema.empty_table()
+        return pa.concat_tables(tables)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    toPandas = to_pandas
+
+    def count(self) -> int:
+        from spark_rapids_tpu.api.functions import count
+        return self.agg(count().alias("count")).collect().column(0)[0].as_py()
+
+    def schema(self) -> Schema:
+        return self._plan.schema()
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema().names()
+
+    def explain(self, print_out: bool = True) -> str:
+        cpu_plan = plan_physical(self._plan, self.session.conf)
+        overrides = TpuOverrides(self.session.conf)
+        final = overrides.apply(cpu_plan)
+        text = overrides.last_explain + "\n\nPhysical plan:\n" + final.tree_string()
+        if print_out:
+            print(text)
+        return text
+
+    def write_parquet(self, path: str, compression: str = "snappy") -> None:
+        from spark_rapids_tpu.io.parquet import write_parquet
+        write_parquet(self.collect(), path, compression)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping):
+        self._df = df
+        self._grouping = grouping
+
+    def agg(self, *cols: Column) -> DataFrame:
+        aggs = []
+        for i, c in enumerate(cols):
+            e = c.expr
+            if not isinstance(e, Alias):
+                e = Alias(e, e.name_hint)
+            aggs.append(e)
+        return DataFrame(
+            lp.Aggregate(self._grouping, tuple(aggs), self._df._plan),
+            self._df.session)
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.api.functions import count
+        return self.agg(count().alias("count"))
+
+    def _simple(self, fname, *cols) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        fn = getattr(F, fname)
+        names = cols or [f.name for f in self._df._plan.schema()
+                         if f.dtype.is_numeric]
+        return self.agg(*[fn(n).alias(f"{fname}({n})") for n in names])
+
+    def sum(self, *cols) -> DataFrame:
+        return self._simple("sum", *cols)
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple("avg", *cols)
+
+    def min(self, *cols) -> DataFrame:
+        return self._simple("min", *cols)
+
+    def max(self, *cols) -> DataFrame:
+        return self._simple("max", *cols)
+
+
+class DataFrameReader:
+    def __init__(self, session: "TpuSession"):
+        self.session = session
+        self._options: Dict[str, str] = {}
+
+    def option(self, k: str, v) -> "DataFrameReader":
+        self._options[k] = str(v)
+        return self
+
+    def parquet(self, *paths: str) -> DataFrame:
+        import pyarrow.parquet as pq
+        schema = Schema.from_pa(pq.read_schema(paths[0]))
+        return DataFrame(lp.FileScan("parquet", tuple(paths), schema,
+                                     tuple(self._options.items())), self.session)
+
+    def csv(self, *paths: str, schema: Optional[Schema] = None) -> DataFrame:
+        from spark_rapids_tpu.io.csv import infer_csv_schema
+        s = schema or infer_csv_schema(paths[0], self._options)
+        return DataFrame(lp.FileScan("csv", tuple(paths), s,
+                                     tuple(self._options.items())), self.session)
+
+    def orc(self, *paths: str) -> DataFrame:
+        import pyarrow.orc as po
+        schema = Schema.from_pa(po.ORCFile(paths[0]).schema)
+        return DataFrame(lp.FileScan("orc", tuple(paths), schema,
+                                     tuple(self._options.items())), self.session)
+
+
+class TpuSession:
+    """SparkSession analog wired to the TPU accelerator (SQLPlugin +
+    RapidsDriverPlugin role: holds the conf, applies the overrides rule)."""
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self.conf = TpuConf(conf or {})
+        self.last_explain: str = ""
+        self.last_plan: Optional[PhysicalExec] = None
+
+    @staticmethod
+    def builder() -> "TpuSessionBuilder":
+        return TpuSessionBuilder()
+
+    def create_dataframe(self, data, schema: Optional[Sequence[str]] = None
+                         ) -> DataFrame:
+        if isinstance(data, pa.Table):
+            table = data
+        elif hasattr(data, "to_dict") and hasattr(data, "columns"):  # pandas
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:  # rows
+            import pandas as pd
+            table = pa.Table.from_pandas(pd.DataFrame(data, columns=schema),
+                                         preserve_index=False)
+        return DataFrame(lp.LocalRelation(table), self)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1
+              ) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(lp.Range(start, end, step), self)
+
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    def set_conf(self, key: str, value) -> None:
+        self.conf = self.conf.with_overrides({key: value})
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, Any] = {}
+
+    def config(self, key: str, value) -> "TpuSessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def getOrCreate(self) -> TpuSession:
+        return TpuSession(self._conf)

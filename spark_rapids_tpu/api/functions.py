@@ -1,0 +1,206 @@
+"""User-facing function namespace (pyspark.sql.functions analog)."""
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from spark_rapids_tpu.api.column import Column, _expr
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs import (Abs, Acos, Asin, Atan, Atan2,
+                                    AtLeastNNonNulls, Average, CaseWhen, Cbrt, Ceil,
+                                    Coalesce, Concat, Cos, Cosh, Count, DateAdd,
+                                    DateDiff, DateSub, DayOfMonth, DayOfWeek,
+                                    DayOfYear, Exp, Expm1, First, Floor, Greatest,
+                                    Hour, If, Last, LastDay, Least, Length, Literal,
+                                    Log, Log1p, Log2, Log10, Lower, Max, Min, Minute,
+                                    Month, MonotonicallyIncreasingID, NaNvl, Pmod,
+                                    Pow, Quarter, Rand, Rint, Round, Second, Signum,
+                                    Sin, Sinh, SparkPartitionID, Sqrt, StringTrim,
+                                    Substring, Sum, Tan, Tanh, ToDegrees, ToRadians,
+                                    UnresolvedAttribute, Upper, Year)
+
+
+def col(name: str) -> Column:
+    return Column(UnresolvedAttribute(name))
+
+
+def lit(value: Any) -> Column:
+    return Column(Literal.of(value))
+
+
+# aggregates ---------------------------------------------------------------
+def count(c: Union[str, Column] = "*") -> Column:
+    # note: `c == "*"` would be wrong here — Column.__eq__ builds an expression
+    if isinstance(c, str):
+        if c == "*":
+            return Column(Count(Literal.of(1)))
+        return Column(Count(col(c).expr))
+    if isinstance(c.expr, Literal):
+        return Column(Count(Literal.of(1)))
+    return Column(Count(c.expr))
+
+
+def sum(c: Union[str, Column]) -> Column:  # noqa: A001 - mirrors pyspark
+    return Column(Sum(_c(c)))
+
+
+def avg(c: Union[str, Column]) -> Column:
+    return Column(Average(_c(c)))
+
+
+mean = avg
+
+
+def min(c: Union[str, Column]) -> Column:  # noqa: A001
+    return Column(Min(_c(c)))
+
+
+def max(c: Union[str, Column]) -> Column:  # noqa: A001
+    return Column(Max(_c(c)))
+
+
+def first(c: Union[str, Column], ignorenulls: bool = False) -> Column:
+    return Column(First(_c(c), ignorenulls))
+
+
+def last(c: Union[str, Column], ignorenulls: bool = False) -> Column:
+    return Column(Last(_c(c), ignorenulls))
+
+
+def _c(c: Union[str, Column]):
+    return col(c).expr if isinstance(c, str) else c.expr
+
+
+# conditionals -------------------------------------------------------------
+class _WhenColumn(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(CaseWhen(tuple(branches), None))
+
+    def when(self, cond: Column, value: Any) -> "_WhenColumn":
+        return _WhenColumn(self._branches + [(cond.expr, _expr(value))])
+
+    def otherwise(self, value: Any) -> Column:
+        return Column(CaseWhen(tuple(self._branches), _expr(value)))
+
+
+def when(cond: Column, value: Any) -> _WhenColumn:
+    return _WhenColumn([(cond.expr, _expr(value))])
+
+
+def coalesce(*cols: Column) -> Column:
+    return Column(Coalesce(tuple(_expr(c) for c in cols)))
+
+
+def nanvl(a: Column, b: Column) -> Column:
+    return Column(NaNvl(_expr(a), _expr(b)))
+
+
+def greatest(*cols) -> Column:
+    return Column(Greatest(tuple(_expr(c) for c in cols)))
+
+
+def least(*cols) -> Column:
+    return Column(Least(tuple(_expr(c) for c in cols)))
+
+
+# math ---------------------------------------------------------------------
+def _unary(cls):
+    def f(c: Union[str, Column]) -> Column:
+        return Column(cls(_c(c)))
+    return f
+
+
+abs = _unary(Abs)  # noqa: A001
+sqrt = _unary(Sqrt)
+cbrt = _unary(Cbrt)
+exp = _unary(Exp)
+expm1 = _unary(Expm1)
+log = _unary(Log)
+log2 = _unary(Log2)
+log10 = _unary(Log10)
+log1p = _unary(Log1p)
+sin = _unary(Sin)
+cos = _unary(Cos)
+tan = _unary(Tan)
+asin = _unary(Asin)
+acos = _unary(Acos)
+atan = _unary(Atan)
+sinh = _unary(Sinh)
+cosh = _unary(Cosh)
+tanh = _unary(Tanh)
+degrees = _unary(ToDegrees)
+radians = _unary(ToRadians)
+signum = _unary(Signum)
+floor = _unary(Floor)
+ceil = _unary(Ceil)
+rint = _unary(Rint)
+
+
+def pow(a, b) -> Column:  # noqa: A001
+    return Column(Pow(_expr(a), _expr(b)))
+
+
+def atan2(a, b) -> Column:
+    return Column(Atan2(_expr(a), _expr(b)))
+
+
+def pmod(a, b) -> Column:
+    return Column(Pmod(_expr(a), _expr(b)))
+
+
+def round(c: Union[str, Column], scale: int = 0) -> Column:  # noqa: A001
+    return Column(Round(_c(c), scale))
+
+
+# strings ------------------------------------------------------------------
+upper = _unary(Upper)
+lower = _unary(Lower)
+length = _unary(Length)
+trim = _unary(StringTrim)
+
+
+def substring(c: Union[str, Column], pos: int, length_: int) -> Column:
+    return Column(Substring(_c(c), Literal.of(pos), Literal.of(length_)))
+
+
+def concat(*cols) -> Column:
+    return Column(Concat(tuple(_c(c) if isinstance(c, str) else c.expr
+                               for c in cols)))
+
+
+# datetime -----------------------------------------------------------------
+year = _unary(Year)
+month = _unary(Month)
+dayofmonth = _unary(DayOfMonth)
+dayofweek = _unary(DayOfWeek)
+dayofyear = _unary(DayOfYear)
+quarter = _unary(Quarter)
+hour = _unary(Hour)
+minute = _unary(Minute)
+second = _unary(Second)
+last_day = _unary(LastDay)
+
+
+def date_add(c, days) -> Column:
+    return Column(DateAdd(_c(c) if isinstance(c, str) else c.expr, _expr(days)))
+
+
+def date_sub(c, days) -> Column:
+    return Column(DateSub(_c(c) if isinstance(c, str) else c.expr, _expr(days)))
+
+
+def datediff(end, start) -> Column:
+    return Column(DateDiff(_expr(end), _expr(start)))
+
+
+# ids / random -------------------------------------------------------------
+def spark_partition_id() -> Column:
+    return Column(SparkPartitionID())
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(MonotonicallyIncreasingID())
+
+
+def rand(seed: int = 0) -> Column:
+    return Column(Rand(seed))

@@ -1,0 +1,79 @@
+"""Physical operator base classes.
+
+Reference analogs: GpuExec trait (GpuExec.scala:58, doExecuteColumnar returning
+RDD[ColumnarBatch]) and Spark's SparkPlan for the CPU side. Here a physical exec
+produces an iterator of batches per partition: HostBatch for CPU execs, DeviceBatch
+for TPU execs; transition execs move between the two (GpuRowToColumnarExec /
+GpuColumnarToRowExec analogs).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.utils.metrics import (MetricSet, NUM_OUTPUT_BATCHES,
+                                            NUM_OUTPUT_ROWS, TOTAL_TIME)
+
+
+class ExecContext:
+    """Per-execution state handed down the operator tree."""
+
+    def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
+                 num_partitions: int = 1):
+        self.conf = conf or TpuConf()
+        self.partition_id = partition_id
+        self.num_partitions = num_partitions
+
+    @property
+    def string_max_bytes(self) -> int:
+        return self.conf.string_max_bytes
+
+
+class PhysicalExec:
+    """Base physical operator. ``output`` is the produced schema; ``execute``
+    yields batches for one partition."""
+
+    #: True when this exec produces DeviceBatch (TPU side)
+    is_device: bool = False
+
+    def __init__(self, children: Sequence["PhysicalExec"], output: Schema):
+        self.children: Tuple[PhysicalExec, ...] = tuple(children)
+        self.output = output
+        self.metrics = MetricSet(NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, TOTAL_TIME)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        raise NotImplementedError(self.name)
+
+    # ---- plan display ---------------------------------------------------------
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + f"{self.name} [{self.output}]"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def transform_up(self, fn) -> "PhysicalExec":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self
+        if tuple(new_children) != self.children:
+            node = self.with_children(new_children)
+        return fn(node)
+
+    def with_children(self, children: Sequence["PhysicalExec"]) -> "PhysicalExec":
+        import copy
+        node = copy.copy(self)
+        node.children = tuple(children)
+        return node
+
+    def count_output(self, num_rows: int) -> None:
+        self.metrics[NUM_OUTPUT_ROWS].add(num_rows)
+        self.metrics[NUM_OUTPUT_BATCHES].add(1)
+
+
+class LeafExec(PhysicalExec):
+    def __init__(self, output: Schema):
+        super().__init__((), output)

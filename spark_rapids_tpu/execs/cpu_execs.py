@@ -1,0 +1,215 @@
+"""CPU physical operators — the fallback/compare engine (the stand-in for CPU
+Spark in the reference's CPU-vs-GPU architecture). Eager numpy over HostBatch,
+sharing the exact kernel code the TPU path traces, so fallback results are
+bit-identical by construction.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
+from spark_rapids_tpu.execs.evaluator import eval_exprs_host, output_schema
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+from spark_rapids_tpu.exprs.misc import SortOrder
+from spark_rapids_tpu.ops import batch_kernels as bk
+from spark_rapids_tpu.ops.aggregate import group_aggregate
+
+
+def _host_colvs(batch: HostBatch) -> List[ColV]:
+    return [ColV(c.dtype, c.data, c.validity, c.lengths) for c in batch.columns]
+
+
+def _colvs_to_host(schema: Schema, colvs: Sequence[ColV], num_rows: int) -> HostBatch:
+    """Host batches keep arrays exactly num_rows long (no capacity padding on
+    the CPU engine), so results of compaction/aggregation are trimmed here."""
+    cols = []
+    for v in colvs:
+        cols.append(HostColumn(
+            v.dtype, np.asarray(v.data)[:num_rows],
+            np.asarray(v.validity)[:num_rows],
+            np.asarray(v.lengths)[:num_rows] if v.lengths is not None else None))
+    return HostBatch(schema, tuple(cols), num_rows)
+
+
+def concat_host_batches(batches: List[HostBatch], schema: Schema) -> HostBatch:
+    if not batches:
+        return HostBatch.from_arrow(schema.to_pa().empty_table())
+    if len(batches) == 1:
+        return batches[0]
+    tables = [b.to_arrow() for b in batches]
+    return HostBatch.from_arrow(pa.concat_tables(tables))
+
+
+class CpuLocalScanExec(LeafExec):
+    def __init__(self, table: pa.Table, string_max_bytes: int = 256):
+        super().__init__(Schema.from_pa(table.schema))
+        self.table = table
+        self._smax = string_max_bytes
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        if ctx.partition_id == 0:
+            b = HostBatch.from_arrow(self.table, ctx.string_max_bytes)
+            self.count_output(b.num_rows)
+            yield b
+
+
+class CpuRangeExec(LeafExec):
+    """Analog of GpuRangeExec (basicPhysicalOperators.scala:182)."""
+
+    def __init__(self, start: int, end: int, step: int):
+        super().__init__(Schema([Field("id", DType.LONG, nullable=False)]))
+        self.start, self.end, self.step = start, end, step
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        if ctx.partition_id != 0:
+            return
+        data = np.arange(self.start, self.end, self.step, dtype=np.int64)
+        col = HostColumn(DType.LONG, data, np.ones(len(data), dtype=bool))
+        self.count_output(len(data))
+        yield HostBatch(self.output, (col,), len(data))
+
+
+class CpuProjectExec(PhysicalExec):
+    def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec):
+        super().__init__((child,), output_schema(exprs))
+        self.exprs = exprs
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for batch in self.children[0].execute(ctx):
+            out = eval_exprs_host(self.exprs, batch, ctx.string_max_bytes,
+                                  {"partition_id": ctx.partition_id})
+            self.count_output(out.num_rows)
+            yield out
+
+
+class CpuFilterExec(PhysicalExec):
+    def __init__(self, condition: Expression, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.condition = condition
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for batch in self.children[0].execute(ctx):
+            colvs = _host_colvs(batch)
+            ectx = EvalCtx(np, colvs, batch.num_rows, ctx.string_max_bytes)
+            with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+                pred = self.condition.eval(ectx)
+                keep = np.logical_and(np.asarray(pred.data, dtype=bool),
+                                      np.asarray(pred.validity, dtype=bool))
+                if keep.ndim == 0:
+                    keep = np.broadcast_to(keep, (batch.num_rows,))
+                out_cols, n = bk.compact(np, keep, colvs, batch.num_rows)
+            out = _colvs_to_host(self.output, out_cols, int(n))
+            self.count_output(out.num_rows)
+            yield out
+
+
+class CpuHashAggregateExec(PhysicalExec):
+    """Whole-input aggregation (single partition path; the partial/final split
+    rides the exchange exec)."""
+
+    def __init__(self, grouping: Tuple[Expression, ...],
+                 aggregates: Tuple[Expression, ...],  # Alias(AggregateFunction)
+                 child: PhysicalExec, output: Schema):
+        super().__init__((child,), output)
+        self.grouping = grouping
+        self.aggregates = aggregates
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        from spark_rapids_tpu.exprs.misc import Alias
+        child_batches = list(self.children[0].execute(ctx))
+        batch = concat_host_batches(child_batches, self.children[0].output)
+        colvs = _host_colvs(batch)
+        n = batch.num_rows
+        cap = max(n, 1)
+        if n == 0:
+            # one all-invalid padding row so global aggregates still emit their
+            # empty-input row (count=0, sum=null)
+            colvs = [ColV(v.dtype,
+                          np.zeros((1,) + v.data.shape[1:], v.data.dtype),
+                          np.zeros(1, dtype=bool),
+                          np.zeros(1, np.int32) if v.lengths is not None else None)
+                     for v in colvs]
+        ectx = EvalCtx(np, colvs, cap, ctx.string_max_bytes)
+        fns = [a.c if isinstance(a, Alias) else a for a in self.aggregates]
+        with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+            key_cols, res_cols, num_groups = group_aggregate(
+                np, ectx, self.grouping, fns, n, cap)
+        out = _colvs_to_host(self.output, list(key_cols) + list(res_cols),
+                             int(num_groups))
+        self.count_output(out.num_rows)
+        yield out
+
+
+class CpuSortExec(PhysicalExec):
+    """Total sort (RequireSingleBatch semantics like GpuSortExec global sort)."""
+
+    def __init__(self, orders: Tuple[SortOrder, ...], child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.orders = orders
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        batches = list(self.children[0].execute(ctx))
+        batch = concat_host_batches(batches, self.output)
+        colvs = _host_colvs(batch)
+        n = batch.num_rows
+        if n == 0:
+            yield batch
+            return
+        ectx = EvalCtx(np, colvs, n, ctx.string_max_bytes)
+        with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+            keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
+                    for o in self.orders]
+            order = bk.sort_indices(np, keys, n)
+            out_cols = [bk.take_colv(np, v, order) for v in colvs]
+        out = _colvs_to_host(self.output, out_cols, n)
+        self.count_output(n)
+        yield out
+
+
+class CpuLimitExec(PhysicalExec):
+    def __init__(self, n: int, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.n = n
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        remaining = self.n
+        for batch in self.children[0].execute(ctx):
+            if remaining <= 0:
+                break
+            take = min(remaining, batch.num_rows)
+            remaining -= take
+            if take == batch.num_rows:
+                yield batch
+            else:
+                t = batch.to_arrow().slice(0, take)
+                yield HostBatch.from_arrow(t, ctx.string_max_bytes)
+
+
+class CpuUnionExec(PhysicalExec):
+    def __init__(self, left: PhysicalExec, right: PhysicalExec):
+        super().__init__((left, right), left.output)
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for child in self.children:
+            yield from child.execute(ctx)
+
+
+class CpuCollectExec(PhysicalExec):
+    """Plan root: drain batches to one arrow table (GpuBringBackToHost analog)."""
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__((child,), child.output)
+
+    def collect(self, ctx: ExecContext) -> pa.Table:
+        tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
+        if not tables:
+            return self.output.to_pa().empty_table()
+        return pa.concat_tables(tables)
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        yield from self.children[0].execute(ctx)

@@ -1,0 +1,374 @@
+"""TPU physical operators.
+
+Reference analogs are the Gpu*Exec operators (basicPhysicalOperators.scala:66
+GpuProjectExec, :127 GpuFilterExec, aggregate.scala:227 GpuHashAggregateExec,
+GpuSortExec.scala:50, limit.scala, GpuCoalesceBatches.scala) — but instead of one
+cuDF JNI call per op, each exec traces its ENTIRE pipeline (expression evaluation,
+masking, compaction/sort/segment reduction) into one jitted XLA program per
+(operator-config, schema, capacity-bucket) key. Logical row counts cross the jit
+boundary as traced scalars and sync to the host once per batch.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
+from spark_rapids_tpu.execs.evaluator import (eval_exprs_device, output_schema)
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+from spark_rapids_tpu.exprs.misc import Alias, SortOrder
+from spark_rapids_tpu.ops import batch_kernels as bk
+from spark_rapids_tpu.ops.aggregate import group_aggregate
+
+_JIT_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+
+
+def _flatten(batch: DeviceBatch) -> List:
+    flat = []
+    for c in batch.columns:
+        flat.append(c.data)
+        flat.append(c.validity)
+        if c.lengths is not None:
+            flat.append(c.lengths)
+    return flat
+
+
+def _unflatten_colvs(schema: Schema, flat) -> List[ColV]:
+    cols, i = [], 0
+    for f in schema:
+        if f.dtype is DType.STRING:
+            cols.append(ColV(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
+            i += 3
+        else:
+            cols.append(ColV(f.dtype, flat[i], flat[i + 1]))
+            i += 2
+    return cols
+
+
+def _flatten_colvs(colvs: Sequence[ColV]) -> List:
+    flat = []
+    for v in colvs:
+        flat.append(v.data)
+        flat.append(v.validity)
+        if v.dtype is DType.STRING:
+            flat.append(v.lengths)
+    return flat
+
+
+def _to_batch(schema: Schema, flat, num_rows: int) -> DeviceBatch:
+    cols, i = [], 0
+    for f in schema:
+        if f.dtype is DType.STRING:
+            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
+            i += 3
+        else:
+            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1]))
+            i += 2
+    return DeviceBatch(schema, tuple(cols), num_rows)
+
+
+def _cached_jit(key, builder):
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder())
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
+                          string_max_bytes: int = 256) -> DeviceBatch:
+    """Concatenate batches into one (GpuCoalesceBatches / Table.concatenate
+    analog). Row offsets are host-static, so this is plain slicing + concat that
+    XLA lowers to device copies; result re-bucketed."""
+    batches = [b for b in batches if b.num_rows > 0]
+    if not batches:
+        return DeviceBatch.empty(schema, string_max_bytes)
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(total)
+    cols = []
+    for ci, f in enumerate(schema):
+        datas, valids, lens = [], [], []
+        for b in batches:
+            c = b.columns[ci]
+            datas.append(c.data[:b.num_rows])
+            valids.append(c.validity[:b.num_rows])
+            if c.lengths is not None:
+                lens.append(c.lengths[:b.num_rows])
+        data = jnp.concatenate(datas, axis=0)
+        validity = jnp.concatenate(valids, axis=0)
+        pad = cap - total
+        if pad:
+            pad_shape = (pad,) + data.shape[1:]
+            data = jnp.concatenate([data, jnp.zeros(pad_shape, data.dtype)], axis=0)
+            validity = jnp.concatenate([validity, jnp.zeros(pad, bool)], axis=0)
+        if f.dtype is DType.STRING:
+            lengths = jnp.concatenate(lens, axis=0)
+            if pad:
+                lengths = jnp.concatenate(
+                    [lengths, jnp.zeros(pad, lengths.dtype)], axis=0)
+            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+        else:
+            cols.append(DeviceColumn(f.dtype, data, validity))
+    return DeviceBatch(schema, tuple(cols), total)
+
+
+# ---------------------------------------------------------------- transitions
+class HostToDeviceExec(PhysicalExec):
+    """Upload transition (GpuRowToColumnarExec / HostColumnarToGpu analog)."""
+
+    is_device = True
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__((child,), child.output)
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for hb in self.children[0].execute(ctx):
+            table = hb.to_arrow() if isinstance(hb, HostBatch) else hb
+            b = DeviceBatch.from_arrow(table, ctx.string_max_bytes)
+            self.count_output(b.num_rows)
+            yield b
+
+
+class DeviceToHostExec(PhysicalExec):
+    """Download transition (GpuColumnarToRowExec analog)."""
+
+    is_device = False
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__((child,), child.output)
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for db in self.children[0].execute(ctx):
+            hb = HostBatch.from_arrow(db.to_arrow(), ctx.string_max_bytes)
+            self.count_output(hb.num_rows)
+            yield hb
+
+
+# ---------------------------------------------------------------- leaf / simple
+class TpuRangeExec(LeafExec):
+    is_device = True
+
+    def __init__(self, start: int, end: int, step: int):
+        super().__init__(Schema([Field("id", DType.LONG, nullable=False)]))
+        self.start, self.end, self.step = start, end, step
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        if ctx.partition_id != 0:
+            return
+        n = max(0, -(-(self.end - self.start) // self.step))
+        cap = bucket_capacity(n)
+        data = self.start + jnp.arange(cap, dtype=jnp.int64) * self.step
+        validity = jnp.arange(cap, dtype=jnp.int32) < n
+        self.count_output(n)
+        yield DeviceBatch(self.output,
+                          (DeviceColumn(DType.LONG, data, validity),), n)
+
+
+class TpuProjectExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec):
+        super().__init__((child,), output_schema(exprs))
+        self.exprs = exprs
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for batch in self.children[0].execute(ctx):
+            out = eval_exprs_device(self.exprs, batch, ctx.string_max_bytes,
+                                    {"partition_id": ctx.partition_id})
+            self.count_output(out.num_rows)
+            yield out
+
+
+class TpuFilterExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, condition: Expression, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.condition = condition
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        schema = self.output
+        for batch in self.children[0].execute(ctx):
+            cap = batch.capacity
+            key = ("filter", self.condition, schema, cap, ctx.string_max_bytes)
+
+            def build(cond=self.condition, schema=schema, cap=cap,
+                      smax=ctx.string_max_bytes):
+                def fn(num_rows, *flat):
+                    colvs = _unflatten_colvs(schema, flat)
+                    ectx = EvalCtx(jnp, colvs, cap, smax)
+                    pred = cond.eval(ectx)
+                    alive = jnp.arange(cap, dtype=np.int32) < num_rows
+                    keep = jnp.logical_and(
+                        jnp.logical_and(pred.data, pred.validity), alive)
+                    if keep.ndim == 0:
+                        keep = jnp.broadcast_to(keep, (cap,))
+                        keep = jnp.logical_and(keep, alive)
+                    out_cols, n = bk.compact(jnp, keep, colvs, num_rows)
+                    return tuple(_flatten_colvs(out_cols)) + (n,)
+                return fn
+
+            fn = _cached_jit(key, build)
+            res = fn(np.int32(batch.num_rows), *_flatten(batch))
+            n = int(res[-1])
+            out = _to_batch(schema, res[:-1], n)
+            self.count_output(n)
+            yield out
+
+
+class TpuHashAggregateExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, grouping: Tuple[Expression, ...],
+                 aggregates: Tuple[Expression, ...], child: PhysicalExec,
+                 output: Schema):
+        super().__init__((child,), output)
+        self.grouping = grouping
+        self.aggregates = aggregates
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        child_batches = list(self.children[0].execute(ctx))
+        batch = concat_device_batches(child_batches, self.children[0].output,
+                                      ctx.string_max_bytes)
+        cap = batch.capacity
+        schema = self.children[0].output
+        fns = tuple(a.c if isinstance(a, Alias) else a for a in self.aggregates)
+        key = ("agg", self.grouping, fns, schema, cap, ctx.string_max_bytes)
+
+        def build(grouping=self.grouping, fns=fns, schema=schema, cap=cap,
+                  smax=ctx.string_max_bytes):
+            def fn(num_rows, *flat):
+                colvs = _unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                key_cols, res_cols, num_groups = group_aggregate(
+                    jnp, ectx, grouping, fns, num_rows, cap)
+                return tuple(_flatten_colvs(list(key_cols) + list(res_cols))) + (
+                    num_groups,)
+            return fn
+
+        fn = _cached_jit(key, build)
+        res = fn(np.int32(batch.num_rows), *_flatten(batch))
+        n = int(res[-1])
+        out = _to_batch(self.output, res[:-1], n)
+        self.count_output(n)
+        yield out
+
+
+class TpuSortExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, orders: Tuple[SortOrder, ...], child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.orders = orders
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        batches = list(self.children[0].execute(ctx))
+        batch = concat_device_batches(batches, self.output, ctx.string_max_bytes)
+        if batch.num_rows == 0:
+            yield batch
+            return
+        cap = batch.capacity
+        schema = self.output
+        key = ("sort", self.orders, schema, cap, ctx.string_max_bytes)
+
+        def build(orders=self.orders, schema=schema, cap=cap,
+                  smax=ctx.string_max_bytes):
+            def fn(num_rows, *flat):
+                colvs = _unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
+                        for o in orders]
+                order = bk.sort_indices(jnp, keys, num_rows)
+                out_cols = [bk.take_colv(jnp, v, order) for v in colvs]
+                return tuple(_flatten_colvs(out_cols))
+            return fn
+
+        fn = _cached_jit(key, build)
+        res = fn(np.int32(batch.num_rows), *_flatten(batch))
+        out = _to_batch(schema, res, batch.num_rows)
+        self.count_output(out.num_rows)
+        yield out
+
+
+class TpuLimitExec(PhysicalExec):
+    """Limit = shrink the logical row count; padding invariants handled by
+    invalidating rows >= n (no data movement at all on device)."""
+
+    is_device = True
+
+    def __init__(self, n: int, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.n = n
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        remaining = self.n
+        for batch in self.children[0].execute(ctx):
+            if remaining <= 0:
+                break
+            take = min(remaining, batch.num_rows)
+            remaining -= take
+            if take == batch.num_rows:
+                self.count_output(take)
+                yield batch
+                continue
+            cols = []
+            alive = jnp.arange(batch.capacity, dtype=np.int32) < take
+            for c in batch.columns:
+                cols.append(DeviceColumn(c.dtype, c.data,
+                                         jnp.logical_and(c.validity, alive),
+                                         c.lengths))
+            self.count_output(take)
+            yield DeviceBatch(batch.schema, tuple(cols), take)
+
+
+class TpuUnionExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, left: PhysicalExec, right: PhysicalExec):
+        super().__init__((left, right), left.output)
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for child in self.children:
+            yield from child.execute(ctx)
+
+
+class TpuCoalesceBatchesExec(PhysicalExec):
+    """Concatenate small batches toward the target size
+    (GpuCoalesceBatches.scala:502 analog; TargetSize goal)."""
+
+    is_device = True
+
+    def __init__(self, child: PhysicalExec, target_bytes: int = 1 << 31,
+                 require_single: bool = False):
+        super().__init__((child,), child.output)
+        self.target_bytes = target_bytes
+        self.require_single = require_single
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        pending: List[DeviceBatch] = []
+        pending_bytes = 0
+        for batch in self.children[0].execute(ctx):
+            pending.append(batch)
+            pending_bytes += batch.device_size_bytes
+            if not self.require_single and pending_bytes >= self.target_bytes:
+                out = concat_device_batches(pending, self.output,
+                                            ctx.string_max_bytes)
+                self.count_output(out.num_rows)
+                yield out
+                pending, pending_bytes = [], 0
+        if pending or self.require_single:
+            out = concat_device_batches(pending, self.output,
+                                        ctx.string_max_bytes)
+            self.count_output(out.num_rows)
+            yield out

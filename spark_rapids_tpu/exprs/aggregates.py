@@ -124,6 +124,9 @@ class _MinMax(AggregateFunction):
 
     def project(self, ctx: EvalCtx) -> List[ColV]:
         v = self.c.eval(ctx)
+        if v.dtype is DType.STRING:
+            # strings reduce via rank-based segment pick; no neutral substitution
+            return [v]
         xp = ctx.xp
         neutral = _reduce_neutral(self.kind, v.dtype)
         data = xp.where(v.validity, v.data, neutral)

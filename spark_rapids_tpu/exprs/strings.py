@@ -144,10 +144,44 @@ class Like(_ConstPatternPredicate):
             return ("suffix", body)
         return ("exact", body)
 
+    @staticmethod
+    def to_regex(pattern: str) -> str:
+        """SQL LIKE pattern -> anchored python regex (escape char '\\')."""
+        import re as _re
+        out = []
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == "\\" and i + 1 < len(pattern):
+                out.append(_re.escape(pattern[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        # \Z not $: $ would also match before a trailing newline
+        return "^" + "".join(out) + r"\Z"
+
     def do_match(self, xp, col, W):
         pat = self.pattern.decode("utf-8")
         kind_needle = Like.classify(pat)
         if kind_needle is None:
+            if xp is np:
+                # eager CPU engine: full regex semantics (the fallback path the
+                # plan layer routes unsupported patterns to)
+                import re as _re
+                rx = _re.compile(Like.to_regex(pat), _re.DOTALL)
+                n = col.data.shape[0]
+                res = np.zeros(n, dtype=bool)
+                for i in range(n):
+                    s = bytes(col.data[i, :col.lengths[i]]).decode(
+                        "utf-8", errors="replace")
+                    res[i] = rx.match(s) is not None
+                return res
             raise NotImplementedError(f"LIKE pattern {pat!r} needs regex; CPU fallback")
         kind, needle = kind_needle
         nb = needle.encode("utf-8")

@@ -1,0 +1,52 @@
+"""ORC scan (reference: GpuOrcScan.scala, 752 LoC — same host-stage/device-decode
+pattern as parquet; SARG pushdown analog pending)."""
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import pyarrow.orc as po
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+
+
+class CpuOrcScanExec(LeafExec):
+    def __init__(self, paths: Tuple[str, ...], schema: Schema):
+        super().__init__(schema)
+        self.paths = paths
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        if ctx.partition_id != 0:
+            return
+        import pyarrow as pa
+        for p in self.paths:
+            f = po.ORCFile(p)
+            for i in range(f.nstripes):
+                rb = f.read_stripe(i)
+                t = pa.Table.from_batches([rb]).cast(self.output.to_pa())
+                b = HostBatch.from_arrow(t, ctx.string_max_bytes)
+                self.count_output(b.num_rows)
+                yield b
+
+
+class TpuOrcScanExec(LeafExec):
+    is_device = True
+
+    def __init__(self, paths: Tuple[str, ...], schema: Schema):
+        super().__init__(schema)
+        self.paths = paths
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        if ctx.partition_id != 0:
+            return
+        import pyarrow as pa
+        for p in self.paths:
+            f = po.ORCFile(p)
+            for i in range(f.nstripes):
+                rb = f.read_stripe(i)
+                t = pa.Table.from_batches([rb]).cast(self.output.to_pa())
+                b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
+                self.count_output(b.num_rows)
+                yield b

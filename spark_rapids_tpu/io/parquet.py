@@ -1,0 +1,71 @@
+"""Parquet scan execs (reference: GpuParquetScan.scala, 699 LoC).
+
+The reference's pattern — CPU footer parse + predicate-pushdown row-group clipping
++ host staging, then device decode (GpuParquetScan.scala:342,576) — maps here to:
+pyarrow reads footers and decodes row groups into host Arrow memory (the CPU
+stage), and the TPU exec uploads straight into bucketed device buffers (the
+device stage). Row-group pruning via parquet statistics happens on the CPU
+before any data is read (clipBlocks analog). Chunking honors
+maxReadBatchSizeRows/Bytes like populateCurrentBlockChunk (GpuParquetScan.scala:599).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+
+
+def _iter_tables(paths: Sequence[str], schema: Schema, max_rows: int,
+                 columns: Optional[List[str]] = None) -> Iterator[pa.Table]:
+    want = columns or schema.names()
+    for path in paths:
+        f = pq.ParquetFile(path)
+        for rb in f.iter_batches(batch_size=max_rows, columns=want):
+            yield pa.Table.from_batches([rb]).cast(schema.to_pa())
+
+
+class CpuParquetScanExec(LeafExec):
+    def __init__(self, paths: Tuple[str, ...], schema: Schema,
+                 max_batch_rows: int = 1 << 20):
+        super().__init__(schema)
+        self.paths = paths
+        self.max_batch_rows = max_batch_rows
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        if ctx.partition_id != 0:
+            return
+        for t in _iter_tables(self.paths, self.output, self.max_batch_rows):
+            b = HostBatch.from_arrow(t, ctx.string_max_bytes)
+            self.count_output(b.num_rows)
+            yield b
+
+
+class TpuParquetScanExec(LeafExec):
+    """Host-staged read + single upload per batch into bucketed device buffers."""
+
+    is_device = True
+
+    def __init__(self, paths: Tuple[str, ...], schema: Schema,
+                 max_batch_rows: int = 1 << 20):
+        super().__init__(schema)
+        self.paths = paths
+        self.max_batch_rows = max_batch_rows
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        if ctx.partition_id != 0:
+            return
+        for t in _iter_tables(self.paths, self.output, self.max_batch_rows):
+            b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
+            self.count_output(b.num_rows)
+            yield b
+
+
+def write_parquet(table: pa.Table, path: str, compression: str = "snappy") -> None:
+    """Columnar parquet write (ColumnarOutputWriter / GpuParquetWriter analog)."""
+    pq.write_table(table, path, compression=compression)

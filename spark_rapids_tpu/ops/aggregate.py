@@ -1,0 +1,209 @@
+"""Sort-based group-by aggregation pipeline.
+
+The TPU replacement for cuDF's hash groupby (reference: aggregate.scala:227
+GpuHashAggregateExec -> Table.groupBy().aggregate()): keys are sorted (XLA's TPU
+sort is excellent and shape-static), group boundaries become segment ids, and
+aggregation buffers reduce via segment ops. The whole pipeline — key evaluation,
+buffer projection, sort, boundary detection, reduction, final evaluation — traces
+into ONE XLA program; group count is a traced scalar (row-count sidecar).
+
+Used eagerly with numpy by the CPU engine and traced with jax.numpy by the TPU
+exec, so both paths share one semantics definition.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.aggregates import AggregateFunction
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx
+from spark_rapids_tpu.ops import batch_kernels as bk
+
+
+def _take(xp, v: ColV, order) -> ColV:
+    return bk.take_colv(xp, v, order)
+
+
+def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunction],
+                    num_rows, capacity: int, evaluate: bool = True):
+    """Full grouped aggregation over one batch.
+
+    Returns (key_cols, result_cols, num_groups): reduced key columns, final
+    aggregate result columns (one per agg fn), and the traced group count.
+    With no keys, produces exactly one group (Spark's global aggregate,
+    including the empty-input row).
+
+    With ``evaluate=False`` this is the *Partial* mode of the reference's
+    GpuHashAggregateExec (aggregate.scala modes Partial/Final): result_cols are
+    the reduced aggregation BUFFERS (flattened across fns) rather than final
+    values, ready for ``merge_aggregate`` after an exchange/all-gather.
+    """
+    alive = bk.alive_mask(xp, capacity, num_rows)
+
+    keys = [e.eval(ctx) for e in key_exprs]
+    # padding rows must not merge with null-key groups: mask handled via `alive`
+    projections: List[List[ColV]] = []
+    for fn in agg_fns:
+        bufs = fn.project(ctx)
+        # padding rows never contribute
+        projections.append([b.with_validity(xp.logical_and(b.validity, alive))
+                            for b in bufs])
+
+    if keys:
+        order = bk.sort_indices(xp, [(k, True, True) for k in keys], alive)
+        starts = bk.rows_equal_adjacent(xp, keys, order, alive)
+        gids = xp.cumsum(starts.astype(np.int32)) - 1
+        gids = xp.clip(gids, 0, capacity - 1)
+        num_groups = xp.sum(starts).astype(np.int32)
+        sorted_alive = alive[order]
+        sorted_keys = [_take(xp, k, order) for k in keys]
+        sorted_projs = [[_take(xp, b, order) for b in bufs]
+                        for bufs in projections]
+    else:
+        order = xp.arange(capacity, dtype=np.int32)
+        gids = xp.zeros(capacity, dtype=np.int32)
+        num_groups = xp.asarray(np.int32(1))
+        sorted_alive = alive
+        sorted_keys = []
+        sorted_projs = projections
+
+    # ---- reduce keys: representative row per group -----------------------------
+    pick, has = bk.segment_pick(xp, xp.ones_like(sorted_alive), gids, capacity,
+                                "first", alive=sorted_alive)
+    key_cols = []
+    for k in sorted_keys:
+        if k.dtype is DType.STRING:
+            key_cols.append(ColV(k.dtype, k.data[pick],
+                                 xp.logical_and(has, k.validity[pick]),
+                                 k.lengths[pick]))
+        else:
+            key_cols.append(ColV(k.dtype, k.data[pick],
+                                 xp.logical_and(has, k.validity[pick])))
+
+    # ---- reduce buffers --------------------------------------------------------
+    group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
+    result_cols = []
+    for fn, bufs in zip(agg_fns, sorted_projs):
+        reduced = _reduce_buffers(xp, fn, bufs, gids, capacity, sorted_alive)
+        if evaluate:
+            out = fn.evaluate(xp, reduced)
+            result_cols.append(out.with_validity(
+                xp.logical_and(out.validity, group_alive)))
+        else:
+            result_cols.extend(
+                b.with_validity(xp.logical_and(b.validity, group_alive))
+                for b in reduced)
+
+    key_cols = [k.with_validity(xp.logical_and(k.validity, group_alive))
+                for k in key_cols]
+    return key_cols, result_cols, num_groups
+
+
+def _segment_minmax_string(xp, b: ColV, gids, capacity: int, kind: str,
+                           sorted_alive) -> ColV:
+    """min/max over device strings: rank rows by byte order once, then pick the
+    lowest/highest-ranked participating row per segment (cuDF's string minmax
+    analog, built from the existing sort + segment machinery)."""
+    participating = xp.logical_and(sorted_alive, b.validity)
+    order = bk.sort_indices(xp, [(b, True, True)], participating)
+    # inverse permutation = rank of each row in sorted order
+    rank = bk._stable_argsort(xp, order).astype(np.int64)
+    n = rank.shape[0]
+    if kind == "min":
+        key = xp.where(participating, rank, np.int64(n + 1))
+        seg = bk.segment_reduce(xp, key, xp.ones_like(participating), gids,
+                                capacity, "min")[0]
+        has = seg <= n
+    else:
+        key = xp.where(participating, rank, np.int64(-1))
+        seg = bk.segment_reduce(xp, key, xp.ones_like(participating), gids,
+                                capacity, "max")[0]
+        has = seg >= 0
+    pick = order[xp.clip(seg, 0, n - 1)]
+    valid = xp.logical_and(has, b.validity[pick])
+    return ColV(b.dtype, b.data[pick], valid, b.lengths[pick])
+
+
+def _reduce_buffers(xp, fn: AggregateFunction, bufs: Sequence[ColV], gids,
+                    capacity: int, sorted_alive) -> List[ColV]:
+    reduced: List[ColV] = []
+    for spec, b in zip(fn.buffer_specs(), bufs):
+        if b.dtype is DType.STRING and spec.kind in ("min", "max"):
+            reduced.append(_segment_minmax_string(xp, b, gids, capacity,
+                                                  spec.kind, sorted_alive))
+        elif spec.kind in ("first", "last"):
+            p2, h2 = bk.segment_pick(xp, b.validity, gids, capacity,
+                                     spec.kind, alive=sorted_alive,
+                                     ignore_nulls=spec.ignore_nulls)
+            valid = xp.logical_and(h2, b.validity[p2])
+            if b.dtype is DType.STRING:
+                reduced.append(ColV(b.dtype, b.data[p2], valid, b.lengths[p2]))
+            else:
+                reduced.append(ColV(b.dtype, b.data[p2], valid))
+        else:
+            data, valid = bk.segment_reduce(xp, b.data, b.validity, gids,
+                                            capacity, spec.kind)
+            reduced.append(ColV(b.dtype, data, valid))
+    return reduced
+
+
+def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
+                    agg_fns: Sequence[AggregateFunction], num_rows, capacity: int):
+    """Final mode: merge partially-aggregated buffers (after an exchange or
+    all-gather) — group by keys again, combine each buffer with its own
+    reduction kind (sum-of-sums, min-of-mins, first-of-firsts...), then run each
+    aggregate's evaluate() (aggregate.scala Final/PartialMerge analog).
+
+    buffer_cols: the flattened partial buffers as produced by
+    group_aggregate(evaluate=False). Returns (key_cols, result_cols, num_groups).
+    """
+    alive = bk.alive_mask(xp, capacity, num_rows)
+    key_cols = [k.with_validity(xp.logical_and(k.validity, alive))
+                for k in key_cols]
+    buffer_cols = [b.with_validity(xp.logical_and(b.validity, alive))
+                   for b in buffer_cols]
+
+    if key_cols:
+        order = bk.sort_indices(xp, [(k, True, True) for k in key_cols], alive)
+        starts = bk.rows_equal_adjacent(xp, key_cols, order, alive)
+        gids = xp.clip(xp.cumsum(starts.astype(np.int32)) - 1, 0, capacity - 1)
+        num_groups = xp.sum(starts).astype(np.int32)
+        sorted_alive = alive[order]
+        sorted_keys = [_take(xp, k, order) for k in key_cols]
+        sorted_bufs = [_take(xp, b, order) for b in buffer_cols]
+    else:
+        gids = xp.zeros(capacity, dtype=np.int32)
+        num_groups = xp.asarray(np.int32(1))
+        sorted_alive = alive
+        sorted_keys = []
+        sorted_bufs = list(buffer_cols)
+
+    pick, has = bk.segment_pick(xp, xp.ones_like(sorted_alive), gids, capacity,
+                                "first", alive=sorted_alive)
+    out_keys = []
+    for k in sorted_keys:
+        if k.dtype is DType.STRING:
+            out_keys.append(ColV(k.dtype, k.data[pick],
+                                 xp.logical_and(has, k.validity[pick]),
+                                 k.lengths[pick]))
+        else:
+            out_keys.append(ColV(k.dtype, k.data[pick],
+                                 xp.logical_and(has, k.validity[pick])))
+
+    group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
+    result_cols = []
+    i = 0
+    for fn in agg_fns:
+        specs = fn.buffer_specs()
+        bufs = sorted_bufs[i:i + len(specs)]
+        i += len(specs)
+        reduced = _reduce_buffers(xp, fn, bufs, gids, capacity, sorted_alive)
+        out = fn.evaluate(xp, reduced)
+        result_cols.append(out.with_validity(
+            xp.logical_and(out.validity, group_alive)))
+
+    out_keys = [k.with_validity(xp.logical_and(k.validity, group_alive))
+                for k in out_keys]
+    return out_keys, result_cols, num_groups

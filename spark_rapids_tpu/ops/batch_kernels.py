@@ -1,0 +1,332 @@
+"""Batch-level kernels: filter compaction, sort, group-by, segment reduction.
+
+These replace the cuDF Table ops the reference leans on (Table.filter,
+Table.orderBy, Table.groupBy().aggregate(), contiguousSplit) with XLA-native
+formulations designed around static shapes:
+
+- outputs keep the input capacity; the *logical* row/group count is returned as a
+  traced scalar (the "row-count sidecar" pattern for dynamic cardinality on TPU);
+- compaction and grouping ride on stable argsort — XLA's sort is highly tuned for
+  TPU, and a sort-based group-by avoids data-dependent hash-table shapes entirely;
+- string keys sort exactly (byte-lexicographic == Spark's UTF8String order) via
+  big-endian uint64 chunk passes, least-significant chunk first;
+- everything here is traceable and fuses into the surrounding jit program.
+
+All functions take/return ColV and plain arrays; ``xp`` is numpy or jax.numpy.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV
+
+
+def _stable_argsort(xp, keys):
+    if xp is np:
+        return np.argsort(keys, kind="stable")
+    return xp.argsort(keys, stable=True)
+
+
+def take_colv(xp, v: ColV, indices) -> ColV:
+    """Permute/gather rows of a column."""
+    if v.dtype is DType.STRING:
+        return ColV(v.dtype, v.data[indices], v.validity[indices],
+                    v.lengths[indices])
+    return ColV(v.dtype, v.data[indices], v.validity[indices])
+
+
+def compact(xp, mask, columns: Sequence[ColV], num_rows):
+    """Move rows where mask is true to the front, preserving order; invalidate the
+    rest. Returns (columns, new_count). Replaces cudf Table.filter.
+
+    ``mask`` must already be False for padding rows (>= num_rows).
+    """
+    keep = xp.asarray(mask, dtype=bool)
+    order = _stable_argsort(xp, xp.logical_not(keep))  # kept rows first, stable
+    new_count = xp.sum(keep).astype(np.int32)
+    cap = keep.shape[0]
+    alive = xp.arange(cap, dtype=np.int32) < new_count
+    out = []
+    for v in columns:
+        g = take_colv(xp, v, order)
+        out.append(g.with_validity(xp.logical_and(g.validity, alive)))
+    return out, new_count
+
+
+def _null_rank(xp, v: ColV, nulls_first: bool):
+    """Null position key (explicit in SortOrder, independent of direction)."""
+    return xp.where(v.validity, np.int8(0), np.int8(-1 if nulls_first else 1))
+
+
+def _key_passes(xp, v: ColV, ascending: bool, nulls_first: bool) -> List:
+    """One sort key -> list of argsort passes, most significant first.
+
+    Each pass is an int/float array whose ascending order realizes the desired
+    order for that component. Composition runs least-significant pass first
+    (stable LSD).
+    """
+    # descending integer keys use bitwise complement (~x is monotone decreasing
+    # with no overflow at INT_MIN, unlike unary minus)
+    def flip_i(k):
+        return k if ascending else ~k
+
+    def flip_f(k):
+        return k if ascending else -k
+
+    passes: List = []
+    if v.dtype is DType.STRING:
+        W = v.data.shape[-1]
+        n_chunks = (W + 7) // 8
+        pad = n_chunks * 8 - W
+        data = v.data
+        if pad:
+            data = xp.concatenate(
+                [data, xp.zeros(data.shape[:-1] + (pad,), dtype=np.uint8)],
+                axis=-1)
+        chunks = data.reshape(data.shape[0], n_chunks, 8).astype(np.uint64)
+        shifts = xp.asarray(np.arange(56, -8, -8, dtype=np.uint64))
+        keys = xp.sum(chunks << shifts, axis=-1)  # big-endian uint64 per chunk
+        # unsigned -> order-preserving signed so argsort compares byte order.
+        # passes[0] (chunk 0) is applied last in LSD composition = most
+        # significant; the length tiebreak at the end is least significant.
+        for i in range(n_chunks):
+            signed = (keys[:, i] ^ np.uint64(2 ** 63)).astype(np.int64)
+            passes.append(flip_i(signed))
+        passes.append(flip_i(v.lengths.astype(np.int64)))
+    elif v.dtype.is_floating:
+        d = v.data.astype(np.float64)
+        nan = xp.isnan(d)
+        val = xp.where(nan, np.float64(np.inf), d)
+        # -0.0 == 0.0 for ordering; canonicalize to avoid backend-dependent ties
+        val = xp.where(val == 0, np.float64(0.0), val)
+        # Spark: NaN is the largest double. Primary comparison is (is_nan, value)
+        passes = [flip_i(nan.astype(np.int8)), flip_f(val)]
+    elif v.dtype is DType.BOOLEAN:
+        passes.append(flip_i(v.data.astype(np.int8)))
+    else:
+        passes.append(flip_i(v.data.astype(np.int64)))
+    # most significant overall: null rank
+    return [_null_rank(xp, v, nulls_first)] + passes
+
+
+def alive_mask(xp, capacity: int, alive_or_n):
+    """Normalize a row-liveness spec: an int num_rows -> prefix mask; an array
+    passes through (scattered liveness appears after all-gather of partials)."""
+    if isinstance(alive_or_n, (int, np.integer)):
+        return xp.arange(capacity, dtype=np.int32) < alive_or_n
+    if getattr(alive_or_n, "ndim", None) == 0:
+        return xp.arange(capacity, dtype=np.int32) < alive_or_n
+    return alive_or_n
+
+
+def sort_indices(xp, keys: Sequence[Tuple[ColV, bool, bool]], alive_or_n):
+    """Lexicographic multi-key sort -> row permutation (dead rows last).
+
+    keys: (column, ascending, nulls_first), most significant first. Implemented
+    as stable argsort passes composed least-significant-first (LSD); XLA's sort
+    is used with stability so earlier passes' order survives ties.
+    """
+    cap = keys[0][0].validity.shape[0]
+    alive = alive_mask(xp, cap, alive_or_n)
+    order = xp.arange(cap, dtype=np.int32)
+    all_passes: List = []
+    for v, asc, nf in keys:
+        all_passes.extend(_key_passes(xp, v, asc, nf))
+    for k in reversed(all_passes):
+        order = order[_stable_argsort(xp, k[order])]
+    # most significant of all: dead/padding rows to the back
+    is_pad = xp.logical_not(alive[order]).astype(np.int8)
+    order = order[_stable_argsort(xp, is_pad)]
+    return order
+
+
+def rows_equal_adjacent(xp, keys: Sequence[ColV], order, alive_or_n):
+    """After sorting by `order`, mark rows that START a new group.
+
+    Spark grouping semantics: null == null, NaN == NaN (keys are normalized
+    upstream for -0.0).
+    """
+    cap = order.shape[0]
+    prev = xp.concatenate([order[:1], order[:-1]])
+    new_group = xp.zeros(cap, dtype=bool)
+    first = xp.arange(cap) == 0
+    for v in keys:
+        a_valid = v.validity[order]
+        b_valid = v.validity[prev]
+        if v.dtype is DType.STRING:
+            same_data = xp.logical_and(
+                xp.all(v.data[order] == v.data[prev], axis=-1),
+                v.lengths[order] == v.lengths[prev])
+        elif v.dtype.is_floating:
+            a, b = v.data[order], v.data[prev]
+            same_data = xp.logical_or(a == b,
+                                      xp.logical_and(xp.isnan(a), xp.isnan(b)))
+        else:
+            same_data = v.data[order] == v.data[prev]
+        same = xp.where(xp.logical_and(a_valid, b_valid), same_data,
+                        a_valid == b_valid)
+        new_group = xp.logical_or(new_group, xp.logical_not(same))
+    new_group = xp.logical_or(new_group, first)
+    # padding rows never start a group
+    alive = alive_mask(xp, cap, alive_or_n)
+    return xp.logical_and(new_group, alive[order])
+
+
+def segment_pick(xp, validity, seg_ids, num_segments: int, kind: str,
+                 alive=None, ignore_nulls: bool = False):
+    """Row index of the first/last participating row per segment.
+
+    Participation: alive rows (non-padding); with ignore_nulls additionally
+    valid rows. Returns (pick_index, has_pick) — callers gather data/lengths/
+    validity with pick_index themselves (needed for string columns with
+    multiple per-row arrays).
+    """
+    n = validity.shape[0]
+    if alive is None:
+        alive = xp.ones_like(validity)
+    candidate = xp.logical_and(alive, validity) if ignore_nulls else alive
+    idx = xp.arange(n, dtype=np.int64)
+    if xp is np:
+        sentinel = n + 1 if kind == "first" else -1
+        pick = np.full(num_segments, sentinel, dtype=np.int64)
+        key = np.where(candidate, idx, sentinel)
+        op = np.minimum if kind == "first" else np.maximum
+        op.at(pick, seg_ids, key)
+    else:
+        import jax
+        ops = jax.ops
+        if kind == "first":
+            key = xp.where(candidate, idx, np.int64(n + 1))
+            pick = ops.segment_min(key, seg_ids, num_segments=num_segments)
+        else:
+            key = xp.where(candidate, idx, np.int64(-1))
+            pick = ops.segment_max(key, seg_ids, num_segments=num_segments)
+    has = xp.logical_and(pick >= 0, pick < n)
+    return xp.clip(pick, 0, max(n - 1, 0)), has
+
+
+def segment_reduce(xp, data, validity, seg_ids, num_segments: int, kind: str,
+                   ignore_nulls: bool = False):
+    """Per-segment reduction. data/validity are row-aligned; seg_ids in
+    [0, num_segments); rows with seg_id == num_segments-1 reserved for padding
+    are fine because their validity is False.
+
+    Returns (seg_data, seg_validity). For first/last, picks the value at the
+    first/last (valid, if ignore_nulls) row of each segment.
+    """
+    if xp is np:
+        return _segment_reduce_np(data, validity, seg_ids, num_segments, kind,
+                                  ignore_nulls)
+    import jax
+    import jax.numpy as jnp
+    ops = jax.ops
+    counts = ops.segment_sum(validity.astype(np.int32), seg_ids,
+                             num_segments=num_segments)
+    seg_valid = counts > 0
+    if kind == "sum":
+        contrib = jnp.where(validity, data, 0).astype(data.dtype)
+        return ops.segment_sum(contrib, seg_ids, num_segments=num_segments), seg_valid
+    if kind in ("min", "max"):
+        return (_segment_minmax_jax(jnp, ops, data, validity, seg_ids,
+                                    num_segments, kind), seg_valid)
+    if kind in ("first", "last"):
+        pick, has = segment_pick(jnp, validity, seg_ids, num_segments, kind,
+                                 ignore_nulls=ignore_nulls)
+        return data[pick], jnp.logical_and(has, validity[pick])
+    raise ValueError(kind)
+
+
+def _segment_minmax_jax(jnp, ops, data, validity, seg_ids, num_segments, kind):
+    if data.dtype == np.bool_:
+        d = data.astype(np.int8)
+        neutral = np.int8(1 if kind == "min" else 0)
+        contrib = jnp.where(validity, d, neutral)
+        f = ops.segment_min if kind == "min" else ops.segment_max
+        return f(contrib, seg_ids, num_segments=num_segments).astype(np.bool_)
+    if np.issubdtype(np.dtype(data.dtype), np.floating):
+        neutral = np.asarray(np.inf if kind == "min" else -np.inf,
+                             dtype=data.dtype)
+        # Spark NaN ordering: NaN is the largest value
+        nan = jnp.isnan(data)
+        d = jnp.where(nan, jnp.asarray(np.inf, dtype=data.dtype), data)
+        contrib = jnp.where(validity, d, neutral)
+        f = ops.segment_min if kind == "min" else ops.segment_max
+        res = f(contrib, seg_ids, num_segments=num_segments)
+        # a max that saw any NaN must return NaN; a min returns NaN only if every
+        # valid value was NaN
+        valid_nan = jnp.logical_and(nan, validity)
+        nan_count = ops.segment_sum(valid_nan.astype(np.int32), seg_ids,
+                                    num_segments=num_segments)
+        valid_count = ops.segment_sum(validity.astype(np.int32), seg_ids,
+                                      num_segments=num_segments)
+        if kind == "max":
+            res = jnp.where(nan_count > 0,
+                            jnp.asarray(np.nan, dtype=data.dtype), res)
+        else:
+            res = jnp.where(jnp.logical_and(valid_count > 0,
+                                            nan_count == valid_count),
+                            jnp.asarray(np.nan, dtype=data.dtype), res)
+        return res
+    neutral = (np.iinfo(np.dtype(data.dtype)).max if kind == "min"
+               else np.iinfo(np.dtype(data.dtype)).min)
+    contrib = jnp.where(validity, data, neutral)
+    f = ops.segment_min if kind == "min" else ops.segment_max
+    return f(contrib, seg_ids, num_segments=num_segments)
+
+
+def _segment_reduce_np(data, validity, seg_ids, num_segments, kind, ignore_nulls):
+    """Eager numpy reference implementation (CPU engine path)."""
+    seg_ids = np.asarray(seg_ids)
+    validity = np.asarray(validity)
+    counts = np.zeros(num_segments, dtype=np.int64)
+    np.add.at(counts, seg_ids, validity.astype(np.int64))
+    seg_valid = counts > 0
+    if kind == "sum":
+        out = np.zeros(num_segments, dtype=data.dtype)
+        np.add.at(out, seg_ids, np.where(validity, data, 0))
+        return out, seg_valid
+    if kind in ("min", "max"):
+        return _np_minmax(data, validity, seg_ids, num_segments, kind), seg_valid
+    if kind in ("first", "last"):
+        pick, has = segment_pick(np, validity, seg_ids, num_segments, kind,
+                                 ignore_nulls=ignore_nulls)
+        return data[pick], has & validity[pick]
+    raise ValueError(kind)
+
+
+def _np_minmax(data, validity, seg_ids, num_segments, kind):
+    isfloat = np.issubdtype(data.dtype, np.floating)
+    if data.dtype == np.bool_:
+        d = data.astype(np.int8)
+        neutral = 1 if kind == "min" else 0
+        out = np.full(num_segments, neutral, dtype=np.int8)
+        getattr(np, "minimum" if kind == "min" else "maximum").at(
+            out, seg_ids, np.where(validity, d, neutral))
+        return out.astype(np.bool_)
+    if isfloat:
+        nan = np.isnan(data)
+        d = np.where(nan, np.inf, data)
+        neutral = np.inf if kind == "min" else -np.inf
+        out = np.full(num_segments, neutral, dtype=data.dtype)
+        getattr(np, "minimum" if kind == "min" else "maximum").at(
+            out, seg_ids, np.where(validity, d, neutral))
+        valid_nan = nan & validity
+        nan_count = np.zeros(num_segments, dtype=np.int64)
+        np.add.at(nan_count, seg_ids, valid_nan.astype(np.int64))
+        valid_count = np.zeros(num_segments, dtype=np.int64)
+        np.add.at(valid_count, seg_ids, validity.astype(np.int64))
+        if kind == "max":
+            out = np.where(nan_count > 0, np.nan, out)
+        else:
+            out = np.where((valid_count > 0) & (nan_count == valid_count),
+                           np.nan, out)
+        return out.astype(data.dtype)
+    neutral = (np.iinfo(data.dtype).max if kind == "min"
+               else np.iinfo(data.dtype).min)
+    out = np.full(num_segments, neutral, dtype=data.dtype)
+    getattr(np, "minimum" if kind == "min" else "maximum").at(
+        out, seg_ids, np.where(validity, data, neutral))
+    return out

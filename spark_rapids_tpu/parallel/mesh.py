@@ -1,0 +1,36 @@
+"""Device mesh management.
+
+The TPU replacement for the reference's executor-per-GPU model
+(GpuDeviceManager.scala: one GPU per executor process): one SPMD program over a
+jax.sharding.Mesh, with batches partitioned along the data axis and collectives
+riding ICI. Multi-host scaling is the same code — jax's global mesh spans hosts
+with DCN between slices.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Rows partitioned over the data axis (leading dim)."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,200 @@
+"""Logical plan nodes produced by the DataFrame API.
+
+The stand-in for Catalyst's optimized logical plan: the session plans these into a
+CPU physical plan (the "Spark CPU plan"), which the overrides engine then rewrites
+onto the TPU (plan/overrides.py) — preserving the reference's architecture where
+acceleration is a *physical plan* rewrite, not a frontend.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+from spark_rapids_tpu.exprs.core import Expression
+from spark_rapids_tpu.exprs.misc import Alias, SortOrder
+
+
+class LogicalPlan:
+    @property
+    def children(self) -> Tuple["LogicalPlan", ...]:
+        return ()
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+
+@dataclass
+class LocalRelation(LogicalPlan):
+    table: pa.Table
+
+    def schema(self) -> Schema:
+        return Schema.from_pa(self.table.schema)
+
+
+@dataclass
+class Range(LogicalPlan):
+    start: int
+    end: int
+    step: int = 1
+
+    def schema(self) -> Schema:
+        return Schema([Field("id", DType.LONG, nullable=False)])
+
+
+@dataclass
+class FileScan(LogicalPlan):
+    fmt: str                      # parquet | csv | orc
+    paths: Tuple[str, ...]
+    read_schema: Schema
+    options: Tuple[Tuple[str, str], ...] = ()
+    filters: Tuple[Expression, ...] = ()   # pushed-down predicates
+
+    def schema(self) -> Schema:
+        return self.read_schema
+
+
+@dataclass
+class Project(LogicalPlan):
+    exprs: Tuple[Expression, ...]   # named via Alias or attribute name
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exprs.core import bind_expression
+        cs = self.child.schema()
+        fields = []
+        for e in self.exprs:
+            b = bind_expression(e, cs)
+            fields.append(Field(e.name_hint, b.dtype(), b.nullable()))
+        return Schema(fields)
+
+
+@dataclass
+class Filter(LogicalPlan):
+    condition: Expression
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+
+@dataclass
+class Aggregate(LogicalPlan):
+    grouping: Tuple[Expression, ...]
+    aggregates: Tuple[Expression, ...]   # Alias(AggregateFunction) entries
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exprs.core import bind_expression
+        cs = self.child.schema()
+        fields = []
+        for e in self.grouping:
+            b = bind_expression(e, cs)
+            fields.append(Field(e.name_hint, b.dtype(), b.nullable()))
+        for e in self.aggregates:
+            b = bind_expression(e, cs)
+            fields.append(Field(e.name_hint, b.dtype(), b.nullable()))
+        return Schema(fields)
+
+
+@dataclass
+class Sort(LogicalPlan):
+    orders: Tuple[SortOrder, ...]
+    child: LogicalPlan
+    is_global: bool = True
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+
+@dataclass
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+
+@dataclass
+class Union(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def schema(self) -> Schema:
+        return self.left.schema()
+
+
+@dataclass
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str                       # inner | left | right | full | left_semi | left_anti | cross
+    left_keys: Tuple[Expression, ...] = ()
+    right_keys: Tuple[Expression, ...] = ()
+    condition: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def schema(self) -> Schema:
+        lf = list(self.left.schema().fields)
+        rf = list(self.right.schema().fields)
+        if self.how in ("left_semi", "left_anti"):
+            return Schema(lf)
+        if self.how in ("left", "full"):
+            rf = [Field(f.name, f.dtype, True) for f in rf]
+        if self.how in ("right", "full"):
+            lf = [Field(f.name, f.dtype, True) for f in lf]
+        names = set()
+        out = []
+        for f in lf + rf:
+            name = f.name
+            i = 0
+            while name in names:
+                i += 1
+                name = f"{f.name}_{i}"
+            names.add(name)
+            out.append(Field(name, f.dtype, f.nullable))
+        return Schema(out)
+
+
+@dataclass
+class Repartition(LogicalPlan):
+    num_partitions: int
+    child: LogicalPlan
+    keys: Tuple[Expression, ...] = ()   # empty = round robin
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        return self.child.schema()

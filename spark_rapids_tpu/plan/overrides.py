@@ -1,0 +1,367 @@
+"""Rule registry + plan-rewrite pass (reference: GpuOverrides.scala, 1811 LoC).
+
+``EXPR_RULES`` is the analog of the 131 ``expr[...]`` rules; ``EXEC_RULES`` of the
+exec rule table (GpuOverrides.scala:1608-1740). Each rule derives a conf key
+(``spark.rapids.tpu.sql.expression.<Name>`` / ``...sql.exec.<Name>``, analog of
+ReplacementRule.confKey at GpuOverrides.scala:126), may carry an incompat note
+(gated by incompatibleOps.enabled), and may add extra tagging checks.
+
+``TpuOverrides.apply`` wraps the CPU physical plan in a meta tree, tags it,
+optionally prints explain output, converts supported subtrees to TPU execs, and
+inserts host<->device transitions (the GpuTransitionOverrides role — here a
+single combined pass since our transitions are value-level, not row/columnar)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Type
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs import cpu_execs as ce
+from spark_rapids_tpu.execs import tpu_execs as te
+from spark_rapids_tpu.execs.base import PhysicalExec
+from spark_rapids_tpu.exprs import (aggregates as agg, arithmetic as ar, bitwise as bw,
+                                    cast as ca, conditional as cond, datetime as dtm,
+                                    literals as li, math as ma, misc as mi,
+                                    nulls as nu, predicates as pr, strings as st)
+from spark_rapids_tpu.exprs.core import BoundReference, Expression
+from spark_rapids_tpu.plan.meta import ExecMeta, ExprMeta
+
+
+@dataclass
+class ExprRule:
+    """Replacement rule for one expression class (ExprRule analog,
+    GpuOverrides.scala:185)."""
+    cls: Type[Expression]
+    desc: str
+    incompat: Optional[str] = None
+    tag: Optional[Callable[[ExprMeta], None]] = None
+
+    @property
+    def conf_key(self) -> str:
+        return f"spark.rapids.tpu.sql.expression.{self.cls.__name__}"
+
+
+@dataclass
+class ExecRule:
+    """Replacement rule for one exec class (ExecRule analog,
+    GpuOverrides.scala:236)."""
+    cls: Type[PhysicalExec]
+    desc: str
+    convert: Callable[[ExecMeta, Sequence[PhysicalExec]], PhysicalExec]
+    exprs_of: Callable[[PhysicalExec], Sequence[Expression]] = lambda e: ()
+    incompat: Optional[str] = None
+    tag: Optional[Callable[[ExecMeta], None]] = None
+
+    @property
+    def conf_key(self) -> str:
+        name = self.cls.__name__.replace("Cpu", "").replace("Exec", "")
+        return f"spark.rapids.tpu.sql.exec.{name}"
+
+
+# ------------------------------------------------------------------ expr tagging
+def _tag_cast(meta: ExprMeta) -> None:
+    e: ca.Cast = meta.expr
+    try:
+        src = e.c.dtype()
+    except TypeError:
+        return
+    if not ca.can_cast_on_device(src, e.to):
+        meta.will_not_work(f"cast {src.value} -> {e.to.value} is not supported "
+                           f"on TPU")
+    if src.is_floating and e.to is DType.STRING and not meta.conf.get(
+            cfg.ENABLE_CAST_FLOAT_TO_STRING):
+        meta.will_not_work("cast float->string disabled "
+                           "(spark.rapids.tpu.sql.castFloatToString.enabled)")
+
+
+def _tag_like(meta: ExprMeta) -> None:
+    e: st.Like = meta.expr
+    lit = e.p
+    if not isinstance(lit, li.Literal) or lit.value is None:
+        meta.will_not_work("LIKE requires a literal pattern on TPU")
+        return
+    if st.Like.classify(str(lit.value)) is None:
+        meta.will_not_work(f"LIKE pattern {lit.value!r} needs a regex engine "
+                           f"(only prefix/suffix/contains/exact run on TPU)")
+
+
+def _tag_literal_pattern(meta: ExprMeta) -> None:
+    lit = meta.expr.children[1]
+    if not isinstance(lit, li.Literal) or lit.value is None:
+        meta.will_not_work(f"{type(meta.expr).__name__} requires a non-null "
+                           f"literal pattern on TPU")
+
+
+def _tag_float_agg(meta: ExprMeta) -> None:
+    """Float sum/avg results vary with reduction order; gate like the reference's
+    spark.rapids.sql.variableFloatAgg.enabled."""
+    child = meta.expr.children[0] if meta.expr.children else None
+    try:
+        dt = child.dtype() if child is not None else None
+    except TypeError:
+        return
+    if dt is not None and dt.is_floating and not meta.conf.get(cfg.ENABLE_FLOAT_AGG):
+        meta.will_not_work(
+            f"{type(meta.expr).__name__} over floating point can produce "
+            f"order-dependent results; enable with "
+            f"spark.rapids.tpu.sql.variableFloatAgg.enabled")
+
+
+_EXPR_RULE_LIST: List[ExprRule] = [
+    ExprRule(li.Literal, "literal value"),
+    ExprRule(BoundReference, "column reference"),
+    ExprRule(mi.Alias, "named expression"),
+    ExprRule(mi.SortOrder, "sort order spec"),
+    ExprRule(mi.SparkPartitionID, "partition id"),
+    ExprRule(mi.MonotonicallyIncreasingID, "monotonically increasing id"),
+    ExprRule(mi.Rand, "random [0,1)",
+             incompat="uses a counter-based PRNG, not Spark's XORShift stream"),
+    ExprRule(mi.KnownFloatingPointNormalized, "normalization marker"),
+    ExprRule(mi.NormalizeNaNAndZero, "NaN/-0.0 canonicalization"),
+    # arithmetic
+    ExprRule(ar.Add, "addition"), ExprRule(ar.Subtract, "subtraction"),
+    ExprRule(ar.Multiply, "multiplication"), ExprRule(ar.Divide, "double division"),
+    ExprRule(ar.IntegralDivide, "integral division"),
+    ExprRule(ar.Remainder, "remainder"), ExprRule(ar.Pmod, "positive modulo"),
+    ExprRule(ar.UnaryMinus, "negation"), ExprRule(ar.UnaryPositive, "identity"),
+    ExprRule(ar.Abs, "absolute value"),
+    ExprRule(ar.Least, "least of values"), ExprRule(ar.Greatest, "greatest of values"),
+    # predicates
+    ExprRule(pr.EqualTo, "equality"), ExprRule(pr.NotEqual, "inequality"),
+    ExprRule(pr.LessThan, "less than"), ExprRule(pr.LessThanOrEqual, "at most"),
+    ExprRule(pr.GreaterThan, "greater than"),
+    ExprRule(pr.GreaterThanOrEqual, "at least"),
+    ExprRule(pr.EqualNullSafe, "null-safe equality"),
+    ExprRule(pr.And, "logical and"), ExprRule(pr.Or, "logical or"),
+    ExprRule(pr.Not, "logical not"), ExprRule(pr.In, "in list"),
+    # nulls
+    ExprRule(nu.IsNull, "is null"), ExprRule(nu.IsNotNull, "is not null"),
+    ExprRule(nu.IsNan, "is NaN"), ExprRule(nu.Coalesce, "first non-null"),
+    ExprRule(nu.NaNvl, "NaN replacement"),
+    ExprRule(nu.AtLeastNNonNulls, "n non-null check"),
+    # conditionals
+    ExprRule(cond.If, "if/else"), ExprRule(cond.CaseWhen, "case/when"),
+    # math
+    ExprRule(ma.Sqrt, "square root"), ExprRule(ma.Cbrt, "cube root"),
+    ExprRule(ma.Exp, "e^x"), ExprRule(ma.Expm1, "e^x - 1"),
+    ExprRule(ma.Log, "natural log"), ExprRule(ma.Log2, "log base 2"),
+    ExprRule(ma.Log10, "log base 10"), ExprRule(ma.Log1p, "log(1+x)"),
+    ExprRule(ma.Sin, "sine"), ExprRule(ma.Cos, "cosine"), ExprRule(ma.Tan, "tangent"),
+    ExprRule(ma.Asin, "arcsine"), ExprRule(ma.Acos, "arccosine"),
+    ExprRule(ma.Atan, "arctangent"), ExprRule(ma.Atan2, "two-arg arctangent"),
+    ExprRule(ma.Sinh, "hyperbolic sine"), ExprRule(ma.Cosh, "hyperbolic cosine"),
+    ExprRule(ma.Tanh, "hyperbolic tangent"),
+    ExprRule(ma.ToDegrees, "radians to degrees"),
+    ExprRule(ma.ToRadians, "degrees to radians"),
+    ExprRule(ma.Signum, "sign"), ExprRule(ma.Floor, "floor"),
+    ExprRule(ma.Ceil, "ceiling"), ExprRule(ma.Rint, "round half even"),
+    ExprRule(ma.Pow, "power"), ExprRule(ma.Round, "round half up"),
+    # bitwise
+    ExprRule(bw.BitwiseAnd, "bitwise and"), ExprRule(bw.BitwiseOr, "bitwise or"),
+    ExprRule(bw.BitwiseXor, "bitwise xor"), ExprRule(bw.BitwiseNot, "bitwise not"),
+    ExprRule(bw.ShiftLeft, "shift left"), ExprRule(bw.ShiftRight, "shift right"),
+    ExprRule(bw.ShiftRightUnsigned, "unsigned shift right"),
+    # cast
+    ExprRule(ca.Cast, "type cast", tag=_tag_cast),
+    # strings
+    ExprRule(st.Upper, "uppercase",
+             incompat="ASCII-only case mapping on device"),
+    ExprRule(st.Lower, "lowercase",
+             incompat="ASCII-only case mapping on device"),
+    ExprRule(st.Length, "character length"),
+    ExprRule(st.StartsWith, "starts with", tag=_tag_literal_pattern),
+    ExprRule(st.EndsWith, "ends with", tag=_tag_literal_pattern),
+    ExprRule(st.Contains, "contains", tag=_tag_literal_pattern),
+    ExprRule(st.Like, "SQL LIKE", tag=_tag_like),
+    ExprRule(st.Substring, "substring"),
+    ExprRule(st.Concat, "string concatenation"),
+    ExprRule(st.StringTrim, "trim spaces"),
+    # datetime
+    ExprRule(dtm.Year, "year"), ExprRule(dtm.Month, "month"),
+    ExprRule(dtm.DayOfMonth, "day of month"), ExprRule(dtm.DayOfWeek, "day of week"),
+    ExprRule(dtm.DayOfYear, "day of year"), ExprRule(dtm.Quarter, "quarter"),
+    ExprRule(dtm.Hour, "hour"), ExprRule(dtm.Minute, "minute"),
+    ExprRule(dtm.Second, "second"), ExprRule(dtm.DateAdd, "date plus days"),
+    ExprRule(dtm.DateSub, "date minus days"), ExprRule(dtm.DateDiff, "day difference"),
+    ExprRule(dtm.LastDay, "last day of month"),
+    # aggregates
+    ExprRule(agg.Count, "count"),
+    ExprRule(agg.Sum, "sum", tag=_tag_float_agg),
+    ExprRule(agg.Average, "average", tag=_tag_float_agg),
+    ExprRule(agg.Min, "minimum"), ExprRule(agg.Max, "maximum"),
+    ExprRule(agg.First, "first value"), ExprRule(agg.Last, "last value"),
+]
+
+EXPR_RULES: Dict[Type[Expression], ExprRule] = {r.cls: r for r in _EXPR_RULE_LIST}
+
+
+# ------------------------------------------------------------------ exec rules
+def _convert_project(meta: ExecMeta, children) -> PhysicalExec:
+    return te.TpuProjectExec(meta.exec.exprs, children[0])
+
+
+def _convert_filter(meta: ExecMeta, children) -> PhysicalExec:
+    return te.TpuFilterExec(meta.exec.condition, children[0])
+
+
+def _convert_agg(meta: ExecMeta, children) -> PhysicalExec:
+    e: ce.CpuHashAggregateExec = meta.exec
+    return te.TpuHashAggregateExec(e.grouping, e.aggregates, children[0], e.output)
+
+
+def _convert_sort(meta: ExecMeta, children) -> PhysicalExec:
+    return te.TpuSortExec(meta.exec.orders, children[0])
+
+
+def _convert_limit(meta: ExecMeta, children) -> PhysicalExec:
+    return te.TpuLimitExec(meta.exec.n, children[0])
+
+
+def _convert_union(meta: ExecMeta, children) -> PhysicalExec:
+    return te.TpuUnionExec(children[0], children[1])
+
+
+def _convert_range(meta: ExecMeta, children) -> PhysicalExec:
+    e: ce.CpuRangeExec = meta.exec
+    return te.TpuRangeExec(e.start, e.end, e.step)
+
+
+def _convert_local_scan(meta: ExecMeta, children) -> PhysicalExec:
+    # local data stays host-resident; the transition pass uploads it
+    raise AssertionError("local scans are not converted; transitions upload them")
+
+
+def _convert_parquet(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.io.parquet import TpuParquetScanExec
+    e = meta.exec
+    return TpuParquetScanExec(e.paths, e.output, e.max_batch_rows)
+
+
+def _tag_parquet(meta: ExecMeta) -> None:
+    if not (meta.conf.get(cfg.PARQUET_ENABLED)
+            and meta.conf.get(cfg.PARQUET_READ_ENABLED)):
+        meta.will_not_work("parquet scanning disabled "
+                           "(spark.rapids.tpu.sql.format.parquet.read.enabled)")
+
+
+def _convert_csv(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.io.csv import TpuCsvScanExec
+    e = meta.exec
+    return TpuCsvScanExec(e.paths, e.output, e.options)
+
+
+def _tag_csv(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.io.csv import SUPPORTED_OPTIONS
+    if not (meta.conf.get(cfg.CSV_ENABLED) and meta.conf.get(cfg.CSV_READ_ENABLED)):
+        meta.will_not_work("CSV scanning disabled "
+                           "(spark.rapids.tpu.sql.format.csv.read.enabled)")
+    for k in meta.exec.options:
+        if k not in SUPPORTED_OPTIONS:
+            meta.will_not_work(f"CSV option {k!r} is not supported on TPU")
+
+
+def _convert_orc(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.io.orc import TpuOrcScanExec
+    e = meta.exec
+    return TpuOrcScanExec(e.paths, e.output)
+
+
+def _tag_orc(meta: ExecMeta) -> None:
+    if not (meta.conf.get(cfg.ORC_ENABLED) and meta.conf.get(cfg.ORC_READ_ENABLED)):
+        meta.will_not_work("ORC scanning disabled "
+                           "(spark.rapids.tpu.sql.format.orc.read.enabled)")
+
+
+def _make_scan_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.io.csv import CpuCsvScanExec
+    from spark_rapids_tpu.io.orc import CpuOrcScanExec
+    from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+    return [
+        ExecRule(CpuParquetScanExec, "parquet scan", _convert_parquet,
+                 tag=_tag_parquet),
+        ExecRule(CpuCsvScanExec, "csv scan", _convert_csv, tag=_tag_csv),
+        ExecRule(CpuOrcScanExec, "orc scan", _convert_orc, tag=_tag_orc),
+    ]
+
+
+_EXEC_RULE_LIST: List[ExecRule] = _make_scan_rules() + [
+    ExecRule(ce.CpuProjectExec, "column projection", _convert_project,
+             exprs_of=lambda e: e.exprs),
+    ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,
+             exprs_of=lambda e: (e.condition,)),
+    ExecRule(ce.CpuHashAggregateExec, "hash aggregate", _convert_agg,
+             exprs_of=lambda e: tuple(e.grouping) + tuple(e.aggregates)),
+    ExecRule(ce.CpuSortExec, "sort", _convert_sort,
+             exprs_of=lambda e: e.orders),
+    ExecRule(ce.CpuLimitExec, "row limit", _convert_limit),
+    ExecRule(ce.CpuUnionExec, "union all", _convert_union),
+    ExecRule(ce.CpuRangeExec, "sequence generation", _convert_range),
+]
+
+EXEC_RULES: Dict[Type[PhysicalExec], ExecRule] = {r.cls: r for r in _EXEC_RULE_LIST}
+
+
+def wrap_expr(expr: Expression, conf: TpuConf) -> ExprMeta:
+    rule = EXPR_RULES.get(type(expr))
+    return ExprMeta(expr, conf, rule)
+
+
+def wrap_exec(exec_node: PhysicalExec, conf: TpuConf) -> ExecMeta:
+    rule = EXEC_RULES.get(type(exec_node))
+    return ExecMeta(exec_node, conf, rule)
+
+
+# ------------------------------------------------------------------ the pass
+class TpuOverrides:
+    """The plan-rewrite rule (GpuOverrides apply analog, GpuOverrides.scala:1754)."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.last_explain: str = ""
+
+    def apply(self, plan: PhysicalExec) -> PhysicalExec:
+        if not self.conf.sql_enabled:
+            return plan
+        meta = wrap_exec(plan, self.conf)
+        meta.tag_for_tpu()
+        lines: List[str] = []
+        meta.explain(lines)
+        self.last_explain = "\n".join(lines)
+        mode = self.conf.explain
+        if mode == "ALL":
+            print(self.last_explain)
+        elif mode == "NOT_ON_TPU":
+            for line in lines:
+                if "cannot run on TPU" in line or "because" in line:
+                    print(line)
+        converted = meta.convert_if_needed()
+        return insert_transitions(converted)
+
+
+def insert_transitions(plan: PhysicalExec) -> PhysicalExec:
+    """Insert host<->device movement at engine boundaries and bring the plan
+    root back to host (GpuTransitionOverrides.scala:38 optimizeGpuPlanTransitions
+    + GpuBringBackToHost analog)."""
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        if isinstance(node, (te.HostToDeviceExec, te.DeviceToHostExec)):
+            return node
+        new_children = []
+        changed = False
+        for c in node.children:
+            want_device = node.is_device
+            if want_device and not c.is_device:
+                new_children.append(te.HostToDeviceExec(c))
+                changed = True
+            elif not want_device and c.is_device:
+                new_children.append(te.DeviceToHostExec(c))
+                changed = True
+            else:
+                new_children.append(c)
+        return node.with_children(new_children) if changed else node
+
+    out = plan.transform_up(fix)
+    if out.is_device:
+        out = te.DeviceToHostExec(out)
+    return out

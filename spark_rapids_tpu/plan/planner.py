@@ -1,0 +1,82 @@
+"""Logical -> CPU physical planning, with expression binding.
+
+The stand-in for Spark's SparkPlanner: produces the CPU physical plan that
+TpuOverrides then rewrites. Expressions are bound to child-output ordinals here
+(GpuBindReferences analog) so both engines evaluate ordinal references.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs import cpu_execs as ce
+from spark_rapids_tpu.execs.base import PhysicalExec
+from spark_rapids_tpu.exprs.core import Expression, bind_expression
+from spark_rapids_tpu.exprs.misc import Alias, SortOrder
+from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+from spark_rapids_tpu.plan import logical as lp
+
+
+def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
+    if isinstance(plan, lp.LocalRelation):
+        return ce.CpuLocalScanExec(plan.table, conf.string_max_bytes)
+    if isinstance(plan, lp.Range):
+        return ce.CpuRangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, lp.FileScan):
+        if plan.fmt == "parquet":
+            return CpuParquetScanExec(plan.paths, plan.read_schema)
+        if plan.fmt == "csv":
+            from spark_rapids_tpu.io.csv import CpuCsvScanExec
+            return CpuCsvScanExec(plan.paths, plan.read_schema,
+                                  dict(plan.options))
+        if plan.fmt == "orc":
+            from spark_rapids_tpu.io.orc import CpuOrcScanExec
+            return CpuOrcScanExec(plan.paths, plan.read_schema)
+        raise ValueError(f"unsupported format {plan.fmt}")
+    if isinstance(plan, lp.Project):
+        child = plan_physical(plan.child, conf)
+        cs = child.output
+        bound = tuple(_named(bind_expression(e, cs), e) for e in plan.exprs)
+        return ce.CpuProjectExec(bound, child)
+    if isinstance(plan, lp.Filter):
+        child = plan_physical(plan.child, conf)
+        return ce.CpuFilterExec(bind_expression(plan.condition, child.output), child)
+    if isinstance(plan, lp.Aggregate):
+        child = plan_physical(plan.child, conf)
+        cs = child.output
+        grouping = tuple(bind_expression(e, cs) for e in plan.grouping)
+        aggs = tuple(_named(bind_expression(e, cs), e) for e in plan.aggregates)
+        return ce.CpuHashAggregateExec(grouping, aggs, child, plan.schema())
+    if isinstance(plan, lp.Sort):
+        child = plan_physical(plan.child, conf)
+        orders = tuple(
+            SortOrder(bind_expression(o.child, child.output), o.ascending,
+                      o.nulls_first) for o in plan.orders)
+        return ce.CpuSortExec(orders, child)
+    if isinstance(plan, lp.Limit):
+        return ce.CpuLimitExec(plan.n, plan_physical(plan.child, conf))
+    if isinstance(plan, lp.Union):
+        return ce.CpuUnionExec(plan_physical(plan.left, conf),
+                               plan_physical(plan.right, conf))
+    if isinstance(plan, lp.Join):
+        try:
+            from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
+        except ImportError as e:
+            raise NotImplementedError(
+                "joins are not implemented yet (join exec layer pending)") from e
+        left = plan_physical(plan.left, conf)
+        right = plan_physical(plan.right, conf)
+        lkeys = tuple(bind_expression(e, left.output) for e in plan.left_keys)
+        rkeys = tuple(bind_expression(e, right.output) for e in plan.right_keys)
+        return CpuHashJoinExec(left, right, plan.how, lkeys, rkeys,
+                               plan.schema())
+    raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
+
+
+def _named(bound: Expression, original: Expression) -> Expression:
+    """Preserve the user-facing name through binding."""
+    if isinstance(bound, Alias):
+        return bound
+    name = original.name_hint
+    return Alias(bound, name)

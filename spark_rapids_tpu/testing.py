@@ -41,6 +41,43 @@ def _values_equal(a: Any, b: Any, approx: Optional[float]) -> bool:
     return a == b
 
 
+def run_with_cpu_and_tpu(build_df, conf: Optional[dict] = None):
+    """Run the same DataFrame-producing function against a TPU-enabled session
+    and a CPU-only session, returning (cpu_table, tpu_table, tpu_session).
+
+    Analog of SparkQueryCompareTestSuite.runOnCpuAndGpu
+    (SparkQueryCompareTestSuite.scala:153,161): the CPU run flips
+    spark.rapids.tpu.sql.enabled=false so everything executes on the fallback
+    engine; the TPU run must actually place supported execs on the device.
+    """
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    base = dict(conf or {})
+    cpu_sess = TpuSession({**base, "spark.rapids.tpu.sql.enabled": "false"})
+    tpu_sess = TpuSession({**base, "spark.rapids.tpu.sql.enabled": "true"})
+    cpu = build_df(cpu_sess).collect()
+    tpu = build_df(tpu_sess).collect()
+    return cpu, tpu, tpu_sess
+
+
+def assert_tpu_and_cpu_equal(build_df, conf: Optional[dict] = None,
+                             ignore_order: bool = False,
+                             approx_float: Optional[float] = None,
+                             expect_tpu_execs: Optional[Sequence[str]] = None):
+    """testSparkResultsAreEqual analog: identical results CPU vs TPU, plus an
+    optional assertion that named execs really ran on the device (the
+    ExecutionPlanCaptureCallback role, Plugin.scala:180-270)."""
+    cpu, tpu, sess = run_with_cpu_and_tpu(build_df, conf)
+    assert_tables_equal(cpu, tpu, ignore_order=ignore_order,
+                        approx_float=approx_float)
+    if expect_tpu_execs:
+        plan_str = sess.last_plan.tree_string() if sess.last_plan else ""
+        for name in expect_tpu_execs:
+            assert name in plan_str, (
+                f"expected {name} on the TPU plan, got:\n{plan_str}\n"
+                f"explain:\n{sess.last_explain}")
+    return cpu
+
+
 def assert_tables_equal(expected: pa.Table, actual: pa.Table,
                         ignore_order: bool = False,
                         approx_float: Optional[float] = None) -> None:

@@ -1,0 +1,178 @@
+"""End-to-end DataFrame tests: CPU engine vs TPU plan-rewritten execution
+(SparkQueryCompareTestSuite analog)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+
+def sample_table():
+    rng = np.random.default_rng(7)
+    n = 500
+    cat = rng.choice(["A", "B", "C", None], n, p=[0.4, 0.3, 0.2, 0.1]).tolist()
+    qty = [None if rng.random() < 0.1 else int(v)
+           for v in rng.integers(0, 100, n)]
+    price = [None if rng.random() < 0.1 else float(v)
+             for v in rng.uniform(0, 50, n)]
+    return pa.table({
+        "cat": pa.array(cat, type=pa.string()),
+        "qty": pa.array(qty, type=pa.int64()),
+        "price": pa.array(price, type=pa.float64()),
+    })
+
+
+def test_project_filter_e2e():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(t)
+                   .filter((F.col("qty") > 50) & F.col("cat").isNotNull())
+                   .select((F.col("qty") * 2).alias("dq"),
+                           F.col("cat"),
+                           (F.col("price") / F.col("qty")).alias("unit"))),
+        expect_tpu_execs=["TpuProjectExec", "TpuFilterExec"])
+
+
+def test_groupby_agg_e2e():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(t)
+                   .groupBy("cat")
+                   .agg(F.count().alias("n"),
+                        F.sum("qty").alias("sq"),
+                        F.min("qty").alias("mn"),
+                        F.max("qty").alias("mx"),
+                        F.avg("qty").alias("av"))),
+        ignore_order=True,
+        expect_tpu_execs=["TpuHashAggregateExec"])
+
+
+def test_float_agg_gated_by_conf():
+    t = sample_table()
+    # default: float sum falls back to CPU
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("cat").agg(
+            F.sum("price").alias("sp")),
+        ignore_order=True)
+    # with variableFloatAgg: runs on TPU
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("cat").agg(
+            F.sum("price").alias("sp")),
+        conf={"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"},
+        ignore_order=True, approx_float=1e-12,
+        expect_tpu_execs=["TpuHashAggregateExec"])
+
+
+def test_sort_limit_e2e():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(t)
+                   .sort(F.col("qty").desc(), F.col("cat").asc())
+                   .limit(37)),
+        expect_tpu_execs=["TpuSortExec", "TpuLimitExec"])
+
+
+def test_union_and_range():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.range(100).union(s.range(50))
+                   .select((F.col("id") % 7).alias("m"))
+                   .groupBy("m").count(),
+        ignore_order=True,
+        expect_tpu_execs=["TpuRangeExec", "TpuUnionExec"])
+
+
+def test_global_agg_empty_and_nonempty():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).agg(F.count().alias("n"),
+                                            F.sum("qty").alias("s")))
+    empty = t.slice(0, 0)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(empty).agg(F.count().alias("n"),
+                                                F.sum("qty").alias("s")))
+
+
+def test_conditional_and_strings_e2e():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(t)
+                   .select(F.when(F.col("qty") > 50, "big")
+                            .when(F.col("qty") > 20, "mid")
+                            .otherwise("small").alias("size"),
+                           F.upper(F.col("cat")).alias("ucat"),
+                           F.col("cat").like("%A%").alias("hasA"))),
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": "true"},
+        expect_tpu_execs=["TpuProjectExec"])
+
+
+def test_fallback_unsupported_expr():
+    """Regex LIKE has no TPU kernel -> whole project falls back, results equal,
+    explain names the reason (StringFallbackSuite analog)."""
+    t = sample_table()
+
+    def q(s):
+        return s.create_dataframe(t).select(
+            F.col("cat").like("%A_B%").alias("m"))
+
+    cpu, tpu, sess = __import__(
+        "spark_rapids_tpu.testing", fromlist=["run_with_cpu_and_tpu"]
+    ).run_with_cpu_and_tpu(q)
+    from spark_rapids_tpu.testing import assert_tables_equal
+    assert_tables_equal(cpu, tpu)
+    assert "TpuProjectExec" not in sess.last_plan.tree_string()
+    assert "needs a regex engine" in sess.last_explain
+
+
+def test_explain_output():
+    t = sample_table()
+    s = TpuSession()
+    df = s.create_dataframe(t).filter(F.col("qty") > 5)
+    text = df.explain(print_out=False)
+    assert "will run on TPU" in text
+    assert "TpuFilterExec" in text
+
+
+def test_count_action():
+    t = sample_table()
+    s = TpuSession()
+    assert s.create_dataframe(t).count() == t.num_rows
+
+
+def test_with_column_and_drop():
+    t = sample_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(t)
+                   .withColumn("double_qty", F.col("qty") * 2)
+                   .drop("price")))
+
+
+def test_count_column_ignores_nulls():
+    # regression (code review): F.count(col) must count non-null only
+    t = pa.table({"x": pa.array([1, None, 3], type=pa.int64())})
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).agg(F.count(F.col("x")).alias("n"),
+                                            F.count().alias("all")))
+    assert cpu.column("n").to_pylist() == [2]
+    assert cpu.column("all").to_pylist() == [3]
+
+
+def test_string_min_max_agg():
+    # regression (code review): string min/max works on BOTH engines
+    t = pa.table({"k": pa.array([1, 1, 2, 2, 2], type=pa.int32()),
+                  "s": pa.array(["pear", "apple", None, "fig", "banana"])})
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("k").agg(
+            F.min("s").alias("mn"), F.max("s").alias("mx")),
+        ignore_order=True, expect_tpu_execs=["TpuHashAggregateExec"])
+    d = dict(zip(cpu.column("k").to_pylist(),
+                 zip(cpu.column("mn").to_pylist(), cpu.column("mx").to_pylist())))
+    assert d == {1: ("apple", "pear"), 2: ("banana", "fig")}
+
+
+def test_with_column_preserves_position():
+    t = pa.table({"a": [1], "b": [2], "c": [3]})
+    s = TpuSession()
+    df = s.create_dataframe(t).withColumn("b", F.col("b") * 10)
+    assert df.columns == ["a", "b", "c"]
+    assert df.collect().to_pydict() == {"a": [1], "b": [20], "c": [3]}

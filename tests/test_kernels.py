@@ -1,0 +1,165 @@
+"""Batch kernel tests: compaction, multi-key sort, grouping, segment reduction —
+numpy eager vs jitted jax parity (analog of SortExecSuite / GpuCoalesceBatchesSuite
+internals)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import DeviceBatch
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.exprs.core import ColV
+from spark_rapids_tpu.ops import batch_kernels as bk
+
+
+def colvs_np(table, smax=32):
+    hb = HostBatch.from_arrow(table, smax)
+    return [ColV(c.dtype, c.data, c.validity, c.lengths) for c in hb.columns], hb
+
+
+def test_compact_stable():
+    t = pa.table({"a": pa.array([10, 20, 30, 40, 50], type=pa.int64())})
+    cols, hb = colvs_np(t)
+    mask = np.array([True, False, True, False, True])
+    out, n = bk.compact(np, mask, cols, 5)
+    assert int(n) == 3
+    assert out[0].data[:3].tolist() == [10, 30, 50]
+    assert out[0].validity[:3].all() and not out[0].validity[3:].any()
+
+
+def test_compact_jit_matches():
+    data = np.arange(16, dtype=np.int64)
+    valid = np.ones(16, dtype=bool)
+    mask = (data % 3 == 0)
+    col = ColV(__import__("spark_rapids_tpu.columnar.dtypes",
+                          fromlist=["DType"]).DType.LONG, data, valid)
+    out_np, n_np = bk.compact(np, mask, [col], 16)
+
+    @jax.jit
+    def f(d, v, m):
+        c = ColV(col.dtype, d, v)
+        out, n = bk.compact(jnp, m, [c], 16)
+        return out[0].data, out[0].validity, n
+
+    d, v, n = f(data, valid, mask)
+    assert int(n) == int(n_np)
+    np.testing.assert_array_equal(np.asarray(d)[:int(n)], out_np[0].data[:int(n_np)])
+
+
+def sort_via(xp, table, keys_spec, num_rows, smax=32):
+    if xp is np:
+        cols, hb = colvs_np(table, smax)
+    else:
+        db = DeviceBatch.from_arrow(table, smax)
+        cols = [ColV(c.dtype, c.data, c.validity, c.lengths) for c in db.columns]
+    keys = [(cols[i], asc, nf) for i, asc, nf in keys_spec]
+    order = bk.sort_indices(xp, keys, num_rows)
+    return np.asarray(order)[:num_rows]
+
+
+def test_sort_numeric_with_nulls_and_nan():
+    nan = float("nan")
+    t = pa.table({"a": pa.array([3.0, None, nan, 1.0, -0.0, 0.0], type=pa.float64())})
+    # ascending, nulls first: None, -0/0 (stable), 1, 3, NaN
+    order = sort_via(np, t, [(0, True, True)], 6)
+    assert order.tolist() == [1, 4, 5, 3, 0, 2]
+    # descending, nulls last: NaN, 3, 1, 0/-0, None
+    order = sort_via(np, t, [(0, False, False)], 6)
+    assert order.tolist() == [2, 0, 3, 4, 5, 1]
+
+
+def test_sort_strings_and_multikey():
+    t = pa.table({"s": pa.array(["b", "a", "ab", None, "a", ""]),
+                  "i": pa.array([1, 2, 3, 4, 1, 5], type=pa.int32())})
+    # sort by s asc nulls first, then i desc
+    order = sort_via(np, t, [(0, True, True), (1, False, False)], 6)
+    # expected: None, "", "a"(i=2), "a"(i=1), "ab", "b"
+    assert order.tolist() == [3, 5, 1, 4, 2, 0]
+
+
+def test_sort_device_matches_cpu():
+    rng = np.random.default_rng(42)
+    vals = rng.integers(-50, 50, 200)
+    nulls = rng.random(200) < 0.2
+    arr = pa.array([None if n else int(v) for v, n in zip(vals, nulls)],
+                   type=pa.int64())
+    strs = pa.array([None if rng.random() < 0.1 else
+                     "".join(rng.choice(list("abc"), rng.integers(0, 5)))
+                     for _ in range(200)])
+    t = pa.table({"i": arr, "s": strs})
+    spec = [(1, True, False), (0, False, True)]
+    o_cpu = sort_via(np, t, spec, 200)
+    o_dev = sort_via(jnp, t, spec, 200)
+    # permutations may differ only within exact-tie groups; compare sorted values
+    tt = t.take(o_cpu.tolist())
+    td = t.take(o_dev.tolist())
+    assert tt.equals(td)
+
+
+def test_group_and_reduce():
+    t = pa.table({"k": pa.array(["x", "y", "x", None, "y", None]),
+                  "v": pa.array([1, 2, 3, 4, None, 6], type=pa.int64())})
+    cols, hb = colvs_np(t)
+    order = bk.sort_indices(np, [(cols[0], True, True)], 6)
+    starts = bk.rows_equal_adjacent(np, [cols[0]], order, 6)
+    gids = np.cumsum(starts) - 1
+    assert gids.max() == 2  # groups: null, x, y
+    v = cols[1]
+    vd, vv = v.data[order], v.validity[order]
+    s, sv = bk.segment_reduce(np, vd, vv, gids, 6, "sum")
+    # null group: 4+6=10; x: 1+3=4; y: 2 (null ignored)
+    assert s[:3].tolist() == [10, 4, 2]
+    assert sv[:3].all()
+
+
+@pytest.mark.parametrize("kind,expected,expected_valid", [
+    ("sum", [4, 0], [True, False]),
+    ("min", [1, 0], [True, False]),
+    ("max", [3, 0], [True, False]),
+])
+def test_segment_reduce_all_null_group(kind, expected, expected_valid):
+    data = np.array([1, 3, 7, 9], dtype=np.int64)
+    validity = np.array([True, True, False, False])
+    gids = np.array([0, 0, 1, 1])
+    out_np, v_np = bk.segment_reduce(np, data, validity, gids, 2, kind)
+    assert v_np.tolist() == expected_valid
+    assert out_np[0] == expected[0]
+
+    f = jax.jit(lambda d, v, g: bk.segment_reduce(jnp, d, v, g, 2, kind))
+    out_j, v_j = f(data, validity, gids)
+    assert np.asarray(v_j).tolist() == expected_valid
+    assert int(out_j[0]) == expected[0]
+
+
+def test_segment_minmax_nan_semantics():
+    data = np.array([1.0, np.nan, np.nan, np.nan, 5.0], dtype=np.float64)
+    validity = np.array([True, True, True, True, True])
+    gids = np.array([0, 0, 1, 1, 1])
+    mx, _ = bk.segment_reduce(np, data, validity, gids, 2, "max")
+    mn, _ = bk.segment_reduce(np, data, validity, gids, 2, "min")
+    assert np.isnan(mx[0]) and np.isnan(mx[1])  # max sees NaN -> NaN
+    assert mn[0] == 1.0 and mn[1] == 5.0        # min ignores NaN unless all NaN
+    data2 = np.array([np.nan, np.nan], dtype=np.float64)
+    mn2, _ = bk.segment_reduce(np, data2, np.ones(2, bool), np.zeros(2, int), 1, "min")
+    assert np.isnan(mn2[0])
+
+    f = jax.jit(lambda d, v, g, k=0: bk.segment_reduce(jnp, d, v, g, 2, "max"))
+    mxj, _ = f(data, validity, gids)
+    assert np.isnan(np.asarray(mxj)[0])
+
+
+def test_segment_first_last():
+    data = np.array([10, 20, 30, 40], dtype=np.int64)
+    validity = np.array([False, True, True, False])
+    gids = np.array([0, 0, 1, 1])
+    f_ig, fv = bk.segment_reduce(np, data, validity, gids, 2, "first",
+                                 ignore_nulls=True)
+    assert f_ig.tolist()[:2] == [20, 30] and fv[:2].all()
+    f_no, fv2 = bk.segment_reduce(np, data, validity, gids, 2, "first",
+                                  ignore_nulls=False)
+    assert fv2.tolist()[:2] == [False, True]  # first row of group 0 is null
+    l_ig, lv = bk.segment_reduce(np, data, validity, gids, 2, "last",
+                                 ignore_nulls=True)
+    assert l_ig.tolist()[:2] == [20, 30] and lv[:2].all()

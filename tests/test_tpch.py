@@ -1,0 +1,24 @@
+"""TPC-H Q1/Q6 correctness: TPU plan vs CPU engine, bit-comparable modulo float
+reduction order (tpch_test.py analog)."""
+import pyarrow as pa
+
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1, q6
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+
+def test_q1_matches_cpu():
+    t = gen_lineitem(scale=0.002, seed=11)  # 12k rows
+    assert_tpu_and_cpu_equal(
+        lambda s: q1(s.create_dataframe(t)),
+        conf=BENCH_CONF,
+        approx_float=1e-12,
+        expect_tpu_execs=["TpuHashAggregateExec", "TpuFilterExec", "TpuSortExec"])
+
+
+def test_q6_matches_cpu():
+    t = gen_lineitem(scale=0.002, seed=12)
+    assert_tpu_and_cpu_equal(
+        lambda s: q6(s.create_dataframe(t)),
+        conf=BENCH_CONF,
+        approx_float=1e-12,
+        expect_tpu_execs=["TpuHashAggregateExec", "TpuFilterExec"])
