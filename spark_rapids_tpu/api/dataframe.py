@@ -79,10 +79,46 @@ class DataFrame:
 
     def join(self, other: "DataFrame", on: Union[str, List[str]],
              how: str = "inner") -> "DataFrame":
+        """USING-style join: key columns appear once in the output (from the
+        left side, the right side for right joins, coalesced for full)."""
+        how = {"leftsemi": "left_semi", "semi": "left_semi",
+               "leftanti": "left_anti", "anti": "left_anti",
+               "leftouter": "left", "rightouter": "right",
+               "outer": "full", "fullouter": "full"}.get(how, how)
         keys = [on] if isinstance(on, str) else list(on)
         lkeys = tuple(UnresolvedAttribute(k) for k in keys)
         rkeys = tuple(UnresolvedAttribute(k) for k in keys)
-        return DataFrame(lp.Join(self._plan, other._plan, how, lkeys, rkeys),
+        joined = lp.Join(self._plan, other._plan, how, lkeys, rkeys)
+        if how in ("left_semi", "left_anti"):
+            return DataFrame(joined, self.session)
+        out = joined.schema()
+        left_n = len(self._plan.schema())
+        right_schema = other._plan.schema()
+        right_key_out = {out[left_n + right_schema.index_of(k)].name: k
+                         for k in keys}
+        exprs = []
+        for i, f in enumerate(out):
+            if i < left_n:
+                if f.name in keys:
+                    from spark_rapids_tpu.exprs import Coalesce
+                    if how == "full":
+                        rname = out[left_n + right_schema.index_of(f.name)].name
+                        exprs.append(Alias(Coalesce(
+                            (UnresolvedAttribute(f.name),
+                             UnresolvedAttribute(rname))), f.name))
+                    elif how == "right":
+                        rname = out[left_n + right_schema.index_of(f.name)].name
+                        exprs.append(Alias(UnresolvedAttribute(rname), f.name))
+                    else:
+                        exprs.append(UnresolvedAttribute(f.name))
+                else:
+                    exprs.append(UnresolvedAttribute(f.name))
+            elif f.name not in right_key_out:
+                exprs.append(UnresolvedAttribute(f.name))
+        return DataFrame(lp.Project(tuple(exprs), joined), self.session)
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(lp.Join(self._plan, other._plan, "cross", (), ()),
                          self.session)
 
     def repartition(self, n: int, *cols: Union[str, Column]) -> "DataFrame":

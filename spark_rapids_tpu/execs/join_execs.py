@@ -1,0 +1,204 @@
+"""Join physical operators.
+
+Reference analogs: GpuShuffledHashJoinExec / GpuBroadcastHashJoinExec /
+GpuSortMergeJoinExec->SHJ replacement (shims/spark300/GpuHashJoin.scala,
+GpuShuffledHashJoinExec.scala, GpuBroadcastHashJoinExec.scala) and
+GpuCartesianProductExec / GpuBroadcastNestedLoopJoinExec for the non-equi forms.
+
+Both engines share ops/join.py's two-phase kernel; the TPU side jits each phase
+per shape bucket. The build side is coalesced to a single batch exactly like the
+reference's RequireSingleBatch build-side goal. A residual non-equi condition is
+applied as a post-join filter (same as GpuHashJoin's joined-then-filtered flow).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.cpu_execs import (_colvs_to_host, _host_colvs,
+                                              concat_host_batches)
+from spark_rapids_tpu.execs.tpu_execs import (_cached_jit, _flatten,
+                                              _flatten_colvs, _to_batch,
+                                              _unflatten_colvs,
+                                              concat_device_batches)
+from spark_rapids_tpu.exprs.core import (ColV, EvalCtx, Expression,
+                                         flat_len as _n_flat)
+from spark_rapids_tpu.ops import batch_kernels as bk
+from spark_rapids_tpu.ops import join as jk
+
+
+def _eval_keys(xp, colvs, capacity, smax, key_exprs) -> List[ColV]:
+    ectx = EvalCtx(xp, colvs, capacity, smax)
+    return [e.eval(ectx) for e in key_exprs]
+
+
+class _HashJoinBase(PhysicalExec):
+    def __init__(self, left: PhysicalExec, right: PhysicalExec, how: str,
+                 left_keys: Tuple[Expression, ...],
+                 right_keys: Tuple[Expression, ...], output: Schema,
+                 condition: Optional[Expression] = None):
+        super().__init__((left, right), output)
+        if how not in jk.JOIN_KINDS:
+            raise ValueError(f"unsupported join type {how}")
+        self.how = how
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = condition
+
+    @property
+    def includes_right_columns(self) -> bool:
+        return self.how not in ("left_semi", "left_anti")
+
+
+class CpuHashJoinExec(_HashJoinBase):
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        lb = concat_host_batches(list(self.children[0].execute(ctx)),
+                                 self.children[0].output)
+        rb = concat_host_batches(list(self.children[1].execute(ctx)),
+                                 self.children[1].output)
+        l_cols = _host_colvs(lb)
+        r_cols = _host_colvs(rb)
+        S, B = max(lb.num_rows, 1), max(rb.num_rows, 1)
+        l_cols = [_pad_np(v, S) for v in l_cols]
+        r_cols = [_pad_np(v, B) for v in r_cols]
+        l_alive = np.arange(S) < lb.num_rows
+        r_alive = np.arange(B) < rb.num_rows
+        with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+            lk = _eval_keys(np, l_cols, S, ctx.string_max_bytes, self.left_keys)
+            rk = _eval_keys(np, r_cols, B, ctx.string_max_bytes, self.right_keys)
+            sized = jk.join_size(np, lk, rk, l_alive, r_alive, self.how)
+            total = int(sized["total"])
+            out_cap = max(total, 1)
+            lrow, lvalid, rrow, rvalid, _ = jk.join_gather(
+                np, sized, S, B, out_cap, self.how)
+            r_out = r_cols if self.includes_right_columns else []
+            out_cols = jk.gather_join_output(np, l_cols, r_out, lrow, lvalid,
+                                             rrow, rvalid)
+            n = total
+            if self.condition is not None:
+                ectx = EvalCtx(np, out_cols, out_cap, ctx.string_max_bytes)
+                pred = self.condition.eval(ectx)
+                keep = np.logical_and(
+                    np.logical_and(np.asarray(pred.data, dtype=bool),
+                                   np.asarray(pred.validity)),
+                    np.arange(out_cap) < total)
+                out_cols, nn = bk.compact(np, keep, out_cols, total)
+                n = int(nn)
+        out = _colvs_to_host(self.output, out_cols, n)
+        self.count_output(n)
+        yield out
+
+
+def _pad_np(v: ColV, cap: int) -> ColV:
+    n = v.data.shape[0]
+    if n == cap:
+        return v
+    pad = cap - n
+    data = np.concatenate([v.data, np.zeros((pad,) + v.data.shape[1:],
+                                            v.data.dtype)])
+    validity = np.concatenate([v.validity, np.zeros(pad, bool)])
+    lengths = (np.concatenate([v.lengths, np.zeros(pad, np.int32)])
+               if v.lengths is not None else None)
+    return ColV(v.dtype, data, validity, lengths)
+
+
+class TpuShuffledHashJoinExec(_HashJoinBase):
+    """Equi-join on device; both phases jitted per shape bucket."""
+
+    is_device = True
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        smax = ctx.string_max_bytes
+        lschema = self.children[0].output
+        rschema = self.children[1].output
+        lb = concat_device_batches(list(self.children[0].execute(ctx)),
+                                   lschema, smax)
+        rb = concat_device_batches(list(self.children[1].execute(ctx)),
+                                   rschema, smax)
+        S, B = lb.capacity, rb.capacity
+
+        key1 = ("join_size", self.how, self.left_keys, self.right_keys,
+                lschema, rschema, S, B, smax)
+
+        def build1(how=self.how, lkeys=self.left_keys, rkeys=self.right_keys,
+                   lschema=lschema, rschema=rschema, S=S, B=B, smax=smax):
+            nl = _n_flat(lschema)
+
+            def fn(l_rows, r_rows, *flat):
+                l_cols = _unflatten_colvs(lschema, flat[:nl])
+                r_cols = _unflatten_colvs(rschema, flat[nl:])
+                l_alive = jnp.arange(S, dtype=np.int32) < l_rows
+                r_alive = jnp.arange(B, dtype=np.int32) < r_rows
+                lk = _eval_keys(jnp, l_cols, S, smax, lkeys)
+                rk = _eval_keys(jnp, r_cols, B, smax, rkeys)
+                sized = jk.join_size(jnp, lk, rk, l_alive, r_alive, how)
+                return (sized["emit_counts"], sized["emit_offsets"],
+                        sized["total"], sized["border"], sized["start_b"],
+                        sized["sgid"], sized["matches_l"])
+            return fn
+
+        fn1 = _cached_jit(key1, build1)
+        flat_in = _flatten(lb) + _flatten(rb)
+        (emit_counts, emit_offsets, total, border, start_b, sgid,
+         matches_l) = fn1(np.int32(lb.num_rows), np.int32(rb.num_rows),
+                          *flat_in)
+        n_out = int(total)
+        out_cap = bucket_capacity(n_out)
+
+        key2 = ("join_gather", self.how, lschema, rschema, S, B, out_cap,
+                self.condition, self.includes_right_columns, smax)
+
+        def build2(how=self.how, lschema=lschema, rschema=rschema, S=S, B=B,
+                   out_cap=out_cap, cond=self.condition,
+                   inc_right=self.includes_right_columns, smax=smax):
+            nl = _n_flat(lschema)
+
+            def fn(emit_counts, emit_offsets, total, border, start_b, sgid,
+                   matches_l, *flat):
+                l_cols = _unflatten_colvs(lschema, flat[:nl])
+                r_cols = _unflatten_colvs(rschema, flat[nl:])
+                sized = dict(emit_counts=emit_counts,
+                             emit_offsets=emit_offsets, total=total,
+                             border=border, start_b=start_b, sgid=sgid,
+                             matches_l=matches_l)
+                lrow, lvalid, rrow, rvalid, _ = jk.join_gather(
+                    jnp, sized, S, B, out_cap, how)
+                r_out = r_cols if inc_right else []
+                out_cols = jk.gather_join_output(jnp, l_cols, r_out, lrow,
+                                                 lvalid, rrow, rvalid)
+                n = total
+                if cond is not None:
+                    ectx = EvalCtx(jnp, out_cols, out_cap, smax)
+                    pred = cond.eval(ectx)
+                    keep = jnp.logical_and(
+                        jnp.logical_and(pred.data, pred.validity),
+                        jnp.arange(out_cap, dtype=np.int64) < total)
+                    out_cols, n = bk.compact(jnp, keep, out_cols, total)
+                return tuple(_flatten_colvs(out_cols)) + (n,)
+            return fn
+
+        fn2 = _cached_jit(key2, build2)
+        res = fn2(emit_counts, emit_offsets, total, border, start_b, sgid,
+                  matches_l, *flat_in)
+        n = int(res[-1])
+        out = _to_batch(self.output, res[:-1], n)
+        self.count_output(n)
+        yield out
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Same device kernel; the build side arrives replicated (broadcast) rather
+    than hash-partitioned. In distributed execution the build child is
+    all-gathered across the mesh instead of exchanged
+    (GpuBroadcastHashJoinExec analog)."""
+
+

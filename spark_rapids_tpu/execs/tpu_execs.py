@@ -24,7 +24,8 @@ from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capaci
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
 from spark_rapids_tpu.execs.evaluator import (eval_exprs_device, output_schema)
-from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+from spark_rapids_tpu.exprs.core import (ColV, EvalCtx, Expression, flat_len,
+                                         flatten_colvs, unflatten_colvs)
 from spark_rapids_tpu.exprs.misc import Alias, SortOrder
 from spark_rapids_tpu.ops import batch_kernels as bk
 from spark_rapids_tpu.ops.aggregate import group_aggregate
@@ -42,26 +43,8 @@ def _flatten(batch: DeviceBatch) -> List:
     return flat
 
 
-def _unflatten_colvs(schema: Schema, flat) -> List[ColV]:
-    cols, i = [], 0
-    for f in schema:
-        if f.dtype is DType.STRING:
-            cols.append(ColV(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
-            i += 3
-        else:
-            cols.append(ColV(f.dtype, flat[i], flat[i + 1]))
-            i += 2
-    return cols
-
-
-def _flatten_colvs(colvs: Sequence[ColV]) -> List:
-    flat = []
-    for v in colvs:
-        flat.append(v.data)
-        flat.append(v.validity)
-        if v.dtype is DType.STRING:
-            flat.append(v.lengths)
-    return flat
+_unflatten_colvs = unflatten_colvs
+_flatten_colvs = flatten_colvs
 
 
 def _to_batch(schema: Schema, flat, num_rows: int) -> DeviceBatch:

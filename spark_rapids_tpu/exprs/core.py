@@ -245,6 +245,35 @@ class BinaryExpression(Expression):
         raise NotImplementedError
 
 
+# ---- flat columnar layout (shared by jit boundaries everywhere) ---------------
+def flat_len(schema) -> int:
+    """Number of flat array slots for a schema: strings use 3 (data, validity,
+    lengths), everything else 2."""
+    return sum(3 if f.dtype is DType.STRING else 2 for f in schema)
+
+
+def flatten_colvs(colvs: Sequence[ColV]) -> list:
+    flat = []
+    for v in colvs:
+        flat.append(v.data)
+        flat.append(v.validity)
+        if v.dtype is DType.STRING:
+            flat.append(v.lengths)
+    return flat
+
+
+def unflatten_colvs(schema, flat) -> list:
+    cols, i = [], 0
+    for f in schema:
+        if f.dtype is DType.STRING:
+            cols.append(ColV(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
+            i += 3
+        else:
+            cols.append(ColV(f.dtype, flat[i], flat[i + 1]))
+            i += 2
+    return cols
+
+
 def widen(ctx: EvalCtx, v: ColV, to: DType) -> ColV:
     """Convert a branch/operand value to the resolved common type.
 

@@ -1,0 +1,235 @@
+"""Equi-join kernels (reference: shims/spark300/GpuHashJoin.scala:220-230 —
+cudf Table.innerJoin/leftJoin/leftSemiJoin/leftAntiJoin/fullJoin).
+
+TPU re-design: instead of a device hash table (dynamic shapes), both sides' keys
+are assigned *dense group ids* by one shared sort over the union of keys — rows
+join iff they share a gid. Join cardinality is dynamic, so the kernel is split:
+
+  phase 1 (size):   one jit program computes per-emit-group counts, offsets and
+                    the total output size (a traced scalar, synced to host once);
+  phase 2 (gather): a second jit program with the bucketed static output
+                    capacity gathers the matching row pairs.
+
+This is the two-pass size-then-gather pattern for dynamic cardinality on XLA.
+Spark semantics: null keys never match (any-null rows are excluded from
+grouping); NaN keys match each other; supported: inner, left, right, full,
+left_semi, left_anti, cross.
+
+Emit-group layout: groups [0, S) are stream (left) rows — each emits its match
+count (or 1 null-padded row for left/full when unmatched, or 0/1 for
+semi/anti); groups [S, S+B) are build (right) rows — each emits 1 when
+unmatched under right/full. A single exclusive-scan over all S+B groups gives
+output offsets for both halves.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.exprs.core import ColV
+from spark_rapids_tpu.ops import batch_kernels as bk
+
+JOIN_KINDS = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+
+def _any_null(xp, keys: Sequence[ColV]):
+    out = None
+    for k in keys:
+        inv = xp.logical_not(k.validity)
+        out = inv if out is None else xp.logical_or(out, inv)
+    return out
+
+
+def _concat_colv(xp, a: ColV, b: ColV) -> ColV:
+    data = xp.concatenate([a.data, b.data], axis=0)
+    validity = xp.concatenate([a.validity, b.validity], axis=0)
+    lengths = (xp.concatenate([a.lengths, b.lengths], axis=0)
+               if a.lengths is not None else None)
+    return ColV(a.dtype, data, validity, lengths)
+
+
+def _exclusive_cumsum(xp, x):
+    c = xp.cumsum(x)
+    return c - x
+
+
+def join_size(xp, l_keys: Sequence[ColV], r_keys: Sequence[ColV],
+              l_alive, r_alive, how: str):
+    """Phase 1. Returns a dict of device arrays:
+    emit_counts [S+B], emit_offsets [S+B], total (scalar), border [B],
+    start_b [S+B], sgid [S], matches_l [S].
+    """
+    S = l_keys[0].validity.shape[0] if l_keys else l_alive.shape[0]
+    B = r_keys[0].validity.shape[0] if r_keys else r_alive.shape[0]
+    G = S + B
+
+    if how == "cross":
+        B_count = xp.sum(r_alive).astype(np.int64)
+        emit_counts = xp.where(l_alive, B_count, 0).astype(np.int64)
+        emit_counts = xp.concatenate(
+            [emit_counts, xp.zeros(B, dtype=np.int64)])
+        emit_offsets = _exclusive_cumsum(xp, emit_counts)
+        total = xp.sum(emit_counts)
+        # build rows in original order, compacted to the front
+        border = bk._stable_argsort(xp, xp.logical_not(r_alive))
+        return dict(emit_counts=emit_counts, emit_offsets=emit_offsets,
+                    total=total, border=border.astype(np.int32),
+                    start_b=xp.zeros(G, dtype=np.int64),
+                    sgid=xp.zeros(S, dtype=np.int32),
+                    matches_l=xp.where(l_alive, B_count, 0).astype(np.int64))
+
+    l_null = _any_null(xp, l_keys)
+    r_null = _any_null(xp, r_keys)
+    l_match_ok = xp.logical_and(l_alive, xp.logical_not(l_null))
+    r_match_ok = xp.logical_and(r_alive, xp.logical_not(r_null))
+
+    keys_all = [_concat_colv(xp, lk, rk) for lk, rk in zip(l_keys, r_keys)]
+    alive_all = xp.concatenate([l_match_ok, r_match_ok])
+    order = bk.sort_indices(xp, [(k, True, True) for k in keys_all], alive_all)
+    starts = bk.rows_equal_adjacent(xp, keys_all, order, alive_all)
+    gids_sorted = xp.cumsum(starts.astype(np.int32)) - 1
+    # scatter gids back to row order; dead rows get -1
+    inv = bk._stable_argsort(xp, order)      # inverse permutation
+    gid_by_row = gids_sorted[inv]
+    gid_by_row = xp.where(alive_all, gid_by_row, -1).astype(np.int32)
+    sgid = gid_by_row[:S]
+    bgid = gid_by_row[S:]
+
+    bgid_safe = xp.clip(bgid, 0, G - 1)
+    ones_b = xp.where(bgid >= 0, 1, 0).astype(np.int64)
+    counts_b = _segment_sum(xp, ones_b, bgid_safe, G)
+    ones_s = xp.where(sgid >= 0, 1, 0).astype(np.int64)
+    counts_s = _segment_sum(xp, ones_s, xp.clip(sgid, 0, G - 1), G)
+
+    matches_l = xp.where(sgid >= 0, counts_b[xp.clip(sgid, 0, G - 1)], 0)
+    matched_b = xp.where(bgid >= 0, counts_s[bgid_safe] > 0, False)
+
+    if how == "inner":
+        emit_l = matches_l
+        emit_r = xp.zeros(B, dtype=np.int64)
+    elif how in ("left",):
+        emit_l = xp.where(l_alive, xp.maximum(matches_l, 1), 0)
+        emit_r = xp.zeros(B, dtype=np.int64)
+    elif how == "right":
+        emit_l = matches_l
+        emit_r = xp.where(xp.logical_and(r_alive, xp.logical_not(matched_b)),
+                          1, 0).astype(np.int64)
+    elif how == "full":
+        emit_l = xp.where(l_alive, xp.maximum(matches_l, 1), 0)
+        emit_r = xp.where(xp.logical_and(r_alive, xp.logical_not(matched_b)),
+                          1, 0).astype(np.int64)
+    elif how == "left_semi":
+        emit_l = xp.where(matches_l > 0, 1, 0).astype(np.int64)
+        emit_r = xp.zeros(B, dtype=np.int64)
+    elif how == "left_anti":
+        emit_l = xp.where(xp.logical_and(l_alive, matches_l == 0), 1, 0
+                          ).astype(np.int64)
+        emit_r = xp.zeros(B, dtype=np.int64)
+    else:
+        raise ValueError(how)
+
+    emit_counts = xp.concatenate([emit_l.astype(np.int64), emit_r])
+    emit_offsets = _exclusive_cumsum(xp, emit_counts)
+    total = xp.sum(emit_counts)
+
+    # build rows sorted by gid (dead rows last); first border-index per gid
+    bkey = xp.where(bgid >= 0, bgid, G).astype(np.int64)
+    border = bk._stable_argsort(xp, bkey).astype(np.int32)
+    pos = xp.arange(B, dtype=np.int64)
+    bgid_sorted = bgid[border]
+    start_b = _segment_min(xp, xp.where(bgid_sorted >= 0, pos, np.int64(B)),
+                           xp.clip(bgid_sorted, 0, G - 1), G)
+
+    return dict(emit_counts=emit_counts, emit_offsets=emit_offsets, total=total,
+                border=border, start_b=start_b, sgid=sgid,
+                matches_l=matches_l.astype(np.int64))
+
+
+def join_gather(xp, sized: dict, S: int, B: int, out_cap: int, how: str):
+    """Phase 2: output row -> (left_row, left_valid, right_row, right_valid).
+
+    left/right_row are gather indices into the original batches; *_valid False
+    means that side is null-padded (outer joins) or absent (semi/anti emit only
+    the left side).
+    """
+    emit_offsets = sized["emit_offsets"]
+    emit_counts = sized["emit_counts"]
+    border = sized["border"]
+    start_b = sized["start_b"]
+    sgid = sized["sgid"]
+    matches_l = sized["matches_l"]
+    total = sized["total"]
+
+    p = xp.arange(out_cap, dtype=np.int64)
+    in_range = p < total
+    g = xp.searchsorted(emit_offsets, p, side="right") - 1
+    g = xp.clip(g, 0, S + B - 1).astype(np.int64)
+    k = p - emit_offsets[g]
+
+    from_stream = g < S
+    srow = xp.clip(g, 0, S - 1)
+    brow_unmatched = xp.clip(g - S, 0, max(B - 1, 0))
+
+    if how == "cross":
+        bpos = xp.clip(k, 0, max(B - 1, 0))
+        right_row = border[bpos]
+        left_valid = xp.logical_and(in_range, from_stream)
+        right_valid = left_valid
+        return (srow.astype(np.int32), left_valid,
+                right_row.astype(np.int32), right_valid, total)
+
+    has_match = matches_l[srow] > 0
+    sg = xp.clip(sgid[srow], 0, S + B - 1)
+    bpos = xp.clip(start_b[sg] + k, 0, max(B - 1, 0))
+    right_from_match = border[bpos]
+
+    if how in ("left_semi", "left_anti"):
+        left_row = srow
+        left_valid = in_range
+        right_row = xp.zeros_like(srow)
+        right_valid = xp.zeros_like(in_range)
+        return (left_row.astype(np.int32), left_valid,
+                right_row.astype(np.int32), right_valid, total)
+
+    left_row = xp.where(from_stream, srow, 0)
+    left_valid = xp.logical_and(in_range, from_stream)
+    right_row = xp.where(from_stream, right_from_match, brow_unmatched)
+    right_valid = xp.logical_and(
+        in_range, xp.logical_or(xp.logical_and(from_stream, has_match),
+                                xp.logical_not(from_stream)))
+    return (left_row.astype(np.int32), left_valid,
+            right_row.astype(np.int32), right_valid, total)
+
+
+def gather_join_output(xp, l_cols: Sequence[ColV], r_cols: Sequence[ColV],
+                       left_row, left_valid, right_row, right_valid
+                       ) -> List[ColV]:
+    """Materialize output columns from gather indices; a False side-valid bit
+    nulls out that side's columns (outer padding)."""
+    out: List[ColV] = []
+    for v in l_cols:
+        g = bk.take_colv(xp, v, left_row)
+        out.append(g.with_validity(xp.logical_and(g.validity, left_valid)))
+    for v in r_cols:
+        g = bk.take_colv(xp, v, right_row)
+        out.append(g.with_validity(xp.logical_and(g.validity, right_valid)))
+    return out
+
+
+def _segment_sum(xp, data, seg_ids, num_segments: int):
+    if xp is np:
+        out = np.zeros(num_segments, dtype=data.dtype)
+        np.add.at(out, seg_ids, data)
+        return out
+    import jax
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def _segment_min(xp, data, seg_ids, num_segments: int):
+    if xp is np:
+        out = np.full(num_segments, np.iinfo(data.dtype).max, dtype=data.dtype)
+        np.minimum.at(out, seg_ids, data)
+        return out
+    import jax
+    return jax.ops.segment_min(data, seg_ids, num_segments=num_segments)

@@ -32,26 +32,8 @@ from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
 from spark_rapids_tpu.ops.aggregate import group_aggregate, merge_aggregate
 
 
-def _unflatten_colvs(schema: Schema, flat) -> List[ColV]:
-    cols, i = [], 0
-    for f in schema:
-        if f.dtype is DType.STRING:
-            cols.append(ColV(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
-            i += 3
-        else:
-            cols.append(ColV(f.dtype, flat[i], flat[i + 1]))
-            i += 2
-    return cols
-
-
-def _flatten_colvs(colvs: Sequence[ColV]) -> List:
-    flat = []
-    for v in colvs:
-        flat.append(v.data)
-        flat.append(v.validity)
-        if v.dtype is DType.STRING:
-            flat.append(v.lengths)
-    return flat
+from spark_rapids_tpu.exprs.core import (flatten_colvs as _flatten_colvs,
+                                         unflatten_colvs as _unflatten_colvs)
 
 
 def build_distributed_aggregate(mesh: Mesh, schema: Schema,
@@ -107,8 +89,7 @@ def _gather_colv(v: ColV, axis: str) -> ColV:
     return ColV(v.dtype, data, validity, lengths)
 
 
-def _flat_len(schema: Schema) -> int:
-    return sum(3 if f.dtype is DType.STRING else 2 for f in schema)
+from spark_rapids_tpu.exprs.core import flat_len as _flat_len
 
 
 def _out_specs(key_exprs, agg_fns) -> Tuple:

@@ -286,7 +286,40 @@ def _make_scan_rules() -> List[ExecRule]:
     ]
 
 
-_EXEC_RULE_LIST: List[ExecRule] = _make_scan_rules() + [
+def _convert_join(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.join_execs import TpuShuffledHashJoinExec
+    e = meta.exec
+    return TpuShuffledHashJoinExec(children[0], children[1], e.how,
+                                   e.left_keys, e.right_keys, e.output,
+                                   e.condition)
+
+
+def _tag_join(meta: ExecMeta) -> None:
+    """GpuHashJoin.tagJoin analog (shims/spark300/GpuHashJoin.scala:36-50)."""
+    e = meta.exec
+    for k in list(e.left_keys) + list(e.right_keys):
+        try:
+            if k.dtype() not in (set(SUPPORTED_JOIN_KEY_TYPES)):
+                meta.will_not_work(f"join key type {k.dtype().value} is not "
+                                   f"supported on TPU")
+        except TypeError:
+            pass
+
+
+SUPPORTED_JOIN_KEY_TYPES = (DType.BOOLEAN, DType.BYTE, DType.SHORT, DType.INT,
+                            DType.LONG, DType.FLOAT, DType.DOUBLE, DType.STRING,
+                            DType.DATE, DType.TIMESTAMP)
+
+
+def _make_join_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
+    return [ExecRule(CpuHashJoinExec, "hash join", _convert_join,
+                     exprs_of=lambda e: tuple(e.left_keys) + tuple(e.right_keys)
+                     + ((e.condition,) if e.condition is not None else ()),
+                     tag=_tag_join)]
+
+
+_EXEC_RULE_LIST: List[ExecRule] = _make_scan_rules() + _make_join_rules() + [
     ExecRule(ce.CpuProjectExec, "column projection", _convert_project,
              exprs_of=lambda e: e.exprs),
     ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,

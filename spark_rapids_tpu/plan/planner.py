@@ -60,17 +60,32 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
         return ce.CpuUnionExec(plan_physical(plan.left, conf),
                                plan_physical(plan.right, conf))
     if isinstance(plan, lp.Join):
-        try:
-            from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
-        except ImportError as e:
-            raise NotImplementedError(
-                "joins are not implemented yet (join exec layer pending)") from e
+        from spark_rapids_tpu.columnar.dtypes import DType
+        from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
+        from spark_rapids_tpu.exprs.cast import Cast
         left = plan_physical(plan.left, conf)
         right = plan_physical(plan.right, conf)
-        lkeys = tuple(bind_expression(e, left.output) for e in plan.left_keys)
-        rkeys = tuple(bind_expression(e, right.output) for e in plan.right_keys)
-        return CpuHashJoinExec(left, right, plan.how, lkeys, rkeys,
-                               plan.schema())
+        lkeys = [bind_expression(e, left.output) for e in plan.left_keys]
+        rkeys = [bind_expression(e, right.output) for e in plan.right_keys]
+        # Catalyst-style key coercion: both sides of each key pair must share a
+        # type or equal keys can land in different sort groups
+        for i, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+            ct = DType.common_type(lk.dtype(), rk.dtype())
+            if lk.dtype() != ct:
+                lkeys[i] = Cast(lk, ct)
+            if rk.dtype() != ct:
+                rkeys[i] = Cast(rk, ct)
+        out_schema = plan.schema()
+        cond = (bind_expression(plan.condition, out_schema)
+                if plan.condition is not None else None)
+        if cond is not None and plan.how != "inner":
+            # post-join filtering is only equivalent to a join condition for
+            # inner joins (the reference's tagJoin has the same restriction)
+            raise NotImplementedError(
+                f"join conditions are only supported for inner joins, not "
+                f"{plan.how}")
+        return CpuHashJoinExec(left, right, plan.how, tuple(lkeys),
+                               tuple(rkeys), out_schema, cond)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
 
 

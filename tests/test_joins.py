@@ -1,0 +1,158 @@
+"""Join tests: all join types, null/NaN keys, duplicates, empty sides, CPU vs
+TPU parity (BroadcastHashJoinSuite / joins pytest analog)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+
+def left_table():
+    return pa.table({
+        "k": pa.array([1, 2, 2, 3, None, 5], type=pa.int64()),
+        "lv": pa.array(["a", "b", "c", "d", "e", "f"]),
+    })
+
+
+def right_table():
+    return pa.table({
+        "k": pa.array([2, 2, 3, 4, None], type=pa.int64()),
+        "rv": pa.array([20, 21, 30, 40, 99], type=pa.int64()),
+    })
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_join_types(how):
+    lt, rt = left_table(), right_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "k", how),
+        ignore_order=True,
+        expect_tpu_execs=["TpuShuffledHashJoinExec"])
+
+
+def test_inner_join_golden():
+    lt, rt = left_table(), right_table()
+    s = TpuSession()
+    out = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k")
+           .sort("k", "lv", "rv").collect())
+    # k=2 matches 2x2 rows; k=3 matches 1; nulls never match
+    assert out.column("k").to_pylist() == [2, 2, 2, 2, 3]
+    assert out.column("lv").to_pylist() == ["b", "b", "c", "c", "d"]
+    assert out.column("rv").to_pylist() == [20, 21, 20, 21, 30]
+
+
+def test_left_join_golden():
+    lt, rt = left_table(), right_table()
+    s = TpuSession()
+    out = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "left")
+           .sort("lv", "rv").collect())
+    assert out.num_rows == 8  # 5 matches + a,e,f unmatched
+    d = dict(zip(out.column("lv").to_pylist(), out.column("rv").to_pylist()))
+    assert d["a"] is None and d["e"] is None and d["f"] is None
+
+
+def test_semi_anti_golden():
+    lt, rt = left_table(), right_table()
+    s = TpuSession()
+    semi = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "left_semi")
+            .sort("lv").collect())
+    assert semi.column("lv").to_pylist() == ["b", "c", "d"]
+    anti = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "left_anti")
+            .sort("lv").collect())
+    assert anti.column("lv").to_pylist() == ["a", "e", "f"]  # null key kept
+
+
+def test_full_join_coalesced_key():
+    lt, rt = left_table(), right_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "full"),
+        ignore_order=True)
+    s = TpuSession()
+    out = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "full")
+           .collect())
+    # 5 matched pairs + 3 unmatched left + 2 unmatched right (incl null-key)
+    assert out.num_rows == 10
+    assert 4 in out.column("k").to_pylist()  # right-only key appears coalesced
+
+
+def test_string_keys_and_nan_keys():
+    lt = pa.table({"s": pa.array(["x", "y", None, "z"]),
+                   "v": pa.array([1, 2, 3, 4], type=pa.int64())})
+    rt = pa.table({"s": pa.array(["y", "z", "z", None]),
+                   "w": pa.array([20, 30, 31, 99], type=pa.int64())})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "s"),
+        ignore_order=True)
+    nan = float("nan")
+    lf = pa.table({"d": pa.array([1.0, nan, 2.0], type=pa.float64()),
+                   "v": pa.array([1, 2, 3], type=pa.int64())})
+    rf = pa.table({"d": pa.array([nan, 2.0], type=pa.float64()),
+                   "w": pa.array([10, 20], type=pa.int64())})
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lf).join(s.create_dataframe(rf), "d"),
+        ignore_order=True)
+    assert cpu.num_rows == 2  # NaN == NaN matches (Spark NaN semantics)
+
+
+def test_empty_sides():
+    lt, rt = left_table(), right_table()
+    empty_r = rt.slice(0, 0)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(empty_r), "k"),
+        ignore_order=True)
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(empty_r), "k",
+                                              "left"),
+        ignore_order=True)
+
+
+def test_cross_join():
+    lt = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+    rt = pa.table({"b": pa.array([10, 20, 30], type=pa.int64())})
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).crossJoin(s.create_dataframe(rt)),
+        ignore_order=True)
+    assert cpu.num_rows == 6
+
+
+def test_join_then_agg_pipeline():
+    """Joined data flows on into aggregation on device (TPC-H-q5-ish shape)."""
+    lt, rt = left_table(), right_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(lt)
+                   .join(s.create_dataframe(rt), "k")
+                   .groupBy("lv").agg(F.sum("rv").alias("srv"),
+                                      F.count().alias("n"))),
+        ignore_order=True,
+        expect_tpu_execs=["TpuShuffledHashJoinExec", "TpuHashAggregateExec"])
+
+
+def test_mixed_dtype_keys_coerced():
+    # regression (code review): int64 x float64 keys must widen, order-independent
+    lt = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                   "v": pa.array([10, 20], type=pa.int64())})
+    for rvals in ([1.5, 1.0], [1.0, 1.5]):
+        rt = pa.table({"k": pa.array(rvals, type=pa.float64()),
+                       "w": pa.array([100, 200], type=pa.int64())})
+        cpu = assert_tpu_and_cpu_equal(
+            lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "k"),
+            ignore_order=True)
+        assert cpu.num_rows == 1
+        assert cpu.column("v").to_pylist() == [10]
+
+
+def test_condition_on_outer_join_rejected():
+    from spark_rapids_tpu.plan import logical as lp
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.exprs import GreaterThan, UnresolvedAttribute
+    s = TpuSession()
+    lt = s.create_dataframe({"k": [1], "a": [1]})
+    rt = s.create_dataframe({"k": [1], "b": [2]})
+    j = DataFrame(lp.Join(lt._plan, rt._plan, "left",
+                          (UnresolvedAttribute("k"),), (UnresolvedAttribute("k"),),
+                          GreaterThan(UnresolvedAttribute("b"),
+                                      UnresolvedAttribute("a"))), s)
+    with pytest.raises(NotImplementedError, match="inner"):
+        j.collect()
