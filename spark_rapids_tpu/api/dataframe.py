@@ -141,9 +141,13 @@ class DataFrame:
         return final
 
     def collect(self) -> pa.Table:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
         final = self._executed_plan()
-        ctx = ExecContext(self.session.conf)
-        tables = [b.to_arrow() for b in final.execute(ctx)]
+        dm = DeviceManager.initialize(self.session.conf)
+        ctx = ExecContext(self.session.conf, device_manager=dm)
+        # device-admission throttle for the whole task (GpuSemaphore analog)
+        with dm.semaphore.held():
+            tables = [b.to_arrow() for b in final.execute(ctx)]
         schema = self._plan.schema().to_pa()
         if not tables:
             return schema.empty_table()

@@ -20,10 +20,11 @@ class ExecContext:
     """Per-execution state handed down the operator tree."""
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
-                 num_partitions: int = 1):
+                 num_partitions: int = 1, device_manager=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
+        self.device_manager = device_manager
 
     @property
     def string_max_bytes(self) -> int:

@@ -1,0 +1,6 @@
+from spark_rapids_tpu.memory.buffer import BufferId, SpillableBuffer, StorageTier
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.store import (BufferCatalog, DeviceMemoryStore,
+                                           DiskStore, HostMemoryStore,
+                                           build_store_chain)
+from spark_rapids_tpu.memory.device_manager import DeviceManager

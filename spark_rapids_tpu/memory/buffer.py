@@ -1,0 +1,137 @@
+"""Spillable buffer handles (reference: RapidsBuffer.scala:53,61 —
+RapidsBufferId / StorageTier / RapidsBuffer with acquire/release refcounting).
+
+A buffer is one materialized DeviceBatch in some storage tier:
+DEVICE (jax arrays in HBM), HOST (numpy mirror), DISK (npz file). The payload
+always moves as the flat columnar layout plus a schema descriptor, so any tier
+can rebuild the batch.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
+from spark_rapids_tpu.utils.arm import Retainable
+
+
+class StorageTier(enum.IntEnum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+@dataclass(frozen=True, order=True)
+class BufferId:
+    """Unique buffer identity; table_id groups shuffle partitions."""
+    table_id: int
+    part_id: int = 0
+
+    def __post_init__(self):
+        if not (0 <= self.part_id < (1 << 20)) or self.table_id < 0:
+            raise ValueError(f"BufferId out of range: table_id={self.table_id} "
+                             f"part_id={self.part_id} (part_id < 2^20)")
+
+    @property
+    def key(self) -> int:
+        return (self.table_id << 20) | self.part_id
+
+
+def _flatten_device(batch: DeviceBatch) -> List:
+    out = []
+    for c in batch.columns:
+        out.append(c.data)
+        out.append(c.validity)
+        if c.lengths is not None:
+            out.append(c.lengths)
+    return out
+
+
+def _rebuild(schema: Schema, arrays: List, num_rows: int) -> DeviceBatch:
+    cols, i = [], 0
+    for f in schema:
+        if f.dtype is DType.STRING:
+            cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1],
+                                     arrays[i + 2]))
+            i += 3
+        else:
+            cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1]))
+            i += 2
+    return DeviceBatch(schema, tuple(cols), num_rows)
+
+
+class SpillableBuffer(Retainable):
+    """One batch in one tier. Refcounted: the owning store holds one reference;
+    acquirers retain/close around use (RapidsBufferStore.isAcquired discipline).
+    """
+
+    def __init__(self, buffer_id: BufferId, schema: Schema, num_rows: int,
+                 tier: StorageTier, payload, size_bytes: int,
+                 spill_priority: float):
+        super().__init__()
+        self.id = buffer_id
+        self.schema = schema
+        self.num_rows = num_rows
+        self.tier = tier
+        self.payload = payload          # device arrays | numpy arrays | file path
+        self.size_bytes = size_bytes
+        self.spill_priority = spill_priority
+        self.owner_store = None         # set by BufferStore.add_buffer
+
+    # ---- materialization -------------------------------------------------------
+    def get_batch(self) -> DeviceBatch:
+        """Materialize as a device batch (uploading from host/disk if needed)."""
+        import jax
+        if self.tier == StorageTier.DEVICE:
+            return _rebuild(self.schema, self.payload, self.num_rows)
+        arrays = self._host_arrays()
+        return _rebuild(self.schema, [jax.device_put(a) for a in arrays],
+                        self.num_rows)
+
+    def _host_arrays(self) -> List[np.ndarray]:
+        if self.tier == StorageTier.HOST:
+            return self.payload
+        if self.tier == StorageTier.DISK:
+            with np.load(self.payload) as z:
+                return [z[f"a{i}"] for i in range(len(z.files))]
+        return [np.asarray(a) for a in self.payload]
+
+    # ---- tier movement ---------------------------------------------------------
+    def to_host(self) -> "SpillableBuffer":
+        arrays = self._host_arrays()
+        size = sum(a.nbytes for a in arrays)
+        return SpillableBuffer(self.id, self.schema, self.num_rows,
+                               StorageTier.HOST, arrays, size,
+                               self.spill_priority)
+
+    def to_disk(self, directory: str) -> "SpillableBuffer":
+        arrays = self._host_arrays()
+        path = os.path.join(directory,
+                            f"buf_{self.id.table_id}_{self.id.part_id}.npz")
+        np.savez(path, **{f"a{i}": a for i, a in enumerate(arrays)})
+        size = os.path.getsize(path)
+        return SpillableBuffer(self.id, self.schema, self.num_rows,
+                               StorageTier.DISK, path, size,
+                               self.spill_priority)
+
+    def _on_release(self) -> None:
+        if self.tier == StorageTier.DISK and isinstance(self.payload, str):
+            try:
+                os.unlink(self.payload)
+            except OSError:
+                pass
+        self.payload = None
+
+    @staticmethod
+    def from_batch(buffer_id: BufferId, batch: DeviceBatch,
+                   spill_priority: float = 0.0) -> "SpillableBuffer":
+        return SpillableBuffer(buffer_id, batch.schema, batch.num_rows,
+                               StorageTier.DEVICE, _flatten_device(batch),
+                               batch.device_size_bytes, spill_priority)

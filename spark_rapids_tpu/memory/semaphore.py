@@ -1,0 +1,68 @@
+"""Device-admission semaphore (reference: GpuSemaphore.scala — limits
+concurrent tasks holding the GPU via spark.rapids.sql.concurrentGpuTasks, with
+per-task acquire and completion-listener release).
+
+Here tasks are host threads driving device work; holding the semaphore bounds
+concurrent HBM working sets. Re-entrant per task: a task that already holds it
+does not double-acquire (acquireIfNecessary semantics).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Set
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int):
+        if max_concurrent <= 0:
+            raise ValueError("max_concurrent must be positive")
+        self.max_concurrent = max_concurrent
+        self._cond = threading.Condition()
+        self._holders: Set[int] = set()
+
+    def _task_id(self, task_id: Optional[int]) -> int:
+        return task_id if task_id is not None else threading.get_ident()
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None,
+                             timeout: Optional[float] = None) -> bool:
+        """Idempotent per task; holder check and permit take are one atomic step
+        under the condition (no check-then-act race between threads sharing a
+        task id). timeout=0 is a non-blocking try."""
+        tid = self._task_id(task_id)
+        with self._cond:
+            if tid in self._holders:
+                return True
+            ok = self._cond.wait_for(
+                lambda: tid in self._holders
+                or len(self._holders) < self.max_concurrent,
+                timeout=timeout)
+            if not ok:
+                return False
+            self._holders.add(tid)  # re-adding after a racer added is harmless
+            return True
+
+    def release_if_necessary(self, task_id: Optional[int] = None) -> None:
+        tid = self._task_id(task_id)
+        with self._cond:
+            if tid in self._holders:
+                self._holders.remove(tid)
+                self._cond.notify_all()
+
+    @contextmanager
+    def held(self, task_id: Optional[int] = None):
+        tid = self._task_id(task_id)
+        with self._cond:
+            already = tid in self._holders
+        if not already:
+            self.acquire_if_necessary(task_id)
+        try:
+            yield
+        finally:
+            if not already:
+                self.release_if_necessary(task_id)
+
+    @property
+    def active_holders(self) -> int:
+        with self._cond:
+            return len(self._holders)

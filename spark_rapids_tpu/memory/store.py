@@ -1,0 +1,284 @@
+"""Tiered spillable buffer stores + catalog.
+
+Reference analogs:
+- RapidsBufferStore.scala (abstract spillable store, chained setSpillStore,
+  synchronousSpill copying the coldest buffer to the next tier);
+- RapidsDeviceMemoryStore / RapidsHostMemoryStore / RapidsDiskStore;
+- RapidsBufferCatalog.scala:30 (tier-ordered buffer lookup);
+- SpillPriorities.scala (ordering constants);
+- DeviceMemoryEventHandler.scala:35 (alloc-failure -> spill -> retry).
+
+The spill order uses the C++ HashedPriorityQueue; the host tier's budget uses
+the C++ AddressSpaceAllocator for arena accounting. The device tier enforces a
+byte budget at admission time (jax owns the real HBM allocator): adding a batch
+that would exceed the budget synchronously spills the coldest buffers down the
+chain first — the admission-based equivalent of the reference's RMM OOM
+callback, plus `handle_oom` for reactive RESOURCE_EXHAUSTED recovery.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.memory.buffer import BufferId, SpillableBuffer, StorageTier
+from spark_rapids_tpu.native import AddressSpaceAllocator, HashedPriorityQueue
+
+# SpillPriorities analog
+INPUT_BATCH_PRIORITY = 100.0
+OUTPUT_BATCH_PRIORITY = 50.0
+SHUFFLE_BUFFER_PRIORITY = 0.0
+
+
+class BufferCatalog:
+    """buffer id -> [buffers by tier]; acquire returns the fastest tier."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._buffers: Dict[BufferId, Dict[StorageTier, SpillableBuffer]] = {}
+
+    def register(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            self._buffers.setdefault(buf.id, {})[buf.tier] = buf
+
+    def unregister(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            tiers = self._buffers.get(buf.id)
+            if tiers and tiers.get(buf.tier) is buf:
+                del tiers[buf.tier]
+                if not tiers:
+                    del self._buffers[buf.id]
+
+    def acquire(self, buffer_id: BufferId) -> Optional[SpillableBuffer]:
+        """Best-tier buffer, retained for the caller (close() when done)."""
+        with self._lock:
+            tiers = self._buffers.get(buffer_id)
+            if not tiers:
+                return None
+            best = min(tiers.keys())
+            buf = tiers[best]
+            buf.retain()
+            return buf
+
+    def ids(self) -> List[BufferId]:
+        with self._lock:
+            return list(self._buffers.keys())
+
+    def remove(self, buffer_id: BufferId) -> None:
+        """Delete a buffer everywhere: store-owned tiers go through their owning
+        store (keeping store bookkeeping consistent); orphans close directly."""
+        with self._lock:
+            tiers = dict(self._buffers.get(buffer_id, {}))
+        for buf in tiers.values():
+            if buf.owner_store is not None:
+                buf.owner_store.remove(buffer_id)
+            else:
+                self.unregister(buf)
+                buf.close()
+
+
+class BufferStore:
+    """One storage tier holding spillable buffers, chained to a slower tier."""
+
+    tier: StorageTier
+
+    def __init__(self, catalog: BufferCatalog, budget_bytes: Optional[int] = None):
+        self.catalog = catalog
+        self.budget_bytes = budget_bytes
+        self._lock = threading.RLock()
+        self._buffers: Dict[int, SpillableBuffer] = {}   # key -> buffer
+        self._spill_queue = HashedPriorityQueue()
+        self._used = 0
+        self.spill_store: Optional["BufferStore"] = None
+
+    # ---- admission -------------------------------------------------------------
+    def add_buffer(self, buf: SpillableBuffer) -> None:
+        assert buf.tier == self.tier, (buf.tier, self.tier)
+        # make room OUTSIDE the store lock: the spill cascade does device->host
+        # transfers and disk writes, which must not serialize unrelated
+        # add/remove traffic (spill_to_size does its own locking per victim)
+        if self.budget_bytes is not None:
+            self.ensure_capacity(buf.size_bytes)
+        with self._lock:
+            buf.owner_store = self
+            self._buffers[buf.id.key] = buf
+            self._spill_queue.offer(buf.id.key, buf.spill_priority)
+            self._used += buf.size_bytes
+        self.catalog.register(buf)
+
+    def ensure_capacity(self, incoming_bytes: int) -> None:
+        """Spill coldest buffers until incoming_bytes fits the budget
+        (synchronousSpill analog)."""
+        if self.budget_bytes is None:
+            return
+        target = self.budget_bytes - incoming_bytes
+        self.spill_to_size(max(target, 0))
+
+    def spill_to_size(self, target_bytes: int) -> int:
+        """Spill until used <= target; returns bytes spilled."""
+        spilled = 0
+        while True:
+            with self._lock:
+                if self._used <= target_bytes:
+                    return spilled
+                entry = self._spill_queue.poll()
+                if entry is None:
+                    return spilled
+                key, _prio = entry
+                buf = self._buffers.pop(key, None)
+                if buf is None:
+                    continue
+                self._used -= buf.size_bytes
+            spilled += buf.size_bytes
+            self._spill_one(buf)
+
+    def _spill_one(self, buf: SpillableBuffer) -> None:
+        if self.spill_store is None:
+            # last tier: dropping data would lose it; keep and give up
+            with self._lock:
+                self._buffers[buf.id.key] = buf
+                self._spill_queue.offer(buf.id.key, buf.spill_priority)
+                self._used += buf.size_bytes
+            raise MemoryError(
+                f"store tier {self.tier.name} over budget with no spill store")
+        moved = self._move_down(buf)
+        self.spill_store.add_buffer(moved)
+        self.catalog.unregister(buf)
+        buf.close()
+
+    def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
+        raise NotImplementedError
+
+    # ---- bookkeeping -----------------------------------------------------------
+    def remove(self, buffer_id: BufferId) -> None:
+        with self._lock:
+            buf = self._buffers.pop(buffer_id.key, None)
+            if buf is not None:
+                self._spill_queue.remove(buffer_id.key)
+                self._used -= buf.size_bytes
+        if buf is not None:
+            self.catalog.unregister(buf)
+            buf.close()
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buffers)
+
+    def close(self) -> None:
+        with self._lock:
+            bufs = list(self._buffers.values())
+            self._buffers.clear()
+            self._used = 0
+        for b in bufs:
+            self.catalog.unregister(b)
+            b.close()
+        self._spill_queue.close()
+
+
+class DeviceMemoryStore(BufferStore):
+    """HBM tier (RapidsDeviceMemoryStore analog). Budget-enforced at admission;
+    jax owns the physical allocator."""
+
+    tier = StorageTier.DEVICE
+
+    def add_batch(self, buffer_id: BufferId, batch, spill_priority: float = 0.0
+                  ) -> SpillableBuffer:
+        buf = SpillableBuffer.from_batch(buffer_id, batch, spill_priority)
+        self.add_buffer(buf)
+        return buf
+
+    def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
+        return buf.to_host()
+
+    def handle_oom(self, needed_bytes: int) -> int:
+        """Reactive OOM recovery (DeviceMemoryEventHandler.onAllocFailure
+        analog): spill at least needed_bytes to the next tier."""
+        with self._lock:
+            target = max(self._used - needed_bytes, 0)
+        return self.spill_to_size(target)
+
+
+class HostMemoryStore(BufferStore):
+    """Host tier backed by arena accounting over the C++ allocator
+    (RapidsHostMemoryStore + AddressSpaceAllocator analog)."""
+
+    tier = StorageTier.HOST
+
+    def __init__(self, catalog: BufferCatalog, budget_bytes: int):
+        super().__init__(catalog, budget_bytes)
+        self.arena = AddressSpaceAllocator(budget_bytes)
+        self._offsets: Dict[int, int] = {}
+
+    def add_buffer(self, buf: SpillableBuffer) -> None:
+        need = max(buf.size_bytes, 1)
+        while True:
+            with self._lock:
+                off = self.arena.allocate(need)
+                if off is not None:
+                    self._offsets[buf.id.key] = off
+                    break
+            # fragmented or full: spill the coldest host buffer to disk and
+            # retry until a contiguous block fits or nothing is left to spill
+            with self._lock:
+                over = self._used
+            freed = self.spill_to_size(max(over - need, 0)) if over else 0
+            if freed == 0:
+                raise MemoryError(
+                    f"host spill arena exhausted ({need} bytes needed, "
+                    f"largest free block {self.arena.largest_free_block})")
+        super().add_buffer(buf)
+
+    def _release_arena(self, key: int) -> None:
+        off = self._offsets.pop(key, None)
+        if off is not None:
+            self.arena.free(off)
+
+    def _spill_one(self, buf: SpillableBuffer) -> None:
+        super()._spill_one(buf)
+        with self._lock:
+            self._release_arena(buf.id.key)
+
+    def remove(self, buffer_id: BufferId) -> None:
+        super().remove(buffer_id)
+        with self._lock:
+            self._release_arena(buffer_id.key)
+
+    def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
+        assert isinstance(self.spill_store, DiskStore), "host spills to disk"
+        return buf.to_disk(self.spill_store.directory)
+
+    def close(self) -> None:
+        super().close()
+        self.arena.close()
+
+
+class DiskStore(BufferStore):
+    """Disk tier (RapidsDiskStore analog); files live in a spill directory."""
+
+    tier = StorageTier.DISK
+
+    def __init__(self, catalog: BufferCatalog, directory: Optional[str] = None):
+        super().__init__(catalog, budget_bytes=None)
+        self.directory = directory or tempfile.mkdtemp(prefix="srtpu_spill_")
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
+        raise AssertionError("disk is the last tier")
+
+
+def build_store_chain(catalog: BufferCatalog, device_budget: int,
+                      host_budget: int, disk_dir: Optional[str] = None):
+    """DEVICE -> HOST -> DISK chain (GpuShuffleEnv.initStorage analog,
+    GpuShuffleEnv.scala:52-70)."""
+    disk = DiskStore(catalog, disk_dir)
+    host = HostMemoryStore(catalog, host_budget)
+    host.spill_store = disk
+    device = DeviceMemoryStore(catalog, device_budget)
+    device.spill_store = host
+    return device, host, disk

@@ -1,0 +1,202 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference delegates its runtime hot paths to native code (RMM pools, cuDF
+JNI, UCX); here the host-runtime pieces — the address-space sub-allocator and
+the spill-ordering priority queue — are C++ compiled on first import and bound
+over a C ABI. Compute stays in XLA; this is the runtime *around* the device.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_BUILD = os.path.join(_DIR, "build")
+_LIB_PATH = os.path.join(_BUILD, "libsrtpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _src_hash() -> str:
+    import hashlib
+    h = hashlib.sha256()
+    for f in sorted(os.listdir(_SRC)):
+        if f.endswith(".cpp"):
+            with open(os.path.join(_SRC, f), "rb") as fh:
+                h.update(f.encode())
+                h.update(fh.read())
+    return h.hexdigest()
+
+
+_HASH_PATH = os.path.join(_BUILD, "src.sha256")
+
+
+def _needs_rebuild() -> bool:
+    """Content-hash check (mtimes are unreliable after git checkout)."""
+    if not os.path.exists(_LIB_PATH) or not os.path.exists(_HASH_PATH):
+        return True
+    with open(_HASH_PATH) as f:
+        return f.read().strip() != _src_hash()
+
+
+def _build() -> None:
+    os.makedirs(_BUILD, exist_ok=True)
+    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC))
+            if f.endswith(".cpp")]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+    with open(_HASH_PATH, "w") as f:
+        f.write(_src_hash())
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            if _needs_rebuild():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            _configure(lib)
+            _lib = lib
+    return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u64 = ctypes.c_uint64
+    i64 = ctypes.c_int64
+    p = ctypes.c_void_p
+    lib.srt_allocator_create.restype = p
+    lib.srt_allocator_create.argtypes = [u64]
+    lib.srt_allocator_destroy.argtypes = [p]
+    lib.srt_allocator_allocate.restype = u64
+    lib.srt_allocator_allocate.argtypes = [p, u64]
+    lib.srt_allocator_free.restype = u64
+    lib.srt_allocator_free.argtypes = [p, u64]
+    lib.srt_allocator_available.restype = u64
+    lib.srt_allocator_available.argtypes = [p]
+    lib.srt_allocator_allocated_size.restype = u64
+    lib.srt_allocator_allocated_size.argtypes = [p, u64]
+    lib.srt_allocator_num_free_blocks.restype = u64
+    lib.srt_allocator_num_free_blocks.argtypes = [p]
+    lib.srt_allocator_largest_free_block.restype = u64
+    lib.srt_allocator_largest_free_block.argtypes = [p]
+
+    lib.srt_pq_create.restype = p
+    lib.srt_pq_destroy.argtypes = [p]
+    lib.srt_pq_offer.restype = ctypes.c_int
+    lib.srt_pq_offer.argtypes = [p, i64, ctypes.c_double]
+    lib.srt_pq_contains.restype = ctypes.c_int
+    lib.srt_pq_contains.argtypes = [p, i64]
+    lib.srt_pq_poll.restype = ctypes.c_int
+    lib.srt_pq_poll.argtypes = [p, ctypes.POINTER(i64),
+                                ctypes.POINTER(ctypes.c_double)]
+    lib.srt_pq_peek.restype = ctypes.c_int
+    lib.srt_pq_peek.argtypes = [p, ctypes.POINTER(i64),
+                                ctypes.POINTER(ctypes.c_double)]
+    lib.srt_pq_remove.restype = ctypes.c_int
+    lib.srt_pq_remove.argtypes = [p, i64]
+    lib.srt_pq_size.restype = u64
+    lib.srt_pq_size.argtypes = [p]
+
+
+NULL_OFFSET = 2 ** 64 - 1
+
+
+class AddressSpaceAllocator:
+    """First-fit sub-allocator over an abstract address space (C++ backed)."""
+
+    def __init__(self, size: int):
+        self._lib = get_lib()
+        self._handle = self._lib.srt_allocator_create(size)
+        if not self._handle:
+            raise MemoryError("failed to create allocator")
+        self.size = size
+
+    def allocate(self, length: int) -> Optional[int]:
+        off = self._lib.srt_allocator_allocate(self._handle, length)
+        return None if off == NULL_OFFSET else off
+
+    def free(self, offset: int) -> int:
+        return self._lib.srt_allocator_free(self._handle, offset)
+
+    @property
+    def available(self) -> int:
+        return self._lib.srt_allocator_available(self._handle)
+
+    def allocated_size(self, offset: int) -> int:
+        return self._lib.srt_allocator_allocated_size(self._handle, offset)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._lib.srt_allocator_num_free_blocks(self._handle)
+
+    @property
+    def largest_free_block(self) -> int:
+        return self._lib.srt_allocator_largest_free_block(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.srt_allocator_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class HashedPriorityQueue:
+    """Min-heap with O(1) contains and keyed priority updates (C++ backed).
+    Lowest priority polls first — the spill order."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        self._handle = self._lib.srt_pq_create()
+        if not self._handle:
+            raise MemoryError("failed to create priority queue")
+
+    def offer(self, key: int, priority: float) -> bool:
+        return bool(self._lib.srt_pq_offer(self._handle, key, priority))
+
+    def __contains__(self, key: int) -> bool:
+        return bool(self._lib.srt_pq_contains(self._handle, key))
+
+    def poll(self):
+        k = ctypes.c_int64()
+        pr = ctypes.c_double()
+        if not self._lib.srt_pq_poll(self._handle, ctypes.byref(k),
+                                     ctypes.byref(pr)):
+            return None
+        return k.value, pr.value
+
+    def peek(self):
+        k = ctypes.c_int64()
+        pr = ctypes.c_double()
+        if not self._lib.srt_pq_peek(self._handle, ctypes.byref(k),
+                                     ctypes.byref(pr)):
+            return None
+        return k.value, pr.value
+
+    def remove(self, key: int) -> bool:
+        return bool(self._lib.srt_pq_remove(self._handle, key))
+
+    def __len__(self) -> int:
+        return self._lib.srt_pq_size(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.srt_pq_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

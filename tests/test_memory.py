@@ -1,0 +1,220 @@
+"""Memory layer tests: native allocator/queue, tiered spill stores, semaphore
+(RapidsDeviceMemoryStoreSuite / RapidsHostMemoryStoreSuite / RapidsDiskStoreSuite /
+RapidsBufferCatalogSuite / GpuSemaphoreSuite / AddressSpaceAllocatorSuite analog)."""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import DeviceBatch
+from spark_rapids_tpu.memory import (BufferCatalog, BufferId, StorageTier,
+                                     TpuSemaphore, build_store_chain)
+from spark_rapids_tpu.native import AddressSpaceAllocator, HashedPriorityQueue
+from spark_rapids_tpu.testing import assert_tables_equal
+
+
+# ---------------------------------------------------------------- native layer
+def test_allocator_first_fit_and_coalescing():
+    a = AddressSpaceAllocator(1000)
+    o1, o2, o3 = a.allocate(100), a.allocate(200), a.allocate(300)
+    assert (o1, o2, o3) == (0, 100, 300)
+    assert a.available == 400
+    a.free(o2)
+    assert a.num_free_blocks == 2
+    assert a.allocate(150) == 100       # first fit reuses the hole
+    a.free(o1), a.free(o3), a.free(100)
+    assert a.available == 1000 and a.num_free_blocks == 1  # fully coalesced
+    assert a.allocate(2000) is None
+    a.close()
+
+
+def test_allocator_fragmentation():
+    a = AddressSpaceAllocator(300)
+    offs = [a.allocate(100) for _ in range(3)]
+    a.free(offs[0]); a.free(offs[2])
+    assert a.available == 200
+    assert a.largest_free_block == 100
+    assert a.allocate(150) is None      # fragmented: no single block fits
+    a.close()
+
+
+def test_priority_queue_order_update_remove():
+    q = HashedPriorityQueue()
+    for k, p in [(1, 5.0), (2, 1.0), (3, 3.0)]:
+        assert q.offer(k, p)
+    assert not q.offer(1, 0.5)          # update, not insert
+    assert q.poll() == (1, 0.5)
+    assert q.peek() == (2, 1.0)
+    assert q.remove(2)
+    assert q.poll() == (3, 3.0)
+    assert q.poll() is None
+    q.close()
+
+
+def test_priority_queue_fifo_among_equal():
+    q = HashedPriorityQueue()
+    for k in range(5):
+        q.offer(k, 1.0)
+    assert [q.poll()[0] for _ in range(5)] == [0, 1, 2, 3, 4]
+    q.close()
+
+
+# ---------------------------------------------------------------- spill tiers
+def make_batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    t = pa.table({"x": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+                  "s": pa.array([f"row{i}" for i in range(n)])})
+    return t, DeviceBatch.from_arrow(t, string_max_bytes=16)
+
+
+def test_spill_chain_device_to_host_to_disk(tmp_path):
+    catalog = BufferCatalog()
+    per_batch, b0 = None, None
+    t0, b = make_batch(64, 0)
+    per_batch = b.device_size_bytes
+    device, host, disk = build_store_chain(
+        catalog, device_budget=per_batch * 2 + 10,
+        host_budget=per_batch * 2 + 10, disk_dir=str(tmp_path))
+
+    tables = {}
+    for i in range(5):
+        t, batch = make_batch(64, i)
+        tables[i] = t
+        device.add_batch(BufferId(i), batch, spill_priority=float(i))
+    # budget 2 batches on device, 2 on host, rest on disk
+    assert len(device) == 2 and len(host) == 2 and len(disk) == 1
+    # coldest (lowest priority = oldest ids) spilled furthest
+    buf = catalog.acquire(BufferId(0))
+    assert buf.tier == StorageTier.DISK
+    got = buf.get_batch().to_arrow()
+    assert_tables_equal(tables[0], got)   # round-trip through disk
+    buf.close()
+    buf4 = catalog.acquire(BufferId(4))
+    assert buf4.tier == StorageTier.DEVICE
+    buf4.close()
+
+
+def test_handle_oom_spills(tmp_path):
+    catalog = BufferCatalog()
+    t, b = make_batch(64, 0)
+    size = b.device_size_bytes
+    device, host, disk = build_store_chain(catalog, size * 10, size * 10,
+                                           str(tmp_path))
+    for i in range(3):
+        _, batch = make_batch(64, i)
+        device.add_batch(BufferId(i), batch)
+    spilled = device.handle_oom(size * 2)
+    assert spilled >= size * 2
+    assert len(host) >= 2
+
+
+def test_catalog_acquire_refcount():
+    catalog = BufferCatalog()
+    t, b = make_batch(16, 1)
+    from spark_rapids_tpu.memory.buffer import SpillableBuffer
+    buf = SpillableBuffer.from_batch(BufferId(7), b)
+    catalog.register(buf)
+    acq = catalog.acquire(BufferId(7))
+    assert acq is buf and buf.refcount == 2
+    acq.close()
+    assert buf.refcount == 1
+    assert catalog.acquire(BufferId(99)) is None
+
+
+# ---------------------------------------------------------------- semaphore
+def test_semaphore_limits_and_reentrancy():
+    sem = TpuSemaphore(2)
+    assert sem.acquire_if_necessary(task_id=1)
+    assert sem.acquire_if_necessary(task_id=1)   # re-entrant, no double hold
+    assert sem.active_holders == 1
+    assert sem.acquire_if_necessary(task_id=2)
+    assert not sem.acquire_if_necessary(task_id=3, timeout=0.05)
+    sem.release_if_necessary(task_id=1)
+    assert sem.acquire_if_necessary(task_id=3, timeout=1.0)
+    sem.release_if_necessary(task_id=2)
+    sem.release_if_necessary(task_id=3)
+    assert sem.active_holders == 0
+
+
+def test_semaphore_concurrent_tasks():
+    sem = TpuSemaphore(2)
+    peak = [0]
+    active = [0]
+    lock = threading.Lock()
+
+    def work(tid):
+        with sem.held(task_id=tid):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            import time
+            time.sleep(0.02)
+            with lock:
+                active[0] -= 1
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert peak[0] <= 2
+
+
+def test_buffer_id_range_check():
+    with pytest.raises(ValueError):
+        BufferId(0, 1 << 20)
+    with pytest.raises(ValueError):
+        BufferId(-1, 0)
+
+
+def test_catalog_remove_store_owned(tmp_path):
+    # regression (code review): catalog.remove must route through the owning
+    # store so spill bookkeeping stays consistent
+    catalog = BufferCatalog()
+    t, b = make_batch(32, 0)
+    device, host, disk = build_store_chain(catalog, 1 << 30, 1 << 30,
+                                           str(tmp_path))
+    device.add_batch(BufferId(1), b)
+    assert len(device) == 1
+    catalog.remove(BufferId(1))
+    assert len(device) == 0 and device.used_bytes == 0
+    assert catalog.acquire(BufferId(1)) is None
+
+
+def test_semaphore_shared_task_id_no_permit_leak():
+    # regression (code review): concurrent same-task acquires must not leak
+    sem = TpuSemaphore(2)
+    barrier = threading.Barrier(4)
+
+    def work():
+        barrier.wait()
+        sem.acquire_if_necessary(task_id=5)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads: th.start()
+    for th in threads: th.join()
+    sem.release_if_necessary(task_id=5)
+    assert sem.active_holders == 0
+    # both permits must still be usable
+    assert sem.acquire_if_necessary(task_id=1, timeout=0.1)
+    assert sem.acquire_if_necessary(task_id=2, timeout=0.1)
+    assert not sem.acquire_if_necessary(task_id=3, timeout=0)  # try-acquire
+
+
+def test_host_arena_fragmentation_spills(tmp_path):
+    # regression (code review): fragmented host arena spills to disk, not error
+    catalog = BufferCatalog()
+    t, b = make_batch(64, 0)
+    size = b.device_size_bytes
+    # host arena holds ~2 buffers
+    device, host, disk = build_store_chain(catalog, size, int(size * 2.5),
+                                           str(tmp_path))
+    for i in range(6):
+        _, batch = make_batch(64, i)
+        device.add_batch(BufferId(i), batch)
+    # everything still reachable
+    for i in range(6):
+        buf = catalog.acquire(BufferId(i))
+        assert buf is not None
+        buf.close()
