@@ -1,3 +1,4 @@
 from spark_rapids_tpu.api.column import Column
 from spark_rapids_tpu.api.dataframe import DataFrame, GroupedData, TpuSession
 from spark_rapids_tpu.api import functions
+from spark_rapids_tpu.api.window import Window, WindowSpec

@@ -79,6 +79,12 @@ class Column:
         dt = DType(to) if isinstance(to, str) else to
         return Column(Cast(self.expr, dt))
 
+    # windowing -------------------------------------------------------------
+    def over(self, spec) -> "Column":
+        from spark_rapids_tpu.exprs.windows import WindowExpression
+        return Column(WindowExpression(self.expr, spec._part, spec._orders,
+                                       spec._frame))
+
     # ordering --------------------------------------------------------------
     def asc(self): return Column(SortOrder(self.expr, True, True))
     def asc_nulls_last(self): return Column(SortOrder(self.expr, True, False))

@@ -25,6 +25,42 @@ def _to_expr(c: Union[str, Column]):
     return UnresolvedAttribute(c) if isinstance(c, str) else c.expr
 
 
+def _extract_windows(exprs, child: lp.LogicalPlan):
+    """Pull WindowExpressions out of a projection list into Window nodes
+    beneath it (Catalyst's ExtractWindowExpressions analog). Expressions
+    sharing a (partition, order) spec land in one Window node."""
+    from spark_rapids_tpu.exprs.windows import WindowExpression
+    pulled = []
+    counter = [0]
+    taken = {f.name for f in child.schema()}
+
+    def fresh_name() -> str:
+        while True:
+            name = f"_we{counter[0]}"
+            counter[0] += 1
+            if name not in taken:
+                taken.add(name)
+                return name
+
+    def strip(e):
+        if isinstance(e, WindowExpression):
+            name = fresh_name()
+            pulled.append(Alias(e, name))
+            return UnresolvedAttribute(name)
+        return e.map_children(strip)
+
+    new_exprs = tuple(strip(e) for e in exprs)
+    if not pulled:
+        return exprs, child
+    groups = {}
+    for a in pulled:
+        groups.setdefault(a.c.sort_spec_key(), []).append(a)
+    node = child
+    for aliases in groups.values():
+        node = lp.Window(tuple(aliases), node)
+    return new_exprs, node
+
+
 class DataFrame:
     def __init__(self, logical: lp.LogicalPlan, session: "TpuSession"):
         self._plan = logical
@@ -33,7 +69,8 @@ class DataFrame:
     # ---- transformations -----------------------------------------------------
     def select(self, *cols: Union[str, Column]) -> "DataFrame":
         exprs = tuple(_to_expr(c) for c in cols)
-        return DataFrame(lp.Project(exprs, self._plan), self.session)
+        exprs, child = _extract_windows(exprs, self._plan)
+        return DataFrame(lp.Project(exprs, child), self.session)
 
     def withColumn(self, name: str, c: Column) -> "DataFrame":
         # a replaced column keeps its position (pyspark semantics)
@@ -47,7 +84,8 @@ class DataFrame:
                 exprs.append(UnresolvedAttribute(f.name))
         if not replaced:
             exprs.append(Alias(c.expr, name))
-        return DataFrame(lp.Project(tuple(exprs), self._plan), self.session)
+        out, child = _extract_windows(tuple(exprs), self._plan)
+        return DataFrame(lp.Project(out, child), self.session)
 
     def filter(self, cond: Column) -> "DataFrame":
         return DataFrame(lp.Filter(cond.expr, self._plan), self.session)

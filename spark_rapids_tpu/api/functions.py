@@ -204,3 +204,46 @@ def monotonically_increasing_id() -> Column:
 
 def rand(seed: int = 0) -> Column:
     return Column(Rand(seed))
+
+
+# window functions ---------------------------------------------------------
+def row_number() -> Column:
+    from spark_rapids_tpu.exprs.windows import RowNumber
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from spark_rapids_tpu.exprs.windows import Rank
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from spark_rapids_tpu.exprs.windows import DenseRank
+    return Column(DenseRank())
+
+
+def percent_rank() -> Column:
+    from spark_rapids_tpu.exprs.windows import PercentRank
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from spark_rapids_tpu.exprs.windows import CumeDist
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from spark_rapids_tpu.exprs.windows import NTile
+    return Column(NTile(n))
+
+
+def lead(c: Union[str, Column], offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.exprs.windows import Lead
+    d = None if default is None else Literal.of(default)
+    return Column(Lead(_c(c) if isinstance(c, str) else c.expr, offset, d))
+
+
+def lag(c: Union[str, Column], offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.exprs.windows import Lag
+    d = None if default is None else Literal.of(default)
+    return Column(Lag(_c(c) if isinstance(c, str) else c.expr, offset, d))

@@ -1,0 +1,154 @@
+"""Window physical operators (reference: GpuWindowExec.scala, 202 LoC +
+GpuWindowExpression.scala — cuDF aggregateWindows / aggregateWindowsOverTimeRanges).
+
+One sort by (partition keys, order keys), then every window expression under that
+spec evaluates against a shared FrameCtx: ranking functions read positional
+indices; aggregate functions project their group-by buffers and reduce them over
+per-row frame intervals (prefix sums / RMQ — ops/window.py). The whole thing —
+key eval, sort, frame bounds, reductions — traces into ONE jitted XLA program on
+the TPU path; the CPU engine runs the same kernel eagerly with numpy.
+
+Output rows are in (partition, order) sorted order, matching Spark's WindowExec,
+with the window columns appended after the child columns.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import Field, Schema
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.exprs.aggregates import AggregateFunction
+from spark_rapids_tpu.exprs.core import (ColV, EvalCtx, Expression,
+                                         flatten_colvs, unflatten_colvs)
+from spark_rapids_tpu.exprs.misc import Alias
+from spark_rapids_tpu.exprs.windows import (WindowExpression, WindowFunction)
+from spark_rapids_tpu.ops import batch_kernels as bk
+from spark_rapids_tpu.ops import window as wk
+
+
+def window_output_schema(child_schema: Schema,
+                         wexprs: Tuple[Expression, ...]) -> Schema:
+    fields = list(child_schema.fields)
+    for e in wexprs:
+        w = e.c if isinstance(e, Alias) else e
+        fields.append(Field(e.name_hint, w.dtype(), w.nullable()))
+    return Schema(fields)
+
+
+def evaluate_window(xp, colvs: List[ColV], wexprs: Tuple[Expression, ...],
+                    num_rows, capacity: int, smax: int) -> List[ColV]:
+    """Shared window kernel: child ColVs -> child (sorted) + window ColVs.
+
+    All wexprs must share one (part_keys, orders) sort spec (the exec guarantees
+    this); frames may differ per expression.
+    """
+    first = wexprs[0].c if isinstance(wexprs[0], Alias) else wexprs[0]
+    part_exprs = first.part_keys
+    orders = first.orders
+
+    ctx = EvalCtx(xp, colvs, capacity, smax)
+    alive = bk.alive_mask(xp, capacity, num_rows)
+    part_keys = [e.eval(ctx) for e in part_exprs]
+    order_keys = [(o.child.eval(ctx), o.ascending, o.nulls_first)
+                  for o in orders]
+
+    sort_keys = ([(k, True, True) for k in part_keys]
+                 + [(k, asc, nf) for k, asc, nf in order_keys])
+    if sort_keys:
+        order = bk.sort_indices(xp, sort_keys, alive)
+    else:
+        order = xp.arange(capacity, dtype=np.int32)
+
+    sorted_cols = [bk.take_colv(xp, v, order) for v in colvs]
+    sctx = EvalCtx(xp, sorted_cols, capacity, smax)
+    fr = wk.build_frame_ctx(xp, part_keys, order_keys, order, alive, capacity)
+
+    out = list(sorted_cols)
+    for e in wexprs:
+        w = e.c if isinstance(e, Alias) else e
+        frame = w.resolved_frame()
+        fn = w.fn
+        if isinstance(fn, WindowFunction):
+            out.append(fn.window_eval(sctx, fr))
+        elif isinstance(fn, AggregateFunction):
+            lo, hi, empty = wk.frame_bounds(fr, frame.frame_type, frame.lower,
+                                            frame.upper)
+            bufs = fn.project(sctx)
+            specs = fn.buffer_specs()
+            reduced = [wk.frame_reduce_buffer(fr, b, s.kind, lo, hi, empty,
+                                              s.ignore_nulls)
+                       for b, s in zip(bufs, specs)]
+            res = fn.evaluate(xp, reduced)
+            out.append(res.with_validity(xp.logical_and(res.validity,
+                                                        fr.salive)))
+        else:
+            raise TypeError(f"not a window function: {type(fn).__name__}")
+    return out
+
+
+class CpuWindowExec(PhysicalExec):
+    """Eager numpy window exec (the CPU-Spark stand-in)."""
+
+    def __init__(self, wexprs: Tuple[Expression, ...], child: PhysicalExec):
+        super().__init__((child,), window_output_schema(child.output, wexprs))
+        self.wexprs = wexprs
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        from spark_rapids_tpu.execs.cpu_execs import (_colvs_to_host,
+                                                      _host_colvs,
+                                                      concat_host_batches)
+        batches = list(self.children[0].execute(ctx))
+        batch = concat_host_batches(batches, self.children[0].output)
+        n = batch.num_rows
+        if n == 0:
+            from spark_rapids_tpu.columnar.host import HostBatch
+            yield HostBatch.from_arrow(self.output.to_pa().empty_table())
+            return
+        colvs = _host_colvs(batch)
+        with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+            out = evaluate_window(np, colvs, self.wexprs, n, n,
+                                  ctx.string_max_bytes)
+        hb = _colvs_to_host(self.output, out, n)
+        self.count_output(hb.num_rows)
+        yield hb
+
+
+class TpuWindowExec(PhysicalExec):
+    """Jitted window exec: requires the whole partition in one batch
+    (RequireSingleBatch, like the reference's window exec)."""
+
+    is_device = True
+
+    def __init__(self, wexprs: Tuple[Expression, ...], child: PhysicalExec):
+        super().__init__((child,), window_output_schema(child.output, wexprs))
+        self.wexprs = wexprs
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        from spark_rapids_tpu.execs.tpu_execs import (_cached_jit, _flatten,
+                                                      _to_batch,
+                                                      concat_device_batches)
+        child_schema = self.children[0].output
+        batches = list(self.children[0].execute(ctx))
+        batch = concat_device_batches(batches, child_schema,
+                                      ctx.string_max_bytes)
+        cap = batch.capacity
+        smax = ctx.string_max_bytes
+        key = ("window", self.wexprs, child_schema, cap, smax)
+
+        def build(wexprs=self.wexprs, schema=child_schema, cap=cap, smax=smax):
+            def fn(num_rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                out = evaluate_window(jnp, colvs, wexprs, num_rows, cap, smax)
+                return tuple(flatten_colvs(out))
+            return fn
+
+        fn = _cached_jit(key, build)
+        res = fn(np.int32(batch.num_rows), *_flatten(batch))
+        out = _to_batch(self.output, res, batch.num_rows)
+        self.count_output(out.num_rows)
+        yield out

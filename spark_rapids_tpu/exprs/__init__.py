@@ -35,3 +35,6 @@ from spark_rapids_tpu.exprs.misc import (Alias, KnownFloatingPointNormalized,
                                          MonotonicallyIncreasingID,
                                          NormalizeNaNAndZero, Rand, SortOrder,
                                          SparkPartitionID)
+from spark_rapids_tpu.exprs.windows import (CumeDist, DenseRank, Lag, Lead, NTile,
+                                            PercentRank, Rank, RowNumber,
+                                            WindowExpression, WindowFrame)

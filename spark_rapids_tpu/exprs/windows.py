@@ -1,0 +1,223 @@
+"""Window expressions (reference: GpuWindowExpression.scala, 723 LoC — window
+frames/spec/rownumber; GpuWindowExec.scala).
+
+A ``WindowExpression`` pairs a function (an AggregateFunction reused verbatim, or
+a ranking WindowFunction) with its partition keys, order keys, and frame. The
+window exec sorts once per (partition, order) spec and hands every expression a
+shared FrameCtx (ops/window.py); aggregates reduce their buffers over per-row
+frame intervals with the SAME BufferSpec kinds used by group-by aggregation, so
+Sum/Count/Min/Max/Average/First/Last are windowed for free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+from spark_rapids_tpu.exprs.misc import SortOrder
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    """Frame spec. ``lower``/``upper``: None = unbounded; ROWS: int row offset
+    (negative = preceding); RANGE: numeric offset on the single order key, with
+    0 = CURRENT ROW (peer-inclusive)."""
+    frame_type: str = "range"  # "rows" | "range"
+    lower: Optional[Union[int, float]] = None
+    upper: Optional[Union[int, float]] = 0
+
+
+class WindowFunction(Expression):
+    """Ranking-style function computed from frame/peer/partition positions."""
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        raise NotImplementedError(type(self).__name__)
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        raise TypeError(f"{type(self).__name__} must be evaluated by a window exec")
+
+
+@dataclass(frozen=True)
+class WindowExpression(Expression):
+    """function OVER (PARTITION BY part_keys ORDER BY orders frame)."""
+    fn: Expression  # AggregateFunction or WindowFunction
+    part_keys: Tuple[Expression, ...] = ()
+    orders: Tuple[SortOrder, ...] = ()
+    frame: Optional[WindowFrame] = None
+
+    def resolved_frame(self) -> WindowFrame:
+        if self.frame is not None:
+            return self.frame
+        if self.orders:
+            # SQL default with ORDER BY: RANGE UNBOUNDED PRECEDING..CURRENT ROW
+            return WindowFrame("range", None, 0)
+        return WindowFrame("rows", None, None)
+
+    def dtype(self) -> DType:
+        return self.fn.dtype()
+
+    def nullable(self) -> bool:
+        return self.fn.nullable()
+
+    @property
+    def name_hint(self) -> str:
+        return self.fn.name_hint
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        raise TypeError("WindowExpression must be evaluated by a window exec")
+
+    def sort_spec_key(self):
+        """Window expressions sharing this key can share one sort + FrameCtx."""
+        return (self.part_keys, self.orders)
+
+
+# ------------------------------------------------------------------ ranking fns
+@dataclass(frozen=True)
+class RowNumber(WindowFunction):
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        data = (fr.idx - fr.seg_first + 1).astype(np.int32)
+        return ColV(DType.INT, data, fr.salive)
+
+
+@dataclass(frozen=True)
+class Rank(WindowFunction):
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        data = (fr.peer_first - fr.seg_first + 1).astype(np.int32)
+        return ColV(DType.INT, data, fr.salive)
+
+
+@dataclass(frozen=True)
+class DenseRank(WindowFunction):
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        xp = ctx.xp
+        # count of peer-group starts in (seg_first, idx]
+        starts = (fr.peer_first == fr.idx).astype(np.int64)
+        c = xp.cumsum(starts)
+        data = (c - c[xp.clip(fr.seg_first, 0, fr.capacity - 1)] + 1)
+        return ColV(DType.INT, data.astype(np.int32), fr.salive)
+
+
+@dataclass(frozen=True)
+class PercentRank(WindowFunction):
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        xp = ctx.xp
+        rank = (fr.peer_first - fr.seg_first).astype(np.float64)
+        denom = xp.maximum(fr.seg_size - 1, 1).astype(np.float64)
+        data = xp.where(fr.seg_size > 1, rank / denom, np.float64(0.0))
+        return ColV(DType.DOUBLE, data, fr.salive)
+
+
+@dataclass(frozen=True)
+class CumeDist(WindowFunction):
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        xp = ctx.xp
+        n = (fr.peer_last - fr.seg_first + 1).astype(np.float64)
+        denom = xp.maximum(fr.seg_size, 1).astype(np.float64)
+        return ColV(DType.DOUBLE, n / denom, fr.salive)
+
+
+@dataclass(frozen=True)
+class NTile(WindowFunction):
+    n: int = 1
+
+    def __post_init__(self):
+        if self.n < 1:
+            raise ValueError(f"ntile() parameter n must be positive, got {self.n}")
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return False
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        xp = ctx.xp
+        # Spark NTile: first (rows % n) buckets get (rows/n + 1) rows each
+        i0 = fr.idx - fr.seg_first
+        rows = xp.maximum(fr.seg_size, 1)
+        n = np.int64(self.n)
+        base = rows // n
+        rem = rows % n
+        big = rem * (base + 1)
+        in_big = i0 < big
+        bucket_big = i0 // xp.maximum(base + 1, 1)
+        bucket_small = rem + (i0 - big) // xp.maximum(base, 1)
+        data = xp.where(in_big, bucket_big, bucket_small) + 1
+        return ColV(DType.INT, data.astype(np.int32), fr.salive)
+
+
+class _LeadLag(WindowFunction):
+    sign = 0
+
+    def dtype(self) -> DType:
+        return self.c.dtype()
+
+    def window_eval(self, ctx: EvalCtx, fr) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)  # ctx columns are already in sorted order
+        j = fr.idx + self.sign * int(self.offset)
+        in_part = xp.logical_and(j >= fr.seg_first, j <= fr.seg_last)
+        jc = xp.clip(j, 0, fr.capacity - 1)
+        from spark_rapids_tpu.exprs.literals import Literal
+        default = self.default if self.default is not None else Literal(
+            None, DType.NULL)
+        d = default.eval(ctx)
+        from spark_rapids_tpu.exprs.core import widen
+        d = widen(ctx, d, v.dtype)
+        data = xp.where(in_part[..., None] if v.dtype is DType.STRING
+                        else in_part, v.data[jc], d.data)
+        valid = xp.where(in_part, v.validity[jc], d.validity)
+        valid = xp.logical_and(valid, fr.salive)
+        if v.dtype is DType.STRING:
+            lengths = xp.where(in_part, v.lengths[jc], d.lengths)
+            return ColV(v.dtype, data, valid, lengths)
+        return ColV(v.dtype, data, valid)
+
+
+@dataclass(frozen=True)
+class Lead(_LeadLag):
+    c: Expression = None  # type: ignore[assignment]
+    offset: int = 1
+    default: Optional[Expression] = None
+    sign = 1
+
+
+@dataclass(frozen=True)
+class Lag(_LeadLag):
+    c: Expression = None  # type: ignore[assignment]
+    offset: int = 1
+    default: Optional[Expression] = None
+    sign = -1

@@ -20,6 +20,7 @@ class TpuSemaphore:
         self.max_concurrent = max_concurrent
         self._cond = threading.Condition()
         self._holders: Set[int] = set()
+        self._nesting: Dict[int, int] = {}
 
     def _task_id(self, task_id: Optional[int]) -> int:
         return task_id if task_id is not None else threading.get_ident()
@@ -47,20 +48,39 @@ class TpuSemaphore:
         with self._cond:
             if tid in self._holders:
                 self._holders.remove(tid)
+                self._nesting.pop(tid, None)
                 self._cond.notify_all()
 
     @contextmanager
     def held(self, task_id: Optional[int] = None):
+        """Scoped hold with per-task nesting: threads sharing a task id each
+        enter/exit; the permit releases only when the LAST one exits (the
+        check-then-act race of a naive snapshot would release mid-work)."""
         tid = self._task_id(task_id)
         with self._cond:
-            already = tid in self._holders
-        if not already:
-            self.acquire_if_necessary(task_id)
+            if tid in self._holders:
+                self._nesting[tid] = self._nesting.get(tid, 1) + 1
+            else:
+                self._cond.wait_for(
+                    lambda: tid in self._holders
+                    or len(self._holders) < self.max_concurrent)
+                if tid in self._holders:
+                    self._nesting[tid] = self._nesting.get(tid, 1) + 1
+                else:
+                    self._holders.add(tid)
+                    self._nesting[tid] = 1
         try:
             yield
         finally:
-            if not already:
-                self.release_if_necessary(task_id)
+            with self._cond:
+                n = self._nesting.get(tid, 0) - 1
+                if n <= 0:
+                    self._nesting.pop(tid, None)
+                    if tid in self._holders:
+                        self._holders.remove(tid)
+                        self._cond.notify_all()
+                else:
+                    self._nesting[tid] = n
 
     @property
     def active_holders(self) -> int:

@@ -136,16 +136,25 @@ class BufferStore:
     def _spill_one(self, buf: SpillableBuffer) -> None:
         if self.spill_store is None:
             # last tier: dropping data would lose it; keep and give up
-            with self._lock:
-                self._buffers[buf.id.key] = buf
-                self._spill_queue.offer(buf.id.key, buf.spill_priority)
-                self._used += buf.size_bytes
+            self._readmit(buf)
             raise MemoryError(
                 f"store tier {self.tier.name} over budget with no spill store")
-        moved = self._move_down(buf)
-        self.spill_store.add_buffer(moved)
+        try:
+            moved = self._move_down(buf)
+            self.spill_store.add_buffer(moved)
+        except Exception:
+            # failed mid-move (e.g. disk full): the victim must stay tracked
+            # here or its backing storage (host arena block) leaks
+            self._readmit(buf)
+            raise
         self.catalog.unregister(buf)
         buf.close()
+
+    def _readmit(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            self._buffers[buf.id.key] = buf
+            self._spill_queue.offer(buf.id.key, buf.spill_priority)
+            self._used += buf.size_bytes
 
     def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
         raise NotImplementedError

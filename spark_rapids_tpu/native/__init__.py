@@ -107,8 +107,42 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 NULL_OFFSET = 2 ** 64 - 1
 
+_build_failed = False
 
-class AddressSpaceAllocator:
+
+def try_get_lib() -> Optional[ctypes.CDLL]:
+    """get_lib that degrades to None when the toolchain is unavailable, so a
+    missing g++ costs the native fast path, not the whole engine."""
+    global _build_failed
+    if _build_failed:
+        return None
+    try:
+        return get_lib()
+    except Exception as e:  # noqa: BLE001 - any build/load failure degrades
+        _build_failed = True
+        import logging
+        logging.getLogger(__name__).warning(
+            "native runtime unavailable (%s); using Python fallbacks", e)
+        return None
+
+
+def AddressSpaceAllocator(size: int):
+    """First-fit sub-allocator over an abstract address space. C++ backed when
+    the toolchain is present; pure-Python fallback otherwise."""
+    if try_get_lib() is not None:
+        return _NativeAddressSpaceAllocator(size)
+    return PyAddressSpaceAllocator(size)
+
+
+def HashedPriorityQueue():
+    """Min-heap with O(1) contains and keyed updates (spill ordering). C++
+    backed when available; pure-Python fallback otherwise."""
+    if try_get_lib() is not None:
+        return _NativeHashedPriorityQueue()
+    return PyHashedPriorityQueue()
+
+
+class _NativeAddressSpaceAllocator:
     """First-fit sub-allocator over an abstract address space (C++ backed)."""
 
     def __init__(self, size: int):
@@ -152,7 +186,7 @@ class AddressSpaceAllocator:
             pass
 
 
-class HashedPriorityQueue:
+class _NativeHashedPriorityQueue:
     """Min-heap with O(1) contains and keyed priority updates (C++ backed).
     Lowest priority polls first — the spill order."""
 
@@ -200,3 +234,121 @@ class HashedPriorityQueue:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------- pure-Python
+class PyAddressSpaceAllocator:
+    """Fallback first-fit allocator with block coalescing (same semantics as
+    the C++ implementation; used when no toolchain is available)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._free = [(0, size)] if size > 0 else []  # sorted (offset, length)
+        self._allocated = {}  # offset -> length
+
+    def allocate(self, length: int):
+        if length <= 0:
+            return None
+        for i, (off, flen) in enumerate(self._free):
+            if flen >= length:
+                if flen == length:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + length, flen - length)
+                self._allocated[off] = length
+                return off
+        return None
+
+    def free(self, offset: int) -> int:
+        length = self._allocated.pop(offset, None)
+        if length is None:
+            return 0
+        import bisect
+        i = bisect.bisect_left(self._free, (offset, 0))
+        self._free.insert(i, (offset, length))
+        # coalesce with neighbors
+        if i + 1 < len(self._free):
+            off, flen = self._free[i]
+            noff, nlen = self._free[i + 1]
+            if off + flen == noff:
+                self._free[i] = (off, flen + nlen)
+                self._free.pop(i + 1)
+        if i > 0:
+            poff, plen = self._free[i - 1]
+            off, flen = self._free[i]
+            if poff + plen == off:
+                self._free[i - 1] = (poff, plen + flen)
+                self._free.pop(i)
+        return length
+
+    @property
+    def available(self) -> int:
+        return sum(l for _, l in self._free)
+
+    def allocated_size(self, offset: int) -> int:
+        return self._allocated.get(offset, 0)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def largest_free_block(self) -> int:
+        return max((l for _, l in self._free), default=0)
+
+    def close(self) -> None:
+        self._free = []
+        self._allocated = {}
+
+
+class PyHashedPriorityQueue:
+    """Fallback keyed min-heap: heapq with lazy deletion + live-entry map."""
+
+    def __init__(self):
+        import heapq
+        self._heapq = heapq
+        self._heap = []  # (priority, seq, key)
+        self._live = {}  # key -> (priority, seq)
+        self._seq = 0
+
+    def offer(self, key: int, priority: float) -> bool:
+        self._seq += 1
+        self._live[key] = (priority, self._seq)
+        self._heapq.heappush(self._heap, (priority, self._seq, key))
+        return True
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._live
+
+    def _prune(self):
+        while self._heap:
+            prio, seq, key = self._heap[0]
+            if self._live.get(key) == (prio, seq):
+                return self._heap[0]
+            self._heapq.heappop(self._heap)
+        return None
+
+    def poll(self):
+        top = self._prune()
+        if top is None:
+            return None
+        prio, seq, key = self._heapq.heappop(self._heap)
+        del self._live[key]
+        return key, prio
+
+    def peek(self):
+        top = self._prune()
+        if top is None:
+            return None
+        prio, _seq, key = top
+        return key, prio
+
+    def remove(self, key: int) -> bool:
+        return self._live.pop(key, None) is not None
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def close(self) -> None:
+        self._heap = []
+        self._live = {}

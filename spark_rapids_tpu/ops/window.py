@@ -1,0 +1,339 @@
+"""Window-function kernels (reference: GpuWindowExec.scala + GpuWindowExpression.scala,
+925 LoC — cuDF ``groupBy.aggregateWindows`` for row frames and
+``aggregateWindowsOverTimeRanges`` for range frames).
+
+TPU re-design: one sort by (partition keys, order keys) turns every window frame
+into an index interval [lo, hi] in the sorted domain, computed with shape-static
+vectorized machinery:
+
+- ROWS frames: interval arithmetic on the row index clipped to partition bounds;
+- RANGE frames: vectorized binary search over the (direction-normalized) order
+  values, restricted to the partition — O(n log n), no data-dependent shapes;
+- running / whole-partition frames fall out as special intervals.
+
+Frame aggregation then rides two primitives that XLA fuses well:
+- invertible reductions (sum / count / average buffers): exclusive prefix sums,
+  frame result = p[hi+1] - p[lo];
+- min / max: a sparse-table RMQ (log2(n) doubling levels, two gathers per query);
+  strings reduce via a rank-then-RMQ trick reusing the byte-wise sort;
+- first / last / lead / lag / ranking functions: plain gathers on the interval
+  endpoints and partition/peer boundary indices.
+
+Everything is generic over ``xp`` (numpy eager for the CPU engine, jax.numpy
+traced for the TPU exec) and jit-fuses into the enclosing window exec program.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV
+from spark_rapids_tpu.ops import batch_kernels as bk
+
+
+def _cummax(xp, a):
+    if xp is np:
+        return np.maximum.accumulate(a)
+    import jax.lax as lax
+    return lax.cummax(a)
+
+
+def _exclusive_prefix_sum(xp, a):
+    """p of length n+1 with p[0]=0, p[i] = sum(a[:i])."""
+    c = xp.cumsum(a)
+    zero = xp.zeros((1,), dtype=c.dtype)
+    return xp.concatenate([zero, c])
+
+
+def _bsearch(xp, vals, target, lo0, hi0, side: str):
+    """Vectorized binary search per row over a shared sorted array.
+
+    Returns the first index j in [lo0, hi0) with vals[j] >= target (side='left')
+    or vals[j] > target (side='right'); hi0 when no such j. All of target/lo0/hi0
+    are per-row arrays; iteration count is static (log2 capacity).
+    """
+    cap = vals.shape[0]
+    lo = lo0.astype(np.int64)
+    hi = hi0.astype(np.int64)
+    iters = max(1, int(math.ceil(math.log2(max(cap, 2)))) + 1)
+    for _ in range(iters):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        v = vals[xp.clip(mid, 0, cap - 1)]
+        pred = (v < target) if side == "left" else (v <= target)
+        go_right = xp.logical_and(active, pred)
+        go_left = xp.logical_and(active, xp.logical_not(pred))
+        lo = xp.where(go_right, mid + 1, lo)
+        hi = xp.where(go_left, mid, hi)
+    return lo
+
+
+# ----------------------------------------------------------------- RMQ tables
+def _rmq_table(xp, data, neutral, kind: str):
+    """Sparse-table for range min/max: level k covers spans of 2^k rows.
+
+    Returns a (levels, cap) stacked array; queries gather two spans.
+    """
+    cap = data.shape[0]
+    levels = max(1, int(math.ceil(math.log2(max(cap, 2)))) + 1)
+    pick = xp.minimum if kind == "min" else xp.maximum
+    t = data
+    tables = [t]
+    for k in range(1, levels):
+        w = 1 << (k - 1)
+        if w >= cap:
+            tables.append(t)
+            continue
+        pad = xp.full((w,), neutral, dtype=data.dtype)
+        shifted = xp.concatenate([t[w:], pad])
+        t = pick(t, shifted)
+        tables.append(t)
+    return xp.stack(tables)
+
+
+def rmq_reduce(xp, data, neutral, kind: str, lo, hi, empty):
+    """Range min/max over inclusive [lo, hi] per row; neutral where empty."""
+    table = _rmq_table(xp, data, neutral, kind)
+    cap = data.shape[0]
+    length = xp.maximum(hi - lo + 1, 1)
+    k = xp.floor(xp.log2(length.astype(np.float64))).astype(np.int64)
+    k = xp.clip(k, 0, table.shape[0] - 1)
+    span = (np.int64(1) << k)
+    a = table[k, xp.clip(lo, 0, cap - 1)]
+    b = table[k, xp.clip(hi - span + 1, 0, cap - 1)]
+    pick = xp.minimum if kind == "min" else xp.maximum
+    res = pick(a, b)
+    return xp.where(empty, xp.asarray(neutral, dtype=data.dtype), res)
+
+
+# ----------------------------------------------------------------- frame context
+@dataclass
+class FrameCtx:
+    """Per-row positional context in the sorted domain, shared by every window
+    function evaluated under one (partition, order) spec."""
+    xp: Any
+    capacity: int
+    idx: Any          # row index in sorted domain
+    salive: Any       # liveness (sorted)
+    seg_first: Any    # first row index of the row's partition
+    seg_last: Any     # last row index of the row's partition
+    seg_size: Any
+    peer_first: Any   # first row of the row's peer group (same order values)
+    peer_last: Any
+    order_vals: Optional[Any]  # direction-normalized float64 order values (1 key)
+    n_order_keys: int
+
+
+def build_frame_ctx(xp, part_keys: Sequence[ColV], order_keys, order, alive,
+                    capacity: int) -> FrameCtx:
+    """order_keys: list of (ColV, ascending) already permuted? NO — raw columns;
+    ``order`` is the sort permutation. Everything returned lives in the sorted
+    domain."""
+    idx = xp.arange(capacity, dtype=np.int64)
+    salive = alive[order]
+
+    part_starts = bk.rows_equal_adjacent(xp, part_keys, order, alive)
+    gids = xp.clip(xp.cumsum(part_starts.astype(np.int32)) - 1, 0, capacity - 1)
+    seg_first = _cummax(xp, xp.where(part_starts, idx, np.int64(0)))
+    sizes = _seg_sum(xp, salive.astype(np.int64), gids, capacity)
+    seg_size = sizes[gids]
+    seg_last = seg_first + xp.maximum(seg_size, 1) - 1
+
+    all_keys = list(part_keys) + [k for k, _, _ in order_keys]
+    if order_keys:
+        peer_starts = bk.rows_equal_adjacent(xp, all_keys, order, alive)
+        peer_first = _cummax(xp, xp.where(peer_starts, idx, np.int64(0)))
+        pgids = xp.clip(xp.cumsum(peer_starts.astype(np.int32)) - 1, 0,
+                        capacity - 1)
+        psizes = _seg_sum(xp, salive.astype(np.int64), pgids, capacity)
+        peer_last = peer_first + xp.maximum(psizes[pgids], 1) - 1
+    else:
+        peer_first, peer_last = seg_first, seg_last
+
+    order_vals = None
+    if len(order_keys) == 1:
+        v, asc, _nf = order_keys[0]
+        sv = bk.take_colv(xp, v, order)
+        if sv.dtype.is_numeric or sv.dtype in (DType.DATE, DType.TIMESTAMP):
+            w = sv.data.astype(np.float64)
+            if sv.dtype.is_floating:
+                w = xp.where(xp.isnan(w), np.float64(np.inf), w)
+            if not asc:
+                w = -w
+            # null rows take -inf/(+inf) so they form their own closed frame
+            # group at the null end of the partition
+            null_key = np.float64(-np.inf) if _nf else np.float64(np.inf)
+            w = xp.where(sv.validity, w, null_key)
+            order_vals = w
+
+    return FrameCtx(xp, capacity, idx, salive, seg_first, seg_last, seg_size,
+                    peer_first, peer_last, order_vals, len(order_keys))
+
+
+def _seg_sum(xp, data, seg_ids, num_segments: int):
+    if xp is np:
+        out = np.zeros(num_segments, dtype=data.dtype)
+        np.add.at(out, seg_ids, data)
+        return out
+    import jax
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def frame_bounds(fr: FrameCtx, frame_type: str, lower, upper):
+    """Per-row inclusive [lo, hi] interval + empty mask for one frame spec.
+
+    lower/upper: None = unbounded; for ROWS an int offset (negative preceding,
+    0 current row); for RANGE a numeric offset on the single order key, with 0
+    meaning CURRENT ROW (peer-inclusive both directions, per SQL semantics).
+    """
+    xp = fr.xp
+    if frame_type == "rows":
+        lo = fr.seg_first if lower is None else xp.maximum(
+            fr.idx + int(lower), fr.seg_first)
+        hi = fr.seg_last if upper is None else xp.minimum(
+            fr.idx + int(upper), fr.seg_last)
+    else:  # range
+        has_offset = any(b is not None and b != 0 for b in (lower, upper))
+        if has_offset and fr.order_vals is None:
+            # Spark's analyzer restriction (mirrored so the CPU engine gives
+            # the same error the TPU tagger predicts)
+            raise ValueError(
+                "RANGE window frame with offsets requires exactly one "
+                "numeric/date/timestamp ORDER BY key")
+        if lower is None:
+            lo = fr.seg_first
+        elif lower == 0:
+            lo = fr.peer_first
+        else:
+            target = fr.order_vals + np.float64(lower)
+            lo = _bsearch(xp, fr.order_vals, target, fr.seg_first,
+                          fr.seg_last + 1, "left")
+        if upper is None:
+            hi = fr.seg_last
+        elif upper == 0:
+            hi = fr.peer_last
+        else:
+            target = fr.order_vals + np.float64(upper)
+            hi = _bsearch(xp, fr.order_vals, target, fr.seg_first,
+                          fr.seg_last + 1, "right") - 1
+    empty = xp.logical_or(lo > hi, xp.logical_not(fr.salive))
+    return lo, hi, empty
+
+
+# ----------------------------------------------------------------- frame reduce
+def frame_reduce_buffer(fr: FrameCtx, buf: ColV, kind: str, lo, hi, empty,
+                        ignore_nulls: bool = False) -> ColV:
+    """Reduce one aggregation buffer over each row's frame interval.
+
+    Mirrors the segment reduction kinds of exprs/aggregates.py (sum, min, max,
+    first, last) so AggregateFunction.project/evaluate are reused verbatim for
+    windowed aggregation.
+    """
+    xp = fr.xp
+    participating = xp.logical_and(buf.validity, fr.salive)
+    pcount = _exclusive_prefix_sum(xp, participating.astype(np.int64))
+    lo_c = xp.where(empty, np.int64(0), lo)
+    hi1 = xp.where(empty, np.int64(0), hi + 1)
+    n_valid = pcount[hi1] - pcount[lo_c]
+    any_valid = n_valid > 0
+
+    if kind == "sum":
+        contrib = xp.where(participating, buf.data,
+                           xp.zeros((), dtype=buf.data.dtype))
+        p = _exclusive_prefix_sum(xp, contrib)
+        return ColV(buf.dtype, p[hi1] - p[lo_c], any_valid)
+
+    if kind in ("min", "max"):
+        if buf.dtype is DType.STRING:
+            return _frame_minmax_string(fr, buf, kind, lo, hi, empty, any_valid)
+        return _frame_minmax(fr, buf, kind, lo, hi, empty, participating,
+                             pcount, any_valid)
+
+    if kind in ("first", "last"):
+        if not ignore_nulls:
+            pos = xp.clip(lo if kind == "first" else hi, 0, fr.capacity - 1)
+            valid = xp.logical_and(xp.logical_not(empty),
+                                   xp.logical_and(buf.validity[pos],
+                                                  fr.salive[pos]))
+        else:
+            cnt_incl = xp.cumsum(participating.astype(np.int64))
+            if kind == "first":
+                # first j in frame with cum count exceeding the count before lo
+                target = pcount[lo_c]
+                pos = _bsearch(xp, cnt_incl, target, lo_c, hi1, "right")
+            else:
+                # position where cum count first reaches the frame-end count
+                target = pcount[hi1]
+                pos = _bsearch(xp, cnt_incl, target - 1, lo_c, hi1, "right")
+            pos = xp.clip(pos, 0, fr.capacity - 1)
+            valid = xp.logical_and(any_valid, xp.logical_not(empty))
+        if buf.dtype is DType.STRING:
+            return ColV(buf.dtype, buf.data[pos], valid, buf.lengths[pos])
+        return ColV(buf.dtype, buf.data[pos], valid)
+
+    raise ValueError(kind)
+
+
+def _frame_minmax(fr: FrameCtx, buf: ColV, kind: str, lo, hi, empty,
+                  participating, pcount, any_valid) -> ColV:
+    xp = fr.xp
+    from spark_rapids_tpu.exprs.aggregates import _reduce_neutral
+    dt = buf.dtype
+    if dt is DType.BOOLEAN:
+        data = buf.data.astype(np.int8)
+        neutral = np.int8(1 if kind == "min" else 0)
+        contrib = xp.where(participating, data, neutral)
+        res = rmq_reduce(xp, contrib, neutral, kind, lo, hi, empty)
+        return ColV(dt, res.astype(np.bool_), any_valid)
+    if dt.is_floating:
+        d = buf.data
+        nan = xp.logical_and(xp.isnan(d), participating)
+        dd = xp.where(nan, xp.asarray(np.inf, dtype=d.dtype), d)
+        neutral = np.asarray(np.inf if kind == "min" else -np.inf,
+                             dtype=np.dtype(d.dtype))
+        contrib = xp.where(participating, dd, neutral)
+        res = rmq_reduce(xp, contrib, neutral, kind, lo, hi, empty)
+        ncount = _exclusive_prefix_sum(xp, nan.astype(np.int64))
+        lo_c = xp.where(empty, np.int64(0), lo)
+        hi1 = xp.where(empty, np.int64(0), hi + 1)
+        n_nan = ncount[hi1] - ncount[lo_c]
+        vcount = pcount[hi1] - pcount[lo_c]
+        if kind == "max":
+            # Spark: NaN is the largest value — any NaN in frame wins the max
+            res = xp.where(n_nan > 0, xp.asarray(np.nan, dtype=d.dtype), res)
+        else:
+            # min is NaN only when every valid value was NaN
+            res = xp.where(xp.logical_and(vcount > 0, n_nan == vcount),
+                           xp.asarray(np.nan, dtype=d.dtype), res)
+        return ColV(dt, res, any_valid)
+    neutral = _reduce_neutral(kind, dt)
+    contrib = xp.where(participating, buf.data, neutral)
+    res = rmq_reduce(xp, contrib, neutral, kind, lo, hi, empty)
+    return ColV(dt, res, any_valid)
+
+
+def _frame_minmax_string(fr: FrameCtx, buf: ColV, kind: str, lo, hi, empty,
+                         any_valid) -> ColV:
+    """Range min/max on strings: byte-order rank once via the shared sort, then
+    integer RMQ over ranks, then gather (the windowed twin of
+    ops/aggregate._segment_minmax_string)."""
+    xp = fr.xp
+    participating = xp.logical_and(buf.validity, fr.salive)
+    order2 = bk.sort_indices(xp, [(buf, True, True)], participating)
+    rank = bk._stable_argsort(xp, order2).astype(np.int64)
+    n = fr.capacity
+    if kind == "min":
+        key = xp.where(participating, rank, np.int64(n + 1))
+        res = rmq_reduce(xp, key, np.int64(n + 1), "min", lo, hi, empty)
+        has = res <= n
+    else:
+        key = xp.where(participating, rank, np.int64(-1))
+        res = rmq_reduce(xp, key, np.int64(-1), "max", lo, hi, empty)
+        has = res >= 0
+    pick = order2[xp.clip(res, 0, n - 1)]
+    valid = xp.logical_and(xp.logical_and(has, any_valid), buf.validity[pick])
+    return ColV(buf.dtype, buf.data[pick], valid, buf.lengths[pick])

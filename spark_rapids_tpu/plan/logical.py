@@ -187,6 +187,27 @@ class Join(LogicalPlan):
 
 
 @dataclass
+class Window(LogicalPlan):
+    """Window computation: child columns ++ one window column per expression.
+    All wexprs share one (partition, order) sort spec (the API groups them)."""
+    wexprs: Tuple[Expression, ...]   # Alias(WindowExpression) entries
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exprs.core import bind_expression
+        cs = self.child.schema()
+        fields = list(cs.fields)
+        for e in self.wexprs:
+            b = bind_expression(e, cs)
+            fields.append(Field(e.name_hint, b.dtype(), b.nullable()))
+        return Schema(fields)
+
+
+@dataclass
 class Repartition(LogicalPlan):
     num_partitions: int
     child: LogicalPlan

@@ -24,7 +24,8 @@ from spark_rapids_tpu.execs.base import PhysicalExec
 from spark_rapids_tpu.exprs import (aggregates as agg, arithmetic as ar, bitwise as bw,
                                     cast as ca, conditional as cond, datetime as dtm,
                                     literals as li, math as ma, misc as mi,
-                                    nulls as nu, predicates as pr, strings as st)
+                                    nulls as nu, predicates as pr, strings as st,
+                                    windows as wn)
 from spark_rapids_tpu.exprs.core import BoundReference, Expression
 from spark_rapids_tpu.plan.meta import ExecMeta, ExprMeta
 
@@ -109,6 +110,26 @@ def _tag_float_agg(meta: ExprMeta) -> None:
             f"spark.rapids.tpu.sql.variableFloatAgg.enabled")
 
 
+def _tag_window_expr(meta: ExprMeta) -> None:
+    """GpuWindowExpression tagging analog: range frames with numeric offsets
+    need exactly one orderable numeric/date/timestamp order key."""
+    e: wn.WindowExpression = meta.expr
+    frame = e.resolved_frame()
+    bounded = [b for b in (frame.lower, frame.upper) if b is not None and b != 0]
+    if frame.frame_type == "range" and bounded:
+        if len(e.orders) != 1:
+            meta.will_not_work("RANGE frames with offsets require exactly one "
+                               "ORDER BY key")
+            return
+        try:
+            dt = e.orders[0].child.dtype()
+        except TypeError:
+            return
+        if not (dt.is_numeric or dt in (DType.DATE, DType.TIMESTAMP)):
+            meta.will_not_work(f"RANGE frame offsets over {dt.value} order key "
+                               f"are not supported on TPU")
+
+
 _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(li.Literal, "literal value"),
     ExprRule(BoundReference, "column reference"),
@@ -186,6 +207,14 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(dtm.Second, "second"), ExprRule(dtm.DateAdd, "date plus days"),
     ExprRule(dtm.DateSub, "date minus days"), ExprRule(dtm.DateDiff, "day difference"),
     ExprRule(dtm.LastDay, "last day of month"),
+    # window
+    ExprRule(wn.WindowExpression, "window expression", tag=_tag_window_expr),
+    ExprRule(wn.RowNumber, "row number"), ExprRule(wn.Rank, "rank"),
+    ExprRule(wn.DenseRank, "dense rank"),
+    ExprRule(wn.PercentRank, "percent rank"),
+    ExprRule(wn.CumeDist, "cumulative distribution"),
+    ExprRule(wn.NTile, "ntile bucketing"),
+    ExprRule(wn.Lead, "lead"), ExprRule(wn.Lag, "lag"),
     # aggregates
     ExprRule(agg.Count, "count"),
     ExprRule(agg.Sum, "sum", tag=_tag_float_agg),
@@ -319,7 +348,19 @@ def _make_join_rules() -> List[ExecRule]:
                      tag=_tag_join)]
 
 
-_EXEC_RULE_LIST: List[ExecRule] = _make_scan_rules() + _make_join_rules() + [
+def _convert_window(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.window_execs import TpuWindowExec
+    return TpuWindowExec(meta.exec.wexprs, children[0])
+
+
+def _make_window_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.execs.window_execs import CpuWindowExec
+    return [ExecRule(CpuWindowExec, "window functions", _convert_window,
+                     exprs_of=lambda e: e.wexprs)]
+
+
+_EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_join_rules()
+                                   + _make_window_rules()) + [
     ExecRule(ce.CpuProjectExec, "column projection", _convert_project,
              exprs_of=lambda e: e.exprs),
     ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,

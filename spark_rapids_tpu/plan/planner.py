@@ -54,6 +54,12 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
             SortOrder(bind_expression(o.child, child.output), o.ascending,
                       o.nulls_first) for o in plan.orders)
         return ce.CpuSortExec(orders, child)
+    if isinstance(plan, lp.Window):
+        from spark_rapids_tpu.execs.window_execs import CpuWindowExec
+        child = plan_physical(plan.child, conf)
+        bound = tuple(_named(bind_expression(e, child.output), e)
+                      for e in plan.wexprs)
+        return CpuWindowExec(bound, child)
     if isinstance(plan, lp.Limit):
         return ce.CpuLimitExec(plan.n, plan_physical(plan.child, conf))
     if isinstance(plan, lp.Union):

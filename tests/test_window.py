@@ -1,0 +1,314 @@
+"""Window function tests (reference: WindowFunctionSuite.scala +
+integration_tests window_function_test.py).
+
+Golden-answer tests pin Spark window semantics (both engines share the kernel in
+ops/window.py, so CPU-vs-TPU parity alone cannot catch a shared semantics bug);
+parity tests then confirm the jitted XLA path matches the eager numpy path, and
+plan assertions confirm the window actually ran on the device engine.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, Window
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+
+
+def sess(enabled=True):
+    return TpuSession({**CONF,
+                       "spark.rapids.tpu.sql.enabled": str(enabled).lower()})
+
+
+def sales_table():
+    return pa.table({
+        "dept": ["a", "a", "a", "b", "b", "b", "b", "c"],
+        "emp": ["e1", "e2", "e3", "e4", "e5", "e6", "e7", "e8"],
+        "salary": pa.array([100, 200, 200, 50, 75, None, 25, 300],
+                           type=pa.int64()),
+    })
+
+
+def by_emp(rows, table):
+    """Index collected rows by the emp column for order-independent asserts."""
+    emps = table.column("emp").to_pylist()
+    return dict(zip(emps, rows))
+
+
+# ----------------------------------------------------------------- golden tests
+def test_row_number_rank_dense_rank_golden():
+    t = sales_table()
+    w = Window.partitionBy("dept").orderBy("salary")
+    df = sess().create_dataframe(t).select(
+        "dept", "emp", "salary",
+        F.row_number().over(w).alias("rn"),
+        F.rank().over(w).alias("rk"),
+        F.dense_rank().over(w).alias("dr"))
+    out = df.collect()
+    rows = {e: (rn, rk, dr) for e, rn, rk, dr in zip(
+        out.column("emp").to_pylist(), out.column("rn").to_pylist(),
+        out.column("rk").to_pylist(), out.column("dr").to_pylist())}
+    # dept a salaries ordered: 100, 200, 200 (tie)
+    assert rows["e1"] == (1, 1, 1)
+    assert rows["e2"] == (2, 2, 2)
+    assert rows["e3"] == (3, 2, 2)
+    # dept b: null sorts FIRST (Spark asc nulls first): e6(None), e7(25), e4(50), e5(75)
+    assert rows["e6"] == (1, 1, 1)
+    assert rows["e7"] == (2, 2, 2)
+    assert rows["e4"] == (3, 3, 3)
+    assert rows["e5"] == (4, 4, 4)
+    assert rows["e8"] == (1, 1, 1)
+
+
+def test_running_sum_default_frame_golden():
+    """Default frame with ORDER BY = RANGE UNBOUNDED PRECEDING..CURRENT ROW,
+    which includes peers (ties)."""
+    t = sales_table()
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sess().create_dataframe(t).select(
+        "emp", F.sum("salary").over(w).alias("s")).collect()
+    rows = dict(zip(out.column("emp").to_pylist(),
+                    out.column("s").to_pylist()))
+    assert rows["e1"] == 100
+    # e2 and e3 are peers (200): both see 100+200+200
+    assert rows["e2"] == 500 and rows["e3"] == 500
+    # dept b: null first (sum null-only frame -> null), then 25, 75, 150
+    assert rows["e6"] is None
+    assert rows["e7"] == 25 and rows["e4"] == 75 and rows["e5"] == 150
+    assert rows["e8"] == 300
+
+
+def test_rows_frame_golden():
+    t = sales_table()
+    w = (Window.partitionBy("dept").orderBy("salary")
+         .rowsBetween(-1, Window.currentRow))
+    out = sess().create_dataframe(t).select(
+        "emp", F.sum("salary").over(w).alias("s")).collect()
+    rows = dict(zip(out.column("emp").to_pylist(), out.column("s").to_pylist()))
+    # dept a sorted: e1(100), e2(200), e3(200) — rows frame ignores peers
+    assert rows["e1"] == 100 and rows["e2"] == 300 and rows["e3"] == 400
+    # dept b sorted: e6(None), e7(25), e4(50), e5(75)
+    assert rows["e6"] is None  # frame = {null} -> sum null
+    assert rows["e7"] == 25    # frame = {null, 25}
+    assert rows["e4"] == 75    # {25, 50}
+    assert rows["e5"] == 125   # {50, 75}
+
+
+def test_whole_partition_frame_golden():
+    t = sales_table()
+    w = (Window.partitionBy("dept").orderBy("salary")
+         .rowsBetween(Window.unboundedPreceding, Window.unboundedFollowing))
+    out = sess().create_dataframe(t).select(
+        "emp",
+        F.max("salary").over(w).alias("mx"),
+        F.min("salary").over(w).alias("mn"),
+        F.count("salary").over(w).alias("cnt"),
+        F.avg("salary").over(w).alias("av")).collect()
+    rows = {e: (mx, mn, c, av) for e, mx, mn, c, av in zip(
+        out.column("emp").to_pylist(), out.column("mx").to_pylist(),
+        out.column("mn").to_pylist(), out.column("cnt").to_pylist(),
+        out.column("av").to_pylist())}
+    for e in ("e1", "e2", "e3"):
+        assert rows[e] == (200, 100, 3, pytest.approx(500 / 3))
+    for e in ("e4", "e5", "e6", "e7"):
+        assert rows[e] == (75, 25, 3, pytest.approx(50.0))
+    assert rows["e8"] == (300, 300, 1, 300.0)
+
+
+def test_range_frame_offsets_golden():
+    t = pa.table({"g": ["x"] * 5, "v": pa.array([1, 3, 4, 7, 8],
+                                                type=pa.int64())})
+    w = Window.partitionBy("g").orderBy("v").rangeBetween(-2, 2)
+    out = sess().create_dataframe(t).select(
+        "v", F.count("v").over(w).alias("c"),
+        F.sum("v").over(w).alias("s")).collect()
+    rows = dict(zip(out.column("v").to_pylist(),
+                    zip(out.column("c").to_pylist(),
+                        out.column("s").to_pylist())))
+    assert rows[1] == (2, 4)     # values in [-1, 3]: {1, 3}
+    assert rows[3] == (3, 8)     # [1, 5]: {1, 3, 4}
+    assert rows[4] == (2, 7)     # [2, 6]: {3, 4}
+    assert rows[7] == (2, 15)    # [5, 9]: {7, 8}
+    assert rows[8] == (2, 15)    # [6, 10]: {7, 8}
+
+
+def test_lead_lag_golden():
+    t = sales_table()
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sess().create_dataframe(t).select(
+        "emp",
+        F.lag("salary", 1).over(w).alias("lg"),
+        F.lead("salary", 1, -1).over(w).alias("ld")).collect()
+    rows = {e: (lg, ld) for e, lg, ld in zip(
+        out.column("emp").to_pylist(), out.column("lg").to_pylist(),
+        out.column("ld").to_pylist())}
+    assert rows["e1"] == (None, 200)   # first in dept a
+    assert rows["e2"] == (100, 200)
+    assert rows["e3"] == (200, -1)     # last -> lead default
+    assert rows["e6"] == (None, 25)    # null row is first in dept b
+    assert rows["e7"] == (None, 50)    # lag hits the null row's value
+    assert rows["e8"] == (None, -1)
+
+
+def test_ntile_percent_rank_cume_dist_golden():
+    t = pa.table({"g": ["x"] * 4, "v": pa.array([10, 20, 20, 40],
+                                                type=pa.int64())})
+    w = Window.partitionBy("g").orderBy("v")
+    out = sess().create_dataframe(t).select(
+        "v", F.ntile(2).over(w).alias("nt"),
+        F.percent_rank().over(w).alias("pr"),
+        F.cume_dist().over(w).alias("cd")).collect()
+    nt = out.column("nt").to_pylist()
+    pr = out.column("pr").to_pylist()
+    cd = out.column("cd").to_pylist()
+    assert nt == [1, 1, 2, 2]
+    assert pr == [0.0, pytest.approx(1 / 3), pytest.approx(1 / 3), 1.0]
+    assert cd == [pytest.approx(0.25), pytest.approx(0.75),
+                  pytest.approx(0.75), 1.0]
+
+
+def test_string_min_max_first_last_over_window():
+    t = pa.table({"g": ["a", "a", "a", "b", "b"],
+                  "s": ["banana", "apple", None, "zebra", "yak"]})
+    w = (Window.partitionBy("g").orderBy("s")
+         .rowsBetween(Window.unboundedPreceding, Window.unboundedFollowing))
+    out = sess().create_dataframe(t).select(
+        "g", F.min("s").over(w).alias("mn"),
+        F.max("s").over(w).alias("mx"),
+        F.first("s", ignorenulls=True).over(w).alias("fv")).collect()
+    rows = {g: (mn, mx, fv) for g, mn, mx, fv in zip(
+        out.column("g").to_pylist(), out.column("mn").to_pylist(),
+        out.column("mx").to_pylist(), out.column("fv").to_pylist())}
+    assert rows["a"] == ("apple", "banana", "apple")
+    assert rows["b"] == ("yak", "zebra", "yak")
+
+
+# ----------------------------------------------------------------- parity tests
+def _parity(build, **kw):
+    assert_tpu_and_cpu_equal(build, conf=CONF,
+                             expect_tpu_execs=["TpuWindowExec"], **kw)
+
+
+def test_parity_mixed_window_functions():
+    rng = np.random.default_rng(7)
+    n = 500
+    t = pa.table({
+        "k": rng.integers(0, 10, n).astype(np.int32),
+        "o": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.normal(size=n),
+    })
+
+    def build(s):
+        w = Window.partitionBy("k").orderBy("o", F.col("v").desc())
+        return s.create_dataframe(t).select(
+            "k", "o",
+            F.row_number().over(w).alias("rn"),
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr"),
+            F.sum("v").over(w).alias("s"),
+            F.lag("o", 2).over(w).alias("lg"))
+    _parity(build, approx_float=1e-10)
+
+
+def test_parity_bounded_rows_and_range_frames():
+    rng = np.random.default_rng(8)
+    n = 300
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    mask = rng.random(n) < 0.1
+    t = pa.table({
+        "k": rng.integers(0, 5, n).astype(np.int32),
+        "v": pa.array([None if m else int(x) for m, x in zip(mask, vals)],
+                      type=pa.int64()),
+    })
+
+    def build(s):
+        wr = Window.partitionBy("k").orderBy("v").rowsBetween(-3, 2)
+        wg = Window.partitionBy("k").orderBy("v").rangeBetween(-10, 10)
+        return s.create_dataframe(t).select(
+            "k",
+            F.min("v").over(wr).alias("mn"),
+            F.max("v").over(wr).alias("mx"),
+            F.count("v").over(wg).alias("c"),
+            F.sum("v").over(wg).alias("s"))
+    _parity(build)
+
+
+def test_parity_no_partition_keys():
+    rng = np.random.default_rng(9)
+    t = pa.table({"v": rng.integers(0, 1000, 200).astype(np.int64)})
+
+    def build(s):
+        w = Window.orderBy("v")
+        return s.create_dataframe(t).select(
+            "v", F.row_number().over(w).alias("rn"),
+            F.sum("v").over(w).alias("s"))
+    _parity(build)
+
+
+def test_parity_desc_range_frame():
+    t = pa.table({"g": ["x"] * 6,
+                  "v": pa.array([5, 1, 9, 3, 7, 5], type=pa.int64())})
+
+    def build(s):
+        w = (Window.partitionBy("g").orderBy(F.col("v").desc())
+             .rangeBetween(-2, 2))
+        return s.create_dataframe(t).select(
+            "v", F.count("v").over(w).alias("c"))
+    _parity(build)
+
+
+def test_window_fallback_unknown_frame_still_correct():
+    """Window over a float order key with range offsets falls back if
+    unsupported; either way results must match the CPU engine."""
+    t = pa.table({"g": ["x"] * 4,
+                  "v": pa.array([1.0, 2.5, 3.0, 9.0])})
+
+    def build(s):
+        w = Window.partitionBy("g").orderBy("v").rangeBetween(-1.5, 1.5)
+        return s.create_dataframe(t).select(
+            "v", F.count("v").over(w).alias("c"))
+    assert_tpu_and_cpu_equal(build, conf=CONF)
+
+
+def test_multiple_specs_in_one_select():
+    t = sales_table()
+
+    def build(s):
+        w1 = Window.partitionBy("dept").orderBy("salary")
+        w2 = Window.partitionBy("dept")
+        return s.create_dataframe(t).select(
+            "emp",
+            F.row_number().over(w1).alias("rn"),
+            F.sum("salary").over(w2).alias("total"))
+    assert_tpu_and_cpu_equal(build, conf=CONF, ignore_order=True)
+
+
+def test_stacked_selects_with_windows_no_name_collision():
+    t = sales_table()
+    s = sess()
+    df = s.create_dataframe(t).select(
+        "dept", "sal" if False else "salary",
+        F.row_number().over(Window.partitionBy("dept").orderBy("salary"))
+        .alias("rn"))
+    out = df.select(
+        "dept",
+        F.max("rn").over(Window.partitionBy("dept")).alias("mx")).collect()
+    assert out.num_rows == 8
+
+
+def test_ntile_rejects_nonpositive():
+    with pytest.raises(ValueError, match="positive"):
+        F.ntile(0)
+
+
+def test_range_frame_invalid_order_key_raises_clearly():
+    t = pa.table({"g": ["x", "x"], "s": ["a", "b"]})
+    for enabled in (True, False):
+        df = sess(enabled).create_dataframe(t).select(
+            "s", F.count("s").over(
+                Window.partitionBy("g").orderBy("s").rangeBetween(-1, 1))
+            .alias("c"))
+        with pytest.raises(ValueError, match="RANGE"):
+            df.collect()
