@@ -95,6 +95,12 @@ class DataFrame:
     def groupBy(self, *cols: Union[str, Column]) -> "GroupedData":
         return GroupedData(self, tuple(_to_expr(c) for c in cols))
 
+    def rollup(self, *cols: Union[str, Column]) -> "GroupedData":
+        return GroupedData(self, tuple(_to_expr(c) for c in cols), "rollup")
+
+    def cube(self, *cols: Union[str, Column]) -> "GroupedData":
+        return GroupedData(self, tuple(_to_expr(c) for c in cols), "cube")
+
     def agg(self, *cols: Column) -> "DataFrame":
         return GroupedData(self, ()).agg(*cols)
 
@@ -222,9 +228,10 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, grouping):
+    def __init__(self, df: DataFrame, grouping, mode: str = "groupby"):
         self._df = df
         self._grouping = grouping
+        self._mode = mode
 
     def agg(self, *cols: Column) -> DataFrame:
         aggs = []
@@ -233,9 +240,49 @@ class GroupedData:
             if not isinstance(e, Alias):
                 e = Alias(e, e.name_hint)
             aggs.append(e)
+        if self._mode != "groupby":
+            return self._grouping_sets_agg(tuple(aggs))
         return DataFrame(
             lp.Aggregate(self._grouping, tuple(aggs), self._df._plan),
             self._df.session)
+
+    def _grouping_sets_agg(self, aggs) -> DataFrame:
+        """rollup/cube via Expand (Spark's Expand + grouping-id plan shape):
+        each row replicates once per grouping set with rolled-up keys nulled;
+        grouping by (expanded keys, grouping id) keeps real nulls distinct
+        from rolled-up nulls; a final projection drops the internal columns."""
+        from spark_rapids_tpu.columnar.dtypes import DType
+        from spark_rapids_tpu.exprs import Literal
+        keys = list(self._grouping)
+        n = len(keys)
+        if self._mode == "rollup":
+            # (all keys), (all but last), ..., (none)
+            masks = [[j < n - i for j in range(n)] for i in range(n + 1)]
+        else:  # cube: every subset
+            masks = [[not ((i >> (n - 1 - j)) & 1) for j in range(n)]
+                     for i in range(2 ** n)]
+        cs = self._df._plan.schema()
+        kn = [f"_gset{i}" for i in range(n)]
+        names = tuple(f.name for f in cs) + tuple(kn) + ("_gid",)
+        projections = []
+        for mask in masks:
+            gid = 0
+            row = [UnresolvedAttribute(f.name) for f in cs]
+            for j, (e, inc) in enumerate(zip(keys, mask)):
+                row.append(e if inc else Literal(None, DType.NULL))
+                if not inc:
+                    gid |= 1 << (n - 1 - j)
+            row.append(Literal(gid, DType.INT))
+            projections.append(tuple(row))
+        expand = lp.Expand(tuple(projections), names, self._df._plan)
+        grouping = tuple(UnresolvedAttribute(k) for k in kn) + (
+            UnresolvedAttribute("_gid"),)
+        agg = lp.Aggregate(grouping, aggs, expand)
+        final = tuple(
+            Alias(UnresolvedAttribute(k), keys[i].name_hint)
+            for i, k in enumerate(kn)
+        ) + tuple(UnresolvedAttribute(a.name_hint) for a in aggs)
+        return DataFrame(lp.Project(final, agg), self._df.session)
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.api.functions import count

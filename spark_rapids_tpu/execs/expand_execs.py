@@ -1,0 +1,72 @@
+"""Expand physical operators (reference: GpuExpandExec.scala, 202 LoC — expand
+projections per batch for rollup/cube/grouping sets).
+
+Each input batch yields one output batch per projection list — a plain fused
+projection per list, so the TPU path reuses the jitted expression evaluator and
+the downstream aggregate coalesces the results. Typed-null slots (the rolled-up
+keys) are cast to the slot's resolved type so every projection aligns.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.evaluator import eval_exprs_device, eval_exprs_host
+from spark_rapids_tpu.exprs.core import ColV, Expression
+from spark_rapids_tpu.exprs.misc import Alias
+
+
+def _aligned(projections: Tuple[Tuple[Expression, ...], ...],
+             output: Schema) -> Tuple[Tuple[Expression, ...], ...]:
+    """Name every slot and pin typed nulls to the slot's resolved type."""
+    from spark_rapids_tpu.exprs.literals import Literal
+    out = []
+    for plist in projections:
+        row = []
+        for e, f in zip(plist, output):
+            if isinstance(e, Alias):
+                e = e.c
+            if isinstance(e, Literal) and e.dtype() is DType.NULL:
+                e = Literal(None, f.dtype)
+            row.append(Alias(e, f.name))
+        out.append(tuple(row))
+    return tuple(out)
+
+
+class CpuExpandExec(PhysicalExec):
+    def __init__(self, projections: Tuple[Tuple[Expression, ...], ...],
+                 child: PhysicalExec, output: Schema):
+        super().__init__((child,), output)
+        self.projections = _aligned(projections, output)
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        for batch in self.children[0].execute(ctx):
+            for plist in self.projections:
+                out = eval_exprs_host(plist, batch, ctx.string_max_bytes)
+                out = _with_schema(out, self.output)
+                self.count_output(out.num_rows)
+                yield out
+
+
+class TpuExpandExec(PhysicalExec):
+    is_device = True
+
+    def __init__(self, projections: Tuple[Tuple[Expression, ...], ...],
+                 child: PhysicalExec, output: Schema):
+        super().__init__((child,), output)
+        self.projections = _aligned(projections, output)
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        for batch in self.children[0].execute(ctx):
+            for plist in self.projections:
+                out = eval_exprs_device(plist, batch, ctx.string_max_bytes)
+                out = _with_schema(out, self.output)
+                self.count_output(out.num_rows)
+                yield out
+
+
+def _with_schema(batch, schema: Schema):
+    """Rebind the evaluated batch to the expand output schema (the evaluator
+    derives nullability per projection; expand's contract is the union)."""
+    return type(batch)(schema, batch.columns, batch.num_rows)

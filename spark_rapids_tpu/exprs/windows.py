@@ -48,6 +48,14 @@ class WindowExpression(Expression):
     orders: Tuple[SortOrder, ...] = ()
     frame: Optional[WindowFrame] = None
 
+    def __post_init__(self):
+        if isinstance(self.fn, WindowFunction) and not self.orders:
+            # Spark's analyzer error for rank/lead/lag/... without ORDER BY;
+            # silent degenerate results (rank()==1 everywhere) are worse
+            raise ValueError(
+                f"window function {type(self.fn).__name__} requires the "
+                f"window to be ordered (add orderBy to the window spec)")
+
     def resolved_frame(self) -> WindowFrame:
         if self.frame is not None:
             return self.frame

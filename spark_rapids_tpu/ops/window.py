@@ -124,8 +124,15 @@ class FrameCtx:
     seg_size: Any
     peer_first: Any   # first row of the row's peer group (same order values)
     peer_last: Any
-    order_vals: Optional[Any]  # direction-normalized float64 order values (1 key)
-    n_order_keys: int
+    # RANGE-offset support (set when there is exactly one orderable order key):
+    order_vals: Optional[Any]  # direction-normalized values in the key's NATIVE
+    #                            domain (int64 for integral/date/timestamp — no
+    #                            float64 precision loss — float64 for floats)
+    special: Optional[Any]     # rows whose key is null or NaN: their frame is
+    #                            exactly their peer group (Spark semantics)
+    dom_lo: Optional[Any]      # per-row bounds of the searchable (non-special)
+    dom_hi: Optional[Any]      # region of the partition
+    n_order_keys: int = 0
 
 
 def build_frame_ctx(xp, part_keys: Sequence[ColV], order_keys, order, alive,
@@ -154,24 +161,41 @@ def build_frame_ctx(xp, part_keys: Sequence[ColV], order_keys, order, alive,
     else:
         peer_first, peer_last = seg_first, seg_last
 
-    order_vals = None
+    order_vals = special = dom_lo = dom_hi = None
     if len(order_keys) == 1:
         v, asc, _nf = order_keys[0]
         sv = bk.take_colv(xp, v, order)
         if sv.dtype.is_numeric or sv.dtype in (DType.DATE, DType.TIMESTAMP):
-            w = sv.data.astype(np.float64)
+            special = xp.logical_not(sv.validity)
             if sv.dtype.is_floating:
-                w = xp.where(xp.isnan(w), np.float64(np.inf), w)
-            if not asc:
-                w = -w
-            # null rows take -inf/(+inf) so they form their own closed frame
-            # group at the null end of the partition
-            null_key = np.float64(-np.inf) if _nf else np.float64(np.inf)
-            w = xp.where(sv.validity, w, null_key)
-            order_vals = w
+                # NaN rows get peer-group frames (Spark: NaN is its own
+                # greatest value; offset arithmetic on it is undefined)
+                special = xp.logical_or(special, xp.isnan(sv.data))
+                w = sv.data.astype(np.float64)
+                if not asc:
+                    w = -w
+            else:
+                # keep the NATIVE int64 domain — float64 would corrupt
+                # timestamp-microsecond-scale keys (spacing > 1 above 2^53).
+                # Descending uses ~x: monotone decreasing, no INT64_MIN overflow
+                w = sv.data.astype(np.int64)
+                if not asc:
+                    w = ~w
+            order_vals = xp.where(special, xp.zeros((), dtype=w.dtype), w)
+            # searchable region per row: the contiguous non-special span of the
+            # partition (sort puts nulls at the nulls_first end, NaN at the
+            # greatest end, so the remainder is contiguous)
+            ok = xp.logical_not(special)
+            lo_pick, lo_has = bk.segment_pick(xp, ok, gids, capacity, "first",
+                                              alive=salive, ignore_nulls=True)
+            hi_pick, _ = bk.segment_pick(xp, ok, gids, capacity, "last",
+                                         alive=salive, ignore_nulls=True)
+            dom_lo = xp.where(lo_has[gids], lo_pick[gids], np.int64(1))
+            dom_hi = xp.where(lo_has[gids], hi_pick[gids], np.int64(0))
 
     return FrameCtx(xp, capacity, idx, salive, seg_first, seg_last, seg_size,
-                    peer_first, peer_last, order_vals, len(order_keys))
+                    peer_first, peer_last, order_vals, special, dom_lo, dom_hi,
+                    len(order_keys))
 
 
 def _seg_sum(xp, data, seg_ids, num_segments: int):
@@ -204,22 +228,30 @@ def frame_bounds(fr: FrameCtx, frame_type: str, lower, upper):
             raise ValueError(
                 "RANGE window frame with offsets requires exactly one "
                 "numeric/date/timestamp ORDER BY key")
+
+        def offset_target(off):
+            if np.issubdtype(fr.order_vals.dtype, np.integer):
+                return fr.order_vals + np.int64(off)
+            return fr.order_vals + np.float64(off)
+
         if lower is None:
             lo = fr.seg_first
         elif lower == 0:
             lo = fr.peer_first
         else:
-            target = fr.order_vals + np.float64(lower)
-            lo = _bsearch(xp, fr.order_vals, target, fr.seg_first,
-                          fr.seg_last + 1, "left")
+            found = _bsearch(xp, fr.order_vals, offset_target(lower),
+                             fr.dom_lo, fr.dom_hi + 1, "left")
+            # null/NaN-keyed rows: frame = their peer group (offset arithmetic
+            # is undefined on them — Spark gives them peer-only frames)
+            lo = xp.where(fr.special, fr.peer_first, found)
         if upper is None:
             hi = fr.seg_last
         elif upper == 0:
             hi = fr.peer_last
         else:
-            target = fr.order_vals + np.float64(upper)
-            hi = _bsearch(xp, fr.order_vals, target, fr.seg_first,
-                          fr.seg_last + 1, "right") - 1
+            found = _bsearch(xp, fr.order_vals, offset_target(upper),
+                             fr.dom_lo, fr.dom_hi + 1, "right") - 1
+            hi = xp.where(fr.special, fr.peer_last, found)
     empty = xp.logical_or(lo > hi, xp.logical_not(fr.salive))
     return lo, hi, empty
 

@@ -187,6 +187,32 @@ class Join(LogicalPlan):
 
 
 @dataclass
+class Expand(LogicalPlan):
+    """Each input row becomes one output row PER projection list (Spark's
+    Expand, used by rollup/cube/grouping sets). All projection lists align on
+    slot count, names, and types."""
+    projections: Tuple[Tuple[Expression, ...], ...]
+    names: Tuple[str, ...]
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exprs.core import bind_expression
+        cs = self.child.schema()
+        fields = []
+        for i, name in enumerate(self.names):
+            slot = [bind_expression(p[i], cs) for p in self.projections]
+            dt = next((b.dtype() for b in slot if b.dtype() is not DType.NULL),
+                      DType.NULL)
+            nullable = any(b.nullable() or b.dtype() is DType.NULL for b in slot)
+            fields.append(Field(name, dt, nullable))
+        return Schema(fields)
+
+
+@dataclass
 class Window(LogicalPlan):
     """Window computation: child columns ++ one window column per expression.
     All wexprs share one (partition, order) sort spec (the API groups them)."""

@@ -348,6 +348,18 @@ def _make_join_rules() -> List[ExecRule]:
                      tag=_tag_join)]
 
 
+def _convert_expand(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.expand_execs import TpuExpandExec
+    return TpuExpandExec(meta.exec.projections, children[0], meta.exec.output)
+
+
+def _make_expand_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.execs.expand_execs import CpuExpandExec
+    return [ExecRule(CpuExpandExec, "expand projections", _convert_expand,
+                     exprs_of=lambda e: tuple(x for p in e.projections
+                                              for x in p))]
+
+
 def _convert_window(meta: ExecMeta, children) -> PhysicalExec:
     from spark_rapids_tpu.execs.window_execs import TpuWindowExec
     return TpuWindowExec(meta.exec.wexprs, children[0])
@@ -360,7 +372,8 @@ def _make_window_rules() -> List[ExecRule]:
 
 
 _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_join_rules()
-                                   + _make_window_rules()) + [
+                                   + _make_window_rules()
+                                   + _make_expand_rules()) + [
     ExecRule(ce.CpuProjectExec, "column projection", _convert_project,
              exprs_of=lambda e: e.exprs),
     ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,

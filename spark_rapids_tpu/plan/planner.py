@@ -54,6 +54,12 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
             SortOrder(bind_expression(o.child, child.output), o.ascending,
                       o.nulls_first) for o in plan.orders)
         return ce.CpuSortExec(orders, child)
+    if isinstance(plan, lp.Expand):
+        from spark_rapids_tpu.execs.expand_execs import CpuExpandExec
+        child = plan_physical(plan.child, conf)
+        projs = tuple(tuple(bind_expression(e, child.output) for e in p)
+                      for p in plan.projections)
+        return CpuExpandExec(projs, child, plan.schema())
     if isinstance(plan, lp.Window):
         from spark_rapids_tpu.execs.window_execs import CpuWindowExec
         child = plan_physical(plan.child, conf)

@@ -1,0 +1,85 @@
+"""Expand / rollup / cube tests (reference: ExpandExecSuite.scala +
+hash_aggregate_test.py rollup cases)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+
+def data():
+    return pa.table({
+        "a": ["x", "x", "y", "y", None],
+        "b": pa.array([1, 2, 1, 1, 1], type=pa.int32()),
+        "v": pa.array([10, 20, 30, 40, 50], type=pa.int64()),
+    })
+
+
+def test_rollup_golden():
+    s = TpuSession()
+    out = s.create_dataframe(data()).rollup("a", "b").agg(
+        F.sum("v").alias("s")).collect()
+    rows = {(r["a"], r["b"]): r["s"] for r in out.to_pylist()}
+    # full detail
+    assert rows[("x", 1)] == 10 and rows[("x", 2)] == 20
+    assert rows[("y", 1)] == 70
+    # real null key stays distinct from rolled-up subtotals
+    assert rows[(None, 1)] == 50
+    # per-a subtotals (b rolled up)
+    assert rows[("x", None)] == 30 and rows[("y", None)] == 70
+    # grand total
+    assert rows[(None, None)] == 150
+    # rollup of (a=None detail) -> (None, None) subtotal for a=None
+    # Spark emits a (null, null) row for BOTH the a=None subtotal and the grand
+    # total; they collapse only if gid matched — ours keeps them distinct rows
+    total_rows = [r for r in out.to_pylist()
+                  if r["a"] is None and r["b"] is None]
+    assert sorted(r["s"] for r in total_rows) == [50, 150]
+    assert out.num_rows == 8
+
+
+def test_cube_golden():
+    s = TpuSession()
+    out = s.create_dataframe(data()).cube("a", "b").agg(
+        F.count("v").alias("c")).collect()
+    # cube adds per-b subtotals on top of rollup
+    rows = [r for r in out.to_pylist() if r["a"] is None and r["b"] == 1]
+    # (None-as-group, b=1): count of all b=1 rows = 4; (a=None real, b=1) = 1
+    assert sorted(r["c"] for r in rows) == [1, 4]
+
+
+def test_rollup_parity_tpu():
+    import numpy as np
+    rng = np.random.default_rng(3)
+    n = 400
+    t = pa.table({
+        "a": rng.integers(0, 4, n).astype(np.int32),
+        "b": rng.integers(0, 3, n).astype(np.int32),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+
+    def build(s):
+        return s.create_dataframe(t).rollup("a", "b").agg(
+            F.sum("v").alias("s"), F.count("v").alias("c"),
+            F.min("v").alias("mn"))
+    assert_tpu_and_cpu_equal(build, ignore_order=True,
+                             expect_tpu_execs=["TpuExpandExec"])
+
+
+def test_cube_parity_tpu():
+    import numpy as np
+    rng = np.random.default_rng(4)
+    n = 200
+    t = pa.table({
+        "a": rng.integers(0, 3, n).astype(np.int64),
+        "b": [None if x == 0 else str(x) for x in rng.integers(0, 3, n)],
+        "v": rng.normal(size=n),
+    })
+
+    def build(s):
+        return s.create_dataframe(t).cube("a", "b").agg(
+            F.count("v").alias("c"), F.max("v").alias("mx"))
+    assert_tpu_and_cpu_equal(
+        build, ignore_order=True,
+        conf={"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"})

@@ -289,7 +289,7 @@ def test_stacked_selects_with_windows_no_name_collision():
     t = sales_table()
     s = sess()
     df = s.create_dataframe(t).select(
-        "dept", "sal" if False else "salary",
+        "dept", "salary",
         F.row_number().over(Window.partitionBy("dept").orderBy("salary"))
         .alias("rn"))
     out = df.select(
@@ -312,3 +312,46 @@ def test_range_frame_invalid_order_key_raises_clearly():
             .alias("c"))
         with pytest.raises(ValueError, match="RANGE"):
             df.collect()
+
+
+def test_range_frame_int64_precision_above_2_53():
+    big = 1 << 60
+    t = pa.table({"g": ["x", "x"],
+                  "v": pa.array([big + 100, big + 300], type=pa.int64())})
+
+    def build(s):
+        w = Window.partitionBy("g").orderBy("v").rangeBetween(-150, 150)
+        return s.create_dataframe(t).select(
+            "v", F.count("v").over(w).alias("c"))
+    out = assert_tpu_and_cpu_equal(build, conf=CONF)
+    # gap is 200 > 150: each row's frame holds only itself (float64 would
+    # collapse the two keys and report 2)
+    assert out.column("c").to_pylist() == [1, 1]
+
+
+def test_range_frame_inf_nan_null_keys():
+    t = pa.table({"g": ["x"] * 4,
+                  "v": pa.array([None, float("-inf"), float("inf"),
+                                 float("nan")])})
+
+    def build(s):
+        w = Window.partitionBy("g").orderBy("v").rangeBetween(-1.0, 1.0)
+        return s.create_dataframe(t).select(
+            "v", F.count("v").over(w).alias("c"))
+    out = assert_tpu_and_cpu_equal(build, conf=CONF)
+    rows = dict(zip(out.column("v").to_pylist(), out.column("c").to_pylist()))
+    # null row: frame = null peers only -> count(v) = 0
+    assert rows[None] == 0
+    # -inf and +inf rows: -inf±1 = -inf, inf±1 = inf -> only themselves
+    assert rows[float("-inf")] == 1 and rows[float("inf")] == 1
+    # NaN row: peer-group frame -> itself (NaN is valid for count)
+    nan_counts = [c for v, c in rows.items()
+                  if isinstance(v, float) and v != v]
+    assert nan_counts == [1]
+
+
+def test_ranking_function_requires_order_by():
+    with pytest.raises(ValueError, match="ordered"):
+        F.rank().over(Window.partitionBy("g"))
+    with pytest.raises(ValueError, match="ordered"):
+        F.lead("v").over(Window.partitionBy("g"))
