@@ -216,10 +216,12 @@ UNSPILL_ENABLED = _conf(
 # --------------------------------------------------------------------------------------
 SHUFFLE_TRANSPORT_CLASS = _conf(
     "shuffle.transport.class", str,
-    "spark_rapids_tpu.shuffle.transport.LocalShuffleTransport",
-    "Fully qualified class of the shuffle transport. The ICI transport moves batches "
-    "device-to-device over the mesh interconnect; Local moves them through host memory "
-    "(analog of spark.rapids.shuffle.transport.class selecting the UCX transport).")
+    "spark_rapids_tpu.shuffle.inprocess.InProcessTransport",
+    "Fully qualified class of the shuffle transport used for peer-to-peer fetches "
+    "(analog of spark.rapids.shuffle.transport.class selecting the UCX transport). "
+    "InProcessTransport serves executors within one process; cross-host DCN transports "
+    "implement the same traits. Mesh-local exchanges bypass this entirely via the ICI "
+    "all_to_all path (shuffle/ici.py).")
 
 SHUFFLE_MAX_INFLIGHT_BYTES = _conf(
     "shuffle.maxReceiveInflightBytes", int, 1 << 30,
@@ -236,7 +238,7 @@ SHUFFLE_BOUNCE_BUFFER_COUNT = _conf(
 
 SHUFFLE_COMPRESSION_CODEC = _conf(
     "shuffle.compression.codec", str, "none",
-    "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), zstd "
+    "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), zlib "
     "(analog of spark.rapids.shuffle.compression.codec).")
 
 SHUFFLE_PARTITIONING_MAX_CPU_BATCH = _conf(
@@ -346,6 +348,24 @@ class TpuConf:
 
     @property
     def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def shuffle_transport_class(self) -> str: return self.get(SHUFFLE_TRANSPORT_CLASS)
+
+    @property
+    def shuffle_max_inflight_bytes(self) -> int:
+        return self.get(SHUFFLE_MAX_INFLIGHT_BYTES)
+
+    @property
+    def shuffle_bounce_buffer_size(self) -> int:
+        return self.get(SHUFFLE_BOUNCE_BUFFER_SIZE)
+
+    @property
+    def shuffle_bounce_buffer_count(self) -> int:
+        return self.get(SHUFFLE_BOUNCE_BUFFER_COUNT)
+
+    @property
+    def shuffle_codec(self) -> str: return self.get(SHUFFLE_COMPRESSION_CODEC)
 
 
 def all_entries() -> List[ConfEntry]:

@@ -1,0 +1,23 @@
+"""Accelerated columnar shuffle (L0).
+
+Reference analogs (SURVEY.md §2.8): the transport-agnostic shuffle layer
+(sql-plugin shuffle/RapidsShuffleTransport.scala, RapidsShuffleClient.scala,
+RapidsShuffleServer.scala, RapidsShuffleIterator.scala), the catalog-backed
+caching writer/reader (RapidsShuffleInternalManager.scala, RapidsCachingReader.scala)
+and the UCX transport (shuffle-plugin ucx/).
+
+TPU re-design: batches are packed into one contiguous buffer described by a
+``TableMeta`` (MetaUtils.scala analog, struct-packed instead of flatbuffers);
+data moves either
+
+- **in-process / DCN path**: tag-addressed transfers through bounce-buffer
+  pools over a pluggable ``ShuffleTransport`` (the UCX-trait analog), with
+  metadata riding the control plane (MapOutputTracker analog); or
+- **ICI path** (``ici.py``): when all partitions live in one SPMD program, the
+  exchange is a single XLA ``all_to_all`` over the device mesh — device-to-device
+  over the interconnect with no host round-trip, the TPU-native replacement for
+  UCX RDMA.
+"""
+from spark_rapids_tpu.shuffle.table_meta import (TableMeta, pack_host_batch,
+                                                 unpack_host_batch)
+from spark_rapids_tpu.shuffle.codec import get_codec

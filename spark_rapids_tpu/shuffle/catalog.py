@@ -1,0 +1,118 @@
+"""Shuffle buffer catalogs: shuffle-block-id -> spillable buffer mapping.
+
+Reference analogs: ShuffleBufferCatalog.scala (shuffleId -> bufferIds over the
+RapidsBufferCatalog, 222 LoC) and ShuffleReceivedBufferCatalog.scala (119 LoC)
+for client-received buffers. Buffers live in the tiered store chain (memory/
+store.py) so cached shuffle data spills HBM -> host -> disk under pressure,
+exactly like the reference's device-store-backed shuffle cache.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.memory.buffer import BufferId, SpillableBuffer
+from spark_rapids_tpu.memory.store import (BufferCatalog, DeviceMemoryStore,
+                                           SHUFFLE_BUFFER_PRIORITY)
+from spark_rapids_tpu.shuffle.table_meta import TableMeta
+
+
+@dataclass(frozen=True, order=True)
+class ShuffleBlockId:
+    """(shuffle, map, partition) address of one cached batch
+    (ShuffleBufferId analog)."""
+    shuffle_id: int
+    map_id: int
+    partition_id: int
+
+
+class ShuffleBufferCatalog:
+    """Maps shuffle block ids to buffer-store ids + TableMeta; owns the
+    registration/removal lifecycle for the map-side shuffle cache."""
+
+    _ids = itertools.count(1 << 20)   # table_id namespace distinct from execs
+
+    def __init__(self, catalog: BufferCatalog, device_store: DeviceMemoryStore):
+        self._catalog = catalog
+        self._device_store = device_store
+        self._lock = threading.RLock()
+        self._blocks: Dict[ShuffleBlockId, List[Tuple[BufferId, TableMeta]]] = {}
+        self._by_shuffle: Dict[int, List[ShuffleBlockId]] = {}
+
+    def add_batch(self, block: ShuffleBlockId, batch, meta: TableMeta) -> BufferId:
+        """Cache one device batch for ``block`` in the spillable device store."""
+        buffer_id = BufferId(next(self._ids), block.partition_id)
+        self._device_store.add_batch(buffer_id, batch,
+                                     spill_priority=SHUFFLE_BUFFER_PRIORITY)
+        with self._lock:
+            self._blocks.setdefault(block, []).append((buffer_id, meta))
+            self._by_shuffle.setdefault(block.shuffle_id, []).append(block)
+        return buffer_id
+
+    def blocks_for_partition(self, shuffle_id: int,
+                             partition_id: int) -> List[ShuffleBlockId]:
+        with self._lock:
+            return [b for b in self._by_shuffle.get(shuffle_id, [])
+                    if b.partition_id == partition_id]
+
+    def metas(self, block: ShuffleBlockId) -> List[TableMeta]:
+        with self._lock:
+            return [m for _, m in self._blocks.get(block, [])]
+
+    def acquire_buffers(self, block: ShuffleBlockId
+                        ) -> List[Tuple[SpillableBuffer, TableMeta]]:
+        """Acquire (retain) every buffer of a block, fastest tier first;
+        callers close() each buffer after use."""
+        with self._lock:
+            entries = list(self._blocks.get(block, []))
+        out = []
+        for buffer_id, meta in entries:
+            buf = self._catalog.acquire(buffer_id)
+            if buf is None:
+                raise KeyError(f"shuffle buffer {buffer_id} vanished for {block}")
+            out.append((buf, meta))
+        return out
+
+    def remove_shuffle(self, shuffle_id: int) -> int:
+        """Unregister a completed shuffle (unregisterShuffle analog)."""
+        with self._lock:
+            blocks = self._by_shuffle.pop(shuffle_id, [])
+            removed = 0
+            for block in blocks:
+                for buffer_id, _ in self._blocks.pop(block, []):
+                    store = self._device_store
+                    # the buffer may have spilled; remove wherever it lives now
+                    buf = self._catalog.acquire(buffer_id)
+                    if buf is not None:
+                        owner = buf.owner_store or store
+                        buf.close()
+                        owner.remove(buffer_id)
+                        removed += 1
+            return removed
+
+
+class ReceivedBufferCatalog:
+    """Client-side catalog of fetched buffers (ShuffleReceivedBufferCatalog
+    analog): holds host-packed buffers + metas until the task materializes them."""
+
+    _ids = itertools.count()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._received: Dict[int, Tuple[bytes, TableMeta]] = {}
+
+    def add(self, buf: bytes, meta: TableMeta) -> int:
+        with self._lock:
+            rid = next(self._ids)
+            self._received[rid] = (buf, meta)
+            return rid
+
+    def take(self, rid: int) -> Tuple[bytes, TableMeta]:
+        with self._lock:
+            return self._received.pop(rid)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._received)

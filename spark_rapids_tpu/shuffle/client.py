@@ -1,0 +1,189 @@
+"""Shuffle client: fetch protocol state machine.
+
+Reference analog: RapidsShuffleClient.scala (804 LoC) — metadata request →
+TableMetas → PendingTransferRequests → BufferReceiveState:108 walking receive
+bounce buffers, consumeBuffers:193 assembling the target buffer, then handing
+the received buffer id to the fetch handler. The inflight throttle
+(queuePending / maxReceiveInflightBytes) gates how many bytes of transfers are
+outstanding per client.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from spark_rapids_tpu.shuffle import messages as msg
+from spark_rapids_tpu.shuffle.catalog import (ReceivedBufferCatalog,
+                                              ShuffleBlockId)
+from spark_rapids_tpu.shuffle.codec import decompress_batch
+from spark_rapids_tpu.shuffle.table_meta import TableMeta
+from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                ClientConnection,
+                                                ShuffleTransport, Transaction,
+                                                TransactionStatus)
+
+
+class ShuffleFetchHandler:
+    """Callbacks a task iterator registers for one fetch
+    (RapidsShuffleFetchHandler analog)."""
+
+    def start(self, expected_tables: int) -> None: ...
+
+    def batch_received(self, received_id: int) -> None: ...
+
+    def transfer_error(self, message: str) -> None: ...
+
+
+class PendingTransferRequest:
+    """One table awaiting transfer (PendingTransferRequest analog)."""
+
+    def __init__(self, block: ShuffleBlockId, table_idx: int, meta: TableMeta):
+        self.block = block
+        self.table_idx = table_idx
+        self.meta = meta
+
+
+class BufferReceiveState:
+    """Receives one table's packed buffer as chunked tag-addressed receives
+    through the bounce pool, assembling into the final target buffer
+    (BufferReceiveState + consumeBuffers analog)."""
+
+    def __init__(self, client: "ShuffleClient", base_tag: int, wire_size: int,
+                 chunk_size: int,
+                 on_done: Callable[[Optional[bytearray], Optional[str]], None]):
+        self.client = client
+        self.base_tag = base_tag
+        self.chunk_size = chunk_size
+        self.wire_size = wire_size
+        self.target = bytearray(wire_size)
+        self.num_chunks = max(1, -(-wire_size // chunk_size))
+        self._next_chunk = 0
+        self._outstanding = 0
+        self._failed = False
+        self._lock = threading.Lock()
+        self._on_done = on_done
+
+    def start(self) -> None:
+        window = min(self.num_chunks, 4)
+        bounces = self.client.transport.recv_bounce.acquire(window)
+        with self._lock:
+            for bb in bounces:
+                self._arm(bb)
+
+    def _arm(self, bounce) -> None:
+        i = self._next_chunk
+        if i >= self.num_chunks or self._failed:
+            bounce.close()
+            if self._outstanding == 0:
+                done, self._on_done = self._on_done, None
+                if done is not None and not self._failed:
+                    done(self.target, None)
+            return
+        self._next_chunk += 1
+        self._outstanding += 1
+        start = i * self.chunk_size
+        length = min(self.chunk_size, self.wire_size - start)
+        alt = AddressLengthTag(bounce.buffer, length, self.base_tag + i)
+
+        def on_rx(tx: Transaction, bounce=bounce, i=i, start=start, length=length):
+            with self._lock:
+                self._outstanding -= 1
+                if tx.status is not TransactionStatus.SUCCESS:
+                    first_error = not self._failed
+                    self._failed = True
+                    bounce.close()
+                    if first_error:
+                        done, self._on_done = self._on_done, None
+                        if done is not None:
+                            done(None, tx.error_message or "receive failed")
+                    return
+                self.target[start:start + length] = bounce.buffer[:length]
+                self._arm(bounce)
+        self.client.connection.receive(alt, on_rx)
+
+
+class ShuffleClient:
+    """Per-peer fetch driver (RapidsShuffleClient analog)."""
+
+    _tag_seq = itertools.count(1)
+
+    def __init__(self, transport: ShuffleTransport,
+                 connection: ClientConnection,
+                 received_catalog: ReceivedBufferCatalog,
+                 codec_name: str = "none"):
+        self.transport = transport
+        self.connection = connection
+        self.received = received_catalog
+        self.codec_name = codec_name
+        self.chunk_size = transport.send_bounce.buffer_size
+
+    # ---- protocol --------------------------------------------------------------
+    def fetch(self, blocks: List[ShuffleBlockId],
+              handler: ShuffleFetchHandler) -> None:
+        """Fetch all tables of ``blocks`` from this peer; async — results land
+        via handler callbacks."""
+        if not blocks:
+            handler.start(0)
+            return
+        req = msg.MetadataRequest(blocks[0].shuffle_id,
+                                  blocks[0].partition_id, tuple(blocks))
+
+        def on_meta(tx: Transaction):
+            if tx.status is not TransactionStatus.SUCCESS:
+                handler.transfer_error(tx.error_message or "metadata failed")
+                return
+            resp = msg.MetadataResponse.from_bytes(tx.response)
+            pending = [PendingTransferRequest(b, i, m)
+                       for b, i, m in resp.tables]
+            # the tracker only lists non-empty blocks, so a requested block the
+            # server no longer has is a lost block, not an empty one
+            answered = {p.block for p in pending}
+            missing = [b for b in blocks if b not in answered]
+            if missing:
+                handler.transfer_error(
+                    f"peer {self.connection.peer_executor_id} lost blocks: "
+                    f"{missing[:3]}{'...' if len(missing) > 3 else ''}")
+                return
+            handler.start(len(pending))
+            for p in pending:
+                self._issue_transfer(p, handler)
+        self.connection.request(msg.REQ_METADATA, req.to_bytes(), on_meta)
+
+    def _issue_transfer(self, p: PendingTransferRequest,
+                        handler: ShuffleFetchHandler) -> None:
+        base_tag = (next(self._tag_seq) << 16)
+        treq = msg.TransferRequest(p.block, p.table_idx, base_tag,
+                                   self.chunk_size, self.codec_name)
+        # admission control before the server starts pushing chunks
+        self.transport.throttle.acquire(p.meta.packed_size)
+        released = threading.Event()
+
+        def release_once():
+            if not released.is_set():
+                released.set()
+                self.transport.throttle.release(p.meta.packed_size)
+
+        def on_transfer_resp(tx: Transaction):
+            if tx.status is not TransactionStatus.SUCCESS:
+                release_once()
+                handler.transfer_error(tx.error_message or "transfer failed")
+                return
+            resp = msg.TransferResponse.from_bytes(tx.response)
+
+            def on_buffer(target: Optional[bytearray], error: Optional[str]):
+                release_once()
+                if error is not None:
+                    handler.transfer_error(error)
+                    return
+                try:
+                    raw, meta = decompress_batch(bytes(target), resp.meta)
+                    rid = self.received.add(raw, meta)
+                except Exception as e:  # noqa: BLE001
+                    handler.transfer_error(f"{type(e).__name__}: {e}")
+                    return
+                handler.batch_received(rid)
+            BufferReceiveState(self, base_tag, resp.wire_size,
+                               self.chunk_size, on_buffer).start()
+        self.connection.request(msg.REQ_TRANSFER, treq.to_bytes(),
+                                on_transfer_resp)

@@ -1,0 +1,95 @@
+"""Pluggable batch compression for shuffle buffers.
+
+Reference analogs: TableCompressionCodec.scala:42 (trait + registry getCodec:100)
+with batched compressor/decompressor (BatchedTableCompressor:127,
+BatchedBufferDecompressor:297), and CopyCompressionCodec.scala (memcpy
+pseudo-codec). The reference compresses on-device via cuDF; here compression is
+a host-side stage of the transfer pipeline (TPU has no general-purpose
+device codec), so codecs operate on the packed host buffer between
+pack_host_batch and the transport send.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Sequence, Tuple
+
+from spark_rapids_tpu.shuffle.table_meta import TableMeta
+
+
+class TableCompressionCodec:
+    """One codec. ``name`` is recorded in TableMeta.codec on the wire."""
+
+    name: str = "?"
+
+    def compress(self, buf: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, buf: bytes, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+class CopyCodec(TableCompressionCodec):
+    """Pass-through (CopyCompressionCodec analog)."""
+
+    name = "copy"
+
+    def compress(self, buf: bytes) -> bytes:
+        return buf
+
+    def decompress(self, buf: bytes, uncompressed_size: int) -> bytes:
+        if len(buf) != uncompressed_size:
+            raise ValueError(f"copy codec size mismatch: {len(buf)} != "
+                             f"{uncompressed_size}")
+        return buf
+
+
+class ZlibCodec(TableCompressionCodec):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        self.level = level
+
+    def compress(self, buf: bytes) -> bytes:
+        return zlib.compress(buf, self.level)
+
+    def decompress(self, buf: bytes, uncompressed_size: int) -> bytes:
+        out = zlib.decompress(buf)
+        if len(out) != uncompressed_size:
+            raise ValueError(f"zlib decompressed to {len(out)}, expected "
+                             f"{uncompressed_size}")
+        return out
+
+
+_REGISTRY: Dict[str, TableCompressionCodec] = {
+    "copy": CopyCodec(),
+    "zlib": ZlibCodec(),
+    "none": CopyCodec(),
+}
+
+
+def get_codec(name: str) -> TableCompressionCodec:
+    """Registry lookup (TableCompressionCodec.getCodec analog)."""
+    codec = _REGISTRY.get(name.lower())
+    if codec is None:
+        raise ValueError(f"unknown shuffle codec {name!r}; known: "
+                         f"{sorted(_REGISTRY)}")
+    return codec
+
+
+def compress_batch(buf: bytes, meta: TableMeta,
+                   codec: TableCompressionCodec) -> Tuple[bytes, TableMeta]:
+    """One table through the codec, meta updated (BatchedTableCompressor analog,
+    minus the device temp-space estimation which host codecs don't need)."""
+    if isinstance(codec, CopyCodec):
+        return buf, meta
+    out = codec.compress(buf)
+    return out, meta.with_codec(codec.name, len(out))
+
+
+def decompress_batch(buf: bytes, meta: TableMeta) -> Tuple[bytes, TableMeta]:
+    """Inverse of compress_batch (BatchedBufferDecompressor analog)."""
+    if meta.codec == "copy":
+        return buf, meta
+    codec = get_codec(meta.codec)
+    out = codec.decompress(buf, meta.uncompressed_size)
+    return out, meta.with_codec("copy", len(out))

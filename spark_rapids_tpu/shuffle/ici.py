@@ -1,0 +1,112 @@
+"""ICI shuffle: hash repartition as ONE XLA all_to_all over the device mesh.
+
+This is the TPU-native replacement for the reference's UCX RDMA data plane
+(shuffle-plugin ucx/UCX.scala): when every reduce partition lives on a device
+of the same SPMD program, the entire map->reduce exchange is a single
+compiled collective riding the inter-chip interconnect — no host round-trip,
+no bounce buffers, no tag matching. The in-process/DCN transport (client.py/
+server.py) remains the path for cross-program topologies, exactly as the
+reference keeps a host fallback next to UCX.
+
+Kernel design (all static shapes, no data-dependent control flow):
+1. per device, stable-argsort local rows by target partition id — the
+   Table.partition + contiguousSplit analog (GpuPartitioning.scala:44-75);
+2. slice the sorted rows into n_dev fixed-capacity chunks via one gather
+   (chunk j = rows destined for device j, padded to chunk_capacity);
+3. lax.all_to_all every column buffer (XLA fuses the per-column collectives
+   into few ICI transfers) plus the per-chunk row counts;
+4. compact received chunks to the front with one more stable argsort, so the
+   output batch obeys the padding invariant (live rows first).
+
+Skew bound: a device can receive at most n_dev * chunk_capacity rows; rows
+beyond chunk_capacity for one destination on one source device would be lost,
+so callers size chunk_capacity for worst-case skew (default: local_capacity,
+which is always safe because a source holds only local_capacity rows).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
+from spark_rapids_tpu.exprs.core import (ColV, flat_len, flatten_colvs,
+                                         unflatten_colvs)
+
+
+def _a2a(x, axis: str):
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
+                          chunk_capacity: Optional[int] = None,
+                          axis: str = "data"):
+    """Build the jitted SPMD repartition step.
+
+    Returns fn(num_rows_local [n_dev] int32, pids [n_dev*cap] int32 sharded,
+    *flat sharded column arrays) -> (out_rows [n_dev] int32, *flat resharded
+    columns with capacity n_dev*chunk_capacity per device).
+
+    ``pids`` is the target partition id per row (device index), computed by the
+    caller from hash exprs — the GpuHashPartitioning.columnarEval analog.
+    """
+    n_dev = int(np.prod([mesh.shape[a] for a in (axis,)]))
+    chunk_cap = chunk_capacity or local_capacity
+    out_cap = n_dev * chunk_cap
+
+    def local_step(num_rows_local, pids, *flat_local):
+        colvs = unflatten_colvs(schema, flat_local)
+        my_rows = num_rows_local[0]
+        live = jnp.arange(local_capacity, dtype=np.int32) < my_rows
+        pid = jnp.where(live, pids, n_dev)        # dead rows -> sentinel bucket
+
+        # 1. group rows by destination (stable keeps intra-partition order)
+        order = jnp.argsort(pid, stable=True)
+        sorted_pid = pid[order]
+
+        # 2. chunk index matrix [n_dev, chunk_cap]
+        counts = jnp.sum(
+            (sorted_pid[None, :] == jnp.arange(n_dev, dtype=np.int32)[:, None]),
+            axis=1, dtype=np.int32)               # rows per destination
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), np.int32), jnp.cumsum(counts)[:-1].astype(np.int32)])
+        offsets = jnp.arange(chunk_cap, dtype=np.int32)[None, :]
+        idx = jnp.clip(starts[:, None] + offsets, 0, local_capacity - 1)
+        within = offsets < counts[:, None]        # [n_dev, chunk_cap]
+        sent = jnp.minimum(counts, chunk_cap)     # overflow clamps (see skew note)
+        gidx = order[idx]                         # chunk row -> original row
+
+        # 3. exchange: counts + every column buffer
+        recv_counts = _a2a(sent, axis)            # [n_dev] rows from each peer
+        out_cols = []
+        for v in colvs:
+            data = _a2a(v.data[gidx], axis)
+            validity = _a2a(v.validity[gidx] & within, axis)
+            lengths = (_a2a(jnp.where(within, v.lengths[gidx], 0), axis)
+                       if v.lengths is not None else None)
+            out_cols.append((v.dtype, data, validity, lengths))
+
+        # 4. compact received rows to the front (padding invariant)
+        recv_live = (jnp.arange(chunk_cap, dtype=np.int32)[None, :]
+                     < recv_counts[:, None]).reshape(out_cap)
+        corder = jnp.argsort(~recv_live, stable=True)
+        total = jnp.sum(recv_counts).astype(np.int32)
+        compacted = []
+        for dt, data, validity, lengths in out_cols:
+            flat_shape = (out_cap,) + data.shape[2:]
+            compacted.append(ColV(
+                dt, data.reshape(flat_shape)[corder],
+                validity.reshape(out_cap)[corder],
+                lengths.reshape(out_cap)[corder] if lengths is not None else None))
+        return (total[None],) + tuple(flatten_colvs(compacted))
+
+    nflat = flat_len(schema)
+    in_specs = (P(axis), P(axis)) + tuple(P(axis) for _ in range(nflat))
+    out_specs = (P(axis),) + tuple(P(axis) for _ in range(nflat))
+    return jax.jit(jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))

@@ -1,0 +1,267 @@
+"""Shuffle manager: caching writer/reader + map-output tracking + task iterator.
+
+Reference analogs:
+- RapidsShuffleInternalManagerBase (RapidsShuffleInternalManager.scala:194) —
+  registerShuffle → GpuShuffleHandle, getWriter → RapidsCachingWriter,
+  getReader → RapidsCachingReader;
+- RapidsCachingWriter (same file :73-160) — per-partition batches into the
+  device store + ShuffleBufferCatalog, metadata-only MapStatus;
+- RapidsCachingReader.scala — local blocks from the catalog, remote via the
+  transport client;
+- RapidsShuffleIterator.scala:46 — task-facing blocking iterator resolving
+  block locations from the MapOutputTracker, semaphore acquire on materialize,
+  fetch-failure surfacing;
+- GpuShuffleEnv.scala:52-70 — wiring stores/catalogs/transport per executor.
+
+Data stays cached ON DEVICE between map and reduce (spilling host→disk under
+pressure); Spark's control plane is replaced by the in-process MapOutputTracker.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory.store import BufferCatalog, build_store_chain
+from spark_rapids_tpu.shuffle.catalog import (ReceivedBufferCatalog,
+                                              ShuffleBlockId,
+                                              ShuffleBufferCatalog)
+from spark_rapids_tpu.shuffle.client import ShuffleClient, ShuffleFetchHandler
+from spark_rapids_tpu.shuffle.server import ShuffleServer
+from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout,
+                                                 batch_string_max,
+                                                 host_to_device_batch,
+                                                 layout_to_meta,
+                                                 unpack_host_batch)
+from spark_rapids_tpu.shuffle.transport import make_transport
+
+
+class ShuffleFetchFailedError(RuntimeError):
+    """RapidsShuffleFetchFailedException analog — callers re-run the map stage
+    (Spark's lineage recompute is the recovery story, SURVEY.md §5)."""
+
+
+@dataclass(frozen=True)
+class MapStatus:
+    """Metadata-only map-completion record (sizes, no data — the data stays
+    cached on the mapper's device)."""
+    executor_id: str
+    map_id: int
+    partition_sizes: Tuple[int, ...]
+
+
+class MapOutputTracker:
+    """Driver-side registry of map outputs (org.apache.spark.MapOutputTracker
+    stand-in for the in-process cluster)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shuffles: Dict[int, Dict[int, MapStatus]] = {}
+
+    def register_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.setdefault(shuffle_id, {})
+
+    def register_map_output(self, shuffle_id: int, status: MapStatus) -> None:
+        with self._lock:
+            self._shuffles[shuffle_id][status.map_id] = status
+
+    def blocks_by_executor(self, shuffle_id: int, partition_id: int
+                           ) -> Dict[str, List[ShuffleBlockId]]:
+        """Non-empty blocks of one reduce partition, grouped by executor."""
+        with self._lock:
+            statuses = list(self._shuffles.get(shuffle_id, {}).values())
+        out: Dict[str, List[ShuffleBlockId]] = {}
+        for st in statuses:
+            if st.partition_sizes[partition_id] > 0:
+                out.setdefault(st.executor_id, []).append(
+                    ShuffleBlockId(shuffle_id, st.map_id, partition_id))
+        return out
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
+
+
+class ShuffleEnv:
+    """Per-executor shuffle wiring (GpuShuffleEnv analog): tiered stores,
+    shuffle catalog, transport + server, client cache."""
+
+    def __init__(self, executor_id: str, conf: Optional[TpuConf] = None,
+                 device_budget: int = 1 << 30, host_budget: int = 1 << 30,
+                 disk_dir: Optional[str] = None):
+        self.executor_id = executor_id
+        self.conf = conf or TpuConf()
+        self.buffer_catalog = BufferCatalog()
+        self.device_store, self.host_store, self.disk_store = build_store_chain(
+            self.buffer_catalog, device_budget, host_budget, disk_dir)
+        self.shuffle_catalog = ShuffleBufferCatalog(self.buffer_catalog,
+                                                    self.device_store)
+        self.received_catalog = ReceivedBufferCatalog()
+        self.transport = make_transport(executor_id, self.conf)
+        self.server = ShuffleServer(self.transport, self.shuffle_catalog,
+                                    self.conf.shuffle_codec)
+        self._clients: Dict[str, ShuffleClient] = {}
+        self._lock = threading.Lock()
+
+    def client_for(self, peer_executor_id: str) -> ShuffleClient:
+        with self._lock:
+            c = self._clients.get(peer_executor_id)
+            if c is None:
+                c = ShuffleClient(self.transport,
+                                  self.transport.connect(peer_executor_id),
+                                  self.received_catalog,
+                                  self.conf.shuffle_codec)
+                self._clients[peer_executor_id] = c
+            return c
+
+    def close(self) -> None:
+        self.transport.shutdown()
+        self.device_store.close()
+        self.host_store.close()
+        self.disk_store.close()
+
+
+class CachingShuffleWriter:
+    """Map-side writer: cache each partition's device batch + register meta
+    (RapidsCachingWriter analog)."""
+
+    def __init__(self, env: ShuffleEnv, tracker: MapOutputTracker,
+                 shuffle_id: int, map_id: int, num_partitions: int):
+        self.env = env
+        self.tracker = tracker
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.num_partitions = num_partitions
+
+    def write(self, partitions: Iterable[Tuple[int, object]]) -> MapStatus:
+        """``partitions`` yields (partition_id, DeviceBatch). Batches with zero
+        rows are recorded as empty (DegenerateRapidsBuffer analog: size 0)."""
+        sizes = [0] * self.num_partitions
+        for pid, batch in partitions:
+            if batch.num_rows == 0:
+                continue
+            layout = DevicePackLayout.for_batch_shape(
+                batch.schema, batch.capacity, batch_string_max(batch))
+            meta = layout_to_meta(layout, batch.num_rows)
+            block = ShuffleBlockId(self.shuffle_id, self.map_id, pid)
+            self.env.shuffle_catalog.add_batch(block, batch, meta)
+            sizes[pid] += meta.packed_size
+        status = MapStatus(self.env.executor_id, self.map_id, tuple(sizes))
+        self.tracker.register_map_output(self.shuffle_id, status)
+        return status
+
+
+class _QueueHandler(ShuffleFetchHandler):
+    """Bridges async client callbacks into the iterator's blocking queue."""
+
+    def __init__(self, q: "queue.Queue", peer: str):
+        self.q = q
+        self.peer = peer
+        self.expected = None
+
+    def start(self, expected_tables: int) -> None:
+        self.expected = expected_tables
+        self.q.put(("start", self.peer, expected_tables))
+
+    def batch_received(self, received_id: int) -> None:
+        self.q.put(("batch", self.peer, received_id))
+
+    def transfer_error(self, message: str) -> None:
+        self.q.put(("error", self.peer, message))
+
+
+class CachingShuffleReader:
+    """Reduce-side reader (RapidsCachingReader + RapidsShuffleIterator analog):
+    local blocks come straight off the catalog (device tier → zero-copy), remote
+    blocks are fetched via the transport client, uploaded on arrival."""
+
+    def __init__(self, env: ShuffleEnv, tracker: MapOutputTracker,
+                 shuffle_id: int, partition_id: int, semaphore=None,
+                 timeout: float = 120.0):
+        self.env = env
+        self.tracker = tracker
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        self.semaphore = semaphore
+        self.timeout = timeout
+
+    def read(self):
+        """Yields DeviceBatch for this reduce partition."""
+        by_exec = self.tracker.blocks_by_executor(self.shuffle_id,
+                                                  self.partition_id)
+        local_blocks = by_exec.pop(self.env.executor_id, [])
+
+        # kick off remote fetches first (overlap with local materialization)
+        q: "queue.Queue" = queue.Queue()
+        expected = 0
+        started = 0
+        for peer, blocks in by_exec.items():
+            self.env.client_for(peer).fetch(blocks, _QueueHandler(q, peer))
+            started += 1
+
+        if self.semaphore is not None:
+            self.semaphore.acquire_if_necessary()
+
+        for block in local_blocks:
+            for buf, _meta in self.env.shuffle_catalog.acquire_buffers(block):
+                try:
+                    yield buf.get_batch()
+                finally:
+                    buf.close()
+
+        # drain remote results
+        starts_seen = 0
+        received = 0
+        while starts_seen < started or received < expected:
+            try:
+                kind, peer, value = q.get(timeout=self.timeout)
+            except queue.Empty:
+                raise ShuffleFetchFailedError(
+                    f"shuffle {self.shuffle_id} partition {self.partition_id}: "
+                    f"timed out waiting for remote blocks")
+            if kind == "start":
+                starts_seen += 1
+                expected += value
+            elif kind == "error":
+                raise ShuffleFetchFailedError(
+                    f"fetch from {peer} failed: {value}")
+            else:
+                received += 1
+                raw, meta = self.env.received_catalog.take(value)
+                hb = unpack_host_batch(raw, meta)
+                yield host_to_device_batch(hb)
+
+
+class ShuffleManager:
+    """Driver-facing registry (RapidsShuffleInternalManagerBase analog)."""
+
+    def __init__(self, tracker: Optional[MapOutputTracker] = None):
+        self.tracker = tracker or MapOutputTracker()
+        self._next_shuffle = 0
+        self._lock = threading.Lock()
+
+    def register_shuffle(self, num_partitions: int) -> Tuple[int, int]:
+        with self._lock:
+            sid = self._next_shuffle
+            self._next_shuffle += 1
+        self.tracker.register_shuffle(sid)
+        return sid, num_partitions
+
+    def get_writer(self, env: ShuffleEnv, shuffle_id: int, map_id: int,
+                   num_partitions: int) -> CachingShuffleWriter:
+        return CachingShuffleWriter(env, self.tracker, shuffle_id, map_id,
+                                    num_partitions)
+
+    def get_reader(self, env: ShuffleEnv, shuffle_id: int, partition_id: int,
+                   semaphore=None) -> CachingShuffleReader:
+        return CachingShuffleReader(env, self.tracker, shuffle_id,
+                                    partition_id, semaphore)
+
+    def unregister_shuffle(self, shuffle_id: int,
+                           envs: Iterable[ShuffleEnv] = ()) -> None:
+        self.tracker.unregister_shuffle(shuffle_id)
+        for env in envs:
+            env.shuffle_catalog.remove_shuffle(shuffle_id)

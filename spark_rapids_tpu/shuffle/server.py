@@ -1,0 +1,167 @@
+"""Shuffle server: serves metadata + chunked buffer sends from the cache.
+
+Reference analog: RapidsShuffleServer.scala (671 LoC) — handleMetadataRequest:284
+serving TableMetas from the catalog, and BufferSendState:380 which acquires a
+possibly-spilled buffer (device/host/disk tier), stages it through send bounce
+buffers, and issues tag-addressed sends on a copy-executor thread.
+
+TPU specifics: a device-cached batch is packed on device (device_pack — one
+jitted bitcast+concat, the contiguous-buffer analog) and downloaded once; a
+spilled batch is packed on host from its spill arrays with identical offsets,
+so the wire format is tier-independent.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.shuffle import messages as msg
+from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.codec import compress_batch, get_codec
+from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout, TableMeta,
+                                                 batch_string_max, device_pack,
+                                                 pack_host_batch)
+from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                ServerConnection,
+                                                ShuffleTransport, Transaction,
+                                                TransactionStatus)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.memory.buffer import SpillableBuffer, StorageTier
+
+
+def _pack_spillable(buf: SpillableBuffer) -> bytes:
+    """Packed host bytes of a cached buffer, tier-aware (BufferSendState's
+    catalog acquire: device → device_pack + download, host/disk → host pack).
+
+    DOUBLE columns force the host path: TPU's x64 emulation has no f64 bitcast,
+    so such batches download their typed arrays and pack on host (same offsets,
+    tier-independent wire format either way)."""
+    if (buf.tier == StorageTier.DEVICE
+            and not any(f.dtype is DType.DOUBLE for f in buf.schema)):
+        batch = buf.get_batch()
+        layout = DevicePackLayout.for_batch_shape(
+            batch.schema, batch.capacity, batch_string_max(batch))
+        packed = device_pack(batch, layout)
+        return bytes(np.asarray(packed).tobytes())
+    arrays = buf._host_arrays()
+    hb = _host_batch_from_arrays(buf, arrays)
+    raw, _ = pack_host_batch(hb)
+    return raw
+
+
+def _host_batch_from_arrays(buf: SpillableBuffer, arrays) -> HostBatch:
+    cols = []
+    i = 0
+    for f in buf.schema:
+        if f.dtype is DType.STRING:
+            cols.append(HostColumn(f.dtype, arrays[i], arrays[i + 1],
+                                   arrays[i + 2]))
+            i += 3
+        else:
+            cols.append(HostColumn(f.dtype, arrays[i], arrays[i + 1]))
+            i += 2
+    return HostBatch(buf.schema, tuple(cols), buf.num_rows)
+
+
+class BufferSendState:
+    """Walks one packed buffer through send bounce buffers as tag-addressed
+    chunk sends (BufferSendState analog). Window = however many bounce buffers
+    the pool yields; each completed chunk re-arms its bounce buffer for the
+    next chunk until the buffer is fully sent."""
+
+    def __init__(self, server: "ShuffleServer", peer: str, data: bytes,
+                 base_tag: int, chunk_size: int):
+        self.server = server
+        self.peer = peer
+        self.data = data
+        self.base_tag = base_tag
+        self.chunk_size = chunk_size
+        self.num_chunks = max(1, -(-len(data) // chunk_size))
+        self._next_chunk = 0
+        self._outstanding = 0
+        self._lock = threading.Lock()
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+    def start(self) -> None:
+        window = min(self.num_chunks, 4)
+        bounces = self.server.transport.send_bounce.acquire(window)
+        with self._lock:
+            for bb in bounces:
+                self._arm(bb)
+
+    def _arm(self, bounce) -> None:
+        """Stage the next chunk into ``bounce`` and send it. Caller holds lock."""
+        i = self._next_chunk
+        if i >= self.num_chunks:
+            bounce.close()
+            if self._outstanding == 0 and not self.done.is_set():
+                self.done.set()
+            return
+        self._next_chunk += 1
+        self._outstanding += 1
+        start = i * self.chunk_size
+        chunk = self.data[start:start + self.chunk_size]
+        bounce.buffer[:len(chunk)] = chunk
+        alt = AddressLengthTag(bounce.buffer, len(chunk), self.base_tag + i)
+
+        def on_done(tx: Transaction, bounce=bounce):
+            with self._lock:
+                self._outstanding -= 1
+                if tx.status is not TransactionStatus.SUCCESS:
+                    self.error = tx.error_message or "send failed"
+                    bounce.close()
+                    self.done.set()
+                    return
+                self._arm(bounce)
+        self.server.server_conn.send(self.peer, alt, on_done)
+
+
+class ShuffleServer:
+    """Registers the request handlers and owns send-state lifecycles
+    (RapidsShuffleServer analog; the copy executor is the transport's
+    progress/rpc threads)."""
+
+    def __init__(self, transport: ShuffleTransport,
+                 catalog: ShuffleBufferCatalog, codec_name: str = "none"):
+        self.transport = transport
+        self.server_conn: ServerConnection = transport.server
+        self.catalog = catalog
+        self.codec_name = codec_name
+        self.server_conn.register_request_handler(msg.REQ_METADATA,
+                                                  self.handle_metadata_request)
+        self.server_conn.register_request_handler(msg.REQ_TRANSFER,
+                                                  self.handle_transfer_request)
+
+    # ---- handlers (run on transport rpc threads) --------------------------------
+    def handle_metadata_request(self, peer: str, payload: bytes) -> bytes:
+        req = msg.MetadataRequest.from_bytes(payload)
+        tables = []
+        for block in req.blocks:
+            for idx, meta in enumerate(self.catalog.metas(block)):
+                tables.append((block, idx, meta))
+        return msg.MetadataResponse(tuple(tables)).to_bytes()
+
+    def handle_transfer_request(self, peer: str, payload: bytes) -> bytes:
+        req = msg.TransferRequest.from_bytes(payload)
+        acquired = self.catalog.acquire_buffers(req.block)
+        if req.table_idx >= len(acquired):
+            for b, _ in acquired:
+                b.close()
+            raise KeyError(f"{req.block} has no table {req.table_idx}")
+        for i, (b, _) in enumerate(acquired):
+            if i != req.table_idx:
+                b.close()
+        buf, meta = acquired[req.table_idx]
+        try:
+            raw = _pack_spillable(buf)
+        finally:
+            buf.close()
+        codec = get_codec(req.codec)
+        wire, wire_meta = compress_batch(raw, meta, codec)
+        state = BufferSendState(self, peer, wire, req.base_tag, req.chunk_size)
+        state.start()
+        return msg.TransferResponse(len(wire), wire_meta).to_bytes()

@@ -1,0 +1,322 @@
+"""TableMeta: metadata describing a batch packed into ONE contiguous buffer.
+
+Reference analogs: MetaUtils.buildTableMeta (MetaUtils.scala:41-116) +
+getBatchFromMeta (MetaUtils.scala:212) and the flatbuffer TableMeta/ColumnMeta/
+SubBufferMeta schemas (sql-plugin/src/main/format/*.fbs). The reference packs a
+cuDF contiguous table (Table.contiguousSplit) and describes sub-buffer offsets
+with flatbuffers; here the pack format is fixed-width struct headers (no
+flatbuffer toolchain needed) and two symmetric pack paths:
+
+- **host pack** (`pack_host_batch`) — numpy buffers copied into one bytearray,
+  64-byte aligned; used by shuffle spill, network transfer, broadcast.
+- **device pack** (`device_pack` / `device_unpack`) — a *jittable* bitcast+concat
+  producing one uint8 vector on device, with a static `DevicePackLayout` per
+  (schema, capacity, string_max_bytes); this is what rides the ICI all_to_all
+  (the contiguousSplit analog — XLA moves one buffer per peer, not K columns).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+
+MAGIC = b"TPUM"
+VERSION = 1
+ALIGN = 64
+
+_DTYPE_CODES = {dt: i for i, dt in enumerate(DType)}
+_CODES_DTYPE = {i: dt for dt, i in _DTYPE_CODES.items()}
+
+
+def _align(n: int, a: int = ALIGN) -> int:
+    return (n + a - 1) & ~(a - 1)
+
+
+@dataclass(frozen=True)
+class SubBufferMeta:
+    """Offset/length of one sub-buffer inside the contiguous buffer
+    (SubBufferMeta.fbs analog)."""
+    offset: int
+    length: int
+
+
+@dataclass(frozen=True)
+class ColumnMeta:
+    """ColumnMeta.fbs analog: one column's dtype + sub-buffer locations."""
+    name: str
+    dtype: DType
+    nullable: bool
+    string_max_bytes: int                 # 0 for non-strings
+    data: SubBufferMeta
+    validity: SubBufferMeta
+    lengths: SubBufferMeta                # length 0 for non-strings
+
+
+@dataclass(frozen=True)
+class TableMeta:
+    """TableMeta.fbs analog. ``codec`` names the compression codec applied to
+    the packed buffer ("copy" = uncompressed, CodecType.fbs analog);
+    ``uncompressed_size`` is the unpacked buffer size either way."""
+    num_rows: int
+    columns: Tuple[ColumnMeta, ...]
+    packed_size: int
+    uncompressed_size: int
+    codec: str = "copy"
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field(c.name, c.dtype, c.nullable) for c in self.columns])
+
+    # ---- wire format ------------------------------------------------------------
+    # header: magic(4s) version(H) codec_len(B) pad(B) num_rows(Q) num_cols(H)
+    #         packed_size(Q) uncompressed_size(Q)
+    _HDR = struct.Struct("<4sHBxQHQQ")
+    # per column: name_len(H) dtype(B) nullable(B) smax(I) 3×(offset Q, length Q)
+    _COL = struct.Struct("<HBBIQQQQQQ")
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        codec_b = self.codec.encode()
+        out += self._HDR.pack(MAGIC, VERSION, len(codec_b), self.num_rows,
+                              len(self.columns), self.packed_size,
+                              self.uncompressed_size)
+        out += codec_b
+        for c in self.columns:
+            nb = c.name.encode()
+            out += self._COL.pack(len(nb), _DTYPE_CODES[c.dtype],
+                                  1 if c.nullable else 0, c.string_max_bytes,
+                                  c.data.offset, c.data.length,
+                                  c.validity.offset, c.validity.length,
+                                  c.lengths.offset, c.lengths.length)
+            out += nb
+        return bytes(out)
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "TableMeta":
+        magic, ver, codec_len, num_rows, ncols, psize, usize = \
+            TableMeta._HDR.unpack_from(b, 0)
+        if magic != MAGIC:
+            raise ValueError(f"bad TableMeta magic {magic!r}")
+        if ver != VERSION:
+            raise ValueError(f"unsupported TableMeta version {ver}")
+        pos = TableMeta._HDR.size
+        codec = b[pos:pos + codec_len].decode()
+        pos += codec_len
+        cols: List[ColumnMeta] = []
+        for _ in range(ncols):
+            (nlen, dcode, nullable, smax, doff, dlen, voff, vlen, loff,
+             llen) = TableMeta._COL.unpack_from(b, pos)
+            pos += TableMeta._COL.size
+            name = b[pos:pos + nlen].decode()
+            pos += nlen
+            cols.append(ColumnMeta(name, _CODES_DTYPE[dcode], bool(nullable),
+                                   smax, SubBufferMeta(doff, dlen),
+                                   SubBufferMeta(voff, vlen),
+                                   SubBufferMeta(loff, llen)))
+        return TableMeta(num_rows, tuple(cols), psize, usize, codec)
+
+    def with_codec(self, codec: str, packed_size: int) -> "TableMeta":
+        return replace(self, codec=codec, packed_size=packed_size)
+
+
+# ---------------------------------------------------------------------------------
+# host pack / unpack
+# ---------------------------------------------------------------------------------
+
+def pack_host_batch(batch: HostBatch) -> Tuple[bytes, TableMeta]:
+    """Copy all column buffers into one contiguous, 64-byte-aligned buffer."""
+    chunks: List[Tuple[int, bytes]] = []       # (offset, raw)
+    cols: List[ColumnMeta] = []
+    pos = 0
+
+    def put(arr: Optional[np.ndarray]) -> SubBufferMeta:
+        nonlocal pos
+        if arr is None:
+            return SubBufferMeta(0, 0)
+        raw = np.ascontiguousarray(arr).tobytes()
+        off = pos
+        chunks.append((off, raw))
+        pos = _align(off + len(raw))
+        return SubBufferMeta(off, len(raw))
+
+    for f, c in zip(batch.schema, batch.columns):
+        smax = int(c.data.shape[1]) if f.dtype is DType.STRING else 0
+        cols.append(ColumnMeta(f.name, f.dtype, f.nullable, smax,
+                               put(c.data), put(c.validity), put(c.lengths)))
+    buf = bytearray(pos)
+    for off, raw in chunks:
+        buf[off:off + len(raw)] = raw
+    meta = TableMeta(batch.num_rows, tuple(cols), len(buf), len(buf))
+    return bytes(buf), meta
+
+
+def unpack_host_batch(buf: bytes, meta: TableMeta) -> HostBatch:
+    """Rebuild a HostBatch from a contiguous buffer (getBatchFromMeta analog)."""
+    if meta.codec != "copy":
+        raise ValueError(f"buffer still compressed with {meta.codec!r}; "
+                         f"decompress first (BatchedBufferDecompressor analog)")
+    mv = memoryview(buf)
+    cols: List[HostColumn] = []
+    for cm in meta.columns:
+        npdt = cm.dtype.np_dtype()
+
+        def sub(s: SubBufferMeta, dt, shape=None):
+            a = np.frombuffer(mv[s.offset:s.offset + s.length], dtype=dt)
+            return a.reshape(shape) if shape is not None else a
+
+        validity = sub(cm.validity, np.bool_)
+        n_cap = len(validity)
+        if cm.dtype is DType.STRING:
+            data = sub(cm.data, np.uint8, (n_cap, cm.string_max_bytes))
+            lengths = sub(cm.lengths, np.int32)
+            cols.append(HostColumn(cm.dtype, data, validity, lengths))
+        else:
+            cols.append(HostColumn(cm.dtype, sub(cm.data, npdt), validity))
+    return HostBatch(meta.schema, tuple(cols), meta.num_rows)
+
+
+# ---------------------------------------------------------------------------------
+# device pack / unpack (jittable; static layout per schema+capacity)
+# ---------------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DevicePackLayout:
+    """Static byte layout of a device-packed batch — computed from
+    (schema, capacity, string_max_bytes) only, so the pack/unpack programs
+    compile once per layout and the ICI all_to_all moves fixed-size buffers."""
+    schema: Schema
+    capacity: int
+    string_max_bytes: int
+    subs: Tuple[Tuple[SubBufferMeta, SubBufferMeta, SubBufferMeta], ...] = field(
+        default=())
+    total_size: int = 0
+
+    @staticmethod
+    def for_batch_shape(schema: Schema, capacity: int,
+                        string_max_bytes: int) -> "DevicePackLayout":
+        pos = 0
+        subs = []
+        for f in schema:
+            if f.dtype is DType.STRING:
+                dsize = capacity * string_max_bytes
+                lsize = capacity * 4
+            else:
+                dsize = capacity * f.dtype.element_size()
+                lsize = 0
+            d = SubBufferMeta(pos, dsize); pos = _align(pos + dsize)
+            v = SubBufferMeta(pos, capacity); pos = _align(pos + capacity)
+            if lsize:
+                l = SubBufferMeta(pos, lsize); pos = _align(pos + lsize)
+            else:
+                l = SubBufferMeta(0, 0)
+            subs.append((d, v, l))
+        return DevicePackLayout(schema, capacity, string_max_bytes,
+                                tuple(subs), pos)
+
+
+def batch_string_max(batch) -> int:
+    """String matrix width of a batch (0 if no string columns). One width per
+    batch is a layout invariant: writer meta and server pack must agree."""
+    for c in batch.columns:
+        if c.dtype is DType.STRING:
+            return int(c.data.shape[1])
+    return 0
+
+
+def layout_to_meta(layout: DevicePackLayout, num_rows: int) -> TableMeta:
+    """TableMeta describing a device-packed buffer. Because device packing and
+    host packing use the same 64-byte alignment over capacity-sized buffers,
+    this meta also round-trips through unpack_host_batch on downloaded bytes."""
+    cols = []
+    for f, (d, v, l) in zip(layout.schema, layout.subs):
+        smax = layout.string_max_bytes if f.dtype is DType.STRING else 0
+        cols.append(ColumnMeta(f.name, f.dtype, f.nullable, smax, d, v, l))
+    return TableMeta(num_rows, tuple(cols), layout.total_size, layout.total_size)
+
+
+def host_to_device_batch(hb: HostBatch):
+    """Upload an unpacked (capacity-sized) HostBatch to the device."""
+    import jax
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    cols = []
+    for c in hb.columns:
+        cols.append(DeviceColumn(
+            c.dtype, jax.device_put(c.data), jax.device_put(c.validity),
+            jax.device_put(c.lengths) if c.lengths is not None else None))
+    return DeviceBatch(hb.schema, tuple(cols), hb.num_rows)
+
+
+def _to_u8(arr):
+    """Bitcast any fixed-width device array to a flat uint8 vector (jittable).
+
+    64-bit integers route through a u32 intermediate: TPU emulates x64 as u32
+    pairs and its X64 rewriter implements i64<->u32 bitcasts but not i64<->u8;
+    the two-step chain produces the same little-endian bytes as a direct cast
+    (verified against numpy tobytes on TPU and CPU backends). float64 has no
+    working device bitcast on TPU at all — callers with f64 columns use the
+    host pack path instead (see server._pack_spillable)."""
+    import jax.numpy as jnp
+    from jax import lax
+    if arr.dtype == jnp.bool_:
+        arr = arr.astype(jnp.uint8)
+    if arr.dtype in (jnp.int64, jnp.uint64):
+        arr = lax.bitcast_convert_type(arr, jnp.uint32)
+    if arr.dtype != jnp.uint8:
+        arr = lax.bitcast_convert_type(arr, jnp.uint8)
+    return arr.reshape(-1)
+
+
+def _from_u8(flat, dtype, shape):
+    import jax.numpy as jnp
+    from jax import lax
+    npdt = np.dtype(dtype)
+    if npdt == np.bool_:
+        return flat.reshape(shape).astype(jnp.bool_)
+    if npdt == np.uint8:
+        return flat.reshape(shape)
+    itemsize = npdt.itemsize
+    if npdt in (np.dtype(np.int64), np.dtype(np.uint64)):
+        words = lax.bitcast_convert_type(
+            flat.reshape(tuple(shape) + (2, 4)), jnp.uint32)
+        return lax.bitcast_convert_type(words, jnp.dtype(npdt))
+    return lax.bitcast_convert_type(
+        flat.reshape(tuple(shape) + (itemsize,)), jnp.dtype(npdt))
+
+
+def device_pack(batch, layout: DevicePackLayout):
+    """DeviceBatch -> one uint8[layout.total_size] device array. Jittable."""
+    import jax.numpy as jnp
+    out = jnp.zeros((layout.total_size,), dtype=jnp.uint8)
+    for col, (d, v, l) in zip(batch.columns, layout.subs):
+        out = out.at[d.offset:d.offset + d.length].set(_to_u8(col.data))
+        out = out.at[v.offset:v.offset + v.length].set(_to_u8(col.validity))
+        if l.length:
+            out = out.at[l.offset:l.offset + l.length].set(_to_u8(col.lengths))
+    return out
+
+
+def device_unpack(buf, layout: DevicePackLayout, num_rows):
+    """uint8 device buffer -> DeviceBatch (padding rows already invalid).
+    Jittable in the arrays; ``num_rows`` is host-side."""
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    cap = layout.capacity
+    cols = []
+    for f, (d, v, l) in zip(layout.schema, layout.subs):
+        validity = _from_u8(buf[v.offset:v.offset + v.length], np.bool_, (cap,))
+        if f.dtype is DType.STRING:
+            data = _from_u8(buf[d.offset:d.offset + d.length], np.uint8,
+                            (cap, layout.string_max_bytes))
+            lengths = _from_u8(buf[l.offset:l.offset + l.length], np.int32, (cap,))
+            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+        else:
+            data = _from_u8(buf[d.offset:d.offset + d.length],
+                            f.dtype.np_dtype(), (cap,))
+            cols.append(DeviceColumn(f.dtype, data, validity))
+    return DeviceBatch(layout.schema, tuple(cols), num_rows)
