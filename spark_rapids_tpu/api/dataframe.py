@@ -188,10 +188,19 @@ class DataFrame:
         from spark_rapids_tpu.memory.device_manager import DeviceManager
         final = self._executed_plan()
         dm = DeviceManager.initialize(self.session.conf)
-        ctx = ExecContext(self.session.conf, device_manager=dm)
-        # device-admission throttle for the whole task (GpuSemaphore analog)
-        with dm.semaphore.held():
-            tables = [b.to_arrow() for b in final.execute(ctx)]
+        cleanups: List = []
+        tables = []
+        try:
+            # device-admission throttle for the whole task (GpuSemaphore analog)
+            with dm.semaphore.held():
+                for p in range(final.num_partitions):
+                    ctx = ExecContext(self.session.conf, partition_id=p,
+                                      num_partitions=final.num_partitions,
+                                      device_manager=dm, cleanups=cleanups)
+                    tables.extend(b.to_arrow() for b in final.execute(ctx))
+        finally:
+            for fn in cleanups:
+                fn()
         schema = self._plan.schema().to_pa()
         if not tables:
             return schema.empty_table()

@@ -20,11 +20,15 @@ class ExecContext:
     """Per-execution state handed down the operator tree."""
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
-                 num_partitions: int = 1, device_manager=None):
+                 num_partitions: int = 1, device_manager=None,
+                 cleanups: Optional[list] = None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.device_manager = device_manager
+        #: shared across the partitions of one action; run by the caller when
+        #: the query finishes (shuffle unregistration etc.)
+        self.cleanups = cleanups
 
     @property
     def string_max_bytes(self) -> int:
@@ -46,6 +50,12 @@ class PhysicalExec:
     @property
     def name(self) -> str:
         return type(self).__name__
+
+    @property
+    def num_partitions(self) -> int:
+        """Output partition count (outputPartitioning analog). Exchanges
+        override; everything else preserves the widest child."""
+        return max((c.num_partitions for c in self.children), default=1)
 
     def execute(self, ctx: ExecContext) -> Iterator:
         raise NotImplementedError(self.name)

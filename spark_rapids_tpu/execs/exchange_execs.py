@@ -1,0 +1,569 @@
+"""Shuffle exchange operators + partitioning implementations.
+
+Reference analogs:
+- GpuShuffleExchangeExec (execution/GpuShuffleExchangeExec.scala, 254 LoC) —
+  partitions each child batch on device, hands the pieces to the shuffle
+  manager, and reads one reduce partition back;
+- the partitioning impls: GpuHashPartitioning.scala (murmur3 hash +
+  Table.partition, partitionInternal:86), GpuRangePartitioning +
+  GpuRangePartitioner (sample-based bounds via SamplingUtils),
+  GpuRoundRobinPartitioning, GpuSinglePartitioning;
+- the common split path Table.contiguousSplit (GpuPartitioning.scala:44-75) —
+  here ONE stable argsort by target partition id + per-partition counts, then
+  host-static slices, all inside a single jitted XLA program per
+  (partitioning, schema, capacity) key;
+- ShuffledBatchRDD / GpuShuffleDependency (execution/ShuffledBatchRDD.scala) —
+  the reduce side reads through the caching shuffle manager, so map outputs
+  stay resident on device (spilling host/disk under memory pressure).
+
+The CPU exchange stands in for Spark's stock shuffle (the non-accelerated
+columnar path through GpuColumnarBatchSerializer): an in-memory split with the
+exact same generic kernels run under numpy, so CPU-vs-TPU compare tests cover
+the partitioning math itself.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DType, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.cpu_execs import _colvs_to_host, _host_colvs
+from spark_rapids_tpu.execs.tpu_execs import (_cached_jit, _flatten,
+                                              _unflatten_colvs)
+from spark_rapids_tpu.exprs.core import (ColV, EvalCtx, Expression,
+                                         flatten_colvs)
+from spark_rapids_tpu.exprs.misc import SortOrder
+from spark_rapids_tpu.ops import batch_kernels as bk
+
+
+# ------------------------------------------------------------------ partitionings
+@dataclass(frozen=True)
+class Partitioning:
+    """Base partitioning spec (GpuPartitioning analog)."""
+    num_partitions: int
+
+    @property
+    def expressions(self) -> Tuple[Expression, ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class SinglePartitioning(Partitioning):
+    """Everything into one partition (GpuSinglePartitioning analog)."""
+    num_partitions: int = 1
+
+
+@dataclass(frozen=True)
+class RoundRobinPartitioning(Partitioning):
+    """Row-cycling distribution (GpuRoundRobinPartitioning analog; start
+    offset varies per map partition/batch like Spark's per-partition start)."""
+
+
+@dataclass(frozen=True)
+class HashPartitioning(Partitioning):
+    """Key-hash distribution (GpuHashPartitioning analog — murmur3-style
+    finalizer over the key columns instead of cudf's murmur3 kernel)."""
+    keys: Tuple[Expression, ...] = ()
+
+    @property
+    def expressions(self) -> Tuple[Expression, ...]:
+        return self.keys
+
+
+@dataclass(frozen=True)
+class RangePartitioning(Partitioning):
+    """Sample-based contiguous key ranges (GpuRangePartitioning +
+    GpuRangePartitioner analog). Bounds are computed at map time from a
+    deterministic sample of the input (SamplingUtils role)."""
+    orders: Tuple[SortOrder, ...] = ()
+
+    @property
+    def expressions(self) -> Tuple[Expression, ...]:
+        return self.orders
+
+
+# ------------------------------------------------------------------ hash kernel
+_H_M1 = np.uint32(0x85EBCA6B)
+_H_M2 = np.uint32(0xC2B2AE35)
+_H_NULL = np.uint32(0x9E3779B9)
+_H_SEED = np.uint32(42)
+
+
+def _fmix32(xp, h):
+    """murmur3 32-bit finalizer (the mixer GpuHashPartitioning gets from cudf's
+    murmur3 kernel; bit-exact Spark parity is not required for correctness —
+    only that equal keys map to equal partitions on both engines)."""
+    h = xp.bitwise_xor(h, xp.right_shift(h, np.uint32(16)))
+    h = (h * _H_M1).astype(np.uint32)
+    h = xp.bitwise_xor(h, xp.right_shift(h, np.uint32(13)))
+    h = (h * _H_M2).astype(np.uint32)
+    h = xp.bitwise_xor(h, xp.right_shift(h, np.uint32(16)))
+    return h
+
+
+def _column_hash(xp, v: ColV) -> "np.ndarray":
+    """Per-row uint32 hash of one key column. Equal values (incl. NaN≡NaN,
+    -0.0≡0.0, Spark grouping semantics) hash equal."""
+    if v.dtype is DType.STRING:
+        smax = v.data.shape[-1]
+        weights = np.empty(smax, dtype=np.uint32)
+        w = 1
+        for i in range(smax):
+            weights[i] = w
+            w = (w * 37) & 0xFFFFFFFF
+        h = xp.sum(v.data.astype(np.uint32) * xp.asarray(weights)[None, :],
+                   axis=-1, dtype=np.uint32)
+        h = xp.bitwise_xor(h, v.lengths.astype(np.uint32))
+        return _fmix32(xp, h)
+    if v.dtype.is_floating:
+        d = v.data.astype(np.float64)
+        # canonicalize: all NaNs equal, -0.0 == 0.0
+        d = xp.where(xp.isnan(d), np.float64(np.nan), d)
+        d = xp.where(d == 0, np.float64(0.0), d)
+        if xp is np:
+            bits = d.view(np.int64)
+        else:
+            bits = jax.lax.bitcast_convert_type(d, jnp.int64)
+    elif v.dtype is DType.BOOLEAN:
+        bits = v.data.astype(np.int64)
+    else:
+        bits = v.data.astype(np.int64)
+    lo = (bits & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    hi = xp.right_shift(bits, np.int64(32)).astype(np.uint32)
+    return _fmix32(xp, xp.bitwise_xor(_fmix32(xp, lo), hi))
+
+
+def hash_partition_ids(xp, keys: Sequence[ColV], cap: int, n: int):
+    """Target partition id per row from the key columns."""
+    h = xp.full((cap,), _H_SEED, dtype=np.uint32)
+    for v in keys:
+        ch = _column_hash(xp, v)
+        if ch.ndim == 0:  # scalar key (literal)
+            ch = xp.broadcast_to(ch, (cap,))
+        valid = v.validity
+        if getattr(valid, "ndim", 1) == 0:
+            valid = xp.broadcast_to(valid, (cap,))
+        ch = xp.where(valid, ch, _H_NULL)
+        h = _fmix32(xp, (h * np.uint32(31) + ch).astype(np.uint32))
+    return (h % np.uint32(n)).astype(np.int32)
+
+
+def _lex_gt_bounds(xp, row_passes: List, bound_passes: List):
+    """pid per row = number of bounds strictly less than the row, comparing the
+    sortable key transforms lexicographically (GpuRangePartitioner's
+    binary-search equivalent, vectorized over all bounds at once)."""
+    cap = row_passes[0].shape[0]
+    nb = bound_passes[0].shape[0]
+    gt = xp.zeros((cap, nb), dtype=bool)
+    eq = xp.ones((cap, nb), dtype=bool)
+    for r, b in zip(row_passes, bound_passes):
+        rb = r[:, None]
+        bb = b[None, :]
+        gt = xp.logical_or(gt, xp.logical_and(eq, rb > bb))
+        eq = xp.logical_and(eq, rb == bb)
+    return xp.sum(gt, axis=1).astype(np.int32)
+
+
+def range_partition_ids(xp, orders: Sequence[SortOrder], row_keys: Sequence[ColV],
+                        bound_keys: Sequence[ColV], cap: int):
+    row_passes: List = []
+    bound_passes: List = []
+    for o, rv, bv in zip(orders, row_keys, bound_keys):
+        row_passes.extend(bk._key_passes(xp, rv, o.ascending, o.nulls_first))
+        bound_passes.extend(bk._key_passes(xp, bv, o.ascending, o.nulls_first))
+    return _lex_gt_bounds(xp, row_passes, bound_passes)
+
+
+# ------------------------------------------------------------------ split kernel
+def split_by_pid(xp, colvs: Sequence[ColV], pids, num_rows, n: int):
+    """Stable partition-major reorder + per-partition counts — the
+    Table.partition + contiguousSplit analog. Dead (padding) rows sort to a
+    virtual partition n at the back. Returns (reordered colvs, counts[n])."""
+    cap = pids.shape[0]
+    alive = bk.alive_mask(xp, cap, num_rows)
+    key = xp.where(alive, pids, np.int32(n))
+    order = bk._stable_argsort(xp, key)
+    out = [bk.take_colv(xp, v, order) for v in colvs]
+    if xp is np:
+        counts = np.bincount(key, minlength=n + 1)[:n].astype(np.int64)
+    else:
+        counts = jnp.bincount(key, length=n + 1)[:n].astype(jnp.int64)
+    return out, counts
+
+
+def _slice_padded(colvs: Sequence[ColV], schema: Schema, start: int,
+                  cnt: int) -> DeviceBatch:
+    """One contiguous slice of partition-major columns -> a fresh DeviceBatch
+    (live rows first, re-bucketed capacity, zero padding)."""
+    cap = bucket_capacity(cnt)
+    pad = cap - cnt
+    cols = []
+    for f, v in zip(schema, colvs):
+        data = v.data[start:start + cnt]
+        validity = v.validity[start:start + cnt]
+        if pad:
+            data = jnp.concatenate(
+                [data, jnp.zeros((pad,) + data.shape[1:], data.dtype)], axis=0)
+            validity = jnp.concatenate([validity, jnp.zeros(pad, bool)], axis=0)
+        if f.dtype is DType.STRING:
+            lengths = v.lengths[start:start + cnt]
+            if pad:
+                lengths = jnp.concatenate(
+                    [lengths, jnp.zeros(pad, lengths.dtype)], axis=0)
+            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+        else:
+            cols.append(DeviceColumn(f.dtype, data, validity))
+    return DeviceBatch(schema, tuple(cols), cnt)
+
+
+# ------------------------------------------------------------------ bounds
+_SAMPLE_TARGET = 4096
+
+
+def _sample_bounds(orders: Sequence[SortOrder], sampled: List[List[ColV]],
+                   n: int) -> Optional[List[ColV]]:
+    """Range bounds from per-batch key samples (numpy ColVs, live rows only).
+    Returns one ColV per order key holding the n-1 bound values."""
+    if not sampled or n <= 1:
+        return None
+    merged: List[ColV] = []
+    for ki in range(len(orders)):
+        parts = [batch_keys[ki] for batch_keys in sampled]
+        data = np.concatenate([np.asarray(p.data) for p in parts])
+        validity = np.concatenate([np.asarray(p.validity) for p in parts])
+        lengths = (np.concatenate([np.asarray(p.lengths) for p in parts])
+                   if parts[0].lengths is not None else None)
+        merged.append(ColV(parts[0].dtype, data, validity, lengths))
+    total = merged[0].validity.shape[0]
+    if total == 0:
+        return None
+    passes: List = []
+    for o, v in zip(orders, merged):
+        passes.extend(bk._key_passes(np, v, o.ascending, o.nulls_first))
+    order = np.lexsort(tuple(reversed([np.asarray(p) for p in passes])))
+    # quantile positions: bound i splits at (i+1)/n of the sorted sample
+    idx = [order[min(total - 1, ((i + 1) * total) // n)] for i in range(n - 1)]
+    idx = np.asarray(idx, dtype=np.int64)
+    return [bk.take_colv(np, v, idx) for v in merged]
+
+
+def _sample_rows(colvs: List[ColV], num_rows: int, k: int) -> List[ColV]:
+    """Deterministic evenly-spaced row sample (SamplingUtils stand-in)."""
+    if num_rows <= 0:
+        idx = np.zeros(0, dtype=np.int64)
+    else:
+        k = min(k, num_rows)
+        idx = np.linspace(0, num_rows - 1, k).astype(np.int64)
+    return [bk.take_colv(np, v, idx) for v in colvs]
+
+
+# ------------------------------------------------------------------ exec base
+class ShuffleExchangeExecBase(PhysicalExec):
+    def __init__(self, partitioning: Partitioning, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self.partitioning = partitioning
+        self._lock = threading.Lock()
+        self._map_done = False
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _child_contexts(self, ctx: ExecContext) -> Iterator[ExecContext]:
+        child_parts = self.children[0].num_partitions
+        for p in range(child_parts):
+            yield ExecContext(ctx.conf, partition_id=p,
+                              num_partitions=child_parts,
+                              device_manager=ctx.device_manager,
+                              cleanups=ctx.cleanups)
+
+
+class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
+    """In-memory exchange for the CPU engine (the stock-Spark-shuffle role)."""
+
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        with self._lock:
+            if not self._map_done:
+                self._run_map(ctx)
+                self._map_done = True
+        for hb in self._parts.get(ctx.partition_id, []):
+            self.count_output(hb.num_rows)
+            yield hb
+
+    def _run_map(self, ctx: ExecContext) -> None:
+        n = self.partitioning.num_partitions
+        self._parts: Dict[int, List[HostBatch]] = {}
+        if ctx.cleanups is not None:
+            # release the shuffled copy when the action finishes (the exec tree
+            # outlives the action via session.last_plan)
+            ctx.cleanups.append(self._release)
+        part = self.partitioning
+
+        # only range partitioning needs the two-pass staging (bounds sampling)
+        bounds = None
+        if isinstance(part, RangePartitioning):
+            staged: List[Tuple[int, int, HostBatch]] = []
+            for cctx in self._child_contexts(ctx):
+                for bi, hb in enumerate(self.children[0].execute(cctx)):
+                    staged.append((cctx.partition_id, bi, hb))
+            sampled = []
+            per = max(1, _SAMPLE_TARGET // max(1, len(staged)))
+            for _, _, hb in staged:
+                colvs = _host_colvs(hb)
+                ectx = EvalCtx(np, colvs, hb.num_rows, ctx.string_max_bytes)
+                keys = [o.child.eval(ectx) for o in part.orders]
+                sampled.append(_sample_rows(keys, hb.num_rows, per))
+            bounds = _sample_bounds(part.orders, sampled, n)
+            batches = iter(staged)
+        else:
+            batches = ((cctx.partition_id, bi, hb)
+                       for cctx in self._child_contexts(ctx)
+                       for bi, hb in enumerate(self.children[0].execute(cctx)))
+
+        for map_p, bi, hb in batches:
+            colvs = _host_colvs(hb)
+            cap = hb.num_rows
+            offset = _round_robin_offset(part, map_p, bi)
+            ectx = EvalCtx(np, colvs, cap, ctx.string_max_bytes)
+            with np.errstate(invalid="ignore", over="ignore"):
+                pids = _compute_pids(np, part, ectx, cap, offset, bounds)
+            sorted_cols, counts = split_by_pid(np, colvs, pids, hb.num_rows, n)
+            offsets = np.concatenate([[0], np.cumsum(counts)])
+            for j in range(n):
+                cnt = int(counts[j])
+                if cnt == 0:
+                    continue
+                start = int(offsets[j])
+                sub = [ColV(v.dtype,
+                            np.asarray(v.data)[start:start + cnt],
+                            np.asarray(v.validity)[start:start + cnt],
+                            (np.asarray(v.lengths)[start:start + cnt]
+                             if v.lengths is not None else None))
+                       for v in sorted_cols]
+                self._parts.setdefault(j, []).append(
+                    _colvs_to_host(self.output, sub, cnt))
+
+    def _release(self) -> None:
+        self._parts = {}
+        self._map_done = False
+
+
+def _round_robin_offset(part: Partitioning, map_partition: int,
+                        batch_index: int) -> int:
+    """Start offset of the row cycle; only round robin distinguishes batches
+    (keeps jit cache keys independent of batch identity for the others)."""
+    if isinstance(part, RoundRobinPartitioning):
+        return (map_partition * 7919 + batch_index) % part.num_partitions
+    return 0
+
+
+def _compute_pids(xp, part: Partitioning, ectx: EvalCtx, cap: int,
+                  offset: int, bounds: Optional[List[ColV]]):
+    if isinstance(part, SinglePartitioning) or part.num_partitions == 1:
+        return xp.zeros(cap, dtype=np.int32)
+    if isinstance(part, RoundRobinPartitioning):
+        return ((xp.arange(cap, dtype=np.int32) + np.int32(offset))
+                % np.int32(part.num_partitions)).astype(np.int32)
+    if isinstance(part, HashPartitioning):
+        keys = [e.eval(ectx) for e in part.keys]
+        return hash_partition_ids(xp, keys, cap, part.num_partitions)
+    if isinstance(part, RangePartitioning):
+        if bounds is None:
+            return xp.zeros(cap, dtype=np.int32)
+        row_keys = [o.child.eval(ectx) for o in part.orders]
+        return range_partition_ids(xp, part.orders, row_keys, bounds, cap)
+    raise NotImplementedError(type(part).__name__)
+
+
+# ------------------------------------------------------------------ TPU exchange
+class _LocalShuffleEnv:
+    """Minimal single-executor env facade over the DeviceManager's spillable
+    store (the GpuShuffleEnv role for the in-process engine — map outputs are
+    cached on device and spill HBM->host->disk under pressure)."""
+
+    def __init__(self, device_manager):
+        from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+        self.executor_id = "local"
+        self.shuffle_catalog = ShuffleBufferCatalog(
+            device_manager.catalog, device_manager.device_store)
+
+
+_EXCHANGE_IDS = itertools.count()
+
+
+def _local_shuffle_env(ctx: ExecContext) -> _LocalShuffleEnv:
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    dm = ctx.device_manager or DeviceManager.initialize(ctx.conf)
+    env = getattr(dm, "_exchange_shuffle_env", None)
+    if env is None:
+        env = _LocalShuffleEnv(dm)
+        dm._exchange_shuffle_env = env
+    return env
+
+
+class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
+    """Device exchange: partition each child batch on device (one jitted
+    sort+count program), cache the pieces in the spillable shuffle catalog,
+    read one reduce partition back per consumer (GpuShuffleExchangeExec +
+    RapidsCachingWriter/Reader composition)."""
+
+    is_device = True
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        with self._lock:
+            if not self._map_done:
+                self._run_map(ctx)
+                self._map_done = True
+        env = _local_shuffle_env(ctx)
+        for block in env.shuffle_catalog.blocks_for_partition(
+                self._shuffle_id, ctx.partition_id):
+            for buf, _meta in env.shuffle_catalog.acquire_buffers(block):
+                try:
+                    batch = buf.get_batch()
+                finally:
+                    buf.close()
+                self.count_output(batch.num_rows)
+                yield batch
+
+    # ---- map side ------------------------------------------------------------
+    def _run_map(self, ctx: ExecContext) -> None:
+        from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+        from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout,
+                                                         batch_string_max,
+                                                         layout_to_meta)
+        env = _local_shuffle_env(ctx)
+        sid = next(_EXCHANGE_IDS)
+        self._shuffle_id = sid
+        if ctx.cleanups is not None:
+            ctx.cleanups.append(
+                lambda: env.shuffle_catalog.remove_shuffle(sid))
+        n = self.partitioning.num_partitions
+        part = self.partitioning
+
+        # only range partitioning stages the child (bounds need a global
+        # sample); the rest split-and-cache each batch as it is produced, so
+        # peak footprint is one batch plus the spillable shuffle cache
+        bounds = None
+        if isinstance(part, RangePartitioning):
+            staged = [(cctx.partition_id, bi, db)
+                      for cctx in self._child_contexts(ctx)
+                      for bi, db in enumerate(self.children[0].execute(cctx))]
+            bounds = self._device_bounds(ctx, part, staged, n)
+            batches = iter(staged)
+        else:
+            batches = ((cctx.partition_id, bi, db)
+                       for cctx in self._child_contexts(ctx)
+                       for bi, db in enumerate(self.children[0].execute(cctx)))
+
+        map_id = 0
+        for map_p, bi, db in batches:
+            if db.num_rows == 0:
+                continue
+            offset = _round_robin_offset(part, map_p, bi)
+            for j, sub in self._split_batch(ctx, part, db, offset, n, bounds):
+                layout = DevicePackLayout.for_batch_shape(
+                    sub.schema, sub.capacity, batch_string_max(sub))
+                meta = layout_to_meta(layout, sub.num_rows)
+                env.shuffle_catalog.add_batch(
+                    ShuffleBlockId(sid, map_id, j), sub, meta)
+            map_id += 1
+
+    def _split_batch(self, ctx, part, db: DeviceBatch, offset: int, n: int,
+                     bounds):
+        """One jitted program: pids + partition-major reorder + counts."""
+        schema = db.schema
+        cap = db.capacity
+        smax = ctx.string_max_bytes
+        if isinstance(part, SinglePartitioning) or n == 1:
+            yield 0, db
+            return
+        bounds_flat = tuple(flatten_colvs(bounds)) if bounds else ()
+        nb = bounds[0].validity.shape[0] if bounds else 0
+        key = ("exchange", part, schema, cap, smax, nb, offset)
+
+        def build(part=part, schema=schema, cap=cap, smax=smax,
+                  offset=offset, nb=nb):
+            def fn(num_rows, *args):
+                bnd = None
+                consumed = 0
+                if nb:
+                    bnd = []
+                    for o in part.orders:
+                        dt = o.child.dtype()
+                        if dt is DType.STRING:
+                            bnd.append(ColV(dt, args[consumed],
+                                            args[consumed + 1],
+                                            args[consumed + 2]))
+                            consumed += 3
+                        else:
+                            bnd.append(ColV(dt, args[consumed],
+                                            args[consumed + 1]))
+                            consumed += 2
+                flat = args[consumed:]
+                colvs = _unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                pids = _compute_pids(jnp, part, ectx, cap, offset, bnd)
+                sorted_cols, counts = split_by_pid(jnp, colvs, pids,
+                                                   num_rows, n)
+                return tuple(flatten_colvs(sorted_cols)) + (counts,)
+            return fn
+
+        fn = _cached_jit(key, build)
+        res = fn(np.int32(db.num_rows), *bounds_flat, *_flatten(db))
+        counts = np.asarray(res[-1])
+        sorted_cols = _unflatten_colvs(schema, res[:-1])
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        for j in range(n):
+            cnt = int(counts[j])
+            if cnt == 0:
+                continue
+            yield j, _slice_padded(sorted_cols, schema, int(offsets[j]), cnt)
+
+    def _device_bounds(self, ctx, part: RangePartitioning,
+                       staged, n: int) -> Optional[List[ColV]]:
+        """Evaluate order keys per batch on device, download a deterministic
+        sample, derive bounds on host."""
+        if not staged:
+            return None
+        per = max(1, _SAMPLE_TARGET // len(staged))
+        sampled = []
+        for _, _, db in staged:
+            if db.num_rows == 0:
+                continue
+            schema, cap, smax = db.schema, db.capacity, ctx.string_max_bytes
+            key = ("exchange-keys", part.orders, schema, cap, smax)
+
+            def build(orders=part.orders, schema=schema, cap=cap, smax=smax):
+                def fn(*flat):
+                    colvs = _unflatten_colvs(schema, flat)
+                    ectx = EvalCtx(jnp, colvs, cap, smax)
+                    keys = [o.child.eval(ectx) for o in orders]
+                    return tuple(flatten_colvs(keys))
+                return fn
+
+            fn = _cached_jit(key, build)
+            flat = [np.asarray(a) for a in fn(*_flatten(db))]
+            keys = []
+            i = 0
+            for o in part.orders:
+                dt = o.child.dtype()
+                if dt is DType.STRING:
+                    keys.append(ColV(dt, flat[i], flat[i + 1], flat[i + 2]))
+                    i += 3
+                else:
+                    keys.append(ColV(dt, flat[i], flat[i + 1]))
+                    i += 2
+            sampled.append(_sample_rows(keys, db.num_rows, per))
+        return _sample_bounds(part.orders, sampled, n)

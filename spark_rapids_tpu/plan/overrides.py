@@ -371,9 +371,22 @@ def _make_window_rules() -> List[ExecRule]:
                      exprs_of=lambda e: e.wexprs)]
 
 
+def _convert_exchange(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.exchange_execs import TpuShuffleExchangeExec
+    return TpuShuffleExchangeExec(meta.exec.partitioning, children[0])
+
+
+def _make_exchange_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.execs.exchange_execs import CpuShuffleExchangeExec
+    return [ExecRule(CpuShuffleExchangeExec, "shuffle exchange",
+                     _convert_exchange,
+                     exprs_of=lambda e: e.partitioning.expressions)]
+
+
 _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_join_rules()
                                    + _make_window_rules()
-                                   + _make_expand_rules()) + [
+                                   + _make_expand_rules()
+                                   + _make_exchange_rules()) + [
     ExecRule(ce.CpuProjectExec, "column projection", _convert_project,
              exprs_of=lambda e: e.exprs),
     ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,

@@ -19,6 +19,44 @@ from spark_rapids_tpu.plan import logical as lp
 
 
 def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
+    """Plan + EnsureRequirements (distribution requirements are satisfied by
+    inserting single-partition exchanges, Spark's EnsureRequirements role)."""
+    return ensure_requirements(_plan_node(plan, conf))
+
+
+def ensure_requirements(plan: PhysicalExec) -> PhysicalExec:
+    from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
+                                                       RangePartitioning,
+                                                       SinglePartitioning)
+    from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
+    from spark_rapids_tpu.execs.window_execs import CpuWindowExec
+    single_required = (ce.CpuHashAggregateExec, ce.CpuLimitExec,
+                       CpuHashJoinExec, CpuWindowExec)
+
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        if isinstance(node, ce.CpuSortExec):
+            # global sort over partitioned input = range exchange +
+            # per-partition sort (Spark's SortExec + RangePartitioning shape;
+            # downstream consumers read partitions in order)
+            child = node.children[0]
+            if child.num_partitions > 1:
+                exchange = CpuShuffleExchangeExec(
+                    RangePartitioning(child.num_partitions, node.orders), child)
+                return node.with_children([exchange])
+            return node
+        if not isinstance(node, single_required):
+            return node
+        new_children = [
+            CpuShuffleExchangeExec(SinglePartitioning(), c)
+            if c.num_partitions > 1 else c for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            return node
+        return node.with_children(new_children)
+
+    return plan.transform_up(fix)
+
+
+def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
     if isinstance(plan, lp.LocalRelation):
         return ce.CpuLocalScanExec(plan.table, conf.string_max_bytes)
     if isinstance(plan, lp.Range):
@@ -35,48 +73,48 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
             return CpuOrcScanExec(plan.paths, plan.read_schema)
         raise ValueError(f"unsupported format {plan.fmt}")
     if isinstance(plan, lp.Project):
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         cs = child.output
         bound = tuple(_named(bind_expression(e, cs), e) for e in plan.exprs)
         return ce.CpuProjectExec(bound, child)
     if isinstance(plan, lp.Filter):
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         return ce.CpuFilterExec(bind_expression(plan.condition, child.output), child)
     if isinstance(plan, lp.Aggregate):
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         cs = child.output
         grouping = tuple(bind_expression(e, cs) for e in plan.grouping)
         aggs = tuple(_named(bind_expression(e, cs), e) for e in plan.aggregates)
         return ce.CpuHashAggregateExec(grouping, aggs, child, plan.schema())
     if isinstance(plan, lp.Sort):
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         orders = tuple(
             SortOrder(bind_expression(o.child, child.output), o.ascending,
                       o.nulls_first) for o in plan.orders)
         return ce.CpuSortExec(orders, child)
     if isinstance(plan, lp.Expand):
         from spark_rapids_tpu.execs.expand_execs import CpuExpandExec
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         projs = tuple(tuple(bind_expression(e, child.output) for e in p)
                       for p in plan.projections)
         return CpuExpandExec(projs, child, plan.schema())
     if isinstance(plan, lp.Window):
         from spark_rapids_tpu.execs.window_execs import CpuWindowExec
-        child = plan_physical(plan.child, conf)
+        child = _plan_node(plan.child, conf)
         bound = tuple(_named(bind_expression(e, child.output), e)
                       for e in plan.wexprs)
         return CpuWindowExec(bound, child)
     if isinstance(plan, lp.Limit):
-        return ce.CpuLimitExec(plan.n, plan_physical(plan.child, conf))
+        return ce.CpuLimitExec(plan.n, _plan_node(plan.child, conf))
     if isinstance(plan, lp.Union):
-        return ce.CpuUnionExec(plan_physical(plan.left, conf),
-                               plan_physical(plan.right, conf))
+        return ce.CpuUnionExec(_plan_node(plan.left, conf),
+                               _plan_node(plan.right, conf))
     if isinstance(plan, lp.Join):
         from spark_rapids_tpu.columnar.dtypes import DType
         from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
         from spark_rapids_tpu.exprs.cast import Cast
-        left = plan_physical(plan.left, conf)
-        right = plan_physical(plan.right, conf)
+        left = _plan_node(plan.left, conf)
+        right = _plan_node(plan.right, conf)
         lkeys = [bind_expression(e, left.output) for e in plan.left_keys]
         rkeys = [bind_expression(e, right.output) for e in plan.right_keys]
         # Catalyst-style key coercion: both sides of each key pair must share a
@@ -98,6 +136,16 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
                 f"{plan.how}")
         return CpuHashJoinExec(left, right, plan.how, tuple(lkeys),
                                tuple(rkeys), out_schema, cond)
+    if isinstance(plan, lp.Repartition):
+        from spark_rapids_tpu.execs.exchange_execs import (
+            CpuShuffleExchangeExec, HashPartitioning, RoundRobinPartitioning)
+        child = _plan_node(plan.child, conf)
+        if plan.keys:
+            keys = tuple(bind_expression(e, child.output) for e in plan.keys)
+            part = HashPartitioning(plan.num_partitions, keys)
+        else:
+            part = RoundRobinPartitioning(plan.num_partitions)
+        return CpuShuffleExchangeExec(part, child)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
 
 

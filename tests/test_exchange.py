@@ -1,0 +1,139 @@
+"""Shuffle exchange + partitioning tests (reference analogs:
+GpuPartitioningSuite, GpuSinglePartitioningSuite, HashSortOptimizeSuite
+plan-shape assertions, repart integration tests)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api.functions import col, spark_partition_id
+from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
+                                                   HashPartitioning,
+                                                   RangePartitioning,
+                                                   SinglePartitioning,
+                                                   TpuShuffleExchangeExec,
+                                                   hash_partition_ids)
+from spark_rapids_tpu.exprs.core import ColV
+from spark_rapids_tpu.columnar.dtypes import DType
+
+
+def _sessions():
+    return (TpuSession({"spark.rapids.tpu.sql.enabled": "true"}),
+            TpuSession({"spark.rapids.tpu.sql.enabled": "false"}))
+
+
+def _data(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"a": rng.integers(0, 50, n).tolist(),
+            "f": rng.normal(size=n).tolist(),
+            "s": [f"k{int(v)}" for v in rng.integers(0, 11, n)]}
+
+
+def test_repartition_preserves_rows():
+    tpu, cpu = _sessions()
+    data = _data()
+    for sess in (tpu, cpu):
+        t = sess.create_dataframe(data).repartition(5, "a").collect()
+        assert sorted(t.column("a").to_pylist()) == sorted(data["a"])
+
+
+def test_hash_partition_equal_keys_colocated():
+    tpu, _ = _sessions()
+    df = (tpu.create_dataframe(_data())
+          .repartition(7, "s")
+          .select(col("s"), spark_partition_id().alias("p")))
+    t = df.collect()
+    by_key = {}
+    for s, p in zip(t.column("s").to_pylist(), t.column("p").to_pylist()):
+        by_key.setdefault(s, set()).add(p)
+    for key, parts in by_key.items():
+        assert len(parts) == 1, f"{key} split across partitions {parts}"
+
+
+def test_round_robin_balance():
+    tpu, _ = _sessions()
+    t = (tpu.create_dataframe(_data(300))
+         .repartition(3)
+         .select(spark_partition_id().alias("p"))).collect()
+    counts = np.bincount(t.column("p").to_pylist(), minlength=3)
+    assert counts.min() >= 80, counts  # roughly even
+
+def test_global_sort_over_partitions():
+    for sess in _sessions():
+        df = (sess.create_dataframe(_data(400, seed=3))
+              .repartition(4, "s").sort("a", "s"))
+        t = df.collect()
+        a = t.column("a").to_pylist()
+        assert a == sorted(a)
+
+
+def test_sort_desc_nulls_over_partitions():
+    data = {"x": ([3, None, 1, 7, None, 2] * 30)}
+    for sess in _sessions():
+        t = (sess.create_dataframe(data).repartition(3)
+             .sort(col("x").desc())).collect()
+        xs = t.column("x").to_pylist()
+        nn = [v for v in xs if v is not None]
+        assert nn == sorted(nn, reverse=True)
+
+
+def test_repartition_then_aggregate_parity():
+    tpu, cpu = _sessions()
+    data = _data(500, seed=5)
+    res = []
+    for sess in (tpu, cpu):
+        t = (sess.create_dataframe(data).repartition(6, "s")
+             .groupBy("s").count().sort("s")).collect()
+        res.append(t.to_pydict())
+    assert res[0] == res[1]
+
+
+def test_exchange_plan_shape():
+    tpu, _ = _sessions()
+    df = tpu.create_dataframe(_data()).repartition(4, "a").groupBy("s").count()
+    df.collect()
+    plan = tpu.last_plan
+    text = plan.tree_string()
+    assert "TpuShuffleExchangeExec" in text
+
+
+def test_exchange_falls_back_when_disabled():
+    sess = TpuSession({
+        "spark.rapids.tpu.sql.enabled": "true",
+        "spark.rapids.tpu.sql.exec.ShuffleExchange": "false"})
+    df = sess.create_dataframe(_data()).repartition(4, "a")
+    t = df.collect()
+    assert t.num_rows == 200
+    assert "CpuShuffleExchangeExec" in sess.last_plan.tree_string()
+
+
+def test_shuffle_cleanup_after_collect():
+    tpu, _ = _sessions()
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    tpu.create_dataframe(_data()).repartition(3, "a").collect()
+    dm = DeviceManager.get()
+    env = getattr(dm, "_exchange_shuffle_env", None)
+    assert env is not None
+    assert env.shuffle_catalog._blocks == {}
+
+
+def test_hash_ids_null_and_nan_canonical():
+    n = 8
+    data = np.array([0.0, -0.0, np.nan, np.nan, 1.5, 1.5, 2.0, 3.0])
+    validity = np.array([True] * 6 + [False, False])
+    keys = [ColV(DType.DOUBLE, data, validity)]
+    pids = hash_partition_ids(np, keys, n, 5)
+    assert pids[0] == pids[1]      # -0.0 == 0.0
+    assert pids[2] == pids[3]      # NaN == NaN
+    assert pids[6] == pids[7]      # nulls co-located
+    assert pids.dtype == np.int32
+
+
+def test_string_hash_distribution():
+    keys = [ColV(DType.STRING,
+                 np.frombuffer("".join(f"key{i:04d}".ljust(8, "\0")
+                                       for i in range(256)).encode(),
+                               dtype=np.uint8).reshape(256, 8),
+                 np.ones(256, bool), np.full(256, 7, np.int32))]
+    pids = hash_partition_ids(np, keys, 256, 8)
+    counts = np.bincount(pids, minlength=8)
+    assert counts.min() > 10, counts
