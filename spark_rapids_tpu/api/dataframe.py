@@ -184,9 +184,8 @@ class DataFrame:
         self.session.last_plan = final
         return final
 
-    def collect(self) -> pa.Table:
+    def _run_partitions(self, final: PhysicalExec) -> List[pa.Table]:
         from spark_rapids_tpu.memory.device_manager import DeviceManager
-        final = self._executed_plan()
         dm = DeviceManager.initialize(self.session.conf)
         cleanups: List = []
         tables = []
@@ -201,6 +200,10 @@ class DataFrame:
         finally:
             for fn in cleanups:
                 fn()
+        return tables
+
+    def collect(self) -> pa.Table:
+        tables = self._run_partitions(self._executed_plan())
         schema = self._plan.schema().to_pa()
         if not tables:
             return schema.empty_table()
@@ -234,6 +237,71 @@ class DataFrame:
     def write_parquet(self, path: str, compression: str = "snappy") -> None:
         from spark_rapids_tpu.io.parquet import write_parquet
         write_parquet(self.collect(), path, compression)
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+
+class DataFrameWriter:
+    """df.write API (DataFrameWriter analog) driving the columnar write path
+    (GpuDataWritingCommandExec / GpuFileFormatWriter)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._mode = "error"
+        self._partition_by: List[str] = []
+        self._options: Dict[str, str] = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        m = {"errorifexists": "error", "default": "error"}.get(m.lower(),
+                                                               m.lower())
+        if m not in ("error", "overwrite", "append", "ignore"):
+            raise ValueError(f"unknown save mode {m!r}")
+        self._mode = m
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partition_by = partitionBy
+
+    def option(self, k: str, v) -> "DataFrameWriter":
+        self._options[k] = str(v)
+        return self
+
+    def _save(self, fmt: str, path: str):
+        from spark_rapids_tpu.io.write_exec import WriteSpec
+        from spark_rapids_tpu.io.write_exec import CpuWriteFilesExec
+        max_records = int(self._options.get("maxRecordsPerFile", "0"))
+        opts = tuple((k, v) for k, v in self._options.items()
+                     if k != "maxRecordsPerFile")
+        spec = WriteSpec(fmt, path, self._mode, tuple(self._partition_by),
+                         opts, max_records)
+        df = DataFrame(lp.WriteFiles(spec, self._df._plan), self._df.session)
+        final = df._executed_plan()
+        df._run_partitions(final)
+        # surface write stats from whichever engine ran the command
+        for node in _iter_execs(final):
+            if isinstance(node, CpuWriteFilesExec):
+                return node.stats
+        return None
+
+    def parquet(self, path: str):
+        return self._save("parquet", path)
+
+    def orc(self, path: str):
+        return self._save("orc", path)
+
+    def csv(self, path: str):
+        return self._save("csv", path)
+
+
+def _iter_execs(plan: PhysicalExec):
+    yield plan
+    for c in plan.children:
+        yield from _iter_execs(c)
 
 
 class GroupedData:
@@ -326,23 +394,37 @@ class DataFrameReader:
         self._options[k] = str(v)
         return self
 
+    def _scan(self, fmt: str, paths, infer_schema) -> DataFrame:
+        """Discover hive partitions, then full read schema = data schema from
+        the first file ++ partition columns."""
+        from spark_rapids_tpu.columnar.dtypes import Field as SField
+        from spark_rapids_tpu.io.datasource import discover_partitioned_files
+        files, pschema = discover_partitioned_files(paths, fmt)
+        if not files:
+            raise FileNotFoundError(f"no {fmt} files under {paths}")
+        data_schema = infer_schema(files[0].path)
+        full = Schema(list(data_schema.fields)
+                      + [SField(f.name, f.dtype, f.nullable) for f in pschema])
+        return DataFrame(lp.FileScan(fmt, tuple(paths), full,
+                                     tuple(self._options.items()),
+                                     files=files, partition_schema=pschema),
+                         self.session)
+
     def parquet(self, *paths: str) -> DataFrame:
         import pyarrow.parquet as pq
-        schema = Schema.from_pa(pq.read_schema(paths[0]))
-        return DataFrame(lp.FileScan("parquet", tuple(paths), schema,
-                                     tuple(self._options.items())), self.session)
+        return self._scan("parquet", paths,
+                          lambda p: Schema.from_pa(pq.read_schema(p)))
 
     def csv(self, *paths: str, schema: Optional[Schema] = None) -> DataFrame:
         from spark_rapids_tpu.io.csv import infer_csv_schema
-        s = schema or infer_csv_schema(paths[0], self._options)
-        return DataFrame(lp.FileScan("csv", tuple(paths), s,
-                                     tuple(self._options.items())), self.session)
+        return self._scan(
+            "csv", paths,
+            lambda p: schema or infer_csv_schema(p, self._options))
 
     def orc(self, *paths: str) -> DataFrame:
         import pyarrow.orc as po
-        schema = Schema.from_pa(po.ORCFile(paths[0]).schema)
-        return DataFrame(lp.FileScan("orc", tuple(paths), schema,
-                                     tuple(self._options.items())), self.session)
+        return self._scan("orc", paths,
+                          lambda p: Schema.from_pa(po.ORCFile(p).schema))
 
 
 class TpuSession:

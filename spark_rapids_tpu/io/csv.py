@@ -49,37 +49,52 @@ def _read_table(path: str, schema: Schema, options: Dict[str, str]) -> pa.Table:
     return t.cast(schema.to_pa())
 
 
-class CpuCsvScanExec(LeafExec):
-    def __init__(self, paths: Tuple[str, ...], schema: Schema,
-                 options: Dict[str, str]):
+class _CsvScanBase(LeafExec):
+    def __init__(self, files, schema: Schema, options: Dict[str, str],
+                 partition_schema: Schema = Schema([])):
         super().__init__(schema)
-        self.paths = paths
+        self.files = tuple(files)
         self.options = options
+        self.partition_schema = partition_schema
+        part_names = {f.name for f in partition_schema}
+        self.data_schema = Schema([f for f in schema
+                                   if f.name not in part_names])
 
-    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
-        if ctx.partition_id != 0:
+    @property
+    def paths(self) -> Tuple[str, ...]:
+        return tuple(f.path for f in self.files)
+
+    scan_partitions: int = 1
+
+    @property
+    def num_partitions(self) -> int:
+        return self.scan_partitions
+
+    def _iter_arrow(self, ctx: ExecContext):
+        from spark_rapids_tpu.io.datasource import (append_partition_columns,
+                                                    assigned_files)
+        if ctx.partition_id >= self.scan_partitions:
             return
-        for p in self.paths:
-            t = _read_table(p, self.output, self.options)
+        for pf in assigned_files(self.files, ctx.partition_id,
+                                 self.scan_partitions):
+            t = _read_table(pf.path, self.data_schema, self.options)
+            yield append_partition_columns(t, self.partition_schema,
+                                           pf.partition_values)
+
+
+class CpuCsvScanExec(_CsvScanBase):
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for t in self._iter_arrow(ctx):
             b = HostBatch.from_arrow(t, ctx.string_max_bytes)
             self.count_output(b.num_rows)
             yield b
 
 
-class TpuCsvScanExec(LeafExec):
+class TpuCsvScanExec(_CsvScanBase):
     is_device = True
 
-    def __init__(self, paths: Tuple[str, ...], schema: Schema,
-                 options: Dict[str, str]):
-        super().__init__(schema)
-        self.paths = paths
-        self.options = options
-
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        if ctx.partition_id != 0:
-            return
-        for p in self.paths:
-            t = _read_table(p, self.output, self.options)
+        for t in self._iter_arrow(ctx):
             b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
             self.count_output(b.num_rows)
             yield b

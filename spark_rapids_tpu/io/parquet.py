@@ -1,13 +1,15 @@
 """Parquet scan execs (reference: GpuParquetScan.scala, 699 LoC).
 
-The reference's pattern — CPU footer parse + predicate-pushdown row-group clipping
-+ host staging, then device decode (GpuParquetScan.scala:342,576) — maps here to:
-pyarrow reads footers and decodes row groups into host Arrow memory (the CPU
-stage), and the TPU exec uploads straight into bucketed device buffers (the
-device stage). Row-group pruning via parquet statistics happens on the CPU
-before any data is read (clipBlocks analog). Chunking honors
-maxReadBatchSizeRows/Bytes like populateCurrentBlockChunk (GpuParquetScan.scala:599).
-"""
+The reference's pattern — CPU footer parse + predicate-pushdown row-group
+clipping + host staging, then device decode (GpuParquetScan.scala:342,576) —
+maps here to: pyarrow reads footers and decodes row groups into host Arrow
+memory (the CPU stage), and the TPU exec uploads straight into bucketed device
+buffers (the device stage). Row-group pruning via parquet statistics happens on
+the CPU before any data is read (clipBlocks analog, GpuParquetScan.scala:688).
+Chunking honors maxReadBatchSizeRows AND maxReadBatchSizeBytes like
+populateCurrentBlockChunk (GpuParquetScan.scala:599); schema evolution fills
+missing columns with nulls (evolveSchemaIfNeededAndClose, :520); hive partition
+values are appended per batch (ColumnarPartitionReaderWithPartitionValues)."""
 from __future__ import annotations
 
 from typing import Iterator, List, Optional, Sequence, Tuple
@@ -19,53 +21,133 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.columnar.dtypes import Schema
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+from spark_rapids_tpu.exprs.core import Expression
+from spark_rapids_tpu.io.datasource import (ColumnStats, PartitionedFile,
+                                            append_partition_columns,
+                                            assigned_files, evolve_schema,
+                                            stats_may_contain)
 
 
-def _iter_tables(paths: Sequence[str], schema: Schema, max_rows: int,
-                 columns: Optional[List[str]] = None) -> Iterator[pa.Table]:
-    want = columns or schema.names()
-    for path in paths:
-        f = pq.ParquetFile(path)
-        for rb in f.iter_batches(batch_size=max_rows, columns=want):
-            yield pa.Table.from_batches([rb]).cast(schema.to_pa())
+def _row_group_stats(md, rg_index: int) -> dict:
+    """Column min/max/null stats for one row group from footer metadata."""
+    rg = md.row_group(rg_index)
+    out = {}
+    for i in range(rg.num_columns):
+        col = rg.column(i)
+        name = col.path_in_schema
+        st = col.statistics
+        if st is None:
+            out[name] = ColumnStats()
+            continue
+        out[name] = ColumnStats(
+            min=st.min if st.has_min_max else None,
+            max=st.max if st.has_min_max else None,
+            null_count=st.null_count if st.has_null_count else None,
+            num_values=rg.num_rows)
+    return out
 
 
-class CpuParquetScanExec(LeafExec):
-    def __init__(self, paths: Tuple[str, ...], schema: Schema,
-                 max_batch_rows: int = 1 << 20):
+def clip_row_groups(pf: pq.ParquetFile,
+                    filters: Sequence[Expression]) -> List[int]:
+    """Row groups whose statistics say they may contain matching rows
+    (clipBlocks analog)."""
+    md = pf.metadata
+    if not filters:
+        return list(range(md.num_row_groups))
+    kept = []
+    for i in range(md.num_row_groups):
+        stats = _row_group_stats(md, i)
+        if all(stats_may_contain(f, stats) for f in filters):
+            kept.append(i)
+    return kept
+
+
+def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
+                      partition_schema: Schema,
+                      filters: Sequence[Expression],
+                      max_rows: int, max_bytes: int) -> Iterator[pa.Table]:
+    pf = pq.ParquetFile(f.path)
+    groups = clip_row_groups(pf, filters)
+    if not groups:
+        return
+    md = pf.metadata
+    # rows-per-batch from the byte budget using the file's average row width
+    # (populateCurrentBlockChunk's size accounting)
+    total_rows = max(1, md.num_rows)
+    total_bytes = sum(md.row_group(i).total_byte_size
+                      for i in range(md.num_row_groups)) or total_rows
+    rows_by_bytes = max(1, int(max_bytes * total_rows / total_bytes))
+    batch_rows = min(max_rows, rows_by_bytes)
+    file_cols = set(md.schema.names)
+    want = [f2.name for f2 in data_schema if f2.name in file_cols]
+    for rb in pf.iter_batches(batch_size=batch_rows, row_groups=groups,
+                              columns=want):
+        t = evolve_schema(pa.Table.from_batches([rb]), data_schema)
+        yield append_partition_columns(t, partition_schema,
+                                       f.partition_values)
+
+
+class _ParquetScanBase(LeafExec):
+    """Shared scan logic (GpuParquetScanBase analog). ``output`` is the full
+    read schema including partition columns."""
+
+    def __init__(self, files: Tuple[PartitionedFile, ...], schema: Schema,
+                 partition_schema: Schema = Schema([]),
+                 filters: Tuple[Expression, ...] = (),
+                 max_batch_rows: int = 1 << 20,
+                 max_batch_bytes: int = 1 << 31):
         super().__init__(schema)
-        self.paths = paths
+        self.files = files
+        self.partition_schema = partition_schema
+        part_names = {f.name for f in partition_schema}
+        self.data_schema = Schema([f for f in schema
+                                   if f.name not in part_names])
+        self.filters = filters
         self.max_batch_rows = max_batch_rows
+        self.max_batch_bytes = max_batch_bytes
 
-    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
-        if ctx.partition_id != 0:
+    @property
+    def paths(self) -> Tuple[str, ...]:
+        return tuple(f.path for f in self.files)
+
+    #: how many scan tasks split the file list (FilePartition planning knob);
+    #: 1 = the whole scan runs in partition 0
+    scan_partitions: int = 1
+
+    @property
+    def num_partitions(self) -> int:
+        return self.scan_partitions
+
+    def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
+        if ctx.partition_id >= self.scan_partitions:
             return
-        for t in _iter_tables(self.paths, self.output, self.max_batch_rows):
+        for f in assigned_files(self.files, ctx.partition_id,
+                                self.scan_partitions):
+            yield from _iter_file_tables(
+                f, self.data_schema, self.partition_schema, self.filters,
+                self.max_batch_rows, self.max_batch_bytes)
+
+
+class CpuParquetScanExec(_ParquetScanBase):
+    def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
+        for t in self._iter_arrow(ctx):
             b = HostBatch.from_arrow(t, ctx.string_max_bytes)
             self.count_output(b.num_rows)
             yield b
 
 
-class TpuParquetScanExec(LeafExec):
+class TpuParquetScanExec(_ParquetScanBase):
     """Host-staged read + single upload per batch into bucketed device buffers."""
 
     is_device = True
 
-    def __init__(self, paths: Tuple[str, ...], schema: Schema,
-                 max_batch_rows: int = 1 << 20):
-        super().__init__(schema)
-        self.paths = paths
-        self.max_batch_rows = max_batch_rows
-
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        if ctx.partition_id != 0:
-            return
-        for t in _iter_tables(self.paths, self.output, self.max_batch_rows):
+        for t in self._iter_arrow(ctx):
             b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
             self.count_output(b.num_rows)
             yield b
 
 
 def write_parquet(table: pa.Table, path: str, compression: str = "snappy") -> None:
-    """Columnar parquet write (ColumnarOutputWriter / GpuParquetWriter analog)."""
+    """Single-file columnar parquet write (GpuParquetWriter analog)."""
     pq.write_table(table, path, compression=compression)

@@ -1,0 +1,102 @@
+"""File-write physical execs.
+
+Reference analog: GpuDataWritingCommandExec.scala (94 LoC) wrapping
+GpuFileFormatWriter.write — the exec drains its child per task, writes part
+files through the commit protocol, and the job commits after the last task.
+The TPU variant consumes device batches and stages them to host for encoding
+(the reference encodes on-device via cudf Table.writeParquetChunked; pyarrow is
+our encoder, so the download IS the transition — it rides the same batch).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.io.writer import (DynamicPartitionDataWriter,
+                                        FileCommitProtocol,
+                                        SingleDirectoryDataWriter, WriteStats,
+                                        resolve_save_mode)
+
+
+@dataclass(frozen=True)
+class WriteSpec:
+    fmt: str                       # parquet | orc | csv
+    path: str
+    mode: str = "error"            # error | overwrite | append | ignore
+    partition_by: Tuple[str, ...] = ()
+    options: Tuple[Tuple[str, str], ...] = ()
+    max_records_per_file: int = 0
+
+    @property
+    def options_dict(self) -> Dict[str, str]:
+        return dict(self.options)
+
+
+class CpuWriteFilesExec(PhysicalExec):
+    """Write command exec: produces no rows; ``stats`` carries the write
+    result (GpuDataWritingCommandExec analog)."""
+
+    def __init__(self, spec: WriteSpec, child: PhysicalExec):
+        super().__init__((child,), Schema([]))
+        self.spec = spec
+        self.stats = WriteStats()
+        self._committer: Optional[FileCommitProtocol] = None
+        self._skipped = False
+
+    def _task_writer(self, task_id: int):
+        child_schema = self.children[0].output
+        if self.spec.partition_by:
+            return DynamicPartitionDataWriter(
+                self.spec.fmt, child_schema, self.spec.partition_by,
+                self._committer, task_id, self.spec.options_dict,
+                self.spec.max_records_per_file)
+        return SingleDirectoryDataWriter(
+            self.spec.fmt, child_schema, self._committer, task_id,
+            self.spec.options_dict, self.spec.max_records_per_file)
+
+    def _batch_table(self, batch):
+        return batch.to_arrow()
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        t0 = time.perf_counter()
+        if ctx.partition_id == 0:
+            self.stats = WriteStats()
+            self._skipped = resolve_save_mode(
+                self.spec.path, self.spec.mode) is None
+            if not self._skipped:
+                self._committer = FileCommitProtocol(self.spec.path)
+                self._committer.setup_job()
+        if self._skipped:
+            return
+        writer = self._task_writer(ctx.partition_id)
+        try:
+            for batch in self.children[0].execute(ctx):
+                writer.write(self._batch_table(batch))
+            writer.close()
+        except Exception:
+            self._committer.abort_job()
+            raise
+        self.stats.num_files += writer.files_written
+        self.stats.num_rows += writer.rows_written
+        if isinstance(writer, DynamicPartitionDataWriter):
+            self.stats.num_partitions += len(writer.partitions_seen)
+        if ctx.partition_id == ctx.num_partitions - 1:
+            self._committer.commit_job()
+            import os
+            self.stats.num_bytes = sum(
+                os.path.getsize(os.path.join(d, f))
+                for d, _, fs in os.walk(self.spec.path) for f in fs
+                if not f.startswith("_"))
+        self.stats.write_time_s += time.perf_counter() - t0
+        return
+        yield  # pragma: no cover — makes this a generator
+
+
+class TpuWriteFilesExec(CpuWriteFilesExec):
+    """Device-side write: consumes DeviceBatch; ``to_arrow`` in the shared
+    ``_batch_table`` performs the device download."""
+
+    is_device = True

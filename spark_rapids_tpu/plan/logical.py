@@ -48,12 +48,30 @@ class Range(LogicalPlan):
 class FileScan(LogicalPlan):
     fmt: str                      # parquet | csv | orc
     paths: Tuple[str, ...]
-    read_schema: Schema
+    read_schema: Schema           # full schema incl partition columns
     options: Tuple[Tuple[str, str], ...] = ()
     filters: Tuple[Expression, ...] = ()   # pushed-down predicates
+    #: hive-partition discovery results (io.datasource.PartitionedFile)
+    files: Tuple = ()
+    partition_schema: Schema = field(default_factory=lambda: Schema([]))
 
     def schema(self) -> Schema:
         return self.read_schema
+
+
+@dataclass
+class WriteFiles(LogicalPlan):
+    """V1 write command (GpuDataWritingCommandExec / InsertIntoHadoopFsRelation
+    analog). Produces no rows."""
+    spec: object                  # io.write_exec.WriteSpec
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        return Schema([])
 
 
 @dataclass

@@ -265,7 +265,8 @@ def _convert_local_scan(meta: ExecMeta, children) -> PhysicalExec:
 def _convert_parquet(meta: ExecMeta, children) -> PhysicalExec:
     from spark_rapids_tpu.io.parquet import TpuParquetScanExec
     e = meta.exec
-    return TpuParquetScanExec(e.paths, e.output, e.max_batch_rows)
+    return TpuParquetScanExec(e.files, e.output, e.partition_schema,
+                              e.filters, e.max_batch_rows, e.max_batch_bytes)
 
 
 def _tag_parquet(meta: ExecMeta) -> None:
@@ -278,7 +279,7 @@ def _tag_parquet(meta: ExecMeta) -> None:
 def _convert_csv(meta: ExecMeta, children) -> PhysicalExec:
     from spark_rapids_tpu.io.csv import TpuCsvScanExec
     e = meta.exec
-    return TpuCsvScanExec(e.paths, e.output, e.options)
+    return TpuCsvScanExec(e.files, e.output, e.options, e.partition_schema)
 
 
 def _tag_csv(meta: ExecMeta) -> None:
@@ -294,7 +295,7 @@ def _tag_csv(meta: ExecMeta) -> None:
 def _convert_orc(meta: ExecMeta, children) -> PhysicalExec:
     from spark_rapids_tpu.io.orc import TpuOrcScanExec
     e = meta.exec
-    return TpuOrcScanExec(e.paths, e.output)
+    return TpuOrcScanExec(e.files, e.output, e.partition_schema)
 
 
 def _tag_orc(meta: ExecMeta) -> None:
@@ -313,6 +314,39 @@ def _make_scan_rules() -> List[ExecRule]:
         ExecRule(CpuCsvScanExec, "csv scan", _convert_csv, tag=_tag_csv),
         ExecRule(CpuOrcScanExec, "orc scan", _convert_orc, tag=_tag_orc),
     ]
+
+
+def _convert_write(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.io.write_exec import TpuWriteFilesExec
+    return TpuWriteFilesExec(meta.exec.spec, children[0])
+
+
+def _tag_write(meta: ExecMeta) -> None:
+    """GpuParquetFileFormat.tagGpuSupport / GpuOrcFileFormat analog: gate on
+    the per-format write conf and the supported compression codecs. CSV has no
+    accelerated writer in the reference — it always falls back."""
+    from spark_rapids_tpu.io.writer import WRITER_CLASSES
+    spec = meta.exec.spec
+    if spec.fmt == "csv":
+        meta.will_not_work("CSV writing does not run on TPU (no accelerated "
+                           "CSV writer in the reference either)")
+        return
+    enabled = {"parquet": (cfg.PARQUET_ENABLED, cfg.PARQUET_WRITE_ENABLED),
+               "orc": (cfg.ORC_ENABLED, cfg.ORC_WRITE_ENABLED)}[spec.fmt]
+    if not all(meta.conf.get(k) for k in enabled):
+        meta.will_not_work(
+            f"{spec.fmt} writing disabled "
+            f"(spark.rapids.tpu.sql.format.{spec.fmt}.write.enabled)")
+    codec = spec.options_dict.get("compression", "snappy").lower()
+    if codec not in WRITER_CLASSES[spec.fmt].SUPPORTED_CODECS:
+        meta.will_not_work(f"compression codec {codec!r} is not supported "
+                           f"for {spec.fmt} on TPU")
+
+
+def _make_write_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.io.write_exec import CpuWriteFilesExec
+    return [ExecRule(CpuWriteFilesExec, "file write command", _convert_write,
+                     tag=_tag_write)]
 
 
 def _convert_join(meta: ExecMeta, children) -> PhysicalExec:
@@ -383,7 +417,8 @@ def _make_exchange_rules() -> List[ExecRule]:
                      exprs_of=lambda e: e.partitioning.expressions)]
 
 
-_EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_join_rules()
+_EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_write_rules()
+                                   + _make_join_rules()
                                    + _make_window_rules()
                                    + _make_expand_rules()
                                    + _make_exchange_rules()) + [

@@ -62,16 +62,41 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
     if isinstance(plan, lp.Range):
         return ce.CpuRangeExec(plan.start, plan.end, plan.step)
     if isinstance(plan, lp.FileScan):
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.io.datasource import PartitionedFile
+        files = plan.files or tuple(PartitionedFile(p) for p in plan.paths)
         if plan.fmt == "parquet":
-            return CpuParquetScanExec(plan.paths, plan.read_schema)
+            return CpuParquetScanExec(
+                files, plan.read_schema, plan.partition_schema, plan.filters,
+                conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS),
+                conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         if plan.fmt == "csv":
             from spark_rapids_tpu.io.csv import CpuCsvScanExec
-            return CpuCsvScanExec(plan.paths, plan.read_schema,
-                                  dict(plan.options))
+            return CpuCsvScanExec(files, plan.read_schema, dict(plan.options),
+                                  plan.partition_schema)
         if plan.fmt == "orc":
             from spark_rapids_tpu.io.orc import CpuOrcScanExec
-            return CpuOrcScanExec(plan.paths, plan.read_schema)
+            return CpuOrcScanExec(files, plan.read_schema,
+                                  plan.partition_schema)
         raise ValueError(f"unsupported format {plan.fmt}")
+    if isinstance(plan, lp.WriteFiles):
+        from spark_rapids_tpu.io.write_exec import CpuWriteFilesExec
+        return CpuWriteFilesExec(plan.spec, _plan_node(plan.child, conf))
+    if isinstance(plan, lp.Filter) and isinstance(plan.child, lp.FileScan) \
+            and plan.child.fmt == "parquet":
+        # predicate pushdown: pushable conjuncts clip parquet row groups; the
+        # Filter itself stays as the exact row-level net (Spark keeps both too)
+        from dataclasses import replace
+        from spark_rapids_tpu.io.datasource import is_pushable, split_conjuncts
+        pushed = tuple(c for c in split_conjuncts(plan.condition)
+                       if is_pushable(c))
+        if pushed:
+            scan = replace(plan.child,
+                           filters=plan.child.filters + pushed)
+            plan = lp.Filter(plan.condition, scan)
+        child = _plan_node(plan.child, conf)
+        return ce.CpuFilterExec(bind_expression(plan.condition, child.output),
+                                child)
     if isinstance(plan, lp.Project):
         child = _plan_node(plan.child, conf)
         cs = child.output
