@@ -159,6 +159,12 @@ STRING_MAX_BYTES = _conf(
     "[rows, maxBytes] uint8 matrix plus a length vector (TPU-friendly layout); rows longer "
     "than this fall back to CPU.", checker=_positive("string.maxBytes"))
 
+BROADCAST_JOIN_THRESHOLD = _conf(
+    "sql.broadcastJoinThreshold.bytes", int, 10 * 1024 * 1024,
+    "Maximum estimated build-side size for a join to use the broadcast hash "
+    "join strategy (the spark.sql.autoBroadcastJoinThreshold role). Sides with "
+    "unknown size never broadcast.")
+
 REPLACE_SORT_MERGE_JOIN = _conf(
     "sql.replaceSortMergeJoin.enabled", bool, True,
     "Replace CPU sort-merge joins with TPU shuffled-hash joins, dropping the sorts "

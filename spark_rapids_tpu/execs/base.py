@@ -60,6 +60,13 @@ class PhysicalExec:
     def execute(self, ctx: ExecContext) -> Iterator:
         raise NotImplementedError(self.name)
 
+    def size_estimate(self) -> Optional[int]:
+        """Estimated output bytes (Spark statistics sizeInBytes role), used by
+        the planner's broadcast-join selection. None = unknown (never
+        broadcast). Narrowing ops pass their child's estimate through as an
+        upper bound; everything else is unknown."""
+        return None
+
     # ---- plan display ---------------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:
         lines = ["  " * indent + f"{self.name} [{self.output}]"]

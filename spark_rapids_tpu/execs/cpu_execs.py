@@ -45,11 +45,24 @@ def concat_host_batches(batches: List[HostBatch], schema: Schema) -> HostBatch:
     return HostBatch.from_arrow(pa.concat_tables(tables))
 
 
+_DTYPE_WIDTH = {DType.BOOLEAN: 1, DType.BYTE: 1, DType.SHORT: 2, DType.INT: 4,
+                DType.FLOAT: 4, DType.DATE: 4, DType.LONG: 8, DType.DOUBLE: 8,
+                DType.TIMESTAMP: 8, DType.STRING: 20, DType.NULL: 1}
+
+
+def _row_width(schema: Schema) -> int:
+    """Nominal bytes per row for size-estimate scaling."""
+    return sum(_DTYPE_WIDTH.get(f.dtype, 8) for f in schema)
+
+
 class CpuLocalScanExec(LeafExec):
     def __init__(self, table: pa.Table, string_max_bytes: int = 256):
         super().__init__(Schema.from_pa(table.schema))
         self.table = table
         self._smax = string_max_bytes
+
+    def size_estimate(self):
+        return self.table.nbytes
 
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         if ctx.partition_id == 0:
@@ -75,6 +88,17 @@ class CpuRangeExec(LeafExec):
 
 
 class CpuProjectExec(PhysicalExec):
+    def size_estimate(self):
+        # scale by the output/input row-width ratio (Spark scales Project
+        # sizeInBytes the same way) so widening projections don't slip under
+        # the broadcast threshold
+        child_sz = self.children[0].size_estimate()
+        if child_sz is None:
+            return None
+        in_w = _row_width(self.children[0].output)
+        out_w = _row_width(self.output)
+        return int(child_sz * out_w / max(in_w, 1))
+
     def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec):
         super().__init__((child,), output_schema(exprs))
         self.exprs = exprs
@@ -88,6 +112,9 @@ class CpuProjectExec(PhysicalExec):
 
 
 class CpuFilterExec(PhysicalExec):
+    def size_estimate(self):
+        return self.children[0].size_estimate()
+
     def __init__(self, condition: Expression, child: PhysicalExec):
         super().__init__((child,), child.output)
         self.condition = condition

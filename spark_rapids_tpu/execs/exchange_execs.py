@@ -282,12 +282,17 @@ class ShuffleExchangeExecBase(PhysicalExec):
         return self.partitioning.num_partitions
 
     def _child_contexts(self, ctx: ExecContext) -> Iterator[ExecContext]:
-        child_parts = self.children[0].num_partitions
-        for p in range(child_parts):
-            yield ExecContext(ctx.conf, partition_id=p,
-                              num_partitions=child_parts,
-                              device_manager=ctx.device_manager,
-                              cleanups=ctx.cleanups)
+        return _child_contexts(self.children[0], ctx)
+
+
+def _child_contexts(child: PhysicalExec, ctx: ExecContext) -> Iterator[ExecContext]:
+    """One ExecContext per partition of ``child`` (map-side / build-side walk)."""
+    child_parts = child.num_partitions
+    for p in range(child_parts):
+        yield ExecContext(ctx.conf, partition_id=p,
+                          num_partitions=child_parts,
+                          device_manager=ctx.device_manager,
+                          cleanups=ctx.cleanups)
 
 
 class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
@@ -567,3 +572,62 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                     i += 2
             sampled.append(_sample_rows(keys, db.num_rows, per))
         return _sample_bounds(part.orders, sampled, n)
+
+
+# ------------------------------------------------------------------ broadcast
+class BroadcastExchangeExecBase(PhysicalExec):
+    """Broadcast exchange (GpuBroadcastExchangeExec analog,
+    execution/GpuBroadcastExchangeExec.scala): materializes the child fully —
+    every child partition — into ONE batch, built once and served to every
+    consumer partition. The reference builds the batch on the driver and caches
+    the deserialized device copy once per executor
+    (SerializeConcatHostBuffersDeserializeBatch:47-66); here the single cached
+    batch plays that per-executor role, released when the action finishes."""
+
+    def __init__(self, child: PhysicalExec):
+        super().__init__((child,), child.output)
+        self._lock = threading.Lock()
+        self._cached = None
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _materialize(self, ctx: ExecContext):
+        child = self.children[0]
+        batches = []
+        for cctx in _child_contexts(child, ctx):
+            batches.extend(child.execute(cctx))
+        return batches
+
+    def _release(self) -> None:
+        self._cached = None
+
+    def execute(self, ctx: ExecContext):
+        with self._lock:
+            if self._cached is None:
+                if ctx.cleanups is not None:
+                    ctx.cleanups.append(self._release)
+                self._cached = self._build(ctx)
+                # count build rows once, not once per consuming partition
+                self.count_output(self._cached.num_rows)
+        yield self._cached
+
+
+class CpuBroadcastExchangeExec(BroadcastExchangeExecBase):
+    def _build(self, ctx: ExecContext) -> HostBatch:
+        from spark_rapids_tpu.execs.cpu_execs import concat_host_batches
+        return concat_host_batches(self._materialize(ctx), self.output)
+
+
+class TpuBroadcastExchangeExec(BroadcastExchangeExecBase):
+    """Device-side broadcast: the concatenated build batch stays in HBM. In
+    distributed execution the build child is all-gathered over the mesh
+    (parallel/distributed.py) instead of serialized through a driver."""
+
+    is_device = True
+
+    def _build(self, ctx: ExecContext) -> DeviceBatch:
+        from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
+        return concat_device_batches(self._materialize(ctx), self.output,
+                                     ctx.string_max_bytes)

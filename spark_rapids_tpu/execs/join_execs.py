@@ -45,14 +45,21 @@ class _HashJoinBase(PhysicalExec):
     def __init__(self, left: PhysicalExec, right: PhysicalExec, how: str,
                  left_keys: Tuple[Expression, ...],
                  right_keys: Tuple[Expression, ...], output: Schema,
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 build_side: str = "right"):
         super().__init__((left, right), output)
         if how not in jk.JOIN_KINDS:
             raise ValueError(f"unsupported join type {how}")
+        if build_side not in ("left", "right"):
+            raise ValueError(f"invalid build side {build_side}")
         self.how = how
         self.left_keys = left_keys
         self.right_keys = right_keys
         self.condition = condition
+        #: which side is materialized as the build table. For the broadcast
+        #: variants the planner wraps this child in a BroadcastExchange; Spark's
+        #: BuildSide restrictions apply (an outer side cannot be broadcast).
+        self.build_side = build_side
 
     @property
     def includes_right_columns(self) -> bool:
@@ -195,10 +202,53 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
         yield out
 
 
+
+class CpuBroadcastHashJoinExec(CpuHashJoinExec):
+    """Equi-join whose build child is a BroadcastExchange; the stream side
+    keeps its partitioning, so the join runs once per stream partition against
+    the one cached build batch (GpuBroadcastHashJoinExec analog,
+    shims/spark300/GpuBroadcastHashJoinExec.scala)."""
+
+
 class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
-    """Same device kernel; the build side arrives replicated (broadcast) rather
-    than hash-partitioned. In distributed execution the build child is
-    all-gathered across the mesh instead of exchanged
-    (GpuBroadcastHashJoinExec analog)."""
+    """Same device kernel as the shuffled join; the build side arrives
+    replicated (broadcast) rather than hash-partitioned. In distributed
+    execution the build child is all-gathered across the mesh instead of
+    exchanged (GpuBroadcastHashJoinExec analog)."""
 
 
+class _NestedLoopMixin:
+    """Brute-force joins evaluate the cross-product kernel, then apply the
+    condition as a filter (how == 'inner' with condition c is equivalent to
+    cross + filter(c))."""
+
+    def __init__(self, left: PhysicalExec, right: PhysicalExec, how: str,
+                 output: Schema, condition: Optional[Expression] = None,
+                 build_side: str = "right"):
+        if how not in ("inner", "cross"):
+            raise ValueError(
+                f"nested-loop/cartesian joins support inner/cross, not {how}")
+        super().__init__(left, right, "cross", (), (), output, condition,
+                         build_side)
+        self.join_type = how
+
+
+class CpuNestedLoopJoinExec(_NestedLoopMixin, CpuHashJoinExec):
+    """Broadcast nested-loop join (GpuBroadcastNestedLoopJoinExec analog,
+    execution/GpuBroadcastNestedLoopJoinExec.scala, disabled by default per
+    GpuOverrides.scala:1688-1691): the build child is a BroadcastExchange, the
+    stream side stays partitioned."""
+
+
+class TpuBroadcastNestedLoopJoinExec(_NestedLoopMixin, TpuShuffledHashJoinExec):
+    pass
+
+
+class CpuCartesianProductExec(_NestedLoopMixin, CpuHashJoinExec):
+    """Cartesian product (GpuCartesianProductExec analog, disabled by
+    default). Both sides are coalesced to single partitions by
+    EnsureRequirements."""
+
+
+class TpuCartesianProductExec(_NestedLoopMixin, TpuShuffledHashJoinExec):
+    pass

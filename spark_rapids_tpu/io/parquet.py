@@ -106,6 +106,13 @@ class _ParquetScanBase(LeafExec):
         self.max_batch_rows = max_batch_rows
         self.max_batch_bytes = max_batch_bytes
 
+    def size_estimate(self):
+        import os
+        try:
+            return sum(os.path.getsize(f.path) for f in self.files)
+        except OSError:
+            return None
+
     @property
     def paths(self) -> Tuple[str, ...]:
         return tuple(f.path for f in self.files)

@@ -113,8 +113,17 @@ class ExecMeta(BaseMeta):
             self.will_not_work(
                 f"{self.exec.name} has no TPU implementation")
             return
-        if not self.conf.is_rule_enabled(self.rule.conf_key):
-            self.will_not_work(f"{self.exec.name} disabled by {self.rule.conf_key}")
+        disabled_note = getattr(self.rule, "disabled_by_default", None)
+        if not self.conf.is_rule_enabled(self.rule.conf_key,
+                                         default=disabled_note is None):
+            if disabled_note is not None and \
+                    self.conf.get_raw(self.rule.conf_key) is None:
+                self.will_not_work(
+                    f"{self.exec.name} is disabled by default "
+                    f"({disabled_note}); enable with {self.rule.conf_key}=true")
+            else:
+                self.will_not_work(
+                    f"{self.exec.name} disabled by {self.rule.conf_key}")
         for f in self.exec.output:
             if f.dtype not in SUPPORTED_TYPES:
                 self.will_not_work(f"output column {f.name}: type {f.dtype} is "

@@ -54,6 +54,10 @@ class ExecRule:
     exprs_of: Callable[[PhysicalExec], Sequence[Expression]] = lambda e: ()
     incompat: Optional[str] = None
     tag: Optional[Callable[[ExecMeta], None]] = None
+    #: None = enabled unless conf turns it off; a string = disabled by default
+    #: for the given reason, enabled by setting the conf key true (the
+    #: reference's `.disabledByDefault(...)` rules, GpuOverrides.scala:1688)
+    disabled_by_default: Optional[str] = None
 
     @property
     def conf_key(self) -> str:
@@ -374,12 +378,50 @@ SUPPORTED_JOIN_KEY_TYPES = (DType.BOOLEAN, DType.BYTE, DType.SHORT, DType.INT,
                             DType.DATE, DType.TIMESTAMP)
 
 
+def _convert_broadcast_join(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.join_execs import TpuBroadcastHashJoinExec
+    e = meta.exec
+    return TpuBroadcastHashJoinExec(children[0], children[1], e.how,
+                                    e.left_keys, e.right_keys, e.output,
+                                    e.condition, e.build_side)
+
+
+def _nested_loop_converter(tpu_cls_name: str):
+    def convert(meta: ExecMeta, children) -> PhysicalExec:
+        from spark_rapids_tpu.execs import join_execs
+        e = meta.exec
+        cls = getattr(join_execs, tpu_cls_name)
+        return cls(children[0], children[1], e.join_type, e.output,
+                   e.condition, e.build_side)
+    return convert
+
+
+def _join_exprs(e) -> tuple:
+    return (tuple(e.left_keys) + tuple(e.right_keys)
+            + ((e.condition,) if e.condition is not None else ()))
+
+
 def _make_join_rules() -> List[ExecRule]:
-    from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
-    return [ExecRule(CpuHashJoinExec, "hash join", _convert_join,
-                     exprs_of=lambda e: tuple(e.left_keys) + tuple(e.right_keys)
-                     + ((e.condition,) if e.condition is not None else ()),
-                     tag=_tag_join)]
+    from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
+                                                   CpuCartesianProductExec,
+                                                   CpuHashJoinExec,
+                                                   CpuNestedLoopJoinExec)
+    return [
+        ExecRule(CpuHashJoinExec, "shuffled hash join", _convert_join,
+                 exprs_of=_join_exprs, tag=_tag_join),
+        ExecRule(CpuBroadcastHashJoinExec, "broadcast hash join",
+                 _convert_broadcast_join, exprs_of=_join_exprs, tag=_tag_join),
+        ExecRule(CpuNestedLoopJoinExec, "broadcast nested-loop join",
+                 _nested_loop_converter("TpuBroadcastNestedLoopJoinExec"),
+                 exprs_of=_join_exprs,
+                 disabled_by_default="the brute-force cross product can be "
+                                     "very slow"),
+        ExecRule(CpuCartesianProductExec, "cartesian product",
+                 _nested_loop_converter("TpuCartesianProductExec"),
+                 exprs_of=_join_exprs,
+                 disabled_by_default="the brute-force cross product can be "
+                                     "very slow"),
+    ]
 
 
 def _convert_expand(meta: ExecMeta, children) -> PhysicalExec:
@@ -410,11 +452,19 @@ def _convert_exchange(meta: ExecMeta, children) -> PhysicalExec:
     return TpuShuffleExchangeExec(meta.exec.partitioning, children[0])
 
 
+def _convert_broadcast_exchange(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.exchange_execs import TpuBroadcastExchangeExec
+    return TpuBroadcastExchangeExec(children[0])
+
+
 def _make_exchange_rules() -> List[ExecRule]:
-    from spark_rapids_tpu.execs.exchange_execs import CpuShuffleExchangeExec
+    from spark_rapids_tpu.execs.exchange_execs import (
+        CpuBroadcastExchangeExec, CpuShuffleExchangeExec)
     return [ExecRule(CpuShuffleExchangeExec, "shuffle exchange",
                      _convert_exchange,
-                     exprs_of=lambda e: e.partitioning.expressions)]
+                     exprs_of=lambda e: e.partitioning.expressions),
+            ExecRule(CpuBroadcastExchangeExec, "broadcast exchange",
+                     _convert_broadcast_exchange)]
 
 
 _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_write_rules()

@@ -25,15 +25,27 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
 
 
 def ensure_requirements(plan: PhysicalExec) -> PhysicalExec:
-    from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
-                                                       RangePartitioning,
-                                                       SinglePartitioning)
-    from spark_rapids_tpu.execs.join_execs import CpuHashJoinExec
+    from spark_rapids_tpu.execs.exchange_execs import (
+        BroadcastExchangeExecBase, CpuBroadcastExchangeExec,
+        CpuShuffleExchangeExec, RangePartitioning, SinglePartitioning)
+    from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
+                                                   CpuHashJoinExec,
+                                                   CpuNestedLoopJoinExec)
     from spark_rapids_tpu.execs.window_execs import CpuWindowExec
     single_required = (ce.CpuHashAggregateExec, ce.CpuLimitExec,
                        CpuHashJoinExec, CpuWindowExec)
 
     def fix(node: PhysicalExec) -> PhysicalExec:
+        if isinstance(node, (CpuBroadcastHashJoinExec, CpuNestedLoopJoinExec)):
+            # broadcast distribution on the build side only; the stream side
+            # keeps its partitioning (BroadcastDistribution requirement)
+            bi = 0 if node.build_side == "left" else 1
+            build = node.children[bi]
+            if not isinstance(build, BroadcastExchangeExecBase):
+                new_children = list(node.children)
+                new_children[bi] = CpuBroadcastExchangeExec(build)
+                return node.with_children(new_children)
+            return node
         if isinstance(node, ce.CpuSortExec):
             # global sort over partitioned input = range exchange +
             # per-partition sort (Spark's SortExec + RangePartitioning shape;
@@ -159,8 +171,8 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
             raise NotImplementedError(
                 f"join conditions are only supported for inner joins, not "
                 f"{plan.how}")
-        return CpuHashJoinExec(left, right, plan.how, tuple(lkeys),
-                               tuple(rkeys), out_schema, cond)
+        return _select_join(left, right, plan.how, tuple(lkeys), tuple(rkeys),
+                            out_schema, cond, conf)
     if isinstance(plan, lp.Repartition):
         from spark_rapids_tpu.execs.exchange_execs import (
             CpuShuffleExchangeExec, HashPartitioning, RoundRobinPartitioning)
@@ -172,6 +184,48 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
             part = RoundRobinPartitioning(plan.num_partitions)
         return CpuShuffleExchangeExec(part, child)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")
+
+
+def _select_join(left: PhysicalExec, right: PhysicalExec, how: str,
+                 lkeys: Tuple[Expression, ...], rkeys: Tuple[Expression, ...],
+                 out_schema: Schema, cond, conf: TpuConf) -> PhysicalExec:
+    """Join strategy selection (Spark JoinSelection role): broadcast hash join
+    when a legal build side's estimated size is under the threshold, shuffled
+    hash join otherwise; keyless joins become broadcast nested-loop or
+    cartesian product."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
+                                                   CpuCartesianProductExec,
+                                                   CpuHashJoinExec,
+                                                   CpuNestedLoopJoinExec)
+    threshold = conf.get(cfg.BROADCAST_JOIN_THRESHOLD)
+
+    def broadcastable(side: PhysicalExec) -> bool:
+        sz = side.size_estimate()
+        return sz is not None and sz <= threshold
+
+    # an outer side cannot be the build side: its unmatched rows would be
+    # emitted once per stream partition (Spark's BuildSide legality rules)
+    can_build_right = how in ("inner", "left", "left_semi", "left_anti", "cross")
+    can_build_left = how in ("inner", "right", "cross")
+    if not lkeys:
+        if how not in ("inner", "cross"):
+            raise NotImplementedError(
+                f"{how} join requires join keys (no nested-loop form)")
+        if can_build_right and broadcastable(right):
+            return CpuNestedLoopJoinExec(left, right, how, out_schema, cond,
+                                         build_side="right")
+        if can_build_left and broadcastable(left):
+            return CpuNestedLoopJoinExec(left, right, how, out_schema, cond,
+                                         build_side="left")
+        return CpuCartesianProductExec(left, right, how, out_schema, cond)
+    if can_build_right and broadcastable(right):
+        return CpuBroadcastHashJoinExec(left, right, how, lkeys, rkeys,
+                                        out_schema, cond, build_side="right")
+    if can_build_left and broadcastable(left):
+        return CpuBroadcastHashJoinExec(left, right, how, lkeys, rkeys,
+                                        out_schema, cond, build_side="left")
+    return CpuHashJoinExec(left, right, how, lkeys, rkeys, out_schema, cond)
 
 
 def _named(bound: Expression, original: Expression) -> Expression:

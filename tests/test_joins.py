@@ -22,14 +22,103 @@ def right_table():
     })
 
 
+NO_BROADCAST = {"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "-1"}
+
+
 @pytest.mark.parametrize("how", ["inner", "left", "right", "full",
                                  "left_semi", "left_anti"])
 def test_join_types(how):
     lt, rt = left_table(), right_table()
     assert_tpu_and_cpu_equal(
         lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "k", how),
-        ignore_order=True,
+        ignore_order=True, conf=NO_BROADCAST,
         expect_tpu_execs=["TpuShuffledHashJoinExec"])
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_broadcast_join_types(how):
+    """Small build sides take the broadcast strategy (BroadcastHashJoinSuite
+    analog): same results, TpuBroadcastHashJoinExec + TpuBroadcastExchangeExec
+    in the plan."""
+    lt, rt = left_table(), right_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).join(s.create_dataframe(rt), "k", how),
+        ignore_order=True,
+        expect_tpu_execs=["TpuBroadcastHashJoinExec", "TpuBroadcastExchangeExec"])
+
+
+def test_broadcast_right_outer_builds_left():
+    """An outer side cannot be broadcast: right outer join must build LEFT."""
+    from spark_rapids_tpu.api import TpuSession
+    lt, rt = left_table(), right_table()
+    s = TpuSession()
+    out = (s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "right")
+           .collect())
+    plan = s.last_plan.tree_string()
+    assert "TpuBroadcastHashJoinExec" in plan
+    assert out.num_rows == 7  # 5 matches + k=4 and null-key right rows
+
+
+def test_broadcast_join_partitioned_stream():
+    """The stream side keeps its partitioning; each partition joins against the
+    one cached build batch."""
+    lt, rt = left_table(), right_table()
+    assert_tpu_and_cpu_equal(
+        lambda s: (s.create_dataframe(lt).repartition(3, "k")
+                   .join(s.create_dataframe(rt), "k", "left")),
+        ignore_order=True,
+        expect_tpu_execs=["TpuBroadcastHashJoinExec"])
+
+
+def test_full_join_never_broadcasts():
+    from spark_rapids_tpu.api import TpuSession
+    lt, rt = left_table(), right_table()
+    s = TpuSession()
+    s.create_dataframe(lt).join(s.create_dataframe(rt), "k", "full").collect()
+    assert "TpuShuffledHashJoinExec" in s.last_plan.tree_string()
+
+
+def test_nested_loop_join_disabled_by_default():
+    """GpuOverrides.scala:1688-1691 analog: brute-force joins stay on CPU
+    unless explicitly enabled."""
+    from spark_rapids_tpu.api import TpuSession
+    lt = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+    rt = pa.table({"b": pa.array([10, 20, 30], type=pa.int64())})
+    s = TpuSession()
+    s.create_dataframe(lt).crossJoin(s.create_dataframe(rt)).collect()
+    plan = s.last_plan.tree_string()
+    assert "CpuNestedLoopJoinExec" in plan
+    assert "disabled by default" in s.last_explain
+
+
+def test_nested_loop_join_enabled():
+    lt = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    rt = pa.table({"b": pa.array([10, 20, 30, None], type=pa.int64())})
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(lt).crossJoin(s.create_dataframe(rt)),
+        ignore_order=True,
+        conf={"spark.rapids.tpu.sql.exec.NestedLoopJoin": "true"},
+        expect_tpu_execs=["TpuBroadcastNestedLoopJoinExec"])
+    assert cpu.num_rows == 12
+
+
+def test_cartesian_product_enabled():
+    """Sides with unknown size estimates go through CartesianProductExec."""
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    lt = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    rt = pa.table({"b": pa.array([10, 20], type=pa.int64())})
+
+    def build(s):
+        # aggregates have unknown output size -> no broadcast -> cartesian
+        left = s.create_dataframe(lt).groupBy("a").agg(F.count().alias("n"))
+        right = s.create_dataframe(rt).groupBy("b").agg(F.count().alias("m"))
+        return left.crossJoin(right)
+
+    cpu = assert_tpu_and_cpu_equal(
+        build, ignore_order=True,
+        conf={"spark.rapids.tpu.sql.exec.CartesianProduct": "true"},
+        expect_tpu_execs=["TpuCartesianProductExec"])
+    assert cpu.num_rows == 6
 
 
 def test_inner_join_golden():
@@ -125,7 +214,7 @@ def test_join_then_agg_pipeline():
                    .join(s.create_dataframe(rt), "k")
                    .groupBy("lv").agg(F.sum("rv").alias("srv"),
                                       F.count().alias("n"))),
-        ignore_order=True,
+        ignore_order=True, conf=NO_BROADCAST,
         expect_tpu_execs=["TpuShuffledHashJoinExec", "TpuHashAggregateExec"])
 
 
