@@ -25,6 +25,33 @@ def _to_expr(c: Union[str, Column]):
     return UnresolvedAttribute(c) if isinstance(c, str) else c.expr
 
 
+def _extract_generators(exprs, child: lp.LogicalPlan):
+    """Pull an Explode/PosExplode out of a projection list into a Generate node
+    beneath it (Catalyst's ExtractGenerator analog). At most one generator per
+    select, like Spark."""
+    from spark_rapids_tpu.exprs.generators import Explode
+    hits = [i for i, e in enumerate(exprs)
+            if isinstance(e.c if isinstance(e, Alias) else e, Explode)]
+    if not hits:
+        return exprs, child
+    if len(hits) > 1:
+        raise ValueError("only one generator (explode/posexplode) is allowed "
+                         "per select")
+    i = hits[0]
+    e = exprs[i]
+    alias = e.name if isinstance(e, Alias) else None
+    gen = e.c if isinstance(e, Alias) else e
+    col_name = alias or "col"
+    node = lp.Generate(gen.child_array.items, gen.with_position, col_name,
+                       child)
+    refs = [UnresolvedAttribute(col_name)]
+    if gen.with_position:
+        refs.insert(0, UnresolvedAttribute("pos"))
+    out = list(exprs)
+    out[i:i + 1] = refs
+    return tuple(out), node
+
+
 def _extract_windows(exprs, child: lp.LogicalPlan):
     """Pull WindowExpressions out of a projection list into Window nodes
     beneath it (Catalyst's ExtractWindowExpressions analog). Expressions
@@ -69,7 +96,8 @@ class DataFrame:
     # ---- transformations -----------------------------------------------------
     def select(self, *cols: Union[str, Column]) -> "DataFrame":
         exprs = tuple(_to_expr(c) for c in cols)
-        exprs, child = _extract_windows(exprs, self._plan)
+        exprs, child = _extract_generators(exprs, self._plan)
+        exprs, child = _extract_windows(exprs, child)
         return DataFrame(lp.Project(exprs, child), self.session)
 
     def withColumn(self, name: str, c: Column) -> "DataFrame":
@@ -84,7 +112,8 @@ class DataFrame:
                 exprs.append(UnresolvedAttribute(f.name))
         if not replaced:
             exprs.append(Alias(c.expr, name))
-        out, child = _extract_windows(tuple(exprs), self._plan)
+        out, child = _extract_generators(tuple(exprs), self._plan)
+        out, child = _extract_windows(out, child)
         return DataFrame(lp.Project(out, child), self.session)
 
     def filter(self, cond: Column) -> "DataFrame":

@@ -23,6 +23,36 @@ def col(name: str) -> Column:
     return Column(UnresolvedAttribute(name))
 
 
+def array(*cols: Union[str, Column]) -> Column:
+    """Per-row array from scalar columns; only consumable by explode/posexplode
+    (the reference's v0 Generate scope, GpuGenerateExec.scala:45-78)."""
+    from spark_rapids_tpu.exprs.generators import CreateArray
+    return Column(CreateArray(tuple(_c(c) for c in cols)))
+
+
+def _as_created_array(c):
+    from spark_rapids_tpu.exprs.generators import CreateArray
+    if isinstance(c, (list, tuple)):
+        return CreateArray(tuple(Literal.of(v) for v in c))
+    e = c.expr if isinstance(c, Column) else None
+    if not isinstance(e, CreateArray):
+        raise ValueError(
+            "explode/posexplode requires array(...) or a Python list literal "
+            "(ARRAY columns are not a columnar type on this engine, matching "
+            "the reference's explode-of-created-array scope)")
+    return e
+
+
+def explode(c) -> Column:
+    from spark_rapids_tpu.exprs.generators import Explode
+    return Column(Explode(_as_created_array(c)))
+
+
+def posexplode(c) -> Column:
+    from spark_rapids_tpu.exprs.generators import Explode
+    return Column(Explode(_as_created_array(c), with_position=True))
+
+
 def lit(value: Any) -> Column:
     return Column(Literal.of(value))
 

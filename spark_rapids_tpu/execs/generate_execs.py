@@ -1,0 +1,50 @@
+"""Generate physical operators (reference: GpuGenerateExec.scala, 194 LoC).
+
+Explode/posexplode of a created array lowers onto the Expand kernel: input row
+i emits one row per array element j, projected as
+(child columns, [pos=j], element_j). Shapes stay static — the output is exactly
+len(elements) batches per input batch — which is the same execution shape the
+reference gets by building one cudf projection table per element
+(GpuGenerateExec.scala doExecuteColumnar). ``outer`` is unsupported, like the
+reference (tagPlanForGpu "outer is not currently supported").
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
+from spark_rapids_tpu.execs.base import PhysicalExec
+from spark_rapids_tpu.execs.expand_execs import CpuExpandExec, TpuExpandExec
+from spark_rapids_tpu.exprs.cast import Cast
+from spark_rapids_tpu.exprs.core import BoundReference, Expression
+from spark_rapids_tpu.exprs.literals import Literal
+
+
+def generate_projections(child_schema: Schema, elements: Tuple[Expression, ...],
+                         pos: bool, output: Schema) -> Tuple[Tuple[Expression, ...], ...]:
+    """One projection list per array element: child cols ++ [pos_j] ++ [elem_j],
+    with elements cast to the resolved common column type."""
+    col_type = output.fields[-1].dtype
+    projections = []
+    for j, e in enumerate(elements):
+        row: list = [BoundReference(i, f.dtype, f.nullable)
+                     for i, f in enumerate(child_schema)]
+        if pos:
+            row.append(Literal(j, DType.INT))
+        if e.dtype() is DType.NULL:
+            e = Literal(None, col_type)
+        elif e.dtype() is not col_type:
+            e = Cast(e, col_type)
+        row.append(e)
+        projections.append(tuple(row))
+    return tuple(projections)
+
+
+class CpuGenerateExec(CpuExpandExec):
+    def __init__(self, projections, child: PhysicalExec, output: Schema):
+        super().__init__(projections, child, output)
+
+
+class TpuGenerateExec(TpuExpandExec):
+    def __init__(self, projections, child: PhysicalExec, output: Schema):
+        super().__init__(projections, child, output)

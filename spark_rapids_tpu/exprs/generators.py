@@ -1,0 +1,55 @@
+"""Generator expressions (reference: GpuGenerateExec.scala meta handling).
+
+The reference's v0 scope is explode/posexplode of a *created* array —
+``Explode(CreateArray(exprs))`` or an array literal (GpuGenerateExec.scala:45-62
+``arrayExprs``, tagPlanForGpu "Only posexplode of a created array is currently
+supported"). That keeps every shape static: each input row emits exactly
+len(elements) output rows, which is the Expand kernel's shape. These classes are
+plan-time markers consumed by the planner; they never reach expression
+evaluation (ARRAY is not a columnar type here, same as the reference's type
+gate excluding ArrayType, GpuOverrides.isSupportedType:389).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import Expression
+
+
+@dataclass(frozen=True)
+class CreateArray(Expression):
+    """Array built from per-row scalar expressions (Spark's CreateArray)."""
+    items: Tuple[Expression, ...]
+
+    def dtype(self) -> DType:
+        raise TypeError("array values only exist inside explode/posexplode on "
+                        "this engine (ARRAY is not a columnar type)")
+
+    def element_type(self) -> DType:
+        dt = DType.NULL
+        for e in self.items:
+            et = e.dtype()
+            if et is DType.NULL:
+                continue
+            dt = et if dt is DType.NULL else DType.common_type(dt, et)
+        return dt
+
+
+@dataclass(frozen=True)
+class Explode(Expression):
+    """One output row per array element (Spark's Explode generator)."""
+    child_array: CreateArray
+    #: with_position=True is posexplode: an extra int 'pos' column
+    with_position: bool = False
+
+    def dtype(self) -> DType:
+        return self.child_array.element_type()
+
+    def nullable(self) -> bool:
+        return any(e.nullable() for e in self.child_array.items)
+
+    @property
+    def name_hint(self) -> str:
+        return "col"

@@ -231,6 +231,37 @@ class Expand(LogicalPlan):
 
 
 @dataclass
+class Generate(LogicalPlan):
+    """Explode/posexplode of a created array (Spark's Generate; reference
+    GpuGenerateExec scope): child columns ++ [pos] ++ [col], one output row per
+    array element per input row."""
+    elements: Tuple[Expression, ...]
+    pos: bool
+    col_name: str
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.exprs.core import bind_expression
+        cs = self.child.schema()
+        fields = list(cs.fields)
+        if self.pos:
+            fields.append(Field("pos", DType.INT, nullable=False))
+        bound = [bind_expression(e, cs) for e in self.elements]
+        dt = DType.NULL
+        for b in bound:
+            et = b.dtype()
+            if et is not DType.NULL:
+                dt = et if dt is DType.NULL else DType.common_type(dt, et)
+        nullable = any(b.nullable() or b.dtype() is DType.NULL for b in bound)
+        fields.append(Field(self.col_name, dt, nullable))
+        return Schema(fields)
+
+
+@dataclass
 class Window(LogicalPlan):
     """Window computation: child columns ++ one window column per expression.
     All wexprs share one (partition, order) sort spec (the API groups them)."""

@@ -429,11 +429,19 @@ def _convert_expand(meta: ExecMeta, children) -> PhysicalExec:
     return TpuExpandExec(meta.exec.projections, children[0], meta.exec.output)
 
 
+def _convert_generate(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.generate_execs import TpuGenerateExec
+    return TpuGenerateExec(meta.exec.projections, children[0], meta.exec.output)
+
+
 def _make_expand_rules() -> List[ExecRule]:
     from spark_rapids_tpu.execs.expand_execs import CpuExpandExec
+    from spark_rapids_tpu.execs.generate_execs import CpuGenerateExec
+    proj_exprs = lambda e: tuple(x for p in e.projections for x in p)  # noqa: E731
     return [ExecRule(CpuExpandExec, "expand projections", _convert_expand,
-                     exprs_of=lambda e: tuple(x for p in e.projections
-                                              for x in p))]
+                     exprs_of=proj_exprs),
+            ExecRule(CpuGenerateExec, "explode of a created array",
+                     _convert_generate, exprs_of=proj_exprs)]
 
 
 def _convert_window(meta: ExecMeta, children) -> PhysicalExec:

@@ -135,6 +135,15 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
         projs = tuple(tuple(bind_expression(e, child.output) for e in p)
                       for p in plan.projections)
         return CpuExpandExec(projs, child, plan.schema())
+    if isinstance(plan, lp.Generate):
+        from spark_rapids_tpu.execs.generate_execs import (
+            CpuGenerateExec, generate_projections)
+        child = _plan_node(plan.child, conf)
+        elements = tuple(bind_expression(e, child.output)
+                         for e in plan.elements)
+        out = plan.schema()
+        projs = generate_projections(child.output, elements, plan.pos, out)
+        return CpuGenerateExec(projs, child, out)
     if isinstance(plan, lp.Window):
         from spark_rapids_tpu.execs.window_execs import CpuWindowExec
         child = _plan_node(plan.child, conf)
