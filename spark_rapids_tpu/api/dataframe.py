@@ -150,15 +150,35 @@ class DataFrame:
 
     unionAll = union
 
-    def join(self, other: "DataFrame", on: Union[str, List[str]],
+    def join(self, other: "DataFrame", on: Union[str, List],
              how: str = "inner") -> "DataFrame":
         """USING-style join: key columns appear once in the output (from the
-        left side, the right side for right joins, coalesced for full)."""
+        left side, the right side for right joins, coalesced for full).
+
+        ``on`` may also contain ``(left_name, right_name)`` pairs for keys
+        named differently on each side; those keep both columns in the output
+        (the ``df1.c1 == df2.c2`` pyspark form)."""
         how = {"leftsemi": "left_semi", "semi": "left_semi",
                "leftanti": "left_anti", "anti": "left_anti",
                "leftouter": "left", "rightouter": "right",
                "outer": "full", "fullouter": "full"}.get(how, how)
-        keys = [on] if isinstance(on, str) else list(on)
+        raw = [on] if isinstance(on, str) else list(on)
+        if any(isinstance(k, tuple) for k in raw):
+            if not all(isinstance(k, tuple) for k in raw):
+                # a string key promises USING dedup/coalesce, which the
+                # pair form does not do — mixing would silently change the
+                # shared key's output semantics
+                raise ValueError(
+                    "join keys must be all strings (USING semantics) or all "
+                    "(left, right) pairs; use ('k', 'k') for same-named keys "
+                    "in the pair form")
+            pairs = raw
+            lkeys = tuple(UnresolvedAttribute(a) for a, _ in pairs)
+            rkeys = tuple(UnresolvedAttribute(b) for _, b in pairs)
+            return DataFrame(
+                lp.Join(self._plan, other._plan, how, lkeys, rkeys),
+                self.session)
+        keys = raw
         lkeys = tuple(UnresolvedAttribute(k) for k in keys)
         rkeys = tuple(UnresolvedAttribute(k) for k in keys)
         joined = lp.Join(self._plan, other._plan, how, lkeys, rkeys)
@@ -203,6 +223,31 @@ class DataFrame:
         keep = [UnresolvedAttribute(f.name) for f in self._plan.schema()
                 if f.name not in names]
         return DataFrame(lp.Project(tuple(keep), self._plan), self.session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = tuple(Alias(UnresolvedAttribute(f.name), new)
+                      if f.name == old else UnresolvedAttribute(f.name)
+                      for f in self._plan.schema())
+        return DataFrame(lp.Project(exprs, self._plan), self.session)
+
+    def distinct(self) -> "DataFrame":
+        return self.dropDuplicates()
+
+    def dropDuplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        """Distinct via group-by (Spark plans distinct the same way). With a
+        subset, the remaining columns keep one arbitrary row per key (pyspark
+        semantics), taken with first()."""
+        from spark_rapids_tpu.exprs import First
+        all_names = [f.name for f in self._plan.schema()]
+        names = subset or all_names
+        grouping = tuple(UnresolvedAttribute(n) for n in names)
+        rest = tuple(Alias(First(UnresolvedAttribute(n), False), n)
+                     for n in all_names if n not in names)
+        agg = DataFrame(lp.Aggregate(grouping, rest, self._plan), self.session)
+        if not rest:
+            return agg
+        # restore the original column order
+        return agg.select(*all_names)
 
     # ---- actions -------------------------------------------------------------
     def _executed_plan(self) -> PhysicalExec:
