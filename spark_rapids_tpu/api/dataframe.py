@@ -266,6 +266,15 @@ class DataFrame:
         try:
             # device-admission throttle for the whole task (GpuSemaphore analog)
             with dm.semaphore.held():
+                from spark_rapids_tpu import config as _cfg
+                if self.session.conf.get(_cfg.ADAPTIVE_ENABLED):
+                    from spark_rapids_tpu.plan.adaptive import adaptive_rewrite
+                    stage_ctx = ExecContext(self.session.conf, partition_id=0,
+                                            num_partitions=1,
+                                            device_manager=dm,
+                                            cleanups=cleanups)
+                    final = adaptive_rewrite(final, stage_ctx)
+                    self.session.last_plan = final
                 for p in range(final.num_partitions):
                     ctx = ExecContext(self.session.conf, partition_id=p,
                                       num_partitions=final.num_partitions,

@@ -159,6 +159,19 @@ STRING_MAX_BYTES = _conf(
     "[rows, maxBytes] uint8 matrix plus a length vector (TPU-friendly layout); rows longer "
     "than this fall back to CPU.", checker=_positive("string.maxBytes"))
 
+ADAPTIVE_ENABLED = _conf(
+    "sql.adaptive.enabled", bool, False,
+    "Adaptive query execution: run shuffle map stages first, then re-plan with "
+    "the observed statistics — coalesce small reduce partitions into "
+    "CustomShuffleReader groups and switch shuffled hash joins to broadcast "
+    "when the built side turned out small (spark.sql.adaptive.enabled role).")
+
+ADAPTIVE_ADVISORY_PARTITION_BYTES = _conf(
+    "sql.adaptive.advisoryPartitionSizeInBytes", int, 64 * 1024 * 1024,
+    "Target post-shuffle partition size for AQE coalescing "
+    "(spark.sql.adaptive.advisoryPartitionSizeInBytes role).",
+    checker=_positive("advisoryPartitionSizeInBytes"))
+
 BROADCAST_JOIN_THRESHOLD = _conf(
     "sql.broadcastJoinThreshold.bytes", int, 10 * 1024 * 1024,
     "Maximum estimated build-side size for a join to use the broadcast hash "

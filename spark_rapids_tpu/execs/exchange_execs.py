@@ -276,6 +276,9 @@ class ShuffleExchangeExecBase(PhysicalExec):
         self.partitioning = partitioning
         self._lock = threading.Lock()
         self._map_done = False
+        #: rows written per reduce partition, filled by _run_map (the
+        #: MapStatus sizes that drive AQE decisions)
+        self._part_rows: Dict[int, int] = {}
 
     @property
     def num_partitions(self) -> int:
@@ -283,6 +286,18 @@ class ShuffleExchangeExecBase(PhysicalExec):
 
     def _child_contexts(self, ctx: ExecContext) -> Iterator[ExecContext]:
         return _child_contexts(self.children[0], ctx)
+
+    def map_output_stats(self, ctx: ExecContext) -> List[int]:
+        """Estimated bytes per reduce partition, forcing the map side to run
+        (Spark's MapOutputStatistics — what AQE reads before re-planning)."""
+        from spark_rapids_tpu.execs.cpu_execs import _row_width
+        with self._lock:
+            if not self._map_done:
+                self._run_map(ctx)
+                self._map_done = True
+        width = _row_width(self.output)
+        return [self._part_rows.get(p, 0) * width
+                for p in range(self.num_partitions)]
 
 
 def _child_contexts(child: PhysicalExec, ctx: ExecContext) -> Iterator[ExecContext]:
@@ -359,9 +374,11 @@ class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
                        for v in sorted_cols]
                 self._parts.setdefault(j, []).append(
                     _colvs_to_host(self.output, sub, cnt))
+                self._part_rows[j] = self._part_rows.get(j, 0) + cnt
 
     def _release(self) -> None:
         self._parts = {}
+        self._part_rows = {}
         self._map_done = False
 
 
@@ -483,6 +500,7 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                 meta = layout_to_meta(layout, sub.num_rows)
                 env.shuffle_catalog.add_batch(
                     ShuffleBlockId(sid, map_id, j), sub, meta)
+                self._part_rows[j] = self._part_rows.get(j, 0) + sub.num_rows
             map_id += 1
 
     def _split_batch(self, ctx, part, db: DeviceBatch, offset: int, n: int,

@@ -1,0 +1,227 @@
+"""Adaptive query execution (reference analogs: GpuCustomShuffleReaderExec,
+execution/GpuCustomShuffleReaderExec.scala 122 LoC; GpuQueryStagePrepOverrides,
+GpuOverrides.scala:1744; GpuTransitionOverrides.optimizeAdaptiveTransitions).
+
+Spark AQE executes shuffle map stages, reads MapOutputStatistics, and re-plans
+the rest of the query. This engine does the same with in-process stages: every
+exchange's map side runs first (its output is cached/spillable), then the plan
+above it is rewritten using the observed per-partition sizes:
+
+- **partition coalescing** — contiguous small reduce partitions are grouped to
+  the advisory size and read through a CustomShuffleReader
+  (CoalescedPartitionSpec semantics);
+- **dynamic broadcast join** — a shuffled hash join whose finished build-side
+  shuffle turned out under the broadcast threshold is rewritten to a broadcast
+  hash join reading ALL of that shuffle's output once (Spark's
+  DynamicJoinSelection + the reader's all-partition mode).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.exchange_execs import (CpuBroadcastExchangeExec,
+                                                   ShuffleExchangeExecBase,
+                                                   SinglePartitioning,
+                                                   TpuBroadcastExchangeExec)
+
+
+class CustomShuffleReaderExecBase(PhysicalExec):
+    """Reads a subset/grouping of an executed exchange's reduce partitions.
+    ``specs[i]`` is the tuple of exchange partition ids consumer partition i
+    reads (coalesced partitions = multi-id tuples; the all-partition single
+    spec is the broadcast-build mode)."""
+
+    def __init__(self, exchange: ShuffleExchangeExecBase,
+                 specs: Tuple[Tuple[int, ...], ...]):
+        super().__init__((exchange,), exchange.output)
+        self.specs = specs
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.specs)
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        exchange = self.children[0]
+        for pid in self.specs[ctx.partition_id]:
+            sub = ExecContext(ctx.conf, partition_id=pid,
+                              num_partitions=exchange.num_partitions,
+                              device_manager=ctx.device_manager,
+                              cleanups=ctx.cleanups)
+            for batch in exchange.execute(sub):
+                self.count_output(batch.num_rows)
+                yield batch
+
+
+class CpuCustomShuffleReaderExec(CustomShuffleReaderExecBase):
+    pass
+
+
+class TpuCustomShuffleReaderExec(CustomShuffleReaderExecBase):
+    is_device = True
+
+
+def _reader_for(exchange: ShuffleExchangeExecBase,
+                specs: Tuple[Tuple[int, ...], ...]) -> CustomShuffleReaderExecBase:
+    cls = (TpuCustomShuffleReaderExec if exchange.is_device
+           else CpuCustomShuffleReaderExec)
+    return cls(exchange, specs)
+
+
+def coalesce_specs(sizes: List[int], target: int) -> Tuple[Tuple[int, ...], ...]:
+    """Group contiguous reduce partitions until each group reaches the
+    advisory size (Spark's coalesceShufflePartitions)."""
+    specs: List[Tuple[int, ...]] = []
+    group: List[int] = []
+    acc = 0
+    for pid, sz in enumerate(sizes):
+        group.append(pid)
+        acc += sz
+        if acc >= target:
+            specs.append(tuple(group))
+            group, acc = [], 0
+    if group:
+        specs.append(tuple(group))
+    return tuple(specs) if specs else ((),)
+
+
+def adaptive_rewrite(plan: PhysicalExec, ctx: ExecContext) -> PhysicalExec:
+    """Run every shuffle map stage, then re-plan the tree above it using the
+    observed statistics. Returns the rewritten plan (the input plan's cached
+    exchange outputs are reused, not recomputed)."""
+    conf = ctx.conf
+    threshold = conf.get(cfg.BROADCAST_JOIN_THRESHOLD)
+    target = conf.get(cfg.ADAPTIVE_ADVISORY_PARTITION_BYTES)
+
+    def stats(node: PhysicalExec) -> Optional[List[int]]:
+        if isinstance(node, ShuffleExchangeExecBase):
+            return node.map_output_stats(ctx)
+        return None
+
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        from spark_rapids_tpu.execs.join_execs import (CpuHashJoinExec,
+                                                       TpuShuffledHashJoinExec)
+
+        # ---- dynamic broadcast join (before generic coalescing so the build
+        # side becomes an all-partition reader, not a coalesced one)
+        if type(node) in (CpuHashJoinExec, TpuShuffledHashJoinExec):
+            rewritten = _try_broadcast_switch(node, stats, threshold)
+            if rewritten is not None:
+                return rewritten
+
+        # ---- coalesce small partitions under any other parent. A
+        # single-partition exchange reads every child partition anyway, so
+        # coalescing beneath it only adds a copy layer (and would hide the
+        # stage from the broadcast-switch unwrap above).
+        if (isinstance(node, ShuffleExchangeExecBase)
+                and isinstance(node.partitioning, SinglePartitioning)):
+            return node
+        new_children = []
+        changed = False
+        for c in node.children:
+            sz = stats(c)
+            if sz is not None and c.num_partitions > 1:
+                specs = coalesce_specs(sz, target)
+                if len(specs) < c.num_partitions:
+                    new_children.append(_reader_for(c, specs))
+                    changed = True
+                    continue
+            new_children.append(c)
+        return node.with_children(new_children) if changed else node
+
+    out = plan.transform_up(fix)
+    # root may itself be an exchange (bare repartition): coalesce it too
+    sz = stats(out)
+    if sz is not None and out.num_partitions > 1:
+        specs = coalesce_specs(sz, target)
+        if len(specs) < out.num_partitions:
+            out = _reader_for(out, specs)
+    return _restore_requirements(out)
+
+
+def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
+    """Re-establish distribution requirements the rewrite may have broken
+    (Spark AQE re-runs EnsureRequirements per stage): a broadcast-switched
+    join now emits the stream side's partitioning, but its parents were
+    planned when it emitted one partition — limits, global sorts, aggregates,
+    windows, and shuffled-join inputs above it need their single-partition
+    input back."""
+    from spark_rapids_tpu.execs import cpu_execs as ce
+    from spark_rapids_tpu.execs import tpu_execs as te
+    from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
+                                                       TpuShuffleExchangeExec)
+    from spark_rapids_tpu.execs.join_execs import (CpuHashJoinExec,
+                                                   TpuShuffledHashJoinExec)
+    from spark_rapids_tpu.execs.window_execs import CpuWindowExec, TpuWindowExec
+
+    def needs_single_children(node: PhysicalExec) -> bool:
+        if type(node) in (CpuHashJoinExec, TpuShuffledHashJoinExec):
+            return True
+        return isinstance(node, (ce.CpuHashAggregateExec,
+                                 te.TpuHashAggregateExec,
+                                 ce.CpuLimitExec, te.TpuLimitExec,
+                                 ce.CpuSortExec, te.TpuSortExec,
+                                 CpuWindowExec, TpuWindowExec))
+
+    def single(child: PhysicalExec) -> PhysicalExec:
+        cls = (TpuShuffleExchangeExec if child.is_device
+               else CpuShuffleExchangeExec)
+        return cls(SinglePartitioning(), child)
+
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        if not needs_single_children(node):
+            return node
+        new_children = [single(c) if c.num_partitions > 1 else c
+                        for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            return node
+        return node.with_children(new_children)
+
+    return plan.transform_up(fix)
+
+
+def _unwrap_single(node: PhysicalExec) -> PhysicalExec:
+    """Look through the single-partition coalescing exchange EnsureRequirements
+    puts above each shuffled-join input: the interesting stage (and statistics)
+    is the exchange underneath it."""
+    if (isinstance(node, ShuffleExchangeExecBase)
+            and isinstance(node.partitioning, SinglePartitioning)
+            and isinstance(node.children[0], ShuffleExchangeExecBase)):
+        return node.children[0]
+    return node
+
+
+def _try_broadcast_switch(join, stats, threshold: int):
+    """If a finished build-side shuffle is small, switch the shuffled hash join
+    to the broadcast variant: build = BroadcastExchange over an all-partition
+    reader of the already-executed exchange. The stream side drops its
+    single-partition coalesce and stays partitioned — the payoff Spark's
+    DynamicJoinSelection is after."""
+    from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
+                                                   TpuBroadcastHashJoinExec)
+    how = join.how
+    sides = []
+    if how in ("inner", "left", "left_semi", "left_anti", "cross"):
+        sides.append(1)
+    if how in ("inner", "right", "cross"):
+        sides.append(0)
+    for bi in sides:
+        build = _unwrap_single(join.children[bi])
+        sz = stats(build)
+        if sz is None or sum(sz) > threshold:
+            continue
+        all_parts = (tuple(range(build.num_partitions)),)
+        bcast_reader = _reader_for(build, all_parts)
+        bcast = (TpuBroadcastExchangeExec(bcast_reader) if build.is_device
+                 else CpuBroadcastExchangeExec(bcast_reader))
+        stream = _unwrap_single(join.children[1 - bi])
+        new_children = [None, None]
+        new_children[bi] = bcast
+        new_children[1 - bi] = stream
+        cls = (TpuBroadcastHashJoinExec if join.is_device
+               else CpuBroadcastHashJoinExec)
+        return cls(new_children[0], new_children[1], how, join.left_keys,
+                   join.right_keys, join.output, join.condition,
+                   build_side="left" if bi == 0 else "right")
+    return None

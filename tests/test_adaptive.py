@@ -1,0 +1,144 @@
+"""AQE tests: custom shuffle reader coalescing + dynamic broadcast join switch
+(GpuCustomShuffleReaderExec / optimizeAdaptiveTransitions analog coverage)."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.plan.adaptive import coalesce_specs
+from spark_rapids_tpu.testing import assert_tables_equal
+
+AQE = {"spark.rapids.tpu.sql.adaptive.enabled": "true"}
+
+
+def table(n=100):
+    return pa.table({"a": pa.array(np.arange(n), type=pa.int64()),
+                     "b": pa.array(np.arange(n) % 7, type=pa.int64())})
+
+
+def test_coalesce_specs():
+    # groups accumulate until the advisory size is reached
+    assert coalesce_specs([10, 10, 10, 10], 25) == ((0, 1, 2), (3,))
+    assert coalesce_specs([30, 30], 25) == ((0,), (1,))
+    assert coalesce_specs([1, 1, 1], 1000) == ((0, 1, 2),)
+    assert coalesce_specs([], 10) == ((),)
+    # empty partitions fold into their neighbors
+    assert coalesce_specs([0, 0, 50, 0], 25) == ((0, 1, 2), (3,))
+
+
+def test_reader_coalesces_small_partitions():
+    t = table()
+    s = TpuSession(AQE)
+    out = (s.create_dataframe(t).repartition(6, "b")
+           .filter(F.col("a") > 10).collect())
+    plan = s.last_plan.tree_string()
+    assert "TpuCustomShuffleReaderExec" in plan
+    assert out.num_rows == 89
+
+    # same answer without AQE
+    s2 = TpuSession()
+    ref = (s2.create_dataframe(t).repartition(6, "b")
+           .filter(F.col("a") > 10).collect())
+    assert "CustomShuffleReader" not in s2.last_plan.tree_string()
+    assert_tables_equal(ref.sort_by("a"), out.sort_by("a"))
+
+
+def test_reader_respects_advisory_size():
+    # a tiny advisory size keeps every (non-empty) partition separate -> no
+    # reader; round-robin spreads rows so no partition is empty
+    t = table()
+    s = TpuSession({**AQE,
+                    "spark.rapids.tpu.sql.adaptive."
+                    "advisoryPartitionSizeInBytes": "1"})
+    out = (s.create_dataframe(t).repartition(4)
+           .filter(F.col("a") > 10).collect())
+    assert "CustomShuffleReader" not in s.last_plan.tree_string()
+    assert out.num_rows == 89
+
+
+def test_dynamic_broadcast_join_switch():
+    t = table()
+
+    def run(conf):
+        s = TpuSession(conf)
+        lt = s.create_dataframe(t).repartition(4, "b")
+        rt = (s.create_dataframe(t).repartition(3, "b")
+              .groupBy("b").agg(F.count().alias("n")))
+        return lt.join(rt, "b").sort("b", "a").collect(), s
+
+    aqe_res, s_aqe = run(AQE)
+    plan = s_aqe.last_plan.tree_string()
+    assert "TpuBroadcastHashJoinExec" in plan
+    assert "TpuBroadcastExchangeExec" in plan
+    assert "TpuCustomShuffleReaderExec" in plan
+
+    ref, s_ref = run({})
+    assert "TpuShuffledHashJoinExec" in s_ref.last_plan.tree_string()
+    assert_tables_equal(ref, aqe_res)
+
+
+def test_broadcast_switch_respects_threshold():
+    t = table(500)
+    s = TpuSession({**AQE,
+                    "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "10"})
+    lt = s.create_dataframe(t).repartition(4, "b")
+    rt = (s.create_dataframe(t).repartition(3, "b")
+          .groupBy("b").agg(F.count().alias("n")))
+    out = lt.join(rt, "b").sort("b", "a").collect()
+    plan = s.last_plan.tree_string()
+    assert "TpuShuffledHashJoinExec" in plan, plan
+    assert out.num_rows == 500
+
+
+def test_aqe_on_cpu_engine():
+    """The fallback engine adapts too (CpuCustomShuffleReaderExec)."""
+    t = table()
+    s = TpuSession({**AQE, "spark.rapids.tpu.sql.enabled": "false"})
+    out = (s.create_dataframe(t).repartition(5, "b")
+           .filter(F.col("a") > 10).collect())
+    assert "CpuCustomShuffleReaderExec" in s.last_plan.tree_string()
+    assert out.num_rows == 89
+
+
+def test_aqe_full_query_pipeline():
+    """Join + aggregate + sort under AQE matches non-AQE output."""
+    t = table(300)
+
+    def run(conf):
+        s = TpuSession(conf)
+        lt = s.create_dataframe(t).repartition(4, "b")
+        rt = (s.create_dataframe(t).repartition(3, "b")
+              .groupBy("b").agg(F.sum("a").alias("sa")))
+        return (lt.join(rt, "b")
+                .groupBy("b").agg(F.count().alias("n"), F.max("sa").alias("m"))
+                .sort("b").collect())
+
+    assert_tables_equal(run({}), run(AQE))
+
+
+def test_broadcast_switch_restores_limit_semantics():
+    """Regression: after the switch the join emits the stream partitioning;
+    a limit planned for single-partition input must still see one partition."""
+    t = table()
+    def run(conf):
+        s = TpuSession(conf)
+        lt = s.create_dataframe(t).repartition(4, "b")
+        rt = (s.create_dataframe(t).repartition(3, "b")
+              .groupBy("b").agg(F.count().alias("n")))
+        return lt.join(rt, "b").limit(5).collect()
+    assert run(AQE).num_rows == 5
+    assert run({}).num_rows == 5
+
+
+def test_broadcast_switch_restores_agg_distribution():
+    """Regression: non-co-partitioned aggregate above a switched join must
+    still produce global groups."""
+    t = table()
+    def run(conf):
+        s = TpuSession(conf)
+        lt = s.create_dataframe(t).repartition(4, "b")
+        rt = (s.create_dataframe(t).repartition(3, "b")
+              .groupBy("b").agg(F.count().alias("n")))
+        return (lt.join(rt, "b")
+                .groupBy("a").agg(F.count().alias("c"))
+                .sort("a").collect())
+    assert_tables_equal(run({}), run(AQE))
