@@ -23,6 +23,26 @@ def col(name: str) -> Column:
     return Column(UnresolvedAttribute(name))
 
 
+def udf(f=None, returnType: Union[str, DType] = DType.DOUBLE):
+    """Row UDF wrapper (pyspark.sql.functions.udf analog). The returned
+    callable produces a PythonUDF expression: row-at-a-time on the CPU engine
+    by default; with spark.rapids.tpu.sql.udfCompiler.enabled the planner
+    compiles the function's bytecode into a columnar expression tree that runs
+    on the TPU (the udf-compiler module's two-stage strategy)."""
+    from spark_rapids_tpu.udf import PythonUDF
+    if isinstance(f, (str, DType)):
+        # the @udf("int") positional form pyspark supports
+        f, returnType = None, f
+    ret = DType(returnType) if isinstance(returnType, str) else returnType
+
+    def make(fn):
+        def wrapper(*cols: Union[str, Column]) -> Column:
+            return Column(PythonUDF(fn, ret, tuple(_c(c) for c in cols)))
+        wrapper.__name__ = getattr(fn, "__name__", "udf")
+        return wrapper
+    return make if f is None else make(f)
+
+
 def array(*cols: Union[str, Column]) -> Column:
     """Per-row array from scalar columns; only consumable by explode/posexplode
     (the reference's v0 Generate scope, GpuGenerateExec.scala:45-78)."""

@@ -287,14 +287,19 @@ class ShuffleExchangeExecBase(PhysicalExec):
     def _child_contexts(self, ctx: ExecContext) -> Iterator[ExecContext]:
         return _child_contexts(self.children[0], ctx)
 
-    def map_output_stats(self, ctx: ExecContext) -> List[int]:
-        """Estimated bytes per reduce partition, forcing the map side to run
-        (Spark's MapOutputStatistics — what AQE reads before re-planning)."""
-        from spark_rapids_tpu.execs.cpu_execs import _row_width
+    def _ensure_map(self, ctx: ExecContext) -> None:
+        """Run the map side exactly once (all three consumers — both engines'
+        reads and AQE's statistics — share this lifecycle)."""
         with self._lock:
             if not self._map_done:
                 self._run_map(ctx)
                 self._map_done = True
+
+    def map_output_stats(self, ctx: ExecContext) -> List[int]:
+        """Estimated bytes per reduce partition, forcing the map side to run
+        (Spark's MapOutputStatistics — what AQE reads before re-planning)."""
+        from spark_rapids_tpu.execs.cpu_execs import _row_width
+        self._ensure_map(ctx)
         width = _row_width(self.output)
         return [self._part_rows.get(p, 0) * width
                 for p in range(self.num_partitions)]
@@ -314,10 +319,7 @@ class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
     """In-memory exchange for the CPU engine (the stock-Spark-shuffle role)."""
 
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
-        with self._lock:
-            if not self._map_done:
-                self._run_map(ctx)
-                self._map_done = True
+        self._ensure_map(ctx)
         for hb in self._parts.get(ctx.partition_id, []):
             self.count_output(hb.num_rows)
             yield hb
@@ -444,10 +446,7 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
     is_device = True
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        with self._lock:
-            if not self._map_done:
-                self._run_map(ctx)
-                self._map_done = True
+        self._ensure_map(ctx)
         env = _local_shuffle_env(ctx)
         for block in env.shuffle_catalog.blocks_for_partition(
                 self._shuffle_id, ctx.partition_id):

@@ -150,6 +150,7 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
     from spark_rapids_tpu.execs import cpu_execs as ce
     from spark_rapids_tpu.execs import tpu_execs as te
     from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
+                                                       RangePartitioning,
                                                        TpuShuffleExchangeExec)
     from spark_rapids_tpu.execs.join_execs import (CpuHashJoinExec,
                                                    TpuShuffledHashJoinExec)
@@ -161,7 +162,6 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
         return isinstance(node, (ce.CpuHashAggregateExec,
                                  te.TpuHashAggregateExec,
                                  ce.CpuLimitExec, te.TpuLimitExec,
-                                 ce.CpuSortExec, te.TpuSortExec,
                                  CpuWindowExec, TpuWindowExec))
 
     def single(child: PhysicalExec) -> PhysicalExec:
@@ -169,7 +169,28 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
                else CpuShuffleExchangeExec)
         return cls(SinglePartitioning(), child)
 
+    def is_range_distributed(child: PhysicalExec) -> bool:
+        """A range exchange — or a reader over one (coalesced groups are
+        contiguous, so partition order survives) — already satisfies a global
+        sort's distribution the way ensure_requirements planned it."""
+        if isinstance(child, CustomShuffleReaderExecBase):
+            child = child.children[0]
+        return (isinstance(child, ShuffleExchangeExecBase)
+                and isinstance(child.partitioning, RangePartitioning))
+
     def fix(node: PhysicalExec) -> PhysicalExec:
+        if isinstance(node, (ce.CpuSortExec, te.TpuSortExec)):
+            # mirror ensure_requirements: global sorts keep their parallel
+            # range-exchange shape; only re-distribute when the rewrite left
+            # the child multi-partition without one
+            child = node.children[0]
+            if child.num_partitions > 1 and not is_range_distributed(child):
+                cls = (TpuShuffleExchangeExec if child.is_device
+                       else CpuShuffleExchangeExec)
+                exchange = cls(RangePartitioning(child.num_partitions,
+                                                 node.orders), child)
+                return node.with_children([exchange])
+            return node
         if not needs_single_children(node):
             return node
         new_children = [single(c) if c.num_partitions > 1 else c

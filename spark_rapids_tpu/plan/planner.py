@@ -21,6 +21,10 @@ from spark_rapids_tpu.plan import logical as lp
 def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
     """Plan + EnsureRequirements (distribution requirements are satisfied by
     inserting single-partition exchanges, Spark's EnsureRequirements role)."""
+    from spark_rapids_tpu import config as cfg
+    if conf.get(cfg.UDF_COMPILER_ENABLED):
+        from spark_rapids_tpu.udf import compile_plan_udfs
+        plan = compile_plan_udfs(plan)
     return ensure_requirements(_plan_node(plan, conf))
 
 

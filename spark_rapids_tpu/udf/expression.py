@@ -1,0 +1,103 @@
+"""Python row-UDF expression (reference analog: GpuScalaUDF.scala — the
+uncompiled wrapper that keeps the query correct on the fallback path).
+
+A ``PythonUDF`` has no device implementation (there is no EXPR rule for it),
+so any exec containing one is tagged NOT_ON_TPU and runs on the CPU engine,
+where ``eval`` applies the function row-at-a-time — exactly the reference's
+behavior for an uncompiled ScalaUDF (the JVM evaluates it row-wise and the
+plan around it falls back). The udf compiler (udf/compiler.py) replaces these
+nodes with real expression trees when it can.
+"""
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import ColV, EvalCtx, Expression
+
+_EPOCH_DATE = datetime.date(1970, 1, 1)
+_EPOCH_TS = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def _to_python(v: ColV, i: int) -> Any:
+    if not bool(v.validity[i]):
+        return None
+    if v.dtype is DType.STRING:
+        n = int(v.lengths[i])
+        return bytes(np.asarray(v.data[i][:n], dtype=np.uint8)).decode(
+            "utf-8", errors="replace")
+    raw = v.data[i]
+    if v.dtype is DType.DATE:
+        return _EPOCH_DATE + datetime.timedelta(days=int(raw))
+    if v.dtype is DType.TIMESTAMP:
+        return _EPOCH_TS + datetime.timedelta(microseconds=int(raw))
+    if v.dtype is DType.BOOLEAN:
+        return bool(raw)
+    if v.dtype.is_floating:
+        return float(raw)
+    return int(raw)
+
+
+@dataclass(frozen=True)
+class PythonUDF(Expression):
+    fn: Callable
+    ret_dtype: DType
+    args: Tuple[Expression, ...]
+
+    def dtype(self) -> DType:
+        return self.ret_dtype
+
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name_hint(self) -> str:
+        return getattr(self.fn, "__name__", "udf")
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        if ctx.xp is not np:
+            raise TypeError("Python UDFs cannot run on device; enable "
+                            "spark.rapids.tpu.sql.udfCompiler.enabled or keep "
+                            "this exec on the CPU engine")
+        cols = [a.eval(ctx) for a in self.args]
+        n = ctx.capacity
+        out = []
+        valid = np.zeros(n, dtype=bool)
+        for i in range(n):
+            res = self.fn(*[_to_python(c, i) for c in cols])
+            valid[i] = res is not None
+            out.append(res)
+        dt = self.ret_dtype
+        if dt is DType.STRING:
+            data = np.zeros((n, ctx.string_max_bytes), dtype=np.uint8)
+            lengths = np.zeros(n, dtype=np.int32)
+            for i, res in enumerate(out):
+                if res is None:
+                    continue
+                raw = str(res).encode("utf-8")[:ctx.string_max_bytes]
+                data[i, :len(raw)] = bytearray(raw)
+                lengths[i] = len(raw)
+            return ColV(dt, data, valid, lengths)
+        phys = np.zeros(n, dtype=_np_dtype(dt))
+        for i, res in enumerate(out):
+            if res is None:
+                continue
+            if dt is DType.DATE and isinstance(res, datetime.date):
+                res = (res - _EPOCH_DATE).days
+            elif dt is DType.TIMESTAMP and isinstance(res, datetime.datetime):
+                if res.tzinfo is None:
+                    res = res.replace(tzinfo=datetime.timezone.utc)
+                res = int(res.timestamp() * 1_000_000)
+            phys[i] = res
+        return ColV(dt, phys, valid)
+
+
+def _np_dtype(dt: DType):
+    return {DType.BOOLEAN: np.bool_, DType.BYTE: np.int8, DType.SHORT: np.int16,
+            DType.INT: np.int32, DType.LONG: np.int64, DType.FLOAT: np.float32,
+            DType.DOUBLE: np.float64, DType.DATE: np.int32,
+            DType.TIMESTAMP: np.int64}[dt]

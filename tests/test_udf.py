@@ -1,0 +1,188 @@
+"""UDF tests: row-wise fallback + bytecode-compiled columnar path
+(udf-compiler OpcodeSuite analog)."""
+import math
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.exprs.core import BoundReference
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.udf import UdfCompileError, compile_udf
+
+COMPILE = {"spark.rapids.tpu.sql.udfCompiler.enabled": "true",
+           "spark.rapids.tpu.sql.incompatibleOps.enabled": "true"}
+
+
+def _cols(*dts):
+    return tuple(BoundReference(i, dt, True) for i, dt in enumerate(dts))
+
+
+def run_both(build, approx=None):
+    """fallback result == compiled result, and the compiled plan is on TPU."""
+    s_fb = TpuSession()
+    fb = build(s_fb).collect()
+    assert "no TPU implementation" in s_fb.last_explain
+    s_c = TpuSession(COMPILE)
+    comp = build(s_c).collect()
+    assert "TpuProjectExec" in s_c.last_plan.tree_string(), s_c.last_explain
+    assert_tables_equal(fb, comp, approx_float=approx)
+    return comp
+
+
+def test_arithmetic_and_branches():
+    t = pa.table({"x": pa.array([1.0, -2.5, 0.5, 4.0]),
+                  "y": pa.array([2.0, 3.0, 1.0, -1.0])})
+
+    @F.udf(returnType="double")
+    def f(x, y):
+        if x > 0:
+            return x + y * 2.0
+        return abs(x - 1) if y > 0 else 0.0
+
+    out = run_both(lambda s: s.create_dataframe(t).select(f("x", "y").alias("r")))
+    assert out.column("r").to_pylist() == [5.0, 3.5, 2.5, 2.0]
+
+
+def test_boolean_ops_and_comparisons():
+    t = pa.table({"a": pa.array([1, 5, 10], type=pa.int64()),
+                  "b": pa.array([2, 2, 2], type=pa.int64())})
+
+    @F.udf(returnType="boolean")
+    def g(a, b):
+        return (a > b and a < 8) or a == 10
+
+    out = run_both(lambda s: s.create_dataframe(t).select(g("a", "b").alias("r")))
+    assert out.column("r").to_pylist() == [False, True, True]
+
+
+def test_math_functions():
+    t = pa.table({"x": pa.array([1.0, 4.0, 9.0])})
+
+    @F.udf(returnType="double")
+    def h(x):
+        return math.sqrt(x) + math.log(x) - math.pow(x, 0.5)
+
+    out = run_both(lambda s: s.create_dataframe(t).select(h("x").alias("r")),
+                   approx=1e-9)
+    assert out.column("r").to_pylist() == pytest.approx(
+        [0.0, math.log(4.0), math.log(9.0)], abs=1e-9)
+
+
+def test_min_max_round():
+    t = pa.table({"x": pa.array([1.4, 2.6]), "y": pa.array([2.0, 1.0])})
+
+    @F.udf(returnType="double")
+    def m(x, y):
+        return min(x, y) + max(x, y) + round(x)
+
+    run_both(lambda s: s.create_dataframe(t).select(m("x", "y").alias("r")))
+
+
+def test_string_methods_and_none_guard():
+    t = pa.table({"s": pa.array(["a", "Bc", None, " d "])})
+
+    @F.udf(returnType="string")
+    def up(s):
+        return s.upper() if s is not None else None
+
+    @F.udf(returnType="boolean")
+    def pref(s):
+        return s.startswith("B") if s is not None else None
+
+    out = run_both(lambda s: s.create_dataframe(t).select(
+        up("s").alias("u"), pref("s").alias("p")))
+    assert out.column("u").to_pylist() == ["A", "BC", None, " D "]
+    assert out.column("p").to_pylist() == [False, True, None, False]
+
+
+def test_in_tuple_and_len():
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int64()),
+                  "s": pa.array(["ab", "c", "defg"])})
+
+    @F.udf(returnType="boolean")
+    def isin(a):
+        return a in (1, 3)
+
+    @F.udf(returnType="int")
+    def slen(s):
+        return len(s) if s is not None else None
+
+    out = run_both(lambda s: s.create_dataframe(t).select(
+        isin("a").alias("i"), slen("s").alias("n")))
+    assert out.column("i").to_pylist() == [True, False, True]
+    assert out.column("n").to_pylist() == [2, 1, 4]
+
+
+def test_declared_return_type_cast():
+    t = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+
+    @F.udf(returnType="long")
+    def double_it(a):
+        return a * 2
+
+    out = run_both(lambda s: s.create_dataframe(t).select(
+        double_it("a").alias("r")))
+    assert out.schema.field("r").type == pa.int64()
+
+
+def test_udf_in_filter_and_agg_pipeline():
+    t = pa.table({"a": pa.array([1, 2, 3, 4], type=pa.int64()),
+                  "g": pa.array(["x", "y", "x", "y"])})
+
+    @F.udf(returnType="boolean")
+    def keep(a):
+        return a % 2 == 0
+
+    s = TpuSession(COMPILE)
+    out = (s.create_dataframe(t).filter(keep("a"))
+           .groupBy("g").agg(F.sum("a").alias("sa")).sort("g").collect())
+    # evens are 2 (g=y) and 4 (g=y)
+    assert out.column("g").to_pylist() == ["y"]
+    assert out.column("sa").to_pylist() == [6]
+    assert "TpuFilterExec" in s.last_plan.tree_string()
+
+
+def test_uncompilable_falls_back():
+    t = pa.table({"a": pa.array([3, 4], type=pa.int64())})
+
+    @F.udf(returnType="long")
+    def looped(a):
+        total = 0
+        for i in range(3):
+            total += a
+        return total
+
+    s = TpuSession(COMPILE)
+    out = s.create_dataframe(t).select(looped("a").alias("r")).collect()
+    # loop -> UdfCompileError -> row-wise fallback, still correct
+    assert out.column("r").to_pylist() == [9, 12]
+    assert "no TPU implementation" in s.last_explain
+
+
+def test_compile_errors_direct():
+    def loop_fn(a):
+        total = 0
+        for i in (1, 2):
+            total += a
+        return total
+
+    with pytest.raises(UdfCompileError, match="not supported"):
+        compile_udf(loop_fn, _cols(DType.LONG))
+    with pytest.raises(UdfCompileError, match="closures|defaults"):
+        y = 3
+        compile_udf(lambda a: a + y, _cols(DType.LONG))
+    with pytest.raises(UdfCompileError, match="takes"):
+        compile_udf(lambda a, b: a, _cols(DType.LONG))
+
+
+def test_is_none_compiles():
+    t = pa.table({"x": pa.array([1.0, None, 3.0])})
+
+    @F.udf(returnType="double")
+    def nz(x):
+        return 0.0 if x is None else x
+
+    out = run_both(lambda s: s.create_dataframe(t).select(nz("x").alias("r")))
+    assert out.column("r").to_pylist() == [1.0, 0.0, 3.0]
