@@ -1,0 +1,72 @@
+"""ML integration: export a DataFrame's data as device-resident jax arrays.
+
+Reference analogs: ColumnarRdd.scala:49 (the public `DataFrame -> RDD[Table]`
+zero-copy export XGBoost consumes, docs/ml-integration.md) and
+InternalColumnarRddConverter.scala:455-476, which finds the
+GpuColumnarToRowExec boundary in the executed plan and re-wires it to expose
+the device tables underneath. Here the boundary is DeviceToHostExec: we cut it
+off the executed plan and hand the DeviceBatches (jax arrays already in HBM)
+straight to the caller — no host round-trip between the SQL engine and the ML
+framework sharing the chip.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.tpu_execs import DeviceToHostExec, HostToDeviceExec
+
+
+def _device_plan(df) -> PhysicalExec:
+    """The executed plan with the trailing device->host transition removed
+    (InternalColumnarRddConverter's boundary cut). Plans that fell back to the
+    CPU engine get a device upload appended instead, mirroring the reference's
+    row-to-columnar fallback conversion."""
+    final = df._executed_plan()
+    if isinstance(final, DeviceToHostExec):
+        return final.children[0]
+    if not final.is_device:
+        return HostToDeviceExec(final)
+    return final
+
+
+def device_batches(df) -> Iterator[DeviceBatch]:
+    """Iterate the query result as device batches (RDD[Table] analog). The
+    arrays stay in HBM; padding rows beyond ``batch.num_rows`` are garbage and
+    must be masked by the consumer (or use :func:`device_arrays`)."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    plan = _device_plan(df)
+    dm = DeviceManager.initialize(df.session.conf)
+    cleanups: List = []
+    try:
+        with dm.semaphore.held():
+            for p in range(plan.num_partitions):
+                ctx = ExecContext(df.session.conf, partition_id=p,
+                                  num_partitions=plan.num_partitions,
+                                  device_manager=dm, cleanups=cleanups)
+                yield from plan.execute(ctx)
+    finally:
+        for fn in cleanups:
+            fn()
+
+
+def device_arrays(df) -> Dict[str, Tuple]:
+    """Collect the whole result as one dict: column name ->
+    ``(data, validity)`` jax arrays trimmed to the real row count — the
+    hand-to-jax.ml entry point (ColumnarRdd's documented use). String columns
+    yield ``(bytes_matrix, validity, lengths)``."""
+    from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
+    batches = list(device_batches(df))
+    schema = df._plan.schema()
+    smax = df.session.conf.string_max_bytes
+    batch = concat_device_batches(batches, schema, smax)
+    n = batch.num_rows
+    out: Dict[str, Tuple] = {}
+    for f, c in zip(schema, batch.columns):
+        if f.dtype is DType.STRING:
+            out[f.name] = (c.data[:n], c.validity[:n], c.lengths[:n])
+        else:
+            out[f.name] = (c.data[:n], c.validity[:n])
+    return out

@@ -1,0 +1,53 @@
+"""ML integration tests (ColumnarRdd / InternalColumnarRddConverter analog)."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import ml
+from spark_rapids_tpu.api import TpuSession, functions as F
+
+
+def table():
+    return pa.table({"x": pa.array([1.0, 2.0, 3.0, 4.0]),
+                     "y": pa.array([10, None, 30, 40], type=pa.int64()),
+                     "s": pa.array(["a", "bb", None, "d"])})
+
+
+def test_device_batches_cut_boundary():
+    s = TpuSession()
+    df = s.create_dataframe(table()).filter(F.col("x") > 1.5)
+    batches = list(ml.device_batches(df))
+    assert sum(b.num_rows for b in batches) == 3
+    # batches are device-resident (jax arrays, not numpy)
+    import jax
+    assert isinstance(batches[0].columns[0].data, jax.Array)
+
+
+def test_device_arrays_values_and_validity():
+    s = TpuSession()
+    df = s.create_dataframe(table())
+    arrs = ml.device_arrays(df)
+    x_data, x_valid = arrs["x"]
+    assert np.asarray(x_data).tolist() == [1.0, 2.0, 3.0, 4.0]
+    assert np.asarray(x_valid).all()
+    y_data, y_valid = arrs["y"]
+    assert np.asarray(y_valid).tolist() == [True, False, True, True]
+    s_data, s_valid, s_len = arrs["s"]
+    assert np.asarray(s_len).tolist() == [1, 2, 0, 1]
+    assert bytes(np.asarray(s_data)[1][:2]) == b"bb"
+
+
+def test_device_arrays_after_aggregation():
+    s = TpuSession()
+    df = (s.create_dataframe(table())
+          .groupBy().agg(F.sum("x").alias("sx"), F.count().alias("n")))
+    arrs = ml.device_arrays(df)
+    assert np.asarray(arrs["sx"][0]).tolist() == [10.0]
+    assert np.asarray(arrs["n"][0]).tolist() == [4]
+
+
+def test_cpu_fallback_gets_uploaded():
+    """A CPU-only plan still hands back device arrays (upload fallback)."""
+    s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    df = s.create_dataframe(table()).filter(F.col("x") > 1.5)
+    arrs = ml.device_arrays(df)
+    assert np.asarray(arrs["x"][0]).tolist() == [2.0, 3.0, 4.0]
