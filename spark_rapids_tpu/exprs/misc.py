@@ -118,8 +118,10 @@ class Rand(Expression):
         batch_no = getattr(ctx, "batch_ordinal", 0)
         if ctx.is_tracing:
             import jax
+            from spark_rapids_tpu import shims
             key = jax.random.fold_in(
-                jax.random.fold_in(jax.random.key(self.seed), pid), batch_no)
+                jax.random.fold_in(shims.get().prng_key(self.seed), pid),
+                batch_no)
             data = jax.random.uniform(key, (ctx.capacity,), dtype=np.float64)
         else:
             rng = np.random.default_rng((self.seed, pid, batch_no))

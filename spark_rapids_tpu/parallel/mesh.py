@@ -24,7 +24,8 @@ def make_mesh(num_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
     devs = list(devices if devices is not None else jax.devices())
     if num_devices is not None:
         devs = devs[:num_devices]
-    return Mesh(np.array(devs), (axis_name,))
+    from spark_rapids_tpu import shims
+    return shims.get().make_mesh(devs, (axis_name,))
 
 
 def batch_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:

@@ -1,0 +1,49 @@
+"""Tooling tests: shim loader, api-validation parity, config doc generation
+(ShimLoader / ApiValidation / RapidsConf.help analog coverage)."""
+import pathlib
+
+from spark_rapids_tpu import api_validation, config, shims
+
+
+def test_shim_loader_picks_provider():
+    s = shims.get()
+    assert isinstance(s, shims.JaxShims)
+    import jax
+    assert type(s).version_match(jax.__version__)
+
+
+def test_shim_provider_selection_logic():
+    assert shims.Jax05PlusShims.version_match("0.9.0")
+    assert shims.Jax05PlusShims.version_match("0.5.1")
+    assert not shims.Jax05PlusShims.version_match("0.4.30")
+    assert shims.Jax04Shims.version_match("0.4.30")
+    assert not shims.Jax04Shims.version_match("0.5.0")
+
+
+def test_shim_rng_and_mesh_work():
+    import jax
+    s = shims.get()
+    key = s.prng_key(7)
+    v = jax.random.uniform(key, (3,))
+    assert v.shape == (3,)
+    assert s.tree_map(lambda x: x + 1, {"a": 1})["a"] == 2
+    m = s.make_mesh(jax.devices()[:1], ("data",))
+    assert m.axis_names == ("data",)
+
+
+def test_exec_constructor_parity():
+    """ApiValidation.scala analog: every Cpu/Tpu exec pair must agree on
+    constructor parameters (conversion rules copy fields across)."""
+    problems = api_validation.validate()
+    assert not problems, "\n".join(problems)
+    assert len(api_validation.exec_pairs()) >= 15
+
+
+def test_config_docs_current():
+    """docs/configs.md must match the registry (the reference regenerates
+    docs/configs.md from RapidsConf and CI diffs it)."""
+    path = pathlib.Path(__file__).resolve().parent.parent / "docs" / "configs.md"
+    assert path.exists(), "run: python -m spark_rapids_tpu.config docs/configs.md"
+    assert path.read_text() == config.generate_docs(), (
+        "docs/configs.md is stale; regenerate with "
+        "python -m spark_rapids_tpu.config docs/configs.md")
