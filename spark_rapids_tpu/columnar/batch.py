@@ -73,21 +73,56 @@ class DeviceBatch:
         schema = Schema.from_pa(table.schema)
         n = table.num_rows
         cap = bucket_capacity(n, bucketed)
-        cols: List[DeviceColumn] = []
+        # stage every column on host at its EXACT row count, then ship ONE
+        # device_put tree (per-buffer transfers each pay a fixed host-link
+        # round trip). Capacity padding and the validity masks of null-free
+        # columns are built on device — no reason to move zeros over the link.
+        staged = []
         for i, f in enumerate(schema):
             arr = table.column(i).combine_chunks()
             if isinstance(arr, pa.ChunkedArray):
                 arr = (arr.chunk(0) if arr.num_chunks == 1
                        else pa.concat_arrays(arr.chunks))
-            cols.append(_arrow_to_device(f.dtype, arr, cap, string_max_bytes, device))
+            staged.append(_arrow_to_staged(f.dtype, arr, string_max_bytes))
+        up = (jax.device_put(staged, device) if device is not None
+              else jax.device_put(staged))
+        # shared all-valid mask, on the same device as the data
+        alive = jnp.arange(cap, dtype=jnp.int32) < n
+        if device is not None:
+            alive = jax.device_put(alive, device)
+        pad = cap - n
+        cols = []
+        for f, (d, v, l) in zip(schema, up):
+            if pad:
+                d = jnp.concatenate(
+                    [d, jnp.zeros((pad,) + d.shape[1:], d.dtype)], axis=0)
+                if l is not None:
+                    l = jnp.concatenate([l, jnp.zeros(pad, l.dtype)], axis=0)
+            if v is not None:
+                validity = (jnp.concatenate([v, jnp.zeros(pad, jnp.bool_)])
+                            if pad else v)
+            else:
+                validity = alive
+            cols.append(DeviceColumn(f.dtype, d, validity, l))
         return DeviceBatch(schema, tuple(cols), n)
 
     def to_arrow(self) -> pa.Table:
-        """Download to a host arrow table (GpuColumnarToRow analog)."""
+        """Download to a host arrow table (GpuColumnarToRow analog). All
+        column buffers are sliced to the live rows on device and fetched in a
+        single device_get so transfers overlap instead of paying one
+        host-link round trip per buffer."""
         n = self.num_rows
+        sliced = []
+        for col in self.columns:
+            sliced.append((col.data[:n], col.validity[:n],
+                           col.lengths[:n] if col.lengths is not None else None))
+        fetched = jax.device_get(sliced)
         arrays: List[pa.Array] = []
-        for f, col in zip(self.schema, self.columns):
-            arrays.append(_device_to_arrow(f.dtype, col, n))
+        for f, (data, validity, lengths) in zip(self.schema, fetched):
+            arrays.append(_numpy_to_arrow(f.dtype, np.asarray(data),
+                                          np.asarray(validity),
+                                          None if lengths is None
+                                          else np.asarray(lengths), n))
         return pa.Table.from_arrays(arrays, schema=self.schema.to_pa())
 
     # ------------------------------------------------------------------ helpers
@@ -99,15 +134,14 @@ class DeviceBatch:
         return DeviceBatch(schema, cols, 0)
 
 
-def _arrow_to_device(dtype: DType, arr: pa.Array, capacity: int,
-                     string_max_bytes: int, device: Any) -> DeviceColumn:
-    n = len(arr)
-    validity = _arrow_validity(arr)
+def _arrow_to_staged(dtype: DType, arr: pa.Array, string_max_bytes: int):
+    """Arrow column -> exact-size host (data, validity_or_None, lengths).
+    validity is None when the column has no nulls (device builds the mask)."""
+    validity = None if arr.null_count == 0 else _arrow_validity(arr)
     if dtype is DType.STRING:
         sarr = arr.cast(pa.string()) if not pa.types.is_string(arr.type) else arr
         mat, lengths = _strings_to_matrix(sarr, string_max_bytes)
-        return DeviceColumn.from_numpy(dtype, mat, validity, capacity,
-                                       string_max_bytes, lengths, device)
+        return mat, validity, lengths
     if dtype is DType.TIMESTAMP:
         np_data = np.asarray(arr.cast(pa.int64()).fill_null(0))
     elif dtype is DType.DATE:
@@ -116,8 +150,7 @@ def _arrow_to_device(dtype: DType, arr: pa.Array, capacity: int,
         np_data = np.asarray(arr.fill_null(False))
     else:
         np_data = np.asarray(arr.fill_null(0))
-    np_data = np_data.astype(dtype.np_dtype(), copy=False)
-    return DeviceColumn.from_numpy(dtype, np_data, validity, capacity, device=device)
+    return np_data.astype(dtype.np_dtype(), copy=False), validity, None
 
 
 def _arrow_validity(arr: pa.Array) -> np.ndarray:
@@ -157,6 +190,11 @@ def _strings_to_matrix(arr: pa.StringArray, max_bytes: int) -> Tuple[np.ndarray,
 
 def _device_to_arrow(dtype: DType, col: DeviceColumn, num_rows: int) -> pa.Array:
     data, validity, lengths = col.to_numpy(num_rows)
+    return _numpy_to_arrow(dtype, data, validity, lengths, num_rows)
+
+
+def _numpy_to_arrow(dtype: DType, data: np.ndarray, validity: np.ndarray,
+                    lengths: Optional[np.ndarray], num_rows: int) -> pa.Array:
     mask = ~validity  # arrow mask semantics: True = null
     if dtype is DType.STRING:
         sel = np.arange(int(lengths.max()) if num_rows else 0)[None, :] < lengths[:, None]

@@ -66,33 +66,46 @@ class DeviceColumn:
                    lengths: Optional[np.ndarray] = None,
                    device: Any = None) -> "DeviceColumn":
         """Pad host buffers to ``capacity`` and upload. Padding rows are invalid/zero."""
+        staged = DeviceColumn.stage_numpy(dtype, data, validity, capacity,
+                                          max_bytes, lengths)
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else jax.device_put
+        return DeviceColumn(dtype, *[put(a) if a is not None else None
+                                     for a in staged])
+
+    @staticmethod
+    def stage_numpy(dtype: DType, data: np.ndarray,
+                    validity: Optional[np.ndarray], capacity: int,
+                    max_bytes: int = 0, lengths: Optional[np.ndarray] = None):
+        """Capacity-padded host buffers ready for upload — split out so batch
+        builders can stage every column first and ship ONE device_put tree
+        (per-array transfers pay a fixed host-link round trip each)."""
         n = data.shape[0]
         if n > capacity:
             raise ValueError(f"{n} rows > capacity {capacity}")
         if validity is None:
             validity = np.ones(n, dtype=np.bool_)
+        vals = np.zeros(capacity, dtype=np.bool_)
+        vals[:n] = validity
         if dtype is DType.STRING:
             assert lengths is not None
             mat = np.zeros((capacity, max_bytes), dtype=np.uint8)
             mat[:n, :data.shape[1]] = data
             lens = np.zeros(capacity, dtype=np.int32)
             lens[:n] = lengths
-            vals = np.zeros(capacity, dtype=np.bool_)
-            vals[:n] = validity
-            put = (lambda x: jax.device_put(x, device)) if device is not None else jax.device_put
-            return DeviceColumn(dtype, put(mat), put(vals), put(lens))
+            return (mat, vals, lens)
         buf = np.zeros(capacity, dtype=dtype.np_dtype())
         buf[:n] = data
-        vals = np.zeros(capacity, dtype=np.bool_)
-        vals[:n] = validity
-        put = (lambda x: jax.device_put(x, device)) if device is not None else jax.device_put
-        return DeviceColumn(dtype, put(buf), put(vals))
+        return (buf, vals, None)
 
     def to_numpy(self, num_rows: int):
-        """Download the first ``num_rows`` rows. Returns (data, validity, lengths)."""
-        data = np.asarray(self.data)[:num_rows]
-        validity = np.asarray(self.validity)[:num_rows]
-        lengths = (np.asarray(self.lengths)[:num_rows]
+        """Download the first ``num_rows`` rows. The slice happens ON DEVICE so
+        only the live rows cross the host link — downloading a capacity-sized
+        buffer to read 4 result rows is pure waste (and host links can be
+        orders of magnitude slower than HBM)."""
+        data = np.asarray(self.data[:num_rows])
+        validity = np.asarray(self.validity[:num_rows])
+        lengths = (np.asarray(self.lengths[:num_rows])
                    if self.lengths is not None else None)
         return data, validity, lengths
 

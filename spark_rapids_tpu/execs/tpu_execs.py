@@ -48,14 +48,22 @@ _flatten_colvs = flatten_colvs
 
 
 def _to_batch(schema: Schema, flat, num_rows: int) -> DeviceBatch:
+    """Wrap kernel outputs as a batch, shrinking to the row count's capacity
+    bucket when the kernel produced far fewer rows than its input capacity
+    (selective filters, aggregates): downstream programs then compile and run
+    at the small shape, and downloads move only live buckets."""
+    cap = flat[0].shape[0] if flat else 0
+    target = bucket_capacity(num_rows)
+    shrink = target < cap
     cols, i = [], 0
     for f in schema:
-        if f.dtype is DType.STRING:
-            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
-            i += 3
-        else:
-            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1]))
-            i += 2
+        step = 3 if f.dtype is DType.STRING else 2
+        parts = [flat[i + k] for k in range(step)]
+        if shrink:
+            parts = [a[:target] for a in parts]
+        cols.append(DeviceColumn(f.dtype, *parts) if step == 3
+                    else DeviceColumn(f.dtype, parts[0], parts[1]))
+        i += step
     return DeviceBatch(schema, tuple(cols), num_rows)
 
 
@@ -273,7 +281,7 @@ class TpuSortExec(PhysicalExec):
                 keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
                         for o in orders]
                 order = bk.sort_indices(jnp, keys, num_rows)
-                out_cols = [bk.take_colv(jnp, v, order) for v in colvs]
+                out_cols = bk.take_columns(jnp, colvs, order)
                 return tuple(_flatten_colvs(out_cols))
             return fn
 

@@ -22,10 +22,6 @@ from spark_rapids_tpu.exprs.core import ColV, EvalCtx
 from spark_rapids_tpu.ops import batch_kernels as bk
 
 
-def _take(xp, v: ColV, order) -> ColV:
-    return bk.take_colv(xp, v, order)
-
-
 def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunction],
                     num_rows, capacity: int, evaluate: bool = True):
     """Full grouped aggregation over one batch.
@@ -58,9 +54,14 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
         gids = xp.clip(gids, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
         sorted_alive = alive[order]
-        sorted_keys = [_take(xp, k, order) for k in keys]
-        sorted_projs = [[_take(xp, b, order) for b in bufs]
-                        for bufs in projections]
+        flat_projs = [b for bufs in projections for b in bufs]
+        taken = bk.take_columns(xp, list(keys) + flat_projs, order)
+        sorted_keys = taken[:len(keys)]
+        sorted_projs = []
+        i = len(keys)
+        for bufs in projections:
+            sorted_projs.append(taken[i:i + len(bufs)])
+            i += len(bufs)
     else:
         order = xp.arange(capacity, dtype=np.int32)
         gids = xp.zeros(capacity, dtype=np.int32)
@@ -69,24 +70,13 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
         sorted_keys = []
         sorted_projs = projections
 
-    # ---- reduce keys: representative row per group -----------------------------
-    pick, has = bk.segment_pick(xp, xp.ones_like(sorted_alive), gids, capacity,
-                                "first", alive=sorted_alive)
-    key_cols = []
-    for k in sorted_keys:
-        if k.dtype is DType.STRING:
-            key_cols.append(ColV(k.dtype, k.data[pick],
-                                 xp.logical_and(has, k.validity[pick]),
-                                 k.lengths[pick]))
-        else:
-            key_cols.append(ColV(k.dtype, k.data[pick],
-                                 xp.logical_and(has, k.validity[pick])))
+    key_cols, reduced_per_fn = _reduce_phase(
+        xp, sorted_keys, list(zip(agg_fns, sorted_projs)), gids, capacity,
+        sorted_alive)
 
-    # ---- reduce buffers --------------------------------------------------------
     group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
     result_cols = []
-    for fn, bufs in zip(agg_fns, sorted_projs):
-        reduced = _reduce_buffers(xp, fn, bufs, gids, capacity, sorted_alive)
+    for fn, reduced in zip(agg_fns, reduced_per_fn):
         if evaluate:
             out = fn.evaluate(xp, reduced)
             result_cols.append(out.with_validity(
@@ -99,6 +89,44 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
     key_cols = [k.with_validity(xp.logical_and(k.validity, group_alive))
                 for k in key_cols]
     return key_cols, result_cols, num_groups
+
+
+def _reduce_phase(xp, sorted_keys, fn_bufs, gids, capacity: int, sorted_alive):
+    """Representative-key pick + per-fn buffer reduction.
+
+    numpy path: eager per-buffer segment ops. Device path: every segment
+    contribution — the key pick's index min and each buffer's reduction —
+    registers with ONE SegmentStacker, so all reductions of a kind/dtype run
+    as a single stacked scatter."""
+    if xp is np:
+        pick, has = bk.segment_pick(xp, xp.ones_like(sorted_alive), gids,
+                                    capacity, "first", alive=sorted_alive)
+        key_cols = [_gather_key(xp, k, pick, has) for k in sorted_keys]
+        reduced = [_reduce_buffers(xp, fn, bufs, gids, capacity, sorted_alive)
+                   for fn, bufs in fn_bufs]
+        return key_cols, reduced
+
+    stacker = bk.SegmentStacker(xp, gids, capacity)
+    idx = xp.arange(capacity, dtype=np.int64)
+    hpick = stacker.add("min", xp.where(sorted_alive, idx,
+                                        np.int64(capacity + 1)))
+    thunk_lists = [_register_reduce(xp, fn, bufs, gids, capacity,
+                                    sorted_alive, stacker)
+                   for fn, bufs in fn_bufs]
+    stacker.run()
+    key = stacker.get(hpick)
+    has = key < capacity
+    pick = xp.clip(key, 0, capacity - 1)
+    key_cols = [_gather_key(xp, k, pick, has) for k in sorted_keys]
+    reduced = [[t() for t in thunks] for thunks in thunk_lists]
+    return key_cols, reduced
+
+
+def _gather_key(xp, k: ColV, pick, has) -> ColV:
+    valid = xp.logical_and(has, k.validity[pick])
+    if k.dtype is DType.STRING:
+        return ColV(k.dtype, k.data[pick], valid, k.lengths[pick])
+    return ColV(k.dtype, k.data[pick], valid)
 
 
 def _segment_minmax_string(xp, b: ColV, gids, capacity: int, kind: str,
@@ -149,6 +177,85 @@ def _reduce_buffers(xp, fn: AggregateFunction, bufs: Sequence[ColV], gids,
     return reduced
 
 
+def _register_reduce(xp, fn: AggregateFunction, bufs: Sequence[ColV], gids,
+                     capacity: int, sorted_alive, stacker: "bk.SegmentStacker"):
+    """Device-path reduction, phase 1: register every segment contribution
+    with the stacker; returns a thunk producing the reduced ColVs after
+    stacker.run(). One stacked scatter per (kind, dtype) replaces the
+    per-buffer segment calls of _reduce_buffers."""
+    idx = xp.arange(capacity, dtype=np.int64)
+    thunks = []
+    for spec, b in zip(fn.buffer_specs(), bufs):
+        if b.dtype is DType.STRING and spec.kind in ("min", "max"):
+            # rare path; the rank sort dominates it anyway
+            thunks.append(lambda b=b, spec=spec: _segment_minmax_string(
+                xp, b, gids, capacity, spec.kind, sorted_alive))
+        elif spec.kind in ("first", "last"):
+            candidate = (xp.logical_and(sorted_alive, b.validity)
+                         if spec.ignore_nulls else sorted_alive)
+            if spec.kind == "first":
+                h = stacker.add("min", xp.where(candidate, idx,
+                                                np.int64(capacity + 1)))
+            else:
+                h = stacker.add("max", xp.where(candidate, idx, np.int64(-1)))
+
+            def pick_thunk(b=b, h=h):
+                key = stacker.get(h)
+                has = xp.logical_and(key >= 0, key < capacity)
+                p2 = xp.clip(key, 0, capacity - 1)
+                valid = xp.logical_and(has, b.validity[p2])
+                if b.dtype is DType.STRING:
+                    return ColV(b.dtype, b.data[p2], valid, b.lengths[p2])
+                return ColV(b.dtype, b.data[p2], valid)
+            thunks.append(pick_thunk)
+        elif spec.kind == "sum":
+            contrib = xp.where(b.validity, b.data, 0).astype(b.data.dtype)
+            h = stacker.add("sum", contrib)
+            hc = stacker.add("sum", b.validity.astype(np.int32))
+            thunks.append(lambda b=b, h=h, hc=hc: ColV(
+                b.dtype, stacker.get(h), stacker.get(hc) > 0))
+        else:  # numeric min/max
+            thunks.append(_register_minmax(xp, b, spec.kind, stacker))
+    return thunks
+
+
+def _register_minmax(xp, b: ColV, kind: str, stacker: "bk.SegmentStacker"):
+    """Stacked numeric/bool min-max with Spark NaN ordering (mirrors
+    bk._segment_minmax_jax semantics)."""
+    hc = stacker.add("sum", b.validity.astype(np.int32))
+    npdt = np.dtype(b.data.dtype)
+    if npdt == np.bool_:
+        d = b.data.astype(np.int8)
+        neutral = np.int8(1 if kind == "min" else 0)
+        h = stacker.add(kind, xp.where(b.validity, d, neutral))
+        return lambda: ColV(b.dtype, stacker.get(h).astype(np.bool_),
+                            stacker.get(hc) > 0)
+    if np.issubdtype(npdt, np.floating):
+        neutral = np.asarray(np.inf if kind == "min" else -np.inf, dtype=npdt)
+        nan = xp.isnan(b.data)
+        d = xp.where(nan, xp.asarray(np.inf, dtype=npdt), b.data)
+        h = stacker.add(kind, xp.where(b.validity, d, neutral))
+        hn = stacker.add("sum",
+                         xp.logical_and(nan, b.validity).astype(np.int32))
+
+        def thunk():
+            res = stacker.get(h)
+            nan_count = stacker.get(hn)
+            valid_count = stacker.get(hc)
+            if kind == "max":
+                res = xp.where(nan_count > 0,
+                               xp.asarray(np.nan, dtype=npdt), res)
+            else:
+                res = xp.where(xp.logical_and(valid_count > 0,
+                                              nan_count == valid_count),
+                               xp.asarray(np.nan, dtype=npdt), res)
+            return ColV(b.dtype, res, valid_count > 0)
+        return thunk
+    neutral = (np.iinfo(npdt).max if kind == "min" else np.iinfo(npdt).min)
+    h = stacker.add(kind, xp.where(b.validity, b.data, neutral))
+    return lambda: ColV(b.dtype, stacker.get(h), stacker.get(hc) > 0)
+
+
 def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
                     agg_fns: Sequence[AggregateFunction], num_rows, capacity: int):
     """Final mode: merge partially-aggregated buffers (after an exchange or
@@ -171,8 +278,9 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
         gids = xp.clip(xp.cumsum(starts.astype(np.int32)) - 1, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
         sorted_alive = alive[order]
-        sorted_keys = [_take(xp, k, order) for k in key_cols]
-        sorted_bufs = [_take(xp, b, order) for b in buffer_cols]
+        taken = bk.take_columns(xp, list(key_cols) + list(buffer_cols), order)
+        sorted_keys = taken[:len(key_cols)]
+        sorted_bufs = taken[len(key_cols):]
     else:
         gids = xp.zeros(capacity, dtype=np.int32)
         num_groups = xp.asarray(np.int32(1))
@@ -180,26 +288,18 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
         sorted_keys = []
         sorted_bufs = list(buffer_cols)
 
-    pick, has = bk.segment_pick(xp, xp.ones_like(sorted_alive), gids, capacity,
-                                "first", alive=sorted_alive)
-    out_keys = []
-    for k in sorted_keys:
-        if k.dtype is DType.STRING:
-            out_keys.append(ColV(k.dtype, k.data[pick],
-                                 xp.logical_and(has, k.validity[pick]),
-                                 k.lengths[pick]))
-        else:
-            out_keys.append(ColV(k.dtype, k.data[pick],
-                                 xp.logical_and(has, k.validity[pick])))
-
-    group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
-    result_cols = []
+    fn_bufs = []
     i = 0
     for fn in agg_fns:
         specs = fn.buffer_specs()
-        bufs = sorted_bufs[i:i + len(specs)]
+        fn_bufs.append((fn, sorted_bufs[i:i + len(specs)]))
         i += len(specs)
-        reduced = _reduce_buffers(xp, fn, bufs, gids, capacity, sorted_alive)
+    out_keys, reduced_per_fn = _reduce_phase(xp, sorted_keys, fn_bufs, gids,
+                                             capacity, sorted_alive)
+
+    group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
+    result_cols = []
+    for fn, reduced in zip(agg_fns, reduced_per_fn):
         out = fn.evaluate(xp, reduced)
         result_cols.append(out.with_validity(
             xp.logical_and(out.validity, group_alive)))
