@@ -1,0 +1,305 @@
+"""Deterministic dsdgen-alike for the TPC-DS store channel.
+
+Reference analog: TpcdsLikeSpark.scala's table setup (the reference converts
+real dsdgen output; this generator synthesizes the same shapes). Covers
+store_sales plus every dimension the store-channel query subset touches, with
+the structural properties those queries depend on: ticket-level consistency
+(all lines of one ss_ticket_number share customer/store/date/hdemo — the
+count-items-per-ticket queries group on that), ~4% null foreign keys like
+dsdgen emits, a real calendar for date_dim, and cross-product demographics
+dimensions. Doubles stand in for decimals (v0 has no decimal support).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+_EPOCH = datetime.date(1970, 1, 1)
+_D0 = datetime.date(1998, 1, 1)
+_DAYS = (datetime.date(2003, 12, 31) - _D0).days + 1
+#: dsdgen's julian-style first date key
+_SK0 = 2450815
+
+CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Men",
+              "Music", "Shoes", "Sports", "Women", "Children"]
+CLASSES = ["accent", "bedding", "classical", "dresses", "mens watch",
+           "pants", "football", "romance", "fiction", "shirts", "athletic",
+           "computers", "stereo", "portable", "reference"]
+CITIES = ["Midway", "Fairview", "Oakland", "Riverside", "Five Points",
+          "Centerville", "Oak Grove", "Pleasant Hill", "Bethel", "Clinton",
+          "Antioch", "Marion", "Greenville", "Union", "Salem", "Spring Hill",
+          "Shiloh", "Liberty", "Wilson", "Glendale"]
+COUNTIES = ["Williamson County", "Walker County", "Ziebach County",
+            "Daviess County", "Barrow County", "Franklin Parish",
+            "Luce County", "Richland County"]
+STATES = ["TN", "GA", "SD", "IN", "LA", "MI", "SC", "OH", "TX", "CA"]
+FIRST_NAMES = ["James", "Mary", "John", "Linda", "Robert", "Susan", "Ana",
+               "David", "Carlos", "Laura", "Kevin", "Grace", "Amy", "Paul"]
+LAST_NAMES = ["Smith", "Jones", "Brown", "Davis", "Miller", "Moore",
+              "Garcia", "Lopez", "Lee", "Walker", "Hall", "Young"]
+SALUTATIONS = ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir", "Miss"]
+BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
+                 "0-500", "Unknown"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+             "Advanced Degree", "Unknown"]
+MARITAL = ["M", "S", "D", "W", "U"]
+CREDIT = ["Low Risk", "High Risk", "Good", "Unknown"]
+DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+             "Saturday", "Sunday"]
+
+
+def n_item(scale): return max(int(18_000 * scale), 100)
+def n_customer(scale): return max(int(100_000 * scale), 300)
+def n_address(scale): return max(int(50_000 * scale), 120)
+def n_store(scale): return max(int(12 * scale), 6)
+def n_promo(scale): return max(int(300 * scale), 12)
+def n_tickets(scale): return max(int(240_000 * scale), 600)
+
+
+def gen_date_dim() -> pa.Table:
+    days = [_D0 + datetime.timedelta(days=i) for i in range(_DAYS)]
+    week0 = _D0.isocalendar()[1]
+    return pa.table({
+        "d_date_sk": pa.array(np.arange(_SK0, _SK0 + _DAYS, dtype=np.int64)),
+        "d_date": pa.array([(d - _EPOCH).days for d in days], type=pa.date32()),
+        "d_year": pa.array(np.array([d.year for d in days], np.int32)),
+        "d_moy": pa.array(np.array([d.month for d in days], np.int32)),
+        "d_dom": pa.array(np.array([d.day for d in days], np.int32)),
+        "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in days],
+                                   np.int32)),
+        "d_dow": pa.array(np.array([d.weekday() for d in days], np.int32)),
+        "d_day_name": pa.array([DAY_NAMES[d.weekday()] for d in days]),
+        # sequential week/month counters like dsdgen's *_seq surrogates
+        "d_week_seq": pa.array(np.array(
+            [(d - _D0).days // 7 + 1 for d in days], np.int32)),
+        "d_month_seq": pa.array(np.array(
+            [(d.year - _D0.year) * 12 + d.month - 1 + 1189 for d in days],
+            np.int32)),
+    })
+
+
+def gen_time_dim() -> pa.Table:
+    sk = np.arange(1440, dtype=np.int64)  # one row per minute of day
+    return pa.table({
+        "t_time_sk": pa.array(sk),
+        "t_hour": pa.array((sk // 60).astype(np.int32)),
+        "t_minute": pa.array((sk % 60).astype(np.int32)),
+    })
+
+
+def gen_item(scale: float, seed: int) -> pa.Table:
+    n = n_item(scale)
+    rng = np.random.default_rng(seed + 11)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    brand_id = (rng.integers(1, 11, n) * 1000000
+                + rng.integers(1, 11, n) * 1000 + rng.integers(1, 11, n))
+    cat_id = rng.integers(1, len(CATEGORIES) + 1, n).astype(np.int32)
+    return pa.table({
+        "i_item_sk": pa.array(sk),
+        "i_item_id": pa.array(np.char.add("AAAAAAAA",
+                                          np.char.zfill(sk.astype(str), 8))),
+        "i_item_desc": pa.array(np.char.add("item desc ", sk.astype(str))),
+        "i_brand_id": pa.array(brand_id.astype(np.int32)),
+        "i_brand": pa.array(np.char.add("corpbrand #", brand_id.astype(str))),
+        "i_class": pa.array(np.array(CLASSES)[rng.integers(0, len(CLASSES), n)]),
+        "i_category_id": pa.array(cat_id),
+        "i_category": pa.array(np.array(CATEGORIES)[cat_id - 1]),
+        # cycle so the specific ids queries filter on (manufact 128, manager
+        # 1/8/28) exist at any generated item count
+        "i_manufact_id": pa.array(((sk - 1) % 1000 + 1).astype(np.int32)),
+        "i_manufact": pa.array(np.char.add("manufact#",
+                                           rng.integers(1, 1001, n).astype(str))),
+        "i_wholesale_cost": pa.array(np.round(rng.uniform(0.05, 70.0, n), 2)),
+        "i_manager_id": pa.array(((sk - 1) % 100 + 1).astype(np.int32)),
+        "i_current_price": pa.array(np.round(rng.uniform(0.09, 99.99, n), 2)),
+    })
+
+
+def gen_customer(scale: float, seed: int) -> pa.Table:
+    n = n_customer(scale)
+    rng = np.random.default_rng(seed + 12)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    cd_n = 2 * len(MARITAL) * len(EDUCATION) * len(CREDIT)
+    hd_n = len(BUY_POTENTIAL) * 10 * 5
+    return pa.table({
+        "c_customer_sk": pa.array(sk),
+        "c_customer_id": pa.array(np.char.add("AAAAAAAA",
+                                              np.char.zfill(sk.astype(str), 8))),
+        "c_current_addr_sk": pa.array(
+            rng.integers(1, n_address(scale) + 1, n).astype(np.int64)),
+        "c_current_cdemo_sk": pa.array(rng.integers(1, cd_n + 1, n).astype(np.int64)),
+        "c_current_hdemo_sk": pa.array(rng.integers(1, hd_n + 1, n).astype(np.int64)),
+        "c_first_name": pa.array(np.array(FIRST_NAMES)[rng.integers(0, len(FIRST_NAMES), n)]),
+        "c_last_name": pa.array(np.array(LAST_NAMES)[rng.integers(0, len(LAST_NAMES), n)]),
+        "c_salutation": pa.array(np.array(SALUTATIONS)[rng.integers(0, len(SALUTATIONS), n)]),
+        "c_preferred_cust_flag": pa.array(np.where(rng.random(n) < 0.5, "Y", "N")),
+        "c_birth_country": pa.array(np.where(rng.random(n) < 0.8,
+                                             "UNITED STATES", "CANADA")),
+    })
+
+
+def gen_customer_address(scale: float, seed: int) -> pa.Table:
+    n = n_address(scale)
+    rng = np.random.default_rng(seed + 13)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "ca_address_sk": pa.array(sk),
+        "ca_city": pa.array(np.array(CITIES)[rng.integers(0, len(CITIES), n)]),
+        "ca_county": pa.array(np.array(COUNTIES)[rng.integers(0, len(COUNTIES), n)]),
+        "ca_state": pa.array(np.array(STATES)[rng.integers(0, len(STATES), n)]),
+        "ca_zip": pa.array(np.char.zfill(
+            rng.integers(10000, 99999, n).astype(str), 5)),
+        "ca_country": pa.array(np.full(n, "United States")),
+        "ca_gmt_offset": pa.array(rng.integers(-8, -4, n).astype(np.float64)),
+    })
+
+
+def gen_customer_demographics() -> pa.Table:
+    rows = [(g, m, e, c)
+            for g in ("M", "F") for m in MARITAL for e in EDUCATION
+            for c in CREDIT]
+    n = len(rows)
+    return pa.table({
+        "cd_demo_sk": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "cd_gender": pa.array([r[0] for r in rows]),
+        "cd_marital_status": pa.array([r[1] for r in rows]),
+        "cd_education_status": pa.array([r[2] for r in rows]),
+        "cd_credit_rating": pa.array([r[3] for r in rows]),
+        "cd_purchase_estimate": pa.array(
+            np.array([500 + (i % 10) * 500 for i in range(n)], np.int32)),
+        "cd_dep_count": pa.array(np.array([i % 7 for i in range(n)], np.int32)),
+    })
+
+
+def gen_household_demographics() -> pa.Table:
+    rows = [(b, d, v) for b in BUY_POTENTIAL for d in range(10)
+            for v in range(5)]
+    n = len(rows)
+    return pa.table({
+        "hd_demo_sk": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "hd_buy_potential": pa.array([r[0] for r in rows]),
+        "hd_dep_count": pa.array(np.array([r[1] for r in rows], np.int32)),
+        "hd_vehicle_count": pa.array(np.array([r[2] for r in rows], np.int32)),
+    })
+
+
+def gen_store(scale: float, seed: int) -> pa.Table:
+    n = n_store(scale)
+    rng = np.random.default_rng(seed + 14)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "s_store_sk": pa.array(sk),
+        "s_store_id": pa.array(np.char.add("AAAAAAAA",
+                                           np.char.zfill(sk.astype(str), 8))),
+        "s_store_name": pa.array(np.array(
+            ["ought", "able", "pri", "ese", "anti", "cally", "ation", "eing"]
+        )[(sk - 1) % 8]),
+        "s_number_employees": pa.array(rng.integers(200, 301, n).astype(np.int32)),
+        # cycle the value pools so every city/county/offset the queries filter
+        # on exists even with a handful of stores
+        "s_city": pa.array(np.array(CITIES)[(sk - 1) % len(CITIES)]),
+        "s_county": pa.array(np.array(COUNTIES)[(sk - 1) % len(COUNTIES)]),
+        "s_state": pa.array(np.array(STATES)[(sk - 1) % len(STATES)]),
+        "s_company_name": pa.array(np.full(n, "Unknown")),
+        "s_zip": pa.array(np.char.zfill(
+            rng.integers(10000, 99999, n).astype(str), 5)),
+        "s_gmt_offset": pa.array((-5.0 - ((sk - 1) % 4)).astype(np.float64)),
+    })
+
+
+def gen_promotion(scale: float, seed: int) -> pa.Table:
+    n = n_promo(scale)
+    rng = np.random.default_rng(seed + 15)
+    yn = lambda p: np.where(rng.random(n) < p, "Y", "N")  # noqa: E731
+    return pa.table({
+        "p_promo_sk": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "p_channel_dmail": pa.array(yn(0.5)),
+        "p_channel_email": pa.array(yn(0.5)),
+        "p_channel_tv": pa.array(yn(0.5)),
+        "p_channel_event": pa.array(yn(0.5)),
+    })
+
+
+def _null_some(rng, arr: np.ndarray, frac: float) -> pa.Array:
+    mask = rng.random(arr.shape[0]) < frac
+    return pa.array(arr, mask=mask)
+
+
+def gen_store_sales(scale: float, seed: int) -> pa.Table:
+    tickets = n_tickets(scale)
+    rng = np.random.default_rng(seed + 16)
+    # dsdgen tickets run long; counts up to ~24 items keep the
+    # count-between-15-and-20 queries (q34) satisfiable
+    lines_per = rng.integers(1, 25, tickets)
+    n = int(lines_per.sum())
+    tick = np.repeat(np.arange(1, tickets + 1, dtype=np.int64), lines_per)
+    # ticket-level attributes (shared by every line of the ticket)
+    t_cust = rng.integers(1, n_customer(scale) + 1, tickets).astype(np.int64)
+    cd_n = 2 * len(MARITAL) * len(EDUCATION) * len(CREDIT)
+    hd_n = len(BUY_POTENTIAL) * 10 * 5
+    t_cdemo = rng.integers(1, cd_n + 1, tickets).astype(np.int64)
+    t_hdemo = rng.integers(1, hd_n + 1, tickets).astype(np.int64)
+    t_addr = rng.integers(1, n_address(scale) + 1, tickets).astype(np.int64)
+    t_store = rng.integers(1, n_store(scale) + 1, tickets).astype(np.int64)
+    t_date = (rng.integers(0, _DAYS, tickets) + _SK0).astype(np.int64)
+    t_time = rng.integers(0, 1440, tickets).astype(np.int64)
+    rep = lambda a: a[tick - 1]  # noqa: E731
+
+    qty = rng.integers(1, 101, n).astype(np.int32)
+    wholesale = np.round(rng.uniform(1.0, 100.0, n), 2)
+    list_price = np.round(wholesale * rng.uniform(1.0, 2.0, n), 2)
+    disc = np.round(rng.uniform(0.0, 1.0, n), 2)
+    sales_price = np.round(list_price * (1 - disc), 2)
+    ext_sales = np.round(qty * sales_price, 2)
+    ext_wholesale = np.round(qty * wholesale, 2)
+    ext_list = np.round(qty * list_price, 2)
+    ext_discount = np.round(qty * (list_price - sales_price), 2)
+    coupon = np.where(rng.random(n) < 0.1,
+                      np.round(ext_sales * rng.uniform(0, 0.5, n), 2), 0.0)
+    net_paid = np.round(ext_sales - coupon, 2)
+    tax = np.round(net_paid * 0.08, 2)
+    return pa.table({
+        "ss_sold_date_sk": _null_some(rng, rep(t_date), 0.04),
+        "ss_sold_time_sk": _null_some(rng, rep(t_time), 0.04),
+        "ss_item_sk": pa.array(rng.integers(1, n_item(scale) + 1, n).astype(np.int64)),
+        "ss_customer_sk": _null_some(rng, rep(t_cust), 0.04),
+        "ss_cdemo_sk": _null_some(rng, rep(t_cdemo), 0.04),
+        "ss_hdemo_sk": _null_some(rng, rep(t_hdemo), 0.04),
+        "ss_addr_sk": _null_some(rng, rep(t_addr), 0.04),
+        "ss_store_sk": _null_some(rng, rep(t_store), 0.04),
+        "ss_promo_sk": _null_some(rng,
+                                  rng.integers(1, n_promo(scale) + 1,
+                                               n).astype(np.int64), 0.04),
+        "ss_ticket_number": pa.array(tick),
+        "ss_quantity": pa.array(qty),
+        "ss_wholesale_cost": pa.array(wholesale),
+        "ss_list_price": pa.array(list_price),
+        "ss_sales_price": pa.array(sales_price),
+        "ss_ext_discount_amt": pa.array(ext_discount),
+        "ss_ext_sales_price": pa.array(ext_sales),
+        "ss_ext_wholesale_cost": pa.array(ext_wholesale),
+        "ss_ext_list_price": pa.array(ext_list),
+        "ss_ext_tax": pa.array(tax),
+        "ss_coupon_amt": pa.array(coupon),
+        "ss_net_paid": pa.array(net_paid),
+        "ss_net_paid_inc_tax": pa.array(np.round(net_paid + tax, 2)),
+        "ss_net_profit": pa.array(np.round(net_paid - ext_wholesale, 2)),
+    })
+
+
+def gen_all(scale: float = 0.002, seed: int = 0) -> Dict[str, pa.Table]:
+    return {
+        "date_dim": gen_date_dim(),
+        "time_dim": gen_time_dim(),
+        "item": gen_item(scale, seed),
+        "customer": gen_customer(scale, seed),
+        "customer_address": gen_customer_address(scale, seed),
+        "customer_demographics": gen_customer_demographics(),
+        "household_demographics": gen_household_demographics(),
+        "store": gen_store(scale, seed),
+        "promotion": gen_promotion(scale, seed),
+        "store_sales": gen_store_sales(scale, seed),
+    }

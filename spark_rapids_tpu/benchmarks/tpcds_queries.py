@@ -1,0 +1,391 @@
+"""TPC-DS store-channel query subset over the DataFrame API.
+
+Reference analog: TpcdsLikeSpark.scala (the reference ships ~100 "Like"
+queries as raw SQL through Catalyst; this engine has no SQL frontend, so each
+is the standard DataFrame translation of the same query text). The subset is
+every query whose tables are store_sales + dimensions — the interactive
+store-channel slice commonly benchmarked — with the same predicates, groupings
+and orderings as the reference's text (one date-window constant shifted to
+land inside the generator's 1998-2003 calendar, noted inline).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.window import Window
+
+col, lit, when = F.col, F.lit, F.when
+
+
+def q3(t):
+    return (t["date_dim"].filter(col("d_moy") == 11)
+            .join(t["store_sales"], [("d_date_sk", "ss_sold_date_sk")])
+            .join(t["item"].filter(col("i_manufact_id") == 128),
+                  [("ss_item_sk", "i_item_sk")])
+            .groupBy("d_year", "i_brand", "i_brand_id")
+            .agg(F.sum("ss_ext_sales_price").alias("sum_agg"))
+            .select("d_year", col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "sum_agg")
+            .sort("d_year", col("sum_agg").desc(), "brand_id")
+            .limit(100))
+
+
+def q7(t):
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == "M") & (col("cd_marital_status") == "S")
+        & (col("cd_education_status") == "College"))
+    promo = t["promotion"].filter((col("p_channel_email") == "N")
+                                  | (col("p_channel_event") == "N"))
+    return (t["store_sales"]
+            .join(t["date_dim"].filter(col("d_year") == 2000),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["item"], [("ss_item_sk", "i_item_sk")])
+            .join(cd, [("ss_cdemo_sk", "cd_demo_sk")])
+            .join(promo, [("ss_promo_sk", "p_promo_sk")])
+            .groupBy("i_item_id")
+            .agg(F.avg("ss_quantity").alias("agg1"),
+                 F.avg("ss_list_price").alias("agg2"),
+                 F.avg("ss_coupon_amt").alias("agg3"),
+                 F.avg("ss_sales_price").alias("agg4"))
+            .sort("i_item_id").limit(100))
+
+
+def q19(t):
+    return (t["date_dim"].filter((col("d_moy") == 11) & (col("d_year") == 1998))
+            .join(t["store_sales"], [("d_date_sk", "ss_sold_date_sk")])
+            .join(t["item"].filter(col("i_manager_id") == 8),
+                  [("ss_item_sk", "i_item_sk")])
+            .join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .join(t["customer_address"], [("c_current_addr_sk", "ca_address_sk")])
+            .join(t["store"], [("ss_store_sk", "s_store_sk")],
+                  )
+            .filter(F.substring("ca_zip", 1, 5) != F.substring("s_zip", 1, 5))
+            .groupBy("i_brand", "i_brand_id", "i_manufact_id", "i_manufact")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .select(col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "i_manufact_id",
+                    "i_manufact", "ext_price")
+            .sort(col("ext_price").desc(), "brand", "brand_id",
+                  "i_manufact_id", "i_manufact")
+            .limit(100))
+
+
+def _ticket_counts(t, date_filter, hd_filter, store_filter):
+    """Shared inner block of q34/q73: count items per (ticket, customer)."""
+    return (t["store_sales"]
+            .join(t["date_dim"].filter(date_filter),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["store"].filter(store_filter),
+                  [("ss_store_sk", "s_store_sk")])
+            .join(t["household_demographics"].filter(hd_filter),
+                  [("ss_hdemo_sk", "hd_demo_sk")])
+            .groupBy("ss_ticket_number", "ss_customer_sk")
+            .agg(F.count().alias("cnt")))
+
+
+def q34(t):
+    dn = _ticket_counts(
+        t,
+        (((col("d_dom") >= 1) & (col("d_dom") <= 3))
+         | ((col("d_dom") >= 25) & (col("d_dom") <= 28)))
+        & col("d_year").isin(1999, 2000, 2001),
+        (col("hd_buy_potential").isin(">10000", "unknown"))
+        & (col("hd_vehicle_count") > 0)
+        & (when(col("hd_vehicle_count") > 0,
+                col("hd_dep_count") / col("hd_vehicle_count"))
+           .otherwise(None) > 1.2),
+        col("s_county") == "Williamson County")
+    return (dn.filter((col("cnt") >= 15) & (col("cnt") <= 20))
+            .join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .select("c_last_name", "c_first_name", "c_salutation",
+                    "c_preferred_cust_flag", "ss_ticket_number", "cnt")
+            .sort("c_last_name", "c_first_name", "c_salutation",
+                  col("c_preferred_cust_flag").desc(), "ss_ticket_number"))
+
+
+def q42(t):
+    return (t["date_dim"].filter((col("d_moy") == 11) & (col("d_year") == 2000))
+            .join(t["store_sales"], [("d_date_sk", "ss_sold_date_sk")])
+            .join(t["item"].filter(col("i_manager_id") == 1),
+                  [("ss_item_sk", "i_item_sk")])
+            .groupBy("d_year", "i_category_id", "i_category")
+            .agg(F.sum("ss_ext_sales_price").alias("s"))
+            .sort(col("s").desc(), "d_year", "i_category_id", "i_category")
+            .limit(100))
+
+
+def q46(t):
+    dn = (t["store_sales"]
+          .join(t["date_dim"].filter(col("d_dow").isin(5, 6)
+                                     & col("d_year").isin(1999, 2000, 2001)),
+                [("ss_sold_date_sk", "d_date_sk")])
+          .join(t["store"].filter(col("s_city").isin("Fairview", "Midway")),
+                [("ss_store_sk", "s_store_sk")])
+          .join(t["household_demographics"].filter(
+                (col("hd_dep_count") == 4) | (col("hd_vehicle_count") == 3)),
+                [("ss_hdemo_sk", "hd_demo_sk")])
+          .join(t["customer_address"], [("ss_addr_sk", "ca_address_sk")])
+          .groupBy("ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                   col("ca_city").alias("bought_city"))
+          .agg(F.sum("ss_coupon_amt").alias("amt"),
+               F.sum("ss_net_profit").alias("profit")))
+    return (dn.join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .join(t["customer_address"], [("c_current_addr_sk", "ca_address_sk")])
+            .filter(col("ca_city") != col("bought_city"))
+            .select("c_last_name", "c_first_name", "ca_city", "bought_city",
+                    "ss_ticket_number", "amt", "profit")
+            .sort("c_last_name", "c_first_name", "ca_city", "bought_city",
+                  "ss_ticket_number")
+            .limit(100))
+
+
+def q52(t):
+    return (t["date_dim"].filter((col("d_moy") == 11) & (col("d_year") == 2000))
+            .join(t["store_sales"], [("d_date_sk", "ss_sold_date_sk")])
+            .join(t["item"].filter(col("i_manager_id") == 1),
+                  [("ss_item_sk", "i_item_sk")])
+            .groupBy("d_year", "i_brand", "i_brand_id")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .select("d_year", col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "ext_price")
+            .sort("d_year", col("ext_price").desc(), "brand_id")
+            .limit(100))
+
+
+def q55(t):
+    return (t["date_dim"].filter((col("d_moy") == 11) & (col("d_year") == 1999))
+            .join(t["store_sales"], [("d_date_sk", "ss_sold_date_sk")])
+            .join(t["item"].filter(col("i_manager_id") == 28),
+                  [("ss_item_sk", "i_item_sk")])
+            .groupBy("i_brand", "i_brand_id")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .select(col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "ext_price")
+            .sort(col("ext_price").desc(), "brand_id")
+            .limit(100))
+
+
+def _weekly_store_sales(t):
+    day = lambda n: F.sum(when(col("d_day_name") == n,  # noqa: E731
+                               col("ss_sales_price")).otherwise(None))
+    return (t["store_sales"]
+            .join(t["date_dim"], [("ss_sold_date_sk", "d_date_sk")])
+            .groupBy("d_week_seq", "ss_store_sk")
+            .agg(day("Sunday").alias("sun_sales"),
+                 day("Monday").alias("mon_sales"),
+                 day("Tuesday").alias("tue_sales"),
+                 day("Wednesday").alias("wed_sales"),
+                 day("Thursday").alias("thu_sales"),
+                 day("Friday").alias("fri_sales"),
+                 day("Saturday").alias("sat_sales")))
+
+
+def q59(t):
+    wss = _weekly_store_sales(t)
+    weeks = (t["date_dim"].select("d_week_seq", "d_month_seq").distinct())
+
+    def year_slice(lo, hi, suffix):
+        cols = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+        sel = [col("s_store_name").alias(f"s_store_name{suffix}"),
+               col("d_week_seq").alias(f"d_week_seq{suffix}"),
+               col("s_store_id").alias(f"s_store_id{suffix}")]
+        sel += [col(f"{c}_sales").alias(f"{c}_sales{suffix}") for c in cols]
+        return (wss
+                .join(weeks.filter((col("d_month_seq") >= lo)
+                                   & (col("d_month_seq") <= hi)),
+                      [("d_week_seq", "d_week_seq")])
+                .join(t["store"], [("ss_store_sk", "s_store_sk")])
+                .select(*sel))
+
+    y = year_slice(1212, 1223, "1")
+    x = year_slice(1224, 1235, "2")
+    joined = y.join(x, [("s_store_id1", "s_store_id2")]).filter(
+        col("d_week_seq1") == col("d_week_seq2") - 52)
+    ratio = lambda c: (col(f"{c}_sales1") / col(f"{c}_sales2")).alias(f"{c}_r")  # noqa: E731
+    return (joined.select("s_store_name1", "s_store_id1", "d_week_seq1",
+                          *[ratio(c) for c in
+                            ("sun", "mon", "tue", "wed", "thu", "fri", "sat")])
+            .sort("s_store_name1", "s_store_id1", "d_week_seq1")
+            .limit(100))
+
+
+def q65(t):
+    # d_month_seq window shifted into the generator calendar (reference uses
+    # 1176..1187, which predates the 1998 epoch here)
+    base = (t["store_sales"]
+            .join(t["date_dim"].filter((col("d_month_seq") >= 1200)
+                                       & (col("d_month_seq") <= 1211)),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .groupBy("ss_store_sk", "ss_item_sk")
+            .agg(F.sum("ss_sales_price").alias("revenue")))
+    avg_rev = (base.groupBy(col("ss_store_sk").alias("sb_store_sk"))
+               .agg(F.avg("revenue").alias("ave")))
+    return (base.join(avg_rev, [("ss_store_sk", "sb_store_sk")])
+            .filter(col("revenue") <= col("ave") * 0.1)
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .join(t["item"], [("ss_item_sk", "i_item_sk")])
+            .select("s_store_name", "i_item_desc", "revenue",
+                    "i_current_price", "i_wholesale_cost", "i_brand")
+            .sort("s_store_name", "i_item_desc")
+            .limit(100))
+
+
+def q68(t):
+    dn = (t["store_sales"]
+          .join(t["date_dim"].filter(((col("d_dom") >= 1) & (col("d_dom") <= 2))
+                                     & col("d_year").isin(1999, 2000, 2001)),
+                [("ss_sold_date_sk", "d_date_sk")])
+          .join(t["store"].filter(col("s_city").isin("Midway", "Fairview")),
+                [("ss_store_sk", "s_store_sk")])
+          .join(t["household_demographics"].filter(
+                (col("hd_dep_count") == 4) | (col("hd_vehicle_count") == 3)),
+                [("ss_hdemo_sk", "hd_demo_sk")])
+          .join(t["customer_address"], [("ss_addr_sk", "ca_address_sk")])
+          .groupBy("ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                   col("ca_city").alias("bought_city"))
+          .agg(F.sum("ss_ext_sales_price").alias("extended_price"),
+               F.sum("ss_ext_list_price").alias("list_price"),
+               F.sum("ss_ext_tax").alias("extended_tax")))
+    return (dn.join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .join(t["customer_address"], [("c_current_addr_sk", "ca_address_sk")])
+            .filter(col("ca_city") != col("bought_city"))
+            .select("c_last_name", "c_first_name", "ca_city", "bought_city",
+                    "ss_ticket_number", "extended_price", "extended_tax",
+                    "list_price")
+            .sort("c_last_name", "ss_ticket_number")
+            .limit(100))
+
+
+def q73(t):
+    dn = _ticket_counts(
+        t,
+        ((col("d_dom") >= 1) & (col("d_dom") <= 2))
+        & col("d_year").isin(1999, 2000, 2001),
+        (col("hd_buy_potential").isin(">10000", "unknown"))
+        & (col("hd_vehicle_count") > 0)
+        & (when(col("hd_vehicle_count") > 0,
+                col("hd_dep_count") / col("hd_vehicle_count"))
+           .otherwise(None) > 1),
+        col("s_county").isin("Williamson County", "Franklin Parish",
+                             "Bronx County", "Orange County"))
+    return (dn.filter((col("cnt") >= 1) & (col("cnt") <= 5))
+            .join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .select("c_last_name", "c_first_name", "c_salutation",
+                    "c_preferred_cust_flag", "ss_ticket_number", "cnt")
+            .sort(col("cnt").desc(), "c_last_name"))
+
+
+def q79(t):
+    ms = (t["store_sales"]
+          .join(t["date_dim"].filter((col("d_dow") == 1)
+                                     & col("d_year").isin(1999, 2000, 2001)),
+                [("ss_sold_date_sk", "d_date_sk")])
+          .join(t["store"].filter((col("s_number_employees") >= 200)
+                                  & (col("s_number_employees") <= 295)),
+                [("ss_store_sk", "s_store_sk")])
+          .join(t["household_demographics"].filter(
+                (col("hd_dep_count") == 6) | (col("hd_vehicle_count") > 2)),
+                [("ss_hdemo_sk", "hd_demo_sk")])
+          .groupBy("ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "s_city")
+          .agg(F.sum("ss_coupon_amt").alias("amt"),
+               F.sum("ss_net_profit").alias("profit")))
+    return (ms.join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .select("c_last_name", "c_first_name",
+                    F.substring("s_city", 1, 30).alias("city"),
+                    "ss_ticket_number", "amt", "profit")
+            .sort("c_last_name", "c_first_name", "city", col("profit").desc())
+            .limit(100))
+
+
+def q89(t):
+    cls_match = (
+        (col("i_category").isin("Books", "Electronics", "Sports")
+         & col("i_class").isin("computers", "stereo", "football"))
+        | (col("i_category").isin("Men", "Jewelry", "Women")
+           & col("i_class").isin("shirts", "birdal", "dresses")))
+    base = (t["store_sales"]
+            .join(t["item"].filter(cls_match), [("ss_item_sk", "i_item_sk")])
+            .join(t["date_dim"].filter(col("d_year") == 1999),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .groupBy("i_category", "i_class", "i_brand", "s_store_name",
+                     "s_company_name", "d_moy")
+            .agg(F.sum("ss_sales_price").alias("sum_sales")))
+    w = Window.partitionBy("i_category", "i_brand", "s_store_name",
+                           "s_company_name")
+    tmp = base.select("i_category", "i_class", "i_brand", "s_store_name",
+                      "s_company_name", "d_moy", "sum_sales",
+                      F.avg("sum_sales").over(w).alias("avg_monthly_sales"))
+    dev = when(col("avg_monthly_sales") != 0.0,
+               F.abs(col("sum_sales") - col("avg_monthly_sales"))
+               / col("avg_monthly_sales")).otherwise(None)
+    return (tmp.filter(dev > 0.1)
+            .select("i_category", "i_class", "i_brand", "s_store_name",
+                    "s_company_name", "d_moy", "sum_sales",
+                    "avg_monthly_sales",
+                    (col("sum_sales") - col("avg_monthly_sales")).alias("_d"))
+            .sort("_d", "s_store_name")
+            .drop("_d")
+            .limit(100))
+
+
+def q96(t):
+    return (t["store_sales"]
+            .join(t["time_dim"].filter((col("t_hour") == 20)
+                                       & (col("t_minute") >= 30)),
+                  [("ss_sold_time_sk", "t_time_sk")])
+            .join(t["household_demographics"].filter(col("hd_dep_count") == 7),
+                  [("ss_hdemo_sk", "hd_demo_sk")])
+            .join(t["store"].filter(col("s_store_name") == "ese"),
+                  [("ss_store_sk", "s_store_sk")])
+            .agg(F.count().alias("cnt")))
+
+
+def q98(t):
+    lo = datetime.date(1999, 2, 22)
+    hi = lo + datetime.timedelta(days=30)
+    base = (t["store_sales"]
+            .join(t["item"].filter(col("i_category").isin("Sports", "Books",
+                                                          "Home")),
+                  [("ss_item_sk", "i_item_sk")])
+            .join(t["date_dim"].filter((col("d_date") >= lit(lo))
+                                       & (col("d_date") <= lit(hi))),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .groupBy("i_item_id", "i_item_desc", "i_category", "i_class",
+                     "i_current_price")
+            .agg(F.sum("ss_ext_sales_price").alias("itemrevenue")))
+    w = Window.partitionBy("i_class")
+    return (base.select("i_item_desc", "i_category", "i_class",
+                        "i_current_price", "itemrevenue", "i_item_id",
+                        (col("itemrevenue") * 100.0
+                         / F.sum("itemrevenue").over(w)).alias("revenueratio"))
+            .sort("i_category", "i_class", "i_item_id", "i_item_desc",
+                  "revenueratio")
+            .drop("i_item_id"))
+
+
+def q43(t):
+    day = lambda n: F.sum(when(col("d_day_name") == n,  # noqa: E731
+                               col("ss_sales_price")).otherwise(None))
+    return (t["store_sales"]
+            .join(t["date_dim"].filter(col("d_year") == 2000),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["store"].filter(col("s_gmt_offset") == -5.0),
+                  [("ss_store_sk", "s_store_sk")])
+            .groupBy("s_store_name", "s_store_id")
+            .agg(day("Sunday").alias("sun_sales"),
+                 day("Monday").alias("mon_sales"),
+                 day("Tuesday").alias("tue_sales"),
+                 day("Wednesday").alias("wed_sales"),
+                 day("Thursday").alias("thu_sales"),
+                 day("Friday").alias("fri_sales"),
+                 day("Saturday").alias("sat_sales"))
+            .sort("s_store_name", "s_store_id")
+            .limit(100))
+
+
+QUERIES: Dict[str, object] = {
+    name: fn for name, fn in list(globals().items())
+    if name.startswith("q") and name[1:].isdigit() and callable(fn)}

@@ -1,0 +1,36 @@
+"""TPC-DS store-channel queries: TPU engine vs CPU engine (tpcds_test.py /
+TpcdsLikeSpark analog for the store-channel subset)."""
+import pytest
+
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+_SCALE = 0.01
+
+# queries whose sort keys can tie -> unordered compare
+_TIES = {"q3", "q7", "q19", "q34", "q42", "q43", "q46", "q52", "q55", "q59",
+         "q65", "q68", "q73", "q79", "q89", "q98"}
+
+_MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
+             "q46": 1, "q52": 1, "q55": 1, "q59": 10, "q65": 1, "q68": 1,
+             "q79": 10, "q89": 10, "q96": 1, "q98": 10}
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_all(_SCALE, seed=3)
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES, key=lambda n: int(n[1:])))
+def test_tpcds_query_matches_cpu(qname, tables):
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: QUERIES[qname](
+            {k: s.create_dataframe(v) for k, v in tables.items()}),
+        conf=BENCH_CONF,
+        ignore_order=qname in _TIES,
+        approx_float=1e-9)
+    assert cpu.num_rows >= _MIN_ROWS.get(qname, 0), (
+        f"{qname} returned {cpu.num_rows} rows; the generator no longer "
+        f"qualifies rows for its predicates")
