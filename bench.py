@@ -21,12 +21,8 @@ def main() -> None:
     table = gen_lineitem(scale=scale, seed=42)
     n_rows = table.num_rows
 
-    # lineitem's flag/status strings are 1 byte; a narrow device string width
-    # cuts the byte-matrix staging/upload/compute by 16x vs the 256 default
-    # (docs/tuning-guide analog of the reference's batch sizing knobs)
-    conf = {**BENCH_CONF, "spark.rapids.tpu.sql.string.maxBytes": "16"}
-    tpu_sess = TpuSession(conf)
-    cpu_sess = TpuSession({**conf, "spark.rapids.tpu.sql.enabled": "false"})
+    tpu_sess = TpuSession(BENCH_CONF)
+    cpu_sess = TpuSession({**BENCH_CONF, "spark.rapids.tpu.sql.enabled": "false"})
 
     # warmup (compile)
     tpu_result = q1(tpu_sess.create_dataframe(table)).collect()

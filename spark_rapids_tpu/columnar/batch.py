@@ -160,15 +160,27 @@ def _arrow_validity(arr: pa.Array) -> np.ndarray:
     return np.asarray(pc.is_valid(arr))
 
 
+def string_width_bucket(max_len: int, cap: int) -> int:
+    """Per-column device string width: the power-of-two bucket covering the
+    longest value, clamped to the session cap. Narrow columns (flags, codes)
+    then cost a fraction of the cap in staging, transfer, and device compute;
+    binary kernels align mixed widths on the fly (ops/strings.align_widths)."""
+    w = 8
+    while w < max_len:
+        w *= 2
+    return min(w, cap)
+
+
 def _strings_to_matrix(arr: pa.StringArray, max_bytes: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Arrow (offsets, bytes) -> fixed-width byte matrix + lengths.
+    """Arrow (offsets, bytes) -> fixed-width byte matrix + lengths, at the
+    column's adaptive width bucket.
 
     Vectorized: the concatenated UTF-8 payload is row-major in arrow, so a boolean
     ragged mask scatters it into the matrix in one numpy op.
     """
     n = len(arr)
     if n == 0:
-        return np.zeros((0, max_bytes), np.uint8), np.zeros(0, np.int32)
+        return np.zeros((0, string_width_bucket(0, max_bytes)), np.uint8),             np.zeros(0, np.int32)
     arr = arr.fill_null("")
     offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32,
                             count=n + 1, offset=arr.offset * 4)
@@ -177,13 +189,14 @@ def _strings_to_matrix(arr: pa.StringArray, max_bytes: int) -> Tuple[np.ndarray,
         raise ValueError(
             f"string of {lengths.max()} bytes exceeds device string width {max_bytes} "
             f"(spark.rapids.tpu.sql.string.maxBytes)")
+    width = string_width_bucket(int(lengths.max(initial=0)), max_bytes)
     data_buf = arr.buffers()[2]
     payload = (np.frombuffer(data_buf, dtype=np.uint8,
                              count=int(offsets[-1]) - int(offsets[0]),
                              offset=int(offsets[0]))
                if data_buf is not None else np.zeros(0, np.uint8))
-    mat = np.zeros((n, max_bytes), dtype=np.uint8)
-    mask = np.arange(max_bytes, dtype=np.int32)[None, :] < lengths[:, None]
+    mat = np.zeros((n, width), dtype=np.uint8)
+    mask = np.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
     mat[mask] = payload
     return mat, lengths
 

@@ -178,9 +178,16 @@ def _lex_gt_bounds(xp, row_passes: List, bound_passes: List):
 
 def range_partition_ids(xp, orders: Sequence[SortOrder], row_keys: Sequence[ColV],
                         bound_keys: Sequence[ColV], cap: int):
+    from spark_rapids_tpu.ops.strings import align_widths
     row_passes: List = []
     bound_passes: List = []
     for o, rv, bv in zip(orders, row_keys, bound_keys):
+        if rv.lengths is not None:
+            # rows and bounds must share a width or their sort-key chunk
+            # counts diverge and the lexicographic passes misalign
+            rd, bd = align_widths(xp, rv.data, bv.data)
+            rv = ColV(rv.dtype, rd, rv.validity, rv.lengths)
+            bv = ColV(bv.dtype, bd, bv.validity, bv.lengths)
         row_passes.extend(bk._key_passes(xp, rv, o.ascending, o.nulls_first))
         bound_passes.extend(bk._key_passes(xp, bv, o.ascending, o.nulls_first))
     return _lex_gt_bounds(xp, row_passes, bound_passes)
@@ -241,7 +248,13 @@ def _sample_bounds(orders: Sequence[SortOrder], sampled: List[List[ColV]],
     merged: List[ColV] = []
     for ki in range(len(orders)):
         parts = [batch_keys[ki] for batch_keys in sampled]
-        data = np.concatenate([np.asarray(p.data) for p in parts])
+        datas = [np.asarray(p.data) for p in parts]
+        if parts[0].lengths is not None:
+            # per-batch adaptive widths: pad samples to the common bucket
+            from spark_rapids_tpu.ops.strings import pad_width
+            W = max(d.shape[-1] for d in datas)
+            datas = [pad_width(np, d, W) for d in datas]
+        data = np.concatenate(datas)
         validity = np.concatenate([np.asarray(p.validity) for p in parts])
         lengths = (np.concatenate([np.asarray(p.lengths) for p in parts])
                    if parts[0].lengths is not None else None)
@@ -463,6 +476,7 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
         from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout,
                                                          batch_string_max,
+                                                         uniform_string_batch,
                                                          layout_to_meta)
         env = _local_shuffle_env(ctx)
         sid = next(_EXCHANGE_IDS)
@@ -494,6 +508,7 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                 continue
             offset = _round_robin_offset(part, map_p, bi)
             for j, sub in self._split_batch(ctx, part, db, offset, n, bounds):
+                sub = uniform_string_batch(sub)
                 layout = DevicePackLayout.for_batch_shape(
                     sub.schema, sub.capacity, batch_string_max(sub))
                 meta = layout_to_meta(layout, sub.num_rows)

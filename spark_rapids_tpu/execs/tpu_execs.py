@@ -96,6 +96,10 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
             valids.append(c.validity[:b.num_rows])
             if c.lengths is not None:
                 lens.append(c.lengths[:b.num_rows])
+        if f.dtype is DType.STRING:
+            from spark_rapids_tpu.ops.strings import pad_width
+            W = max(d.shape[-1] for d in datas)
+            datas = [pad_width(jnp, d, W) for d in datas]
         data = jnp.concatenate(datas, axis=0)
         validity = jnp.concatenate(valids, axis=0)
         pad = cap - total

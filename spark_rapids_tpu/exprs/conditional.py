@@ -16,11 +16,13 @@ def _select(ctx: EvalCtx, cond, t: ColV, f: ColV, dt: DType) -> ColV:
     f = widen(ctx, f, dt)
     if dt is DType.STRING:
         from spark_rapids_tpu.exprs.strings import _as_column
+        from spark_rapids_tpu.ops.strings import align_widths
         if getattr(cond, "ndim", 0) != 0:  # column-shaped condition
             t = _as_column(xp, t, ctx.capacity)
             f = _as_column(xp, f, ctx.capacity)
-        cnd = cond[..., None] if t.data.ndim == 2 else cond
-        data = xp.where(cnd, t.data, f.data)
+        td, fd = align_widths(xp, t.data, f.data)
+        cnd = cond[..., None] if td.ndim == 2 else cond
+        data = xp.where(cnd, td, fd)
         lengths = xp.where(cond, t.lengths, f.lengths)
         valid = xp.where(cond, t.validity, f.validity)
         return ColV(dt, data, valid, lengths)

@@ -63,11 +63,14 @@ class Literal(Expression):
         phys = _to_physical(self.value, dt)
         valid = xp.asarray(phys is not None)
         if dt is DType.STRING:
+            from spark_rapids_tpu.columnar.batch import string_width_bucket
             raw = (phys or "").encode("utf-8")
             if len(raw) > ctx.string_max_bytes:
                 raise ValueError(f"string literal longer than device width "
                                  f"{ctx.string_max_bytes}")
-            buf = np.zeros(ctx.string_max_bytes, dtype=np.uint8)
+            buf = np.zeros(string_width_bucket(len(raw),
+                                               ctx.string_max_bytes),
+                           dtype=np.uint8)
             buf[:len(raw)] = bytearray(raw)
             return ColV(dt, xp.asarray(buf), valid,
                         xp.asarray(np.int32(len(raw))), is_scalar=True)

@@ -80,8 +80,10 @@ class Coalesce(Expression):
                 continue
             take_new = xp.logical_and(xp.logical_not(out.validity), v.validity)
             if dt is DType.STRING:
-                tn = take_new[..., None] if hasattr(take_new, "ndim") and v.data.ndim == 2 else take_new
-                data = xp.where(tn, v.data, out.data)
+                from spark_rapids_tpu.ops.strings import align_widths
+                vd, od = align_widths(xp, v.data, out.data)
+                tn = take_new[..., None] if hasattr(take_new, "ndim") and vd.ndim == 2 else take_new
+                data = xp.where(tn, vd, od)
                 lengths = xp.where(take_new, v.lengths, out.lengths)
                 out = ColV(dt, data, xp.logical_or(out.validity, v.validity), lengths)
             else:

@@ -42,6 +42,11 @@ def _any_null(xp, keys: Sequence[ColV]):
 
 
 def _concat_colv(xp, a: ColV, b: ColV) -> ColV:
+    if a.lengths is not None:
+        from spark_rapids_tpu.ops.strings import align_widths
+        ad, bd = align_widths(xp, a.data, b.data)
+        a = ColV(a.dtype, ad, a.validity, a.lengths)
+        b = ColV(b.dtype, bd, b.validity, b.lengths)
     data = xp.concatenate([a.data, b.data], axis=0)
     validity = xp.concatenate([a.validity, b.validity], axis=0)
     lengths = (xp.concatenate([a.lengths, b.lengths], axis=0)

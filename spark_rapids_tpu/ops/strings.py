@@ -22,8 +22,25 @@ def _bcast_rows(xp, data, lengths, like_data):
     return data, lengths
 
 
+def pad_width(xp, data, W: int):
+    """Zero-pad a byte matrix (or scalar byte vector) to width W."""
+    cur = data.shape[-1]
+    if cur >= W:
+        return data
+    pad_shape = data.shape[:-1] + (W - cur,)
+    return xp.concatenate([data, xp.zeros(pad_shape, dtype=np.uint8)], axis=-1)
+
+
+def align_widths(xp, ld, rd):
+    """Pad the narrower of two string payloads so binary kernels can mix
+    per-column adaptive widths (padding bytes are zero by invariant)."""
+    W = max(ld.shape[-1], rd.shape[-1])
+    return pad_width(xp, ld, W), pad_width(xp, rd, W)
+
+
 def string_eq(xp, ld, ll, rd, rl):
     """Equality: lengths equal and all payload bytes equal (padding is zeroed)."""
+    ld, rd = align_widths(xp, ld, rd)
     ld, ll = _bcast_rows(xp, ld, ll, rd)
     rd, rl = _bcast_rows(xp, rd, rl, ld)
     axis = -1
@@ -32,6 +49,7 @@ def string_eq(xp, ld, ll, rd, rl):
 
 def string_lt(xp, ld, ll, rd, rl):
     """Byte-lexicographic less-than, ties broken by length."""
+    ld, rd = align_widths(xp, ld, rd)
     ld, ll = _bcast_rows(xp, ld, ll, rd)
     rd, rl = _bcast_rows(xp, rd, rl, ld)
     diff = ld != rd
@@ -78,6 +96,10 @@ def lower_ascii(xp, data):
 
 def starts_with(xp, data, lengths, prefix: bytes, W: int):
     """Row starts with the constant prefix."""
+    if len(prefix) > W:
+        # a needle longer than the column's width bucket can't match any row
+        n = data.shape[0] if data.ndim == 2 else 1
+        return xp.zeros((n,) if data.ndim == 2 else (), dtype=bool)
     p = np.zeros(W, dtype=np.uint8)
     p[:len(prefix)] = bytearray(prefix)
     relevant = np.arange(W, dtype=np.int32) < len(prefix)
@@ -172,6 +194,7 @@ def concat2(xp, ld, ll, rd, rl, W: int):
     """Concatenate two string columns row-wise, truncating at W bytes."""
     ld, ll = _bcast_rows(xp, ld, ll, rd)
     rd, rl = _bcast_rows(xp, rd, rl, ld)
+    ld, rd = pad_width(xp, ld, W), pad_width(xp, rd, W)
     pos = np.arange(W, dtype=np.int32)[None, :]
     from_right = pos >= ll[:, None]
     ridx = xp.clip(pos - ll[:, None], 0, W - 1)

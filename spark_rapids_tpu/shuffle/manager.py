@@ -32,6 +32,7 @@ from spark_rapids_tpu.shuffle.client import ShuffleClient, ShuffleFetchHandler
 from spark_rapids_tpu.shuffle.server import ShuffleServer
 from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout,
                                                  batch_string_max,
+                                                 uniform_string_batch,
                                                  host_to_device_batch,
                                                  layout_to_meta,
                                                  unpack_host_batch)
@@ -143,6 +144,7 @@ class CachingShuffleWriter:
         for pid, batch in partitions:
             if batch.num_rows == 0:
                 continue
+            batch = uniform_string_batch(batch)
             layout = DevicePackLayout.for_batch_shape(
                 batch.schema, batch.capacity, batch_string_max(batch))
             meta = layout_to_meta(layout, batch.num_rows)

@@ -22,6 +22,7 @@ from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
 from spark_rapids_tpu.shuffle.codec import compress_batch, get_codec
 from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout, TableMeta,
                                                  batch_string_max, device_pack,
+                                                 uniform_string_batch,
                                                  pack_host_batch)
 from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ServerConnection,
@@ -41,7 +42,7 @@ def _pack_spillable(buf: SpillableBuffer) -> bytes:
     tier-independent wire format either way)."""
     if (buf.tier == StorageTier.DEVICE
             and not any(f.dtype is DType.DOUBLE for f in buf.schema)):
-        batch = buf.get_batch()
+        batch = uniform_string_batch(buf.get_batch())
         layout = DevicePackLayout.for_batch_shape(
             batch.schema, batch.capacity, batch_string_max(batch))
         packed = device_pack(batch, layout)

@@ -219,6 +219,28 @@ class DevicePackLayout:
                                 tuple(subs), pos)
 
 
+def uniform_string_batch(batch):
+    """Pad every string column to the batch's max width — DevicePackLayout
+    describes ONE width per batch, so per-column adaptive widths normalize
+    here before packing/shuffling."""
+    widths = [int(c.data.shape[1]) for c in batch.columns
+              if c.dtype is not None and c.lengths is not None]
+    if not widths or len(set(widths)) <= 1:
+        return batch
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.ops.strings import pad_width
+    W = max(widths)
+    cols = []
+    for c in batch.columns:
+        if c.lengths is not None and int(c.data.shape[1]) != W:
+            cols.append(DeviceColumn(c.dtype, pad_width(jnp, c.data, W),
+                                     c.validity, c.lengths))
+        else:
+            cols.append(c)
+    return batch.with_columns(batch.schema, cols)
+
+
 def batch_string_max(batch) -> int:
     """String matrix width of a batch (0 if no string columns). One width per
     batch is a layout invariant: writer meta and server pack must agree."""

@@ -71,3 +71,46 @@ def test_padding_rows_are_invalid():
     for col in batch.columns:
         validity = np.asarray(col.validity)
         assert not validity[batch.num_rows:].any()
+
+
+def test_adaptive_string_widths():
+    """Per-column width buckets: narrow columns stage narrow; mixed widths
+    align inside binary kernels, range partitioning, and shuffle packing."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import (DeviceBatch,
+                                                 string_width_bucket)
+    assert string_width_bucket(0, 256) == 8
+    assert string_width_bucket(3, 256) == 8
+    assert string_width_bucket(9, 256) == 16
+    assert string_width_bucket(300, 64) == 64
+    t = pa.table({"flag": pa.array(["A", "B"]),
+                  "city": pa.array(["Pleasant Hill", "Oak Grove Station"])})
+    db = DeviceBatch.from_arrow(t, 256)
+    assert db.column_by_name("flag").data.shape[-1] == 8
+    assert db.column_by_name("city").data.shape[-1] == 32
+
+
+def test_mixed_width_string_ops():
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+    t = pa.table({"a": pa.array(["x", "yy", "zzz", None]),
+                  "b": pa.array(["a-much-longer-value", "yy", None, "q"])})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            (F.col("a") == F.col("b")).alias("eq"),
+            (F.col("a") < F.col("b")).alias("lt"),
+            F.concat(F.col("a"), F.col("b")).alias("cc"),
+            F.coalesce(F.col("a"), F.col("b")).alias("co"),
+            F.when(F.col("a") == "x", F.col("b")).otherwise(F.col("a"))
+            .alias("sel")))
+
+
+def test_long_prefix_on_narrow_column():
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    s = TpuSession()
+    df = s.create_dataframe(pa.table({"s": pa.array(["ab", "cd"])}))
+    assert df.filter(F.col("s").startswith("longer-than-bucket")).collect().num_rows == 0
+    assert df.filter(F.col("s").like("longer-than-bucket%")).collect().num_rows == 0
