@@ -387,6 +387,56 @@ def _iter_execs(plan: PhysicalExec):
         yield from _iter_execs(c)
 
 
+def _tree_has(e, cls) -> bool:
+    if isinstance(e, cls):
+        return True
+    return any(_tree_has(c, cls) for c in e.children)
+
+
+def _null_safe_zero(dt):
+    """A valid stand-in value of the key's type for coalescing null keys; rows
+    are disambiguated by the paired isnull flag, so the value itself is
+    arbitrary."""
+    import datetime
+    from spark_rapids_tpu.columnar.dtypes import DType
+    if dt is DType.STRING:
+        return ""
+    if dt is DType.BOOLEAN:
+        return False
+    if dt is DType.DATE:
+        return datetime.date(1970, 1, 1)
+    if dt is DType.TIMESTAMP:
+        return datetime.datetime(1970, 1, 1)
+    if dt.is_floating:
+        return 0.0
+    return 0
+
+
+def _null_safe_key_join(left: "DataFrame", right: "DataFrame",
+                        keynames: List[str]) -> "DataFrame":
+    """Inner join on keys where null keys match each other (eqNullSafe): each
+    key joins as the pair (coalesce(k, zero), isnull(k)). The right side's key
+    and helper columns are dropped afterwards."""
+    from spark_rapids_tpu.api import functions as F
+    lschema = left.schema()
+    pairs = []
+    drop_after = []
+    for j, kn in enumerate(keynames):
+        dt = lschema[lschema.index_of(kn)].dtype
+        zero = F.lit(_null_safe_zero(dt))
+        lv, ln = f"__jl{j}_v", f"__jl{j}_n"
+        rv, rn = f"__jr{j}_v", f"__jr{j}_n"
+        rk = f"__jr{j}_k"
+        left = (left.withColumn(lv, F.coalesce(F.col(kn), zero))
+                .withColumn(ln, F.col(kn).isNull()))
+        right = (right.withColumnRenamed(kn, rk)
+                 .withColumn(rv, F.coalesce(F.col(rk), zero))
+                 .withColumn(rn, F.col(rk).isNull()))
+        pairs += [(lv, rv), (ln, rn)]
+        drop_after += [lv, ln, rv, rn, rk]
+    return left.join(right, pairs).drop(*drop_after)
+
+
 class GroupedData:
     def __init__(self, df: DataFrame, grouping, mode: str = "groupby"):
         self._df = df
@@ -394,17 +444,75 @@ class GroupedData:
         self._mode = mode
 
     def agg(self, *cols: Column) -> DataFrame:
+        from spark_rapids_tpu.exprs import DistinctAgg
         aggs = []
         for i, c in enumerate(cols):
             e = c.expr
             if not isinstance(e, Alias):
                 e = Alias(e, e.name_hint)
             aggs.append(e)
+        if any(isinstance(a.c, DistinctAgg) for a in aggs):
+            if self._mode != "groupby":
+                raise NotImplementedError(
+                    "distinct aggregates are not supported with rollup/cube")
+            return self._distinct_agg(aggs)
+        for a in aggs:
+            if _tree_has(a.c, DistinctAgg):
+                raise NotImplementedError(
+                    "distinct aggregate must be a top-level aggregate "
+                    "expression (optionally aliased)")
         if self._mode != "groupby":
             return self._grouping_sets_agg(tuple(aggs))
         return DataFrame(
             lp.Aggregate(self._grouping, tuple(aggs), self._df._plan),
             self._df.session)
+
+    def _distinct_agg(self, aggs) -> DataFrame:
+        """Rewrite an aggregation containing DISTINCT aggregates into
+        dedup-then-aggregate subplans recombined on the grouping keys — the
+        join-based form of Spark's RewriteDistinctAggregates (the reference GPU
+        plugin falls back to CPU for these; here both engines run the rewrite).
+
+        Each distinct agg becomes: select(keys, child) -> dropDuplicates ->
+        groupBy(keys).agg(inner). Group sets are identical across subplans (every
+        subplan sees every input row), so an inner join on the keys recombines
+        them; keys are joined null-safely (coalesce + isnull flag pairs, the
+        standard eqNullSafe lowering) because a group key may be null."""
+        from spark_rapids_tpu.exprs import DistinctAgg
+        df = self._df
+        keys = list(self._grouping)
+        keynames = [k.name_hint for k in keys]
+        out_names = [a.name_hint for a in aggs]
+
+        regular = [a for a in aggs if not isinstance(a.c, DistinctAgg)]
+        for a in regular:
+            if _tree_has(a.c, DistinctAgg):
+                raise NotImplementedError(
+                    "distinct aggregate must be a top-level aggregate "
+                    "expression (optionally aliased)")
+        parts: List[DataFrame] = []
+        if regular:
+            parts.append(GroupedData(df, tuple(keys)).agg(
+                *[Column(a) for a in regular]))
+        for i, a in enumerate(aggs):
+            if not isinstance(a.c, DistinctAgg):
+                continue
+            inner = a.c.inner
+            vname = f"__dv{i}"
+            sel = [Column(Alias(k, kn)) for k, kn in zip(keys, keynames)]
+            sel.append(Column(Alias(inner.child, vname)))
+            dd = df.select(*sel).dropDuplicates()
+            rebuilt = inner.map_children(
+                lambda _e: UnresolvedAttribute(vname))
+            grouping = tuple(UnresolvedAttribute(kn) for kn in keynames)
+            parts.append(GroupedData(dd, grouping).agg(
+                Column(Alias(rebuilt, a.name))))
+
+        result = parts[0]
+        for p in parts[1:]:
+            result = (_null_safe_key_join(result, p, keynames) if keynames
+                      else result.crossJoin(p))
+        return result.select(*(keynames + out_names))
 
     def _grouping_sets_agg(self, aggs) -> DataFrame:
         """rollup/cube via Expand (Spark's Expand + grouping-id plan shape):

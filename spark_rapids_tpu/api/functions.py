@@ -7,16 +7,20 @@ from spark_rapids_tpu.api.column import Column, _expr
 from spark_rapids_tpu.columnar.dtypes import DType
 from spark_rapids_tpu.exprs import (Abs, Acos, Asin, Atan, Atan2,
                                     AtLeastNNonNulls, Average, CaseWhen, Cbrt, Ceil,
-                                    Coalesce, Concat, Cos, Cosh, Count, DateAdd,
+                                    Coalesce, Concat, Corr, Cos, Cosh, Count,
+                                    CovarPop, CovarSamp, DateAdd,
                                     DateDiff, DateSub, DayOfMonth, DayOfWeek,
-                                    DayOfYear, Exp, Expm1, First, Floor, Greatest,
+                                    DayOfYear, DistinctAgg, Exp, Expm1, First,
+                                    Floor, Greatest,
                                     Hour, If, Last, LastDay, Least, Length, Literal,
                                     Log, Log1p, Log2, Log10, Lower, Max, Min, Minute,
                                     Month, MonotonicallyIncreasingID, NaNvl, Pmod,
                                     Pow, Quarter, Rand, Rint, Round, Second, Signum,
-                                    Sin, Sinh, SparkPartitionID, Sqrt, StringTrim,
+                                    Sin, Sinh, SparkPartitionID, Sqrt, StddevPop,
+                                    StddevSamp, StringTrim,
                                     Substring, Sum, Tan, Tanh, ToDegrees, ToRadians,
-                                    UnresolvedAttribute, Upper, Year)
+                                    UnresolvedAttribute, Upper, VariancePop,
+                                    VarianceSamp, Year)
 
 
 def col(name: str) -> Column:
@@ -114,6 +118,54 @@ def first(c: Union[str, Column], ignorenulls: bool = False) -> Column:
 
 def last(c: Union[str, Column], ignorenulls: bool = False) -> Column:
     return Column(Last(_c(c), ignorenulls))
+
+
+def stddev(c: Union[str, Column]) -> Column:
+    return Column(StddevSamp(_c(c)))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c: Union[str, Column]) -> Column:
+    return Column(StddevPop(_c(c)))
+
+
+def variance(c: Union[str, Column]) -> Column:
+    return Column(VarianceSamp(_c(c)))
+
+
+var_samp = variance
+
+
+def var_pop(c: Union[str, Column]) -> Column:
+    return Column(VariancePop(_c(c)))
+
+
+def corr(a: Union[str, Column], b: Union[str, Column]) -> Column:
+    return Column(Corr(_c(a), _c(b)))
+
+
+def covar_samp(a: Union[str, Column], b: Union[str, Column]) -> Column:
+    return Column(CovarSamp(_c(a), _c(b)))
+
+
+def covar_pop(a: Union[str, Column], b: Union[str, Column]) -> Column:
+    return Column(CovarPop(_c(a), _c(b)))
+
+
+def countDistinct(c: Union[str, Column]) -> Column:
+    return Column(DistinctAgg(count(c).expr))
+
+
+count_distinct = countDistinct
+
+
+def sumDistinct(c: Union[str, Column]) -> Column:
+    return Column(DistinctAgg(Sum(_c(c))))
+
+
+sum_distinct = sumDistinct
 
 
 def _c(c: Union[str, Column]):

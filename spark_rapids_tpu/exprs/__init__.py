@@ -29,8 +29,11 @@ from spark_rapids_tpu.exprs.strings import (Concat, Contains, EndsWith, Length, 
 from spark_rapids_tpu.exprs.datetime import (DateAdd, DateDiff, DateSub, DayOfMonth,
                                              DayOfWeek, DayOfYear, Hour, LastDay,
                                              Minute, Month, Quarter, Second, Year)
-from spark_rapids_tpu.exprs.aggregates import (AggregateFunction, Average, Count,
-                                               First, Last, Max, Min, Sum)
+from spark_rapids_tpu.exprs.aggregates import (AggregateFunction, Average, Corr,
+                                               Count, CovarPop, CovarSamp,
+                                               DistinctAgg, First, Last, Max,
+                                               Min, StddevPop, StddevSamp, Sum,
+                                               VariancePop, VarianceSamp)
 from spark_rapids_tpu.exprs.misc import (Alias, KnownFloatingPointNormalized,
                                          MonotonicallyIncreasingID,
                                          NormalizeNaNAndZero, Rand, SortOrder,

@@ -205,6 +205,196 @@ class Last(_FirstLast):
     kind = "last"
 
 
+class _MomentBase(AggregateFunction):
+    """Shared machinery for variance/stddev: buffers (count, sum, sum-of-squares),
+    all sum-mergeable so distributed partial/final merge reuses the sum kernel
+    (Spark computes these with a mutable central-moment buffer; a sum-of-powers
+    decomposition is the order-independent equivalent that XLA segment ops want)."""
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        return [BufferSpec(DType.LONG, "sum"), BufferSpec(DType.DOUBLE, "sum"),
+                BufferSpec(DType.DOUBLE, "sum")]
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        v = self.c.eval(ctx)
+        xp = ctx.xp
+        x = xp.where(v.validity, v.data, 0).astype(np.float64)
+        valid = v.validity
+        if v.is_scalar:
+            x = xp.broadcast_to(x, (ctx.capacity,))
+            valid = xp.broadcast_to(valid, (ctx.capacity,))
+        n = valid.astype(np.int64)
+        ones = xp.ones_like(n, dtype=bool)
+        return [ColV(DType.LONG, n, ones), ColV(DType.DOUBLE, x, valid),
+                ColV(DType.DOUBLE, x * x, valid)]
+
+    def _moments(self, xp, buffers):
+        n = buffers[0].data.astype(np.float64)
+        s, ss = buffers[1].data, buffers[2].data
+        safe_n = xp.where(n == 0, 1.0, n)
+        # max() guards the tiny negative residue of catastrophic cancellation
+        m2 = xp.maximum(ss - s * s / safe_n, 0.0)
+        return n, m2
+
+
+@dataclass(frozen=True)
+class VarianceSamp(_MomentBase):
+    c: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, m2 = self._moments(xp, buffers)
+        data = m2 / xp.where(n < 2, 1.0, n - 1.0)
+        return ColV(DType.DOUBLE, data, n >= 2)
+
+
+@dataclass(frozen=True)
+class VariancePop(_MomentBase):
+    c: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, m2 = self._moments(xp, buffers)
+        data = m2 / xp.where(n == 0, 1.0, n)
+        return ColV(DType.DOUBLE, data, n >= 1)
+
+
+@dataclass(frozen=True)
+class StddevSamp(_MomentBase):
+    c: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, m2 = self._moments(xp, buffers)
+        data = xp.sqrt(m2 / xp.where(n < 2, 1.0, n - 1.0))
+        return ColV(DType.DOUBLE, data, n >= 2)
+
+
+@dataclass(frozen=True)
+class StddevPop(_MomentBase):
+    c: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, m2 = self._moments(xp, buffers)
+        data = xp.sqrt(m2 / xp.where(n == 0, 1.0, n))
+        return ColV(DType.DOUBLE, data, n >= 1)
+
+
+class _BivariateBase(AggregateFunction):
+    """corr/covar buffers: (n, Σx, Σy, Σxy[, Σx², Σy²]); a row participates
+    only when BOTH sides are non-null (Spark's pairwise-deletion semantics)."""
+    with_squares = False
+
+    @property
+    def x(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def y(self) -> Expression:
+        return self.children[1]
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def buffer_specs(self) -> List[BufferSpec]:
+        n = 6 if self.with_squares else 4
+        return ([BufferSpec(DType.LONG, "sum")]
+                + [BufferSpec(DType.DOUBLE, "sum")] * (n - 1))
+
+    def project(self, ctx: EvalCtx) -> List[ColV]:
+        xv = self.x.eval(ctx)
+        yv = self.y.eval(ctx)
+        xp = ctx.xp
+        both = xp.logical_and(xv.validity, yv.validity)
+        x = xp.where(both, xv.data, 0).astype(np.float64)
+        y = xp.where(both, yv.data, 0).astype(np.float64)
+        if xv.is_scalar or yv.is_scalar:
+            x = xp.broadcast_to(x, (ctx.capacity,))
+            y = xp.broadcast_to(y, (ctx.capacity,))
+            both = xp.broadcast_to(both, (ctx.capacity,))
+        n = both.astype(np.int64)
+        ones = xp.ones_like(n, dtype=bool)
+        cols = [ColV(DType.LONG, n, ones), ColV(DType.DOUBLE, x, both),
+                ColV(DType.DOUBLE, y, both), ColV(DType.DOUBLE, x * y, both)]
+        if self.with_squares:
+            cols += [ColV(DType.DOUBLE, x * x, both),
+                     ColV(DType.DOUBLE, y * y, both)]
+        return cols
+
+
+@dataclass(frozen=True)
+class Corr(_BivariateBase):
+    cx: Expression
+    cy: Expression
+    with_squares = True
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n = buffers[0].data.astype(np.float64)
+        sx, sy, sxy, sxx, syy = (b.data for b in buffers[1:])
+        safe_n = xp.where(n == 0, 1.0, n)
+        cov = sxy - sx * sy / safe_n
+        vx = xp.maximum(sxx - sx * sx / safe_n, 0.0)
+        vy = xp.maximum(syy - sy * sy / safe_n, 0.0)
+        denom = xp.sqrt(vx * vy)
+        data = cov / xp.where(denom == 0, 1.0, denom)
+        # Spark: corr is null for n<2 or zero variance (NaN actually) — match
+        # the null-on-degenerate convention used across this engine
+        valid = xp.logical_and(n >= 2, denom > 0)
+        return ColV(DType.DOUBLE, data, valid)
+
+
+class _CovarBase(_BivariateBase):
+    def _cov(self, xp, buffers):
+        n = buffers[0].data.astype(np.float64)
+        sx, sy, sxy = (b.data for b in buffers[1:4])
+        safe_n = xp.where(n == 0, 1.0, n)
+        return n, sxy - sx * sy / safe_n
+
+
+@dataclass(frozen=True)
+class CovarSamp(_CovarBase):
+    cx: Expression
+    cy: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, cov = self._cov(xp, buffers)
+        data = cov / xp.where(n < 2, 1.0, n - 1.0)
+        return ColV(DType.DOUBLE, data, n >= 2)
+
+
+@dataclass(frozen=True)
+class CovarPop(_CovarBase):
+    cx: Expression
+    cy: Expression
+
+    def evaluate(self, xp, buffers: List[ColV]) -> ColV:
+        n, cov = self._cov(xp, buffers)
+        data = cov / xp.where(n == 0, 1.0, n)
+        return ColV(DType.DOUBLE, data, n >= 1)
+
+
+@dataclass(frozen=True)
+class DistinctAgg(AggregateFunction):
+    """Marker wrapping an aggregate over DISTINCT values of its child.
+
+    Never executed directly: GroupedData.agg rewrites any aggregation that
+    contains one into dedup-then-aggregate subplans joined on the grouping keys
+    (the join-based form of Spark's RewriteDistinctAggregates; the reference GPU
+    plugin does not accelerate distinct aggregates at all in v0 — this engine
+    runs them through the same two-phase group-by kernels as everything else)."""
+    inner: AggregateFunction
+
+    def dtype(self) -> DType:
+        return self.inner.dtype()
+
+    def nullable(self) -> bool:
+        return self.inner.nullable()
+
+    @property
+    def name_hint(self) -> str:
+        return f"{self.inner.name_hint}_distinct"
+
+
 def _reduce_neutral(kind: str, dt: DType):
     """Neutral element substituted for null inputs before reduction."""
     npdt = dt.np_dtype()

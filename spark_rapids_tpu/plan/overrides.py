@@ -101,17 +101,21 @@ def _tag_literal_pattern(meta: ExprMeta) -> None:
 
 def _tag_float_agg(meta: ExprMeta) -> None:
     """Float sum/avg results vary with reduction order; gate like the reference's
-    spark.rapids.sql.variableFloatAgg.enabled."""
-    child = meta.expr.children[0] if meta.expr.children else None
-    try:
-        dt = child.dtype() if child is not None else None
-    except TypeError:
+    spark.rapids.sql.variableFloatAgg.enabled. Checks every argument (corr/covar
+    take two)."""
+    if meta.conf.get(cfg.ENABLE_FLOAT_AGG):
         return
-    if dt is not None and dt.is_floating and not meta.conf.get(cfg.ENABLE_FLOAT_AGG):
-        meta.will_not_work(
-            f"{type(meta.expr).__name__} over floating point can produce "
-            f"order-dependent results; enable with "
-            f"spark.rapids.tpu.sql.variableFloatAgg.enabled")
+    for child in meta.expr.children:
+        try:
+            dt = child.dtype()
+        except TypeError:
+            continue
+        if dt.is_floating:
+            meta.will_not_work(
+                f"{type(meta.expr).__name__} over floating point can produce "
+                f"order-dependent results; enable with "
+                f"spark.rapids.tpu.sql.variableFloatAgg.enabled")
+            return
 
 
 def _tag_window_expr(meta: ExprMeta) -> None:
@@ -225,6 +229,14 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(agg.Average, "average", tag=_tag_float_agg),
     ExprRule(agg.Min, "minimum"), ExprRule(agg.Max, "maximum"),
     ExprRule(agg.First, "first value"), ExprRule(agg.Last, "last value"),
+    ExprRule(agg.StddevSamp, "sample standard deviation", tag=_tag_float_agg),
+    ExprRule(agg.StddevPop, "population standard deviation",
+             tag=_tag_float_agg),
+    ExprRule(agg.VarianceSamp, "sample variance", tag=_tag_float_agg),
+    ExprRule(agg.VariancePop, "population variance", tag=_tag_float_agg),
+    ExprRule(agg.Corr, "Pearson correlation", tag=_tag_float_agg),
+    ExprRule(agg.CovarSamp, "sample covariance", tag=_tag_float_agg),
+    ExprRule(agg.CovarPop, "population covariance", tag=_tag_float_agg),
 ]
 
 EXPR_RULES: Dict[Type[Expression], ExprRule] = {r.cls: r for r in _EXPR_RULE_LIST}

@@ -1,0 +1,212 @@
+"""Statistical aggregates (stddev/variance/corr/covar) and DISTINCT-aggregate
+rewrite: CPU-vs-TPU parity plus golden numpy/pandas cross-checks.
+
+Reference analog: the pytest hash_aggregate tests; the reference GPU plugin
+does not accelerate these in v0 (AggregateFunctions.scala covers
+Count/Max/Min/Sum/Average/First/Last only) — this engine runs them on-device
+through the same buffer-spec kernels.
+"""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal, run_with_cpu_and_tpu
+
+col = F.col
+
+
+def _table(seed=7, n=200, nulls=True):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 8, n)
+    x = rng.normal(size=n) * 10
+    y = 3.0 * x + rng.normal(size=n)
+    i = rng.integers(0, 5, n).astype(np.int64)
+    kmask = rng.random(n) < 0.1 if nulls else np.zeros(n, bool)
+    xmask = rng.random(n) < 0.15 if nulls else np.zeros(n, bool)
+    ymask = rng.random(n) < 0.15 if nulls else np.zeros(n, bool)
+    return pa.table({
+        "k": pa.array([None if m else int(v) for v, m in zip(k, kmask)],
+                      type=pa.int32()),
+        "x": pa.array([None if m else float(v) for v, m in zip(x, xmask)]),
+        "y": pa.array([None if m else float(v) for v, m in zip(y, ymask)]),
+        "i": pa.array(i),
+    })
+
+
+def test_stddev_variance_matches_numpy():
+    t = _table(nulls=False)
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.stddev("x").alias("sd"),
+                     F.stddev_pop("x").alias("sdp"),
+                     F.variance("x").alias("v"),
+                     F.var_pop("x").alias("vp"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build, approx_float=1e-9)
+    ks = cpu.column("k").to_pylist()
+    karr = t.column("k").to_numpy()
+    xarr = t.column("x").to_numpy()
+    for row, kv in enumerate(ks):
+        xs = xarr[karr == kv]
+        assert cpu.column("sd")[row].as_py() == pytest.approx(np.std(xs, ddof=1))
+        assert cpu.column("sdp")[row].as_py() == pytest.approx(np.std(xs))
+        assert cpu.column("v")[row].as_py() == pytest.approx(np.var(xs, ddof=1))
+        assert cpu.column("vp")[row].as_py() == pytest.approx(np.var(xs))
+
+
+def test_corr_covar_matches_numpy():
+    t = _table(nulls=False)
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.corr("x", "y").alias("c"),
+                     F.covar_samp("x", "y").alias("cs"),
+                     F.covar_pop("x", "y").alias("cp"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build, approx_float=1e-9)
+    karr = t.column("k").to_numpy()
+    xarr, yarr = t.column("x").to_numpy(), t.column("y").to_numpy()
+    for row, kv in enumerate(cpu.column("k").to_pylist()):
+        xs, ys = xarr[karr == kv], yarr[karr == kv]
+        assert cpu.column("c")[row].as_py() == pytest.approx(
+            np.corrcoef(xs, ys)[0, 1])
+        assert cpu.column("cs")[row].as_py() == pytest.approx(
+            np.cov(xs, ys, ddof=1)[0, 1])
+        assert cpu.column("cp")[row].as_py() == pytest.approx(
+            np.cov(xs, ys, ddof=0)[0, 1])
+
+
+def test_stat_aggs_place_on_tpu():
+    """With variableFloatAgg enabled, the whole aggregation runs on-device."""
+    t = _table(nulls=True)
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.stddev("x").alias("sd"), F.corr("x", "y").alias("c"))
+                .sort("k"))
+
+    assert_tpu_and_cpu_equal(
+        build,
+        conf={"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"},
+        approx_float=1e-9,
+        expect_tpu_execs=["TpuHashAggregateExec"])
+
+
+def test_stat_aggs_with_nulls_cpu_tpu_parity():
+    t = _table(nulls=True)
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.stddev("x").alias("sd"),
+                     F.variance("x").alias("v"),
+                     F.corr("x", "y").alias("c"),
+                     F.covar_pop("x", "y").alias("cp"),
+                     F.count("x").alias("n"))
+                .sort("k"))
+
+    assert_tpu_and_cpu_equal(build, approx_float=1e-9)
+
+
+def test_stat_aggs_degenerate_groups():
+    # groups of size 1 -> stddev_samp/corr null; size 0 valid -> all null
+    t = pa.table({
+        "k": pa.array([0, 1, 1, 2], type=pa.int32()),
+        "x": pa.array([5.0, 1.0, None, None]),
+        "y": pa.array([2.0, 3.0, 4.0, 1.0]),
+    })
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.stddev("x").alias("sd"),
+                     F.stddev_pop("x").alias("sdp"),
+                     F.corr("x", "y").alias("c"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("sd").to_pylist() == [None, None, None]
+    assert cpu.column("sdp").to_pylist() == [0.0, 0.0, None]
+    assert cpu.column("c").to_pylist() == [None, None, None]
+
+
+def test_count_distinct_grouped_and_null_keys():
+    t = _table(nulls=True)
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.countDistinct("i").alias("nd"),
+                     F.sum("x").alias("sx"),
+                     F.count().alias("n"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build, approx_float=1e-9)
+    # golden: pandas nunique with null keys kept as a group
+    import pandas as pd
+    g = t.to_pandas().groupby("k", dropna=False)
+    nd = {None if (isinstance(kv, float) and math.isnan(kv)) else int(kv): v
+          for kv, v in g["i"].nunique().to_dict().items()}
+    for row, kv in enumerate(cpu.column("k").to_pylist()):
+        assert cpu.column("nd")[row].as_py() == nd[kv], f"group {kv}"
+
+
+def test_count_distinct_counts_values_not_rows():
+    t = pa.table({
+        "k": pa.array([1, 1, 1, 2, 2], type=pa.int32()),
+        "v": pa.array([3, 3, None, 4, 5], type=pa.int64()),
+    })
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.countDistinct("v").alias("nd"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    # null is not counted (Spark count semantics); duplicates collapse
+    assert cpu.column("nd").to_pylist() == [1, 2]
+
+
+def test_sum_distinct():
+    t = pa.table({
+        "k": pa.array([1, 1, 1, 2], type=pa.int32()),
+        "v": pa.array([3.0, 3.0, 2.0, 4.0]),
+    })
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.sumDistinct("v").alias("sd"),
+                     F.avg("v").alias("m"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("sd").to_pylist() == [5.0, 4.0]
+
+
+def test_global_distinct_agg():
+    t = _table(nulls=True)
+
+    def build(sess):
+        return sess.create_dataframe(t).agg(
+            F.countDistinct("i").alias("nd"), F.count("i").alias("n"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("nd")[0].as_py() == len(set(
+        v for v in t.column("i").to_pylist() if v is not None))
+
+
+def test_distinct_agg_distributed_partitions():
+    """Distinct rewrite composes with multi-partition execution + exchanges."""
+    t = _table(nulls=True, n=500)
+
+    def build(sess):
+        df = sess.create_dataframe(t).repartition(4, "i")
+        return (df.groupBy("k")
+                .agg(F.countDistinct("i").alias("nd"),
+                     F.stddev("x").alias("sd"))
+                .sort("k"))
+
+    assert_tpu_and_cpu_equal(build, approx_float=1e-9)
