@@ -228,6 +228,24 @@ def _null_some(rng, arr: np.ndarray, frac: float) -> pa.Array:
     return pa.array(arr, mask=mask)
 
 
+def _price_lines(rng, n: int):
+    """Per-line pricing derivation shared by the sales fact generators:
+    quantity, wholesale/list/sales prices and the ext_* amounts."""
+    qty = rng.integers(1, 101, n).astype(np.int32)
+    wholesale = np.round(rng.uniform(1.0, 100.0, n), 2)
+    list_price = np.round(wholesale * rng.uniform(1.0, 2.0, n), 2)
+    disc = np.round(rng.uniform(0.0, 1.0, n), 2)
+    sales_price = np.round(list_price * (1 - disc), 2)
+    return {
+        "qty": qty, "wholesale": wholesale, "list_price": list_price,
+        "sales_price": sales_price,
+        "ext_sales": np.round(qty * sales_price, 2),
+        "ext_wholesale": np.round(qty * wholesale, 2),
+        "ext_list": np.round(qty * list_price, 2),
+        "ext_discount": np.round(qty * (list_price - sales_price), 2),
+    }
+
+
 def gen_store_sales(scale: float, seed: int) -> pa.Table:
     tickets = n_tickets(scale)
     rng = np.random.default_rng(seed + 16)
@@ -248,15 +266,11 @@ def gen_store_sales(scale: float, seed: int) -> pa.Table:
     t_time = rng.integers(0, 1440, tickets).astype(np.int64)
     rep = lambda a: a[tick - 1]  # noqa: E731
 
-    qty = rng.integers(1, 101, n).astype(np.int32)
-    wholesale = np.round(rng.uniform(1.0, 100.0, n), 2)
-    list_price = np.round(wholesale * rng.uniform(1.0, 2.0, n), 2)
-    disc = np.round(rng.uniform(0.0, 1.0, n), 2)
-    sales_price = np.round(list_price * (1 - disc), 2)
-    ext_sales = np.round(qty * sales_price, 2)
-    ext_wholesale = np.round(qty * wholesale, 2)
-    ext_list = np.round(qty * list_price, 2)
-    ext_discount = np.round(qty * (list_price - sales_price), 2)
+    p = _price_lines(rng, n)
+    qty, wholesale, list_price, sales_price = (
+        p["qty"], p["wholesale"], p["list_price"], p["sales_price"])
+    ext_sales, ext_wholesale, ext_list, ext_discount = (
+        p["ext_sales"], p["ext_wholesale"], p["ext_list"], p["ext_discount"])
     coupon = np.where(rng.random(n) < 0.1,
                       np.round(ext_sales * rng.uniform(0, 0.5, n), 2), 0.0)
     net_paid = np.round(ext_sales - coupon, 2)

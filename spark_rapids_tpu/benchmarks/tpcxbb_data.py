@@ -1,0 +1,364 @@
+"""Deterministic data generator for the TPCx-BB ("BigBench") table set.
+
+Reference analog: TpcxbbLikeSpark.scala's 19 table schemas
+(integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala:172-767). The reference
+loads vendor-generated CSVs; this module synthesizes the same shapes with the
+structural properties the supported queries depend on:
+
+- store_returns rows are drawn FROM store_sales lines (same ticket/item/
+  customer, returned 1-60 days later) so the return-ratio and
+  returned-then-repurchased queries (q20, q21) have matches;
+- a slice of web_sales is derived from store_returns (same item, returning
+  customer buys on the web afterwards) for q21's re-purchase chain;
+- web_returns rows are drawn from web_sales orders (q16's order/item join);
+- a slice of web_clickstreams replays store_sales purchases as logged-in views
+  1-30 days earlier (q5's per-user click profile, q12's view-then-buy funnel);
+- inventory quantity is zero-inflated Poisson with per-item rates so some items
+  exceed q23's coefficient-of-variation >= 1.3 cutoff;
+- item_marketprices carries several competitor price records per item (q24).
+
+Dimensions shared with TPC-DS (date_dim, time_dim, item, customer, store,
+demographics, promotion, customer_address) reuse the tpcds_data generators,
+extended with the extra columns the TPCx-BB queries touch (i_class_id,
+c_login/c_email_address). Doubles stand in for decimals (v0 scope).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.benchmarks.tpcds_data import (
+    _D0, _DAYS, _EPOCH, _SK0, _null_some, _price_lines, gen_customer,
+    gen_customer_address, gen_customer_demographics, gen_date_dim,
+    gen_household_demographics, gen_item, gen_promotion, gen_store,
+    gen_store_sales, gen_time_dim, n_customer, n_item)
+
+
+def date_sk(d: datetime.date) -> int:
+    """The d_date_sk of a calendar date in the generated date_dim."""
+    return _SK0 + (d - _D0).days
+
+
+def n_warehouse(scale): return max(int(8 * scale), 4)
+def n_web_page(scale): return max(int(120 * scale), 30)
+def n_reviews(scale): return max(int(6_000 * scale), 250)
+def n_web_orders(scale): return max(int(60_000 * scale), 400)
+def n_clicks(scale): return max(int(400_000 * scale), 2_000)
+
+
+def _extend_item(t: pa.Table, seed: int) -> pa.Table:
+    """i_class_id 1..15 (q26 buckets on it; cycled so every id exists) and a
+    guaranteed population in q22's 0.98-1.5 price window (uniform prices over
+    0.09-99.99 would leave ~0 such items at small scales)."""
+    rng = np.random.default_rng(seed + 30)
+    n = t.num_rows
+    class_id = (np.arange(n) % 15 + 1).astype(np.int32)
+    price = t.column("i_current_price").to_numpy(zero_copy_only=False).copy()
+    cheap = np.arange(n) % 25 == 3
+    price[cheap] = np.round(rng.uniform(1.0, 1.45, int(cheap.sum())), 2)
+    idx = t.schema.get_field_index("i_current_price")
+    t = t.set_column(idx, "i_current_price", pa.array(price))
+    return t.append_column("i_class_id", pa.array(class_id))
+
+
+def _extend_customer(t: pa.Table, seed: int) -> pa.Table:
+    """c_login / c_email_address (q6/q13 report them)."""
+    n = t.num_rows
+    sk = np.arange(1, n + 1)
+    login = np.char.add("user", sk.astype(str))
+    email = np.char.add(login, "@example.com")
+    return (t.append_column("c_login", pa.array(login))
+            .append_column("c_email_address", pa.array(email)))
+
+
+def gen_warehouse(scale: float, seed: int) -> pa.Table:
+    n = n_warehouse(scale)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    states = np.array(["TN", "GA", "SD", "IN", "LA", "MI", "SC", "OH"])
+    return pa.table({
+        "w_warehouse_sk": pa.array(sk),
+        "w_warehouse_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "w_warehouse_name": pa.array(np.char.add("Warehouse no ",
+                                                 sk.astype(str))),
+        "w_state": pa.array(states[(sk - 1) % len(states)]),
+    })
+
+
+def gen_web_page(scale: float, seed: int) -> pa.Table:
+    n = n_web_page(scale)
+    rng = np.random.default_rng(seed + 31)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    # ~1/3 of pages land in q14's 5000-6000 char window
+    chars = rng.integers(3000, 9000, n).astype(np.int32)
+    return pa.table({
+        "wp_web_page_sk": pa.array(sk),
+        "wp_web_page_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "wp_char_count": pa.array(chars),
+        "wp_link_count": pa.array(rng.integers(2, 25, n).astype(np.int32)),
+    })
+
+
+_REVIEW_WORDS = np.array(["great", "poor", "solid", "broken", "love", "hate",
+                          "fast", "slow", "works", "failed", "classic",
+                          "value", "cheap", "premium"])
+
+
+def gen_product_reviews(scale: float, seed: int) -> pa.Table:
+    n = n_reviews(scale)
+    rng = np.random.default_rng(seed + 32)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    w = lambda: _REVIEW_WORDS[rng.integers(0, len(_REVIEW_WORDS), n)]  # noqa: E731
+    content = np.char.add(np.char.add(w(), " "), np.char.add(w(), " product"))
+    return pa.table({
+        "pr_review_sk": pa.array(sk),
+        "pr_review_rating": pa.array(rng.integers(1, 6, n).astype(np.int32)),
+        "pr_item_sk": _null_some(
+            rng, rng.integers(1, n_item(scale) + 1, n).astype(np.int64), 0.02),
+        "pr_user_sk": _null_some(
+            rng, rng.integers(1, n_customer(scale) + 1, n).astype(np.int64),
+            0.04),
+        "pr_review_content": pa.array(content),
+    })
+
+
+def gen_store_returns(scale: float, seed: int,
+                      store_sales: pa.Table) -> pa.Table:
+    """~8% of store_sales lines come back 1-60 days later (dsdgen links
+    returns to sales the same way; q20/q21 join on ticket+item+customer)."""
+    rng = np.random.default_rng(seed + 33)
+    n_ss = store_sales.num_rows
+    take = np.flatnonzero(rng.random(n_ss) < 0.08)
+    sold_date = store_sales.column("ss_sold_date_sk").to_numpy(
+        zero_copy_only=False)
+    cust = store_sales.column("ss_customer_sk").to_numpy(zero_copy_only=False)
+    item = store_sales.column("ss_item_sk").to_numpy(zero_copy_only=False)
+    tick = store_sales.column("ss_ticket_number").to_numpy(
+        zero_copy_only=False)
+    qty = store_sales.column("ss_quantity").to_numpy(zero_copy_only=False)
+    net = store_sales.column("ss_net_paid").to_numpy(zero_copy_only=False)
+
+    k = take.shape[0]
+    ret_date = sold_date[take] + rng.integers(1, 61, k)
+    ret_qty = np.minimum(rng.integers(1, 101, k), qty[take]).astype(np.int32)
+    frac = ret_qty / np.maximum(qty[take], 1)
+    amt = np.round(np.nan_to_num(net[take]) * frac, 2)
+    return pa.table({
+        "sr_returned_date_sk": pa.array(
+            np.where(np.isnan(ret_date), 0, ret_date).astype(np.int64),
+            mask=np.isnan(ret_date)),
+        "sr_item_sk": pa.array(item[take].astype(np.int64)),
+        "sr_customer_sk": pa.array(
+            np.where(np.isnan(cust[take]), 0, cust[take]).astype(np.int64),
+            mask=np.isnan(cust[take])),
+        "sr_ticket_number": pa.array(tick[take].astype(np.int64)),
+        "sr_return_quantity": pa.array(ret_qty),
+        "sr_return_amt": pa.array(amt),
+    })
+
+
+def gen_web_sales(scale: float, seed: int,
+                  store_returns: pa.Table) -> pa.Table:
+    """Random web orders plus a replay slice: every 3rd store return's
+    (item, customer) re-buys online 30-400 days after the return (q21's
+    store->return->web chain, q6/q13's store-vs-web customers)."""
+    rng = np.random.default_rng(seed + 34)
+    orders = n_web_orders(scale)
+    lines_per = rng.integers(1, 9, orders)
+    n = int(lines_per.sum())
+    order_no = np.repeat(np.arange(1, orders + 1, dtype=np.int64), lines_per)
+    o_cust = rng.integers(1, n_customer(scale) + 1, orders).astype(np.int64)
+    o_date = (rng.integers(0, _DAYS, orders) + _SK0).astype(np.int64)
+    o_time = rng.integers(0, 1440, orders).astype(np.int64)
+    o_hdemo = rng.integers(1, 6 * 10 * 5 + 1, orders).astype(np.int64)
+    o_page = rng.integers(1, n_web_page(scale) + 1, orders).astype(np.int64)
+    rep = lambda a: a[order_no - 1]  # noqa: E731
+
+    item = rng.integers(1, n_item(scale) + 1, n).astype(np.int64)
+    cust = rep(o_cust)
+    date = rep(o_date)
+    time, hdemo, page = rep(o_time), rep(o_hdemo), rep(o_page)
+
+    # replay slice from store_returns
+    sr_item = store_returns.column("sr_item_sk").to_numpy(zero_copy_only=False)
+    sr_cust = store_returns.column("sr_customer_sk").to_numpy(
+        zero_copy_only=False)
+    sr_date = store_returns.column("sr_returned_date_sk").to_numpy(
+        zero_copy_only=False)
+    sel = np.flatnonzero(~np.isnan(sr_cust) & ~np.isnan(sr_date))[::3]
+    m = sel.shape[0]
+    if m:
+        r_date = np.minimum(sr_date[sel] + rng.integers(30, 401, m),
+                            _SK0 + _DAYS - 1).astype(np.int64)
+        item = np.concatenate([item, sr_item[sel].astype(np.int64)])
+        cust = np.concatenate([cust, sr_cust[sel].astype(np.int64)])
+        date = np.concatenate([date, r_date])
+        order_no = np.concatenate(
+            [order_no, np.arange(orders + 1, orders + m + 1, dtype=np.int64)])
+        time = np.concatenate(
+            [time, rng.integers(0, 1440, m).astype(np.int64)])
+        hdemo = np.concatenate(
+            [hdemo, rng.integers(1, 6 * 10 * 5 + 1, m).astype(np.int64)])
+        page = np.concatenate(
+            [page, rng.integers(1, n_web_page(scale) + 1, m).astype(np.int64)])
+        n += m
+
+    p = _price_lines(rng, n)
+    return pa.table({
+        "ws_sold_date_sk": _null_some(rng, date, 0.04),
+        "ws_sold_time_sk": _null_some(rng, time, 0.04),
+        "ws_item_sk": pa.array(item),
+        "ws_bill_customer_sk": _null_some(rng, cust, 0.04),
+        "ws_ship_hdemo_sk": _null_some(rng, hdemo, 0.04),
+        "ws_web_page_sk": _null_some(rng, page, 0.04),
+        "ws_warehouse_sk": pa.array(
+            rng.integers(1, n_warehouse(scale) + 1, n).astype(np.int64)),
+        "ws_order_number": pa.array(order_no),
+        "ws_quantity": pa.array(p["qty"]),
+        "ws_wholesale_cost": pa.array(p["wholesale"]),
+        "ws_list_price": pa.array(p["list_price"]),
+        "ws_sales_price": pa.array(p["sales_price"]),
+        "ws_ext_discount_amt": pa.array(p["ext_discount"]),
+        "ws_ext_sales_price": pa.array(p["ext_sales"]),
+        "ws_ext_wholesale_cost": pa.array(p["ext_wholesale"]),
+        "ws_ext_list_price": pa.array(p["ext_list"]),
+        "ws_net_paid": pa.array(p["ext_sales"]),
+    })
+
+
+def gen_web_returns(scale: float, seed: int, web_sales: pa.Table) -> pa.Table:
+    """~8% of web_sales lines refunded (q16 left-joins on order+item)."""
+    rng = np.random.default_rng(seed + 35)
+    n_ws = web_sales.num_rows
+    take = np.flatnonzero(rng.random(n_ws) < 0.08)
+    order = web_sales.column("ws_order_number").to_numpy(zero_copy_only=False)
+    item = web_sales.column("ws_item_sk").to_numpy(zero_copy_only=False)
+    net = web_sales.column("ws_net_paid").to_numpy(zero_copy_only=False)
+    k = take.shape[0]
+    cash = np.round(net[take] * rng.uniform(0.1, 1.0, k), 2)
+    return pa.table({
+        "wr_order_number": pa.array(order[take].astype(np.int64)),
+        "wr_item_sk": pa.array(item[take].astype(np.int64)),
+        "wr_refunded_cash": _null_some(rng, cash, 0.05),
+    })
+
+
+def gen_web_clickstreams(scale: float, seed: int,
+                         store_sales: pa.Table) -> pa.Table:
+    """Random browsing plus a replay slice: every 4th store-sales line was
+    viewed logged-in 1-30 days before purchase with no sale recorded (q12's
+    view-then-buy window; q5 profiles clicks per user)."""
+    rng = np.random.default_rng(seed + 36)
+    n = n_clicks(scale)
+    item = rng.integers(1, n_item(scale) + 1, n).astype(np.int64)
+    user = rng.integers(1, n_customer(scale) + 1, n).astype(np.int64)
+    date = (rng.integers(0, _DAYS, n) + _SK0).astype(np.int64)
+    sales = rng.integers(1, 1_000_000, n).astype(np.int64)
+    # ~60% of random clicks are views (no sale), ~25% anonymous
+    view = rng.random(n) < 0.6
+    anon = rng.random(n) < 0.25
+
+    ss_item = store_sales.column("ss_item_sk").to_numpy(zero_copy_only=False)
+    ss_cust = store_sales.column("ss_customer_sk").to_numpy(
+        zero_copy_only=False)
+    ss_date = store_sales.column("ss_sold_date_sk").to_numpy(
+        zero_copy_only=False)
+    ok = np.flatnonzero(~np.isnan(ss_cust) & ~np.isnan(ss_date))[::4]
+    m = ok.shape[0]
+    item = np.concatenate([item, ss_item[ok].astype(np.int64)])
+    user = np.concatenate([user, ss_cust[ok].astype(np.int64)])
+    date = np.concatenate(
+        [date, (ss_date[ok] - rng.integers(1, 31, m)).astype(np.int64)])
+    sales = np.concatenate([sales, np.zeros(m, dtype=np.int64)])
+    view = np.concatenate([view, np.ones(m, dtype=bool)])
+    anon = np.concatenate([anon, np.zeros(m, dtype=bool)])
+    n += m
+
+    return pa.table({
+        "wcs_click_date_sk": pa.array(date),
+        "wcs_click_time_sk": pa.array(
+            rng.integers(0, 1440, n).astype(np.int64)),
+        "wcs_sales_sk": pa.array(sales, mask=view),
+        "wcs_item_sk": _null_some(rng, item, 0.03),
+        "wcs_web_page_sk": pa.array(
+            rng.integers(1, n_web_page(scale) + 1, n).astype(np.int64)),
+        "wcs_user_sk": pa.array(user, mask=anon),
+    })
+
+
+def gen_inventory(scale: float, seed: int) -> pa.Table:
+    """Weekly per-item/warehouse snapshots for 2001 (the year q22/q23 probe).
+    Zero-inflated Poisson with per-item rates: low-rate items clear q23's
+    stddev/mean >= 1.3 bar, high-rate ones don't."""
+    rng = np.random.default_rng(seed + 37)
+    items = min(n_item(scale), 400)  # bound the cross product
+    warehouses = n_warehouse(scale)
+    week_starts = np.arange(date_sk(datetime.date(2001, 1, 1)),
+                            date_sk(datetime.date(2002, 1, 1)), 7,
+                            dtype=np.int64)
+    ii, ww, dd = np.meshgrid(np.arange(1, items + 1, dtype=np.int64),
+                             np.arange(1, warehouses + 1, dtype=np.int64),
+                             week_starts, indexing="ij")
+    n = ii.size
+    lam = np.exp(rng.uniform(np.log(0.3), np.log(60.0), items))
+    qty = rng.poisson(lam[ii.ravel() - 1]).astype(np.int32)
+    return pa.table({
+        "inv_date_sk": pa.array(dd.ravel()),
+        "inv_item_sk": pa.array(ii.ravel()),
+        "inv_warehouse_sk": pa.array(ww.ravel()),
+        "inv_quantity_on_hand": _null_some(rng, qty, 0.02),
+    })
+
+
+def gen_item_marketprices(scale: float, seed: int,
+                          item: pa.Table) -> pa.Table:
+    """~3 competitor price records per item, consecutive date ranges (q24
+    measures quantity sold inside/outside each record's window)."""
+    rng = np.random.default_rng(seed + 38)
+    n_i = item.num_rows
+    price = item.column("i_current_price").to_numpy(zero_copy_only=False)
+    per = rng.integers(2, 5, n_i)
+    n = int(per.sum())
+    isk = np.repeat(np.arange(1, n_i + 1, dtype=np.int64), per)
+    comp_price = np.round(price[isk - 1] * rng.uniform(0.7, 1.3, n), 2)
+    start = (rng.integers(0, _DAYS - 120, n) + _SK0).astype(np.int64)
+    length = rng.integers(30, 121, n).astype(np.int64)
+    return pa.table({
+        "imp_sk": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "imp_item_sk": pa.array(isk),
+        "imp_competitor_price": _null_some(rng, comp_price, 0.05),
+        "imp_start_date": pa.array(start),
+        "imp_end_date": pa.array(start + length),
+    })
+
+
+def gen_all(scale: float = 0.002, seed: int = 0) -> Dict[str, pa.Table]:
+    store_sales = gen_store_sales(scale, seed)
+    store_returns = gen_store_returns(scale, seed, store_sales)
+    web_sales = gen_web_sales(scale, seed, store_returns)
+    item = _extend_item(gen_item(scale, seed), seed)
+    return {
+        "date_dim": gen_date_dim(),
+        "time_dim": gen_time_dim(),
+        "item": item,
+        "customer": _extend_customer(gen_customer(scale, seed), seed),
+        "customer_address": gen_customer_address(scale, seed),
+        "customer_demographics": gen_customer_demographics(),
+        "household_demographics": gen_household_demographics(),
+        "store": gen_store(scale, seed),
+        "promotion": gen_promotion(scale, seed),
+        "warehouse": gen_warehouse(scale, seed),
+        "web_page": gen_web_page(scale, seed),
+        "product_reviews": gen_product_reviews(scale, seed),
+        "store_sales": store_sales,
+        "store_returns": store_returns,
+        "web_sales": web_sales,
+        "web_returns": gen_web_returns(scale, seed, web_sales),
+        "web_clickstreams": gen_web_clickstreams(scale, seed, store_sales),
+        "inventory": gen_inventory(scale, seed),
+        "item_marketprices": gen_item_marketprices(scale, seed, item),
+    }

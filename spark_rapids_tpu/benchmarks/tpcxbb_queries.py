@@ -1,0 +1,560 @@
+"""TPCx-BB ("BigBench") query suite over the DataFrame API.
+
+Reference analog: TpcxbbLikeSpark.scala Q1Like..Q30Like
+(integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala:785-2069). The reference
+ships the 30 BigBench queries as raw SQL through Catalyst and marks 11 of them
+unsupported (UDTF/UDF/python: q1-q4, q8, q10, q18, q19, q27, q29, q30); this
+module carries the same 19 supported queries as their standard DataFrame
+translations, with the same predicates, groupings and orderings.
+
+Constant adaptations to the generator's 1998-2003 calendar and small-scale
+dimensions are noted inline (the reference's constants assume vendor dsdgen
+output); the query *shapes* are unchanged.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.benchmarks.tpcxbb_data import date_sk
+
+col, lit, when = F.col, F.lit, F.when
+
+
+def q5(t):
+    """Per-user click profile in category vs demographics (logistic-regression
+    input vectors; TpcxbbLikeSpark.scala:809)."""
+    clicks = (t["web_clickstreams"].filter(col("wcs_user_sk").isNotNull())
+              .join(t["item"], [("wcs_item_sk", "i_item_sk")]))
+    in_cat = lambda i: F.sum(  # noqa: E731
+        when(col("i_category_id") == i, 1).otherwise(0)).alias(f"clicks_in_{i}")
+    per_user = (clicks.groupBy("wcs_user_sk")
+                .agg(F.sum(when(col("i_category") == "Books", 1).otherwise(0))
+                     .alias("clicks_in_category"),
+                     *[in_cat(i) for i in range(1, 8)]))
+    return (per_user
+            .join(t["customer"], [("wcs_user_sk", "c_customer_sk")])
+            .join(t["customer_demographics"],
+                  [("c_current_cdemo_sk", "cd_demo_sk")])
+            .select("clicks_in_category",
+                    when(col("cd_education_status").isin(
+                        "Advanced Degree", "College", "4 yr Degree",
+                        "2 yr Degree"), 1).otherwise(0)
+                    .alias("college_education"),
+                    when(col("cd_gender") == "M", 1).otherwise(0).alias("male"),
+                    *[f"clicks_in_{i}" for i in range(1, 8)]))
+
+
+def q6(t):
+    """Customers shifting from store to web purchases
+    (TpcxbbLikeSpark.scala:868)."""
+    dd = t["date_dim"].filter((col("d_year") >= 2001) & (col("d_year") <= 2002))
+    half = lambda p: (((col(f"{p}_ext_list_price")  # noqa: E731
+                        - col(f"{p}_ext_wholesale_cost")
+                        - col(f"{p}_ext_discount_amt"))
+                       + col(f"{p}_ext_sales_price")) / 2)
+    yr = lambda y, v: F.sum(when(col("d_year") == y, v).otherwise(0.0))  # noqa: E731
+    store = (t["store_sales"].join(dd, [("ss_sold_date_sk", "d_date_sk")])
+             .groupBy(col("ss_customer_sk").alias("customer_sk"))
+             .agg(yr(2001, half("ss")).alias("first_year_total"),
+                  yr(2002, half("ss")).alias("second_year_total"))
+             .filter(col("first_year_total") > 0))
+    web = (t["web_sales"].join(dd, [("ws_sold_date_sk", "d_date_sk")])
+           .groupBy(col("ws_bill_customer_sk").alias("customer_sk"))
+           .agg(yr(2001, half("ws")).alias("w_first_year_total"),
+                yr(2002, half("ws")).alias("w_second_year_total"))
+           .filter(col("w_first_year_total") > 0))
+    ratio_w = col("w_second_year_total") / col("w_first_year_total")
+    ratio_s = col("second_year_total") / col("first_year_total")
+    return (store.join(web, [("customer_sk", "customer_sk")])
+            .filter(ratio_w > ratio_s)
+            .join(t["customer"], [("customer_sk", "c_customer_sk")])
+            .select(ratio_w.alias("web_sales_increase_ratio"),
+                    col("customer_sk").alias("c_customer_sk"),
+                    "c_first_name", "c_last_name", "c_preferred_cust_flag",
+                    "c_birth_country", "c_login", "c_email_address")
+            .sort(col("web_sales_increase_ratio").desc(), "c_customer_sk",
+                  "c_first_name", "c_last_name", "c_preferred_cust_flag",
+                  "c_birth_country", "c_login")
+            .limit(100))
+
+
+def q7(t):
+    """States with >=10 sales of items priced 20% above category average
+    (TpcxbbLikeSpark.scala:949). Date window shifted to the generator
+    calendar: 2001-07 (reference: 2004-07)."""
+    avg_price = (t["item"].groupBy(col("i_category").alias("cat"))
+                 .agg(F.avg("i_current_price").alias("cat_avg"))
+                 .select("cat", (col("cat_avg") * 1.2).alias("avg_price")))
+    high = (t["item"].join(avg_price, [("i_category", "cat")])
+            .filter(col("i_current_price") > col("avg_price"))
+            .select("i_item_sk"))
+    dates = (t["date_dim"]
+             .filter((col("d_year") == 2001) & (col("d_moy") == 7))
+             .select("d_date_sk"))
+    return (t["store_sales"]
+            .join(high, [("ss_item_sk", "i_item_sk")], "leftsemi")
+            .join(dates, [("ss_sold_date_sk", "d_date_sk")], "leftsemi")
+            .join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+            .join(t["customer_address"].filter(col("ca_state").isNotNull()),
+                  [("c_current_addr_sk", "ca_address_sk")])
+            .groupBy("ca_state").agg(F.count().alias("cnt"))
+            .filter(col("cnt") >= 10)
+            .sort(col("cnt").desc(), "ca_state")
+            .limit(10))
+
+
+def q9(t):
+    """Total quantity over demographic/price and state/profit band unions
+    (TpcxbbLikeSpark.scala:1021). State triplets drawn from the generator's
+    state pool (reference: KY/GA/NM, MT/OR/IN, WI/MO/WV)."""
+    price_ok = (((col("cd_marital_status") == "M")
+                 & (col("cd_education_status") == "4 yr Degree")
+                 & (col("ss_sales_price") >= 100)
+                 & (col("ss_sales_price") <= 150))
+                | ((col("cd_marital_status") == "M")
+                   & (col("cd_education_status") == "4 yr Degree")
+                   & (col("ss_sales_price") >= 50)
+                   & (col("ss_sales_price") <= 200))
+                | ((col("cd_marital_status") == "M")
+                   & (col("cd_education_status") == "4 yr Degree")
+                   & (col("ss_sales_price") >= 150)
+                   & (col("ss_sales_price") <= 200)))
+    geo_ok = (((col("ca_country") == "United States")
+               & col("ca_state").isin("GA", "TN", "SD")
+               & (col("ss_net_profit") >= 0) & (col("ss_net_profit") <= 2000))
+              | ((col("ca_country") == "United States")
+                 & col("ca_state").isin("IN", "LA", "MI")
+                 & (col("ss_net_profit") >= 150)
+                 & (col("ss_net_profit") <= 3000))
+              | ((col("ca_country") == "United States")
+                 & col("ca_state").isin("SC", "OH", "TX")
+                 & (col("ss_net_profit") >= 50)
+                 & (col("ss_net_profit") <= 25000)))
+    return (t["store_sales"]
+            .join(t["date_dim"].filter(col("d_year") == 2001),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["customer_address"], [("ss_addr_sk", "ca_address_sk")])
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .join(t["customer_demographics"], [("ss_cdemo_sk", "cd_demo_sk")])
+            .filter(price_ok & geo_ok)
+            .agg(F.sum("ss_quantity").alias("sum_quantity")))
+
+
+def q11(t):
+    """Correlation of review stats with monthly revenue
+    (TpcxbbLikeSpark.scala:1103)."""
+    lo, hi = datetime.date(2003, 1, 2), datetime.date(2003, 2, 2)
+    reviews = (t["product_reviews"].filter(col("pr_item_sk").isNotNull())
+               .groupBy(col("pr_item_sk").alias("pid"))
+               .agg(F.count().alias("reviews_count"),
+                    F.avg("pr_review_rating").alias("avg_rating")))
+    dates = (t["date_dim"]
+             .filter((col("d_date") >= lit(lo)) & (col("d_date") <= lit(hi)))
+             .select("d_date_sk"))
+    revenue = (t["web_sales"].filter(col("ws_item_sk").isNotNull())
+               .join(dates, [("ws_sold_date_sk", "d_date_sk")], "leftsemi")
+               .groupBy("ws_item_sk")
+               .agg(F.sum("ws_net_paid").alias("revenue")))
+    return (reviews.join(revenue, [("pid", "ws_item_sk")])
+            .agg(F.corr("reviews_count", "avg_rating").alias("corr")))
+
+
+def q12(t):
+    """Customers who viewed a category online then bought in-store within 90
+    days (TpcxbbLikeSpark.scala:1161). Click window start shifted into the
+    generator calendar (reference: date_sk 37134)."""
+    w0 = date_sk(datetime.date(2001, 10, 1))
+    views = (t["web_clickstreams"]
+             .filter((col("wcs_click_date_sk") >= w0)
+                     & (col("wcs_click_date_sk") <= w0 + 30)
+                     & col("wcs_user_sk").isNotNull()
+                     & col("wcs_sales_sk").isNull())
+             .join(t["item"].filter(col("i_category").isin("Books",
+                                                           "Electronics")),
+                   [("wcs_item_sk", "i_item_sk")])
+             .select("wcs_user_sk", "wcs_click_date_sk"))
+    buys = (t["store_sales"]
+            .filter((col("ss_sold_date_sk") >= w0)
+                    & (col("ss_sold_date_sk") <= w0 + 90)
+                    & col("ss_customer_sk").isNotNull())
+            .join(t["item"].filter(col("i_category").isin("Books",
+                                                          "Electronics")),
+                  [("ss_item_sk", "i_item_sk")])
+            .select("ss_customer_sk", "ss_sold_date_sk"))
+    return (views.join(buys, [("wcs_user_sk", "ss_customer_sk")])
+            .filter(col("wcs_click_date_sk") < col("ss_sold_date_sk"))
+            .select("wcs_user_sk").distinct()
+            .sort("wcs_user_sk"))
+
+
+def q13(t):
+    """Customers whose web-sales growth beats their store-sales growth
+    (TpcxbbLikeSpark.scala:1203)."""
+    dd = (t["date_dim"].filter(col("d_year").isin(2001, 2002))
+          .select("d_date_sk", "d_year"))
+    yr = lambda y, c: F.sum(when(col("d_year") == y,  # noqa: E731
+                                 col(c)).otherwise(0.0))
+    store = (t["store_sales"].join(dd, [("ss_sold_date_sk", "d_date_sk")])
+             .groupBy(col("ss_customer_sk").alias("customer_sk"))
+             .agg(yr(2001, "ss_net_paid").alias("first_year_total"),
+                  yr(2002, "ss_net_paid").alias("second_year_total"))
+             .filter(col("first_year_total") > 0))
+    web = (t["web_sales"].join(dd, [("ws_sold_date_sk", "d_date_sk")])
+           .groupBy(col("ws_bill_customer_sk").alias("customer_sk"))
+           .agg(yr(2001, "ws_net_paid").alias("w_first_year_total"),
+                yr(2002, "ws_net_paid").alias("w_second_year_total"))
+           .filter(col("w_first_year_total") > 0))
+    ratio_w = col("w_second_year_total") / col("w_first_year_total")
+    ratio_s = col("second_year_total") / col("first_year_total")
+    return (store.join(web, [("customer_sk", "customer_sk")])
+            .filter(ratio_w > ratio_s)
+            .join(t["customer"], [("customer_sk", "c_customer_sk")])
+            .select(col("customer_sk").alias("c_customer_sk"),
+                    "c_first_name", "c_last_name",
+                    ratio_s.alias("storeSalesIncreaseRatio"),
+                    ratio_w.alias("webSalesIncreaseRatio"))
+            .sort(col("webSalesIncreaseRatio").desc(), "c_customer_sk",
+                  "c_first_name", "c_last_name")
+            .limit(100))
+
+
+def q14(t):
+    """Morning-to-evening web sales ratio for high-content pages
+    (TpcxbbLikeSpark.scala:1284)."""
+    base = (t["web_sales"]
+            .join(t["household_demographics"].filter(col("hd_dep_count") == 5),
+                  [("ws_ship_hdemo_sk", "hd_demo_sk")])
+            .join(t["web_page"].filter((col("wp_char_count") >= 5000)
+                                       & (col("wp_char_count") <= 6000)),
+                  [("ws_web_page_sk", "wp_web_page_sk")])
+            .join(t["time_dim"].filter(col("t_hour").isin(7, 8, 19, 20)),
+                  [("ws_sold_time_sk", "t_time_sk")])
+            .groupBy("t_hour").agg(F.count().alias("cnt")))
+    am = (col("t_hour") >= 7) & (col("t_hour") <= 8)
+    pm = (col("t_hour") >= 19) & (col("t_hour") <= 20)
+    return (base.agg(
+        F.sum(when(am, col("cnt")).otherwise(0)).alias("amc"),
+        F.sum(when(pm, col("cnt")).otherwise(0)).alias("pmc"))
+        .select(when(col("pmc") > 0, col("amc") / col("pmc"))
+                .otherwise(-1.00).alias("am_pm_ratio")))
+
+
+def q15(t):
+    """Categories with flat/declining store sales via least-squares slope
+    (TpcxbbLikeSpark.scala:1313). Store 3 (reference: store 10; the generator
+    floors at 6 stores)."""
+    lo, hi = datetime.date(2001, 9, 2), datetime.date(2002, 9, 2)
+    dates = (t["date_dim"]
+             .filter((col("d_date") >= lit(lo)) & (col("d_date") <= lit(hi)))
+             .select("d_date_sk"))
+    daily = (t["store_sales"].filter(col("ss_store_sk") == 3)
+             .join(dates, [("ss_sold_date_sk", "d_date_sk")], "leftsemi")
+             .join(t["item"].filter(col("i_category_id").isNotNull()),
+                   [("ss_item_sk", "i_item_sk")])
+             .groupBy(col("i_category_id").alias("cat"),
+                      col("ss_sold_date_sk").alias("x"))
+             .agg(F.sum("ss_net_paid").alias("y")))
+    per_cat = (daily
+               .select("cat", "x", "y", (col("x") * col("y")).alias("xy"),
+                       (col("x") * col("x")).alias("xx"))
+               .groupBy("cat")
+               .agg(F.count("x").alias("n"), F.sum("x").alias("sx"),
+                    F.sum("y").alias("sy"), F.sum("xy").alias("sxy"),
+                    F.sum("xx").alias("sxx")))
+    slope = ((col("n") * col("sxy") - col("sx") * col("sy"))
+             / (col("n") * col("sxx") - col("sx") * col("sx")))
+    intercept = (col("sy") - slope * col("sx")) / col("n")
+    return (per_cat.select("cat", slope.alias("slope"),
+                           intercept.alias("intercept"))
+            .filter(col("slope") <= 0.0)
+            .sort("cat"))
+
+
+def q16(t):
+    """Web sales net of refunds around a price-change date
+    (TpcxbbLikeSpark.scala:1377)."""
+    pivot = datetime.date(2001, 3, 16)
+    lo, hi = (pivot - datetime.timedelta(days=30),
+              pivot + datetime.timedelta(days=30))
+    sales = (t["web_sales"]
+             .join(t["web_returns"],
+                   [("ws_order_number", "wr_order_number"),
+                    ("ws_item_sk", "wr_item_sk")], "left")
+             .join(t["item"], [("ws_item_sk", "i_item_sk")])
+             .join(t["warehouse"], [("ws_warehouse_sk", "w_warehouse_sk")])
+             .join(t["date_dim"]
+                   .filter((col("d_date") >= lit(lo))
+                           & (col("d_date") <= lit(hi))),
+                   [("ws_sold_date_sk", "d_date_sk")]))
+    net = col("ws_sales_price") - F.coalesce(col("wr_refunded_cash"),
+                                             lit(0.0))
+    return (sales.groupBy("w_state", "i_item_id")
+            .agg(F.sum(when(col("d_date") < lit(pivot), net).otherwise(0.0))
+                 .alias("sales_before"),
+                 F.sum(when(col("d_date") >= lit(pivot), net).otherwise(0.0))
+                 .alias("sales_after"))
+            .sort("w_state", "i_item_id")
+            .limit(100))
+
+
+def q17(t):
+    """Promotional vs total sales ratio (TpcxbbLikeSpark.scala:1419)."""
+    in_tz_cust = (t["customer"]
+                  .join(t["customer_address"]
+                        .filter(col("ca_gmt_offset") == -5.0),
+                        [("c_current_addr_sk", "ca_address_sk")], "leftsemi")
+                  .select("c_customer_sk"))
+    base = (t["store_sales"]
+            .join(t["date_dim"].filter((col("d_year") == 2001)
+                                       & (col("d_moy") == 12)),
+                  [("ss_sold_date_sk", "d_date_sk")], "leftsemi")
+            .join(t["item"].filter(col("i_category").isin("Books", "Music")),
+                  [("ss_item_sk", "i_item_sk")], "leftsemi")
+            .join(t["store"].filter(col("s_gmt_offset") == -5.0),
+                  [("ss_store_sk", "s_store_sk")], "leftsemi")
+            .join(in_tz_cust, [("ss_customer_sk", "c_customer_sk")],
+                  "leftsemi")
+            .join(t["promotion"], [("ss_promo_sk", "p_promo_sk")]))
+    promo_on = ((col("p_channel_dmail") == "Y") | (col("p_channel_email") == "Y")
+                | (col("p_channel_tv") == "Y"))
+    per_channel = (base.groupBy("p_channel_email", "p_channel_dmail",
+                                "p_channel_tv")
+                   .agg(F.sum("ss_ext_sales_price").alias("total"))
+                   .select(when(promo_on, col("total")).otherwise(0.0)
+                           .alias("promotional"), "total"))
+    return (per_channel.agg(F.sum("promotional").alias("promotional"),
+                            F.sum("total").alias("total"))
+            .select("promotional", "total",
+                    when(col("total") > 0,
+                         100.0 * col("promotional") / col("total"))
+                    .otherwise(0.0).alias("promo_percent")))
+
+
+def q20(t):
+    """Customer return-behavior segmentation vectors
+    (TpcxbbLikeSpark.scala:1480)."""
+    orders = (t["store_sales"]
+              .groupBy("ss_customer_sk")
+              .agg(F.countDistinct("ss_ticket_number").alias("orders_count"),
+                   F.count("ss_item_sk").alias("orders_items"),
+                   F.sum("ss_net_paid").alias("orders_money")))
+    returns = (t["store_returns"]
+               .groupBy("sr_customer_sk")
+               .agg(F.countDistinct("sr_ticket_number").alias("returns_count"),
+                    F.count("sr_item_sk").alias("returns_items"),
+                    F.sum("sr_return_amt").alias("returns_money")))
+    ratio = lambda a, b: F.round(  # noqa: E731
+        when(col(a).isNull() | col(b).isNull() | (col(a) / col(b)).isNull(),
+             0.0).otherwise(col(a) / col(b)), 7)
+    return (orders.join(returns, [("ss_customer_sk", "sr_customer_sk")],
+                        "left")
+            .select(col("ss_customer_sk").alias("user_sk"),
+                    ratio("returns_count", "orders_count").alias("orderRatio"),
+                    ratio("returns_items", "orders_items").alias("itemsRatio"),
+                    ratio("returns_money", "orders_money")
+                    .alias("monetaryRatio"),
+                    F.round(F.coalesce(col("returns_count"), lit(0)), 0)
+                    .alias("frequency"))
+            .sort("user_sk"))
+
+
+def q21(t):
+    """Items sold, returned within 6 months, re-bought on the web
+    (TpcxbbLikeSpark.scala:1542)."""
+    part_sr = (t["store_returns"]
+               .join(t["date_dim"].filter((col("d_year") == 2003)
+                                          & (col("d_moy") >= 1)
+                                          & (col("d_moy") <= 7)),
+                     [("sr_returned_date_sk", "d_date_sk")])
+               .select("sr_item_sk", "sr_customer_sk", "sr_ticket_number",
+                       "sr_return_quantity"))
+    part_ws = (t["web_sales"]
+               .join(t["date_dim"].filter((col("d_year") >= 2003)
+                                          & (col("d_year") <= 2005)),
+                     [("ws_sold_date_sk", "d_date_sk")])
+               .select("ws_item_sk", "ws_bill_customer_sk", "ws_quantity"))
+    part_ss = (t["store_sales"]
+               .join(t["date_dim"].filter((col("d_year") == 2003)
+                                          & (col("d_moy") == 1)),
+                     [("ss_sold_date_sk", "d_date_sk")])
+               .select("ss_item_sk", "ss_store_sk", "ss_customer_sk",
+                       "ss_ticket_number", "ss_quantity"))
+    return (part_sr
+            .join(part_ws, [("sr_item_sk", "ws_item_sk"),
+                            ("sr_customer_sk", "ws_bill_customer_sk")])
+            .join(part_ss, [("sr_ticket_number", "ss_ticket_number"),
+                            ("sr_item_sk", "ss_item_sk"),
+                            ("sr_customer_sk", "ss_customer_sk")])
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .join(t["item"], [("sr_item_sk", "i_item_sk")])
+            .groupBy("i_item_id", "i_item_desc", "s_store_id", "s_store_name")
+            .agg(F.sum("ss_quantity").alias("store_sales_quantity"),
+                 F.sum("sr_return_quantity").alias("store_returns_quantity"),
+                 F.sum("ws_quantity").alias("web_sales_quantity"))
+            .sort("i_item_id", "i_item_desc", "s_store_id", "s_store_name")
+            .limit(100))
+
+
+def q22(t):
+    """Inventory change around a price-change date by warehouse
+    (TpcxbbLikeSpark.scala:1630)."""
+    pivot = lit(datetime.date(2001, 5, 8))
+    dd = F.datediff(col("d_date"), pivot)
+    base = (t["inventory"]
+            .join(t["item"].filter((col("i_current_price") >= 0.98)
+                                   & (col("i_current_price") <= 1.5)),
+                  [("inv_item_sk", "i_item_sk")])
+            .join(t["warehouse"], [("inv_warehouse_sk", "w_warehouse_sk")])
+            .join(t["date_dim"], [("inv_date_sk", "d_date_sk")])
+            .filter((dd >= -30) & (dd <= 30)))
+    agg = (base.groupBy("w_warehouse_name", "i_item_id")
+           .agg(F.sum(when(dd < 0, col("inv_quantity_on_hand")).otherwise(0))
+                .alias("inv_before"),
+                F.sum(when(dd >= 0, col("inv_quantity_on_hand")).otherwise(0))
+                .alias("inv_after")))
+    ratio = col("inv_after") / col("inv_before")
+    return (agg.filter((col("inv_before") > 0)
+                       & (ratio >= 2.0 / 3.0) & (ratio <= 3.0 / 2.0))
+            .sort("w_warehouse_name", "i_item_id")
+            .limit(100))
+
+
+def q23(t):
+    """Items with high inventory coefficient-of-variation in consecutive
+    months (TpcxbbLikeSpark.scala:1685)."""
+    monthly = (t["inventory"]
+               .join(t["date_dim"].filter((col("d_year") == 2001)
+                                          & (col("d_moy") >= 1)
+                                          & (col("d_moy") <= 2)),
+                     [("inv_date_sk", "d_date_sk")])
+               .groupBy("inv_warehouse_sk", "inv_item_sk", "d_moy")
+               .agg(F.stddev("inv_quantity_on_hand").alias("stdev"),
+                    F.avg("inv_quantity_on_hand").alias("mean")))
+    cov_tab = (monthly.filter((col("mean") > 0)
+                              & (col("stdev") / col("mean") >= 1.3))
+               .select("inv_warehouse_sk", "inv_item_sk", "d_moy",
+                       (col("stdev") / col("mean")).alias("cov")))
+    inv1 = (cov_tab.filter(col("d_moy") == 1)
+            .select(col("inv_warehouse_sk").alias("w1"),
+                    col("inv_item_sk").alias("i1"),
+                    col("d_moy").alias("d_moy"), col("cov").alias("cov")))
+    inv2 = (cov_tab.filter(col("d_moy") == 2)
+            .select(col("inv_warehouse_sk").alias("w2"),
+                    col("inv_item_sk").alias("i2"),
+                    col("d_moy").alias("d_moy2"), col("cov").alias("cov2")))
+    return (inv1.join(inv2, [("w1", "w2"), ("i1", "i2")])
+            .select(col("w1").alias("inv_warehouse_sk"),
+                    col("i1").alias("inv_item_sk"), "d_moy", "cov",
+                    "d_moy2", "cov2")
+            .sort("inv_warehouse_sk", "inv_item_sk"))
+
+
+def q24(t):
+    """Cross-price elasticity of demand for one item
+    (TpcxbbLikeSpark.scala:1761). Item 10 (reference: item 10000; the
+    generator floors at 100 items)."""
+    comp = (t["item"].filter(col("i_item_sk") == 10)
+            .join(t["item_marketprices"], [("i_item_sk", "imp_item_sk")])
+            .select("i_item_sk", "imp_sk",
+                    ((col("imp_competitor_price") - col("i_current_price"))
+                     / col("i_current_price")).alias("price_change"),
+                    "imp_start_date",
+                    (col("imp_end_date") - col("imp_start_date"))
+                    .alias("no_days_comp_price")))
+    during = lambda d: ((col(d) >= col("imp_start_date"))  # noqa: E731
+                        & (col(d) < col("imp_start_date")
+                           + col("no_days_comp_price")))
+    before = lambda d: ((col(d) >= col("imp_start_date")  # noqa: E731
+                         - col("no_days_comp_price"))
+                        & (col(d) < col("imp_start_date")))
+    ws = (t["web_sales"].join(comp, [("ws_item_sk", "i_item_sk")])
+          .groupBy("ws_item_sk", "imp_sk", "price_change")
+          .agg(F.sum(when(during("ws_sold_date_sk"), col("ws_quantity"))
+                     .otherwise(0)).alias("current_ws_quant"),
+               F.sum(when(before("ws_sold_date_sk"), col("ws_quantity"))
+                     .otherwise(0)).alias("prev_ws_quant")))
+    ss = (t["store_sales"].join(comp, [("ss_item_sk", "i_item_sk")])
+          .groupBy("ss_item_sk", "imp_sk", "price_change")
+          .agg(F.sum(when(during("ss_sold_date_sk"), col("ss_quantity"))
+                     .otherwise(0)).alias("current_ss_quant"),
+               F.sum(when(before("ss_sold_date_sk"), col("ss_quantity"))
+                     .otherwise(0)).alias("prev_ss_quant")))
+    elasticity = ((col("current_ss_quant") + col("current_ws_quant")
+                   - col("prev_ss_quant") - col("prev_ws_quant"))
+                  / ((col("prev_ss_quant") + col("prev_ws_quant"))
+                     * col("price_change")))
+    return (ws.join(ss, [("ws_item_sk", "ss_item_sk"), ("imp_sk", "imp_sk")])
+            .groupBy("ws_item_sk")
+            .agg(F.avg(elasticity).alias("cross_price_elasticity")))
+
+
+def q25(t):
+    """RFM segmentation inputs over both channels
+    (TpcxbbLikeSpark.scala:1861). Recency pivot = date_sk(2003-01-02)
+    (reference constant 37621 encodes the same date in dsdgen's epoch)."""
+    cutoff = lit(datetime.date(2002, 1, 2))
+    store = (t["store_sales"]
+             .join(t["date_dim"].filter(col("d_date") > cutoff),
+                   [("ss_sold_date_sk", "d_date_sk")])
+             .filter(col("ss_customer_sk").isNotNull())
+             .groupBy(col("ss_customer_sk").alias("cid"))
+             .agg(F.countDistinct("ss_ticket_number").alias("frequency"),
+                  F.max("ss_sold_date_sk").alias("most_recent_date"),
+                  F.sum("ss_net_paid").alias("amount")))
+    web = (t["web_sales"]
+           .join(t["date_dim"].filter(col("d_date") > cutoff),
+                 [("ws_sold_date_sk", "d_date_sk")])
+           .filter(col("ws_bill_customer_sk").isNotNull())
+           .groupBy(col("ws_bill_customer_sk").alias("cid"))
+           .agg(F.countDistinct("ws_order_number").alias("frequency"),
+                F.max("ws_sold_date_sk").alias("most_recent_date"),
+                F.sum("ws_net_paid").alias("amount")))
+    pivot = date_sk(datetime.date(2003, 1, 2))
+    return (store.union(web)
+            .groupBy("cid")
+            .agg(F.max("most_recent_date").alias("mrd"),
+                 F.sum("frequency").alias("frequency"),
+                 F.sum("amount").alias("totalspend"))
+            .select("cid",
+                    when(lit(pivot) - col("mrd") < 60, 1.0).otherwise(0.0)
+                    .alias("recency"),
+                    "frequency", "totalspend")
+            .sort("cid"))
+
+
+def q26(t):
+    """Book-buyer clustering vectors: per-customer counts by item class
+    (TpcxbbLikeSpark.scala:1945)."""
+    idc = lambda i: F.count(  # noqa: E731
+        when(col("i_class_id") == i, 1).otherwise(None)).alias(f"id{i}")
+    return (t["store_sales"].filter(col("ss_customer_sk").isNotNull())
+            .join(t["item"].filter(col("i_category") == "Books"),
+                  [("ss_item_sk", "i_item_sk")])
+            .groupBy(col("ss_customer_sk").alias("cid"))
+            .agg(F.count("ss_item_sk").alias("item_count"),
+                 *[idc(i) for i in range(1, 16)])
+            .filter(col("item_count") > 5)
+            .drop("item_count")
+            .sort("cid"))
+
+
+def q28(t):
+    """Sentiment-classifier train/test split of product reviews
+    (TpcxbbLikeSpark.scala:2004): 90% train (pmod(sk,10) in 1..9), 10% test."""
+    return (t["product_reviews"]
+            .select("pr_review_sk", col("pr_review_rating").alias("pr_rating"),
+                    "pr_review_content")
+            .withColumn("part", when(F.pmod(col("pr_review_sk"), 10) == 0,
+                                     "test").otherwise("train"))
+            .sort("pr_review_sk"))
+
+
+QUERIES: Dict[str, object] = {
+    name: fn for name, fn in list(globals().items())
+    if name.startswith("q") and name[1:].isdigit() and callable(fn)}
+
+#: queries the reference marks unsupported (UDTF/UDF/python)
+UNSUPPORTED = ("q1", "q2", "q3", "q4", "q8", "q10", "q18", "q19", "q27",
+               "q29", "q30")

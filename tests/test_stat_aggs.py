@@ -186,6 +186,27 @@ def test_sum_distinct():
     assert cpu.column("sd").to_pylist() == [5.0, 4.0]
 
 
+def test_multiple_distinct_aggs_one_aggregation():
+    """Exercises the multi-part join chain of the rewrite (parts[2:])."""
+    t = pa.table({
+        "k": pa.array([1, 1, 2, 2, None], type=pa.int32()),
+        "v": pa.array([3, 3, 4, 5, 6], type=pa.int64()),
+        "w": pa.array([1.0, 2.0, 2.0, 2.0, None]),
+    })
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.countDistinct("v").alias("ndv"),
+                     F.countDistinct("w").alias("ndw"),
+                     F.count().alias("n"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("ndv").to_pylist() == [1, 1, 2]
+    assert cpu.column("ndw").to_pylist() == [0, 2, 1]
+    assert cpu.column("n").to_pylist() == [1, 2, 2]
+
+
 def test_global_distinct_agg():
     t = _table(nulls=True)
 
