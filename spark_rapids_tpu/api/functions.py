@@ -12,13 +12,17 @@ from spark_rapids_tpu.exprs import (Abs, Acos, Asin, Atan, Atan2,
                                     DateDiff, DateSub, DayOfMonth, DayOfWeek,
                                     DayOfYear, DistinctAgg, Exp, Expm1, First,
                                     Floor, Greatest,
-                                    Hour, If, Last, LastDay, Least, Length, Literal,
+                                    Hour, If, InitCap, Last, LastDay, Least,
+                                    Length, Literal,
                                     Log, Log1p, Log2, Log10, Lower, Max, Min, Minute,
                                     Month, MonotonicallyIncreasingID, NaNvl, Pmod,
                                     Pow, Quarter, Rand, Rint, Round, Second, Signum,
                                     Sin, Sinh, SparkPartitionID, Sqrt, StddevPop,
-                                    StddevSamp, StringTrim,
-                                    Substring, Sum, Tan, Tanh, ToDegrees, ToRadians,
+                                    StddevSamp, StringLocate, StringLPad,
+                                    StringReplace, StringRPad, StringTrim,
+                                    StringTrimLeft, StringTrimRight,
+                                    Substring, SubstringIndex, Sum, Tan, Tanh,
+                                    ToDegrees, ToRadians,
                                     UnresolvedAttribute, Upper, VariancePop,
                                     VarianceSamp, Year)
 
@@ -268,6 +272,45 @@ def substring(c: Union[str, Column], pos: int, length_: int) -> Column:
 def concat(*cols) -> Column:
     return Column(Concat(tuple(_c(c) if isinstance(c, str) else c.expr
                                for c in cols)))
+
+
+initcap = _unary(InitCap)
+
+
+def ltrim(c: Union[str, Column], trim_chars: Optional[str] = None) -> Column:
+    t = None if trim_chars is None else Literal.of(trim_chars)
+    return Column(StringTrimLeft(_c(c), t))
+
+
+def rtrim(c: Union[str, Column], trim_chars: Optional[str] = None) -> Column:
+    t = None if trim_chars is None else Literal.of(trim_chars)
+    return Column(StringTrimRight(_c(c), t))
+
+
+def locate(substr: str, c: Union[str, Column], pos: int = 1) -> Column:
+    return Column(StringLocate(Literal.of(substr), _c(c), Literal.of(pos)))
+
+
+def instr(c: Union[str, Column], substr: str) -> Column:
+    return Column(StringLocate(Literal.of(substr), _c(c), Literal.of(1)))
+
+
+def lpad(c: Union[str, Column], length_: int, pad: str) -> Column:
+    return Column(StringLPad(_c(c), Literal.of(length_), Literal.of(pad)))
+
+
+def rpad(c: Union[str, Column], length_: int, pad: str) -> Column:
+    return Column(StringRPad(_c(c), Literal.of(length_), Literal.of(pad)))
+
+
+def replace(c: Union[str, Column], search: str, replacement: str = "") -> Column:
+    return Column(StringReplace(_c(c), Literal.of(search),
+                                Literal.of(replacement)))
+
+
+def substring_index(c: Union[str, Column], delim: str, count_: int) -> Column:
+    return Column(SubstringIndex(_c(c), Literal.of(delim),
+                                 Literal.of(count_)))
 
 
 # datetime -----------------------------------------------------------------

@@ -23,9 +23,13 @@ from spark_rapids_tpu.exprs.bitwise import (BitwiseAnd, BitwiseNot, BitwiseOr,
                                             BitwiseXor, ShiftLeft, ShiftRight,
                                             ShiftRightUnsigned)
 from spark_rapids_tpu.exprs.cast import Cast, can_cast_on_device
-from spark_rapids_tpu.exprs.strings import (Concat, Contains, EndsWith, Length, Like,
-                                            Lower, StartsWith, StringTrim, Substring,
-                                            Upper)
+from spark_rapids_tpu.exprs.strings import (Concat, Contains, EndsWith, InitCap,
+                                            Length, Like, Lower, StartsWith,
+                                            StringLocate, StringLPad,
+                                            StringReplace, StringRPad,
+                                            StringTrim, StringTrimLeft,
+                                            StringTrimRight, Substring,
+                                            SubstringIndex, Upper)
 from spark_rapids_tpu.exprs.datetime import (DateAdd, DateDiff, DateSub, DayOfMonth,
                                              DayOfWeek, DayOfYear, Hour, LastDay,
                                              Minute, Month, Quarter, Second, Year)

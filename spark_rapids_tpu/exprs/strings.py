@@ -222,17 +222,8 @@ class Substring(Expression):
                               xp.where(p == 0, 0, xp.maximum(nchars + p, 0)))
         start_char = xp.minimum(start_char, nchars)
         end_char = xp.minimum(start_char + l, nchars)
-        # char index -> byte offset: count non-continuation bytes cumulatively
-        in_range = np.arange(W, dtype=np.int32)[None, :] < v.lengths[:, None]
-        is_start = xp.logical_and((v.data & 0xC0) != 0x80, in_range)
-        char_idx = xp.cumsum(is_start.astype(np.int32), axis=-1)  # 1-based char no.
-        # byte offset of char k = first position where char_idx == k+1
-        def char_to_byte(k):
-            # number of bytes before char k = count of positions with char_idx <= k
-            return xp.sum(xp.logical_and(in_range, char_idx <= k[:, None]),
-                          axis=-1).astype(np.int32)
-        start_b = char_to_byte(start_char)
-        end_b = char_to_byte(end_char)
+        start_b = sk.char_to_byte_offset(xp, v.data, v.lengths, start_char, W)
+        end_b = sk.char_to_byte_offset(xp, v.data, v.lengths, end_char, W)
         data, lengths = sk.substring(xp, v.data, v.lengths, start_b,
                                      end_b - start_b, W)
         validity = xp.logical_and(v.validity,
@@ -260,10 +251,137 @@ class Concat(Expression):
         return out
 
 
+class _TrimBase(Expression):
+    """Shared trim machinery (reference: GpuStringTrim/Left/Right,
+    stringFunctions.scala:211-266 — cudf strip with an optional literal
+    trim-character set)."""
+    left = True
+    right = True
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def _trim_chars(self) -> bytes:
+        if self.trim is None:
+            return b" "
+        if not isinstance(self.trim, Literal) or self.trim.value is None:
+            raise TypeError(f"{type(self).__name__} requires a literal "
+                            f"trim-character set")
+        chars = str(self.trim.value).encode("utf-8")
+        if any(b > 127 for b in chars):
+            # per-byte membership would strip partial UTF-8 sequences
+            raise TypeError(f"{type(self).__name__} trim-character set must "
+                            f"be ASCII (got {self.trim.value!r})")
+        return chars
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        W = v.data.shape[-1]
+        start, new_len = sk.trim_bounds(xp, v.data, v.lengths, W,
+                                        self.left, self.right,
+                                        self._trim_chars())
+        data, lengths = sk.substring(xp, v.data, v.lengths, start, new_len, W)
+        return ColV(DType.STRING, data, v.validity, lengths)
+
+
 @dataclass(frozen=True)
-class StringTrim(Expression):
-    """trim(str): strip ASCII spaces from both ends (Spark trims ' ' only)."""
+class StringTrim(_TrimBase):
+    """trim(str): strip the trim chars (default ASCII space) from both ends."""
     c: Expression
+    trim: Optional[Expression] = None
+
+
+@dataclass(frozen=True)
+class StringTrimLeft(_TrimBase):
+    c: Expression
+    trim: Optional[Expression] = None
+    right = False
+
+
+@dataclass(frozen=True)
+class StringTrimRight(_TrimBase):
+    c: Expression
+    trim: Optional[Expression] = None
+    left = False
+
+
+@dataclass(frozen=True)
+class InitCap(UnaryExpression):
+    """initcap: capitalize the letter after each space, lowercase the rest
+    (Spark toLowerCase().toTitleCase(); ASCII scope like Upper/Lower)."""
+    c: Expression
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        v = self.c.eval(ctx)
+        return ColV(DType.STRING, sk.initcap(ctx.xp, v.data, v.lengths),
+                    v.validity, v.lengths, is_scalar=v.is_scalar)
+
+
+def _literal_utf8(e: Expression, what: str) -> Optional[bytes]:
+    """Constant string operand; None when the literal is null (callers emit a
+    null column, matching the reference's scalar-operand handling)."""
+    if not isinstance(e, Literal):
+        raise TypeError(f"{what} must be a literal string")
+    return None if e.value is None else str(e.value).encode("utf-8")
+
+
+def _all_null(xp, dtype: DType, capacity: int, W: int = 0) -> ColV:
+    if dtype is DType.STRING:
+        return ColV(DType.STRING, xp.zeros((capacity, W), dtype=np.uint8),
+                    xp.zeros(capacity, dtype=bool),
+                    xp.zeros(capacity, dtype=np.int32))
+    return ColV(dtype, xp.zeros(capacity, dtype=dtype.np_dtype()),
+                xp.zeros(capacity, dtype=bool))
+
+
+@dataclass(frozen=True)
+class StringLocate(Expression):
+    """locate(substr, str, start): 1-based character position of the first
+    occurrence at or after character position start; 0 when absent. Literal
+    substr/start like the reference (GpuStringLocate supports only the
+    scalar-scalar-column form); the kernel converts char positions to/from
+    byte offsets for multibyte UTF-8 data.
+
+    Null/edge semantics mirror GpuStringLocate: null start -> 0; null substr
+    -> null; start < 1 -> 0; empty substr -> 1 (for non-null rows)."""
+    sub: Expression
+    c: Expression
+    start: Expression
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        W = v.data.shape[-1]
+        if not isinstance(self.start, Literal):
+            raise TypeError("locate requires a literal start position")
+        needle = _literal_utf8(self.sub, "locate substring")
+        if self.start.value is None:
+            return ColV(DType.INT, xp.zeros(ctx.capacity, dtype=np.int32),
+                        xp.ones(ctx.capacity, dtype=bool))
+        if needle is None:
+            return _all_null(xp, DType.INT, ctx.capacity)
+        start1 = int(self.start.value)
+        if start1 < 1:
+            data = xp.zeros(ctx.capacity, dtype=np.int32)
+        elif len(needle) == 0:
+            data = xp.ones(ctx.capacity, dtype=np.int32)
+        else:
+            data = sk.locate(xp, v.data, v.lengths, needle, start1, W)
+        return ColV(DType.INT, data, v.validity)
+
+
+@dataclass(frozen=True)
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search/replace (the
+    reference's GpuStringReplace supports only scalar operands). Null search
+    or replace -> all-null result; empty search -> unchanged input."""
+    c: Expression
+    search: Expression
+    replace: Expression
 
     def dtype(self) -> DType:
         return DType.STRING
@@ -271,14 +389,82 @@ class StringTrim(Expression):
     def eval(self, ctx: EvalCtx) -> ColV:
         xp = ctx.xp
         v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
-        W = v.data.shape[-1]
-        pos = np.arange(W, dtype=np.int32)[None, :]
-        in_range = pos < v.lengths[:, None]
-        non_space = xp.logical_and(v.data != 32, in_range)
-        any_ns = xp.any(non_space, axis=-1)
-        first = xp.argmax(non_space, axis=-1).astype(np.int32)
-        last = (W - 1 - xp.argmax(non_space[:, ::-1], axis=-1)).astype(np.int32)
-        start = xp.where(any_ns, first, 0)
-        new_len = xp.where(any_ns, last - first + 1, 0)
-        data, lengths = sk.substring(xp, v.data, v.lengths, start, new_len, W)
+        search = _literal_utf8(self.search, "replace search")
+        repl = _literal_utf8(self.replace, "replace replacement")
+        if search is None or repl is None:
+            return _all_null(xp, DType.STRING, ctx.capacity,
+                             v.data.shape[-1])
+        if len(search) == 0:
+            return v
+        W_out = ctx.string_max_bytes
+        data, lengths = sk.replace_const(xp, v.data, v.lengths, search, repl,
+                                         W_out)
+        return ColV(DType.STRING, data, v.validity, lengths)
+
+
+class _PadBase(Expression):
+    side = ""
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        if not isinstance(self.length, Literal):
+            raise TypeError("pad length must be a literal")
+        pad_bytes = _literal_utf8(self.pad, "pad string")
+        if self.length.value is None or pad_bytes is None:
+            return _all_null(xp, DType.STRING, ctx.capacity,
+                             v.data.shape[-1])
+        target = max(int(self.length.value), 0)
+        W_out = max(v.data.shape[-1], min(target, ctx.string_max_bytes))
+        data, lengths = sk.pad(xp, v.data, v.lengths, target,
+                               pad_bytes, self.side, W_out)
+        return ColV(DType.STRING, data, v.validity, lengths)
+
+
+@dataclass(frozen=True)
+class StringLPad(_PadBase):
+    """lpad(str, len, pad): literal len/pad (GpuStringLPad scalar operands)."""
+    c: Expression
+    length: Expression
+    pad: Expression
+    side = "left"
+
+
+@dataclass(frozen=True)
+class StringRPad(_PadBase):
+    c: Expression
+    length: Expression
+    pad: Expression
+    side = "right"
+
+
+@dataclass(frozen=True)
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) with literal delim/count
+    (GpuSubstringIndex scalar operands)."""
+    c: Expression
+    delim: Expression
+    count: Expression
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        if not isinstance(self.count, Literal):
+            raise TypeError("substring_index count must be a literal")
+        delim = _literal_utf8(self.delim, "substring_index delimiter")
+        if self.count.value is None or delim is None:
+            return _all_null(xp, DType.STRING, ctx.capacity,
+                             v.data.shape[-1])
+        cnt = int(self.count.value)
+        if len(delim) == 0 or cnt == 0:
+            return ColV(DType.STRING, xp.zeros_like(v.data), v.validity,
+                        xp.zeros(ctx.capacity, dtype=np.int32))
+        data, lengths = sk.substring_index(xp, v.data, v.lengths, delim, cnt,
+                                           v.data.shape[-1])
         return ColV(DType.STRING, data, v.validity, lengths)

@@ -119,25 +119,29 @@ def ends_with(xp, data, lengths, suffix: bytes, W: int):
     return xp.logical_and(lengths >= k, xp.all(tail == suf, axis=-1))
 
 
-def contains(xp, data, lengths, needle: bytes, W: int):
-    """Constant-needle substring search via shifted window compare.
-
-    Builds a [n, W, k] comparison — fine for the fixed W used on device and fully
-    vector-parallel; replaces cuDF's stringContains kernel.
-    """
+def _needle_hits(xp, data, lengths, needle: bytes, W: int):
+    """Shifted-window compare for a constant needle: ok[n, S] is True where a
+    whole in-bounds occurrence starts (S = W - k + 1). The one [n, S, k]
+    comparison is the shared core of contains/locate/greedy_matches."""
     k = len(needle)
-    if k == 0:
-        return xp.ones(data.shape[0], dtype=bool)
-    if k > W:
-        return xp.zeros(data.shape[0], dtype=bool)
-    starts = np.arange(W - k + 1, dtype=np.int32)           # [S]
+    starts = np.arange(W - k + 1, dtype=np.int32)            # [S]
     offs = np.arange(k, dtype=np.int32)                      # [k]
     gather = xp.asarray(starts[:, None] + offs[None, :])     # [S, k]
     windows = data[:, gather]                                # [n, S, k]
     ndl = xp.asarray(np.frombuffer(needle, dtype=np.uint8))
     hit = xp.all(windows == ndl, axis=-1)                    # [n, S]
     valid_start = xp.asarray(starts)[None, :] <= (lengths[:, None] - k)
-    return xp.any(xp.logical_and(hit, valid_start), axis=-1)
+    return xp.logical_and(hit, valid_start)
+
+
+def contains(xp, data, lengths, needle: bytes, W: int):
+    """Constant-needle substring search (cuDF stringContains analog)."""
+    k = len(needle)
+    if k == 0:
+        return xp.ones(data.shape[0], dtype=bool)
+    if k > W:
+        return xp.zeros(data.shape[0], dtype=bool)
+    return xp.any(_needle_hits(xp, data, lengths, needle, W), axis=-1)
 
 
 def substring(xp, data, lengths, start0, slice_len, W: int):
@@ -188,6 +192,229 @@ def bool_to_string(xp, v, W: int):
     data = xp.where(v[:, None], xp.asarray(true_row), xp.asarray(false_row))
     lengths = xp.where(v, 4, 5).astype(np.int32)
     return data, lengths
+
+
+def char_starts(xp, data, lengths, W: int):
+    """Bool [n, W]: position begins a UTF-8 character (non-continuation byte
+    within the row's length)."""
+    in_range = np.arange(W, dtype=np.int32)[None, :] < lengths[:, None]
+    return xp.logical_and((data & 0xC0) != 0x80, in_range)
+
+
+def char_to_byte_offset(xp, data, lengths, char_count, W: int):
+    """Byte offset of the given 0-based per-row character index (number of
+    bytes spanned by the first char_count characters)."""
+    char_idx = xp.cumsum(char_starts(xp, data, lengths, W).astype(np.int32),
+                         axis=-1)                            # 1-based char no.
+    in_range = np.arange(W, dtype=np.int32)[None, :] < lengths[:, None]
+    return xp.sum(xp.logical_and(in_range, char_idx <= char_count[:, None]),
+                  axis=-1).astype(np.int32)
+
+
+def locate(xp, data, lengths, needle: bytes, start1, W: int):
+    """1-based *character* position of the first occurrence of the constant
+    needle at or after 1-based character position start1; 0 when absent
+    (Spark StringLocate is char-based; cuDF's stringLocate analog)."""
+    n = data.shape[0]
+    k = len(needle)
+    if k == 0 or k > W:
+        return xp.zeros(n, dtype=np.int32)
+    start1 = xp.broadcast_to(xp.asarray(np.int32(start1)), (n,)) \
+        if np.ndim(start1) == 0 else start1
+    byte_start = char_to_byte_offset(xp, data, lengths, start1 - 1, W)
+    ok = _needle_hits(xp, data, lengths, needle, W)
+    S = ok.shape[1]
+    ok = xp.logical_and(
+        ok, np.arange(S, dtype=np.int32)[None, :] >= byte_start[:, None])
+    any_ok = xp.any(ok, axis=-1)
+    first = xp.argmax(ok, axis=-1).astype(np.int32)
+    # byte offset -> 1-based char position: chars beginning strictly before it
+    starts = char_starts(xp, data, lengths, W)
+    nchars_before = xp.sum(xp.logical_and(
+        starts, np.arange(W, dtype=np.int32)[None, :] < first[:, None]),
+        axis=-1).astype(np.int32)
+    return xp.where(any_ok, nchars_before + 1, 0).astype(np.int32)
+
+
+def trim_bounds(xp, data, lengths, W: int, left: bool, right: bool,
+                chars: bytes = b" "):
+    """(start, new_len) after stripping any of the given chars from the chosen
+    ends (Spark trim family; default strips ASCII space only)."""
+    pos = np.arange(W, dtype=np.int32)[None, :]
+    in_range = pos < lengths[:, None]
+    member = xp.zeros(data.shape, dtype=bool)
+    for ch in bytearray(chars):
+        member = xp.logical_or(member, data == np.uint8(ch))
+    keepable = xp.logical_and(xp.logical_not(member), in_range)
+    any_keep = xp.any(keepable, axis=-1)
+    first = xp.argmax(keepable, axis=-1).astype(np.int32)
+    last = (W - 1 - xp.argmax(keepable[:, ::-1], axis=-1)).astype(np.int32)
+    start = xp.where(xp.logical_and(any_keep, left), first, 0)
+    end = xp.where(any_keep, xp.where(right, last + 1, lengths), 0)
+    new_len = xp.maximum(end - start, 0)
+    return start, new_len
+
+
+def initcap(xp, data, lengths):
+    """Spark initcap: lowercase everything, then uppercase the first character
+    and any character following a space (UTF8String.toLowerCase().toTitleCase():
+    the word delimiter is the single space char)."""
+    low = lower_ascii(xp, data)
+    after_space = xp.concatenate(
+        [xp.ones(data.shape[:-1] + (1,), dtype=bool), data[..., :-1] == 32],
+        axis=-1)
+    return xp.where(after_space, upper_ascii(xp, low), low)
+
+
+def pad(xp, data, lengths, target: int, pad_bytes: bytes, side: str, W: int):
+    """lpad/rpad to a constant target length with a cyclic constant pad;
+    strings longer than target are truncated (Spark semantics). An empty pad
+    can only truncate."""
+    n = data.shape[0]
+    data = pad_width(xp, data, W)
+    tgt = min(target, W)
+    plen = len(pad_bytes)
+    j = np.arange(W, dtype=np.int32)[None, :]
+    if plen == 0:
+        new_len = xp.minimum(lengths, tgt).astype(np.int32)
+        keep = j < new_len[:, None]
+        return xp.where(keep, data[:, :W], 0).astype(np.uint8), new_len
+    parr = xp.asarray(np.frombuffer(pad_bytes, dtype=np.uint8))
+    new_len = xp.full((n,), tgt, dtype=np.int32)
+    if side == "right":
+        fill_idx = (j - lengths[:, None]) % plen
+        filled = parr[xp.clip(fill_idx, 0, plen - 1)]
+        out = xp.where(j < lengths[:, None], data, filled)
+    else:
+        shift = xp.maximum(tgt - lengths, 0).astype(np.int32)[:, None]
+        src = xp.clip(j - shift, 0, W - 1)
+        moved = xp.take_along_axis(data, src, axis=-1)
+        filled = parr[xp.clip(j % plen, 0, plen - 1)]
+        out = xp.where(j < shift, filled, moved)
+    keep = j < new_len[:, None]
+    return xp.where(keep, out, 0).astype(np.uint8), new_len
+
+
+def greedy_matches(xp, data, lengths, needle: bytes, W: int):
+    """Non-overlapping left-to-right constant-needle match starts (the scan
+    order Spark's indexOf-based replace/substring_index use). Returns
+    (sel [n, W] bool, plain [n, W] int32) where plain is 1 for a byte emitted
+    as-is, 0 at and inside a selected match span.
+
+    The greedy selection is inherently sequential in W; it runs as a
+    compiled lax.scan on device (constant program size) and a plain loop on
+    the numpy path."""
+    n = data.shape[0]
+    k = len(needle)
+    pos_all = np.arange(W, dtype=np.int32)
+    in_range = pos_all[None, :] < lengths[:, None]
+    if k == 0 or k > W:
+        sel = xp.zeros((n, W), dtype=bool)
+        return sel, in_range.astype(np.int32)
+    ok = _needle_hits(xp, data, lengths, needle, W)
+    S = ok.shape[1]
+    okW = xp.concatenate(
+        [ok, xp.zeros((n, W - S), dtype=bool)], axis=1) if S < W else ok
+
+    if xp is np:
+        sel = np.zeros((n, W), dtype=bool)
+        inside = np.zeros((n, W), dtype=bool)
+        nxt = np.zeros(n, dtype=np.int32)
+        for i in range(W):
+            can = np.logical_and(okW[:, i], nxt <= i)
+            inside[:, i] = nxt > i
+            sel[:, i] = can
+            nxt = np.where(can, np.int32(i + k), nxt)
+    else:
+        import jax
+
+        def step(nxt, col):
+            ok_i, i = col
+            can = xp.logical_and(ok_i, nxt <= i)
+            inside_i = nxt > i
+            nxt = xp.where(can, i + np.int32(k), nxt)
+            return nxt, (can, inside_i)
+
+        iota = xp.arange(W, dtype=np.int32)
+        _, (sel_t, inside_t) = jax.lax.scan(
+            step, xp.zeros(n, dtype=np.int32), (okW.T, iota))
+        sel, inside = sel_t.T, inside_t.T
+    plain = xp.logical_and(in_range,
+                           xp.logical_not(xp.logical_or(sel, inside)))
+    return sel, plain.astype(np.int32)
+
+
+def replace_const(xp, data, lengths, search: bytes, repl: bytes, W_out: int):
+    """replace(str, search, repl) with constant search/repl via greedy match
+    selection + rank-gather reassembly (cuDF stringReplace analog). Output is
+    truncated at W_out bytes."""
+    W = data.shape[-1]
+    r = len(repl)
+    n = data.shape[0]
+    sel, plain = greedy_matches(xp, data, lengths, search, W)
+    emit = xp.where(sel, np.int32(r), plain)                  # [n, W]
+    csum = xp.cumsum(emit, axis=-1)
+    dst = (csum - emit).astype(np.int32)                      # exclusive
+    new_len = xp.minimum(csum[:, -1], W_out).astype(np.int32)
+    o = np.arange(W_out, dtype=np.int32)
+    # Source position for output o: scatter each emitting head i to its
+    # destination slot, then forward-fill with a running max — O(n*(W+W_out))
+    # instead of materializing an [n, W, W_out] comparison.
+    i_idx = np.arange(W, dtype=np.int32)[None, :]
+    emitting = xp.logical_and(emit > 0, dst < W_out)
+    d = xp.where(emitting, dst, W_out - 1)
+    vals = xp.where(emitting, i_idx, -1).astype(np.int32)
+    rows = np.broadcast_to(np.arange(n, dtype=np.int32)[:, None], (n, W))
+    if xp is np:
+        head = np.full((n, W_out), -1, dtype=np.int32)
+        np.maximum.at(head, (rows.ravel(), np.asarray(d).ravel()),
+                      np.asarray(vals).ravel())
+        inv = np.maximum.accumulate(head, axis=1)
+    else:
+        import jax
+        head = xp.full((n, W_out), -1, dtype=np.int32)
+        head = head.at[xp.asarray(rows), d].max(vals)
+        inv = jax.lax.cummax(head, axis=1)
+    inv = xp.clip(inv, 0, W - 1).astype(np.int32)
+    src_char = xp.take_along_axis(data, inv, axis=-1)
+    is_repl = xp.take_along_axis(sel, inv, axis=-1)
+    kk = o[None, :] - xp.take_along_axis(dst, inv, axis=-1)
+    if r > 0:
+        rarr = xp.asarray(np.frombuffer(repl, dtype=np.uint8))
+        repl_char = rarr[xp.clip(kk, 0, r - 1)]
+    else:
+        repl_char = xp.zeros_like(src_char)
+    out = xp.where(is_repl, repl_char, src_char)
+    keep = o[None, :] < new_len[:, None]
+    return xp.where(keep, out, 0).astype(np.uint8), new_len
+
+
+def substring_index(xp, data, lengths, delim: bytes, count: int, W: int):
+    """substring_index(str, delim, count): text before the count-th delimiter
+    (count > 0), after the |count|-th-from-last (count < 0), or empty
+    (count == 0); the whole string when there are fewer delimiters."""
+    n = data.shape[0]
+    if count == 0 or len(delim) == 0:
+        return (xp.zeros_like(data), xp.zeros(n, dtype=np.int32))
+    sel, _ = greedy_matches(xp, data, lengths, delim, W)
+    occ = xp.cumsum(sel.astype(np.int32), axis=-1)            # [n, W]
+    total = occ[:, -1]
+    k = len(delim)
+    if count > 0:
+        # cut before the count-th occurrence
+        is_cut = xp.logical_and(sel, occ == count)
+        has = total >= count
+        cut = xp.argmax(is_cut, axis=-1).astype(np.int32)
+        start = xp.zeros(n, dtype=np.int32)
+        new_len = xp.where(has, cut, lengths)
+    else:
+        want = total + count + 1                               # 1-based index
+        is_cut = xp.logical_and(sel, occ == want[:, None])
+        has = total >= -count
+        cut = xp.argmax(is_cut, axis=-1).astype(np.int32)
+        start = xp.where(has, cut + k, 0).astype(np.int32)
+        new_len = xp.where(has, lengths - start, lengths)
+    return substring(xp, data, lengths, start, new_len, W)
 
 
 def concat2(xp, ld, ll, rd, rl, W: int):

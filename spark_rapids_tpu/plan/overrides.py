@@ -99,6 +99,21 @@ def _tag_literal_pattern(meta: ExprMeta) -> None:
                            f"literal pattern on TPU")
 
 
+def _tag_literal_operands(*fields):
+    """Gate like the reference's scalar-only doColumnar overloads: the named
+    operands must be literals (null literals are fine — the kernels emit the
+    matching null/zero columns)."""
+    def tag(meta: ExprMeta) -> None:
+        for f in fields:
+            v = getattr(meta.expr, f, None)
+            if v is not None and not isinstance(v, li.Literal):
+                meta.will_not_work(
+                    f"{type(meta.expr).__name__} requires a literal {f} "
+                    f"on TPU (the reference supports only scalar {f})")
+                return
+    return tag
+
+
 def _tag_float_agg(meta: ExprMeta) -> None:
     """Float sum/avg results vary with reduction order; gate like the reference's
     spark.rapids.sql.variableFloatAgg.enabled. Checks every argument (corr/covar
@@ -206,7 +221,24 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(st.Like, "SQL LIKE", tag=_tag_like),
     ExprRule(st.Substring, "substring"),
     ExprRule(st.Concat, "string concatenation"),
-    ExprRule(st.StringTrim, "trim spaces"),
+    ExprRule(st.StringTrim, "trim spaces",
+             tag=_tag_literal_operands("trim")),
+    ExprRule(st.StringTrimLeft, "left trim",
+             tag=_tag_literal_operands("trim")),
+    ExprRule(st.StringTrimRight, "right trim",
+             tag=_tag_literal_operands("trim")),
+    ExprRule(st.InitCap, "initcap",
+             incompat="ASCII-only case mapping on device"),
+    ExprRule(st.StringLocate, "substring position",
+             tag=_tag_literal_operands("sub", "start")),
+    ExprRule(st.StringReplace, "string replace",
+             tag=_tag_literal_operands("search", "replace")),
+    ExprRule(st.StringLPad, "left pad",
+             tag=_tag_literal_operands("length", "pad")),
+    ExprRule(st.StringRPad, "right pad",
+             tag=_tag_literal_operands("length", "pad")),
+    ExprRule(st.SubstringIndex, "substring by delimiter",
+             tag=_tag_literal_operands("delim", "count")),
     # datetime
     ExprRule(dtm.Year, "year"), ExprRule(dtm.Month, "month"),
     ExprRule(dtm.DayOfMonth, "day of month"), ExprRule(dtm.DayOfWeek, "day of week"),

@@ -1,0 +1,260 @@
+"""Extended string function family (locate/trim-sides/initcap/replace/pad/
+substring_index): CPU (numpy eager) vs device (jitted XLA) parity plus golden
+Spark-semantics checks.
+
+Reference analog: stringFunctions.scala GpuStringLocate/GpuStringTrimLeft/
+GpuStringTrimRight/GpuInitCap/GpuStringReplace/GpuStringLPad/GpuStringRPad/
+GpuSubstringIndex and the pytest string tests. ASCII scope on device, like
+the engine's Upper/Lower."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+col = F.col
+
+STRINGS = ["hello world", "  padded  ", "a,b,c,d", "aaa", "", "ab",
+           None, "one two  three", "xxabxxabxx", ",lead", "trail,",
+           "no match here", "aaaa", " x "]
+
+
+def _df(sess):
+    return sess.create_dataframe(pa.table({"s": pa.array(STRINGS)}))
+
+
+def _golden(build, expected):
+    cpu = assert_tpu_and_cpu_equal(build)
+    got = cpu.column(cpu.column_names[-1]).to_pylist()
+    assert got == expected, f"got {got}\nexpected {expected}"
+
+
+def test_locate():
+    def build(sess):
+        return _df(sess).select("s", F.locate("a", col("s"), 2).alias("p"))
+
+    def ref(s):
+        if s is None:
+            return None
+        return s.find("a", 1) + 1  # python 0-based from idx1 -> 1-based
+
+    _golden(build, [ref(s) for s in STRINGS])
+
+
+def test_locate_edges():
+    def build(sess):
+        return _df(sess).select(
+            F.locate("", col("s")).alias("empty"),
+            F.locate("a", col("s"), 0).alias("zero_start"),
+            F.instr(col("s"), "b").alias("instr"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("empty").to_pylist() == [
+        None if s is None else 1 for s in STRINGS]
+    assert cpu.column("zero_start").to_pylist() == [
+        None if s is None else 0 for s in STRINGS]
+    assert cpu.column("instr").to_pylist() == [
+        None if s is None else s.find("b") + 1 for s in STRINGS]
+
+
+def test_trim_sides():
+    def build(sess):
+        return _df(sess).select(F.ltrim(col("s")).alias("l"),
+                                F.rtrim(col("s")).alias("r"),
+                                F.trim(col("s")).alias("b"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("l").to_pylist() == [
+        None if s is None else s.lstrip(" ") for s in STRINGS]
+    assert cpu.column("r").to_pylist() == [
+        None if s is None else s.rstrip(" ") for s in STRINGS]
+    assert cpu.column("b").to_pylist() == [
+        None if s is None else s.strip(" ") for s in STRINGS]
+
+
+def test_trim_custom_chars():
+    def build(sess):
+        return _df(sess).select(F.ltrim(col("s"), ",x").alias("l"),
+                                F.rtrim(col("s"), ",x").alias("r"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("l").to_pylist() == [
+        None if s is None else s.lstrip(",x") for s in STRINGS]
+    assert cpu.column("r").to_pylist() == [
+        None if s is None else s.rstrip(",x") for s in STRINGS]
+
+
+def test_initcap():
+    def build(sess):
+        return _df(sess).select(F.initcap(col("s")).alias("t"))
+
+    def ref(s):
+        # Spark: lowercase, then uppercase after each single space
+        out, prev_space = [], True
+        for ch in s.lower():
+            out.append(ch.upper() if prev_space else ch)
+            prev_space = ch == " "
+        return "".join(out)
+
+    _golden(build, [None if s is None else ref(s) for s in STRINGS])
+
+
+def test_replace():
+    def build(sess):
+        return _df(sess).select(F.replace(col("s"), "ab", "XYZ").alias("t"))
+
+    _golden(build, [None if s is None else s.replace("ab", "XYZ")
+                    for s in STRINGS])
+
+
+def test_replace_delete_and_empty_search():
+    def build(sess):
+        return _df(sess).select(F.replace(col("s"), "a").alias("d"),
+                                F.replace(col("s"), "", "zz").alias("e"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("d").to_pylist() == [
+        None if s is None else s.replace("a", "") for s in STRINGS]
+    # empty search -> unchanged (reference GpuStringReplace)
+    assert cpu.column("e").to_pylist() == STRINGS
+
+
+def test_replace_overlapping_needles():
+    t = pa.table({"s": pa.array(["aaaa", "aaa", "aa", "a", ""])})
+
+    def build(sess):
+        return (sess.create_dataframe(t)
+                .select(F.replace(col("s"), "aa", "b").alias("t")))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    # greedy left-to-right, non-overlapping: aaaa->bb, aaa->ba
+    assert cpu.column("t").to_pylist() == ["bb", "ba", "b", "a", ""]
+
+
+def test_pad():
+    def build(sess):
+        return _df(sess).select(F.lpad(col("s"), 8, "*-").alias("l"),
+                                F.rpad(col("s"), 8, "*-").alias("r"))
+
+    def lp(s):
+        if len(s) >= 8:
+            return s[:8]
+        fill = "*-" * 8
+        return fill[:8 - len(s)] + s
+
+    def rp(s):
+        if len(s) >= 8:
+            return s[:8]
+        fill = "*-" * 8
+        return s + fill[:8 - len(s)]
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("l").to_pylist() == [
+        None if s is None else lp(s) for s in STRINGS]
+    assert cpu.column("r").to_pylist() == [
+        None if s is None else rp(s) for s in STRINGS]
+
+
+def test_substring_index():
+    def build(sess):
+        return _df(sess).select(
+            F.substring_index(col("s"), ",", 2).alias("a"),
+            F.substring_index(col("s"), ",", -1).alias("b"),
+            F.substring_index(col("s"), ",", 0).alias("z"))
+
+    def ref(s, cnt):
+        parts = s.split(",")
+        if cnt > 0:
+            return s if len(parts) <= cnt else ",".join(parts[:cnt])
+        return s if len(parts) <= -cnt else ",".join(parts[cnt:])
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("a").to_pylist() == [
+        None if s is None else ref(s, 2) for s in STRINGS]
+    assert cpu.column("b").to_pylist() == [
+        None if s is None else ref(s, -1) for s in STRINGS]
+    assert cpu.column("z").to_pylist() == [
+        None if s is None else "" for s in STRINGS]
+
+
+def test_null_literal_operands():
+    """Null scalar operands match the reference's all-null / zero outputs."""
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.exprs import (Literal, StringLocate, StringReplace,
+                                        UnresolvedAttribute)
+    from spark_rapids_tpu.columnar.dtypes import DType
+
+    def build(sess):
+        s = UnresolvedAttribute("s")
+        return _df(sess).select(
+            Column(StringLocate(Literal(None, DType.STRING), s,
+                                Literal.of(1))).alias("null_sub"),
+            Column(StringLocate(Literal.of("a"), s,
+                                Literal(None, DType.INT))).alias("null_start"),
+            Column(StringReplace(s, Literal(None, DType.STRING),
+                                 Literal.of("x"))).alias("null_search"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("null_sub").to_pylist() == [None] * len(STRINGS)
+    assert cpu.column("null_start").to_pylist() == [0] * len(STRINGS)
+    assert cpu.column("null_search").to_pylist() == [None] * len(STRINGS)
+
+
+def test_placement_on_tpu():
+    # initcap is incompat-gated (ASCII-only case mapping), so opt in
+    def build(sess):
+        return _df(sess).select(F.initcap(F.replace(col("s"), "a", "b"))
+                                .alias("t"))
+
+    assert_tpu_and_cpu_equal(
+        build,
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": "true"},
+        expect_tpu_execs=["TpuProjectExec"])
+
+
+def test_initcap_incompat_gated():
+    """Without the incompat opt-in, initcap stays off the device (same gating
+    as Upper/Lower's ASCII-only case mapping)."""
+    from spark_rapids_tpu.testing import run_with_cpu_and_tpu
+
+    def build(sess):
+        return _df(sess).select(F.initcap(col("s")).alias("t"))
+
+    _, _, sess = run_with_cpu_and_tpu(build)
+    assert "initcap" in (sess.last_explain or "").lower() or \
+        "InitCap" in (sess.last_explain or "")
+
+
+def test_trim_rejects_non_ascii_trim_set():
+    """Per-byte membership would strip partial UTF-8 sequences, so a
+    multibyte trim set is rejected outright."""
+    with pytest.raises(TypeError, match="ASCII"):
+        F.ltrim(col("s"), "é").expr._trim_chars()
+
+
+def test_locate_multibyte_char_positions():
+    """Spark locate is character-based: multibyte chars count as one."""
+    t = pa.table({"s": pa.array(["héllo", "ééa", "aéa", None])})
+
+    def build(sess):
+        return (sess.create_dataframe(t)
+                .select(F.locate("l", col("s")).alias("l"),
+                        F.locate("a", col("s"), 2).alias("a2")))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("l").to_pylist() == [3, 0, 0, None]
+    # 'a' at char 1 in 'aéa' is before start=2; next is char 3
+    assert cpu.column("a2").to_pylist() == [0, 3, 3, None]
+
+
+def test_replace_grows_within_max_bytes():
+    """Replacement longer than the search pattern grows rows up to the
+    configured string width budget."""
+    t = pa.table({"s": pa.array(["abab", "ab", "ba", None])})
+
+    def build(sess):
+        return (sess.create_dataframe(t)
+                .select(F.replace(col("s"), "ab", "12345").alias("t")))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("t").to_pylist() == ["1234512345", "12345", "ba", None]
