@@ -1,9 +1,15 @@
 """Benchmark entry point (driver contract: prints ONE JSON line).
 
-Runs TPC-H Q1 over generated lineitem data end-to-end (host staging -> device
-upload -> fused filter+aggregate+sort on TPU -> download) and compares against
-the CPU engine (eager numpy, the stand-in for CPU Spark — the reference's
-baseline in its 4x-typical-speedup claim, docs/FAQ.md:66).
+Default: TPC-H Q1 (scan -> fused filter+aggregate -> sort) on the TPU engine
+end-to-end, compared against the CPU engine (eager numpy, the stand-in for
+CPU Spark in the reference's 4x-typical claim, docs/FAQ.md:66).
+BENCH_SUITE=tpcxbb switches to the reference's headline TPCx-BB family
+(BASELINE.md config 1); its multi-join plans sync per join phase, so over a
+high-latency chip tunnel the default stays on the single-pipeline Q1.
+
+Env knobs: BENCH_SUITE (tpch | tpcxbb, default tpch), BENCH_QUERY (query
+name within the tpcxbb suite), BENCH_SCALE (table scale factor), BENCH_ITERS
+(timed iterations after the compile warmup, default 3).
 """
 import json
 import os
@@ -11,39 +17,72 @@ import sys
 import time
 
 
-def main() -> None:
-    scale = float(os.environ.get("BENCH_SCALE", "0.05"))  # 300k rows default
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-
-    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
+def _bench_tpch(scale: float):
     from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
 
     table = gen_lineitem(scale=scale, seed=42)
-    n_rows = table.num_rows
+    # lineitem's flag/status strings are 1 byte; a narrow device string width
+    # cuts the byte-matrix staging/upload/compute by 16x vs the 256 default
+    conf = {**BENCH_CONF, "spark.rapids.tpu.sql.string.maxBytes": "16"}
+    tpu_sess = TpuSession(conf)
+    cpu_sess = TpuSession({**conf,
+                           "spark.rapids.tpu.sql.enabled": "false"})
+    run_tpu = lambda: q1(tpu_sess.create_dataframe(table)).collect()  # noqa: E731
+    run_cpu = lambda: q1(cpu_sess.create_dataframe(table)).collect()  # noqa: E731
+    return "tpch_q1", table.num_rows, run_tpu, run_cpu
 
+
+def _bench_tpcxbb(scale: float, qname: str):
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+    from spark_rapids_tpu.benchmarks.tpcxbb_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpcxbb_queries import QUERIES
+
+    tables = gen_all(scale=scale, seed=42)
+    query = QUERIES[qname]
+    n_rows = (tables["web_clickstreams"].num_rows if qname == "q5"
+              else sum(v.num_rows for v in tables.values()))
     tpu_sess = TpuSession(BENCH_CONF)
-    cpu_sess = TpuSession({**BENCH_CONF, "spark.rapids.tpu.sql.enabled": "false"})
+    cpu_sess = TpuSession({**BENCH_CONF,
+                           "spark.rapids.tpu.sql.enabled": "false"})
+    tpu_t = {k: tpu_sess.create_dataframe(v) for k, v in tables.items()}
+    cpu_t = {k: cpu_sess.create_dataframe(v) for k, v in tables.items()}
+    return (f"tpcxbb_{qname}", n_rows,
+            lambda: query(tpu_t).collect(), lambda: query(cpu_t).collect())
 
-    # warmup (compile)
-    tpu_result = q1(tpu_sess.create_dataframe(table)).collect()
+
+def main() -> None:
+    suite = os.environ.get("BENCH_SUITE", "tpch")
+    scale = float(os.environ.get("BENCH_SCALE", "0.05"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+
+    if suite == "tpch":
+        name, n_rows, run_tpu, run_cpu = _bench_tpch(scale)
+    elif suite == "tpcxbb":
+        qname = os.environ.get("BENCH_QUERY", "q5")
+        name, n_rows, run_tpu, run_cpu = _bench_tpcxbb(scale, qname)
+    else:
+        raise SystemExit(f"unknown BENCH_SUITE {suite!r} (tpch | tpcxbb)")
+
+    tpu_result = run_tpu()  # warmup (compile)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        q1(tpu_sess.create_dataframe(table)).collect()
+        run_tpu()
     tpu_time = (time.perf_counter() - t0) / iters
 
     t0 = time.perf_counter()
-    cpu_result = q1(cpu_sess.create_dataframe(table)).collect()
+    cpu_result = run_cpu()
     cpu_time = time.perf_counter() - t0
 
-    # sanity: same group count
     assert tpu_result.num_rows == cpu_result.num_rows, (
         f"result mismatch: {tpu_result.num_rows} vs {cpu_result.num_rows}")
 
     tpu_rps = n_rows / tpu_time
     cpu_rps = n_rows / cpu_time
     print(json.dumps({
-        "metric": "tpch_q1_rows_per_sec",
+        "metric": f"{name}_rows_per_sec",
         "value": round(tpu_rps),
         "unit": "rows/s",
         "vs_baseline": round(tpu_rps / cpu_rps, 3),

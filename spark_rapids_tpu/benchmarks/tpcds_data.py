@@ -1,11 +1,15 @@
-"""Deterministic dsdgen-alike for the TPC-DS store channel.
+"""Deterministic dsdgen-alike for the full TPC-DS table set: the store,
+catalog and web sales channels, their returns, inventory, and every dimension
+the query suite touches.
 
 Reference analog: TpcdsLikeSpark.scala's table setup (the reference converts
-real dsdgen output; this generator synthesizes the same shapes). Covers
-store_sales plus every dimension the store-channel query subset touches, with
-the structural properties those queries depend on: ticket-level consistency
-(all lines of one ss_ticket_number share customer/store/date/hdemo — the
-count-items-per-ticket queries group on that), ~4% null foreign keys like
+real dsdgen output; this generator synthesizes the same shapes) with the
+structural properties the queries depend on: ticket/order-level consistency
+(all lines of one ticket or order share customer/store/date — the per-order
+count-distinct queries group on that), returns sampled from their sales facts
+(same order/item link), a catalog-channel replay of store returns (the
+bought/returned/bought-again chains), planted price/brand bands where random
+draws would qualify ~0 rows at small scales, ~4% null foreign keys like
 dsdgen emits, a real calendar for date_dim, and cross-product demographics
 dimensions. Doubles stand in for decimals (v0 has no decimal support).
 """
@@ -27,7 +31,22 @@ CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Men",
               "Music", "Shoes", "Sports", "Women", "Children"]
 CLASSES = ["accent", "bedding", "classical", "dresses", "mens watch",
            "pants", "football", "romance", "fiction", "shirts", "athletic",
-           "computers", "stereo", "portable", "reference"]
+           "computers", "stereo", "portable", "reference", "personal",
+           "self-help", "fragrances", "accessories"]
+
+#: planted (category, class, brand) combos matching the brand-list predicates
+#: of q53/q63 — random draws over the three independent pools would qualify
+#: ~0 items at small scales
+_BRAND_COMBOS = [
+    ("Books", "personal", "scholaramalgamalg #14"),
+    ("Children", "portable", "scholaramalgamalg #7"),
+    ("Electronics", "reference", "exportiunivamalg #9"),
+    ("Books", "self-help", "scholaramalgamalg #9"),
+    ("Women", "accessories", "amalgimporto #1"),
+    ("Music", "classical", "edu packscholar #1"),
+    ("Men", "fragrances", "exportiimporto #1"),
+    ("Women", "pants", "importoamalg #1"),
+]
 CITIES = ["Midway", "Fairview", "Oakland", "Riverside", "Five Points",
           "Centerville", "Oak Grove", "Pleasant Hill", "Bethel", "Clinton",
           "Antioch", "Marion", "Greenville", "Union", "Salem", "Spring Hill",
@@ -70,6 +89,8 @@ def gen_date_dim() -> pa.Table:
         "d_dom": pa.array(np.array([d.day for d in days], np.int32)),
         "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in days],
                                    np.int32)),
+        "d_quarter_name": pa.array(
+            [f"{d.year}Q{(d.month - 1) // 3 + 1}" for d in days]),
         "d_dow": pa.array(np.array([d.weekday() for d in days], np.int32)),
         "d_day_name": pa.array([DAY_NAMES[d.weekday()] for d in days]),
         # sequential week/month counters like dsdgen's *_seq surrogates
@@ -97,14 +118,33 @@ def gen_item(scale: float, seed: int) -> pa.Table:
     brand_id = (rng.integers(1, 11, n) * 1000000
                 + rng.integers(1, 11, n) * 1000 + rng.integers(1, 11, n))
     cat_id = rng.integers(1, len(CATEGORIES) + 1, n).astype(np.int32)
+    # dsdgen-style syllable brand names with a small number suffix, so the
+    # brand-list predicates (q53/q63 style) have real values to match
+    brand_bases = np.array(["amalgimporto #", "edu packscholar #",
+                            "exportiimporto #", "importoamalg #",
+                            "scholaramalgamalg #", "exportiunivamalg #",
+                            "corpamalgamalg #", "amalgamalg #"])
+    brand = np.char.add(brand_bases[rng.integers(0, len(brand_bases), n)],
+                        rng.integers(1, 16, n).astype(str))
+    cls = np.array(CLASSES)[rng.integers(0, len(CLASSES), n)]
+    # plant every 10th item on a qualifying (category, class, brand) combo
+    planted = np.flatnonzero((sk - 1) % 10 == 5)
+    combo = [np.array([c[j] for c in _BRAND_COMBOS])
+             for j in range(3)]
+    which = np.arange(planted.shape[0]) % len(_BRAND_COMBOS)
+    cat_id[planted] = np.array(
+        [CATEGORIES.index(c) + 1 for c in combo[0]], np.int32)[which]
+    cls[planted] = combo[1][which]
+    brand[planted] = combo[2][which]
     return pa.table({
         "i_item_sk": pa.array(sk),
         "i_item_id": pa.array(np.char.add("AAAAAAAA",
                                           np.char.zfill(sk.astype(str), 8))),
         "i_item_desc": pa.array(np.char.add("item desc ", sk.astype(str))),
+        "i_product_name": pa.array(np.char.add("product ", sk.astype(str))),
         "i_brand_id": pa.array(brand_id.astype(np.int32)),
-        "i_brand": pa.array(np.char.add("corpbrand #", brand_id.astype(str))),
-        "i_class": pa.array(np.array(CLASSES)[rng.integers(0, len(CLASSES), n)]),
+        "i_brand": pa.array(brand),
+        "i_class": pa.array(cls),
         "i_category_id": pa.array(cat_id),
         "i_category": pa.array(np.array(CATEGORIES)[cat_id - 1]),
         # cycle so the specific ids queries filter on (manufact 128, manager
@@ -114,7 +154,16 @@ def gen_item(scale: float, seed: int) -> pa.Table:
                                            rng.integers(1, 1001, n).astype(str))),
         "i_wholesale_cost": pa.array(np.round(rng.uniform(0.05, 70.0, n), 2)),
         "i_manager_id": pa.array(((sk - 1) % 100 + 1).astype(np.int32)),
-        "i_current_price": pa.array(np.round(rng.uniform(0.09, 99.99, n), 2)),
+        # planted price bands (uniform prices would leave these windows nearly
+        # empty at small scales): every 25th item at ~1.00-1.49 (q21/q40/q82's
+        # cheap-item window) and every 25th-offset-7 at 68-98 (q37's mid-price
+        # window, paired with steady inventory in gen_inventory)
+        "i_current_price": pa.array(np.where(
+            (sk - 1) % 25 == 3,
+            np.round(rng.uniform(1.0, 1.45, n), 2),
+            np.where((sk - 1) % 25 == 7,
+                     np.round(rng.uniform(68.0, 98.0, n), 2),
+                     np.round(rng.uniform(0.09, 99.99, n), 2)))),
     })
 
 
@@ -138,6 +187,9 @@ def gen_customer(scale: float, seed: int) -> pa.Table:
         "c_preferred_cust_flag": pa.array(np.where(rng.random(n) < 0.5, "Y", "N")),
         "c_birth_country": pa.array(np.where(rng.random(n) < 0.8,
                                              "UNITED STATES", "CANADA")),
+        "c_birth_year": pa.array(rng.integers(1924, 1993, n).astype(np.int32)),
+        "c_birth_month": pa.array(rng.integers(1, 13, n).astype(np.int32)),
+        "c_birth_day": pa.array(rng.integers(1, 29, n).astype(np.int32)),
     })
 
 
@@ -304,7 +356,406 @@ def gen_store_sales(scale: float, seed: int) -> pa.Table:
     })
 
 
+def n_warehouse(scale): return max(int(10 * scale), 5)
+def n_web_site(scale): return max(int(8 * scale), 4)
+def n_web_page(scale): return max(int(120 * scale), 30)
+def n_call_center(scale): return max(int(8 * scale), 4)
+def n_catalog_page(scale): return max(int(200 * scale), 40)
+def n_orders(scale): return max(int(100_000 * scale), 500)
+
+SHIP_TYPES = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"]
+SHIP_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+                 "LATVIAN", "ALLIANCE", "GREAT EASTERN", "DIAMOND", "RUPEKSA"]
+
+
+def gen_warehouse(scale: float, seed: int) -> pa.Table:
+    n = n_warehouse(scale)
+    rng = np.random.default_rng(seed + 21)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "w_warehouse_sk": pa.array(sk),
+        "w_warehouse_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "w_warehouse_name": pa.array(np.char.add("Warehouse no ",
+                                                 sk.astype(str))),
+        "w_warehouse_sq_ft": pa.array(
+            rng.integers(50_000, 1_000_000, n).astype(np.int32)),
+        "w_city": pa.array(np.array(CITIES)[(sk - 1) % len(CITIES)]),
+        "w_county": pa.array(np.array(COUNTIES)[(sk - 1) % len(COUNTIES)]),
+        "w_state": pa.array(np.array(STATES)[(sk - 1) % len(STATES)]),
+        "w_country": pa.array(np.full(n, "United States")),
+        "w_gmt_offset": pa.array((-5.0 - ((sk - 1) % 4)).astype(np.float64)),
+    })
+
+
+def gen_web_site(scale: float, seed: int) -> pa.Table:
+    n = n_web_site(scale)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "web_site_sk": pa.array(sk),
+        "web_site_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "web_name": pa.array(np.char.add("site_", ((sk - 1) % 4).astype(str))),
+        "web_company_name": pa.array(np.array(
+            ["pri", "able", "ought", "ese", "anti", "cally"])[(sk - 1) % 6]),
+        "web_state": pa.array(np.array(STATES)[(sk - 1) % len(STATES)]),
+        "web_gmt_offset": pa.array((-5.0 - ((sk - 1) % 4)).astype(np.float64)),
+    })
+
+
+def gen_web_page(scale: float, seed: int) -> pa.Table:
+    n = n_web_page(scale)
+    rng = np.random.default_rng(seed + 22)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "wp_web_page_sk": pa.array(sk),
+        "wp_web_page_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "wp_char_count": pa.array(rng.integers(3000, 9000, n).astype(np.int32)),
+        "wp_link_count": pa.array(rng.integers(2, 25, n).astype(np.int32)),
+    })
+
+
+def gen_call_center(scale: float, seed: int) -> pa.Table:
+    n = n_call_center(scale)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "cc_call_center_sk": pa.array(sk),
+        "cc_call_center_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "cc_name": pa.array(np.array(["NY Metro", "Mid Atlantic",
+                                      "North Midwest", "Pacific NW"])[
+            (sk - 1) % 4]),
+        "cc_manager": pa.array(np.array(FIRST_NAMES)[(sk - 1)
+                                                     % len(FIRST_NAMES)]),
+        "cc_county": pa.array(np.array(COUNTIES)[(sk - 1) % len(COUNTIES)]),
+    })
+
+
+def gen_catalog_page(scale: float, seed: int) -> pa.Table:
+    n = n_catalog_page(scale)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "cp_catalog_page_sk": pa.array(sk),
+        "cp_catalog_page_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "cp_catalog_number": pa.array(((sk - 1) // 100 + 1).astype(np.int32)),
+        "cp_catalog_page_number": pa.array(((sk - 1) % 100 + 1)
+                                           .astype(np.int32)),
+    })
+
+
+def gen_ship_mode() -> pa.Table:
+    rows = [(t, c) for t in SHIP_TYPES for c in SHIP_CARRIERS[:4]]
+    n = len(rows)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "sm_ship_mode_sk": pa.array(sk),
+        "sm_ship_mode_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "sm_type": pa.array([r[0] for r in rows]),
+        "sm_code": pa.array(np.array(["AIR", "SURFACE", "SEA"])[(sk - 1) % 3]),
+        "sm_carrier": pa.array([r[1] for r in rows]),
+    })
+
+
+def gen_reason() -> pa.Table:
+    reasons = ["Package was damaged", "Stopped working", "Did not get it on time",
+               "Not the product that was ordred", "Parts missing",
+               "Does not work with a product that I have",
+               "Gift exchange", "Did not like the color",
+               "Did not like the model", "Did not fit"]
+    n = len(reasons)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "r_reason_sk": pa.array(sk),
+        "r_reason_id": pa.array(np.char.add(
+            "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
+        "r_reason_desc": pa.array(reasons),
+    })
+
+
+def gen_income_band() -> pa.Table:
+    n = 20
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    return pa.table({
+        "ib_income_band_sk": pa.array(sk),
+        "ib_lower_bound": pa.array(((sk - 1) * 10000).astype(np.int32)),
+        "ib_upper_bound": pa.array((sk * 10000 - 1).astype(np.int32)),
+    })
+
+
+def _gen_channel_sales(scale: float, seed: int, prefix: str,
+                       extra: Dict[str, int],
+                       replay=None) -> pa.Table:
+    """Order-structured sales fact for the catalog/web channels: all lines of
+    an order share customer/date/addr/etc (dsdgen's order consistency, which
+    the order-level count-distinct queries group on); the warehouse varies
+    per line (q16/q94 probe orders shipping from multiple warehouses).
+    ``extra`` maps extra per-order dim columns to their key-space size.
+    ``replay`` is an optional (customer_sk, item_sk, date_sk) triple of
+    equal-length arrays appended as single-line orders — the
+    bought/returned/bought-again chains q25/q29-style queries join on."""
+    rng = np.random.default_rng(seed + sum(prefix.encode()))
+    orders = n_orders(scale)
+    lines_per = rng.integers(1, 9, orders)
+    n = int(lines_per.sum())
+    order_no = np.repeat(np.arange(1, orders + 1, dtype=np.int64), lines_per)
+
+    cd_n = 2 * len(MARITAL) * len(EDUCATION) * len(CREDIT)
+    hd_n = len(BUY_POTENTIAL) * 10 * 5
+    per_order = {
+        "sold_date_sk": (rng.integers(0, _DAYS, orders) + _SK0),
+        "sold_time_sk": rng.integers(0, 1440, orders),
+        "bill_customer_sk": rng.integers(1, n_customer(scale) + 1, orders),
+        "bill_cdemo_sk": rng.integers(1, cd_n + 1, orders),
+        "bill_hdemo_sk": rng.integers(1, hd_n + 1, orders),
+        "bill_addr_sk": rng.integers(1, n_address(scale) + 1, orders),
+        "ship_customer_sk": rng.integers(1, n_customer(scale) + 1, orders),
+        "ship_cdemo_sk": rng.integers(1, cd_n + 1, orders),
+        "ship_hdemo_sk": rng.integers(1, hd_n + 1, orders),
+        "ship_addr_sk": rng.integers(1, n_address(scale) + 1, orders),
+        "ship_mode_sk": rng.integers(1, len(SHIP_TYPES) * 4 + 1, orders),
+    }
+    for name, size in extra.items():
+        per_order[name] = rng.integers(1, size + 1, orders)
+
+    replay_items = None
+    if replay is not None:
+        r_cust, r_item, r_date = (np.asarray(a, dtype=np.int64)
+                                  for a in replay)
+        m = r_cust.shape[0]
+        per_order = {k: np.concatenate([v, rng.integers(1, int(v.max()) + 1, m)])
+                     for k, v in per_order.items()}
+        per_order["sold_date_sk"][-m:] = np.minimum(
+            r_date + rng.integers(1, 90, m), _SK0 + _DAYS - 1)
+        per_order["bill_customer_sk"][-m:] = r_cust
+        order_no = np.concatenate(
+            [order_no, np.arange(orders + 1, orders + m + 1, dtype=np.int64)])
+        replay_items = r_item
+        orders += m
+        n += m
+    per_order["ship_date_sk"] = np.minimum(
+        per_order["sold_date_sk"] + rng.integers(1, 121, orders),
+        _SK0 + _DAYS - 1)
+    rep = lambda a: a[order_no - 1]  # noqa: E731
+
+    p = _price_lines(rng, n)
+    ship_cost = np.round(p["qty"] * rng.uniform(0.5, 10.0, n), 2)
+    coupon = np.where(rng.random(n) < 0.1,
+                      np.round(p["ext_sales"] * rng.uniform(0, 0.5, n), 2),
+                      0.0)
+    net_paid = np.round(p["ext_sales"] - coupon, 2)
+    tax = np.round(net_paid * 0.08, 2)
+    cols = {}
+    for name, arr in per_order.items():
+        cols[f"{prefix}_{name}"] = _null_some(
+            rng, rep(arr.astype(np.int64)), 0.04)
+    item_sk = rng.integers(1, n_item(scale) + 1, n).astype(np.int64)
+    if replay_items is not None:
+        item_sk[-replay_items.shape[0]:] = replay_items
+    cols[f"{prefix}_item_sk"] = pa.array(item_sk)
+    cols[f"{prefix}_warehouse_sk"] = _null_some(
+        rng, rng.integers(1, n_warehouse(scale) + 1, n).astype(np.int64),
+        0.04)
+    cols[f"{prefix}_promo_sk"] = _null_some(
+        rng, rng.integers(1, n_promo(scale) + 1, n).astype(np.int64), 0.04)
+    cols[f"{prefix}_order_number"] = pa.array(order_no)
+    cols[f"{prefix}_quantity"] = pa.array(p["qty"])
+    cols[f"{prefix}_wholesale_cost"] = pa.array(p["wholesale"])
+    cols[f"{prefix}_list_price"] = pa.array(p["list_price"])
+    cols[f"{prefix}_sales_price"] = pa.array(p["sales_price"])
+    cols[f"{prefix}_ext_discount_amt"] = pa.array(p["ext_discount"])
+    cols[f"{prefix}_ext_sales_price"] = pa.array(p["ext_sales"])
+    cols[f"{prefix}_ext_wholesale_cost"] = pa.array(p["ext_wholesale"])
+    cols[f"{prefix}_ext_list_price"] = pa.array(p["ext_list"])
+    cols[f"{prefix}_ext_tax"] = pa.array(tax)
+    cols[f"{prefix}_coupon_amt"] = pa.array(coupon)
+    cols[f"{prefix}_ext_ship_cost"] = pa.array(ship_cost)
+    cols[f"{prefix}_net_paid"] = pa.array(net_paid)
+    cols[f"{prefix}_net_paid_inc_tax"] = pa.array(np.round(net_paid + tax, 2))
+    cols[f"{prefix}_net_paid_inc_ship"] = pa.array(
+        np.round(net_paid + ship_cost, 2))
+    cols[f"{prefix}_net_profit"] = pa.array(
+        np.round(net_paid - p["ext_wholesale"], 2))
+    return pa.table(cols)
+
+
+def _gen_channel_returns(scale: float, seed: int, sales: pa.Table,
+                         sp: str, rp: str, carry: Dict[str, str],
+                         frac: float = 0.08) -> pa.Table:
+    """Returns fact sampled from sales lines (same order/item link dsdgen
+    uses), returned 1-60 days after the sale."""
+    rng = np.random.default_rng(seed + sum(rp.encode()))
+    n_s = sales.num_rows
+    take = np.flatnonzero(rng.random(n_s) < frac)
+    k = take.shape[0]
+    get = lambda c: sales.column(c).to_numpy(zero_copy_only=False)[take]  # noqa: E731
+
+    sold = get(f"{sp}_sold_date_sk")
+    ret_date = np.minimum(np.nan_to_num(sold, nan=_SK0) + rng.integers(1, 61, k),
+                          _SK0 + _DAYS - 1)
+    qty = get(f"{sp}_quantity")
+    net = np.nan_to_num(get(f"{sp}_net_paid"))
+    ret_qty = np.minimum(rng.integers(1, 101, k), qty).astype(np.int32)
+    frac_q = ret_qty / np.maximum(qty, 1)
+    amt = np.round(net * frac_q, 2)
+    fee = np.round(rng.uniform(0.5, 100.0, k), 2)
+    cols = {
+        f"{rp}_returned_date_sk": pa.array(
+            np.where(np.isnan(sold), 0, ret_date).astype(np.int64),
+            mask=np.isnan(sold)),
+        f"{rp}_returned_time_sk": pa.array(
+            rng.integers(0, 1440, k).astype(np.int64)),
+    }
+    for src, dst in carry.items():
+        v = sales.column(src).to_numpy(zero_copy_only=False)[take]
+        if v.dtype.kind == "f":
+            cols[dst] = pa.array(np.where(np.isnan(v), 0, v).astype(np.int64),
+                                 mask=np.isnan(v))
+        else:
+            cols[dst] = pa.array(v.astype(np.int64))
+    cols[f"{rp}_reason_sk"] = _null_some(
+        rng, rng.integers(1, 11, k).astype(np.int64), 0.04)
+    cols[f"{rp}_return_quantity"] = pa.array(ret_qty)
+    amt_name = "return_amount" if rp == "cr" else "return_amt"
+    cols[f"{rp}_{amt_name}"] = pa.array(amt)
+    cols[f"{rp}_return_tax"] = pa.array(np.round(amt * 0.08, 2))
+    cols[f"{rp}_return_amt_inc_tax"] = pa.array(np.round(amt * 1.08, 2))
+    cols[f"{rp}_fee"] = pa.array(fee)
+    cols[f"{rp}_return_ship_cost"] = pa.array(
+        np.round(rng.uniform(0.5, 50.0, k) * ret_qty, 2))
+    cols[f"{rp}_refunded_cash"] = pa.array(
+        np.round(amt * rng.uniform(0.3, 1.0, k), 2))
+    cols[f"{rp}_net_loss"] = pa.array(np.round(fee + amt * 0.1, 2))
+    return pa.table(cols)
+
+
+def gen_catalog_sales(scale: float, seed: int, replay=None) -> pa.Table:
+    return _gen_channel_sales(scale, seed, "cs", {
+        "call_center_sk": n_call_center(scale),
+        "catalog_page_sk": n_catalog_page(scale)},
+        replay=replay)
+
+
+def gen_web_sales_ds(scale: float, seed: int) -> pa.Table:
+    return _gen_channel_sales(scale, seed, "ws", {
+        "web_page_sk": n_web_page(scale), "web_site_sk": n_web_site(scale)})
+
+
+def gen_catalog_returns(scale: float, seed: int, cs: pa.Table) -> pa.Table:
+    return _gen_channel_returns(scale, seed, cs, "cs", "cr", {
+        "cs_item_sk": "cr_item_sk",
+        "cs_order_number": "cr_order_number",
+        "cs_bill_customer_sk": "cr_refunded_customer_sk",
+        "cs_ship_customer_sk": "cr_returning_customer_sk",
+        "cs_bill_cdemo_sk": "cr_refunded_cdemo_sk",
+        "cs_bill_addr_sk": "cr_returning_addr_sk",
+        "cs_call_center_sk": "cr_call_center_sk",
+        "cs_catalog_page_sk": "cr_catalog_page_sk",
+        "cs_warehouse_sk": "cr_warehouse_sk",
+    })
+
+
+def gen_web_returns_ds(scale: float, seed: int, ws: pa.Table) -> pa.Table:
+    return _gen_channel_returns(scale, seed, ws, "ws", "wr", {
+        "ws_item_sk": "wr_item_sk",
+        "ws_order_number": "wr_order_number",
+        "ws_bill_customer_sk": "wr_refunded_customer_sk",
+        "ws_bill_cdemo_sk": "wr_refunded_cdemo_sk",
+        "ws_bill_addr_sk": "wr_refunded_addr_sk",
+        "ws_ship_customer_sk": "wr_returning_customer_sk",
+        "ws_web_page_sk": "wr_web_page_sk",
+    })
+
+
+def gen_store_returns(scale: float, seed: int, ss: pa.Table) -> pa.Table:
+    rng = np.random.default_rng(seed + 23)
+    n_s = ss.num_rows
+    take = np.flatnonzero(rng.random(n_s) < 0.08)
+    k = take.shape[0]
+    get = lambda c: ss.column(c).to_numpy(zero_copy_only=False)[take]  # noqa: E731
+    sold = get("ss_sold_date_sk")
+    ret_date = np.minimum(np.nan_to_num(sold, nan=_SK0) + rng.integers(1, 61, k),
+                          _SK0 + _DAYS - 1)
+    qty = get("ss_quantity")
+    net = np.nan_to_num(get("ss_net_paid"))
+    ret_qty = np.minimum(rng.integers(1, 101, k), qty).astype(np.int32)
+    amt = np.round(net * (ret_qty / np.maximum(qty, 1)), 2)
+    fee = np.round(rng.uniform(0.5, 100.0, k), 2)
+
+    def carry(c):
+        v = get(c)
+        if v.dtype.kind == "f":
+            return pa.array(np.where(np.isnan(v), 0, v).astype(np.int64),
+                            mask=np.isnan(v))
+        return pa.array(v.astype(np.int64))
+
+    return pa.table({
+        "sr_returned_date_sk": pa.array(
+            np.where(np.isnan(sold), 0, ret_date).astype(np.int64),
+            mask=np.isnan(sold)),
+        "sr_return_time_sk": pa.array(
+            rng.integers(0, 1440, k).astype(np.int64)),
+        "sr_item_sk": carry("ss_item_sk"),
+        "sr_customer_sk": carry("ss_customer_sk"),
+        "sr_cdemo_sk": carry("ss_cdemo_sk"),
+        "sr_hdemo_sk": carry("ss_hdemo_sk"),
+        "sr_addr_sk": carry("ss_addr_sk"),
+        "sr_store_sk": carry("ss_store_sk"),
+        "sr_reason_sk": _null_some(
+            rng, rng.integers(1, 11, k).astype(np.int64), 0.04),
+        "sr_ticket_number": carry("ss_ticket_number"),
+        "sr_return_quantity": pa.array(ret_qty),
+        "sr_return_amt": pa.array(amt),
+        "sr_return_tax": pa.array(np.round(amt * 0.08, 2)),
+        "sr_return_amt_inc_tax": pa.array(np.round(amt * 1.08, 2)),
+        "sr_fee": pa.array(fee),
+        "sr_refunded_cash": pa.array(
+            np.round(amt * rng.uniform(0.3, 1.0, k), 2)),
+        "sr_net_loss": pa.array(np.round(fee + amt * 0.1, 2)),
+    })
+
+
+def gen_inventory(scale: float, seed: int) -> pa.Table:
+    """Weekly per-item/warehouse snapshots over the whole calendar,
+    zero-inflated Poisson per-item rates (high-variance items matter for the
+    coefficient-of-variation and stock-window queries)."""
+    rng = np.random.default_rng(seed + 24)
+    items = min(n_item(scale), 300)
+    warehouses = n_warehouse(scale)
+    week_starts = np.arange(_SK0, _SK0 + _DAYS, 7, dtype=np.int64)
+    ii, ww, dd = np.meshgrid(np.arange(1, items + 1, dtype=np.int64),
+                             np.arange(1, warehouses + 1, dtype=np.int64),
+                             week_starts, indexing="ij")
+    lam = np.exp(rng.uniform(np.log(0.3), np.log(300.0), items))
+    # the mid-price plant (gen_item's %25==7 band) keeps steady three-digit
+    # stock so q37/q82's 100-500 on-hand window is populated
+    lam[np.arange(items) % 25 == 7] = 150.0
+    qty = rng.poisson(lam[ii.ravel() - 1]).astype(np.int32)
+    return pa.table({
+        "inv_date_sk": pa.array(dd.ravel()),
+        "inv_item_sk": pa.array(ii.ravel()),
+        "inv_warehouse_sk": pa.array(ww.ravel()),
+        "inv_quantity_on_hand": _null_some(rng, qty, 0.02),
+    })
+
+
 def gen_all(scale: float = 0.002, seed: int = 0) -> Dict[str, pa.Table]:
+    store_sales = gen_store_sales(scale, seed)
+    store_returns = gen_store_returns(scale, seed, store_sales)
+    # every 3rd store return re-buys the item from the catalog afterwards
+    # (the bought/returned/bought-again chains q25/q29 join on)
+    cust = store_returns.column("sr_customer_sk").to_numpy(
+        zero_copy_only=False)
+    rdate = store_returns.column("sr_returned_date_sk").to_numpy(
+        zero_copy_only=False)
+    item = store_returns.column("sr_item_sk").to_numpy(zero_copy_only=False)
+    ok = np.flatnonzero(~np.isnan(cust) & ~np.isnan(rdate))[::3]
+    catalog_sales = gen_catalog_sales(
+        scale, seed,
+        replay=(cust[ok], item[ok], rdate[ok]))
+    web_sales = gen_web_sales_ds(scale, seed)
     return {
         "date_dim": gen_date_dim(),
         "time_dim": gen_time_dim(),
@@ -315,5 +766,19 @@ def gen_all(scale: float = 0.002, seed: int = 0) -> Dict[str, pa.Table]:
         "household_demographics": gen_household_demographics(),
         "store": gen_store(scale, seed),
         "promotion": gen_promotion(scale, seed),
-        "store_sales": gen_store_sales(scale, seed),
+        "warehouse": gen_warehouse(scale, seed),
+        "web_site": gen_web_site(scale, seed),
+        "web_page": gen_web_page(scale, seed),
+        "call_center": gen_call_center(scale, seed),
+        "catalog_page": gen_catalog_page(scale, seed),
+        "ship_mode": gen_ship_mode(),
+        "reason": gen_reason(),
+        "income_band": gen_income_band(),
+        "store_sales": store_sales,
+        "store_returns": store_returns,
+        "catalog_sales": catalog_sales,
+        "catalog_returns": gen_catalog_returns(scale, seed, catalog_sales),
+        "web_sales": web_sales,
+        "web_returns": gen_web_returns_ds(scale, seed, web_sales),
+        "inventory": gen_inventory(scale, seed),
     }
