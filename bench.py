@@ -54,7 +54,11 @@ def _bench_tpcxbb(scale: float, qname: str):
 
 def main() -> None:
     suite = os.environ.get("BENCH_SUITE", "tpch")
-    scale = float(os.environ.get("BENCH_SCALE", "0.05"))
+    # tpch default: 6M lineitem rows — large enough that per-dispatch link
+    # latency amortizes and the device's throughput advantage over the eager
+    # CPU engine shows. The tpcxbb tables stay small (19-table multi-join).
+    default_scale = "1.0" if suite == "tpch" else "0.05"
+    scale = float(os.environ.get("BENCH_SCALE", default_scale))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
 
     if suite == "tpch":

@@ -107,6 +107,17 @@ IMPROVED_FLOAT_OPS = _conf(
     "sql.improvedFloatOps.enabled", bool, False,
     "Enable float ops (e.g. string cast of floats) that do not match Spark bit-for-bit.")
 
+SCAN_CACHE_ENABLED = _conf(
+    "sql.scanCache.enabled", bool, True,
+    "Keep device copies of scanned in-memory tables across actions, so repeated queries "
+    "over the same DataFrame skip the host-to-device upload (device-tier analog of the "
+    "RapidsBufferCatalog's cached batches).")
+
+SCAN_CACHE_BYTES = _conf(
+    "sql.scanCache.maxBytes", int, 2 << 30,
+    "Upper bound on device bytes held by the scan cache; least-recently-used tables are "
+    "evicted past it.")
+
 ENABLE_CAST_FLOAT_TO_STRING = _conf(
     "sql.castFloatToString.enabled", bool, False,
     "Cast float/double to string on the TPU; formatting may differ from Java in corner "

@@ -120,7 +120,11 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
 
 # ---------------------------------------------------------------- transitions
 class HostToDeviceExec(PhysicalExec):
-    """Upload transition (GpuRowToColumnarExec / HostColumnarToGpu analog)."""
+    """Upload transition (GpuRowToColumnarExec / HostColumnarToGpu analog).
+
+    Directly over an in-memory scan, the upload is cached across actions
+    (scan_cache) so repeated queries on the same DataFrame skip the
+    host->device transfer."""
 
     is_device = True
 
@@ -128,7 +132,25 @@ class HostToDeviceExec(PhysicalExec):
         super().__init__((child,), child.output)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        for hb in self.children[0].execute(ctx):
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.execs.cpu_execs import CpuLocalScanExec
+        child = self.children[0]
+        if (isinstance(child, CpuLocalScanExec)
+                and ctx.conf.get(cfg.SCAN_CACHE_ENABLED)):
+            if ctx.partition_id != 0:
+                return
+            from spark_rapids_tpu.memory.scan_cache import get_cache
+            cache = get_cache(ctx.conf.get(cfg.SCAN_CACHE_BYTES))
+            smax = ctx.string_max_bytes
+            b = cache.get(child.table, smax)
+            if b is None:
+                b = DeviceBatch.from_arrow(child.table, smax)
+                cache.put(child.table, smax, b)
+            child.count_output(b.num_rows)
+            self.count_output(b.num_rows)
+            yield b
+            return
+        for hb in child.execute(ctx):
             table = hb.to_arrow() if isinstance(hb, HostBatch) else hb
             b = DeviceBatch.from_arrow(table, ctx.string_max_bytes)
             self.count_output(b.num_rows)

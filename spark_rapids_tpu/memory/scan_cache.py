@@ -1,0 +1,86 @@
+"""Device-resident cache of scanned in-memory tables.
+
+Repeated actions over the same DataFrame re-run the whole physical plan,
+including the host->device upload of the scanned arrow table — by far the
+dominant cost on a remote-attached chip. This cache keeps the uploaded
+DeviceBatch alive across actions, keyed by the identity of the (immutable)
+arrow table, with LRU eviction over a device-byte budget.
+
+Reference analog: the device tier of the spillable buffer store
+(RapidsDeviceMemoryStore.scala / RapidsBufferCatalog.scala) which keeps hot
+columnar batches resident in device memory; this is its scan-side
+specialization (there is no JVM-side BlockManager here to hand buffers to).
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+
+class DeviceScanCache:
+    """LRU over (table identity, string width) -> DeviceBatch.
+
+    Identity is checked with a weakref to the arrow table: a dead or replaced
+    object at the same address can never produce a false hit, and a table
+    being garbage-collected drops its entry's bytes from the budget on the
+    next eviction sweep.
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        # key -> (weakref to table, DeviceBatch, nbytes)
+        self._entries: "OrderedDict[Tuple[int, int], tuple]" = OrderedDict()
+
+    def get(self, table, smax: int):
+        key = (id(table), smax)
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        ref, batch, _ = entry
+        if ref() is not table:  # address reused by a different table
+            del self._entries[key]
+            return None
+        self._entries.move_to_end(key)
+        return batch
+
+    def put(self, table, smax: int, batch) -> None:
+        try:
+            ref = weakref.ref(table)
+        except TypeError:  # object not weakref-able: skip caching
+            return
+        nbytes = batch.device_size_bytes
+        if nbytes > self.max_bytes:
+            return
+        self._entries[(id(table), smax)] = (ref, batch, nbytes)
+        self._evict()
+
+    def _evict(self) -> None:
+        # drop dead entries first, then LRU until under budget
+        for key in [k for k, (r, _, _) in self._entries.items() if r() is None]:
+            del self._entries[key]
+        while self._entries and self._total() > self.max_bytes:
+            self._entries.popitem(last=False)
+
+    def _total(self) -> int:
+        return sum(n for _, _, n in self._entries.values())
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+_cache: Optional[DeviceScanCache] = None
+
+
+def get_cache(max_bytes: int) -> DeviceScanCache:
+    """Process-wide cache (one device per process, like the executor-wide
+    device store); the budget follows the most recent session's conf. The
+    eviction sweep runs here too, so dead tables and budget shrinks are
+    reclaimed even on hit-only workloads."""
+    global _cache
+    if _cache is None:
+        _cache = DeviceScanCache(max_bytes)
+    else:
+        _cache.max_bytes = max_bytes
+        _cache._evict()
+    return _cache

@@ -1,0 +1,90 @@
+"""Device scan cache: repeated actions reuse the uploaded batch; identity,
+eviction and the disable conf behave as documented."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.memory.scan_cache import DeviceScanCache, get_cache
+
+
+def _table(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({"a": rng.integers(0, 10, n), "b": rng.random(n)})
+
+
+def test_repeated_collect_hits_cache():
+    t = _table()
+    sess = TpuSession({})
+    df = sess.create_dataframe(t).groupBy("a").agg(F.count().alias("s"))
+    r1 = df.collect()
+    cache = get_cache(2 << 30)
+    assert cache.get(t, sess.conf.string_max_bytes) is not None
+    before = cache.get(t, sess.conf.string_max_bytes)
+    r2 = df.collect()
+    after = cache.get(t, sess.conf.string_max_bytes)
+    assert before is after, "second action should reuse the cached upload"
+    assert r1.equals(r2)
+
+
+class FakeBatch:
+    def __init__(self, nbytes=0):
+        self.device_size_bytes = nbytes
+
+
+def test_identity_not_equality():
+    """A different table object never hits, even with equal contents."""
+    cache = DeviceScanCache(1 << 20)
+    t1, t2 = _table(seed=1), _table(seed=1)
+    cache.put(t1, 64, FakeBatch())
+    assert cache.get(t1, 64) is not None
+    assert cache.get(t2, 64) is None
+
+
+def test_eviction_by_budget():
+    cache = DeviceScanCache(100)
+    tables = [_table(n=2, seed=i) for i in range(4)]
+    for t in tables:
+        cache.put(t, 64, FakeBatch(40))
+    # 4 * 40 > 100: the two least-recently-used entries were evicted
+    assert cache.get(tables[0], 64) is None
+    assert cache.get(tables[1], 64) is None
+    assert cache.get(tables[2], 64) is not None
+    assert cache.get(tables[3], 64) is not None
+
+
+def test_oversized_entry_not_cached():
+    cache = DeviceScanCache(10)
+    t = _table(n=2)
+    cache.put(t, 64, FakeBatch(100))
+    assert cache.get(t, 64) is None
+
+
+def test_budget_shrink_evicts_on_get_cache():
+    from spark_rapids_tpu.memory import scan_cache as sc
+    cache = sc.get_cache(1000)
+    cache.clear()
+    t = _table(n=2, seed=42)
+    cache.put(t, 64, FakeBatch(500))
+    assert cache.get(t, 64) is not None
+    sc.get_cache(100)  # shrink budget -> sweep
+    assert cache.get(t, 64) is None
+    cache.clear()
+
+
+def test_disable_conf():
+    t = _table(seed=7)
+    sess = TpuSession({"spark.rapids.tpu.sql.scanCache.enabled": "false"})
+    df = sess.create_dataframe(t).agg(F.count().alias("s"))
+    df.collect()
+    cache = get_cache(2 << 30)
+    assert cache.get(t, sess.conf.string_max_bytes) is None
+
+
+def test_dead_table_entry_dropped():
+    cache = DeviceScanCache(1 << 20)
+    t = _table(n=3, seed=9)
+    cache.put(t, 64, FakeBatch())
+    del t
+    cache._evict()
+    assert not cache._entries
