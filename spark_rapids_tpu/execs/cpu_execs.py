@@ -137,14 +137,18 @@ class CpuFilterExec(PhysicalExec):
 
 class CpuHashAggregateExec(PhysicalExec):
     """Whole-input aggregation (single partition path; the partial/final split
-    rides the exchange exec)."""
+    rides the exchange exec). ``pre_filter`` is a fused upstream filter
+    predicate folded into the row mask (set by the device fusion pass; kept
+    on the CPU exec for constructor parity and fallback fidelity)."""
 
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...],  # Alias(AggregateFunction)
-                 child: PhysicalExec, output: Schema):
+                 child: PhysicalExec, output: Schema,
+                 pre_filter: Optional[Expression] = None):
         super().__init__((child,), output)
         self.grouping = grouping
         self.aggregates = aggregates
+        self.pre_filter = pre_filter
 
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         from spark_rapids_tpu.exprs.misc import Alias
@@ -164,8 +168,21 @@ class CpuHashAggregateExec(PhysicalExec):
         ectx = EvalCtx(np, colvs, cap, ctx.string_max_bytes)
         fns = [a.c if isinstance(a, Alias) else a for a in self.aggregates]
         with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
-            key_cols, res_cols, num_groups = group_aggregate(
-                np, ectx, self.grouping, fns, n, cap)
+            mask = None
+            if self.pre_filter is not None:
+                p = self.pre_filter.eval(ectx)
+                mask = np.logical_and(p.data, p.validity)
+                if mask.ndim == 0:
+                    mask = np.broadcast_to(mask, (cap,))
+            # hash-ordered grouping, exact-sort fallback on hash collision —
+            # the same two-step the device exec runs, so group output order
+            # is identical across engines
+            key_cols, res_cols, num_groups, collision = group_aggregate(
+                np, ectx, self.grouping, fns, n, cap, grouping="hash",
+                extra_mask=mask)
+            if bool(collision):
+                key_cols, res_cols, num_groups = group_aggregate(
+                    np, ectx, self.grouping, fns, n, cap, extra_mask=mask)
         out = _colvs_to_host(self.output, list(key_cols) + list(res_cols),
                              int(num_groups))
         self.count_output(out.num_rows)

@@ -245,14 +245,20 @@ class TpuFilterExec(PhysicalExec):
 
 
 class TpuHashAggregateExec(PhysicalExec):
+    """Grouped aggregation; may carry a fused upstream filter predicate
+    (``pre_filter``) folded into the alive-mask, so the filtered rows never
+    materialize (the whole-stage-fusion analog of Spark's codegen collapsing
+    Filter into HashAggregate)."""
+
     is_device = True
 
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...], child: PhysicalExec,
-                 output: Schema):
+                 output: Schema, pre_filter: Optional[Expression] = None):
         super().__init__((child,), output)
         self.grouping = grouping
         self.aggregates = aggregates
+        self.pre_filter = pre_filter
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         child_batches = list(self.children[0].execute(ctx))
@@ -261,23 +267,46 @@ class TpuHashAggregateExec(PhysicalExec):
         cap = batch.capacity
         schema = self.children[0].output
         fns = tuple(a.c if isinstance(a, Alias) else a for a in self.aggregates)
-        key = ("agg", self.grouping, fns, schema, cap, ctx.string_max_bytes)
 
-        def build(grouping=self.grouping, fns=fns, schema=schema, cap=cap,
-                  smax=ctx.string_max_bytes):
-            def fn(num_rows, *flat):
-                colvs = _unflatten_colvs(schema, flat)
-                ectx = EvalCtx(jnp, colvs, cap, smax)
-                key_cols, res_cols, num_groups = group_aggregate(
-                    jnp, ectx, grouping, fns, num_rows, cap)
-                return tuple(_flatten_colvs(list(key_cols) + list(res_cols))) + (
-                    num_groups,)
-            return fn
+        def build(mode):
+            def make(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
+                     smax=ctx.string_max_bytes, mode=mode,
+                     pre=self.pre_filter):
+                def fn(num_rows, *flat):
+                    colvs = _unflatten_colvs(schema, flat)
+                    ectx = EvalCtx(jnp, colvs, cap, smax)
+                    mask = None
+                    if pre is not None:
+                        p = pre.eval(ectx)
+                        mask = jnp.logical_and(p.data, p.validity)
+                        if mask.ndim == 0:
+                            mask = jnp.broadcast_to(mask, (cap,))
+                    res = group_aggregate(jnp, ectx, keys_, fns, num_rows,
+                                          cap, grouping=mode,
+                                          extra_mask=mask)
+                    key_cols, res_cols, num_groups = res[:3]
+                    tail = ((num_groups, res[3]) if mode == "hash"
+                            else (num_groups,))
+                    return tuple(_flatten_colvs(
+                        list(key_cols) + list(res_cols))) + tail
+                return fn
+            return make
 
-        fn = _cached_jit(key, build)
+        # hash-ordered grouping first (one argsort over the key hash); the
+        # exact lexsort re-runs only on the astronomically rare 64-bit
+        # collision between distinct keys
+        key = ("agg", self.grouping, fns, self.pre_filter, schema, cap,
+               ctx.string_max_bytes)
+        fn = _cached_jit(key + ("hash",), build("hash"))
         res = fn(np.int32(batch.num_rows), *_flatten(batch))
-        n = int(res[-1])
-        out = _to_batch(self.output, res[:-1], n)
+        if self.grouping and bool(res[-1]):
+            fn = _cached_jit(key + ("sort",), build("sort"))
+            res = fn(np.int32(batch.num_rows), *_flatten(batch))
+            n = int(res[-1])
+            out = _to_batch(self.output, res[:-1], n)
+        else:
+            n = int(res[-2])
+            out = _to_batch(self.output, res[:-2], n)
         self.count_output(n)
         yield out
 

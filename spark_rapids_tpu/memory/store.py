@@ -207,7 +207,12 @@ class DeviceMemoryStore(BufferStore):
 
     def handle_oom(self, needed_bytes: int) -> int:
         """Reactive OOM recovery (DeviceMemoryEventHandler.onAllocFailure
-        analog): spill at least needed_bytes to the next tier."""
+        analog): drop the scan cache's device copies first (they are pure
+        re-uploadable caches), then spill at least needed_bytes to the next
+        tier."""
+        from spark_rapids_tpu.memory import scan_cache
+        if scan_cache._cache is not None:
+            scan_cache._cache.clear()
         with self._lock:
             target = max(self._used - needed_bytes, 0)
         return self.spill_to_size(target)

@@ -23,7 +23,8 @@ from spark_rapids_tpu.ops import batch_kernels as bk
 
 
 def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunction],
-                    num_rows, capacity: int, evaluate: bool = True):
+                    num_rows, capacity: int, evaluate: bool = True,
+                    grouping: str = "sort", extra_mask=None):
     """Full grouped aggregation over one batch.
 
     Returns (key_cols, result_cols, num_groups): reduced key columns, final
@@ -35,21 +36,41 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
     GpuHashAggregateExec (aggregate.scala modes Partial/Final): result_cols are
     the reduced aggregation BUFFERS (flattened across fns) rather than final
     values, ready for ``merge_aggregate`` after an exchange/all-gather.
+
+    ``grouping="hash"`` orders rows by a 64-bit key hash (one argsort) instead
+    of the exact multi-key lexsort and returns a 4th value: a traced collision
+    flag. When it is True two distinct keys shared a hash and the result may
+    have split groups — the caller must re-run with grouping="sort".
+
+    ``extra_mask`` excludes rows (a fused upstream filter predicate): a masked
+    row participates in no group, exactly as if it had been compacted away.
     """
     alive = bk.alive_mask(xp, capacity, num_rows)
+    if extra_mask is not None:
+        alive = xp.logical_and(alive, extra_mask)
 
-    keys = [e.eval(ctx) for e in key_exprs]
+    # scalar keys/buffers (literals, e.g. after project inlining) broadcast to
+    # full columns so the grouping kernels can index them
+    keys = [bk.as_column(xp, e.eval(ctx), capacity) for e in key_exprs]
     # padding rows must not merge with null-key groups: mask handled via `alive`
     projections: List[List[ColV]] = []
     for fn in agg_fns:
-        bufs = fn.project(ctx)
+        bufs = [bk.as_column(xp, b, capacity) for b in fn.project(ctx)]
         # padding rows never contribute
         projections.append([b.with_validity(xp.logical_and(b.validity, alive))
                             for b in bufs])
 
+    collision = xp.asarray(False)
+    out_cap = capacity
     if keys:
-        order = bk.sort_indices(xp, [(k, True, True) for k in keys], alive)
+        if grouping == "hash":
+            order, hashes = bk.hash_group_order(xp, keys, alive)
+        else:
+            order = bk.sort_indices(xp, [(k, True, True) for k in keys], alive)
         starts = bk.rows_equal_adjacent(xp, keys, order, alive)
+        if grouping == "hash":
+            collision = bk.detect_hash_collision(xp, hashes, order, starts,
+                                                 alive)
         gids = xp.cumsum(starts.astype(np.int32)) - 1
         gids = xp.clip(gids, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
@@ -70,11 +91,21 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
         sorted_keys = []
         sorted_projs = projections
 
-    key_cols, reduced_per_fn = _reduce_phase(
-        xp, sorted_keys, list(zip(agg_fns, sorted_projs)), gids, capacity,
-        sorted_alive)
+    if keys and grouping == "hash":
+        # bounded group space: boundary-scan reduction emits GROUP_CAP-sized
+        # outputs; more groups than that re-runs through the exact sort path
+        # (flagged exactly like a hash collision)
+        out_cap = min(capacity, GROUP_CAP)
+        collision = xp.logical_or(collision, num_groups > out_cap)
+        key_cols, reduced_per_fn = _reduce_phase_scan(
+            xp, sorted_keys, list(zip(agg_fns, sorted_projs)), gids,
+            num_groups, capacity, out_cap, sorted_alive)
+    else:
+        key_cols, reduced_per_fn = _reduce_phase(
+            xp, sorted_keys, list(zip(agg_fns, sorted_projs)), gids, capacity,
+            sorted_alive)
 
-    group_alive = xp.arange(capacity, dtype=np.int32) < num_groups
+    group_alive = xp.arange(out_cap, dtype=np.int32) < num_groups
     result_cols = []
     for fn, reduced in zip(agg_fns, reduced_per_fn):
         if evaluate:
@@ -88,7 +119,110 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
 
     key_cols = [k.with_validity(xp.logical_and(k.validity, group_alive))
                 for k in key_cols]
+    if grouping == "hash":
+        return key_cols, result_cols, num_groups, collision
     return key_cols, result_cols, num_groups
+
+
+#: static group-space bound of the boundary-scan reduction; queries producing
+#: more groups re-run through the exact sort path
+GROUP_CAP = 65536
+
+
+def _reduce_phase_scan(xp, sorted_keys, fn_bufs, gids, num_groups,
+                       capacity: int, out_cap: int, sorted_alive):
+    """Boundary-scan reduction over hash-ordered rows.
+
+    TPU scatters cost ~100ns/row regardless of the segment space, while
+    cumsum and gathers run at memory bandwidth. With rows sorted by group,
+    INTEGER sums/counts reduce as cumsum differences at the group boundaries
+    (found with two searchsorted calls over the non-decreasing gids —
+    wrapping int arithmetic keeps them exact through any cumsum overflow) and
+    first/last/keys are single gathers at the boundary rows. FLOAT sums must
+    not use cumsum differences: the accumulator mixes other groups' values,
+    so a group that cancels to exactly 0.0 picks up an epsilon residue and
+    flips predicates like `HAVING sum(x) > 0` — they go through the stacked
+    scatter instead (one scatter per dtype, shared with min/max)."""
+    g = xp.arange(out_cap, dtype=np.int32)
+    start_pos = xp.searchsorted(gids, g, side="left")
+    end_pos = xp.searchsorted(gids, g, side="right") - 1
+    # dead rows keep the final gid: clamp the last group's end to alive rows
+    n_alive = xp.sum(sorted_alive).astype(np.int32)
+    end_pos = xp.minimum(end_pos, xp.maximum(n_alive - 1, 0))
+    has = g < num_groups
+    start_c = xp.clip(start_pos, 0, capacity - 1).astype(np.int32)
+    end_c = xp.clip(end_pos, 0, capacity - 1).astype(np.int32)
+    gids_b = xp.minimum(gids, np.int32(out_cap - 1))
+
+    key_cols = [_gather_key(xp, k, start_c, has) for k in sorted_keys]
+
+    def seg_sum(contrib):
+        c = xp.cumsum(contrib)
+        tail = c[end_c]
+        head = xp.where(start_c > 0, c[xp.clip(start_c - 1, 0, capacity - 1)],
+                        xp.zeros_like(tail))
+        return tail - head
+
+    stacker = (bk.SegmentStacker(xp, gids_b, out_cap) if xp is not np
+               else None)
+    thunk_lists = []
+    for fn, bufs in fn_bufs:
+        thunks = []
+        for spec, b in zip(fn.buffer_specs(), bufs):
+            if b.dtype is DType.STRING and spec.kind in ("min", "max"):
+                thunks.append(lambda b=b, spec=spec: _segment_minmax_string(
+                    xp, b, gids_b, out_cap, spec.kind, sorted_alive))
+            elif spec.kind in ("first", "last") and spec.ignore_nulls:
+                def pick(b=b, spec=spec):
+                    p2, h2 = bk.segment_pick(xp, b.validity, gids_b, out_cap,
+                                             spec.kind, alive=sorted_alive,
+                                             ignore_nulls=True)
+                    valid = xp.logical_and(h2, b.validity[p2])
+                    return bk.take_colv(xp, b, p2).with_validity(valid)
+                thunks.append(pick)
+            elif spec.kind in ("first", "last"):
+                pos = start_c if spec.kind == "first" else end_c
+                thunks.append(lambda b=b, pos=pos: bk.take_colv(xp, b, pos)
+                              .with_validity(xp.logical_and(has,
+                                                            b.validity[pos])))
+            elif spec.kind == "sum" and not np.issubdtype(
+                    np.dtype(b.data.dtype), np.floating):
+                def int_sum(b=b):
+                    contrib = xp.where(b.validity, b.data,
+                                       0).astype(b.data.dtype)
+                    s = seg_sum(contrib)
+                    cnt = seg_sum(b.validity.astype(np.int32))
+                    return ColV(b.dtype, s, cnt > 0)
+                thunks.append(int_sum)
+            elif spec.kind == "sum":  # float: scatter, stacked on device
+                if stacker is not None:
+                    contrib = xp.where(b.validity, b.data,
+                                       0).astype(b.data.dtype)
+                    h = stacker.add("sum", contrib)
+                    hc = stacker.add("sum", b.validity.astype(np.int32))
+                    thunks.append(lambda b=b, h=h, hc=hc: ColV(
+                        b.dtype, stacker.get(h), stacker.get(hc) > 0))
+                else:
+                    def np_sum(b=b):
+                        data, valid = bk.segment_reduce(
+                            xp, b.data, b.validity, gids_b, out_cap, "sum")
+                        return ColV(b.dtype, data, valid)
+                    thunks.append(np_sum)
+            else:  # numeric/bool min-max
+                if stacker is not None:
+                    thunks.append(_register_minmax(xp, b, spec.kind, stacker))
+                else:
+                    def np_mm(b=b, spec=spec):
+                        data, valid = bk.segment_reduce(
+                            xp, b.data, b.validity, gids_b, out_cap,
+                            spec.kind)
+                        return ColV(b.dtype, data, valid)
+                    thunks.append(np_mm)
+        thunk_lists.append(thunks)
+    if stacker is not None and stacker._buckets:
+        stacker.run()
+    reduced = [[t() for t in thunks] for thunks in thunk_lists]
+    return key_cols, reduced
 
 
 def _reduce_phase(xp, sorted_keys, fn_bufs, gids, capacity: int, sorted_alive):
@@ -257,14 +391,16 @@ def _register_minmax(xp, b: ColV, kind: str, stacker: "bk.SegmentStacker"):
 
 
 def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
-                    agg_fns: Sequence[AggregateFunction], num_rows, capacity: int):
+                    agg_fns: Sequence[AggregateFunction], num_rows, capacity: int,
+                    grouping: str = "sort"):
     """Final mode: merge partially-aggregated buffers (after an exchange or
     all-gather) — group by keys again, combine each buffer with its own
     reduction kind (sum-of-sums, min-of-mins, first-of-firsts...), then run each
     aggregate's evaluate() (aggregate.scala Final/PartialMerge analog).
 
     buffer_cols: the flattened partial buffers as produced by
-    group_aggregate(evaluate=False). Returns (key_cols, result_cols, num_groups).
+    group_aggregate(evaluate=False). Returns (key_cols, result_cols, num_groups),
+    plus the collision flag when grouping="hash" (see group_aggregate).
     """
     alive = bk.alive_mask(xp, capacity, num_rows)
     key_cols = [k.with_validity(xp.logical_and(k.validity, alive))
@@ -272,9 +408,17 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
     buffer_cols = [b.with_validity(xp.logical_and(b.validity, alive))
                    for b in buffer_cols]
 
+    collision = xp.asarray(False)
     if key_cols:
-        order = bk.sort_indices(xp, [(k, True, True) for k in key_cols], alive)
+        if grouping == "hash":
+            order, hashes = bk.hash_group_order(xp, key_cols, alive)
+        else:
+            order = bk.sort_indices(xp, [(k, True, True) for k in key_cols],
+                                    alive)
         starts = bk.rows_equal_adjacent(xp, key_cols, order, alive)
+        if grouping == "hash":
+            collision = bk.detect_hash_collision(xp, hashes, order, starts,
+                                                 alive)
         gids = xp.clip(xp.cumsum(starts.astype(np.int32)) - 1, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
         sorted_alive = alive[order]
@@ -306,4 +450,6 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
 
     out_keys = [k.with_validity(xp.logical_and(k.validity, group_alive))
                 for k in out_keys]
+    if grouping == "hash":
+        return out_keys, result_cols, num_groups, collision
     return out_keys, result_cols, num_groups

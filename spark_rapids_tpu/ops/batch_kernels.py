@@ -30,6 +30,128 @@ def _stable_argsort(xp, keys):
     return xp.argsort(keys, stable=True)
 
 
+# ---------------------------------------------------------------------------
+# 64-bit row hashing (the grouping fast path's sort key)
+# ---------------------------------------------------------------------------
+_HSEED = np.uint64(0x243F6A8885A308D3)
+_HNULL = np.uint64(0x452821E638D01377)
+_HGOLD = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(xp, z):
+    """splitmix64 finalizer (wrapping uint64 arithmetic)."""
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def _hash64_col(xp, v: ColV):
+    """Per-row 64-bit hash of one column; equal keys (Spark grouping
+    semantics: null==null, NaN==NaN, -0.0==0.0) hash equal."""
+    if v.dtype is DType.STRING:
+        W = v.data.shape[-1]
+        # pack each 8-byte chunk into a uint64 (injective) and mix it through
+        # splitmix64 with a per-chunk offset before combining — a linear
+        # base-31 fold has structured everyday collisions ("Aa" == "BB")
+        # that would permanently defeat the hash fast path
+        pad = (-W) % 8
+        data = v.data
+        if pad:
+            data = xp.concatenate(
+                [data, xp.zeros(data.shape[:-1] + (pad,), dtype=np.uint8)],
+                axis=-1)
+        shifts = xp.asarray((np.arange(7, -1, -1) * 8).astype(np.uint64))
+        chunks = data.reshape(data.shape[:-1] + (-1, 8)).astype(np.uint64)
+        words = xp.sum(chunks << shifts, axis=-1)           # [n, W/8]
+        n_words = words.shape[-1]
+        bits = v.lengths.astype(np.uint64)
+        for i in range(n_words):
+            bits = _mix64(xp, bits ^ _mix64(xp, words[..., i]
+                                            + np.uint64(i + 1) * _HGOLD))
+    elif v.dtype.is_floating:
+        from spark_rapids_tpu.shims import get as _shims
+        d = v.data
+        # canonicalize -0.0 and NaN so equal-under-grouping values share bits
+        d = xp.where(d == 0, xp.zeros_like(d), d)
+        nan = xp.isnan(d)
+        d = xp.where(nan, xp.ones_like(d), d)
+        if xp is np:
+            bits = d.astype(np.float64).view(np.uint64)
+        else:
+            bits = _shims().bitcast(d.astype(np.float64), np.uint64)
+        bits = xp.where(nan, xp.full_like(bits, np.uint64(0x7FF8000000000000)),
+                        bits)
+    elif v.dtype is DType.BOOLEAN:
+        bits = v.data.astype(np.uint64)
+    else:
+        bits = v.data.astype(np.int64).astype(np.uint64)
+    h = _mix64(xp, bits + _HGOLD)
+    return xp.where(v.validity, h, _HNULL)
+
+
+def hash64_cols(xp, cols: Sequence[ColV]):
+    """Combined 64-bit row hash over the key columns."""
+    n = cols[0].validity.shape[0]
+    h = xp.full((n,), _HSEED, dtype=np.uint64)
+    for v in cols:
+        h = _mix64(xp, (h ^ _hash64_col(xp, v)) * _HGOLD + _HGOLD)
+    return h
+
+
+def hash_group_order(xp, keys: Sequence[ColV], alive_or_n):
+    """Grouping fast path: one stable argsort over the 64-bit key hash instead
+    of a full multi-key lexsort (string keys make the exact sort especially
+    expensive: their order needs rank sub-sorts). Equal keys land contiguous
+    (equal hash + stable order); boundaries still come from exact key
+    comparison (rows_equal_adjacent), so the only hazard is two DIFFERENT keys
+    sharing a hash — detect_hash_collision flags that and callers fall back to
+    the exact sort. Returns (order, hashes)."""
+    cap = keys[0].validity.shape[0]
+    alive = alive_mask(xp, cap, alive_or_n)
+    h = hash64_cols(xp, keys)
+    # dead rows sort last: their key is the max uint64, unreachable by h >> 1
+    sort_key = xp.where(alive, h >> np.uint64(1),
+                        np.uint64(0xFFFFFFFFFFFFFFFF))
+    order = _stable_argsort(xp, sort_key)
+    return order, h
+
+
+def detect_hash_collision(xp, hashes, order, starts, alive_or_n):
+    """True when any group boundary separates two alive rows with the SAME
+    sort key — i.e. two distinct keys collided. (A run holding two distinct
+    keys always has an adjacent differing pair, so the adjacent check is
+    sufficient to detect every split-group hazard.) Rows sort by h >> 1, so
+    the comparison must use the same shifted key: hashes differing only in
+    the lowest bit still interleave in sort order."""
+    cap = order.shape[0]
+    alive = alive_mask(xp, cap, alive_or_n)
+    hs = hashes[order] >> np.uint64(1)
+    prev_h = xp.concatenate([hs[:1], hs[:-1]])
+    a = alive[order]
+    prev_a = xp.concatenate([xp.zeros(1, dtype=bool), a[:-1]])
+    return xp.any(xp.logical_and(
+        xp.logical_and(starts, hs == prev_h),
+        xp.logical_and(a, prev_a)))
+
+
+def as_column(xp, v: ColV, capacity: int) -> ColV:
+    """Broadcast a scalar ColV (a literal, e.g. after project inlining) to a
+    full column so row-wise kernels can index it."""
+    scalar = (v.data.ndim == 1 if v.dtype is DType.STRING
+              else v.data.ndim == 0)
+    if not scalar:
+        return v
+    if v.dtype is DType.STRING:
+        W = v.data.shape[-1]
+        data = xp.broadcast_to(xp.reshape(v.data, (1, W)), (capacity, W))
+        lengths = xp.broadcast_to(xp.reshape(v.lengths, (1,)), (capacity,))
+    else:
+        data = xp.broadcast_to(xp.reshape(v.data, (1,)), (capacity,))
+        lengths = None
+    validity = xp.broadcast_to(xp.reshape(v.validity, (1,)), (capacity,))
+    return ColV(v.dtype, data, validity, lengths)
+
+
 def take_colv(xp, v: ColV, indices) -> ColV:
     """Permute/gather rows of a column."""
     if v.dtype is DType.STRING:

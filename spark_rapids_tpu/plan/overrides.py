@@ -574,7 +574,65 @@ class TpuOverrides:
                 if "cannot run on TPU" in line or "because" in line:
                     print(line)
         converted = meta.convert_if_needed()
-        return insert_transitions(converted)
+        return insert_transitions(fuse_device_ops(converted))
+
+
+def _substitute_refs(e: Expression, repl) -> Expression:
+    from spark_rapids_tpu.exprs.core import BoundReference
+    if isinstance(e, BoundReference):
+        return repl[e.ordinal]
+    return e.map_children(lambda c: _substitute_refs(c, repl))
+
+
+def _has_nondeterministic(e: Expression) -> bool:
+    from spark_rapids_tpu.exprs.misc import MonotonicallyIncreasingID, Rand
+    if isinstance(e, (Rand, MonotonicallyIncreasingID)):
+        return True
+    return any(_has_nondeterministic(c) for c in e.children)
+
+
+def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
+    """Collapse Filter/Project chains into the device aggregation above them
+    (the whole-stage-fusion analog of Spark codegen collapsing these into one
+    stage): the filter predicate folds into the aggregation's alive-mask and
+    project expressions inline into the aggregate/grouping expressions, so
+    the filtered/projected intermediate never materializes (on TPU that
+    removes a full compact — mask argsort + gathers of every column)."""
+    from spark_rapids_tpu.exprs.misc import Alias
+    from spark_rapids_tpu.exprs.predicates import And
+
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        if not isinstance(node, te.TpuHashAggregateExec):
+            return node
+        grouping, aggs, pre = node.grouping, node.aggregates, node.pre_filter
+        child = node.children[0]
+        changed = False
+        while True:
+            if isinstance(child, te.TpuFilterExec):
+                cond = child.condition
+                pre = cond if pre is None else And(cond, pre)
+                child = child.children[0]
+                changed = True
+                continue
+            if isinstance(child, te.TpuProjectExec):
+                repl = [a.c if isinstance(a, Alias) else a
+                        for a in child.exprs]
+                if any(_has_nondeterministic(r) for r in repl):
+                    break
+                grouping = tuple(_substitute_refs(g, repl) for g in grouping)
+                aggs = tuple(_substitute_refs(a, repl) for a in aggs)
+                if pre is not None:
+                    pre = _substitute_refs(pre, repl)
+                child = child.children[0]
+                changed = True
+                continue
+            break
+        if changed:
+            return te.TpuHashAggregateExec(grouping, aggs, child, node.output,
+                                           pre_filter=pre)
+        return node
+
+    return plan.transform_up(fix)
 
 
 def insert_transitions(plan: PhysicalExec) -> PhysicalExec:

@@ -12,7 +12,8 @@ def test_q1_matches_cpu():
         lambda s: q1(s.create_dataframe(t)),
         conf=BENCH_CONF,
         approx_float=1e-12,
-        expect_tpu_execs=["TpuHashAggregateExec", "TpuFilterExec", "TpuSortExec"])
+        # the filter fuses into the aggregation's alive-mask
+        expect_tpu_execs=["TpuHashAggregateExec", "TpuSortExec"])
 
 
 def test_q6_matches_cpu():
@@ -21,4 +22,4 @@ def test_q6_matches_cpu():
         lambda s: q6(s.create_dataframe(t)),
         conf=BENCH_CONF,
         approx_float=1e-12,
-        expect_tpu_execs=["TpuHashAggregateExec", "TpuFilterExec"])
+        expect_tpu_execs=["TpuHashAggregateExec"])

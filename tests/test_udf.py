@@ -141,7 +141,9 @@ def test_udf_in_filter_and_agg_pipeline():
     # evens are 2 (g=y) and 4 (g=y)
     assert out.column("g").to_pylist() == ["y"]
     assert out.column("sa").to_pylist() == [6]
-    assert "TpuFilterExec" in s.last_plan.tree_string()
+    # the compiled-UDF filter fuses into the device aggregation
+    assert "TpuHashAggregateExec" in s.last_plan.tree_string()
+    assert "CpuFilterExec" not in s.last_plan.tree_string()
 
 
 def test_uncompilable_falls_back():
