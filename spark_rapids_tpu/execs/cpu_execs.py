@@ -138,8 +138,8 @@ class CpuFilterExec(PhysicalExec):
 class CpuHashAggregateExec(PhysicalExec):
     """Whole-input aggregation (single partition path; the partial/final split
     rides the exchange exec). ``pre_filter`` is a fused upstream filter
-    predicate folded into the row mask (set by the device fusion pass; kept
-    on the CPU exec for constructor parity and fallback fidelity)."""
+    predicate folded into the row mask (set by fuse_device_ops for CPU
+    aggregations inside a TPU-enabled session's plan)."""
 
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...],  # Alias(AggregateFunction)

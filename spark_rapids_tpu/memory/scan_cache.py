@@ -13,6 +13,7 @@ specialization (there is no JVM-side BlockManager here to hand buffers to).
 """
 from __future__ import annotations
 
+import threading
 import weakref
 from collections import OrderedDict
 from typing import Optional, Tuple
@@ -24,25 +25,28 @@ class DeviceScanCache:
     Identity is checked with a weakref to the arrow table: a dead or replaced
     object at the same address can never produce a false hit, and a table
     being garbage-collected drops its entry's bytes from the budget on the
-    next eviction sweep.
+    next eviction sweep. All operations lock: the OOM recovery path clears
+    the cache from whatever thread hit the allocation failure.
     """
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
+        self._lock = threading.Lock()
         # key -> (weakref to table, DeviceBatch, nbytes)
         self._entries: "OrderedDict[Tuple[int, int], tuple]" = OrderedDict()
 
     def get(self, table, smax: int):
         key = (id(table), smax)
-        entry = self._entries.get(key)
-        if entry is None:
-            return None
-        ref, batch, _ = entry
-        if ref() is not table:  # address reused by a different table
-            del self._entries[key]
-            return None
-        self._entries.move_to_end(key)
-        return batch
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            ref, batch, _ = entry
+            if ref() is not table:  # address reused by a different table
+                del self._entries[key]
+                return None
+            self._entries.move_to_end(key)
+            return batch
 
     def put(self, table, smax: int, batch) -> None:
         try:
@@ -52,12 +56,18 @@ class DeviceScanCache:
         nbytes = batch.device_size_bytes
         if nbytes > self.max_bytes:
             return
-        self._entries[(id(table), smax)] = (ref, batch, nbytes)
-        self._evict()
+        with self._lock:
+            self._entries[(id(table), smax)] = (ref, batch, nbytes)
+            self._evict_locked()
 
     def _evict(self) -> None:
+        with self._lock:
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
         # drop dead entries first, then LRU until under budget
-        for key in [k for k, (r, _, _) in self._entries.items() if r() is None]:
+        for key in [k for k, (r, _, _) in self._entries.items()
+                    if r() is None]:
             del self._entries[key]
         while self._entries and self._total() > self.max_bytes:
             self._entries.popitem(last=False)
@@ -66,7 +76,8 @@ class DeviceScanCache:
         return sum(n for _, _, n in self._entries.values())
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
 
 _cache: Optional[DeviceScanCache] = None

@@ -391,16 +391,16 @@ def _register_minmax(xp, b: ColV, kind: str, stacker: "bk.SegmentStacker"):
 
 
 def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
-                    agg_fns: Sequence[AggregateFunction], num_rows, capacity: int,
-                    grouping: str = "sort"):
+                    agg_fns: Sequence[AggregateFunction], num_rows, capacity: int):
     """Final mode: merge partially-aggregated buffers (after an exchange or
     all-gather) — group by keys again, combine each buffer with its own
     reduction kind (sum-of-sums, min-of-mins, first-of-firsts...), then run each
     aggregate's evaluate() (aggregate.scala Final/PartialMerge analog).
 
     buffer_cols: the flattened partial buffers as produced by
-    group_aggregate(evaluate=False). Returns (key_cols, result_cols, num_groups),
-    plus the collision flag when grouping="hash" (see group_aggregate).
+    group_aggregate(evaluate=False). Returns (key_cols, result_cols, num_groups).
+    Always uses the exact sort ordering: inputs here are already-reduced
+    partials (tiny), so the hash fast path has nothing to win.
     """
     alive = bk.alive_mask(xp, capacity, num_rows)
     key_cols = [k.with_validity(xp.logical_and(k.validity, alive))
@@ -408,17 +408,10 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
     buffer_cols = [b.with_validity(xp.logical_and(b.validity, alive))
                    for b in buffer_cols]
 
-    collision = xp.asarray(False)
     if key_cols:
-        if grouping == "hash":
-            order, hashes = bk.hash_group_order(xp, key_cols, alive)
-        else:
-            order = bk.sort_indices(xp, [(k, True, True) for k in key_cols],
-                                    alive)
+        order = bk.sort_indices(xp, [(k, True, True) for k in key_cols],
+                                alive)
         starts = bk.rows_equal_adjacent(xp, key_cols, order, alive)
-        if grouping == "hash":
-            collision = bk.detect_hash_collision(xp, hashes, order, starts,
-                                                 alive)
         gids = xp.clip(xp.cumsum(starts.astype(np.int32)) - 1, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
         sorted_alive = alive[order]
@@ -450,6 +443,4 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
 
     out_keys = [k.with_validity(xp.logical_and(k.validity, group_alive))
                 for k in out_keys]
-    if grouping == "hash":
-        return out_keys, result_cols, num_groups, collision
     return out_keys, result_cols, num_groups

@@ -69,16 +69,35 @@ def _hash64_col(xp, v: ColV):
             bits = _mix64(xp, bits ^ _mix64(xp, words[..., i]
                                             + np.uint64(i + 1) * _HGOLD))
     elif v.dtype.is_floating:
-        from spark_rapids_tpu.shims import get as _shims
-        d = v.data
-        # canonicalize -0.0 and NaN so equal-under-grouping values share bits
-        d = xp.where(d == 0, xp.zeros_like(d), d)
+        # arithmetic mantissa/exponent decomposition — the TPU x64 emulation
+        # cannot compile an f64 bitcast, and both engines must use the SAME
+        # derivation so group output order matches across CPU and device.
+        # Exactness: ax / 2^floor(log2 ax) scales the exponent only, and
+        # m * 2^52 is an exact (even-above-2^53) integer, so equal floats get
+        # equal (mi, e) and distinct floats distinct ones even when the log2
+        # rounds the exponent estimate off by one.
+        d = v.data.astype(np.float64)
+        # not signbit(): it bitcasts f64 internally, which the TPU x64
+        # emulation cannot compile; -0.0 and NaN are canonicalized below
+        sign = d < 0
+        ax = xp.abs(d)
         nan = xp.isnan(d)
-        d = xp.where(nan, xp.ones_like(d), d)
-        if xp is np:
-            bits = d.astype(np.float64).view(np.uint64)
-        else:
-            bits = _shims().bitcast(d.astype(np.float64), np.uint64)
+        inf = xp.isinf(d)
+        finite_pos = xp.logical_and(ax > 0,
+                                    xp.logical_not(xp.logical_or(nan, inf)))
+        ax_safe = xp.where(finite_pos, ax, 1.0)
+        e = xp.floor(xp.log2(ax_safe))
+        mi = ((ax_safe / xp.exp2(e)) * np.float64(2 ** 52)).astype(np.int64)
+        bits = (mi.astype(np.uint64)
+                ^ _mix64(xp, e.astype(np.int64).astype(np.uint64) + _HGOLD)
+                ^ (xp.where(sign, np.uint64(1), np.uint64(0))
+                   << np.uint64(63)))
+        # canonical classes: +/-0.0 hash as one value, every NaN as one
+        # value, +/-inf as their own values (distinct from finite 1.0)
+        bits = xp.where(ax == 0, xp.full_like(bits, np.uint64(0)), bits)
+        bits = xp.where(inf, xp.full_like(bits, np.uint64(0x7FF0000000000000))
+                        ^ (xp.where(sign, np.uint64(1), np.uint64(0))
+                           << np.uint64(63)), bits)
         bits = xp.where(nan, xp.full_like(bits, np.uint64(0x7FF8000000000000)),
                         bits)
     elif v.dtype is DType.BOOLEAN:

@@ -601,20 +601,27 @@ def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
     from spark_rapids_tpu.exprs.misc import Alias
     from spark_rapids_tpu.exprs.predicates import And
 
+    shapes = {
+        te.TpuHashAggregateExec: (te.TpuFilterExec, te.TpuProjectExec),
+        ce.CpuHashAggregateExec: (ce.CpuFilterExec, ce.CpuProjectExec),
+    }
+
     def fix(node: PhysicalExec) -> PhysicalExec:
-        if not isinstance(node, te.TpuHashAggregateExec):
+        pair = shapes.get(type(node))
+        if pair is None:
             return node
+        filter_cls, project_cls = pair
         grouping, aggs, pre = node.grouping, node.aggregates, node.pre_filter
         child = node.children[0]
         changed = False
         while True:
-            if isinstance(child, te.TpuFilterExec):
+            if isinstance(child, filter_cls):
                 cond = child.condition
                 pre = cond if pre is None else And(cond, pre)
                 child = child.children[0]
                 changed = True
                 continue
-            if isinstance(child, te.TpuProjectExec):
+            if isinstance(child, project_cls):
                 repl = [a.c if isinstance(a, Alias) else a
                         for a in child.exprs]
                 if any(_has_nondeterministic(r) for r in repl):
@@ -628,8 +635,8 @@ def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
                 continue
             break
         if changed:
-            return te.TpuHashAggregateExec(grouping, aggs, child, node.output,
-                                           pre_filter=pre)
+            return type(node)(grouping, aggs, child, node.output,
+                              pre_filter=pre)
         return node
 
     return plan.transform_up(fix)

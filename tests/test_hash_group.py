@@ -63,6 +63,25 @@ def test_hash_string_no_structured_collisions():
         assert h[0] != h[1], (l, r)
 
 
+def test_float_hash_compiles_without_bitcast():
+    """The TPU x64 emulation cannot compile f64 bitcasts (signbit included);
+    the float hash must stay pure-arithmetic or it only breaks on hardware."""
+    import jax
+    import jax.numpy as jnp
+
+    def h(data, validity):
+        return bk.hash64_cols(jnp, [ColV(DType.DOUBLE, data, validity)])
+
+    jaxpr = str(jax.make_jaxpr(h)(np.array([1.5, -2.0, 0.0]),
+                                  np.array([True, True, False])))
+    assert "bitcast" not in jaxpr, jaxpr
+    # and parity: traced result equals the numpy path
+    out = jax.jit(h)(np.array([1.5, -2.0, 0.0]), np.array([True, True, False]))
+    ref = bk.hash64_cols(np, [ColV(DType.DOUBLE, np.array([1.5, -2.0, 0.0]),
+                                   np.array([True, True, False]))])
+    assert np.array_equal(np.asarray(out), ref)
+
+
 def test_collision_detected_and_order_correct():
     keys = [_colv([5, 7, 5, 7, 9, 5])]
     order, h = bk.hash_group_order(np, keys, 6)
