@@ -68,6 +68,13 @@ MARITAL = ["M", "S", "D", "W", "U"]
 CREDIT = ["Low Risk", "High Risk", "Good", "Unknown"]
 DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
              "Saturday", "Sunday"]
+COLORS = ["powder", "khaki", "brown", "honeydew", "floral", "deep", "light",
+          "cornflower", "midnight", "snow", "cyan", "papaya", "orange",
+          "frosted", "forest", "ghost", "red", "blue", "green", "white"]
+UNITS = ["Ounce", "Oz", "Bunch", "Ton", "N/A", "Dozen", "Box", "Pound",
+         "Pallet", "Gross", "Cup", "Dram", "Each", "Tbl", "Lb", "Bundle"]
+SIZES = ["medium", "extra large", "N/A", "small", "petite", "large",
+         "economy"]
 
 
 def n_item(scale): return max(int(18_000 * scale), 100)
@@ -104,10 +111,16 @@ def gen_date_dim() -> pa.Table:
 
 def gen_time_dim() -> pa.Table:
     sk = np.arange(1440, dtype=np.int64)  # one row per minute of day
+    hour = (sk // 60).astype(np.int32)
+    meal = np.where((hour >= 6) & (hour < 9), "breakfast",
+                    np.where((hour >= 11) & (hour < 13), "lunch",
+                             np.where((hour >= 17) & (hour < 20), "dinner",
+                                      "")))
     return pa.table({
         "t_time_sk": pa.array(sk),
-        "t_hour": pa.array((sk // 60).astype(np.int32)),
+        "t_hour": pa.array(hour),
         "t_minute": pa.array((sk % 60).astype(np.int32)),
+        "t_meal_time": pa.array(meal, mask=(meal == "")),
     })
 
 
@@ -127,6 +140,25 @@ def gen_item(scale: float, seed: int) -> pa.Table:
     brand = np.char.add(brand_bases[rng.integers(0, len(brand_bases), n)],
                         rng.integers(1, 16, n).astype(str))
     cls = np.array(CLASSES)[rng.integers(0, len(CLASSES), n)]
+    color = np.array(COLORS)[rng.integers(0, len(COLORS), n)]
+    units = np.array(UNITS)[rng.integers(0, len(UNITS), n)]
+    size = np.array(SIZES)[rng.integers(0, len(SIZES), n)]
+    # plant q41-style variant combos (category/color/units/size quadruples
+    # its predicate matches) on every 25th-offset-11 item
+    variant = [("Women", "powder", "Ounce", "medium"),
+               ("Women", "brown", "Bunch", "small"),
+               ("Men", "floral", "Dozen", "petite"),
+               ("Men", "light", "Box", "medium"),
+               ("Women", "midnight", "Pallet", "extra large"),
+               ("Men", "orange", "Each", "large")]
+    vplant = np.flatnonzero((sk - 1) % 25 == 11)
+    vwhich = np.arange(vplant.shape[0]) % len(variant)
+    vcat = np.array([v[0] for v in variant])[vwhich]
+    cat_id[vplant] = np.array([CATEGORIES.index(c) + 1 for c in vcat],
+                              np.int32)
+    color[vplant] = np.array([v[1] for v in variant])[vwhich]
+    units[vplant] = np.array([v[2] for v in variant])[vwhich]
+    size[vplant] = np.array([v[3] for v in variant])[vwhich]
     # plant every 10th item on a qualifying (category, class, brand) combo
     planted = np.flatnonzero((sk - 1) % 10 == 5)
     combo = [np.array([c[j] for c in _BRAND_COMBOS])
@@ -150,8 +182,11 @@ def gen_item(scale: float, seed: int) -> pa.Table:
         # cycle so the specific ids queries filter on (manufact 128, manager
         # 1/8/28) exist at any generated item count
         "i_manufact_id": pa.array(((sk - 1) % 1000 + 1).astype(np.int32)),
-        "i_manufact": pa.array(np.char.add("manufact#",
-                                           rng.integers(1, 1001, n).astype(str))),
+        "i_manufact": pa.array(np.char.add(
+            "manufact#", ((sk - 1) % 50 + 1).astype(str))),
+        "i_color": pa.array(color),
+        "i_units": pa.array(units),
+        "i_size": pa.array(size),
         "i_wholesale_cost": pa.array(np.round(rng.uniform(0.05, 70.0, n), 2)),
         "i_manager_id": pa.array(((sk - 1) % 100 + 1).astype(np.int32)),
         # planted price bands (uniform prices would leave these windows nearly
@@ -256,6 +291,14 @@ def gen_store(scale: float, seed: int) -> pa.Table:
         "s_county": pa.array(np.array(COUNTIES)[(sk - 1) % len(COUNTIES)]),
         "s_state": pa.array(np.array(STATES)[(sk - 1) % len(STATES)]),
         "s_company_name": pa.array(np.full(n, "Unknown")),
+        "s_company_id": pa.array(((sk - 1) % 6 + 1).astype(np.int32)),
+        "s_street_number": pa.array(rng.integers(1, 1000, n).astype(str)),
+        "s_street_name": pa.array(np.array(
+            ["Main", "Oak", "Park", "First", "Elm"])[(sk - 1) % 5]),
+        "s_street_type": pa.array(np.array(
+            ["St", "Ave", "Blvd", "Ln"])[(sk - 1) % 4]),
+        "s_suite_number": pa.array(np.char.add(
+            "Suite ", ((sk - 1) % 20 * 10).astype(str))),
         "s_zip": pa.array(np.char.zfill(
             rng.integers(10000, 99999, n).astype(str), 5)),
         "s_gmt_offset": pa.array((-5.0 - ((sk - 1) % 4)).astype(np.float64)),

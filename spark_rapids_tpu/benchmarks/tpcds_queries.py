@@ -1240,6 +1240,229 @@ def q88(t):
     return out
 
 
+def q41(t):
+    """Manufacturers with qualifying item variants (correlated count(*)>0 as
+    a semi-join on i_manufact). Manufact-id window 738..778 -> 38..78 (the
+    generator cycles ids over 1..n_item)."""
+    combo = lambda cat, colors, units, sizes: (  # noqa: E731
+        (col("i_category") == cat) & col("i_color").isin(*colors)
+        & col("i_units").isin(*units) & col("i_size").isin(*sizes))
+    variants = (combo("Women", ("powder", "khaki"), ("Ounce", "Oz"),
+                      ("medium", "extra large"))
+                | combo("Women", ("brown", "honeydew"), ("Bunch", "Ton"),
+                        ("N/A", "small"))
+                | combo("Men", ("floral", "deep"), ("N/A", "Dozen"),
+                        ("petite", "large"))
+                | combo("Men", ("light", "cornflower"), ("Box", "Pound"),
+                        ("medium", "extra large"))
+                | combo("Women", ("midnight", "snow"), ("Pallet", "Gross"),
+                        ("medium", "extra large"))
+                | combo("Women", ("cyan", "papaya"), ("Cup", "Dram"),
+                        ("N/A", "small"))
+                | combo("Men", ("orange", "frosted"), ("Each", "Tbl"),
+                        ("petite", "large"))
+                | combo("Men", ("forest", "ghost"), ("Lb", "Bundle"),
+                        ("medium", "extra large")))
+    qualifying = (t["item"].filter(variants)
+                  .select(col("i_manufact").alias("qm")).distinct())
+    return (t["item"]
+            .filter((col("i_manufact_id") >= 38)
+                    & (col("i_manufact_id") <= 78))
+            .join(qualifying, [("i_manufact", "qm")], "leftsemi")
+            .select("i_product_name").distinct()
+            .sort("i_product_name").limit(100))
+
+
+def q48(t):
+    # state triplets adapted to the generator pool
+    demo_ok = (((col("cd_marital_status") == "M")
+                & (col("cd_education_status") == "4 yr Degree")
+                & (col("ss_sales_price") >= 100.0)
+                & (col("ss_sales_price") <= 150.0))
+               | ((col("cd_marital_status") == "D")
+                  & (col("cd_education_status") == "2 yr Degree")
+                  & (col("ss_sales_price") >= 50.0)
+                  & (col("ss_sales_price") <= 100.0))
+               | ((col("cd_marital_status") == "S")
+                  & (col("cd_education_status") == "College")
+                  & (col("ss_sales_price") >= 150.0)
+                  & (col("ss_sales_price") <= 200.0)))
+    geo_ok = (((col("ca_country") == "United States")
+               & col("ca_state").isin("TX", "OH", "GA")
+               & (col("ss_net_profit") >= 0) & (col("ss_net_profit") <= 2000))
+              | ((col("ca_country") == "United States")
+                 & col("ca_state").isin("TN", "IN", "SD")
+                 & (col("ss_net_profit") >= 150)
+                 & (col("ss_net_profit") <= 3000))
+              | ((col("ca_country") == "United States")
+                 & col("ca_state").isin("LA", "MI", "CA")
+                 & (col("ss_net_profit") >= 50)
+                 & (col("ss_net_profit") <= 25000)))
+    return (t["store_sales"]
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .join(t["date_dim"].filter(col("d_year") == 2000),
+                  [("ss_sold_date_sk", "d_date_sk")])
+            .join(t["customer_demographics"], [("ss_cdemo_sk", "cd_demo_sk")])
+            .join(t["customer_address"], [("ss_addr_sk", "ca_address_sk")])
+            .filter(demo_ok & geo_ok)
+            .agg(F.sum("ss_quantity").alias("sum_quantity")))
+
+
+def q50(t):
+    days = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    bucket = lambda lo, hi: F.sum(  # noqa: E731
+        when(((days > lo) if lo is not None else lit(True))
+             & ((days <= hi) if hi is not None else lit(True)), 1)
+        .otherwise(0))
+    return (t["store_sales"]
+            .join(t["store_returns"]
+                  .join(t["date_dim"].filter((col("d_year") == 2001)
+                                             & (col("d_moy") == 8))
+                        .select(col("d_date_sk").alias("d2_sk")),
+                        [("sr_returned_date_sk", "d2_sk")]),
+                  [("ss_ticket_number", "sr_ticket_number"),
+                   ("ss_item_sk", "sr_item_sk"),
+                   ("ss_customer_sk", "sr_customer_sk")])
+            .join(t["store"], [("ss_store_sk", "s_store_sk")])
+            .groupBy("s_store_name", "s_company_id", "s_street_number",
+                     "s_street_name", "s_street_type", "s_suite_number",
+                     "s_city", "s_county", "s_state", "s_zip")
+            .agg(bucket(None, 30).alias("d30"),
+                 bucket(30, 60).alias("d31_60"),
+                 bucket(60, 90).alias("d61_90"),
+                 bucket(90, 120).alias("d91_120"),
+                 bucket(120, None).alias("d_over_120"))
+            .sort("s_store_name", "s_company_id", "s_street_number",
+                  "s_street_name", "s_street_type", "s_suite_number",
+                  "s_city", "s_county", "s_state", "s_zip")
+            .limit(100))
+
+
+def q61(t):
+    def slice_sales(with_promo):
+        base = (t["store_sales"]
+                .join(t["date_dim"].filter((col("d_year") == 1998)
+                                           & (col("d_moy") == 11)),
+                      [("ss_sold_date_sk", "d_date_sk")])
+                .join(t["store"].filter(col("s_gmt_offset") == -5.0),
+                      [("ss_store_sk", "s_store_sk")])
+                .join(t["customer"], [("ss_customer_sk", "c_customer_sk")])
+                .join(t["customer_address"]
+                      .filter(col("ca_gmt_offset") == -5.0),
+                      [("c_current_addr_sk", "ca_address_sk")])
+                .join(t["item"].filter(col("i_category") == "Jewelry"),
+                      [("ss_item_sk", "i_item_sk")]))
+        if with_promo:
+            base = base.join(
+                t["promotion"].filter((col("p_channel_dmail") == "Y")
+                                      | (col("p_channel_email") == "Y")
+                                      | (col("p_channel_tv") == "Y")),
+                [("ss_promo_sk", "p_promo_sk")])
+        name = "promotions" if with_promo else "total"
+        return base.agg(F.sum("ss_ext_sales_price").alias(name))
+
+    return (slice_sales(True).crossJoin(slice_sales(False))
+            .select("promotions", "total",
+                    (col("promotions") / col("total") * 100.0)
+                    .alias("promo_pct")))
+
+
+def q71(t):
+    dd = (t["date_dim"].filter((col("d_moy") == 11) & (col("d_year") == 1999))
+          .select("d_date_sk"))
+
+    def channel(sales, price, item_k, date_k, time_k):
+        return (sales.join(dd, [(date_k, "d_date_sk")], "leftsemi")
+                .select(col(price).alias("ext_price"),
+                        col(item_k).alias("sold_item_sk"),
+                        col(time_k).alias("time_sk")))
+
+    u = (channel(t["web_sales"], "ws_ext_sales_price", "ws_item_sk",
+                 "ws_sold_date_sk", "ws_sold_time_sk")
+         .union(channel(t["catalog_sales"], "cs_ext_sales_price",
+                        "cs_item_sk", "cs_sold_date_sk", "cs_sold_time_sk"))
+         .union(channel(t["store_sales"], "ss_ext_sales_price", "ss_item_sk",
+                        "ss_sold_date_sk", "ss_sold_time_sk")))
+    return (u.join(t["item"].filter(col("i_manager_id") == 1),
+                   [("sold_item_sk", "i_item_sk")])
+            .join(t["time_dim"].filter(col("t_meal_time")
+                                       .isin("breakfast", "dinner")),
+                  [("time_sk", "t_time_sk")])
+            .groupBy("i_brand", "i_brand_id", "t_hour", "t_minute")
+            .agg(F.sum("ext_price").alias("ext_price"))
+            .select(col("i_brand_id").alias("brand_id"),
+                    col("i_brand").alias("brand"), "t_hour", "t_minute",
+                    "ext_price")
+            .sort(col("ext_price").desc(), "brand_id"))
+
+
+def q82(t):
+    lo = datetime.date(2000, 5, 25)
+    hi = lo + datetime.timedelta(days=60)
+    # price 62..92 overlaps the generator's planted 68-98 band; manufact list
+    # 129/270/821/423 -> the planted ids 8/33/58/83 (like q37)
+    items = t["item"].filter(
+        (col("i_current_price") >= 62) & (col("i_current_price") <= 92)
+        & col("i_manufact_id").isin(8, 33, 58, 83))
+    inv = (t["inventory"]
+           .filter((col("inv_quantity_on_hand") >= 100)
+                   & (col("inv_quantity_on_hand") <= 500))
+           .join(t["date_dim"].filter((col("d_date") >= lit(lo))
+                                      & (col("d_date") <= lit(hi))),
+                 [("inv_date_sk", "d_date_sk")]))
+    return (items.join(inv, [("i_item_sk", "inv_item_sk")])
+            .join(t["store_sales"], [("i_item_sk", "ss_item_sk")], "leftsemi")
+            .select("i_item_id", "i_item_desc", "i_current_price")
+            .dropDuplicates()
+            .sort("i_item_id").limit(100))
+
+
+def q87(t):
+    dd = (t["date_dim"].filter((col("d_month_seq") >= 1200)
+                               & (col("d_month_seq") <= 1211))
+          .select("d_date_sk", "d_date"))
+
+    def bought(sales, cust_k, date_k, names=("c_last_name", "c_first_name",
+                                             "d_date")):
+        return (sales.join(dd, [(date_k, "d_date_sk")])
+                .join(t["customer"], [(cust_k, "c_customer_sk")])
+                .select(col("c_last_name").alias(names[0]),
+                        col("c_first_name").alias(names[1]),
+                        col("d_date").alias(names[2])).distinct())
+
+    store = bought(t["store_sales"], "ss_customer_sk", "ss_sold_date_sk")
+    catalog = bought(t["catalog_sales"], "cs_bill_customer_sk",
+                     "cs_sold_date_sk", ("ln", "fn", "dt"))
+    web = bought(t["web_sales"], "ws_bill_customer_sk", "ws_sold_date_sk",
+                 ("ln", "fn", "dt"))
+    keys = [("c_last_name", "ln"), ("c_first_name", "fn"), ("d_date", "dt")]
+    return (store.join(catalog, keys, "leftanti")
+            .join(web, keys, "leftanti")
+            .agg(F.count().alias("cnt")))
+
+
+def q97(t):
+    dd = (t["date_dim"].filter((col("d_month_seq") >= 1200)
+                               & (col("d_month_seq") <= 1211))
+          .select("d_date_sk"))
+    ssci = (t["store_sales"].join(dd, [("ss_sold_date_sk", "d_date_sk")],
+                                  "leftsemi")
+            .select(col("ss_customer_sk").alias("s_cust"),
+                    col("ss_item_sk").alias("s_item")).distinct())
+    csci = (t["catalog_sales"].join(dd, [("cs_sold_date_sk", "d_date_sk")],
+                                    "leftsemi")
+            .select(col("cs_bill_customer_sk").alias("c_cust"),
+                    col("cs_item_sk").alias("c_item")).distinct())
+    j = ssci.join(csci, [("s_cust", "c_cust"), ("s_item", "c_item")], "full")
+    return j.agg(
+        F.sum(when(col("s_item").isNotNull() & col("c_item").isNull(), 1)
+              .otherwise(0)).alias("store_only"),
+        F.sum(when(col("s_item").isNull() & col("c_item").isNotNull(), 1)
+              .otherwise(0)).alias("catalog_only"),
+        F.sum(when(col("s_item").isNotNull() & col("c_item").isNotNull(), 1)
+              .otherwise(0)).alias("store_and_catalog"))
+
+
 QUERIES: Dict[str, object] = {
     name: fn for name, fn in list(globals().items())
     if name.startswith("q") and name[1:].isdigit() and callable(fn)}

@@ -15,7 +15,8 @@ _TIES = {"q3", "q7", "q19", "q34", "q42", "q43", "q46", "q52", "q55", "q59",
          "q15", "q18", "q20", "q25", "q26", "q29", "q45", "q62", "q93",
          "q99",
          "q6", "q17", "q33", "q36", "q47", "q53", "q60", "q63", "q69",
-         "q76", "q86"}
+         "q76", "q86",
+         "q50", "q71"}
 
 _MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
              "q46": 1, "q52": 1, "q55": 1, "q59": 10, "q65": 1, "q68": 1,
@@ -25,7 +26,9 @@ _MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
              "q62": 10, "q90": 1, "q92": 1, "q93": 10, "q94": 1, "q99": 10,
              "q6": 1, "q13": 1, "q17": 5, "q28": 1, "q33": 5, "q36": 10,
              "q44": 5, "q47": 10, "q53": 10, "q60": 1, "q63": 10, "q69": 5,
-             "q76": 10, "q86": 10, "q88": 1}
+             "q76": 10, "q86": 10, "q88": 1,
+             "q41": 1, "q48": 1, "q50": 1, "q61": 1, "q71": 1, "q82": 1,
+             "q87": 1, "q97": 1}
 
 
 @pytest.fixture(scope="module")
@@ -43,6 +46,16 @@ def _drop_compiled_executables():
     jax.clear_caches()
 
 
+# scalar-aggregate queries always return one row, so the row-count guard is
+# vacuous; assert the named aggregate actually saw qualifying rows instead.
+# q13/q32/q90/q92/q96 are knowingly absent: their compound predicates
+# (triple demographic+price bands, 1.3x-average excess discounts, narrow
+# half-hour windows) legitimately qualify zero rows at this generator scale,
+# so only engine parity is asserted for them.
+_SCALAR_CHECK = {"q48": "sum_quantity", "q61": "total", "q87": "cnt",
+                 "q97": "store_and_catalog"}
+
+
 @pytest.mark.parametrize("qname", sorted(QUERIES, key=lambda n: int(n[1:])))
 def test_tpcds_query_matches_cpu(qname, tables):
     cpu = assert_tpu_and_cpu_equal(
@@ -54,3 +67,9 @@ def test_tpcds_query_matches_cpu(qname, tables):
     assert cpu.num_rows >= _MIN_ROWS.get(qname, 0), (
         f"{qname} returned {cpu.num_rows} rows; the generator no longer "
         f"qualifies rows for its predicates")
+    check = _SCALAR_CHECK.get(qname)
+    if check is not None:
+        v = cpu.column(check)[0].as_py()
+        assert v is not None and v > 0, (
+            f"{qname}: {check}={v!r}; the generator no longer qualifies "
+            f"rows for its predicates")
