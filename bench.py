@@ -69,16 +69,18 @@ def main() -> None:
     else:
         raise SystemExit(f"unknown BENCH_SUITE {suite!r} (tpch | tpcxbb)")
 
+    # CPU baseline first: the remote-device client's background threads would
+    # otherwise steal host CPU from the single-core numpy run
+    t0 = time.perf_counter()
+    cpu_result = run_cpu()
+    cpu_time = time.perf_counter() - t0
+
     tpu_result = run_tpu()  # warmup (compile)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         run_tpu()
     tpu_time = (time.perf_counter() - t0) / iters
-
-    t0 = time.perf_counter()
-    cpu_result = run_cpu()
-    cpu_time = time.perf_counter() - t0
 
     assert tpu_result.num_rows == cpu_result.num_rows, (
         f"result mismatch: {tpu_result.num_rows} vs {cpu_result.num_rows}")
