@@ -483,36 +483,52 @@ class GroupedData:
         keys = list(self._grouping)
         keynames = [k.name_hint for k in keys]
         out_names = [a.name_hint for a in aggs]
+        if len(set(keynames + out_names)) != len(keynames) + len(out_names):
+            raise ValueError(
+                "duplicate output names in a DISTINCT aggregation: "
+                f"{keynames + out_names!r} — alias the colliding columns")
 
-        regular = [a for a in aggs if not isinstance(a.c, DistinctAgg)]
-        for a in regular:
+        # Subplans are recombined BY NAME, so keys and agg outputs get
+        # generated unique names (__gk{i}/__da{i}); user-facing names come
+        # back only in the final select.
+        gk = [f"__gk{i}" for i in range(len(keys))]
+        da = [f"__da{i}" for i in range(len(aggs))]
+        key_aliases = tuple(Alias(k, g) for k, g in zip(keys, gk))
+
+        regular = [(i, a) for i, a in enumerate(aggs)
+                   if not isinstance(a.c, DistinctAgg)]
+        for _, a in regular:
             if _tree_has(a.c, DistinctAgg):
                 raise NotImplementedError(
                     "distinct aggregate must be a top-level aggregate "
                     "expression (optionally aliased)")
         parts: List[DataFrame] = []
         if regular:
-            parts.append(GroupedData(df, tuple(keys)).agg(
-                *[Column(a) for a in regular]))
+            parts.append(GroupedData(df, key_aliases).agg(
+                *[Column(Alias(a.c, da[i])) for i, a in regular]))
         for i, a in enumerate(aggs):
             if not isinstance(a.c, DistinctAgg):
                 continue
             inner = a.c.inner
             vname = f"__dv{i}"
-            sel = [Column(Alias(k, kn)) for k, kn in zip(keys, keynames)]
+            sel = [Column(ka) for ka in key_aliases]
             sel.append(Column(Alias(inner.child, vname)))
             dd = df.select(*sel).dropDuplicates()
             rebuilt = inner.map_children(
                 lambda _e: UnresolvedAttribute(vname))
-            grouping = tuple(UnresolvedAttribute(kn) for kn in keynames)
+            grouping = tuple(UnresolvedAttribute(g) for g in gk)
             parts.append(GroupedData(dd, grouping).agg(
-                Column(Alias(rebuilt, a.name))))
+                Column(Alias(rebuilt, da[i]))))
 
         result = parts[0]
         for p in parts[1:]:
-            result = (_null_safe_key_join(result, p, keynames) if keynames
+            result = (_null_safe_key_join(result, p, gk) if gk
                       else result.crossJoin(p))
-        return result.select(*(keynames + out_names))
+        final = [Column(Alias(UnresolvedAttribute(g), kn))
+                 for g, kn in zip(gk, keynames)]
+        final += [Column(Alias(UnresolvedAttribute(d), on))
+                  for d, on in zip(da, out_names)]
+        return result.select(*final)
 
     def _grouping_sets_agg(self, aggs) -> DataFrame:
         """rollup/cube via Expand (Spark's Expand + grouping-id plan shape):

@@ -418,7 +418,11 @@ class _PadBase(Expression):
             return _all_null(xp, DType.STRING, ctx.capacity,
                              v.data.shape[-1])
         target = max(int(self.length.value), 0)
-        W_out = max(v.data.shape[-1], min(target, ctx.string_max_bytes))
+        # width bound: surviving prefix (≤ min(input W, 4 bytes/char · target))
+        # plus the worst-case cyclic fill in BYTES of `target` pad CHARS
+        bound = (min(v.data.shape[-1], 4 * target)
+                 + sk.pad_fill_total_bytes(pad_bytes, target))
+        W_out = max(v.data.shape[-1], min(bound, ctx.string_max_bytes))
         data, lengths = sk.pad(xp, v.data, v.lengths, target,
                                pad_bytes, self.side, W_out)
         return ColV(DType.STRING, data, v.validity, lengths)

@@ -75,12 +75,35 @@ class DeviceScanCache:
     def _total(self) -> int:
         return sum(n for _, _, n in self._entries.values())
 
+    def total_bytes(self) -> int:
+        """Device bytes currently held — the device store counts these toward
+        its budget so proactive spill decisions see cached scans."""
+        with self._lock:
+            return self._total()
+
+    def shrink_by(self, nbytes: int) -> int:
+        """Evict LRU entries until at least nbytes are freed (or the cache is
+        empty); returns bytes freed. Called by the device store's admission
+        path — cached scans are re-uploadable, so they go before real spills."""
+        freed = 0
+        with self._lock:
+            while self._entries and freed < nbytes:
+                _, (_, _, n) = self._entries.popitem(last=False)
+                freed += n
+        return freed
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
 
 
 _cache: Optional[DeviceScanCache] = None
+_cache_lock = threading.Lock()
+
+
+def peek_cache() -> Optional[DeviceScanCache]:
+    """The live cache, if any — without creating one."""
+    return _cache
 
 
 def get_cache(max_bytes: int) -> DeviceScanCache:
@@ -89,9 +112,10 @@ def get_cache(max_bytes: int) -> DeviceScanCache:
     eviction sweep runs here too, so dead tables and budget shrinks are
     reclaimed even on hit-only workloads."""
     global _cache
-    if _cache is None:
-        _cache = DeviceScanCache(max_bytes)
-    else:
-        _cache.max_bytes = max_bytes
-        _cache._evict()
-    return _cache
+    with _cache_lock:
+        if _cache is None:
+            _cache = DeviceScanCache(max_bytes)
+        else:
+            _cache.max_bytes = max_bytes
+            _cache._evict()
+        return _cache

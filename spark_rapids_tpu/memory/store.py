@@ -205,14 +205,35 @@ class DeviceMemoryStore(BufferStore):
     def _move_down(self, buf: SpillableBuffer) -> SpillableBuffer:
         return buf.to_host()
 
+    def ensure_capacity(self, incoming_bytes: int) -> None:
+        """Admission accounting includes the scan cache's device bytes (they
+        share the same HBM): cached scans are pure re-uploadable copies, so
+        they are evicted before any real buffer is spilled down the chain."""
+        if self.budget_bytes is None:
+            return
+        from spark_rapids_tpu.memory import scan_cache
+        cache = scan_cache.peek_cache()
+        cache_bytes = 0
+        if cache is not None:
+            with self._lock:
+                used = self._used
+            cache_bytes = cache.total_bytes()
+            overflow = (used + cache_bytes + incoming_bytes
+                        - self.budget_bytes)
+            if overflow > 0:
+                cache_bytes -= cache.shrink_by(overflow)
+        self.spill_to_size(
+            max(self.budget_bytes - incoming_bytes - cache_bytes, 0))
+
     def handle_oom(self, needed_bytes: int) -> int:
         """Reactive OOM recovery (DeviceMemoryEventHandler.onAllocFailure
         analog): drop the scan cache's device copies first (they are pure
         re-uploadable caches), then spill at least needed_bytes to the next
         tier."""
         from spark_rapids_tpu.memory import scan_cache
-        if scan_cache._cache is not None:
-            scan_cache._cache.clear()
+        cache = scan_cache.peek_cache()
+        if cache is not None:
+            cache.clear()
         with self._lock:
             target = max(self._used - needed_bytes, 0)
         return self.spill_to_size(target)

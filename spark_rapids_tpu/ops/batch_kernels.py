@@ -72,10 +72,10 @@ def _hash64_col(xp, v: ColV):
         # arithmetic mantissa/exponent decomposition — the TPU x64 emulation
         # cannot compile an f64 bitcast, and both engines must use the SAME
         # derivation so group output order matches across CPU and device.
-        # Exactness: ax / 2^floor(log2 ax) scales the exponent only, and
-        # m * 2^52 is an exact (even-above-2^53) integer, so equal floats get
-        # equal (mi, e) and distinct floats distinct ones even when the log2
-        # rounds the exponent estimate off by one.
+        # log2 need not round bit-identically across libms, so the estimate
+        # is CANONICALIZED: force m into [1, 2) with exact power-of-two
+        # scaling. After that (mi, e) is the unique normalized frexp pair on
+        # every engine, and m * 2^52 is an exact integer.
         d = v.data.astype(np.float64)
         # not signbit(): it bitcasts f64 internally, which the TPU x64
         # emulation cannot compile; -0.0 and NaN are canonicalized below
@@ -86,8 +86,14 @@ def _hash64_col(xp, v: ColV):
         finite_pos = xp.logical_and(ax > 0,
                                     xp.logical_not(xp.logical_or(nan, inf)))
         ax_safe = xp.where(finite_pos, ax, 1.0)
-        e = xp.floor(xp.log2(ax_safe))
-        mi = ((ax_safe / xp.exp2(e)) * np.float64(2 ** 52)).astype(np.int64)
+        e = xp.clip(xp.floor(xp.log2(ax_safe)), -1074.0, 1023.0)
+        m = ax_safe / xp.exp2(e)
+        for _ in range(2):  # each step fixes one off-by-one in the estimate
+            too_big = m >= 2.0
+            too_small = m < 1.0
+            e = xp.where(too_big, e + 1.0, xp.where(too_small, e - 1.0, e))
+            m = xp.where(too_big, m * 0.5, xp.where(too_small, m * 2.0, m))
+        mi = (m * np.float64(2 ** 52)).astype(np.int64)
         bits = (mi.astype(np.uint64)
                 ^ _mix64(xp, e.astype(np.int64).astype(np.uint64) + _HGOLD)
                 ^ (xp.where(sign, np.uint64(1), np.uint64(0))

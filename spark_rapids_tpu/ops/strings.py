@@ -266,31 +266,79 @@ def initcap(xp, data, lengths):
     return xp.where(after_space, upper_ascii(xp, low), low)
 
 
+def pad_fill_total_bytes(pad_bytes: bytes, target: int) -> int:
+    """Byte length of `target` characters of the cyclic pad (worst-case fill);
+    O(len(pad)) arithmetic, not O(target)."""
+    chars = _utf8_chars(pad_bytes)
+    if not chars or target <= 0:
+        return 0
+    q, r = divmod(target, len(chars))
+    return q * sum(len(c) for c in chars) + sum(len(c) for c in chars[:r])
+
+
+def _utf8_chars(b: bytes):
+    """Split bytes on UTF-8 character boundaries (non-continuation bytes)."""
+    starts = [i for i, c in enumerate(b) if (c & 0xC0) != 0x80]
+    return [b[s:e] for s, e in zip(starts, starts[1:] + [len(b)])]
+
+
 def pad(xp, data, lengths, target: int, pad_bytes: bytes, side: str, W: int):
-    """lpad/rpad to a constant target length with a cyclic constant pad;
-    strings longer than target are truncated (Spark semantics). An empty pad
-    can only truncate."""
+    """lpad/rpad to a constant target length in CHARACTERS with a cyclic
+    constant pad; strings longer than target chars are truncated on a char
+    boundary (Spark semantics — stringFunctions BasePad is char-based). An
+    empty pad can only truncate."""
     n = data.shape[0]
     data = pad_width(xp, data, W)
-    tgt = min(target, W)
-    plen = len(pad_bytes)
     j = np.arange(W, dtype=np.int32)[None, :]
-    if plen == 0:
-        new_len = xp.minimum(lengths, tgt).astype(np.int32)
+    charcnt = char_lengths(xp, data, lengths)
+    keep_chars = xp.minimum(charcnt, np.int32(target))
+    # byte length of the surviving prefix — always a char boundary
+    keep_bytes = char_to_byte_offset(xp, data, lengths, keep_chars, W)
+    pchars = _utf8_chars(pad_bytes)
+    if not pchars:
+        new_len = keep_bytes
         keep = j < new_len[:, None]
-        return xp.where(keep, data[:, :W], 0).astype(np.uint8), new_len
-    parr = xp.asarray(np.frombuffer(pad_bytes, dtype=np.uint8))
-    new_len = xp.full((n,), tgt, dtype=np.int32)
+        return xp.where(keep, data, 0).astype(np.uint8), new_len
+    # Cyclic fill of up to T pad characters, precomputed host-side (pad is a
+    # literal); fill_len[m] = bytes of the first m fill chars. T clamps the
+    # host work to the output width: every fill char is >= 1 byte and the
+    # output is truncated at W bytes, so chars past W are provably discarded.
+    T = min(target, W)
+    fill = b"".join(pchars[i % len(pchars)] for i in range(T))
+    fill_len = np.zeros(T + 1, dtype=np.int32)
+    acc = 0
+    for m in range(T):
+        acc += len(pchars[m % len(pchars)])
+        fill_len[m + 1] = acc
+    farr = xp.asarray(np.frombuffer(fill, dtype=np.uint8)) if fill \
+        else xp.zeros(1, dtype=np.uint8)
+    pad_chars = xp.clip(np.int64(target) - charcnt, 0, T).astype(np.int32)
+    fill_bytes = xp.asarray(fill_len)[pad_chars]
+    new_len = xp.minimum(keep_bytes + fill_bytes, W).astype(np.int32)
+    fcap = max(len(fill), 1)
     if side == "right":
-        fill_idx = (j - lengths[:, None]) % plen
-        filled = parr[xp.clip(fill_idx, 0, plen - 1)]
-        out = xp.where(j < lengths[:, None], data, filled)
+        from_fill = j >= keep_bytes[:, None]
+        fidx = xp.clip(j - keep_bytes[:, None], 0, fcap - 1)
+        out = xp.where(from_fill, farr[fidx], data)
     else:
-        shift = xp.maximum(tgt - lengths, 0).astype(np.int32)[:, None]
-        src = xp.clip(j - shift, 0, W - 1)
+        from_fill = j < fill_bytes[:, None]
+        fidx = xp.clip(j, 0, fcap - 1)
+        src = xp.clip(j - fill_bytes[:, None], 0, W - 1)
         moved = xp.take_along_axis(data, src, axis=-1)
-        filled = parr[xp.clip(j % plen, 0, plen - 1)]
-        out = xp.where(j < shift, filled, moved)
+        out = xp.where(from_fill, farr[fidx], moved)
+    # The W-clamp above cuts at a raw byte offset; round it down to a char
+    # boundary so a split multibyte pad (or input) char can never emit
+    # invalid UTF-8. Last char start within the kept bytes + its lead-byte
+    # length decide whether the final char survives whole.
+    start_keep = xp.logical_and((out & 0xC0) != 0x80, j < new_len[:, None])
+    s = (W - 1 - xp.argmax(start_keep[:, ::-1], axis=-1)).astype(np.int32)
+    lead = xp.take_along_axis(out, s[:, None], axis=-1)[:, 0]
+    clen = xp.where(lead < 0xC0, 1,
+                    xp.where(lead < 0xE0, 2,
+                             xp.where(lead < 0xF0, 3, 4))).astype(np.int32)
+    new_len = xp.where(new_len > 0,
+                       xp.where(s + clen <= new_len, new_len, s),
+                       new_len).astype(np.int32)
     keep = j < new_len[:, None]
     return xp.where(keep, out, 0).astype(np.uint8), new_len
 

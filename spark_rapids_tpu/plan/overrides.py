@@ -133,6 +133,18 @@ def _tag_float_agg(meta: ExprMeta) -> None:
             return
 
 
+def _tag_stat_agg(meta: ExprMeta) -> None:
+    """stddev/variance/corr/covar accumulate DOUBLE sum / sum-of-products
+    buffers whatever the input type, so their results are order-dependent even
+    over INTEGER columns — gate unconditionally, not per-child dtype."""
+    if meta.conf.get(cfg.ENABLE_FLOAT_AGG):
+        return
+    meta.will_not_work(
+        f"{type(meta.expr).__name__} accumulates double buffers whose "
+        f"reduction order varies; enable with "
+        f"spark.rapids.tpu.sql.variableFloatAgg.enabled")
+
+
 def _tag_window_expr(meta: ExprMeta) -> None:
     """GpuWindowExpression tagging analog: range frames with numeric offsets
     need exactly one orderable numeric/date/timestamp order key."""
@@ -261,14 +273,14 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(agg.Average, "average", tag=_tag_float_agg),
     ExprRule(agg.Min, "minimum"), ExprRule(agg.Max, "maximum"),
     ExprRule(agg.First, "first value"), ExprRule(agg.Last, "last value"),
-    ExprRule(agg.StddevSamp, "sample standard deviation", tag=_tag_float_agg),
+    ExprRule(agg.StddevSamp, "sample standard deviation", tag=_tag_stat_agg),
     ExprRule(agg.StddevPop, "population standard deviation",
-             tag=_tag_float_agg),
-    ExprRule(agg.VarianceSamp, "sample variance", tag=_tag_float_agg),
-    ExprRule(agg.VariancePop, "population variance", tag=_tag_float_agg),
-    ExprRule(agg.Corr, "Pearson correlation", tag=_tag_float_agg),
-    ExprRule(agg.CovarSamp, "sample covariance", tag=_tag_float_agg),
-    ExprRule(agg.CovarPop, "population covariance", tag=_tag_float_agg),
+             tag=_tag_stat_agg),
+    ExprRule(agg.VarianceSamp, "sample variance", tag=_tag_stat_agg),
+    ExprRule(agg.VariancePop, "population variance", tag=_tag_stat_agg),
+    ExprRule(agg.Corr, "Pearson correlation", tag=_tag_stat_agg),
+    ExprRule(agg.CovarSamp, "sample covariance", tag=_tag_stat_agg),
+    ExprRule(agg.CovarPop, "population covariance", tag=_tag_stat_agg),
 ]
 
 EXPR_RULES: Dict[Type[Expression], ExprRule] = {r.cls: r for r in _EXPR_RULE_LIST}

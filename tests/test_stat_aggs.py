@@ -207,6 +207,24 @@ def test_multiple_distinct_aggs_one_aggregation():
     assert cpu.column("n").to_pylist() == [1, 2, 2]
 
 
+def test_distinct_agg_rejects_colliding_output_names():
+    """The rewrite recombines subplans by name; colliding user-facing names
+    (agg alias == key name, or duplicate key hints) must raise instead of
+    silently misbinding."""
+    import pytest
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    t = pa.table({
+        "k": pa.array([1, 1, 2], type=pa.int32()),
+        "v": pa.array([3, 3, 4], type=pa.int64()),
+    })
+    sess = TpuSession.builder().getOrCreate()
+    df = sess.create_dataframe(t)
+    with pytest.raises(ValueError, match="duplicate output names"):
+        df.groupBy("k").agg(F.countDistinct("v").alias("k"))
+    with pytest.raises(ValueError, match="duplicate output names"):
+        df.groupBy("k", "k").agg(F.countDistinct("v").alias("nd"))
+
+
 def test_global_distinct_agg():
     t = _table(nulls=True)
 

@@ -155,6 +155,53 @@ def test_pad():
         None if s is None else rp(s) for s in STRINGS]
 
 
+def test_pad_multibyte_char_semantics():
+    """Spark lpad/rpad count CHARACTERS, not bytes: multibyte input must get
+    the right padded char length and truncation must never split a UTF-8
+    sequence (reference BasePad, stringFunctions.scala:709)."""
+    strings = ["é", "héllo", "日本語のテキスト", "", "ab", None, "ééé"]
+
+    def build(sess):
+        df = sess.create_dataframe(pa.table({"s": pa.array(strings)}))
+        return df.select(F.lpad(col("s"), 3, "x").alias("l"),
+                         F.rpad(col("s"), 2, "x").alias("r"),
+                         F.lpad(col("s"), 4, "ü-").alias("lm"))
+
+    def lp(s, n, p):
+        if len(s) >= n:
+            return s[:n]
+        fill = p * n
+        return fill[:n - len(s)] + s
+
+    def rp(s, n, p):
+        if len(s) >= n:
+            return s[:n]
+        fill = p * n
+        return s + fill[:n - len(s)]
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("l").to_pylist() == [
+        None if s is None else lp(s, 3, "x") for s in strings]
+    assert cpu.column("r").to_pylist() == [
+        None if s is None else rp(s, 2, "x") for s in strings]
+    assert cpu.column("lm").to_pylist() == [
+        None if s is None else lp(s, 4, "ü-") for s in strings]
+
+
+def test_pad_clamped_at_max_bytes_keeps_valid_utf8():
+    """When the padded result overflows string.maxBytes, the byte clamp must
+    round down to a char boundary — never emit a split UTF-8 sequence."""
+    def build(sess):
+        sess.set_conf("spark.rapids.tpu.sql.string.maxBytes", 256)
+        df = sess.create_dataframe(pa.table({"s": pa.array(["a", "ééé"])}))
+        return df.select(F.rpad(col("s"), 200, "é").alias("r"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    for v in cpu.column("r").to_pylist():  # decodes cleanly, ends whole
+        assert v.encode("utf-8").decode("utf-8") == v
+        assert len(v.encode("utf-8")) <= 256
+
+
 def test_substring_index():
     def build(sess):
         return _df(sess).select(
