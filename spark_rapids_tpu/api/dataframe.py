@@ -251,10 +251,22 @@ class DataFrame:
 
     # ---- actions -------------------------------------------------------------
     def _executed_plan(self) -> PhysicalExec:
+        from spark_rapids_tpu import config as _cfg
         cpu_plan = plan_physical(self._plan, self.session.conf)
         overrides = TpuOverrides(self.session.conf)
         final = overrides.apply(cpu_plan)
-        self.session.last_explain = overrides.last_explain
+        mesh_note = ""
+        if self.session.conf.get(_cfg.MESH_ENABLED):
+            if self.session.conf.get(_cfg.ADAPTIVE_ENABLED):
+                mesh_note = (
+                    "\n! mesh execution disabled: "
+                    "spark.rapids.tpu.sql.adaptive.enabled is set (AQE "
+                    "re-plans around host-side exchanges; turn one of the "
+                    "two off)")
+            else:
+                from spark_rapids_tpu.plan.mesh_rewrite import mesh_rewrite
+                final = mesh_rewrite(final, self.session.conf)
+        self.session.last_explain = overrides.last_explain + mesh_note
         self.session.last_plan = final
         return final
 

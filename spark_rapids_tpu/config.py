@@ -204,6 +204,20 @@ UDF_COMPILER_ENABLED = _conf(
     "Compile Python row UDFs into columnar expression trees so they ride the normal "
     "acceleration path (analog of spark.rapids.sql.udfCompiler.enabled).")
 
+MESH_ENABLED = _conf(
+    "sql.mesh.enabled", bool, False,
+    "Distributed SPMD execution over a jax.sharding.Mesh: device subtrees run "
+    "sharded across the mesh data axis with exchanges as ICI collectives "
+    "(all_to_all repartition, all-gather broadcast/merge) — the role the "
+    "reference fills with one-task-per-GPU executors plus the UCX accelerated "
+    "shuffle (RapidsShuffleInternalManager). Incompatible with "
+    "sql.adaptive.enabled: when both are set, mesh lowering is skipped and "
+    "the explain output says so.")
+
+MESH_NUM_DEVICES = _conf(
+    "sql.mesh.numDevices", int, 0,
+    "Devices in the execution mesh; 0 uses every visible device.")
+
 # --------------------------------------------------------------------------------------
 # Memory / scheduling (analog of spark.rapids.memory.*)
 # --------------------------------------------------------------------------------------

@@ -1,0 +1,842 @@
+"""Distributed physical operators over a device mesh.
+
+The TPU-native replacement for the reference's distributed execution stack:
+where spark-rapids runs one task per GPU and moves batches between executors
+through the UCX shuffle (RapidsShuffleInternalManager.scala:194 wiring the
+accelerated shuffle into query execution, GpuShuffleExchangeExec partitioning
+on device), this engine runs every operator as ONE SPMD program over a
+``jax.sharding.Mesh``:
+
+- a partition is a mesh shard (MeshBatch, parallel/mesh_batch.py);
+- a shuffle exchange is a single compiled ``all_to_all`` over ICI
+  (no host round trip, no serialization, no bounce buffers);
+- a broadcast exchange is buffer replication across the mesh (XLA
+  all-gather), the GpuBroadcastExchangeExec role;
+- aggregation is partial-per-shard -> all-gather -> replicated merge
+  (aggregate.scala Partial/Final modes fused into one program).
+
+Dynamic output sizes (filter/join cardinality) cross the SPMD boundary as
+per-shard row-count vectors — one tiny host sync per operator, amortized over
+the whole mesh, never per batch per device.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import DType, Schema, bucket_capacity
+from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
+from spark_rapids_tpu.execs.evaluator import colv_to_column, output_schema
+from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+from spark_rapids_tpu.exprs.core import (ColV, EvalCtx, Expression, flat_len,
+                                         flatten_colvs, unflatten_colvs)
+from spark_rapids_tpu.exprs.misc import Alias, SortOrder
+from spark_rapids_tpu.ops import batch_kernels as bk
+from spark_rapids_tpu.ops import join as jk
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_tpu.parallel.mesh_batch import (MeshBatch, flatten_mesh,
+                                                  gather_mesh, mesh_columns,
+                                                  replicate_device_batch,
+                                                  scatter_arrow,
+                                                  scatter_device_batch)
+
+_SAMPLE_PER_SHARD = 512
+
+
+def _shard_jit(mesh: Mesh, key: Tuple, builder, in_specs, out_specs):
+    """Cached jit(shard_map(...)) keyed like the single-chip program cache."""
+    def make():
+        return jax.shard_map(builder(), mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    return _cached_jit(("mesh", mesh, key), make)
+
+
+def _specs(n: int, spec=P(DATA_AXIS)) -> Tuple:
+    return tuple(spec for _ in range(n))
+
+
+def _shard_ectx(colvs, cap: int, smax: int) -> EvalCtx:
+    """EvalCtx for a shard_map body: the shard index IS the partition id, so
+    partition-dependent expressions (spark_partition_id,
+    monotonically_increasing_id, rand's per-partition stream) produce
+    distinct per-shard values instead of n_dev identical copies."""
+    ectx = EvalCtx(jnp, colvs, cap, smax)
+    ectx.partition_id = jax.lax.axis_index(DATA_AXIS).astype(np.int32)
+    return ectx
+
+
+class MeshExec(PhysicalExec):
+    """Base for mesh-sharded operators. One host-side partition; the
+    parallelism lives in the mesh."""
+
+    is_device = True
+    is_mesh = True
+
+    def __init__(self, children, output: Schema, mesh: Mesh):
+        super().__init__(children, output)
+        self.mesh = mesh
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _one_child_batch(self, ctx: ExecContext, i: int = 0) -> MeshBatch:
+        batches = list(self.children[i].execute(ctx))
+        assert len(batches) == 1, (
+            f"mesh subtree produced {len(batches)} batches")
+        return batches[0]
+
+
+# ------------------------------------------------------------------ transitions
+class MeshScatterExec(MeshExec):
+    """Host rows -> mesh-sharded batch (the upload + partition step: the
+    HostToDeviceExec role fused with the initial even distribution the
+    reference gets from Spark's input partitioning)."""
+
+    def __init__(self, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        import pyarrow as pa
+        child = self.children[0]
+        tables = []
+        for p in range(child.num_partitions):
+            cctx = ExecContext(ctx.conf, partition_id=p,
+                               num_partitions=child.num_partitions,
+                               device_manager=ctx.device_manager,
+                               cleanups=ctx.cleanups)
+            for hb in child.execute(cctx):
+                tables.append(hb if isinstance(hb, pa.Table) else hb.to_arrow())
+        if not tables:
+            table = self.output.to_pa().empty_table()
+        elif len(tables) == 1:
+            table = tables[0]
+        else:
+            table = pa.concat_tables(tables)
+        mb = scatter_arrow(table, self.mesh, ctx.string_max_bytes)
+        self.count_output(mb.num_rows)
+        yield mb
+
+
+class MeshFromDeviceExec(MeshExec):
+    """Single-device batches -> mesh batch (scatter), the entry point for a
+    small single-device intermediate (e.g. an aggregation result) joining a
+    distributed pipeline."""
+
+    def __init__(self, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
+        db = concat_device_batches(list(self.children[0].execute(ctx)),
+                                   self.output, ctx.string_max_bytes)
+        mb = scatter_device_batch(db, self.mesh)
+        self.count_output(mb.num_rows)
+        yield mb
+
+
+class MeshGatherExec(MeshExec):
+    """Mesh batch -> one single-device batch (shard-major order), the
+    boundary back to single-device execution (collect, unsupported ops)."""
+
+    is_mesh = False  # consumers see a plain DeviceBatch
+
+    def __init__(self, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for mb in self.children[0].execute(ctx):
+            db = gather_mesh(mb)
+            self.count_output(db.num_rows)
+            yield db
+
+
+# ------------------------------------------------------------------ row-parallel
+class MeshProjectExec(MeshExec):
+    def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec,
+                 mesh: Mesh):
+        super().__init__((child,), output_schema(exprs), mesh)
+        self.exprs = exprs
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        for mb in self.children[0].execute(ctx):
+            cap = mb.local_capacity
+            schema = self.children[0].output
+            smax = ctx.string_max_bytes
+            key = ("mproject", self.exprs, schema, cap, smax)
+
+            def build(exprs=self.exprs, schema=schema, cap=cap, smax=smax):
+                def fn(*flat):
+                    colvs = unflatten_colvs(schema, flat)
+                    ectx = _shard_ectx(colvs, cap, smax)
+                    outs = []
+                    for e in exprs:
+                        v = e.eval(ectx)
+                        data, validity, lengths = colv_to_column(v, jnp, cap,
+                                                                 smax)
+                        outs.append(data)
+                        outs.append(validity)
+                        if v.dtype is DType.STRING:
+                            outs.append(lengths)
+                    return tuple(outs)
+                return fn
+
+            nout = flat_len(self.output)
+            fn = _shard_jit(self.mesh, key, build,
+                            _specs(flat_len(schema)), _specs(nout))
+            res = fn(*flatten_mesh(mb))
+            out = MeshBatch(self.output, mesh_columns(self.output, res),
+                            mb.rows_per_shard, self.mesh)
+            self.count_output(out.num_rows)
+            yield out
+
+
+class MeshFilterExec(MeshExec):
+    def __init__(self, condition: Expression, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+        self.condition = condition
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        for mb in self.children[0].execute(ctx):
+            cap = mb.local_capacity
+            schema = self.output
+            smax = ctx.string_max_bytes
+            key = ("mfilter", self.condition, schema, cap, smax)
+
+            def build(cond=self.condition, schema=schema, cap=cap, smax=smax):
+                def fn(rows, *flat):
+                    colvs = unflatten_colvs(schema, flat)
+                    ectx = _shard_ectx(colvs, cap, smax)
+                    pred = cond.eval(ectx)
+                    alive = jnp.arange(cap, dtype=np.int32) < rows[0]
+                    keep = jnp.logical_and(pred.data, pred.validity)
+                    if keep.ndim == 0:
+                        keep = jnp.broadcast_to(keep, (cap,))
+                    keep = jnp.logical_and(keep, alive)
+                    out_cols, n = bk.compact(jnp, keep, colvs, rows[0])
+                    return (n[None].astype(np.int32),) + tuple(
+                        flatten_colvs(out_cols))
+                return fn
+
+            nflat = flat_len(schema)
+            fn = _shard_jit(self.mesh, key, build,
+                            (P(DATA_AXIS),) + _specs(nflat),
+                            (P(DATA_AXIS),) + _specs(nflat))
+            res = fn(mb.rows_dev(), *flatten_mesh(mb))
+            rows = np.asarray(res[0]).astype(np.int32)
+            out = MeshBatch(schema, mesh_columns(schema, res[1:]), rows,
+                            self.mesh)
+            out = _maybe_shrink(out)
+            self.count_output(out.num_rows)
+            yield out
+
+
+def _maybe_shrink(mb: MeshBatch) -> MeshBatch:
+    """Re-bucket the local capacity after a selective op (the _to_batch shrink
+    analog): all shards share one static shape, so the bucket follows the
+    LARGEST shard."""
+    max_rows = int(mb.rows_per_shard.max(initial=0))
+    new_cap = max(bucket_capacity(max_rows), 1)
+    cap = mb.local_capacity
+    if new_cap >= cap:
+        return mb
+    key = ("mshrink", mb.mesh, mb.schema, cap, new_cap,
+           tuple(c.data.shape[1:] for c in mb.columns))
+
+    def build(cap=cap, new_cap=new_cap):
+        def fn(*flat):
+            return tuple(a[:new_cap] for a in flat)
+        return fn
+
+    n = len(flatten_mesh(mb))
+    fn = _shard_jit(mb.mesh, key, build, _specs(n), _specs(n))
+    res = fn(*flatten_mesh(mb))
+    return MeshBatch(mb.schema, mesh_columns(mb.schema, res),
+                     mb.rows_per_shard, mb.mesh)
+
+
+# ------------------------------------------------------------------ repartition
+def _mesh_repartition(mb: MeshBatch, op_key: Tuple, pid_builder,
+                      extra_flat: Tuple = (), n_extra: int = 0,
+                      smax: int = 256) -> MeshBatch:
+    """Generic ICI repartition: two programs (count, exchange).
+
+    ``pid_builder(colvs, ectx)`` returns int32[local_cap] destination shards.
+    The count pre-pass sizes the per-(source,dest) chunk so the exchange can
+    NEVER clamp rows away (the skew-overflow guard the VERDICT called for):
+    chunk capacity is the bucketed max over the actual counts matrix.
+    Extra (replicated) inputs — e.g. range bounds — ride along as ``extra_flat``
+    with ``n_extra`` flat slots.
+
+    Relationship to shuffle/ici.py build_ici_repartition: same exchange
+    kernel shape (stable argsort by pid, fixed-capacity chunks, all_to_all,
+    compaction), different overflow strategy — ici.py takes caller-computed
+    pids and returns a clamp flag for its retry driver; this one fuses the
+    pid computation into the program and pre-sizes the chunk so overflow is
+    impossible. A kernel-level fix in one belongs in the other too.
+    """
+    mesh, n_dev, cap = mb.mesh, mb.n_dev, mb.local_capacity
+    schema = mb.schema
+    nflat = flat_len(schema)
+    rows = mb.rows_dev()
+
+    def build_count():
+        def fn(rows, *args):
+            extra = args[:n_extra]
+            colvs = unflatten_colvs(schema, args[n_extra:])
+            ectx = _shard_ectx(colvs, cap, smax)
+            live = jnp.arange(cap, dtype=np.int32) < rows[0]
+            pid = jnp.where(live, pid_builder(colvs, ectx, extra), n_dev)
+            counts = jnp.sum(
+                pid[None, :] == jnp.arange(n_dev, dtype=np.int32)[:, None],
+                axis=1, dtype=np.int32)
+            return counts
+        return fn
+
+    fnc = _shard_jit(mesh, op_key + ("count",), build_count,
+                     (P(DATA_AXIS),) + _specs(n_extra, P()) + _specs(nflat),
+                     P(DATA_AXIS))
+    cmat = np.asarray(fnc(rows, *extra_flat, *flatten_mesh(mb))).reshape(
+        n_dev, n_dev)
+    chunk_cap = max(bucket_capacity(int(cmat.max(initial=0))), 1)
+    recv = cmat.sum(axis=0).astype(np.int32)
+    out_cap = max(bucket_capacity(int(recv.max(initial=0))), 1)
+
+    def build_exchange(chunk_cap=chunk_cap, out_cap=out_cap):
+        def fn(rows, *args):
+            extra = args[:n_extra]
+            colvs = unflatten_colvs(schema, args[n_extra:])
+            ectx = _shard_ectx(colvs, cap, smax)
+            live = jnp.arange(cap, dtype=np.int32) < rows[0]
+            pid = jnp.where(live, pid_builder(colvs, ectx, extra), n_dev)
+            order = jnp.argsort(pid, stable=True)
+            sorted_pid = pid[order]
+            counts = jnp.sum(
+                sorted_pid[None, :]
+                == jnp.arange(n_dev, dtype=np.int32)[:, None],
+                axis=1, dtype=np.int32)
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), np.int32),
+                 jnp.cumsum(counts)[:-1].astype(np.int32)])
+            offs = jnp.arange(chunk_cap, dtype=np.int32)[None, :]
+            idx = jnp.clip(starts[:, None] + offs, 0, cap - 1)
+            within = offs < counts[:, None]
+            gidx = order[idx]
+
+            def a2a(x):
+                return jax.lax.all_to_all(x, DATA_AXIS, split_axis=0,
+                                          concat_axis=0, tiled=True)
+
+            recv_counts = a2a(counts)
+            recv_live = (jnp.arange(chunk_cap, dtype=np.int32)[None, :]
+                         < recv_counts[:, None]).reshape(n_dev * chunk_cap)
+            corder = jnp.argsort(~recv_live, stable=True)[:out_cap]
+            total = jnp.sum(recv_counts).astype(np.int32)
+            outs = [total[None]]
+            for v in colvs:
+                data = a2a(v.data[gidx])
+                flat_shape = (n_dev * chunk_cap,) + data.shape[2:]
+                outs.append(data.reshape(flat_shape)[corder])
+                validity = a2a(v.validity[gidx] & within)
+                outs.append(validity.reshape(n_dev * chunk_cap)[corder])
+                if v.lengths is not None:
+                    lens = a2a(jnp.where(within, v.lengths[gidx], 0))
+                    outs.append(lens.reshape(n_dev * chunk_cap)[corder])
+            return tuple(outs)
+        return fn
+
+    fne = _shard_jit(mesh, op_key + ("exchange", chunk_cap, out_cap),
+                     build_exchange,
+                     (P(DATA_AXIS),) + _specs(n_extra, P()) + _specs(nflat),
+                     (P(DATA_AXIS),) + _specs(nflat))
+    res = fne(rows, *extra_flat, *flatten_mesh(mb))
+    new_rows = np.asarray(res[0]).astype(np.int32)
+    assert int(new_rows.sum()) == mb.num_rows, (
+        f"mesh repartition lost rows: {new_rows.sum()} != {mb.num_rows}")
+    return MeshBatch(schema, mesh_columns(schema, res[1:]), new_rows, mesh)
+
+
+def _hash_pid_builder(keys: Tuple[Expression, ...], n_dev: int):
+    from spark_rapids_tpu.execs.exchange_execs import hash_partition_ids
+
+    def pid(colvs, ectx, extra):
+        kvs = [e.eval(ectx) for e in keys]
+        return hash_partition_ids(jnp, kvs, ectx.capacity, n_dev)
+    return pid
+
+
+class MeshShuffleExchangeExec(MeshExec):
+    """Explicit repartition over the mesh (the GpuShuffleExchangeExec +
+    accelerated-shuffle composition, collapsed into one ICI all_to_all)."""
+
+    def __init__(self, partitioning, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+        self.partitioning = partitioning
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        from spark_rapids_tpu.execs.exchange_execs import (HashPartitioning,
+                                                           RoundRobinPartitioning)
+        part = self.partitioning
+        n_dev = int(self.mesh.devices.size)
+        for mb in self.children[0].execute(ctx):
+            if isinstance(part, HashPartitioning):
+                builder = _hash_pid_builder(part.keys, n_dev)
+            elif isinstance(part, RoundRobinPartitioning):
+                def builder(colvs, ectx, extra, n_dev=n_dev):
+                    i = jax.lax.axis_index(DATA_AXIS).astype(np.int32)
+                    return ((jnp.arange(ectx.capacity, dtype=np.int32) + i)
+                            % np.int32(n_dev))
+            else:
+                raise NotImplementedError(
+                    f"mesh exchange for {type(part).__name__}")
+            out = _mesh_repartition(
+                mb, ("mexchange", part, mb.schema, mb.local_capacity),
+                builder, smax=ctx.string_max_bytes)
+            self.count_output(out.num_rows)
+            yield out
+
+
+# ------------------------------------------------------------------ aggregate
+class MeshHashAggregateExec(MeshExec):
+    """Distributed aggregation as ONE SPMD program: per-shard partial
+    aggregation (Partial mode), all-gather of partial keys+buffers over ICI,
+    replicated merge (Final mode). Output is a small single-device batch —
+    the natural shape for everything downstream of a group-by."""
+
+    is_mesh = False  # produces a plain DeviceBatch
+
+    def __init__(self, grouping: Tuple[Expression, ...],
+                 aggregates: Tuple[Expression, ...], child: PhysicalExec,
+                 output: Schema, mesh: Mesh,
+                 pre_filter: Optional[Expression] = None):
+        super().__init__((child,), output, mesh)
+        self.grouping = grouping
+        self.aggregates = aggregates
+        self.pre_filter = pre_filter
+
+    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.ops.aggregate import (group_aggregate,
+                                                    merge_aggregate)
+        mb = self._one_child_batch(ctx)
+        cap = mb.local_capacity
+        schema = self.children[0].output
+        smax = ctx.string_max_bytes
+        n_dev = mb.n_dev
+        fns = tuple(a.c if isinstance(a, Alias) else a
+                    for a in self.aggregates)
+        key = ("magg", self.grouping, fns, self.pre_filter, schema, cap, smax)
+
+        def build(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
+                  smax=smax, pre=self.pre_filter, n_dev=n_dev):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                ectx = _shard_ectx(colvs, cap, smax)
+                mask = None
+                if pre is not None:
+                    p = pre.eval(ectx)
+                    mask = jnp.logical_and(p.data, p.validity)
+                    if mask.ndim == 0:
+                        mask = jnp.broadcast_to(mask, (cap,))
+                key_cols, buf_cols, ng = group_aggregate(
+                    jnp, ectx, keys_, fns, rows[0], cap, evaluate=False,
+                    extra_mask=mask)
+                galive = jax.lax.all_gather(
+                    jnp.arange(cap, dtype=np.int32) < ng, DATA_AXIS,
+                    tiled=True)
+                gk = [_gather_colv(k) for k in key_cols]
+                gb = [_gather_colv(b) for b in buf_cols]
+                out_keys, out_res, total = merge_aggregate(
+                    jnp, gk, gb, fns, galive, cap * n_dev)
+                return tuple(flatten_colvs(list(out_keys) + list(out_res))
+                             ) + (total,)
+            return fn
+
+        nout = flat_len(self.output)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
+                        _specs(nout, P()) + (P(),))
+        res = fn(mb.rows_dev(), *flatten_mesh(mb))
+        n = int(res[-1])
+        dev = jax.devices()[0]
+        placed = jax.device_put(list(res[:-1]), dev)
+        from spark_rapids_tpu.execs.tpu_execs import _to_batch
+        out = _to_batch(self.output, placed, n)
+        self.count_output(n)
+        yield out
+
+
+def _gather_colv(v: ColV) -> ColV:
+    data = jax.lax.all_gather(v.data, DATA_AXIS, tiled=True)
+    validity = jax.lax.all_gather(v.validity, DATA_AXIS, tiled=True)
+    lengths = (jax.lax.all_gather(v.lengths, DATA_AXIS, tiled=True)
+               if v.lengths is not None else None)
+    return ColV(v.dtype, data, validity, lengths)
+
+
+# ------------------------------------------------------------------ joins
+class MeshHashJoinBase(MeshExec):
+    def __init__(self, left: PhysicalExec, right: PhysicalExec, how: str,
+                 left_keys, right_keys, output: Schema, mesh: Mesh,
+                 condition: Optional[Expression] = None,
+                 build_side: str = "right"):
+        super().__init__((left, right), output, mesh)
+        self.how = how
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = condition
+        self.build_side = build_side
+
+    @property
+    def includes_right_columns(self) -> bool:
+        return self.how not in ("left_semi", "left_anti")
+
+    def _local_join(self, ctx: ExecContext, lb_flat, rb_flat, l_rows, r_rows,
+                    lschema: Schema, rschema: Schema, S: int, B: int,
+                    r_replicated: bool, l_replicated: bool = False
+                    ) -> MeshBatch:
+        """Per-shard two-phase join under shard_map. A ``*_replicated`` side
+        is a broadcast build (same rows on every shard); the other side is
+        sharded, so each of its rows is evaluated on exactly one shard and
+        the per-shard outputs union to the full join."""
+        mesh = self.mesh
+        smax = ctx.string_max_bytes
+        lspec = P() if l_replicated else P(DATA_AXIS)
+        rspec = P() if r_replicated else P(DATA_AXIS)
+        nl, nr = flat_len(lschema), flat_len(rschema)
+        key1 = ("mjoin_size", self.how, self.left_keys, self.right_keys,
+                lschema, rschema, S, B, smax, r_replicated, l_replicated)
+
+        def build1(how=self.how, lkeys=self.left_keys, rkeys=self.right_keys,
+                   lschema=lschema, rschema=rschema, S=S, B=B, smax=smax):
+            def fn(l_rows, r_rows, *flat):
+                l_cols = unflatten_colvs(lschema, flat[:nl])
+                r_cols = unflatten_colvs(rschema, flat[nl:])
+                l_alive = jnp.arange(S, dtype=np.int32) < l_rows[0]
+                r_alive = jnp.arange(B, dtype=np.int32) < r_rows[0]
+                lectx = _shard_ectx(l_cols, S, smax)
+                rectx = _shard_ectx(r_cols, B, smax)
+                lk = [e.eval(lectx) for e in lkeys]
+                rk = [e.eval(rectx) for e in rkeys]
+                sized = jk.join_size(jnp, lk, rk, l_alive, r_alive, how)
+                return (sized["emit_counts"], sized["emit_offsets"],
+                        sized["total"][None], sized["border"],
+                        sized["start_b"], sized["sgid"], sized["matches_l"])
+            return fn
+
+        fn1 = _shard_jit(mesh, key1, build1,
+                         (lspec, rspec) + _specs(nl, lspec)
+                         + _specs(nr, rspec),
+                         _specs(7))
+        res1 = fn1(l_rows, r_rows, *lb_flat, *rb_flat)
+        totals = np.asarray(res1[2]).astype(np.int64)
+        out_cap = max(bucket_capacity(int(totals.max(initial=0))), 1)
+
+        key2 = ("mjoin_gather", self.how, lschema, rschema, S, B, out_cap,
+                self.condition, self.includes_right_columns, smax,
+                r_replicated, l_replicated)
+
+        def build2(how=self.how, lschema=lschema, rschema=rschema, S=S, B=B,
+                   out_cap=out_cap, cond=self.condition,
+                   inc_right=self.includes_right_columns, smax=smax):
+            def fn(emit_counts, emit_offsets, total, border, start_b, sgid,
+                   matches_l, *flat):
+                l_cols = unflatten_colvs(lschema, flat[:nl])
+                r_cols = unflatten_colvs(rschema, flat[nl:])
+                sized = dict(emit_counts=emit_counts,
+                             emit_offsets=emit_offsets, total=total[0],
+                             border=border, start_b=start_b, sgid=sgid,
+                             matches_l=matches_l)
+                lrow, lvalid, rrow, rvalid, _ = jk.join_gather(
+                    jnp, sized, S, B, out_cap, how)
+                r_out = r_cols if inc_right else []
+                out_cols = jk.gather_join_output(jnp, l_cols, r_out, lrow,
+                                                 lvalid, rrow, rvalid)
+                n = total[0]
+                if cond is not None:
+                    ectx = EvalCtx(jnp, out_cols, out_cap, smax)
+                    pred = cond.eval(ectx)
+                    keep = jnp.logical_and(
+                        jnp.logical_and(pred.data, pred.validity),
+                        jnp.arange(out_cap, dtype=np.int64) < n)
+                    out_cols, n = bk.compact(jnp, keep, out_cols, n)
+                return (n[None].astype(np.int32),) + tuple(
+                    flatten_colvs(out_cols))
+            return fn
+
+        nout = flat_len(self.output)
+        fn2 = _shard_jit(mesh, key2, build2,
+                         _specs(7) + _specs(nl, lspec) + _specs(nr, rspec),
+                         (P(DATA_AXIS),) + _specs(nout))
+        res2 = fn2(*res1, *lb_flat, *rb_flat)
+        rows = np.asarray(res2[0]).astype(np.int32)
+        out = MeshBatch(self.output, mesh_columns(self.output, res2[1:]),
+                        rows, mesh)
+        return _maybe_shrink(out)
+
+
+class MeshShuffledHashJoinExec(MeshHashJoinBase):
+    """Shuffled equi-join: both sides hash-repartitioned by join key over the
+    mesh (one all_to_all each), then joined per shard (the
+    GpuShuffledHashJoinExec + RapidsCachingWriter/Reader path, with the whole
+    exchange riding ICI)."""
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        n_dev = int(self.mesh.devices.size)
+        lb = self._one_child_batch(ctx, 0)
+        rb = self._one_child_batch(ctx, 1)
+        smax = ctx.string_max_bytes
+        lb = _mesh_repartition(
+            lb, ("mjoin_lpart", tuple(self.left_keys), lb.schema,
+                 lb.local_capacity),
+            _hash_pid_builder(tuple(self.left_keys), n_dev), smax=smax)
+        rb = _mesh_repartition(
+            rb, ("mjoin_rpart", tuple(self.right_keys), rb.schema,
+                 rb.local_capacity),
+            _hash_pid_builder(tuple(self.right_keys), n_dev), smax=smax)
+        out = self._local_join(ctx, flatten_mesh(lb), flatten_mesh(rb),
+                               lb.rows_dev(), rb.rows_dev(),
+                               self.children[0].output,
+                               self.children[1].output,
+                               lb.local_capacity, rb.local_capacity,
+                               r_replicated=False)
+        self.count_output(out.num_rows)
+        yield out
+
+
+class MeshBroadcastHashJoinExec(MeshHashJoinBase):
+    """Broadcast equi-join: the build side (per ``build_side``, already
+    materialized to a single batch by its BroadcastExchange) is replicated
+    across the mesh; the stream side stays sharded — no stream movement at
+    all (GpuBroadcastHashJoinExec analog)."""
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        from spark_rapids_tpu.execs.tpu_execs import (_flatten,
+                                                      concat_device_batches)
+        bi = 0 if self.build_side == "left" else 1
+        si = 1 - bi
+        stream = self._one_child_batch(ctx, si)
+        build_batches = list(self.children[bi].execute(ctx))
+        db = concat_device_batches(build_batches, self.children[bi].output,
+                                   ctx.string_max_bytes)
+        rep = replicate_device_batch(db, self.mesh)
+        rep_rows = jax.device_put(
+            np.asarray([db.num_rows], dtype=np.int32),
+            NamedSharding(self.mesh, P()))
+        if bi == 1:
+            out = self._local_join(ctx, flatten_mesh(stream), _flatten(rep),
+                                   stream.rows_dev(), rep_rows,
+                                   self.children[0].output,
+                                   self.children[1].output,
+                                   stream.local_capacity, db.capacity,
+                                   r_replicated=True)
+        else:
+            out = self._local_join(ctx, _flatten(rep), flatten_mesh(stream),
+                                   rep_rows, stream.rows_dev(),
+                                   self.children[0].output,
+                                   self.children[1].output,
+                                   db.capacity, stream.local_capacity,
+                                   r_replicated=False, l_replicated=True)
+        self.count_output(out.num_rows)
+        yield out
+
+
+# ------------------------------------------------------------------ sort
+class MeshSortExec(MeshExec):
+    """Global sort: sample-based range repartition over ICI (ascending shard
+    index = ascending key range), then one local sort per shard. Shard-major
+    gather order IS the global sort order (GpuSortExec + GpuRangePartitioning
+    composition)."""
+
+    def __init__(self, orders: Tuple[SortOrder, ...], child: PhysicalExec,
+                 mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+        self.orders = orders
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        from spark_rapids_tpu.execs.exchange_execs import (_sample_bounds,
+                                                           range_partition_ids)
+        mb = self._one_child_batch(ctx)
+        n_dev = mb.n_dev
+        smax = ctx.string_max_bytes
+        schema = self.output
+        if mb.num_rows and n_dev > 1:
+            bounds = self._sampled_bounds(mb, smax)
+            if bounds is not None:
+                bflat = []
+                for v in bounds:
+                    for a in flatten_colvs([v]):
+                        bflat.append(jax.device_put(
+                            np.asarray(a), NamedSharding(self.mesh, P())))
+                nb = len(bflat)
+                bschema = tuple(v.dtype for v in bounds)
+                nbound = bounds[0].validity.shape[0]
+
+                def pid(colvs, ectx, extra, orders=self.orders,
+                        bschema=bschema):
+                    bnd = []
+                    i = 0
+                    for dt in bschema:
+                        if dt is DType.STRING:
+                            bnd.append(ColV(dt, extra[i], extra[i + 1],
+                                            extra[i + 2]))
+                            i += 3
+                        else:
+                            bnd.append(ColV(dt, extra[i], extra[i + 1]))
+                            i += 2
+                    row_keys = [o.child.eval(ectx) for o in orders]
+                    return range_partition_ids(jnp, orders, row_keys, bnd,
+                                               ectx.capacity)
+
+                mb = _mesh_repartition(
+                    mb, ("msort_part", self.orders, schema,
+                         mb.local_capacity, nbound),
+                    pid, extra_flat=tuple(bflat), n_extra=nb, smax=smax)
+
+        cap = mb.local_capacity
+        key = ("msort", self.orders, schema, cap, smax)
+
+        def build(orders=self.orders, schema=schema, cap=cap, smax=smax):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
+                        for o in orders]
+                order = bk.sort_indices(jnp, keys, rows[0])
+                out_cols = bk.take_columns(jnp, colvs, order)
+                return tuple(flatten_colvs(out_cols))
+            return fn
+
+        nflat = flat_len(schema)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(nflat), _specs(nflat))
+        res = fn(mb.rows_dev(), *flatten_mesh(mb))
+        out = MeshBatch(schema, mesh_columns(schema, res), mb.rows_per_shard,
+                        self.mesh)
+        self.count_output(out.num_rows)
+        yield out
+
+    def _sampled_bounds(self, mb: MeshBatch, smax: int):
+        """Evaluate the order keys per shard, pull an evenly spaced sample to
+        the host, derive n_dev-1 range bounds (SamplingUtils role)."""
+        from spark_rapids_tpu.execs.exchange_execs import _sample_bounds
+        cap = mb.local_capacity
+        schema = mb.schema
+        k = min(_SAMPLE_PER_SHARD, cap)
+        key = ("msort_sample", self.orders, schema, cap, k, smax)
+
+        def build(orders=self.orders, schema=schema, cap=cap, k=k, smax=smax):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                keys = [o.child.eval(ectx) for o in orders]
+                idx = jnp.asarray(
+                    np.linspace(0, cap - 1, k).astype(np.int32))
+                alive = idx < rows[0]
+                outs = [alive]
+                for v in keys:
+                    v = bk.as_column(jnp, v, cap)
+                    outs.extend(flatten_colvs([bk.take_colv(jnp, v, idx)]))
+                return tuple(outs)
+            return fn
+
+        n_keys_flat = sum(3 if o.child.dtype() is DType.STRING else 2
+                          for o in self.orders)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
+                        _specs(1 + n_keys_flat))
+        res = [np.asarray(a) for a in fn(mb.rows_dev(), *flatten_mesh(mb))]
+        alive = res[0]
+        if not alive.any():
+            return None
+        keys = []
+        i = 1
+        for o in self.orders:
+            dt = o.child.dtype()
+            if dt is DType.STRING:
+                keys.append(ColV(dt, res[i][alive], res[i + 1][alive],
+                                 res[i + 2][alive]))
+                i += 3
+            else:
+                keys.append(ColV(dt, res[i][alive], res[i + 1][alive]))
+                i += 2
+        return _sample_bounds(self.orders, [keys], mb.n_dev)
+
+
+# ------------------------------------------------------------------ limit/union
+class MeshLimitExec(MeshExec):
+    """Global limit over shard-major order: per-shard take counts are plain
+    host arithmetic over the row-count vector; no device work at all."""
+
+    def __init__(self, n: int, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), child.output, mesh)
+        self.n = n
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        remaining = self.n
+        for mb in self.children[0].execute(ctx):
+            take = np.zeros_like(mb.rows_per_shard)
+            left = remaining
+            for d in range(mb.n_dev):
+                t = min(left, int(mb.rows_per_shard[d]))
+                take[d] = t
+                left -= t
+            remaining = left
+            out = MeshBatch(mb.schema, mb.columns, take, mb.mesh)
+            out = _maybe_shrink(out)
+            self.count_output(out.num_rows)
+            yield out
+            if remaining <= 0:
+                break
+
+
+class MeshUnionExec(MeshExec):
+    """Per-shard concatenation of two mesh batches (no data movement across
+    shards; shard-major order = left rows then right rows per shard)."""
+
+    def __init__(self, left: PhysicalExec, right: PhysicalExec, mesh: Mesh):
+        super().__init__((left, right), left.output, mesh)
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        lb = self._one_child_batch(ctx, 0)
+        rb = self._one_child_batch(ctx, 1)
+        capL, capR = lb.local_capacity, rb.local_capacity
+        rows = lb.rows_per_shard + rb.rows_per_shard
+        out_cap = max(bucket_capacity(int(rows.max(initial=0))), 1)
+        schema = self.output
+        key = ("munion", schema, capL, capR, out_cap,
+               tuple(c.data.shape[1:] for c in lb.columns),
+               tuple(c.data.shape[1:] for c in rb.columns))
+
+        def build(schema=schema, capL=capL, capR=capR, out_cap=out_cap):
+            def fn(l_rows, r_rows, *flat):
+                nl = flat_len(schema)
+                l_cols = unflatten_colvs(schema, flat[:nl])
+                r_cols = unflatten_colvs(schema, flat[nl:])
+                liveL = jnp.arange(capL, dtype=np.int32) < l_rows[0]
+                liveR = jnp.arange(capR, dtype=np.int32) < r_rows[0]
+                live = jnp.concatenate([liveL, liveR])
+                order = jnp.argsort(~live, stable=True)[:out_cap]
+                outs = []
+                for lv, rv in zip(l_cols, r_cols):
+                    merged = jk._concat_colv(jnp, lv, rv)
+                    outs.extend(flatten_colvs(
+                        [bk.take_colv(jnp, merged, order)]))
+                return tuple(outs)
+            return fn
+
+        nflat = flat_len(schema)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS), P(DATA_AXIS)) + _specs(2 * nflat),
+                        _specs(nflat))
+        res = fn(lb.rows_dev(), rb.rows_dev(), *flatten_mesh(lb),
+                 *flatten_mesh(rb))
+        out = MeshBatch(schema, mesh_columns(schema, res), rows, self.mesh)
+        self.count_output(out.num_rows)
+        yield out

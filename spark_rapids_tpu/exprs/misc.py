@@ -91,8 +91,10 @@ class MonotonicallyIncreasingID(Expression):
         xp = ctx.xp
         pid = getattr(ctx, "partition_id", 0)
         base = getattr(ctx, "row_offset", 0)
-        data = (np.int64(pid) << np.int64(33)) + base + xp.arange(
-            ctx.capacity, dtype=np.int64)
+        # asarray, not np.int64(): pid may be a traced shard index under
+        # mesh execution (mesh execs inject lax.axis_index as partition_id)
+        data = ((xp.asarray(pid).astype(np.int64) << np.int64(33)) + base
+                + xp.arange(ctx.capacity, dtype=np.int64))
         return ColV(DType.LONG, data, xp.ones_like(data, dtype=bool))
 
 

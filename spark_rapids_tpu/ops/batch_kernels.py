@@ -66,8 +66,10 @@ def _hash64_col(xp, v: ColV):
         n_words = words.shape[-1]
         bits = v.lengths.astype(np.uint64)
         for i in range(n_words):
-            bits = _mix64(xp, bits ^ _mix64(xp, words[..., i]
-                                            + np.uint64(i + 1) * _HGOLD))
+            # wrapping multiply precomputed in python ints: numpy warns on
+            # scalar uint64 overflow even though wrapping is intended
+            off = np.uint64(((i + 1) * int(_HGOLD)) & 0xFFFFFFFFFFFFFFFF)
+            bits = _mix64(xp, bits ^ _mix64(xp, words[..., i] + off))
     elif v.dtype.is_floating:
         # arithmetic mantissa/exponent decomposition — the TPU x64 emulation
         # cannot compile an f64 bitcast, and both engines must use the SAME

@@ -1,0 +1,201 @@
+"""Mesh-sharded columnar batches.
+
+The distributed execution unit: one logical batch whose column arrays live
+partitioned across a ``jax.sharding.Mesh`` data axis. Global array shape is
+``[n_dev * local_capacity, ...]`` with ``NamedSharding(mesh, P('data'))``;
+device d owns rows ``[d*local_capacity, (d+1)*local_capacity)`` and the live
+rows of each shard are a prefix (the same padding invariant as DeviceBatch,
+per shard).
+
+This replaces the reference's executor-task partitioning of batches
+(ShuffledBatchRDD partitions, one GPU per executor): a partition IS a mesh
+shard, and every exchange between partitions is an XLA collective over ICI
+instead of a UCX transfer (shuffle-plugin/.../ucx/UCX.scala:53).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, _arrow_to_staged
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DType, Schema, bucket_capacity
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclass(frozen=True)
+class MeshBatch:
+    """Columns sharded over the mesh data axis + per-shard live row counts."""
+
+    schema: Schema
+    columns: Tuple[DeviceColumn, ...]
+    #: host-side int32[n_dev]: live rows per shard (each shard's live rows are
+    #: a prefix of its local slice)
+    rows_per_shard: np.ndarray
+    mesh: Mesh
+
+    @property
+    def n_dev(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @property
+    def local_capacity(self) -> int:
+        cap = self.columns[0].capacity if self.columns else 0
+        return cap // self.n_dev
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.rows_per_shard.sum())
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    def rows_dev(self):
+        """rows_per_shard as a device array sharded one-per-shard (the shape
+        shard_map bodies see is [1])."""
+        return jax.device_put(self.rows_per_shard.astype(np.int32),
+                              NamedSharding(self.mesh, P(DATA_AXIS)))
+
+
+def flatten_mesh(mb: MeshBatch) -> List:
+    flat = []
+    for c in mb.columns:
+        flat.append(c.data)
+        flat.append(c.validity)
+        if c.lengths is not None:
+            flat.append(c.lengths)
+    return flat
+
+
+def mesh_columns(schema: Schema, flat) -> Tuple[DeviceColumn, ...]:
+    cols, i = [], 0
+    for f in schema:
+        if f.dtype is DType.STRING:
+            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1], flat[i + 2]))
+            i += 3
+        else:
+            cols.append(DeviceColumn(f.dtype, flat[i], flat[i + 1]))
+            i += 2
+    return tuple(cols)
+
+
+def scatter_arrow(table: pa.Table, mesh: Mesh, string_max_bytes: int
+                  ) -> MeshBatch:
+    """Host arrow table -> mesh batch: rows split contiguously across shards
+    (shard-major order preserves the table's row order end to end), each shard
+    padded to a shared power-of-two local capacity, one sharded device_put per
+    column buffer."""
+    table = table.combine_chunks()
+    schema = Schema.from_pa(table.schema)
+    n = table.num_rows
+    n_dev = int(mesh.devices.size)
+    per = -(-n // n_dev) if n else 0
+    local_cap = max(bucket_capacity(per), 1)
+    total = n_dev * local_cap
+    rows = np.zeros(n_dev, dtype=np.int32)
+    for d in range(n_dev):
+        rows[d] = max(0, min(per, n - d * per))
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    cols: List[DeviceColumn] = []
+    for i, f in enumerate(schema):
+        arr = table.column(i).combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = (arr.chunk(0) if arr.num_chunks == 1
+                   else pa.concat_arrays(arr.chunks))
+        data, validity, lengths = _arrow_to_staged(f.dtype, arr,
+                                                   string_max_bytes)
+        if validity is None:
+            validity = np.ones(n, dtype=bool)
+        gdata = np.zeros((total,) + data.shape[1:], dtype=data.dtype)
+        gvalid = np.zeros(total, dtype=bool)
+        glen = (np.zeros(total, dtype=np.int32) if lengths is not None
+                else None)
+        for d in range(n_dev):
+            if rows[d] == 0:
+                continue
+            src = slice(d * per, d * per + rows[d])
+            dst = slice(d * local_cap, d * local_cap + rows[d])
+            gdata[dst] = data[src]
+            gvalid[dst] = validity[src]
+            if glen is not None:
+                glen[dst] = lengths[src]
+        up = jax.device_put(
+            (gdata, gvalid) + ((glen,) if glen is not None else ()), sharding)
+        cols.append(DeviceColumn(f.dtype, up[0], up[1],
+                                 up[2] if glen is not None else None))
+    return MeshBatch(schema, tuple(cols), rows, mesh)
+
+
+def scatter_device_batch(db: DeviceBatch, mesh: Mesh) -> MeshBatch:
+    """Single-device batch -> mesh batch (host staging; the entry path for
+    small single-device intermediates joining a mesh pipeline)."""
+    return scatter_arrow(db.to_arrow(), mesh, _string_width(db))
+
+
+def _string_width(db: DeviceBatch) -> int:
+    w = 8
+    for c in db.columns:
+        if c.lengths is not None:
+            w = max(w, c.data.shape[-1])
+    return w
+
+
+def gather_mesh(mb: MeshBatch) -> DeviceBatch:
+    """Mesh batch -> one compacted single-device batch, preserving shard-major
+    row order (shard 0 rows first). The compaction runs as one XLA program
+    over the sharded arrays (GSPMD all-gathers over ICI); the result lands on
+    the default device."""
+    n_dev, cap = mb.n_dev, mb.local_capacity
+    total_rows = mb.num_rows
+    out_cap = max(bucket_capacity(total_rows), 1)
+    rows = mb.rows_dev()
+    key = ("mesh-gather", mb.mesh, mb.schema, cap,
+           tuple(c.data.shape[1:] for c in mb.columns), out_cap)
+
+    from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+
+    def build(mesh=mb.mesh, n_dev=n_dev, cap=cap, out_cap=out_cap,
+              schema=mb.schema):
+        def fn(rows, *flat):
+            live = (jnp.arange(cap, dtype=np.int32)[None, :]
+                    < rows[:, None]).reshape(n_dev * cap)
+            order = jnp.argsort(~live, stable=True)[:out_cap]
+            outs = []
+            for a in flat:
+                g = jax.lax.with_sharding_constraint(
+                    a[order], NamedSharding(mesh, P()))
+                outs.append(g)
+            return tuple(outs)
+        return fn
+
+    fn = _cached_jit(key, build)
+    res = fn(rows, *flatten_mesh(mb))
+    dev = jax.devices()[0]
+    placed = jax.device_put(list(res), dev)
+    cols = mesh_columns(mb.schema, placed)
+    return DeviceBatch(mb.schema, cols, total_rows)
+
+
+def replicate_device_batch(db: DeviceBatch, mesh: Mesh) -> DeviceBatch:
+    """Replicate a single-device batch's arrays across the mesh (the
+    all-gather role of GpuBroadcastExchangeExec's per-executor batch cache:
+    XLA broadcasts the buffers over ICI)."""
+    sharding = NamedSharding(mesh, P())
+    cols = []
+    for c in db.columns:
+        data = jax.device_put(c.data, sharding)
+        validity = jax.device_put(c.validity, sharding)
+        lengths = (jax.device_put(c.lengths, sharding)
+                   if c.lengths is not None else None)
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    return DeviceBatch(db.schema, tuple(cols), db.num_rows)

@@ -1,0 +1,162 @@
+"""Physical-plan rewrite: single-device TPU operators -> mesh SPMD operators.
+
+Runs after TpuOverrides (the GpuOverrides analog) when
+``spark.rapids.tpu.sql.mesh.enabled`` is set: every maximal device subtree
+over supported operators is lowered onto the session mesh, with
+scatter/gather transitions at the boundaries. This is the step the reference
+gets from Spark's task scheduler + RapidsShuffleInternalManager (distributing
+the plan over executors); here distribution is a plan property, and the
+exchanges are XLA collectives.
+
+Lowering rules:
+- upload transitions become mesh scatters; download boundaries gather;
+- project/filter/sort/limit/union/exchange run per shard (ICI repartition
+  where rows must move);
+- hash aggregation becomes partial-per-shard + all-gather + merge, returning
+  a small single-device batch (post-agg plans run single-device, the right
+  shape for group-by results);
+- shuffled hash joins repartition both sides by key hash over the mesh;
+  broadcast hash joins replicate the build batch;
+- unsupported operators (window, expand/generate, nested-loop forms, writes)
+  fall back to single-device execution behind a gather — correctness first,
+  with the boundary explicit in the plan.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs import tpu_execs as te
+from spark_rapids_tpu.execs.base import PhysicalExec
+from spark_rapids_tpu.execs import mesh_execs as me
+
+
+def _is_mesh(node: PhysicalExec) -> bool:
+    return getattr(node, "is_mesh", False)
+
+
+def mesh_rewrite(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
+    """Lower device subtrees onto the session mesh (no-op when disabled or
+    fewer than 2 devices)."""
+    if not conf.get(cfg.MESH_ENABLED):
+        return plan
+    import jax
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    n = conf.get(cfg.MESH_NUM_DEVICES) or len(jax.devices())
+    n = min(n, len(jax.devices()))
+    if n < 2:
+        return plan
+    mesh = make_mesh(n)
+    return _rewrite(plan, mesh)
+
+
+def _gathered(node: PhysicalExec, mesh) -> PhysicalExec:
+    """Adapt a mesh producer for a consumer that needs DeviceBatch."""
+    if isinstance(node, me.MeshScatterExec):
+        # scatter-then-gather is a plain upload: collapse the round trip
+        return te.HostToDeviceExec(node.children[0])
+    if isinstance(node, me.MeshFromDeviceExec):
+        return node.children[0]
+    return me.MeshGatherExec(node, mesh) if _is_mesh(node) else node
+
+
+def _meshed(node: PhysicalExec, mesh) -> Optional[PhysicalExec]:
+    """Adapt a node for a consumer that needs MeshBatch: mesh producers pass
+    through; single-device producers are scattered; host producers (CPU
+    execs) return None (caller decides)."""
+    if _is_mesh(node):
+        return node
+    if getattr(node, "is_device", False):
+        return me.MeshFromDeviceExec(node, mesh)
+    return None
+
+
+def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
+    from spark_rapids_tpu.execs.exchange_execs import (HashPartitioning,
+                                                       RoundRobinPartitioning,
+                                                       TpuBroadcastExchangeExec,
+                                                       TpuShuffleExchangeExec)
+    from spark_rapids_tpu.execs.join_execs import (_NestedLoopMixin,
+                                                   TpuBroadcastHashJoinExec,
+                                                   TpuShuffledHashJoinExec)
+
+    kids = [_rewrite(c, mesh) for c in node.children]
+
+    # ---- transitions --------------------------------------------------------
+    if isinstance(node, te.HostToDeviceExec):
+        return me.MeshScatterExec(kids[0], mesh)
+    if isinstance(node, te.DeviceToHostExec):
+        return te.DeviceToHostExec(_gathered(kids[0], mesh))
+
+    # ---- pass-through / drop ------------------------------------------------
+    if isinstance(node, te.TpuCoalesceBatchesExec) and _is_mesh(kids[0]):
+        return kids[0]
+
+    # ---- row-parallel -------------------------------------------------------
+    if isinstance(node, te.TpuProjectExec) and _is_mesh(kids[0]):
+        return me.MeshProjectExec(node.exprs, kids[0], mesh)
+    if isinstance(node, te.TpuFilterExec) and _is_mesh(kids[0]):
+        return me.MeshFilterExec(node.condition, kids[0], mesh)
+
+    # ---- aggregation --------------------------------------------------------
+    if isinstance(node, te.TpuHashAggregateExec) and _is_mesh(kids[0]):
+        return me.MeshHashAggregateExec(node.grouping, node.aggregates,
+                                        kids[0], node.output, mesh,
+                                        node.pre_filter)
+
+    # ---- joins --------------------------------------------------------------
+    if isinstance(node, _NestedLoopMixin):
+        pass  # brute-force forms stay single-device (fall through to gather)
+    elif isinstance(node, TpuBroadcastHashJoinExec):
+        bi = 0 if node.build_side == "left" else 1
+        si = 1 - bi
+        build = kids[bi]
+        if isinstance(build, TpuBroadcastExchangeExec):
+            build = build.with_children([_gathered(build.children[0], mesh)])
+        smesh = _meshed(kids[si], mesh)
+        if smesh is not None:
+            ordered = [None, None]
+            ordered[bi], ordered[si] = build, smesh
+            return me.MeshBroadcastHashJoinExec(
+                ordered[0], ordered[1], node.how, node.left_keys,
+                node.right_keys, node.output, mesh, node.condition,
+                node.build_side)
+        kids = list(kids)
+        kids[bi] = build
+    elif isinstance(node, TpuShuffledHashJoinExec):
+        lm = _meshed(kids[0], mesh)
+        rm = _meshed(kids[1], mesh)
+        if lm is not None and rm is not None and (
+                _is_mesh(kids[0]) or _is_mesh(kids[1])):
+            return me.MeshShuffledHashJoinExec(
+                lm, rm, node.how, tuple(node.left_keys),
+                tuple(node.right_keys), node.output, mesh, node.condition,
+                node.build_side)
+
+    # ---- sort/limit/union ---------------------------------------------------
+    if isinstance(node, te.TpuSortExec) and _is_mesh(kids[0]):
+        return me.MeshSortExec(node.orders, kids[0], mesh)
+    if isinstance(node, te.TpuLimitExec) and _is_mesh(kids[0]):
+        return me.MeshLimitExec(node.n, kids[0], mesh)
+    if isinstance(node, te.TpuUnionExec) and (
+            _is_mesh(kids[0]) or _is_mesh(kids[1])):
+        lm = _meshed(kids[0], mesh)
+        rm = _meshed(kids[1], mesh)
+        if lm is not None and rm is not None:
+            return me.MeshUnionExec(lm, rm, mesh)
+
+    # ---- exchanges ----------------------------------------------------------
+    if isinstance(node, TpuShuffleExchangeExec) and _is_mesh(kids[0]):
+        part = node.partitioning
+        if isinstance(part, (HashPartitioning, RoundRobinPartitioning)):
+            return me.MeshShuffleExchangeExec(part, kids[0], mesh)
+        return me.MeshGatherExec(kids[0], mesh)
+    if isinstance(node, TpuBroadcastExchangeExec):
+        return node.with_children([_gathered(kids[0], mesh)])
+
+    # ---- everything else: gather mesh children ------------------------------
+    new_kids = [_gathered(c, mesh) for c in kids]
+    if all(a is b for a, b in zip(new_kids, node.children)):
+        return node
+    return node.with_children(new_kids)

@@ -18,10 +18,13 @@ Kernel design (all static shapes, no data-dependent control flow):
 4. compact received chunks to the front with one more stable argsort, so the
    output batch obeys the padding invariant (live rows first).
 
-Skew bound: a device can receive at most n_dev * chunk_capacity rows; rows
-beyond chunk_capacity for one destination on one source device would be lost,
-so callers size chunk_capacity for worst-case skew (default: local_capacity,
-which is always safe because a source holds only local_capacity rows).
+Skew bound: a device can receive at most n_dev * chunk_capacity rows. Rows
+beyond chunk_capacity for one destination on one source device cannot ride
+that exchange, so the program RETURNS an overflow count (the collision-flag
+pattern of the aggregation fast path): callers must check it and re-run with
+a larger chunk capacity — ``ici_repartition`` below does exactly that,
+doubling until clean. The default chunk_capacity = local_capacity is always
+safe because a source holds only local_capacity rows.
 """
 from __future__ import annotations
 
@@ -49,11 +52,16 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
     """Build the jitted SPMD repartition step.
 
     Returns fn(num_rows_local [n_dev] int32, pids [n_dev*cap] int32 sharded,
-    *flat sharded column arrays) -> (out_rows [n_dev] int32, *flat resharded
-    columns with capacity n_dev*chunk_capacity per device).
+    *flat sharded column arrays) -> (out_rows [n_dev] int32,
+    overflow_rows [] int32 replicated, *flat resharded columns with capacity
+    n_dev*chunk_capacity per device).
 
     ``pids`` is the target partition id per row (device index), computed by the
     caller from hash exprs — the GpuHashPartitioning.columnarEval analog.
+    ``overflow_rows`` counts rows clamped away by chunk_capacity across ALL
+    devices; a nonzero value means the output is incomplete and the exchange
+    must re-run with a larger chunk capacity (never ignore it — that is
+    silent row loss).
     """
     n_dev = int(np.prod([mesh.shape[a] for a in (axis,)]))
     chunk_cap = chunk_capacity or local_capacity
@@ -78,7 +86,11 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
         offsets = jnp.arange(chunk_cap, dtype=np.int32)[None, :]
         idx = jnp.clip(starts[:, None] + offsets, 0, local_capacity - 1)
         within = offsets < counts[:, None]        # [n_dev, chunk_cap]
-        sent = jnp.minimum(counts, chunk_cap)     # overflow clamps (see skew note)
+        sent = jnp.minimum(counts, chunk_cap)     # overflow clamps (flagged)
+        # clamped rows are DETECTED, not silently dropped: global count of
+        # rows that could not ride this exchange, replicated to every device
+        overflow = jax.lax.psum(
+            jnp.sum(counts - sent).astype(np.int32), axis)
         gidx = order[idx]                         # chunk row -> original row
 
         # 3. exchange: counts + every column buffer
@@ -103,10 +115,33 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
                 dt, data.reshape(flat_shape)[corder],
                 validity.reshape(out_cap)[corder],
                 lengths.reshape(out_cap)[corder] if lengths is not None else None))
-        return (total[None],) + tuple(flatten_colvs(compacted))
+        return (total[None], overflow) + tuple(flatten_colvs(compacted))
 
     nflat = flat_len(schema)
     in_specs = (P(axis), P(axis)) + tuple(P(axis) for _ in range(nflat))
-    out_specs = (P(axis),) + tuple(P(axis) for _ in range(nflat))
+    out_specs = (P(axis), P()) + tuple(P(axis) for _ in range(nflat))
     return jax.jit(jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
+
+
+def ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
+                    num_rows_local, pids, flat_cols,
+                    chunk_capacity: Optional[int] = None,
+                    axis: str = "data"):
+    """Overflow-safe repartition driver: runs the exchange, checks the
+    overflow flag, and re-runs with a doubled chunk capacity until no row was
+    clamped (the detect-and-re-run pattern of the aggregation hash fast
+    path). Returns (out_rows [n_dev], flat resharded columns)."""
+    chunk = chunk_capacity or local_capacity
+    while True:
+        fn = build_ici_repartition(mesh, schema, local_capacity,
+                                   chunk_capacity=chunk, axis=axis)
+        res = fn(num_rows_local, pids, *flat_cols)
+        if int(res[1]) == 0:
+            return res[0], res[2:]
+        if chunk >= local_capacity:
+            raise AssertionError(
+                "ici repartition overflowed at chunk_capacity == "
+                "local_capacity — impossible unless inputs violate the "
+                "padding invariant")
+        chunk = min(chunk * 2, local_capacity)

@@ -1,8 +1,12 @@
 """Mortgage ETL benchmark tests (mortgage_test.py / MortgageSparkSuite
 analog)."""
+import pytest
+
 from spark_rapids_tpu.benchmarks import mortgage as M
 from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
 from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+pytestmark = pytest.mark.slow
 
 
 def _dfs(s, scale=0.02, seed=0):

@@ -8,6 +8,8 @@ from spark_rapids_tpu.benchmarks.tpch_data import gen_all
 from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
 from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
 
+pytestmark = pytest.mark.slow
+
 _SCALE = 0.002
 
 # queries whose final sort key can tie (floats aggregated in different orders

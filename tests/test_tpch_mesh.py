@@ -1,0 +1,61 @@
+"""All 22 TPC-H queries executed DISTRIBUTED over the 8-device virtual mesh
+vs the CPU engine (the VERDICT's 'CPU-vs-mesh' bar: real queries — multi-join,
+agg, sort, limit — running through the ICI exchange path, not just one
+aggregate pattern)."""
+import pytest
+
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+from spark_rapids_tpu.benchmarks.tpch_data import gen_all
+from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+pytestmark = pytest.mark.slow
+
+_SCALE = 0.002
+
+_TIES = {2, 3, 5, 9, 10, 11, 16, 18, 21}
+
+MESH_CONF = {
+    **BENCH_CONF,
+    "spark.rapids.tpu.sql.mesh.enabled": "true",
+    "spark.rapids.tpu.sql.exec.NestedLoopJoin": "true",
+    "spark.rapids.tpu.sql.exec.CartesianProduct": "true",
+}
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_all(_SCALE, seed=7)
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_query_matches_cpu_on_mesh(qnum, tables, eight_devices):
+    cpu = assert_tpu_and_cpu_equal(
+        lambda s: QUERIES[qnum](
+            {k: s.create_dataframe(v) for k, v in tables.items()}),
+        conf=MESH_CONF,
+        ignore_order=qnum in _TIES,
+        approx_float=1e-9)
+    assert cpu.num_rows > 0 or qnum == 18
+
+
+def test_mesh_execs_actually_ran(tables, eight_devices):
+    """The mesh plan must really lower onto mesh operators (not silently fall
+    back to single-device execution): a multi-join query distributed end to
+    end, with the shuffled-join ICI exchange forced on."""
+    assert_tpu_and_cpu_equal(
+        lambda s: QUERIES[3](
+            {k: s.create_dataframe(v) for k, v in tables.items()}),
+        conf={**MESH_CONF,
+              "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1"},
+        ignore_order=True, approx_float=1e-9,
+        expect_tpu_execs=["MeshScatterExec", "MeshShuffledHashJoinExec",
+                          "MeshHashAggregateExec"])
+
+
+def test_mesh_broadcast_join_ran(tables, eight_devices):
+    assert_tpu_and_cpu_equal(
+        lambda s: QUERIES[3](
+            {k: s.create_dataframe(v) for k, v in tables.items()}),
+        conf=MESH_CONF, ignore_order=True, approx_float=1e-9,
+        expect_tpu_execs=["MeshScatterExec", "MeshBroadcastHashJoinExec"])

@@ -7,6 +7,8 @@ from spark_rapids_tpu.benchmarks.tpcxbb_data import gen_all
 from spark_rapids_tpu.benchmarks.tpcxbb_queries import QUERIES, UNSUPPORTED
 from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
 
+pytestmark = pytest.mark.slow
+
 _SCALE = 0.01
 
 # queries whose sort keys can tie (or that have no ordering) -> unordered
