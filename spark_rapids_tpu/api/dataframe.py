@@ -230,6 +230,10 @@ class DataFrame:
                       for f in self._plan.schema())
         return DataFrame(lp.Project(exprs, self._plan), self.session)
 
+    def createOrReplaceTempView(self, name: str) -> None:
+        """Register this DataFrame for SQL access via session.sql()."""
+        self.session.register_view(name, self)
+
     def distinct(self) -> "DataFrame":
         return self.dropDuplicates()
 
@@ -654,6 +658,27 @@ class TpuSession:
         self.conf = TpuConf(conf or {})
         self.last_explain: str = ""
         self.last_plan: Optional[PhysicalExec] = None
+        self._views: Dict[str, DataFrame] = {}
+
+    # ---- SQL frontend -----------------------------------------------------
+    def table(self, name: str) -> "DataFrame":
+        try:
+            return self._views[name.lower()]
+        except KeyError:
+            raise KeyError(f"table or view not found: {name}") from None
+
+    def register_view(self, name: str, df: "DataFrame") -> None:
+        self._views[name.lower()] = df
+
+    def sql(self, query: str) -> "DataFrame":
+        """Run a SQL query over registered temp views (the role Catalyst's
+        parser/analyzer plays for the reference — its benchmark suites feed
+        raw SQL, TpcdsLikeSpark.scala:30)."""
+        from spark_rapids_tpu.sql.parser import parse_sql
+        from spark_rapids_tpu.sql.planner import SqlPlanner
+        stmt = parse_sql(query)
+        df, _names = SqlPlanner(self).plan(stmt)
+        return df
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":

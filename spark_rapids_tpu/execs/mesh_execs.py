@@ -433,36 +433,60 @@ class MeshHashAggregateExec(MeshExec):
                     for a in self.aggregates)
         key = ("magg", self.grouping, fns, self.pre_filter, schema, cap, smax)
 
-        def build(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
-                  smax=smax, pre=self.pre_filter, n_dev=n_dev):
-            def fn(rows, *flat):
-                colvs = unflatten_colvs(schema, flat)
-                ectx = _shard_ectx(colvs, cap, smax)
-                mask = None
-                if pre is not None:
-                    p = pre.eval(ectx)
-                    mask = jnp.logical_and(p.data, p.validity)
-                    if mask.ndim == 0:
-                        mask = jnp.broadcast_to(mask, (cap,))
-                key_cols, buf_cols, ng = group_aggregate(
-                    jnp, ectx, keys_, fns, rows[0], cap, evaluate=False,
-                    extra_mask=mask)
-                galive = jax.lax.all_gather(
-                    jnp.arange(cap, dtype=np.int32) < ng, DATA_AXIS,
-                    tiled=True)
-                gk = [_gather_colv(k) for k in key_cols]
-                gb = [_gather_colv(b) for b in buf_cols]
-                out_keys, out_res, total = merge_aggregate(
-                    jnp, gk, gb, fns, galive, cap * n_dev)
-                return tuple(flatten_colvs(list(out_keys) + list(out_res))
-                             ) + (total,)
-            return fn
+        def build(mode):
+            def make(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
+                     smax=smax, pre=self.pre_filter, n_dev=n_dev, mode=mode):
+                def fn(rows, *flat):
+                    colvs = unflatten_colvs(schema, flat)
+                    ectx = _shard_ectx(colvs, cap, smax)
+                    mask = None
+                    if pre is not None:
+                        p = pre.eval(ectx)
+                        mask = jnp.logical_and(p.data, p.validity)
+                        if mask.ndim == 0:
+                            mask = jnp.broadcast_to(mask, (cap,))
+                    res = group_aggregate(
+                        jnp, ectx, keys_, fns, rows[0], cap, evaluate=False,
+                        grouping=mode, extra_mask=mask)
+                    key_cols, buf_cols, ng = res[:3]
+                    pcap = (key_cols[0].validity.shape[0] if key_cols
+                            else buf_cols[0].validity.shape[0])
+                    galive = jax.lax.all_gather(
+                        jnp.arange(pcap, dtype=np.int32) < ng, DATA_AXIS,
+                        tiled=True)
+                    gk = [_gather_colv(k) for k in key_cols]
+                    gb = [_gather_colv(b) for b in buf_cols]
+                    out_keys, out_res, total = merge_aggregate(
+                        jnp, gk, gb, fns, galive, pcap * n_dev)
+                    out = tuple(flatten_colvs(list(out_keys)
+                                              + list(out_res))) + (total,)
+                    if mode == "hash":
+                        # any shard's collision poisons the whole result:
+                        # OR across the mesh, replicated to every device
+                        bad = jax.lax.psum(res[3].astype(np.int32),
+                                           DATA_AXIS) > 0
+                        out = out + (bad,)
+                    return out
+                return fn
+            return make
 
         nout = flat_len(self.output)
-        fn = _shard_jit(self.mesh, key, build,
-                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
-                        _specs(nout, P()) + (P(),))
-        res = fn(mb.rows_dev(), *flatten_mesh(mb))
+        in_specs = (P(DATA_AXIS),) + _specs(flat_len(schema))
+        # hash-ordered grouping first (same fast path as the single-device
+        # aggregate); the exact lexsort program re-runs only on a flagged
+        # 64-bit collision or group-cap overflow
+        if self.grouping:
+            fn = _shard_jit(self.mesh, key + ("hash",), build("hash"),
+                            in_specs, _specs(nout, P()) + (P(), P()))
+            res = fn(mb.rows_dev(), *flatten_mesh(mb))
+            collided = bool(res[-1])
+            res = res[:-1]
+        else:
+            collided = True  # no-key aggregation: sort mode is already cheap
+        if collided:
+            fn = _shard_jit(self.mesh, key + ("sort",), build("sort"),
+                            in_specs, _specs(nout, P()) + (P(),))
+            res = fn(mb.rows_dev(), *flatten_mesh(mb))
         n = int(res[-1])
         dev = jax.devices()[0]
         placed = jax.device_put(list(res[:-1]), dev)

@@ -120,8 +120,13 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
     nflat = flat_len(schema)
     in_specs = (P(axis), P(axis)) + tuple(P(axis) for _ in range(nflat))
     out_specs = (P(axis), P()) + tuple(P(axis) for _ in range(nflat))
-    return jax.jit(jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+    # cached per (mesh, schema, capacities): same-shaped batch streams reuse
+    # the compiled exchange instead of paying XLA compilation per call
+    from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+    key = ("ici-repart", mesh, schema, local_capacity, chunk_cap, axis)
+    return _cached_jit(key, lambda: jax.shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False))
 
 
 def ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,

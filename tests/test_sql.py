@@ -1,0 +1,143 @@
+"""SQL frontend: parser/planner unit coverage + TPC-H Q1-Q22 as raw SQL
+producing results identical to the DataFrame forms (the VERDICT's acceptance
+bar; reference analog: Catalyst consuming TpchLikeSpark SQL)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+from spark_rapids_tpu.benchmarks.tpch_data import gen_all
+from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
+from spark_rapids_tpu.benchmarks.tpch_sql import SQL_QUERIES
+from spark_rapids_tpu.sql.lexer import SqlError
+from spark_rapids_tpu.testing import assert_tables_equal
+
+_SCALE = 0.002
+
+# queries whose final sort key can tie -> unordered compare
+_TIES = {2, 3, 5, 9, 10, 11, 16, 18, 21}
+
+_CONF = {**BENCH_CONF,
+         "spark.rapids.tpu.sql.exec.NestedLoopJoin": "true",
+         "spark.rapids.tpu.sql.exec.CartesianProduct": "true"}
+
+
+@pytest.fixture(scope="module")
+def sql_session():
+    tables = gen_all(_SCALE, seed=7)
+    sess = TpuSession(_CONF)
+    for name, tab in tables.items():
+        sess.create_dataframe(tab).createOrReplaceTempView(name)
+    return sess, tables
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qnum", sorted(SQL_QUERIES))
+def test_tpch_sql_matches_dataframe(qnum, sql_session):
+    sess, tables = sql_session
+    sql_out = sess.sql(SQL_QUERIES[qnum]).collect()
+    df_out = QUERIES[qnum](
+        {k: sess.create_dataframe(v) for k, v in tables.items()}).collect()
+    # compare positionally: SQL output names come from the spec text and may
+    # differ in case from the DataFrame aliases
+    assert sql_out.num_rows == df_out.num_rows, (
+        f"q{qnum}: {sql_out.num_rows} vs {df_out.num_rows} rows")
+    sql_out = sql_out.rename_columns(df_out.column_names)
+    assert_tables_equal(df_out, sql_out, ignore_order=qnum in _TIES,
+                        approx_float=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# small unit coverage
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mini():
+    s = TpuSession(_CONF)
+    t = pa.table({"k": pa.array([1, 1, 2, 2, 3], type=pa.int64()),
+                  "v": pa.array([10, 20, 30, 40, None], type=pa.int64()),
+                  "name": pa.array(["a", "b", "c", "d", "e"])})
+    u = pa.table({"k": pa.array([1, 2, 4], type=pa.int64()),
+                  "w": pa.array([1.5, 2.5, 4.5])})
+    s.create_dataframe(t).createOrReplaceTempView("t")
+    s.create_dataframe(u).createOrReplaceTempView("u")
+    return s
+
+
+def test_sql_agg_group_order(mini):
+    out = mini.sql("select k, sum(v) as sv, count(v) as nv, count(*) as n "
+                   "from t group by k order by k").collect()
+    assert out.to_pydict() == {"k": [1, 2, 3], "sv": [30, 70, None],
+                               "nv": [2, 2, 0], "n": [2, 2, 1]}
+
+
+def test_sql_join_where_pushdown(mini):
+    out = mini.sql("select t.name, u.w from t, u "
+                   "where t.k = u.k and u.w > 2 order by t.name").collect()
+    assert out.to_pydict() == {"name": ["c", "d"], "w": [2.5, 2.5]}
+
+
+def test_sql_explicit_left_join(mini):
+    out = mini.sql("select t.k, u.w from t left outer join u on t.k = u.k "
+                   "and u.w > 2 order by t.k, t.name").collect()
+    assert out.column("w").to_pylist() == [None, None, 2.5, 2.5, None]
+
+
+def test_sql_between_like_case_isnull(mini):
+    out = mini.sql(
+        "select name, case when v between 15 and 35 then 'mid' "
+        "when v is null then 'none' else 'out' end as bucket "
+        "from t where name like '%' order by name").collect()
+    assert out.column("bucket").to_pylist() == [
+        "out", "mid", "mid", "out", "none"]
+
+
+def test_sql_exists_and_in(mini):
+    got = mini.sql("select name from t where exists "
+                   "(select * from u where u.k = t.k) order by name"
+                   ).collect()
+    assert got.column("name").to_pylist() == ["a", "b", "c", "d"]
+    got = mini.sql("select name from t where k not in (select k from u) "
+                   "order by name").collect()
+    assert got.column("name").to_pylist() == ["e"]
+
+
+def test_sql_scalar_subqueries(mini):
+    got = mini.sql("select name from t where v > (select avg(v) from t) "
+                   "order by name").collect()
+    assert got.column("name").to_pylist() == ["c", "d"]
+    # correlated with compound item
+    got = mini.sql(
+        "select name from t where v >= (select 2 * min(w) from u "
+        "where u.k = t.k) order by name").collect()
+    assert got.column("name").to_pylist() == ["a", "b", "c", "d"]
+
+
+def test_sql_derived_table_and_having(mini):
+    got = mini.sql(
+        "select big_k, count(*) as n from "
+        "(select k as big_k, sum(v) as sv from t group by k having "
+        "sum(v) > 25) as s group by big_k order by big_k").collect()
+    assert got.to_pydict() == {"big_k": [1, 2], "n": [1, 1]}
+
+
+def test_sql_error_messages(mini):
+    with pytest.raises(SqlError):
+        mini.sql("select nosuchcol from t")
+    with pytest.raises(SqlError, match="ambiguous"):
+        mini.sql("select k from t, u where t.k = u.k")
+    with pytest.raises(KeyError, match="not found"):
+        mini.sql("select * from nosuchtable")
+
+
+def test_sql_date_interval_folding(mini):
+    import datetime
+    s = mini
+    d = pa.table({"d": pa.array([datetime.date(1998, 9, 1),
+                                 datetime.date(1998, 9, 3)])})
+    s.create_dataframe(d).createOrReplaceTempView("dates")
+    got = s.sql("select d from dates where "
+                "d <= date '1998-12-01' - interval '90' day").collect()
+    assert got.column("d").to_pylist() == [datetime.date(1998, 9, 1)]
+    got = s.sql("select d from dates where "
+                "d < date '1997-09-02' + interval '1' year").collect()
+    assert got.num_rows == 1
