@@ -267,6 +267,18 @@ SHUFFLE_TRANSPORT_CLASS = _conf(
     "implement the same traits. Mesh-local exchanges bypass this entirely via the ICI "
     "all_to_all path (shuffle/ici.py).")
 
+SHUFFLE_TCP_PORT = _conf(
+    "shuffle.tcp.listenPort", int, 0,
+    "Listen port of the TCP shuffle transport's management/data socket "
+    "(UCX.scala:113 startManagementPort analog); 0 picks an ephemeral port, "
+    "published through the registry directory.")
+
+SHUFFLE_TCP_REGISTRY = _conf(
+    "shuffle.tcp.registryDir", str, "",
+    "Directory where TCP-transport executors publish their host:port for peer "
+    "discovery (the management-handshake rendezvous; shared storage or the "
+    "control plane's executor registry on a real cluster).")
+
 SHUFFLE_MAX_INFLIGHT_BYTES = _conf(
     "shuffle.maxReceiveInflightBytes", int, 1 << 30,
     "Per-client cap on bytes of shuffle data in flight "
@@ -395,6 +407,12 @@ class TpuConf:
 
     @property
     def shuffle_transport_class(self) -> str: return self.get(SHUFFLE_TRANSPORT_CLASS)
+
+    @property
+    def shuffle_tcp_port(self) -> int: return self.get(SHUFFLE_TCP_PORT)
+
+    @property
+    def shuffle_tcp_registry(self) -> str: return self.get(SHUFFLE_TCP_REGISTRY)
 
     @property
     def shuffle_max_inflight_bytes(self) -> int:

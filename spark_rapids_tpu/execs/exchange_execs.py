@@ -197,12 +197,12 @@ def range_partition_ids(xp, orders: Sequence[SortOrder], row_keys: Sequence[ColV
 def split_by_pid(xp, colvs: Sequence[ColV], pids, num_rows, n: int):
     """Stable partition-major reorder + per-partition counts — the
     Table.partition + contiguousSplit analog. Dead (padding) rows sort to a
-    virtual partition n at the back. Returns (reordered colvs, counts[n])."""
+    virtual partition n at the back. One variadic sort carries every column
+    (no per-column gathers). Returns (reordered colvs, counts[n])."""
     cap = pids.shape[0]
     alive = bk.alive_mask(xp, cap, num_rows)
     key = xp.where(alive, pids, np.int32(n))
-    order = bk._stable_argsort(xp, key)
-    out = [bk.take_colv(xp, v, order) for v in colvs]
+    out, _ = bk.sort_colvs(xp, [key], colvs)
     if xp is np:
         counts = np.bincount(key, minlength=n + 1)[:n].astype(np.int64)
     else:

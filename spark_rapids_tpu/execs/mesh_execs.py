@@ -730,10 +730,12 @@ class MeshSortExec(MeshExec):
             def fn(rows, *flat):
                 colvs = unflatten_colvs(schema, flat)
                 ectx = EvalCtx(jnp, colvs, cap, smax)
-                keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
-                        for o in orders]
-                order = bk.sort_indices(jnp, keys, rows[0])
-                out_cols = bk.take_columns(jnp, colvs, order)
+                alive = bk.alive_mask(jnp, cap, rows[0])
+                passes = [jnp.logical_not(alive).astype(np.int8)]
+                for o in orders:
+                    passes.extend(bk._key_passes(jnp, o.child.eval(ectx),
+                                                 o.ascending, o.nulls_first))
+                out_cols, _ = bk.sort_colvs(jnp, passes, colvs)
                 return tuple(flatten_colvs(out_cols))
             return fn
 

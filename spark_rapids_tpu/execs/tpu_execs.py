@@ -333,10 +333,14 @@ class TpuSortExec(PhysicalExec):
             def fn(num_rows, *flat):
                 colvs = _unflatten_colvs(schema, flat)
                 ectx = EvalCtx(jnp, colvs, cap, smax)
-                keys = [(o.child.eval(ectx), o.ascending, o.nulls_first)
-                        for o in orders]
-                order = bk.sort_indices(jnp, keys, num_rows)
-                out_cols = bk.take_columns(jnp, colvs, order)
+                alive = bk.alive_mask(jnp, cap, num_rows)
+                # dead rows last, then the order keys — ONE variadic sort
+                # carrying every column (no per-column gathers)
+                passes = [jnp.logical_not(alive).astype(np.int8)]
+                for o in orders:
+                    passes.extend(bk._key_passes(jnp, o.child.eval(ectx),
+                                                 o.ascending, o.nulls_first))
+                out_cols, _ = bk.sort_colvs(jnp, passes, colvs)
                 return tuple(_flatten_colvs(out_cols))
             return fn
 

@@ -63,28 +63,39 @@ def group_aggregate(xp, ctx: EvalCtx, key_exprs, agg_fns: Sequence[AggregateFunc
     collision = xp.asarray(False)
     out_cap = capacity
     if keys:
+        # ONE variadic sort carries every key and aggregation buffer with the
+        # sort keys — no argsort + per-column gathers (a TPU gather costs
+        # ~2x the sort itself; see bk.multi_sort)
+        flat_projs = [b for bufs in projections for b in bufs]
         if grouping == "hash":
-            order, hashes = bk.hash_group_order(xp, keys, alive)
+            h = bk.hash64_cols(xp, keys)
+            hs = h >> np.uint64(1)
+            # dead rows sort last: max uint64, unreachable by h >> 1
+            passes = [xp.where(alive, hs,
+                               np.uint64(0xFFFFFFFFFFFFFFFF))]
+            extras = [alive, hs]
         else:
-            order = bk.sort_indices(xp, [(k, True, True) for k in keys], alive)
-        starts = bk.rows_equal_adjacent(xp, keys, order, alive)
+            passes = [xp.logical_not(alive).astype(np.int8)]
+            for k in keys:
+                passes.extend(bk._key_passes(xp, k, True, True))
+            extras = [alive]
+        sorted_all, sorted_extras = bk.sort_colvs(
+            xp, passes, list(keys) + flat_projs, extras)
+        sorted_keys = sorted_all[:len(keys)]
+        sorted_alive = sorted_extras[0]
+        starts = bk.starts_from_sorted(xp, sorted_keys, sorted_alive)
         if grouping == "hash":
-            collision = bk.detect_hash_collision(xp, hashes, order, starts,
-                                                 alive)
+            collision = bk.detect_hash_collision_sorted(
+                xp, sorted_extras[1], starts, sorted_alive)
         gids = xp.cumsum(starts.astype(np.int32)) - 1
         gids = xp.clip(gids, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
-        sorted_alive = alive[order]
-        flat_projs = [b for bufs in projections for b in bufs]
-        taken = bk.take_columns(xp, list(keys) + flat_projs, order)
-        sorted_keys = taken[:len(keys)]
         sorted_projs = []
         i = len(keys)
         for bufs in projections:
-            sorted_projs.append(taken[i:i + len(bufs)])
+            sorted_projs.append(sorted_all[i:i + len(bufs)])
             i += len(bufs)
     else:
-        order = xp.arange(capacity, dtype=np.int32)
         gids = xp.zeros(capacity, dtype=np.int32)
         num_groups = xp.asarray(np.int32(1))
         sorted_alive = alive
@@ -409,15 +420,17 @@ def merge_aggregate(xp, key_cols: Sequence[ColV], buffer_cols: Sequence[ColV],
                    for b in buffer_cols]
 
     if key_cols:
-        order = bk.sort_indices(xp, [(k, True, True) for k in key_cols],
-                                alive)
-        starts = bk.rows_equal_adjacent(xp, key_cols, order, alive)
+        passes = [xp.logical_not(alive).astype(np.int8)]
+        for k in key_cols:
+            passes.extend(bk._key_passes(xp, k, True, True))
+        sorted_all, sorted_extras = bk.sort_colvs(
+            xp, passes, list(key_cols) + list(buffer_cols), [alive])
+        sorted_keys = sorted_all[:len(key_cols)]
+        sorted_bufs = sorted_all[len(key_cols):]
+        sorted_alive = sorted_extras[0]
+        starts = bk.starts_from_sorted(xp, sorted_keys, sorted_alive)
         gids = xp.clip(xp.cumsum(starts.astype(np.int32)) - 1, 0, capacity - 1)
         num_groups = xp.sum(starts).astype(np.int32)
-        sorted_alive = alive[order]
-        taken = bk.take_columns(xp, list(key_cols) + list(buffer_cols), order)
-        sorted_keys = taken[:len(key_cols)]
-        sorted_bufs = taken[len(key_cols):]
     else:
         gids = xp.zeros(capacity, dtype=np.int32)
         num_groups = xp.asarray(np.int32(1))

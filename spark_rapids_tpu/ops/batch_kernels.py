@@ -187,19 +187,202 @@ def take_colv(xp, v: ColV, indices) -> ColV:
     return ColV(v.dtype, v.data[indices], v.validity[indices])
 
 
+# ---------------------------------------------------------------------------
+# variadic payload sort — the TPU replacement for argsort + gathers
+# ---------------------------------------------------------------------------
+# On TPU a random-access gather of n rows costs ~2x the SORT of n rows (the
+# sorting network streams memory; gathers do not vectorize), so
+# "argsort + one gather per column" is the single most expensive pattern in
+# the engine. XLA's variadic sort moves payload operands WITH the keys, so
+# one lax.sort replaces the argsort and every gather.
+
+def multi_sort(xp, passes: Sequence, payloads: Sequence):
+    """Stable lexicographic sort by ``passes`` (most significant first),
+    carrying ``payloads`` along. Returns (sorted_passes, sorted_payloads)."""
+    if xp is np:
+        order = np.lexsort(tuple(reversed([np.asarray(p) for p in passes])))
+        return ([np.asarray(p)[order] for p in passes],
+                [np.asarray(p)[order] for p in payloads])
+    import jax
+    res = jax.lax.sort(tuple(passes) + tuple(payloads),
+                       num_keys=len(passes), is_stable=True)
+    return list(res[:len(passes)]), list(res[len(passes):])
+
+
+def _pack_bytes(xp, data):
+    """[n, W] uint8 -> list of [n] uint64 big-endian words (strings ride a
+    variadic sort as a few word operands instead of a 2-D gather)."""
+    n, W = data.shape
+    n_words = (W + 7) // 8
+    pad = n_words * 8 - W
+    if pad:
+        data = xp.concatenate([data, xp.zeros((n, pad), np.uint8)], axis=-1)
+    chunks = data.reshape(n, n_words, 8).astype(np.uint64)
+    shifts = xp.asarray(np.arange(56, -8, -8, dtype=np.uint64))
+    words = xp.sum(chunks << shifts[None, None, :], axis=-1)
+    return [words[:, i] for i in range(n_words)]
+
+
+def _unpack_bytes(xp, words: Sequence, W: int):
+    stacked = xp.stack(list(words), axis=1)          # [n, n_words]
+    shifts = xp.asarray(np.arange(56, -8, -8, dtype=np.uint64))
+    bytes_ = ((stacked[:, :, None] >> shifts[None, None, :])
+              & np.uint64(0xFF)).astype(np.uint8)
+    n = stacked.shape[0]
+    return bytes_.reshape(n, len(words) * 8)[:, :W]
+
+
+#: XLA TPU compile time for a variadic sort grows steeply with operand
+#: count; above this many payload operands the argsort+gather fallback is
+#: cheaper end-to-end (compile once vs run many notwithstanding)
+MAX_SORT_PAYLOADS = 16
+
+
+def sort_colvs(xp, passes: Sequence, colvs: Sequence[ColV],
+               extras: Sequence = ()):
+    """Sort whole columns by the key passes in ONE pass: device side uses a
+    single variadic lax.sort (string payloads packed into uint64 words,
+    duplicate arrays sorted once, all validity vectors bit-packed into one
+    word operand); the CPU side keeps lexsort + gathers. Returns
+    (sorted colvs, sorted extras). Ordering is identical across engines
+    (both stable lexicographic)."""
+    if xp is np:
+        order = np.lexsort(tuple(reversed([np.asarray(p) for p in passes])))
+        return ([take_colv(np, v, order) for v in colvs],
+                [np.asarray(e)[order] for e in extras])
+    # dedup payload arrays by identity: BoundReference evaluation returns the
+    # SAME tracer for repeated uses of a column (sum(x) and avg(x) share x),
+    # so each distinct buffer rides the sort once
+    slot_of: dict = {}
+    payloads: List = []
+    bools: List = []          # validity vectors, bit-packed into u64 words
+    bool_slot: dict = {}
+
+    def add(a):
+        key = id(a)
+        if key not in slot_of:
+            slot_of[key] = len(payloads)
+            payloads.append(a)
+        return slot_of[key]
+
+    def add_bool(a):
+        key = id(a)
+        if key not in bool_slot:
+            bool_slot[key] = len(bools)
+            bools.append(a)
+        return bool_slot[key]
+
+    specs = []
+    for v in colvs:
+        if v.dtype is DType.STRING:
+            words = _pack_bytes(xp, v.data)
+            specs.append((v.dtype, [add(w) for w in words],
+                          v.data.shape[-1], add(v.lengths),
+                          add_bool(v.validity)))
+        else:
+            specs.append((v.dtype, None, 0, add(v.data),
+                          add_bool(v.validity)))
+    extra_slots = []
+    for e in extras:
+        if getattr(e, "dtype", None) == np.bool_:
+            extra_slots.append(("b", add_bool(e)))
+        else:
+            extra_slots.append(("p", add(e)))
+    n_bool_words = (len(bools) + 63) // 64
+    packed_bools = []
+    for w in range(n_bool_words):
+        chunk = bools[w * 64:(w + 1) * 64]
+        word = None
+        for i, b in enumerate(chunk):
+            piece = b.astype(np.uint64) << np.uint64(i)
+            word = piece if word is None else word | piece
+        packed_bools.append(word)
+
+    all_payloads = payloads + packed_bools
+    if len(all_payloads) > MAX_SORT_PAYLOADS:
+        # too many operands for a fast compile: one sort for the permutation,
+        # then gathers (the pre-variadic pattern)
+        cap = passes[0].shape[0]
+        iota = xp.arange(cap, dtype=np.int32)
+        _, (order,) = multi_sort(xp, passes, [iota])
+        return ([take_colv(xp, v, order) for v in colvs],
+                [e[order] for e in extras])
+
+    _, sp = multi_sort(xp, passes, all_payloads)
+    sorted_bools = []
+    for w in range(n_bool_words):
+        word = sp[len(payloads) + w]
+        sorted_bools.extend(
+            ((word >> np.uint64(i)) & np.uint64(1)).astype(bool)
+            for i in range(min(64, len(bools) - w * 64)))
+    out = []
+    for dt, word_slots, W, data_slot, valid_slot in specs:
+        if word_slots is not None:
+            data = _unpack_bytes(xp, [sp[s] for s in word_slots], W)
+            out.append(ColV(dt, data, sorted_bools[valid_slot],
+                            sp[data_slot]))
+        else:
+            out.append(ColV(dt, sp[data_slot], sorted_bools[valid_slot]))
+    sorted_extras = [sorted_bools[s] if kind == "b" else sp[s]
+                     for kind, s in extra_slots]
+    return out, sorted_extras
+
+
+def starts_from_sorted(xp, sorted_keys: Sequence[ColV], sorted_alive):
+    """Group-start marks over ALREADY-SORTED key columns (the adjacent
+    compare of rows_equal_adjacent without the order indirection)."""
+    cap = sorted_alive.shape[0]
+    first = xp.arange(cap) == 0
+    new_group = xp.zeros(cap, dtype=bool)
+
+    def prev(a):
+        return xp.concatenate([a[:1], a[:-1]], axis=0)
+
+    for v in sorted_keys:
+        a_valid = v.validity
+        b_valid = prev(v.validity)
+        if v.dtype is DType.STRING:
+            same_data = xp.logical_and(
+                xp.all(v.data == prev(v.data), axis=-1),
+                v.lengths == prev(v.lengths))
+        elif v.dtype.is_floating:
+            a, b = v.data, prev(v.data)
+            same_data = xp.logical_or(
+                a == b, xp.logical_and(xp.isnan(a), xp.isnan(b)))
+        else:
+            same_data = v.data == prev(v.data)
+        same = xp.where(xp.logical_and(a_valid, b_valid), same_data,
+                        a_valid == b_valid)
+        new_group = xp.logical_or(new_group, xp.logical_not(same))
+    new_group = xp.logical_or(new_group, first)
+    return xp.logical_and(new_group, sorted_alive)
+
+
+def detect_hash_collision_sorted(xp, hs_sorted, starts, sorted_alive):
+    """Collision flag over hash-sorted rows: a group boundary between two
+    alive rows with the same (shifted) hash means two distinct keys collided."""
+    prev_h = xp.concatenate([hs_sorted[:1], hs_sorted[:-1]])
+    prev_a = xp.concatenate([xp.zeros(1, dtype=bool), sorted_alive[:-1]])
+    return xp.any(xp.logical_and(
+        xp.logical_and(starts, hs_sorted == prev_h),
+        xp.logical_and(sorted_alive, prev_a)))
+
+
 def compact(xp, mask, columns: Sequence[ColV], num_rows):
     """Move rows where mask is true to the front, preserving order; invalidate the
     rest. Returns (columns, new_count). Replaces cudf Table.filter.
 
-    ``mask`` must already be False for padding rows (>= num_rows).
+    ``mask`` must already be False for padding rows (>= num_rows). One
+    variadic sort on device (no per-column gathers).
     """
     keep = xp.asarray(mask, dtype=bool)
-    order = _stable_argsort(xp, xp.logical_not(keep))  # kept rows first, stable
     new_count = xp.sum(keep).astype(np.int32)
     cap = keep.shape[0]
     alive = xp.arange(cap, dtype=np.int32) < new_count
+    sorted_cols, _ = sort_colvs(
+        xp, [xp.logical_not(keep).astype(np.int8)], columns)
     out = [g.with_validity(xp.logical_and(g.validity, alive))
-           for g in take_columns(xp, columns, order)]
+           for g in sorted_cols]
     return out, new_count
 
 

@@ -1,0 +1,383 @@
+"""Cross-host TCP shuffle transport.
+
+Reference analog: the UCX transport (shuffle-plugin ucx/UCX.scala:53) — a
+management-port handshake (UCX.scala:113 startManagementPort), a dedicated
+progress thread per connection draining completions, and tag-addressed
+transfers. This is the DCN-path equivalent over plain sockets: executors in
+DIFFERENT PROCESSES (or hosts) exchange shuffle buffers through framed
+messages; the in-process transport remains the intra-host fast path, exactly
+as the reference keeps host-local optimizations next to UCX.
+
+Wire format (all big-endian):
+  frame   := kind(1) tag(8) length(4) payload[length]
+  kinds   := H (hello: payload = executor id)
+             Q (request: payload = type_len(2) type body; tag = request id)
+             P (response: payload = status(1) body; tag = request id)
+             D (data: tag-addressed buffer)
+
+Peer discovery uses a registry directory (the management rendezvous): every
+transport writes ``<registry>/<executor_id>`` containing ``host:port``;
+connect() polls the peer's file. On a cluster this directory is shared
+storage or is replaced by the control plane's executor registry.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                ClientConnection,
+                                                ServerConnection,
+                                                ShuffleTransport, Transaction,
+                                                TransactionStatus)
+
+_HDR = struct.Struct(">cQI")
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock, kind: bytes,
+                tag: int, payload: bytes) -> None:
+    with lock:
+        sock.sendall(_HDR.pack(kind, tag, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Peer:
+    """One live socket + its writer lock and reader (progress) thread."""
+
+    def __init__(self, transport: "TcpTransport", sock: socket.socket,
+                 peer_id: str = "?"):
+        self.transport = transport
+        self.sock = sock
+        self.peer_id = peer_id
+        self.wlock = threading.Lock()
+        self.reader = threading.Thread(target=self._read_loop,
+                                       name=f"tcp-shuffle-reader-{peer_id}",
+                                       daemon=True)
+        self.reader.start()
+
+    def _read_loop(self) -> None:
+        t = self.transport
+        try:
+            while True:
+                hdr = _recv_exact(self.sock, _HDR.size)
+                if hdr is None:
+                    break
+                kind, tag, length = _HDR.unpack(hdr)
+                payload = _recv_exact(self.sock, length) if length else b""
+                if payload is None and length:
+                    break
+                if kind == b"H":
+                    self.peer_id = payload.decode()
+                    t._register_peer(self.peer_id, self)
+                elif kind == b"D":
+                    t._on_data(tag, payload)
+                elif kind == b"P":
+                    t._on_response(tag, payload)
+                elif kind == b"Q":
+                    t._on_request(self, tag, payload)
+        except Exception as e:  # noqa: BLE001 - fail pending work, not hang
+            t._peer_lost(self, f"{type(e).__name__}: {e}")
+            return
+        t._peer_lost(self, "connection closed")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TcpClientConnection(ClientConnection):
+    def __init__(self, transport: "TcpTransport", peer: _Peer):
+        self._t = transport
+        self._peer = peer
+        self.peer_executor_id = peer.peer_id
+
+    def request(self, req_type: str, payload: bytes,
+                cb: Callable[[Transaction], None]) -> Transaction:
+        tx = Transaction().start(cb)
+        rid = self._t._next_request_id()
+        self._t._pending_rpcs[rid] = tx
+        body = (struct.pack(">H", len(req_type)) + req_type.encode()
+                + payload)
+        try:
+            _send_frame(self._peer.sock, self._peer.wlock, b"Q", rid, body)
+        except OSError as e:
+            self._t._pending_rpcs.pop(rid, None)
+            tx.complete(TransactionStatus.ERROR, f"send failed: {e}")
+        return tx
+
+    def send(self, alt: AddressLengthTag, cb) -> Transaction:
+        return self._t._async_send(self._peer, alt, cb)
+
+    def receive(self, alt: AddressLengthTag, cb) -> Transaction:
+        tx = Transaction(alt.tag).start(cb)
+        self._t._post_receive(alt, tx)
+        return tx
+
+
+class TcpServerConnection(ServerConnection):
+    def __init__(self, transport: "TcpTransport"):
+        self._t = transport
+
+    def register_request_handler(self, req_type: str,
+                                 handler: Callable[[str, bytes], bytes]
+                                 ) -> None:
+        self._t._handlers[req_type] = handler
+
+    def send(self, peer_executor_id: str, alt: AddressLengthTag,
+             cb) -> Transaction:
+        """Server-initiated data ride the SAME socket the peer opened (the
+        reference's server sends to the client's tag space)."""
+        peer = self._t._peer_by_id(peer_executor_id)
+        if peer is None:
+            tx = Transaction(alt.tag).start(cb)
+            self._t._progress_put(lambda: tx.complete(
+                TransactionStatus.ERROR,
+                f"no connection from {peer_executor_id!r}"))
+            return tx
+        return self._t._async_send(peer, alt, cb)
+
+
+class TcpTransport(ShuffleTransport):
+    """conf spark.rapids.tpu.shuffle.transport.class =
+    spark_rapids_tpu.shuffle.tcp.TcpTransport"""
+
+    def __init__(self, executor_id: str, conf=None):
+        super().__init__(executor_id, conf)
+        self._handlers: Dict[str, Callable[[str, bytes], bytes]] = {}
+        self._pending_rpcs: Dict[int, Transaction] = {}
+        self._rpc_id = 0
+        self._rpc_lock = threading.Lock()
+        self._tag_lock = threading.Lock()
+        self._pending_recvs: Dict[int, Tuple[AddressLengthTag, Transaction]] = {}
+        self._early_data: Dict[int, bytes] = {}
+        self._peers: Dict[str, _Peer] = {}
+        self._clients: Dict[str, TcpClientConnection] = {}
+        self._clients_lock = threading.Lock()
+        self._server_conn = TcpServerConnection(self)
+        # worker pool for request handlers (the server copy-executor role)
+        import queue as _q
+        self._work: "_q.Queue[Optional[Callable[[], None]]]" = _q.Queue()
+        for i in range(2):
+            threading.Thread(target=self._work_loop, daemon=True,
+                             name=f"tcp-shuffle-server-{executor_id}-{i}"
+                             ).start()
+        # progress thread: ALL send completions run here, never inline on the
+        # caller (the reference's single-progress-thread contract — callers
+        # hold their own state locks when issuing sends, UCX.scala:70-112)
+        self._progress: "_q.Queue[Optional[Callable[[], None]]]" = _q.Queue()
+        threading.Thread(target=self._progress_loop, daemon=True,
+                         name=f"tcp-shuffle-progress-{executor_id}").start()
+        # management port: listen + registry publication (UCX.scala:113)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", self.conf.shuffle_tcp_port))
+        self._listener.listen(16)
+        self.address = self._listener.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"tcp-shuffle-accept-{executor_id}").start()
+        self._registry = self.conf.shuffle_tcp_registry
+        if self._registry:
+            os.makedirs(self._registry, exist_ok=True)
+            path = os.path.join(self._registry, executor_id)
+            with open(path + ".tmp", "w") as f:
+                f.write(f"{self.address[0]}:{self.address[1]}")
+            os.replace(path + ".tmp", path)
+
+    # ---- plumbing ----------------------------------------------------------
+    def _progress_loop(self) -> None:
+        while True:
+            fn = self._progress.get()
+            if fn is None:
+                return
+            fn()
+
+    def _progress_put(self, fn: Callable[[], None]) -> None:
+        self._progress.put(fn)
+
+    def _async_send(self, peer: _Peer, alt: AddressLengthTag,
+                    cb) -> Transaction:
+        tx = Transaction(alt.tag).start(cb)
+        data = bytes(alt.buffer[:alt.length])
+
+        def run():
+            try:
+                _send_frame(peer.sock, peer.wlock, b"D", alt.tag, data)
+                tx.stats.sent_bytes = len(data)
+                tx.complete(TransactionStatus.SUCCESS)
+            except OSError as e:
+                tx.complete(TransactionStatus.ERROR, f"send failed: {e}")
+        self._progress_put(run)
+        return tx
+
+    def _work_loop(self) -> None:
+        while True:
+            fn = self._work.get()
+            if fn is None:
+                return
+            fn()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _Peer(self, sock)
+
+    def _register_peer(self, peer_id: str, peer: _Peer) -> None:
+        self._peers[peer_id] = peer
+
+    def _peer_lost(self, peer: _Peer, reason: str) -> None:
+        """A reader exited: every pending transaction fails NOW (a silent
+        hang until the fetch timeout is strictly worse than an error — the
+        iterator's ShuffleFetchFailedError drives the stage retry)."""
+        with self._tag_lock:
+            recvs = list(self._pending_recvs.values())
+            self._pending_recvs.clear()
+        rpcs = list(self._pending_rpcs.values())
+        self._pending_rpcs.clear()
+
+        def fail():
+            msg = f"peer {peer.peer_id!r} lost: {reason}"
+            for _, tx in recvs:
+                tx.complete(TransactionStatus.ERROR, msg)
+            for tx in rpcs:
+                tx.complete(TransactionStatus.ERROR, msg)
+        self._progress_put(fail)
+
+    def _peer_by_id(self, peer_id: str) -> Optional[_Peer]:
+        return self._peers.get(peer_id)
+
+    def _next_request_id(self) -> int:
+        with self._rpc_lock:
+            self._rpc_id += 1
+            return self._rpc_id
+
+    def _post_receive(self, alt: AddressLengthTag, tx: Transaction) -> None:
+        with self._tag_lock:
+            data = self._early_data.pop(alt.tag, None)
+            if data is None:
+                self._pending_recvs[alt.tag] = (alt, tx)
+                return
+        # complete on the progress thread, NEVER inline: the poster holds its
+        # own state lock (inprocess._TagTable defers the same way)
+        self._progress_put(lambda: self._fill(alt, tx, data))
+
+    def _on_data(self, tag: int, payload: bytes) -> None:
+        with self._tag_lock:
+            pending = self._pending_recvs.pop(tag, None)
+            if pending is None:
+                self._early_data[tag] = payload   # send raced ahead of recv
+                return
+        alt, tx = pending
+        self._fill(alt, tx, payload)
+
+    @staticmethod
+    def _fill(alt: AddressLengthTag, tx: Transaction, data: bytes) -> None:
+        n = min(len(data), alt.length)
+        alt.buffer[:n] = data[:n]
+        tx.stats.received_bytes = n
+        tx.complete(TransactionStatus.SUCCESS)
+
+    def _on_response(self, rid: int, payload: bytes) -> None:
+        tx = self._pending_rpcs.pop(rid, None)
+        if tx is None:
+            return
+        ok = payload[:1] == b"\x00"
+        tx.response = payload[1:]
+        tx.stats.received_bytes = len(tx.response)
+        if ok:
+            tx.complete(TransactionStatus.SUCCESS)
+        else:
+            tx.complete(TransactionStatus.ERROR,
+                        payload[1:].decode(errors="replace"))
+
+    def _on_request(self, peer: _Peer, rid: int, body: bytes) -> None:
+        (tlen,) = struct.unpack(">H", body[:2])
+        req_type = body[2:2 + tlen].decode()
+        payload = body[2 + tlen:]
+
+        def run():
+            handler = self._handlers.get(req_type)
+            try:
+                if handler is None:
+                    raise KeyError(f"no handler for {req_type!r}")
+                resp = b"\x00" + handler(peer.peer_id, payload)
+            except Exception as e:  # noqa: BLE001 - propagated to the peer
+                resp = b"\x01" + f"{type(e).__name__}: {e}".encode()
+            try:
+                _send_frame(peer.sock, peer.wlock, b"P", rid, resp)
+            except OSError:
+                pass
+        self._work.put(run)
+
+    # ---- transport API -----------------------------------------------------
+    def connect(self, peer_executor_id: str) -> TcpClientConnection:
+        with self._clients_lock:
+            conn = self._clients.get(peer_executor_id)
+            if conn is not None:
+                return conn
+        host, port = self._resolve(peer_executor_id)
+        sock = socket.create_connection((host, port), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        peer = _Peer(self, sock, peer_executor_id)
+        self._register_peer(peer_executor_id, peer)
+        _send_frame(sock, peer.wlock, b"H", 0, self.executor_id.encode())
+        conn = TcpClientConnection(self, peer)
+        with self._clients_lock:
+            self._clients[peer_executor_id] = conn
+        return conn
+
+    def _resolve(self, peer_executor_id: str, timeout: float = 30.0
+                 ) -> Tuple[str, int]:
+        if ":" in peer_executor_id:          # direct host:port addressing
+            host, _, port = peer_executor_id.rpartition(":")
+            return host, int(port)
+        if not self._registry:
+            raise ConnectionError(
+                f"cannot resolve {peer_executor_id!r}: no registry dir "
+                f"(spark.rapids.tpu.shuffle.tcp.registryDir)")
+        path = os.path.join(self._registry, peer_executor_id)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with open(path) as f:
+                    host, _, port = f.read().strip().rpartition(":")
+                    return host, int(port)
+            except (FileNotFoundError, ValueError):
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"executor {peer_executor_id!r} never registered "
+                        f"in {self._registry}") from None
+                time.sleep(0.05)
+
+    @property
+    def server(self) -> TcpServerConnection:
+        return self._server_conn
+
+    def shutdown(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for p in self._peers.values():
+            p.close()
+        self._work.put(None)
+        self._work.put(None)
+        self._progress.put(None)

@@ -203,9 +203,14 @@ class Parser:
                 op = "<>"
             left = A.BinOp(op, left, right)
 
+    #: binding power of the predicate postfixes (BETWEEN/IN/LIKE/IS NULL):
+    #: looser than arithmetic/comparison, tighter than NOT/AND
+    _POSTFIX_BP = 30
+
     def _postfix(self, left: A.Node, min_bp: int) -> A.Node:
-        """BETWEEN / IN / LIKE / IS [NOT] NULL — bind tighter than AND."""
-        if _BP["and"] >= min_bp or True:
+        """BETWEEN / IN / LIKE / IS [NOT] NULL — bind looser than arithmetic
+        (a + 1 BETWEEN x AND y predicates over a + 1), tighter than AND."""
+        if min_bp <= self._POSTFIX_BP:
             negated = False
             save = self.i
             if self.at_kw("not"):

@@ -356,6 +356,11 @@ def _func(node: A.FuncCall, scope: Scope) -> Column:
                 return F.count()
             inner = to_column(node.args[0], scope)
             return F.countDistinct(inner) if node.distinct else F.count(inner)
+        if name in ("corr", "covar_samp", "covar_pop"):
+            two = {"corr": F.corr, "covar_samp": F.covar_samp,
+                   "covar_pop": F.covar_pop}[name]
+            return two(to_column(node.args[0], scope),
+                       to_column(node.args[1], scope))
         fn = {"sum": F.sum, "avg": F.avg, "min": F.min, "max": F.max,
               "stddev": F.stddev, "stddev_pop": F.stddev_pop,
               "variance": F.variance, "var_pop": F.var_pop,
@@ -407,14 +412,17 @@ class SqlPlanner:
             else:
                 conjs.append(c)
 
-        # push single-relation conjuncts below the joins
+        # push single-relation conjuncts below the joins — except into the
+        # null-producing side of an outer join, where a WHERE filter must run
+        # post-join (it sees the null-extended rows; standard SQL semantics)
+        nullable = self._nullable_aliases(stmt)
         remaining: List[A.Node] = []
         for c in conjs:
             aliases = self._aliases_of(c, scope, outer)
             if aliases == "outer":
                 remaining.append(c)
                 continue
-            if len(aliases) == 1:
+            if len(aliases) == 1 and next(iter(aliases)) not in nullable:
                 a = next(iter(aliases))
                 r = next(r for r in rels if r.alias == a)
                 sub_scope = Scope([(r.alias, r.raw_cols)])
@@ -456,6 +464,26 @@ class SqlPlanner:
                                 for c in out_names])
             return _Rel(rel.alias, pref, out_names)
         raise SqlError(f"unsupported FROM item {type(rel).__name__}")
+
+    def _nullable_aliases(self, stmt: A.Select):
+        """Aliases whose columns may be null-extended by an outer join (the
+        right side of LEFT, everything before a RIGHT, everyone under FULL)."""
+        out = set()
+        seen = []
+        for item in stmt.relations:
+            rel = item.relation if isinstance(item, A.JoinItem) else item
+            alias = (rel.alias if isinstance(rel, A.SubqueryRef)
+                     else (rel.alias or rel.name))
+            if isinstance(item, A.JoinItem):
+                if item.how == "left":
+                    out.add(alias)
+                elif item.how == "right":
+                    out.update(seen)
+                elif item.how == "full":
+                    out.update(seen)
+                    out.add(alias)
+            seen.append(alias)
+        return out
 
     def _aliases_of(self, c: A.Node, scope: Scope, outer: Optional[Scope]):
         aliases = set()
@@ -685,12 +713,13 @@ class SqlPlanner:
         rels = self._relations(stmt)
         scope = Scope([(r.alias, r.raw_cols) for r in rels])
         conjs, join_conds, remaining, sub_preds = [], [], [], []
+        nullable = self._nullable_aliases(stmt)
         for c in _conjuncts(stmt.where):
             if _has_subquery(c):
                 sub_preds.append(c)
                 continue
             aliases = self._aliases_of(c, scope, None)
-            if len(aliases) == 1:
+            if len(aliases) == 1 and next(iter(aliases)) not in nullable:
                 a = next(iter(aliases))
                 r = next(r for r in rels if r.alias == a)
                 r.df = r.df.filter(to_column(
@@ -750,6 +779,20 @@ class SqlPlanner:
             # distinct — Q18's HAVING sum(...) > 300 shape)
             sub_df, names = self.plan(q)
             oc, df = self._key_col(df, pred.value, scope)
+            if pred.negated:
+                # three-valued NOT IN (Catalyst's null-aware anti join): any
+                # NULL in the subquery, or a NULL probe value, yields UNKNOWN
+                # — the row is filtered unless the subquery is empty
+                n, nn = self._name("cnt"), self._name("nulls")
+                flags = sub_df.agg(
+                    F.count().alias(n),
+                    F.sum(F.when(col(names[0]).isNull(), 1).otherwise(0))
+                    .alias(nn))
+                df = df.crossJoin(flags)
+                df = df.filter((col(n) == 0)
+                               | (col(oc).isNotNull()
+                                  & (F.coalesce(col(nn), F.lit(0)) == 0)))
+                df = df.drop(n, nn)
             return df.join(sub_df, [(oc, names[0])], how)
         if q.group_by or q.having:
             raise SqlError("correlated IN subqueries with GROUP BY are not "
@@ -881,10 +924,6 @@ class SqlPlanner:
                 name = scope.resolve(g)
                 key_cols.append(col(name))
                 group_names.append(name)
-                table[g] = A.ColRef(name.split(".", 1)[1]
-                                    if "." in name else name,
-                                    qualifier=None)
-                # keep both qualified and raw forms resolvable post-agg
                 table[g] = A.ColRef(name)
             else:
                 name = self._name("g")
@@ -927,16 +966,10 @@ class SqlPlanner:
         grouped = df.groupBy(*key_cols).agg(*agg_cols) if key_cols else \
             df.agg(*agg_cols)
 
-        # 3. post-agg scope: group names + agg hidden names
+        # 3. post-agg scope: group columns stay addressable by qualified or
+        # plain name, agg results by their hidden names
         for ast, name in aggs.items():
             table[ast] = A.ColRef(name)
-        post_scope = Scope(
-            [(alias, cols_) for alias, cols_ in scope.relations
-             if any(f"{alias}.{c}" in group_names for c in cols_)],
-            extras=[n for n in group_names if not n.startswith("__") or True]
-            + list(aggs.values()))
-        # qualified group columns stay addressable by their plain/qualified
-        # names; hidden names resolve via extras
         post_scope = _PostAggScope(group_names, list(aggs.values()))
 
         # 4. HAVING

@@ -160,10 +160,11 @@ def test_inflight_throttle_fifo():
 # end-to-end: two executors, cached write, remote fetch
 # ---------------------------------------------------------------------------------
 
-def two_env_cluster(tmp_path, codec="none"):
+def two_env_cluster(tmp_path, codec="none", conf_overrides=None):
     conf = TpuConf({"spark.rapids.tpu.shuffle.compression.codec": codec,
                     "spark.rapids.tpu.shuffle.bounceBuffers.size": 4096,
-                    "spark.rapids.tpu.shuffle.bounceBuffers.count": 8})
+                    "spark.rapids.tpu.shuffle.bounceBuffers.count": 8,
+                    **(conf_overrides or {})})
     e0 = ShuffleEnv("exec-0", conf, disk_dir=str(tmp_path / "e0"))
     e1 = ShuffleEnv("exec-1", conf, disk_dir=str(tmp_path / "e1"))
     mgr = ShuffleManager()

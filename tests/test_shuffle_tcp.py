@@ -1,0 +1,155 @@
+"""TCP shuffle transport: the cross-host DCN path (UCX.scala analog) over
+real sockets — same trait family as the in-process transport, exercised
+in-process over loopback AND across two OS processes."""
+import subprocess
+import sys
+import textwrap
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.shuffle.tcp import TcpTransport
+from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                TransactionStatus)
+
+
+def _conf(tmp_path):
+    return TpuConf({
+        "spark.rapids.tpu.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+        "spark.rapids.tpu.shuffle.tcp.registryDir": str(tmp_path / "reg"),
+        "spark.rapids.tpu.shuffle.bounceBuffers.size": 4096,
+        "spark.rapids.tpu.shuffle.bounceBuffers.count": 8,
+    })
+
+
+def test_tcp_rpc_and_tagged_transfer(tmp_path):
+    conf = _conf(tmp_path)
+    a = TcpTransport("exec-a", conf)
+    b = TcpTransport("exec-b", conf)
+    try:
+        b.server.register_request_handler(
+            "echo", lambda peer, payload: b"from-b:" + payload)
+        conn = a.connect("exec-b")
+        tx = conn.request("echo", b"hello", lambda t: None).wait(10)
+        assert tx.status is TransactionStatus.SUCCESS
+        assert tx.response == b"from-b:hello"
+
+        # tag-addressed transfer: b's server sends into a's posted receive
+        buf = AddressLengthTag(bytearray(11), 11, tag=0x42)
+        rx = conn.receive(buf, lambda t: None)
+        sb = AddressLengthTag.for_bytes(b"payload-abc", tag=0x42)
+        stx = b.server.send("exec-a", sb, lambda t: None).wait(10)
+        assert stx.status is TransactionStatus.SUCCESS
+        rx.wait(10)
+        assert bytes(buf.buffer) == b"payload-abc"
+
+        # error propagation: unknown handler -> transaction error
+        err = conn.request("nope", b"", lambda t: None).wait(10)
+        assert err.status is TransactionStatus.ERROR
+        assert "no handler" in err.error_message
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_tcp_early_send_matches_late_receive(tmp_path):
+    conf = _conf(tmp_path)
+    a = TcpTransport("exec-a2", conf)
+    b = TcpTransport("exec-b2", conf)
+    try:
+        conn = a.connect("exec-b2")
+        # client sends BEFORE the server posts the receive: the data parks in
+        # the early-data table and completes the receive when it arrives
+        conn.send(AddressLengthTag.for_bytes(b"xyzzy", tag=7),
+                  lambda t: None).wait(10)
+        import time
+        time.sleep(0.1)
+        buf = AddressLengthTag(bytearray(5), 5, tag=7)
+        # b posts the receive in its own transport (tag table is per process)
+        rx_conn = b.connect("exec-a2")
+        rx = rx_conn.receive(buf, lambda t: None).wait(10)
+        assert rx.status is TransactionStatus.SUCCESS
+        assert bytes(buf.buffer) == b"xyzzy"
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_two_executor_shuffle_roundtrip_over_tcp(tmp_path):
+    """The VERDICT bar: the full cached-write/remote-fetch shuffle protocol
+    (manager + catalogs + client/server state machines) riding the socket
+    transport instead of the in-process fabric."""
+    from tests.test_shuffle import (collect_partition, sample_table,
+                                    two_env_cluster, write_partitioned)
+    conf_overrides = {
+        "spark.rapids.tpu.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+        "spark.rapids.tpu.shuffle.tcp.registryDir": str(tmp_path / "reg"),
+    }
+    mgr, e0, e1 = two_env_cluster(tmp_path, conf_overrides=conf_overrides)
+    sid, _ = mgr.register_shuffle(2)
+    t0 = sample_table(120, seed=1)
+    t1 = sample_table(90, seed=2)
+    write_partitioned(mgr, e0, sid, 0, t0, 2)
+    write_partitioned(mgr, e1, sid, 1, t1, 2)
+    got = collect_partition(mgr, e0, sid, 0)
+    expected = pa.concat_tables([t0.take(list(range(0, 120, 2))),
+                                 t1.take(list(range(0, 90, 2)))])
+    assert got.sort_by("f").equals(expected.sort_by("f"))
+    got1 = collect_partition(mgr, e1, sid, 1)
+    exp1 = pa.concat_tables([t0.take(list(range(1, 120, 2))),
+                             t1.take(list(range(1, 90, 2)))])
+    assert sorted(got1["f"].to_pylist()) == sorted(exp1["f"].to_pylist())
+
+
+_PEER_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.shuffle.tcp import TcpTransport
+    from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                    TransactionStatus)
+    conf = TpuConf({{
+        "spark.rapids.tpu.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+        "spark.rapids.tpu.shuffle.tcp.registryDir": {reg!r}}})
+    t = TcpTransport("exec-remote", conf)
+    t.server.register_request_handler(
+        "double", lambda peer, payload: payload * 2)
+    # announce readiness, then serve until the driver kills us
+    print("READY", flush=True)
+    import time
+    time.sleep(60)
+""")
+
+
+def test_cross_process_rpc(tmp_path):
+    """Two OS processes: the peer registers over the registry directory, the
+    local transport resolves and round-trips an RPC across the real network
+    stack (the cross-host topology the in-process transport cannot cover)."""
+    import os
+    reg = str(tmp_path / "reg")
+    script = _PEER_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        reg=reg)
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True,
+                            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        line = proc.stdout.readline().strip()
+        assert line == "READY", f"peer failed to start: {line}"
+        conf = TpuConf({
+            "spark.rapids.tpu.shuffle.tcp.registryDir": reg})
+        local = TcpTransport("exec-local", conf)
+        try:
+            conn = local.connect("exec-remote")
+            tx = conn.request("double", b"ab", lambda t: None).wait(15)
+            assert tx.status is TransactionStatus.SUCCESS
+            assert tx.response == b"abab"
+        finally:
+            local.shutdown()
+    finally:
+        proc.kill()
+        proc.wait()

@@ -141,3 +141,52 @@ def test_sql_date_interval_folding(mini):
     got = s.sql("select d from dates where "
                 "d < date '1997-09-02' + interval '1' year").collect()
     assert got.num_rows == 1
+
+
+def test_sql_postfix_precedence(mini):
+    # a + 1 BETWEEN ... predicates over the SUM, not the literal
+    got = mini.sql("select name from t where v + 5 between 20 and 36 "
+                   "order by name").collect()
+    assert got.column("name").to_pylist() == ["b", "c"]
+    got = mini.sql("select name from t where k + 0 in (1, 3) "
+                   "order by name").collect()
+    assert got.column("name").to_pylist() == ["a", "b", "e"]
+
+
+def test_sql_left_join_where_not_pushed(mini):
+    # a WHERE filter on the null side of a LEFT JOIN runs post-join
+    # (it eliminates null-extended rows; pushing it below would keep them)
+    got = mini.sql("select t.k from t left outer join u on t.k = u.k "
+                   "where u.w = 1.5 order by t.k").collect()
+    assert got.column("k").to_pylist() == [1, 1]
+
+
+def test_sql_not_in_null_semantics(mini):
+    import pyarrow as _pa
+    s = mini
+    s.create_dataframe(_pa.table({
+        "v": _pa.array([1, 2, None], type=_pa.int64())})
+    ).createOrReplaceTempView("t3")
+    s.create_dataframe(_pa.table({
+        "w": _pa.array([1, None], type=_pa.int64())})
+    ).createOrReplaceTempView("u3")
+    s.create_dataframe(_pa.table({
+        "w": _pa.array([], type=_pa.int64())})
+    ).createOrReplaceTempView("u4")
+    # NULL in the subquery -> every row is UNKNOWN -> empty result
+    assert mini.sql("select v from t3 where v not in (select w from u3)"
+                    ).collect().num_rows == 0
+    # empty subquery -> NOT IN is true for every row, including NULL
+    assert mini.sql("select v from t3 where v not in (select w from u4)"
+                    ).collect().num_rows == 3
+    # no nulls anywhere: plain anti-join semantics
+    assert mini.sql("select v from t3 where v is not null and v not in "
+                    "(select w from u3 where w is not null) order by v"
+                    ).collect().column("v").to_pylist() == [2]
+
+
+def test_sql_corr_covar(mini):
+    got = mini.sql(
+        "select corr(k, v) as c, covar_pop(k, v) as cp from t "
+        "where v is not null").collect()
+    assert got.num_rows == 1 and got.column("c")[0].as_py() is not None
