@@ -63,6 +63,15 @@ class Column:
     def isNotNull(self): return Column(IsNotNull(self.expr))
     def isNaN(self): return Column(IsNan(self.expr))
     def isin(self, *vals):
+        # large numeric literal sets take the InSet fast path (GpuInSet
+        # analog: one sorted-membership probe instead of per-item equality)
+        non_null = [v for v in vals if v is not None]
+        if len(non_null) > 16 and all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in non_null):
+            from spark_rapids_tpu.exprs.predicates import InSet
+            return Column(InSet(self.expr, tuple(sorted(non_null)),
+                                has_null=len(non_null) < len(vals)))
         return Column(In(self.expr, tuple(Literal.of(v) for v in vals)))
 
     # strings ---------------------------------------------------------------
@@ -70,6 +79,17 @@ class Column:
     def endswith(self, p): return Column(EndsWith(self.expr, _expr(p)))
     def contains(self, p): return Column(Contains(self.expr, _expr(p)))
     def like(self, p): return Column(Like(self.expr, _expr(p)))
+
+    def rlike(self, p):
+        from spark_rapids_tpu.exprs.strings import RLike
+        return Column(RLike(self.expr, _expr(p)))
+
+    def getItem(self, i: int):
+        from spark_rapids_tpu.exprs.strings import GetArrayItem
+        return Column(GetArrayItem(self.expr, int(i)))
+
+    def __getitem__(self, i: int):
+        return self.getItem(i)
 
     # naming / casting ------------------------------------------------------
     def alias(self, name: str) -> "Column":

@@ -392,3 +392,62 @@ def lag(c: Union[str, Column], offset: int = 1, default=None) -> Column:
     from spark_rapids_tpu.exprs.windows import Lag
     d = None if default is None else Literal.of(default)
     return Column(Lag(_c(c) if isinstance(c, str) else c.expr, offset, d))
+
+
+def regexp_replace(c: Union[str, Column], pattern: str,
+                   replacement: str = "") -> Column:
+    from spark_rapids_tpu.exprs.strings import RegExpReplace
+    return Column(RegExpReplace(_c(c), Literal.of(pattern),
+                                Literal.of(replacement)))
+
+
+def split(c: Union[str, Column], pattern: str, limit: int = -1) -> Column:
+    """split(str, regex): index the result with [i]/getItem(i) (arrays are
+    not a columnar type; the item access fuses into one split-part kernel)."""
+    from spark_rapids_tpu.exprs.strings import StringSplit
+    return Column(StringSplit(_c(c), Literal.of(pattern), limit))
+
+
+def unix_timestamp(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.datetime import UnixTimestamp
+    return Column(UnixTimestamp(_c(c)))
+
+
+def to_unix_timestamp(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.datetime import ToUnixTimestamp
+    return Column(ToUnixTimestamp(_c(c)))
+
+
+def from_unixtime(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.datetime import FromUnixTime
+    return Column(FromUnixTime(_c(c)))
+
+
+def weekday(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.datetime import WeekDay
+    return Column(WeekDay(_c(c)))
+
+
+def cot(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.math import Cot
+    return Column(Cot(_c(c)))
+
+
+def asinh(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.math import Asinh
+    return Column(Asinh(_c(c)))
+
+
+def acosh(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.math import Acosh
+    return Column(Acosh(_c(c)))
+
+
+def atanh(c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.math import Atanh
+    return Column(Atanh(_c(c)))
+
+
+def log_base(base: float, c: Union[str, Column]) -> Column:
+    from spark_rapids_tpu.exprs.math import Logarithm
+    return Column(Logarithm(Literal.of(float(base)), _c(c)))

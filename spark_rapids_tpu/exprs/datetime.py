@@ -240,3 +240,96 @@ class Quarter(Expression):
         _, m, _ = civil_from_days(xp, _days_of(v, xp))
         return ColV(DType.INT, ((m - 1) // 3 + 1).astype(np.int32), v.validity,
                     is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class WeekDay(Expression):
+    """0 = Monday ... 6 = Sunday (Spark WeekDay; datetimeExpressions.scala)."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)
+        days = _days_of(v, xp)
+        # 1970-01-01 was a Thursday (weekday 3)
+        data = ((days + 3) % 7).astype(np.int32)
+        return ColV(DType.INT, data, v.validity, is_scalar=v.is_scalar)
+
+
+MICROS_PER_SECOND = 1_000_000
+
+
+@dataclass(frozen=True)
+class ToUnixTimestamp(Expression):
+    """to_unix_timestamp(ts_or_date): UTC epoch seconds (the default
+    yyyy-MM-dd HH:mm:ss format path of datetimeExpressions.scala
+    GpuToUnixTimestamp — non-default formats stay on CPU, same gate as the
+    reference's incompatible-format tagging)."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.LONG
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)
+        if v.dtype is DType.DATE:
+            secs = v.data.astype(np.int64) * 86_400
+        else:
+            secs = v.data.astype(np.int64) // MICROS_PER_SECOND
+        return ColV(DType.LONG, secs, v.validity, is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class UnixTimestamp(ToUnixTimestamp):
+    """unix_timestamp(col) — same kernel as ToUnixTimestamp (Spark's two
+    names for the epoch-seconds conversion)."""
+    c: Expression
+
+
+@dataclass(frozen=True)
+class FromUnixTime(Expression):
+    """from_unixtime(seconds): epoch seconds -> 'yyyy-MM-dd HH:mm:ss' string
+    (default format only; UTC — GpuFromUnixTime analog)."""
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = self.c.eval(ctx)
+        secs = v.data.astype(np.int64)
+        days = secs // 86_400
+        tod = secs - days * 86_400
+        y, m, d = civil_from_days(xp, days)
+        hh = (tod // 3600).astype(np.int64)
+        mm = ((tod % 3600) // 60).astype(np.int64)
+        ss = (tod % 60).astype(np.int64)
+        W = 19
+
+        def dig(x, p10):
+            return ((x // p10) % 10 + 48).astype(np.uint8)
+
+        cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1),
+                xp.full_like(ss, 45).astype(np.uint8),
+                dig(m, 10), dig(m, 1),
+                xp.full_like(ss, 45).astype(np.uint8),
+                dig(d, 10), dig(d, 1),
+                xp.full_like(ss, 32).astype(np.uint8),
+                dig(hh, 10), dig(hh, 1),
+                xp.full_like(ss, 58).astype(np.uint8),
+                dig(mm, 10), dig(mm, 1),
+                xp.full_like(ss, 58).astype(np.uint8),
+                dig(ss, 10), dig(ss, 1)]
+        if getattr(v.data, "ndim", 0) == 0:
+            data = xp.stack(cols).astype(np.uint8)
+            lengths = xp.asarray(np.int32(W))
+        else:
+            data = xp.stack(cols, axis=-1).astype(np.uint8)
+            lengths = xp.full(v.data.shape, W, dtype=np.int32)
+        return ColV(DType.STRING, data, v.validity, lengths,
+                    is_scalar=v.is_scalar)

@@ -177,3 +177,38 @@ class Round(Expression):
         elif v.dtype is DType.FLOAT:
             data = data.astype(np.float32)
         return ColV(v.dtype, data, v.validity, is_scalar=v.is_scalar)
+
+
+Cot = _double_unary("Cot", lambda xp, d: 1.0 / xp.tan(d))
+Asinh = _double_unary("Asinh", lambda xp, d: xp.arcsinh(d))
+Acosh = _double_unary("Acosh", lambda xp, d: xp.arccosh(d))
+Atanh = _double_unary("Atanh", lambda xp, d: xp.arctanh(d))
+
+
+@dataclass(frozen=True)
+class Logarithm(BinaryExpression):
+    """log(base, expr) — NULL when expr <= 0 or base <= 0 (Spark
+    mathExpressions Logarithm semantics)."""
+    b: Expression
+    c: Expression
+
+    def dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def operand_dtype(self) -> DType:
+        return DType.DOUBLE
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        l = self.b.eval(ctx)
+        r = self.c.eval(ctx)
+        base = l.data.astype(np.float64)
+        v = r.data.astype(np.float64)
+        safe_b = xp.where(base > 0, base, 1.0)
+        safe_v = xp.where(v > 0, v, 1.0)
+        data = xp.log(safe_v) / xp.log(safe_b)
+        validity = xp.logical_and(
+            xp.logical_and(l.validity, r.validity),
+            xp.logical_and(base > 0, v > 0))
+        return ColV(DType.DOUBLE, data, validity,
+                    is_scalar=l.is_scalar and r.is_scalar)

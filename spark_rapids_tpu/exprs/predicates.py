@@ -229,3 +229,37 @@ class In(Expression):
         valid = xp.logical_and(v.validity,
                                xp.logical_or(found, not has_null_item))
         return ColV(DType.BOOLEAN, found, valid, is_scalar=v.is_scalar)
+
+
+@dataclass(frozen=True)
+class InSet(Expression):
+    """value IN (large literal set) — GpuInSet.scala:98 analog. Where In
+    evaluates one equality per item (fused but O(items) passes), InSet does
+    ONE searchsorted membership probe against the sorted set — the device
+    cost is O(log n) per row however large the list. Numeric/date values
+    only (strings keep the per-item path via In)."""
+    value: Expression
+    values: Tuple  # python scalars, no nulls
+    has_null: bool = False
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        import numpy as _np
+        xp = ctx.xp
+        v = self.value.eval(ctx)
+        # compare in the VALUE column's domain: probing a double column
+        # against an int set must not truncate 3.7 -> 3
+        cmp_dtype = (_np.float64 if v.dtype.is_floating
+                     else v.dtype.np_dtype())
+        arr = _np.sort(_np.asarray(list(self.values)).astype(cmp_dtype))
+        table = xp.asarray(arr)
+        d = v.data.astype(cmp_dtype)
+        idx = xp.searchsorted(table, d)
+        idx_c = xp.clip(idx, 0, len(arr) - 1)
+        found = xp.logical_and(idx < len(arr), table[idx_c] == d)
+        # Spark 3VL: null when no match and (value null or set has null)
+        validity = v.validity if not self.has_null else \
+            xp.logical_and(v.validity, found)
+        return ColV(DType.BOOLEAN, found, validity, is_scalar=v.is_scalar)

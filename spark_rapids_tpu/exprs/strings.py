@@ -182,7 +182,12 @@ class Like(_ConstPatternPredicate):
                         "utf-8", errors="replace")
                     res[i] = rx.match(s) is not None
                 return res
-            raise NotImplementedError(f"LIKE pattern {pat!r} needs regex; CPU fallback")
+            # device: compiled DFA over the byte matrix (anchored; byte-level
+            # semantics — '_' consumes one BYTE, so multibyte chars under '_'
+            # diverge from Spark; ASCII scope like Upper/Lower)
+            from spark_rapids_tpu.ops import regex as rk
+            dfa = rk.compile_dfa(rk.like_to_regex(pat, self.escape))
+            return rk.dfa_match(xp, dfa, col.data, col.lengths)
         kind, needle = kind_needle
         nb = needle.encode("utf-8")
         if kind == "contains":
@@ -472,3 +477,172 @@ class SubstringIndex(Expression):
         data, lengths = sk.substring_index(xp, v.data, v.lengths, delim, cnt,
                                            v.data.shape[-1])
         return ColV(DType.STRING, data, v.validity, lengths)
+
+
+@dataclass(frozen=True)
+class RLike(_ConstPatternPredicate):
+    """str RLIKE pattern (Java Pattern.find semantics: unanchored search).
+    Device path: compiled DFA with a leading any-byte loop
+    (stringFunctions.scala GpuRLike analog; byte-level '.', ASCII scope)."""
+    c: Expression
+    p: Expression
+
+    def do_match(self, xp, col, W):
+        from spark_rapids_tpu.ops import regex as rk
+        pat = self.pattern.decode("utf-8")
+        if xp is np:
+            import re as _re
+            rx = _re.compile(pat)
+            n = col.data.shape[0]
+            res = np.zeros(n, dtype=bool)
+            for i in range(n):
+                s = bytes(col.data[i, :col.lengths[i]]).decode(
+                    "utf-8", errors="replace")
+                res[i] = rx.search(s) is not None
+            return res
+        # '^' anchors are rejected at tag time (Java's '^a|b' anchors only
+        # the first branch — subtle semantics the DFA does not implement)
+        dfa = rk.compile_dfa(pat, search=True)
+        return rk.dfa_match(xp, dfa, col.data, col.lengths, search=True)
+
+
+def _regex_spans(xp, pat: str, data, lengths, W: int):
+    """Leftmost non-overlapping regex match spans: (sel, span_len)."""
+    from spark_rapids_tpu.ops import regex as rk
+    dfa = rk.compile_dfa(pat)
+    if dfa.accept[dfa.start]:
+        raise TypeError(f"pattern {pat!r} can match the empty string; "
+                        f"zero-length matches are not supported on device")
+    match_len = rk.dfa_find_spans(xp, dfa, data, lengths)
+    sel = rk.regex_greedy_spans(xp, match_len, lengths, W)
+    span_len = xp.where(sel, xp.maximum(match_len, 0), 0).astype(np.int32)
+    return sel, span_len
+
+
+@dataclass(frozen=True)
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement) with literal pattern and
+    replacement (no group backreferences — the reference's GpuRegExpReplace
+    has the same restriction). Leftmost non-overlapping matches, DFA-longest
+    per start (POSIX-style; Java's backtracking-greedy agrees on the
+    supported subset's common patterns)."""
+    c: Expression
+    pattern_e: Expression
+    replacement: Expression
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        v = _as_column(xp, self.c.eval(ctx), ctx.capacity)
+        pat = _literal_utf8(self.pattern_e, "regexp pattern")
+        repl = _literal_utf8(self.replacement, "regexp replacement")
+        if pat is None or repl is None:
+            return _all_null(xp, DType.STRING, ctx.capacity,
+                             v.data.shape[-1])
+        W = v.data.shape[-1]
+        W_out = ctx.string_max_bytes
+        if xp is np:
+            import re as _re
+            rx = _re.compile(pat.decode())
+            n = v.data.shape[0]
+            out = np.zeros((n, W_out), dtype=np.uint8)
+            lens = np.zeros(n, dtype=np.int32)
+            for i in range(n):
+                s = bytes(v.data[i, :v.lengths[i]]).decode(
+                    "utf-8", errors="replace")
+                rb = rx.sub(repl.decode(), s).encode()[:W_out]
+                out[i, :len(rb)] = bytearray(rb)
+                lens[i] = len(rb)
+            return ColV(DType.STRING, out, v.validity, lens)
+        sel, span_len = _regex_spans(xp, pat.decode(), v.data, v.lengths, W)
+        inside = sk.spans_inside(xp, sel, span_len, W)
+        pos = np.arange(W, dtype=np.int32)[None, :]
+        plain = xp.logical_and(
+            pos < v.lengths[:, None],
+            xp.logical_not(xp.logical_or(sel, inside))).astype(np.int32)
+        data, lengths = sk.reassemble_spans(xp, v.data, sel, plain, repl,
+                                            W_out)
+        return ColV(DType.STRING, data, v.validity, lengths)
+
+
+@dataclass(frozen=True)
+class StringSplit(Expression):
+    """split(str, regex): array-valued; only consumable through
+    GetArrayItem (split(x, d)[i]) or size() on this engine — ARRAY is not a
+    columnar type (same gate as CreateArray)."""
+    c: Expression
+    pattern_e: Expression
+    limit: int = -1
+
+    def dtype(self) -> DType:
+        raise TypeError("split() produces an array; index it with [i] / "
+                        "getItem(i) (ARRAY is not a columnar type here)")
+
+    def element_type(self) -> DType:
+        return DType.STRING
+
+
+@dataclass(frozen=True)
+class GetArrayItem(Expression):
+    """array[i] with a literal ordinal (complexTypeExtractors.scala:88
+    GpuGetArrayItem analog): supports CreateArray children (static pick) and
+    StringSplit (fused split-part kernel — the array never materializes)."""
+    child: Expression
+    ordinal: int
+
+    def dtype(self) -> DType:
+        from spark_rapids_tpu.exprs.generators import CreateArray
+        if isinstance(self.child, CreateArray):
+            return self.child.element_type()
+        if isinstance(self.child, StringSplit):
+            return DType.STRING
+        raise TypeError("GetArrayItem supports created arrays and split() "
+                        "results only")
+
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        from spark_rapids_tpu.exprs.generators import CreateArray
+        xp = ctx.xp
+        if isinstance(self.child, CreateArray):
+            items = self.child.items
+            if not (0 <= self.ordinal < len(items)):
+                return _all_null(xp, self.child.element_type(), ctx.capacity,
+                                 ctx.string_max_bytes)
+            return items[self.ordinal].eval(ctx)
+        split: StringSplit = self.child
+        v = _as_column(xp, split.c.eval(ctx), ctx.capacity)
+        pat = _literal_utf8(split.pattern_e, "split pattern")
+        if pat is None or self.ordinal < 0:
+            return _all_null(xp, DType.STRING, ctx.capacity,
+                             v.data.shape[-1])
+        W = v.data.shape[-1]
+        if xp is np:
+            import re as _re
+            # Java Pattern.split ignores capture groups; python interleaves
+            # them — convert (x) to (?:x) for the reference path
+            cpu_pat = _re.sub(r"(?<!\\)\((?!\?)", "(?:", pat.decode())
+            rx = _re.compile(cpu_pat)
+            n = v.data.shape[0]
+            out = np.zeros((n, W), dtype=np.uint8)
+            lens = np.zeros(n, dtype=np.int32)
+            valid = np.asarray(v.validity).copy()
+            for i in range(n):
+                s = bytes(v.data[i, :v.lengths[i]]).decode(
+                    "utf-8", errors="replace")
+                parts = rx.split(s)
+                if self.ordinal < len(parts):
+                    b = parts[self.ordinal].encode()[:W]
+                    out[i, :len(b)] = bytearray(b)
+                    lens[i] = len(b)
+                else:
+                    valid[i] = False
+            return ColV(DType.STRING, out, valid, lens)
+        sel, span_len = _regex_spans(xp, pat.decode(), v.data, v.lengths, W)
+        data, lengths, exists = sk.split_field(
+            xp, v.data, v.lengths, sel, span_len, self.ordinal, W)
+        return ColV(DType.STRING, data,
+                    xp.logical_and(v.validity, exists), lengths)

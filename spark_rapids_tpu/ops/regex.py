@@ -1,0 +1,399 @@
+"""Device regex engine: compiled DFA over the byte matrix.
+
+The TPU replacement for cuDF's regex kernels (reference:
+stringFunctions.scala GpuLike/GpuRegExpReplace/GpuStringSplit delegating to
+cudf's regex engine). Patterns are plan-time literals, so compilation is
+host-side: a regex subset parses to a Thompson NFA, subset-construction
+yields a dense DFA transition table [n_states, 256], and matching is a
+fixed-length scan over the byte-matrix columns — W steps of vectorized
+table lookups, no data-dependent control flow (lax.scan on device).
+
+Supported syntax (the subset the benchmark suites and LIKE lowering need):
+literals, ``.``, classes ``[a-z0-9_]`` with ranges and negation, ``*`` ``+``
+``?`` quantifiers, alternation ``|``, grouping ``()``, anchors are implicit
+(match() is anchored; search() prepends an any-byte loop). Byte-level
+semantics: multibyte UTF-8 is matched byte-wise (``.`` consumes one BYTE) —
+ASCII scope, like the engine's Upper/Lower, tagged incompat in the rules.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import numpy as np
+
+_EPS = -1
+
+
+class RegexError(ValueError):
+    pass
+
+
+class _Nfa:
+    def __init__(self):
+        self.edges: List[List[Tuple[int, Optional[Set[int]]]]] = []
+
+    def state(self) -> int:
+        self.edges.append([])
+        return len(self.edges) - 1
+
+    def add(self, a: int, b: int, chars: Optional[Set[int]]) -> None:
+        self.edges[a].append((b, chars))
+
+
+def _parse(pattern: str):
+    """pattern -> (nfa, start, accept) via recursive descent."""
+    nfa = _Nfa()
+    pos = [0]
+    data = pattern
+
+    def peek():
+        return data[pos[0]] if pos[0] < len(data) else None
+
+    def take():
+        c = data[pos[0]]
+        pos[0] += 1
+        return c
+
+    def parse_alt():
+        s, e = parse_seq()
+        while peek() == "|":
+            take()
+            s2, e2 = parse_seq()
+            ns, ne = nfa.state(), nfa.state()
+            nfa.add(ns, s, None)
+            nfa.add(ns, s2, None)
+            nfa.add(e, ne, None)
+            nfa.add(e2, ne, None)
+            s, e = ns, ne
+        return s, e
+
+    def parse_seq():
+        s = nfa.state()
+        e = s
+        while peek() is not None and peek() not in "|)":
+            s2, e2 = parse_piece()
+            nfa.add(e, s2, None)
+            e = e2
+        return s, e
+
+    def parse_piece():
+        s, e = parse_atom()
+        while peek() in ("*", "+", "?"):
+            q = take()
+            ns, ne = nfa.state(), nfa.state()
+            nfa.add(ns, s, None)
+            nfa.add(e, ne, None)
+            if q in ("*", "?"):
+                nfa.add(ns, ne, None)
+            if q in ("*", "+"):
+                nfa.add(e, s, None)
+            s, e = ns, ne
+        return s, e
+
+    def parse_atom():
+        c = peek()
+        if c is None:
+            raise RegexError(f"unexpected end of pattern {data!r}")
+        if c == "(":
+            take()
+            s, e = parse_alt()
+            if peek() != ")":
+                raise RegexError(f"unbalanced '(' in {data!r}")
+            take()
+            return s, e
+        if c == "[":
+            take()
+            chars = _parse_class(take, peek)
+            return _char_edge(chars)
+        if c == ".":
+            take()
+            return _char_edge(set(range(256)))
+        if c == "\\":
+            take()
+            nxt = take() if peek() is not None else None
+            if nxt is None:
+                raise RegexError(f"dangling escape in {data!r}")
+            cls = _ESCAPES.get(nxt)
+            return _char_edge(cls if cls is not None
+                              else {ord(nxt) & 0xFF})
+        if c in ")|*+?":
+            raise RegexError(f"unexpected {c!r} in {data!r}")
+        if c in "{}^$":
+            # syntax Java regex gives meaning to but this subset does not
+            # implement — reject rather than silently matching literally
+            raise RegexError(f"unsupported regex syntax {c!r} in {data!r} "
+                             f"(escape it to match literally)")
+        take()
+        bs = c.encode("utf-8")
+        # multibyte literal: its bytes match in SEQUENCE (chained edges)
+        s = nfa.state()
+        e = s
+        for b in bs:
+            s2, e2 = _char_edge({b})
+            nfa.add(e, s2, None)
+            e = e2
+        return s, e
+
+    def _char_edge(chars: Set[int]):
+        s, e = nfa.state(), nfa.state()
+        nfa.add(s, e, chars)
+        return s, e
+
+    def _parse_class(take, peek):
+        neg = False
+        if peek() == "^":
+            take()
+            neg = True
+        chars: Set[int] = set()
+        prev: Optional[int] = None
+        while peek() is not None and peek() != "]":
+            c = take()
+            if c == "\\" and peek() is not None:
+                c2 = take()
+                cls = _ESCAPES.get(c2)
+                if cls is not None:
+                    chars |= cls
+                    prev = None
+                    continue
+                c = c2
+            if c == "-" and prev is not None and peek() not in (None, "]"):
+                hi = ord(take())
+                chars |= set(range(prev, hi + 1))
+                prev = None
+                continue
+            b = ord(c)
+            if b > 0xFF:
+                raise RegexError("non-ASCII literal in character class")
+            chars.add(b)
+            prev = b
+        if peek() != "]":
+            raise RegexError(f"unbalanced '[' in {data!r}")
+        take()
+        return set(range(256)) - chars if neg else chars
+
+    s, e = parse_alt()
+    if pos[0] != len(data):
+        raise RegexError(f"trailing input at {pos[0]} in {data!r}")
+    return nfa, s, e
+
+
+_ESCAPES: Dict[str, Set[int]] = {
+    "d": set(range(ord("0"), ord("9") + 1)),
+    "w": (set(range(ord("a"), ord("z") + 1))
+          | set(range(ord("A"), ord("Z") + 1))
+          | set(range(ord("0"), ord("9") + 1)) | {ord("_")}),
+    "s": {0x20, 0x09, 0x0A, 0x0D, 0x0B, 0x0C},
+}
+_ESCAPES["D"] = set(range(256)) - _ESCAPES["d"]
+_ESCAPES["W"] = set(range(256)) - _ESCAPES["w"]
+_ESCAPES["S"] = set(range(256)) - _ESCAPES["s"]
+
+
+class Dfa:
+    """Dense DFA: trans [n_states, 256] int32 (state 0 = dead sink),
+    accept [n_states] bool, start state index."""
+
+    def __init__(self, trans: np.ndarray, accept: np.ndarray, start: int):
+        self.trans = trans
+        self.accept = accept
+        self.start = start
+
+    @property
+    def n_states(self) -> int:
+        return self.trans.shape[0]
+
+
+def compile_dfa(pattern: str, search: bool = False,
+                max_states: int = 512) -> Dfa:
+    """Regex subset -> DFA. ``search=True`` allows a match to start anywhere
+    (prepends an any-byte loop — RLike semantics); otherwise the match is
+    anchored at the start (LIKE lowering adds its own .* where needed)."""
+    nfa, start, accept = _parse(pattern)
+    if search:
+        ns = nfa.state()
+        nfa.add(ns, ns, set(range(256)))
+        nfa.add(ns, start, None)
+        start = ns
+
+    def eps_closure(states: FrozenSet[int]) -> FrozenSet[int]:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for dst, chars in nfa.edges[s]:
+                if chars is None and dst not in out:
+                    out.add(dst)
+                    stack.append(dst)
+        return frozenset(out)
+
+    start_set = eps_closure(frozenset([start]))
+    # state 0 is the dead sink
+    ids: Dict[FrozenSet[int], int] = {frozenset(): 0, start_set: 1}
+    acc: List[bool] = [False, accept in start_set]
+    row_of: Dict[int, np.ndarray] = {0: np.zeros(256, np.int32)}
+    work = [start_set]
+    while work:
+        cur = work.pop()
+        row = np.zeros(256, np.int32)
+        by_byte: Dict[int, Set[int]] = {}
+        for s in cur:
+            for dst, chars in nfa.edges[s]:
+                if chars is None:
+                    continue
+                for b in chars:
+                    by_byte.setdefault(b, set()).add(dst)
+        for b, dsts in by_byte.items():
+            t = eps_closure(frozenset(dsts))
+            if t not in ids:
+                if len(ids) >= max_states:
+                    raise RegexError(
+                        f"pattern {pattern!r} exceeds {max_states} DFA "
+                        f"states")
+                ids[t] = len(ids)
+                acc.append(accept in t)
+                work.append(t)
+            row[b] = ids[t]
+        row_of[ids[cur]] = row
+    table = np.stack([row_of[i] for i in range(len(ids))])
+    return Dfa(table, np.asarray(acc, bool), 1)
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> str:
+    """SQL LIKE pattern -> this engine's regex (anchored by construction)."""
+    out = []
+    i = 0
+    special = set(".[]()*+?|\\^${}")
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            out.append("\\" + nxt if nxt in special else nxt)
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        elif c in special:
+            out.append("\\" + c)
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# device/np kernels
+# ---------------------------------------------------------------------------
+def dfa_match(xp, dfa: Dfa, data, lengths, search: bool = False):
+    """bool[n]: does each row (its first `length` bytes) match?
+
+    Anchored mode accepts when the state AT the row's length position is
+    accepting (full-row match). Search mode accepts when ANY prefix position
+    within the row reached an accepting state — pair with
+    compile_dfa(search=True) for find-anywhere (RLike), or with an anchored
+    DFA for match-at-start-only (a leading ^).
+    """
+    n, W = data.shape
+    trans = xp.asarray(dfa.trans)
+    accept = xp.asarray(dfa.accept)
+    flat = trans.reshape(-1)
+    state = xp.full((n,), dfa.start, dtype=np.int32)
+    hit = xp.logical_and(accept[state],
+                         xp.asarray(True) if search else lengths == 0)
+
+    def at(jj):
+        return (jj + 1 <= lengths) if search else (lengths == jj + 1)
+
+    if xp is np:
+        for j in range(W):
+            state = flat[state * 256 + data[:, j].astype(np.int32)]
+            hit = np.logical_or(hit, np.logical_and(accept[state], at(j)))
+        return hit
+    import jax
+
+    def step(carry, col):
+        state, hit = carry
+        byte, jj = col
+        state = flat[state * 256 + byte.astype(np.int32)]
+        hit = xp.logical_or(hit, xp.logical_and(accept[state], at(jj)))
+        return (state, hit), None
+
+    iota = xp.arange(W, dtype=np.int32)
+    (state, hit), _ = jax.lax.scan(step, (state, hit), (data.T, iota))
+    return hit
+
+
+def dfa_find_spans(xp, dfa: Dfa, data, lengths):
+    """Leftmost-longest match spans for an (anchored) DFA run from every
+    starting byte position. Returns match_len [n, W] int32: the LONGEST
+    match length starting at each position (-1 = no match). O(W^2 / 8)ish:
+    one scan of W steps over a [n, W] state matrix (DFA instance per start).
+    """
+    n, W = data.shape
+    trans = xp.asarray(dfa.trans)
+    accept = xp.asarray(dfa.accept)
+    flat = trans.reshape(-1)
+    pos = np.arange(W, dtype=np.int32)
+    valid_start = xp.asarray(pos)[None, :] <= lengths[:, None] - 0
+    state = xp.where(valid_start, np.int32(dfa.start), np.int32(0))
+    # empty match (zero-length) allowed when start state accepts
+    best = xp.where(xp.logical_and(bool(dfa.accept[dfa.start]),
+                                   valid_start),
+                    np.int32(0), np.int32(-1))
+
+    def body(j, state, best):
+        # instance starting at position p consumes byte p + j
+        idx = xp.clip(xp.asarray(pos)[None, :] + j, 0, W - 1)
+        byte = xp.take_along_axis(data, idx, axis=-1).astype(np.int32)
+        in_range = (xp.asarray(pos)[None, :] + j) < lengths[:, None]
+        state = xp.where(in_range,
+                         flat[state * 256 + byte], np.int32(0))
+        best = xp.where(xp.logical_and(accept[state], in_range),
+                        (xp.asarray(j) + 1).astype(np.int32)
+                        if xp is not np else np.int32(j + 1), best)
+        return state, best
+
+    if xp is np:
+        for j in range(W):
+            state, best = body(j, state, best)
+        return best
+    import jax
+
+    def step(carry, j):
+        state, best = carry
+        state, best = body(j, state, best)
+        return (state, best), None
+
+    (state, best), _ = jax.lax.scan(
+        step, (state, best), xp.arange(W, dtype=np.int32))
+    return best
+
+
+def regex_greedy_spans(xp, match_len, lengths, W: int):
+    """Leftmost non-overlapping span selection over per-position match
+    lengths (Java Matcher.find() order): sel[n, W] marks span starts,
+    span_len[n, W] their lengths (zero-length matches advance by one)."""
+    n = match_len.shape[0]
+    if xp is np:
+        sel = np.zeros((n, W), dtype=bool)
+        nxt = np.zeros(n, dtype=np.int32)
+        for i in range(W):
+            m = match_len[:, i]
+            can = np.logical_and(m >= 0, nxt <= i)
+            can = np.logical_and(can, i <= lengths - 0)
+            sel[:, i] = can
+            nxt = np.where(can, np.maximum(i + m, i + 1), nxt)
+        return sel
+    import jax
+
+    def step(nxt, col):
+        m, i = col
+        can = xp.logical_and(m >= 0, nxt <= i)
+        nxt = xp.where(can, xp.maximum(i + m, i + 1), nxt)
+        return nxt, can
+
+    iota = xp.arange(W, dtype=np.int32)
+    _, selT = jax.lax.scan(step, xp.zeros(n, dtype=np.int32),
+                           (match_len.T, iota))
+    return selT.T

@@ -397,9 +397,17 @@ def replace_const(xp, data, lengths, search: bytes, repl: bytes, W_out: int):
     selection + rank-gather reassembly (cuDF stringReplace analog). Output is
     truncated at W_out bytes."""
     W = data.shape[-1]
+    sel, plain = greedy_matches(xp, data, lengths, search, W)
+    return reassemble_spans(xp, data, sel, plain, repl, W_out)
+
+
+def reassemble_spans(xp, data, sel, plain, repl: bytes, W_out: int):
+    """Rank-gather reassembly shared by constant replace and regex replace:
+    ``sel`` marks span starts (each emits the whole replacement), ``plain``
+    is 1 where a byte passes through unchanged, 0 inside spans/padding."""
+    W = data.shape[-1]
     r = len(repl)
     n = data.shape[0]
-    sel, plain = greedy_matches(xp, data, lengths, search, W)
     emit = xp.where(sel, np.int32(r), plain)                  # [n, W]
     csum = xp.cumsum(emit, axis=-1)
     dst = (csum - emit).astype(np.int32)                      # exclusive
@@ -478,3 +486,48 @@ def concat2(xp, ld, ll, rd, rl, W: int):
     new_len = xp.minimum(ll + rl, W).astype(np.int32)
     keep = pos < new_len[:, None]
     return xp.where(keep, out, 0).astype(np.uint8), new_len
+
+
+def spans_inside(xp, sel, span_len, W: int):
+    """Positions covered by a span but not its start, from span starts +
+    per-start lengths (the regex analog of greedy_matches' `inside`)."""
+    starts = sel.astype(np.int32)
+    ends_pos = xp.clip(xp.where(sel, np.arange(W, dtype=np.int32)[None, :]
+                                + span_len, W), 0, W)
+    n = sel.shape[0]
+    # scatter -1 at each span end (bucket W collects off-the-end)
+    if xp is np:
+        delta = np.zeros((n, W + 1), dtype=np.int32)
+        np.add.at(delta, (np.arange(n)[:, None], ends_pos), -starts)
+    else:
+        delta = xp.zeros((n, W + 1), dtype=np.int32)
+        rows = xp.asarray(np.broadcast_to(np.arange(n)[:, None], sel.shape))
+        delta = delta.at[rows, ends_pos].add(-starts)
+    delta = delta[:, :W] + starts
+    covered = xp.cumsum(delta, axis=-1) > 0
+    return xp.logical_and(covered, xp.logical_not(sel))
+
+
+def split_field(xp, data, lengths, sel, span_len, k: int, W: int):
+    """Field k (0-based) of each row split at the selected delimiter spans
+    (Spark split(str, regex)[k]): bytes between the end of span k-1 and the
+    start of span k. Returns (data, lengths, exists)."""
+    pos = np.arange(W, dtype=np.int32)[None, :]
+    occ = xp.cumsum(sel.astype(np.int32), axis=-1)            # 1-based at sel
+    total = occ[:, -1]
+    if k == 0:
+        start = xp.zeros(lengths.shape[0], dtype=np.int32)
+    else:
+        is_k = xp.logical_and(sel, occ == k)
+        has_k = total >= k
+        p = xp.argmax(is_k, axis=-1).astype(np.int32)
+        slen = xp.take_along_axis(span_len, p[:, None], axis=-1)[:, 0]
+        start = xp.where(has_k, p + xp.maximum(slen, 0), lengths)
+    is_next = xp.logical_and(sel, occ == k + 1)
+    has_next = total >= k + 1
+    endp = xp.where(has_next, xp.argmax(is_next, axis=-1).astype(np.int32),
+                    lengths)
+    exists = total >= k
+    d, l = substring(xp, data, lengths, start.astype(np.int32),
+                     xp.maximum(endp - start, 0), W)
+    return d, l, exists

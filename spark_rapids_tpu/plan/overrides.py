@@ -88,8 +88,60 @@ def _tag_like(meta: ExprMeta) -> None:
         meta.will_not_work("LIKE requires a literal pattern on TPU")
         return
     if st.Like.classify(str(lit.value)) is None:
-        meta.will_not_work(f"LIKE pattern {lit.value!r} needs a regex engine "
-                           f"(only prefix/suffix/contains/exact run on TPU)")
+        # general pattern: the DFA engine handles it; reject only patterns
+        # the regex subset cannot compile
+        from spark_rapids_tpu.ops.regex import like_to_regex
+        _tag_regex_pattern(meta, like_to_regex(str(lit.value), e.escape))
+
+
+def _tag_regex_pattern(meta: ExprMeta, pattern) -> None:
+    from spark_rapids_tpu.ops.regex import RegexError, compile_dfa
+    try:
+        compile_dfa(pattern)
+    except RegexError as err:
+        meta.will_not_work(f"pattern not supported by the device regex "
+                           f"engine: {err}")
+
+
+def _check_regex_literal(expr, field: str, will_not_work,
+                         forbid_empty: bool) -> None:
+    """Shared tag body: the named field must be a literal whose pattern the
+    device engine compiles (anchors included: '^' is rejected by the parser —
+    anchored-search/replace semantics are not implemented on device)."""
+    lit = getattr(expr, field)
+    if not isinstance(lit, li.Literal) or lit.value is None:
+        will_not_work(f"{type(expr).__name__} requires a literal pattern "
+                      f"on TPU")
+        return
+    from spark_rapids_tpu.ops.regex import RegexError, compile_dfa
+    try:
+        dfa = compile_dfa(str(lit.value))
+    except RegexError as err:
+        will_not_work(f"pattern not supported by the device regex "
+                      f"engine: {err}")
+        return
+    if forbid_empty and dfa.accept[dfa.start]:
+        will_not_work("zero-length-matching patterns are not supported on "
+                      "the device regex engine")
+
+
+def _tag_regex_expr(field: str, forbid_empty: bool = False):
+    def tag(meta: ExprMeta) -> None:
+        _check_regex_literal(meta.expr, field, meta.will_not_work,
+                             forbid_empty)
+    return tag
+
+
+def _tag_get_array_item(meta: ExprMeta) -> None:
+    from spark_rapids_tpu.exprs.generators import CreateArray
+    e: st.GetArrayItem = meta.expr
+    if isinstance(e.child, st.StringSplit):
+        _check_regex_literal(e.child, "pattern_e", meta.will_not_work,
+                             forbid_empty=True)
+        return
+    if not isinstance(e.child, CreateArray):
+        meta.will_not_work("GetArrayItem supports created arrays and "
+                           "split() results only")
 
 
 def _tag_literal_pattern(meta: ExprMeta) -> None:
@@ -231,6 +283,16 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(st.EndsWith, "ends with", tag=_tag_literal_pattern),
     ExprRule(st.Contains, "contains", tag=_tag_literal_pattern),
     ExprRule(st.Like, "SQL LIKE", tag=_tag_like),
+    ExprRule(st.RLike, "regex search (RLIKE)",
+             tag=_tag_regex_expr("p"),
+             incompat="byte-level regex: '.'/'_' consume one BYTE, so "
+                      "multibyte UTF-8 under wildcards diverges from Spark"),
+    ExprRule(st.RegExpReplace, "regex replace",
+             tag=_tag_regex_expr("pattern_e", forbid_empty=True),
+             incompat="DFA leftmost-longest matching; no group "
+                      "backreferences; byte-level wildcards"),
+    ExprRule(st.GetArrayItem, "array element access",
+             tag=_tag_get_array_item),
     ExprRule(st.Substring, "substring"),
     ExprRule(st.Concat, "string concatenation"),
     ExprRule(st.StringTrim, "trim spaces",
@@ -269,6 +331,16 @@ _EXPR_RULE_LIST: List[ExprRule] = [
     ExprRule(wn.Lead, "lead"), ExprRule(wn.Lag, "lag"),
     # aggregates
     ExprRule(agg.Count, "count"),
+    ExprRule(pr.InSet, "IN over a large literal set"),
+    ExprRule(dtm.WeekDay, "weekday (0=Monday)"),
+    ExprRule(dtm.UnixTimestamp, "epoch seconds"),
+    ExprRule(dtm.ToUnixTimestamp, "epoch seconds (to_unix_timestamp)"),
+    ExprRule(dtm.FromUnixTime, "epoch seconds -> formatted string"),
+    ExprRule(ma.Cot, "cotangent"),
+    ExprRule(ma.Asinh, "inverse hyperbolic sine"),
+    ExprRule(ma.Acosh, "inverse hyperbolic cosine"),
+    ExprRule(ma.Atanh, "inverse hyperbolic tangent"),
+    ExprRule(ma.Logarithm, "arbitrary-base logarithm"),
     ExprRule(agg.Sum, "sum", tag=_tag_float_agg),
     ExprRule(agg.Average, "average", tag=_tag_float_agg),
     ExprRule(agg.Min, "minimum"), ExprRule(agg.Max, "maximum"),

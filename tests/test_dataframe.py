@@ -107,21 +107,32 @@ def test_conditional_and_strings_e2e():
 
 
 def test_fallback_unsupported_expr():
-    """Regex LIKE has no TPU kernel -> whole project falls back, results equal,
-    explain names the reason (StringFallbackSuite analog)."""
+    """General LIKE patterns now run on the device DFA engine; a NON-literal
+    pattern still has no TPU kernel -> whole project falls back, results
+    equal, explain names the reason (StringFallbackSuite analog)."""
     t = sample_table()
 
-    def q(s):
+    def on_device(s):
         return s.create_dataframe(t).select(
             F.col("cat").like("%A_B%").alias("m"))
 
-    cpu, tpu, sess = __import__(
-        "spark_rapids_tpu.testing", fromlist=["run_with_cpu_and_tpu"]
-    ).run_with_cpu_and_tpu(q)
-    from spark_rapids_tpu.testing import assert_tables_equal
+    from spark_rapids_tpu.testing import (assert_tables_equal,
+                                          run_with_cpu_and_tpu)
+    cpu, tpu, sess = run_with_cpu_and_tpu(on_device)
+    assert_tables_equal(cpu, tpu)
+    assert "TpuProjectExec" in sess.last_plan.tree_string()
+
+    def falls_back(s):
+        # {n} quantifiers are outside the device regex subset -> CPU fallback
+        return s.create_dataframe(t).select(
+            F.col("cat").rlike("A{2}").alias("m"))
+
+    cpu, tpu, sess = run_with_cpu_and_tpu(
+        falls_back, conf={"spark.rapids.tpu.sql.incompatibleOps.enabled":
+                          "true"})
     assert_tables_equal(cpu, tpu)
     assert "TpuProjectExec" not in sess.last_plan.tree_string()
-    assert "needs a regex engine" in sess.last_explain
+    assert "not supported by the device regex engine" in sess.last_explain
 
 
 def test_explain_output():

@@ -1,0 +1,155 @@
+"""Device regex engine (DFA over the byte matrix): general LIKE, RLIKE,
+regexp_replace, split()[i], plus the new datetime/math/InSet expressions —
+CPU (python re / numpy) vs device (jitted DFA scan) parity.
+
+Reference analogs: stringFunctions.scala GpuLike/GpuRLike/GpuRegExpReplace/
+GpuStringSplit, GpuInSet.scala:98, complexTypeExtractors.scala:88,
+datetimeExpressions.scala unix-time family, mathExpressions.scala."""
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+col = F.col
+CONF = {"spark.rapids.tpu.sql.incompatibleOps.enabled": "true"}
+
+STRINGS = ["hello world", "h3ll0", "aaa bbb ccc", "", None, "a,b,,c",
+           "Customer XYZ Complaints", "MEDIUM POLISHED brass", "forest#12",
+           "PROMO done", "xx12yy345", "no digits here"]
+
+
+def _df(sess):
+    return sess.create_dataframe(pa.table({"s": pa.array(STRINGS)}))
+
+
+def test_general_like_patterns():
+    def build(sess):
+        return _df(sess).select(
+            col("s").like("%Customer%Complaints%").alias("a"),
+            col("s").like("h_ll_").alias("b"),
+            col("s").like("%o_l%").alias("c"),
+            col("s").like("a%c").alias("d"))
+
+    cpu = assert_tpu_and_cpu_equal(build, conf=CONF)
+    assert cpu.column("a").to_pylist()[6] is True
+    assert cpu.column("b").to_pylist()[1] is True
+
+
+def test_rlike():
+    def build(sess):
+        return _df(sess).select(
+            col("s").rlike("[0-9]+").alias("digits"),
+            col("s").rlike("^h").alias("starts_h"),
+            col("s").rlike("b+ c").alias("bc"))
+
+    cpu = assert_tpu_and_cpu_equal(build, conf=CONF)
+    import re
+    exp = [None if s is None else bool(re.search(r"[0-9]+", s))
+           for s in STRINGS]
+    assert cpu.column("digits").to_pylist() == exp
+
+
+def test_regexp_replace():
+    def build(sess):
+        return _df(sess).select(
+            F.regexp_replace(col("s"), "[0-9]+", "#").alias("r"),
+            F.regexp_replace(col("s"), "l+", "L").alias("l"))
+
+    cpu = assert_tpu_and_cpu_equal(build, conf=CONF)
+    import re
+    assert cpu.column("r").to_pylist() == [
+        None if s is None else re.sub(r"[0-9]+", "#", s) for s in STRINGS]
+
+
+def test_split_get_item():
+    def build(sess):
+        return _df(sess).select(
+            F.split(col("s"), ",")[0].alias("p0"),
+            F.split(col("s"), ",")[2].alias("p2"),
+            F.split(col("s"), "[ ]+")[1].alias("w1"))
+
+    cpu = assert_tpu_and_cpu_equal(build, conf=CONF)
+    row = STRINGS.index("a,b,,c")
+    assert cpu.column("p0").to_pylist()[row] == "a"
+    assert cpu.column("p2").to_pylist()[row] == ""
+    assert cpu.column("w1").to_pylist()[2] == "bbb"
+    # out-of-range -> null
+    assert cpu.column("p2").to_pylist()[0] is None
+
+
+def test_unix_time_family_and_weekday():
+    ts = [datetime.datetime(2001, 2, 3, 4, 5, 6),
+          datetime.datetime(1969, 12, 31, 23, 59, 59), None]
+    dates = [datetime.date(2020, 1, 6), datetime.date(1970, 1, 1), None]
+
+    def build(sess):
+        df = sess.create_dataframe(pa.table({
+            "t": pa.array(ts, type=pa.timestamp("us")),
+            "d": pa.array(dates)}))
+        return df.select(
+            F.unix_timestamp(col("t")).alias("ut"),
+            F.to_unix_timestamp(col("d")).alias("ud"),
+            F.from_unixtime(F.unix_timestamp(col("t"))).alias("fmt"),
+            F.weekday(col("d")).alias("wd"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("ut").to_pylist()[0] == int(
+        ts[0].replace(tzinfo=datetime.timezone.utc).timestamp())
+    assert cpu.column("fmt").to_pylist()[0] == "2001-02-03 04:05:06"
+    assert cpu.column("wd").to_pylist() == [0, 3, None]  # Mon, Thu
+
+
+def test_inset_large_list():
+    vals = list(range(0, 4000, 7))
+    t = pa.table({"v": pa.array([0, 7, 8, 3997, None, -7], type=pa.int64())})
+
+    def build(sess):
+        return sess.create_dataframe(t).select(
+            col("v").isin(*vals).alias("m"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    # 3997 = 7*571 IS in the set
+    assert cpu.column("m").to_pylist() == [True, True, False, True, None,
+                                           False]
+
+
+def test_new_math_fns():
+    t = pa.table({"x": pa.array([0.5, 1.5, -0.5, None])})
+
+    def build(sess):
+        return sess.create_dataframe(t).select(
+            F.cot(col("x")).alias("cot"),
+            F.asinh(col("x")).alias("ash"),
+            F.atanh(col("x")).alias("ath"),
+            F.log_base(2.0, col("x")).alias("lb"))
+
+    cpu = assert_tpu_and_cpu_equal(build, approx_float=1e-12)
+    assert cpu.column("lb").to_pylist()[2] is None  # log of negative -> null
+    assert abs(cpu.column("cot").to_pylist()[0] - 1 / np.tan(0.5)) < 1e-12
+
+
+def test_regex_fuzz_vs_python_re():
+    """Random ASCII haystacks x a pattern pool: device DFA must agree with
+    python re on match/replace for the supported subset."""
+    import re
+    rng = np.random.default_rng(11)
+    alphabet = list("abc01 ,.")
+    strs = ["".join(rng.choice(alphabet, rng.integers(0, 18)))
+            for _ in range(120)] + [None]
+    pats = [r"[0-9]+", r"a+b", r"(a|b)c", r"[a-c]*[0-9]", r"a.c", r"b,"]
+
+    t = pa.table({"s": pa.array(strs)})
+    for pat in pats:
+        def build(sess, pat=pat):
+            return sess.create_dataframe(t).select(
+                col("s").rlike(pat).alias("m"),
+                F.regexp_replace(col("s"), pat, "@").alias("r"))
+
+        cpu = assert_tpu_and_cpu_equal(build, conf=CONF)
+        rx = re.compile(pat)
+        assert cpu.column("m").to_pylist() == [
+            None if s is None else bool(rx.search(s)) for s in strs], pat

@@ -18,7 +18,12 @@ _TIES = {"q3", "q7", "q19", "q34", "q42", "q43", "q46", "q52", "q55", "q59",
          "q99",
          "q6", "q17", "q33", "q36", "q47", "q53", "q60", "q63", "q69",
          "q76", "q86",
-         "q50", "q71"}
+         "q50", "q71",
+         "q1", "q2", "q4", "q5", "q8", "q9", "q10", "q11", "q12", "q14",
+         "q22", "q23", "q24", "q27", "q30", "q31", "q35", "q38", "q39",
+         "q49", "q51", "q54", "q56", "q57", "q58", "q64", "q66", "q67",
+         "q70", "q72", "q74", "q75", "q77", "q78", "q80", "q81", "q83",
+         "q84", "q85", "q91", "q95"}
 
 _MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
              "q46": 1, "q52": 1, "q55": 1, "q59": 10, "q65": 1, "q68": 1,
@@ -30,7 +35,11 @@ _MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
              "q44": 5, "q47": 10, "q53": 10, "q60": 1, "q63": 10, "q69": 5,
              "q76": 10, "q86": 10, "q88": 1,
              "q41": 1, "q48": 1, "q50": 1, "q61": 1, "q71": 1, "q82": 1,
-             "q87": 1, "q97": 1}
+             "q87": 1, "q97": 1,
+             "q2": 10, "q9": 1, "q10": 1, "q22": 10, "q23": 1, "q27": 10,
+             "q35": 10, "q38": 1, "q39": 10, "q49": 10, "q51": 1, "q56": 5,
+             "q57": 10, "q64": 10, "q67": 10, "q70": 5, "q72": 10,
+             "q77": 10, "q80": 10, "q84": 10, "q85": 1, "q95": 1}
 
 
 @pytest.fixture(scope="module")
