@@ -403,7 +403,11 @@ def regexp_replace(c: Union[str, Column], pattern: str,
 
 def split(c: Union[str, Column], pattern: str, limit: int = -1) -> Column:
     """split(str, regex): index the result with [i]/getItem(i) (arrays are
-    not a columnar type; the item access fuses into one split-part kernel)."""
+    not a columnar type; the item access fuses into one split-part kernel).
+    Only limit=-1 (split at every match) is supported."""
+    if limit != -1:
+        raise NotImplementedError(
+            "split() with a positive limit is not supported (only -1)")
     from spark_rapids_tpu.exprs.strings import StringSplit
     return Column(StringSplit(_c(c), Literal.of(pattern), limit))
 

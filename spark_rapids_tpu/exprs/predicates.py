@@ -249,9 +249,11 @@ class InSet(Expression):
         import numpy as _np
         xp = ctx.xp
         v = self.value.eval(ctx)
-        # compare in the VALUE column's domain: probing a double column
-        # against an int set must not truncate 3.7 -> 3
-        cmp_dtype = (_np.float64 if v.dtype.is_floating
+        # compare in the WIDER domain: a double column probed against an
+        # int set must not truncate 3.7 -> 3, and float literals probed
+        # against an int column must not truncate either (Spark widens)
+        any_float_lit = any(isinstance(x, float) for x in self.values)
+        cmp_dtype = (_np.float64 if (v.dtype.is_floating or any_float_lit)
                      else v.dtype.np_dtype())
         arr = _np.sort(_np.asarray(list(self.values)).astype(cmp_dtype))
         table = xp.asarray(arr)

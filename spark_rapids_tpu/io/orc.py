@@ -1,9 +1,15 @@
-"""ORC scan (reference: GpuOrcScan.scala, 752 LoC — same host-stage/
-device-decode pattern as parquet). Reads stripe-at-a-time (the reference's
-stripe chunking), evolves schema, and appends hive partition values."""
+"""ORC scan with stripe pruning and chunking.
+
+Reference analog: GpuOrcScan.scala (752 LoC) + OrcFilters.scala:194 — footer
+parse on host, SARG-style stripe clipping from per-stripe statistics, then
+stripe-batched decode with the same rows/bytes chunk budgets as the parquet
+reader (populateCurrentBlockChunk analog). Stripe statistics come from the
+file's own metadata section (io/orc_meta.py — pyarrow exposes none), and the
+pruning predicate evaluator is shared with parquet
+(datasource.stats_may_contain)."""
 from __future__ import annotations
 
-from typing import Iterator, Tuple
+from typing import Iterator, List, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.orc as po
@@ -12,20 +18,49 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.columnar.dtypes import Schema
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+from spark_rapids_tpu.exprs.core import Expression
 from spark_rapids_tpu.io.datasource import (PartitionedFile,
                                             append_partition_columns,
-                                            evolve_schema)
+                                            evolve_schema, stats_may_contain)
+
+
+def clip_stripes(path: str, filters: Sequence[Expression],
+                 nstripes: int) -> List[int]:
+    """Stripes whose statistics say they may contain matching rows (the
+    OrcFilters SARG clipping analog). No stats or no filters keeps all.
+    One footer parse total — the reader's own ORCFile handle supplies
+    ``nstripes``."""
+    if not filters:
+        return list(range(nstripes))
+    try:
+        from spark_rapids_tpu.io.orc_meta import read_orc_meta
+        meta = read_orc_meta(path)
+    except Exception:
+        return list(range(nstripes))
+    if len(meta.stripe_stats) != nstripes:
+        return list(range(nstripes))
+    kept = []
+    for i, stats in enumerate(meta.stripe_stats):
+        if all(stats_may_contain(flt, stats) for flt in filters):
+            kept.append(i)
+    return kept
 
 
 class _OrcScanBase(LeafExec):
     def __init__(self, files: Tuple[PartitionedFile, ...], schema: Schema,
-                 partition_schema: Schema = Schema([])):
+                 partition_schema: Schema = Schema([]),
+                 filters: Tuple[Expression, ...] = (),
+                 max_batch_rows: int = 1 << 20,
+                 max_batch_bytes: int = 1 << 31):
         super().__init__(schema)
         self.files = files
         self.partition_schema = partition_schema
         part_names = {f.name for f in partition_schema}
         self.data_schema = Schema([f for f in schema
                                    if f.name not in part_names])
+        self.filters = filters
+        self.max_batch_rows = max_batch_rows
+        self.max_batch_bytes = max_batch_bytes
 
     @property
     def paths(self) -> Tuple[str, ...]:
@@ -47,12 +82,29 @@ class _OrcScanBase(LeafExec):
             file_cols = set(f.schema.names)
             want = [fl.name for fl in self.data_schema
                     if fl.name in file_cols]
-            for i in range(f.nstripes):
+            stripes = clip_stripes(pf.path, self.filters, f.nstripes)
+            # chunk stripes to the rows/bytes budgets
+            # (populateCurrentBlockChunk analog): small stripes coalesce
+            # into one decode, huge ones go alone
+            pending: List[pa.RecordBatch] = []
+            rows = 0
+            for i in stripes:
                 rb = f.read_stripe(i, columns=want)
-                t = evolve_schema(pa.Table.from_batches([rb]),
-                                  self.data_schema)
-                yield append_partition_columns(t, self.partition_schema,
-                                               pf.partition_values)
+                pending.append(rb)
+                rows += rb.num_rows
+                nbytes = sum(b.nbytes for b in pending)
+                if rows >= self.max_batch_rows or \
+                        nbytes >= self.max_batch_bytes:
+                    yield self._emit(pending, pf)
+                    pending, rows = [], 0
+            if pending:
+                yield self._emit(pending, pf)
+
+    def _emit(self, batches: List[pa.RecordBatch],
+              pf: PartitionedFile) -> pa.Table:
+        t = evolve_schema(pa.Table.from_batches(batches), self.data_schema)
+        return append_partition_columns(t, self.partition_schema,
+                                        pf.partition_values)
 
 
 class CpuOrcScanExec(_OrcScanBase):

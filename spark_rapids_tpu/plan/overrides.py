@@ -88,8 +88,15 @@ def _tag_like(meta: ExprMeta) -> None:
         meta.will_not_work("LIKE requires a literal pattern on TPU")
         return
     if st.Like.classify(str(lit.value)) is None:
-        # general pattern: the DFA engine handles it; reject only patterns
-        # the regex subset cannot compile
+        # general pattern: the DFA engine handles it, but '_'/'%' consume
+        # BYTES — multibyte UTF-8 under wildcards diverges from Spark, the
+        # same caveat class as RLike: gate behind incompatibleOps
+        if not meta.conf.get(cfg.INCOMPATIBLE_OPS):
+            meta.will_not_work(
+                f"general LIKE pattern {lit.value!r} uses the byte-level "
+                f"device regex engine; enable with "
+                f"spark.rapids.tpu.sql.incompatibleOps.enabled")
+            return
         from spark_rapids_tpu.ops.regex import like_to_regex
         _tag_regex_pattern(meta, like_to_regex(str(lit.value), e.escape))
 
@@ -427,7 +434,8 @@ def _tag_csv(meta: ExecMeta) -> None:
 def _convert_orc(meta: ExecMeta, children) -> PhysicalExec:
     from spark_rapids_tpu.io.orc import TpuOrcScanExec
     e = meta.exec
-    return TpuOrcScanExec(e.files, e.output, e.partition_schema)
+    return TpuOrcScanExec(e.files, e.output, e.partition_schema, e.filters,
+                          e.max_batch_rows, e.max_batch_bytes)
 
 
 def _tag_orc(meta: ExecMeta) -> None:

@@ -92,14 +92,16 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
                                   plan.partition_schema)
         if plan.fmt == "orc":
             from spark_rapids_tpu.io.orc import CpuOrcScanExec
-            return CpuOrcScanExec(files, plan.read_schema,
-                                  plan.partition_schema)
+            return CpuOrcScanExec(
+                files, plan.read_schema, plan.partition_schema, plan.filters,
+                conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS),
+                conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         raise ValueError(f"unsupported format {plan.fmt}")
     if isinstance(plan, lp.WriteFiles):
         from spark_rapids_tpu.io.write_exec import CpuWriteFilesExec
         return CpuWriteFilesExec(plan.spec, _plan_node(plan.child, conf))
     if isinstance(plan, lp.Filter) and isinstance(plan.child, lp.FileScan) \
-            and plan.child.fmt == "parquet":
+            and plan.child.fmt in ("parquet", "orc"):
         # predicate pushdown: pushable conjuncts clip parquet row groups; the
         # Filter itself stays as the exact row-level net (Spark keeps both too)
         from dataclasses import replace

@@ -118,9 +118,16 @@ def test_fallback_unsupported_expr():
 
     from spark_rapids_tpu.testing import (assert_tables_equal,
                                           run_with_cpu_and_tpu)
-    cpu, tpu, sess = run_with_cpu_and_tpu(on_device)
+    cpu, tpu, sess = run_with_cpu_and_tpu(
+        on_device, conf={"spark.rapids.tpu.sql.incompatibleOps.enabled":
+                         "true"})
     assert_tables_equal(cpu, tpu)
     assert "TpuProjectExec" in sess.last_plan.tree_string()
+
+    # without the incompat opt-in, the byte-level engine is not used
+    cpu, tpu, sess = run_with_cpu_and_tpu(on_device)
+    assert_tables_equal(cpu, tpu)
+    assert "byte-level" in sess.last_explain
 
     def falls_back(s):
         # {n} quantifiers are outside the device regex subset -> CPU fallback

@@ -10,7 +10,8 @@ import pyarrow.parquet as pq
 import pytest
 
 from spark_rapids_tpu.api import TpuSession, functions as F
-from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.testing import (assert_tables_equal,
+                                      assert_tpu_and_cpu_equal)
 
 
 def sample_table(n=200, seed=3):
@@ -232,3 +233,53 @@ def test_csv_partition_discovery(tmp_path):
     back = (sess.read.option("header", "true").csv(str(d)).collect()
             .sort_by("v"))
     assert back.column("k").to_pylist() == ["a", "a", "b"]
+
+
+def test_orc_stripe_pruning_and_chunking(tmp_path):
+    """Stripe statistics (read straight from the file's metadata section —
+    pyarrow exposes none) must prune non-matching stripes, and small stripes
+    must coalesce to the reader's rows budget (GpuOrcScan.scala +
+    OrcFilters.scala:194 analog)."""
+    import datetime
+    import numpy as np
+    import pyarrow.orc as po
+    from spark_rapids_tpu.exprs import (GreaterThanOrEqual, Literal,
+                                        UnresolvedAttribute)
+    from spark_rapids_tpu.io.orc import clip_stripes
+    from spark_rapids_tpu.io.orc_meta import read_orc_meta
+
+    path = str(tmp_path / "t.orc")
+    t = pa.table({
+        "k": pa.array(np.arange(10_000), type=pa.int64()),
+        "s": pa.array([f"v{i:05d}" for i in range(10_000)]),
+        "d": pa.array([datetime.date(2000, 1, 1)
+                       + datetime.timedelta(days=i % 90)
+                       for i in range(10_000)])})
+    po.write_table(t, path, stripe_size=64 * 1024)
+
+    meta = read_orc_meta(path)
+    assert len(meta.stripes) > 4
+    assert len(meta.stripe_stats) == len(meta.stripes)
+    assert meta.stripe_stats[0]["k"].min == 0
+    assert meta.stripe_stats[-1]["k"].max == 9_999
+    assert meta.stripe_stats[0]["s"].min == "v00000"
+
+    flt = GreaterThanOrEqual(UnresolvedAttribute("k"), Literal.of(9_000))
+    kept = clip_stripes(path, [flt], len(meta.stripes))
+    assert 0 < len(kept) < len(meta.stripes)
+
+    # engine end to end: pushdown + correct rows, CPU vs TPU
+    def build(sess):
+        return (sess.read.orc(path)
+                .filter(F.col("k") >= 9_000).select("k", "s"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.num_rows == 1_000
+
+    # chunk coalescing: a small rows budget splits the scan into batches
+    def build2(sess):
+        sess.set_conf("spark.rapids.tpu.sql.reader.batchSizeRows", 3_000)
+        return sess.read.orc(path).select("k")
+
+    cpu = assert_tpu_and_cpu_equal(build2)
+    assert cpu.num_rows == 10_000
