@@ -232,9 +232,10 @@ def _unpack_bytes(xp, words: Sequence, W: int):
     return bytes_.reshape(n, len(words) * 8)[:, :W]
 
 
-#: XLA TPU compile time for a variadic sort grows steeply with operand
-#: count; above this many payload operands the argsort+gather fallback is
-#: cheaper end-to-end (compile once vs run many notwithstanding)
+#: XLA TPU compile time for a variadic sort grows steeply with TOTAL
+#: operand count (keys + payloads; multi-key stable sorts with many
+#: payloads have been observed to wedge the compiler outright); above this
+#: bound the argsort+gather fallback is the safer end-to-end choice
 MAX_SORT_PAYLOADS = 16
 
 
@@ -299,7 +300,7 @@ def sort_colvs(xp, passes: Sequence, colvs: Sequence[ColV],
         packed_bools.append(word)
 
     all_payloads = payloads + packed_bools
-    if len(all_payloads) > MAX_SORT_PAYLOADS:
+    if len(all_payloads) + len(passes) > MAX_SORT_PAYLOADS:
         # too many operands for a fast compile: one sort for the permutation,
         # then gathers (the pre-variadic pattern)
         cap = passes[0].shape[0]
