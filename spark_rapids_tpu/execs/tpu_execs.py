@@ -285,28 +285,35 @@ class TpuHashAggregateExec(PhysicalExec):
                                           cap, grouping=mode,
                                           extra_mask=mask)
                     key_cols, res_cols, num_groups = res[:3]
-                    tail = ((num_groups, res[3]) if mode == "hash"
+                    tail = ((num_groups, res[3]) if mode in ("hash", "onehot")
                             else (num_groups,))
                     return tuple(_flatten_colvs(
                         list(key_cols) + list(res_cols))) + tail
                 return fn
             return make
 
-        # hash-ordered grouping first (one argsort over the key hash); the
-        # exact lexsort re-runs only on the astronomically rare 64-bit
-        # collision between distinct keys
+        # fastest grouping first: the sort-free one-hot path (bounded group
+        # count, exact overflow/collision flag), then hash-ordered grouping
+        # (one variadic sort), then the exact lexsort — each escalation only
+        # on a flagged run
         key = ("agg", self.grouping, fns, self.pre_filter, schema, cap,
                ctx.string_max_bytes)
-        fn = _cached_jit(key + ("hash",), build("hash"))
-        res = fn(np.int32(batch.num_rows), *_flatten(batch))
-        if self.grouping and bool(res[-1]):
-            fn = _cached_jit(key + ("sort",), build("sort"))
+        from spark_rapids_tpu.ops.aggregate import grouping_modes
+        modes = grouping_modes(self.grouping, fns)
+        res = None
+        for mode in modes:
+            fn = _cached_jit(key + (mode,), build(mode))
             res = fn(np.int32(batch.num_rows), *_flatten(batch))
-            n = int(res[-1])
-            out = _to_batch(self.output, res[:-1], n)
-        else:
+            flagged = (mode in ("hash", "onehot") and self.grouping
+                       and bool(res[-1]))
+            if not flagged:
+                break
+        if mode in ("hash", "onehot"):
             n = int(res[-2])
             out = _to_batch(self.output, res[:-2], n)
+        else:
+            n = int(res[-1])
+            out = _to_batch(self.output, res[:-1], n)
         self.count_output(n)
         yield out
 

@@ -45,6 +45,30 @@ def _mix64(xp, z):
     return z ^ (z >> np.uint64(31))
 
 
+def _float_canon(xp, d):
+    """Canonical frexp decomposition of float64 data: returns
+    (sign, e, mi, zero, inf, nan) with m in [1,2) scaled so mi = m*2^52 is an
+    exact integer, identical on every engine (no bitcasts — the TPU x64
+    emulation cannot compile an f64 bitcast). Shared by the hash and the
+    injective key-word encodings so both see the same classes."""
+    sign = d < 0
+    ax = xp.abs(d)
+    nan = xp.isnan(d)
+    inf = xp.isinf(d)
+    finite_pos = xp.logical_and(ax > 0,
+                                xp.logical_not(xp.logical_or(nan, inf)))
+    ax_safe = xp.where(finite_pos, ax, 1.0)
+    e = xp.clip(xp.floor(xp.log2(ax_safe)), -1074.0, 1023.0)
+    m = ax_safe / xp.exp2(e)
+    for _ in range(2):  # each step fixes one off-by-one in the estimate
+        too_big = m >= 2.0
+        too_small = m < 1.0
+        e = xp.where(too_big, e + 1.0, xp.where(too_small, e - 1.0, e))
+        m = xp.where(too_big, m * 0.5, xp.where(too_small, m * 2.0, m))
+    mi = (m * np.float64(2 ** 52)).astype(np.int64)
+    return sign, e, mi, ax == 0, inf, nan
+
+
 def _hash64_col(xp, v: ColV):
     """Per-row 64-bit hash of one column; equal keys (Spark grouping
     semantics: null==null, NaN==NaN, -0.0==0.0) hash equal."""
@@ -71,38 +95,19 @@ def _hash64_col(xp, v: ColV):
             off = np.uint64(((i + 1) * int(_HGOLD)) & 0xFFFFFFFFFFFFFFFF)
             bits = _mix64(xp, bits ^ _mix64(xp, words[..., i] + off))
     elif v.dtype.is_floating:
-        # arithmetic mantissa/exponent decomposition — the TPU x64 emulation
-        # cannot compile an f64 bitcast, and both engines must use the SAME
-        # derivation so group output order matches across CPU and device.
-        # log2 need not round bit-identically across libms, so the estimate
-        # is CANONICALIZED: force m into [1, 2) with exact power-of-two
-        # scaling. After that (mi, e) is the unique normalized frexp pair on
-        # every engine, and m * 2^52 is an exact integer.
+        # arithmetic mantissa/exponent decomposition (shared _float_canon) —
+        # both engines must use the SAME derivation so group output order
+        # matches across CPU and device. (mi, e) is the unique normalized
+        # frexp pair on every engine, and m * 2^52 is an exact integer.
         d = v.data.astype(np.float64)
-        # not signbit(): it bitcasts f64 internally, which the TPU x64
-        # emulation cannot compile; -0.0 and NaN are canonicalized below
-        sign = d < 0
-        ax = xp.abs(d)
-        nan = xp.isnan(d)
-        inf = xp.isinf(d)
-        finite_pos = xp.logical_and(ax > 0,
-                                    xp.logical_not(xp.logical_or(nan, inf)))
-        ax_safe = xp.where(finite_pos, ax, 1.0)
-        e = xp.clip(xp.floor(xp.log2(ax_safe)), -1074.0, 1023.0)
-        m = ax_safe / xp.exp2(e)
-        for _ in range(2):  # each step fixes one off-by-one in the estimate
-            too_big = m >= 2.0
-            too_small = m < 1.0
-            e = xp.where(too_big, e + 1.0, xp.where(too_small, e - 1.0, e))
-            m = xp.where(too_big, m * 0.5, xp.where(too_small, m * 2.0, m))
-        mi = (m * np.float64(2 ** 52)).astype(np.int64)
+        sign, e, mi, zero, inf, nan = _float_canon(xp, d)
         bits = (mi.astype(np.uint64)
                 ^ _mix64(xp, e.astype(np.int64).astype(np.uint64) + _HGOLD)
                 ^ (xp.where(sign, np.uint64(1), np.uint64(0))
                    << np.uint64(63)))
         # canonical classes: +/-0.0 hash as one value, every NaN as one
         # value, +/-inf as their own values (distinct from finite 1.0)
-        bits = xp.where(ax == 0, xp.full_like(bits, np.uint64(0)), bits)
+        bits = xp.where(zero, xp.full_like(bits, np.uint64(0)), bits)
         bits = xp.where(inf, xp.full_like(bits, np.uint64(0x7FF0000000000000))
                         ^ (xp.where(sign, np.uint64(1), np.uint64(0))
                            << np.uint64(63)), bits)
@@ -703,6 +708,141 @@ class SegmentStacker:
     def get(self, handle):
         key, idx = handle
         return self._results[key][:, idx]
+
+
+def key_words(xp, v: ColV) -> List:
+    """Injective uint64 encoding of one grouping-key column: a static-length
+    word list such that two rows are grouping-equal (Spark semantics:
+    null==null, NaN==NaN, -0.0==0.0) IFF all their words are equal. Invalid
+    rows canonicalize every word to 0 — pair with a validity word (see
+    ``validity_word``) to separate null from a zero-encoded value.
+
+    Used by the one-hot aggregation path for EXACT hash-collision detection:
+    per group, min(word) != max(word) for any word proves two distinct keys
+    shared a hash.
+    """
+    if v.dtype is DType.STRING:
+        W = v.data.shape[-1]
+        pad = (-W) % 8
+        data = v.data
+        if pad:
+            data = xp.concatenate(
+                [data, xp.zeros(data.shape[:-1] + (pad,), dtype=np.uint8)],
+                axis=-1)
+        shifts = xp.asarray((np.arange(7, -1, -1) * 8).astype(np.uint64))
+        chunks = data.reshape(data.shape[:-1] + (-1, 8)).astype(np.uint64)
+        words = xp.sum(chunks << shifts, axis=-1)
+        out = [xp.where(v.validity, words[..., i], np.uint64(0))
+               for i in range(words.shape[-1])]
+        out.append(xp.where(v.validity, v.lengths.astype(np.uint64),
+                            np.uint64(0)))
+        return out
+    if v.dtype.is_floating:
+        d = v.data.astype(np.float64)
+        sign, e, mi, zero, inf, nan = _float_canon(xp, d)
+        # finite: w0 = mi (in [2^52, 2^53)); specials use small codes that a
+        # finite mi can never take. w1 = sign/exponent field.
+        w0 = mi.astype(np.uint64)
+        w0 = xp.where(zero, np.uint64(1), w0)
+        w0 = xp.where(inf, np.uint64(2), w0)
+        w0 = xp.where(nan, np.uint64(3), w0)
+        w1 = ((e.astype(np.int64) + np.int64(1074)).astype(np.uint64)
+              | (xp.where(sign, np.uint64(1), np.uint64(0)) << np.uint64(13)))
+        w1 = xp.where(zero, np.uint64(0), w1)
+        w1 = xp.where(nan, np.uint64(0), w1)
+        w1 = xp.where(inf, xp.where(sign, np.uint64(1), np.uint64(0)), w1)
+        return [xp.where(v.validity, w0, np.uint64(0)),
+                xp.where(v.validity, w1, np.uint64(0))]
+    if v.dtype is DType.BOOLEAN:
+        return [xp.where(v.validity, v.data.astype(np.uint64), np.uint64(0))]
+    bits = v.data.astype(np.int64).astype(np.uint64)
+    return [xp.where(v.validity, bits, np.uint64(0))]
+
+
+def validity_word(xp, keys: Sequence[ColV]):
+    """One uint64 packing every key column's validity bit (<=64 columns)."""
+    w = None
+    for i, v in enumerate(keys[:64]):
+        piece = v.validity.astype(np.uint64) << np.uint64(i)
+        w = piece if w is None else w | piece
+    return w
+
+
+#: block shape of the sorted-segment reduction: B consecutive sorted rows
+#: reduce into L block-local one-hot slots. A block spanning >= L distinct
+#: segments trips the traced overflow flag and the program falls back to the
+#: full scatter (correct at the old speed).
+_SEG_BLOCK_B = 512
+_SEG_BLOCK_L = 16
+
+
+class SortedSegmentStacker(SegmentStacker):
+    """SegmentStacker over SORTED (non-decreasing) seg_ids.
+
+    TPU scatters cost ~100ns/row regardless of segment count, which made the
+    stacked scatter the dominant kernel of every aggregation (~0.6s for 6M
+    rows on v5e). With sorted ids, rows reduce block-locally first: each
+    block of B rows builds a [B, L] one-hot against its local id offsets and
+    reduces to L partials, then only nb*L partials (a ~B/L-fold reduction in
+    scattered rows) go through the real scatter. Blocks spanning >= L
+    segments flip a traced overflow flag; a lax.cond then routes the stacked
+    contributions through the plain full scatter instead, so skewed/tiny-group
+    inputs stay correct. Measured ~9x over the full scatter at 6M rows.
+    """
+
+    def run(self) -> None:
+        import jax
+        xp = self.xp
+        gids = self.seg_ids
+        cap = gids.shape[0]
+        B, L = _SEG_BLOCK_B, _SEG_BLOCK_L
+        if xp is np or cap % B or cap < 4 * B:
+            super().run()
+            return
+        nb = cap // B
+        g2 = gids.reshape(nb, B)
+        first = g2[:, :1]
+        overflow = xp.any((g2[:, -1:] - first) >= L)
+        loc = xp.clip(g2 - first, 0, L - 1)
+        onehot = loc[:, :, None] == xp.arange(L, dtype=gids.dtype)[None, None, :]
+        pg = xp.clip(first + xp.arange(L, dtype=np.int32)[None, :], 0,
+                     self.num_segments - 1).reshape(-1)
+
+        ops = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}
+        self._ran = True
+        for key, arrs in self._buckets.items():
+            kind, _ = key
+            m = xp.stack(arrs, axis=1)          # [cap, k]
+            dt = m.dtype
+            if kind == "sum":
+                neutral = xp.zeros((), dtype=dt)
+            elif kind == "min":
+                neutral = (xp.asarray(np.inf, dt)
+                           if np.issubdtype(dt, np.floating)
+                           else xp.asarray(np.iinfo(dt).max, dt))
+            else:
+                neutral = (xp.asarray(-np.inf, dt)
+                           if np.issubdtype(dt, np.floating)
+                           else xp.asarray(np.iinfo(dt).min, dt))
+
+            def blocked(m, kind=kind, neutral=neutral):
+                k = m.shape[1]
+                mb = m.reshape(nb, B, 1, k)
+                masked = xp.where(onehot[:, :, :, None], mb, neutral)
+                if kind == "sum":
+                    part = xp.sum(masked, axis=1, dtype=m.dtype)
+                elif kind == "min":
+                    part = xp.min(masked, axis=1)
+                else:
+                    part = xp.max(masked, axis=1)
+                return ops[kind](part.reshape(nb * L, k).astype(m.dtype), pg,
+                                 num_segments=self.num_segments)
+
+            def full(m, kind=kind):
+                return ops[kind](m, gids, num_segments=self.num_segments)
+
+            self._results[key] = jax.lax.cond(overflow, full, blocked, m)
 
 
 def take_columns(xp, columns: Sequence[ColV], indices) -> List[ColV]:

@@ -213,3 +213,167 @@ def test_group_cap_fallback_many_groups():
         agg_mod.GROUP_CAP = old
     assert cpu.num_rows == n
     assert cpu.column("s").to_pylist() == [1] * n
+
+
+# ---------------------------------------------------------------------------
+# one-hot (sort-free, scatter-free) low-cardinality fast path
+# ---------------------------------------------------------------------------
+def _q1ish_inputs(n=400, nulls=True, seed=7):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 6, n)
+    v = rng.integers(-100, 100, n).astype(np.float64)
+    v[rng.random(n) < 0.1] = np.nan
+    mk = pa.array(k, type=pa.int64())
+    mv = pa.array(v, type=pa.float64(),
+                  mask=(rng.random(n) < 0.15) if nulls else None)
+    return pa.table({"k": mk, "v": mv})
+
+
+def _run_group_aggregate(t, grouping, fns_builder=None):
+    from spark_rapids_tpu.exprs import (Average, Count, Literal, Max, Min,
+                                        Sum, bind_expression)
+    from spark_rapids_tpu.exprs.core import EvalCtx, UnresolvedAttribute
+    from spark_rapids_tpu.columnar.dtypes import Schema
+    from spark_rapids_tpu.columnar.host import HostBatch
+    from spark_rapids_tpu.ops.aggregate import group_aggregate
+
+    schema = Schema.from_pa(t.schema)
+    hb = HostBatch.from_arrow(t, 8)
+    n = t.num_rows
+    colvs = [ColV(c.dtype, c.data, c.validity, c.lengths) for c in hb.columns]
+    ectx = EvalCtx(np, colvs, n, 8)
+    b = lambda name: bind_expression(UnresolvedAttribute(name), schema)
+    keys = (b("k"),)
+    fns = (Sum(b("v")), Min(b("v")), Max(b("v")), Average(b("v")),
+           Count(Literal.of(1)))
+    return group_aggregate(np, ectx, keys, fns, n, n, grouping=grouping)
+
+
+def _group_map(kcols, rcols, n):
+    out = {}
+    for i in range(int(n)):
+        key = (int(kcols[0].data[i]) if kcols[0].validity[i] else None)
+        vals = []
+        for r in rcols:
+            vals.append(float(r.data[i]) if r.validity[i] else None)
+        out[key] = tuple(vals)
+    return out
+
+
+def test_onehot_matches_sort_with_nulls_and_nans():
+    t = _q1ish_inputs()
+    ks, rs, n_s = _run_group_aggregate(t, "sort")
+    ko, ro, n_o, collision = _run_group_aggregate(t, "onehot")
+    assert not bool(collision)
+    assert int(n_s) == int(n_o)
+    ms, mo = _group_map(ks, rs, n_s), _group_map(ko, ro, n_o)
+    assert set(ms) == set(mo)
+    for k in ms:
+        for a, b in zip(ms[k], mo[k]):
+            if a is None or b is None:
+                assert a is b, (k, ms[k], mo[k])
+            elif np.isnan(a) or np.isnan(b):
+                assert np.isnan(a) and np.isnan(b), (k, ms[k], mo[k])
+            else:
+                assert abs(a - b) < 1e-9, (k, ms[k], mo[k])
+
+
+def test_onehot_overflow_flagged():
+    from spark_rapids_tpu.ops.aggregate import ONEHOT_CAP
+    n = ONEHOT_CAP * 3
+    t = pa.table({"k": np.arange(n), "v": np.ones(n, np.float64)})
+    _, _, _, collision = _run_group_aggregate(t, "onehot")
+    assert bool(collision)
+
+
+def test_onehot_jit_matches_numpy():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exprs import Count, Literal, Sum, bind_expression
+    from spark_rapids_tpu.exprs.core import EvalCtx, UnresolvedAttribute
+    from spark_rapids_tpu.columnar.dtypes import Schema
+    from spark_rapids_tpu.columnar.host import HostBatch
+    from spark_rapids_tpu.ops.aggregate import group_aggregate
+
+    t = _q1ish_inputs(n=257)
+    schema = Schema.from_pa(t.schema)
+    hb = HostBatch.from_arrow(t, 8)
+    n = t.num_rows
+    b = lambda name: bind_expression(UnresolvedAttribute(name), schema)
+    keys = (b("k"),)
+    fns = (Sum(b("v")), Count(Literal.of(1)))
+
+    flat = []
+    for c in hb.columns:
+        flat.append(c.data)
+        flat.append(c.validity)
+
+    def prog(*flat):
+        colvs = [ColV(c.dtype, flat[2 * i], flat[2 * i + 1])
+                 for i, c in enumerate(hb.columns)]
+        ectx = EvalCtx(jnp, colvs, n, 8)
+        ks, rs, ng, coll = group_aggregate(jnp, ectx, keys, fns, n, n,
+                                           grouping="onehot")
+        return ([k.data for k in ks] + [k.validity for k in ks]
+                + [r.data for r in rs] + [r.validity for r in rs]
+                + [ng, coll])
+
+    jout = [np.asarray(a) for a in jax.jit(prog)(*flat)]
+    colvs = [ColV(c.dtype, c.data, c.validity) for c in hb.columns]
+    ectx = EvalCtx(np, colvs, n, 8)
+    ks, rs, ng, coll = group_aggregate(np, ectx, keys, fns, n, n,
+                                       grouping="onehot")
+    assert not bool(coll) and not bool(jout[-1])
+    assert int(ng) == int(jout[-2])
+    m_np = _group_map(ks, rs, ng)
+    kj = [ColV(DType.LONG, jout[0], jout[1])]
+    rj = [ColV(DType.DOUBLE, jout[2], jout[4]),
+          ColV(DType.LONG, jout[3], jout[5])]
+    m_j = _group_map(kj, rj, int(jout[-2]))
+    assert set(m_np) == set(m_j)
+    for k in m_np:
+        for a, b in zip(m_np[k], m_j[k]):
+            if a is None or b is None:
+                assert a is b, (k, m_np[k], m_j[k])
+            elif np.isnan(a) or np.isnan(b):
+                assert np.isnan(a) and np.isnan(b), (k, m_np[k], m_j[k])
+            else:
+                assert abs(a - b) < 1e-9, (k, m_np[k], m_j[k])
+
+
+def test_key_words_null_vs_zero_and_float_canon():
+    ints = ColV(DType.LONG, np.array([0, 0, 5]),
+                np.array([True, False, True]))
+    w = bk.key_words(np, ints)[0]
+    vw = bk.validity_word(np, [ints])
+    # data words canonicalize nulls to 0 — only the validity word separates
+    # null from a genuine zero
+    assert w[0] == w[1] and vw[0] != vw[1]
+
+    f = ColV(DType.DOUBLE, np.array([-0.0, 0.0, np.nan, np.nan, 1.5, 2.5]),
+             np.ones(6, bool))
+    w0, w1 = bk.key_words(np, f)
+    assert w0[0] == w0[1] and w1[0] == w1[1]      # -0.0 == 0.0
+    assert w0[2] == w0[3] and w1[2] == w1[3]      # NaN == NaN
+    assert (w0[4], w1[4]) != (w0[5], w1[5])       # distinct finites differ
+    # injectivity across close values
+    g = ColV(DType.DOUBLE, np.array([1.0, np.nextafter(1.0, 2.0)]),
+             np.ones(2, bool))
+    gw0, gw1 = bk.key_words(np, g)
+    assert (gw0[0], gw1[0]) != (gw0[1], gw1[1])
+
+
+def test_min_max_string_still_uses_hash_path():
+    """String min/max is outside the one-hot path; the engine must fall back
+    and stay correct."""
+    t = pa.table({"k": pa.array([1, 1, 2, 2, 2]),
+                  "s": pa.array(["b", "a", "z", "m", "q"])})
+
+    def build(sess):
+        return (sess.create_dataframe(t).groupBy("k")
+                .agg(F.min("s").alias("lo"), F.max("s").alias("hi"))
+                .sort("k"))
+
+    cpu = assert_tpu_and_cpu_equal(build)
+    assert cpu.column("lo").to_pylist() == ["a", "m"]
+    assert cpu.column("hi").to_pylist() == ["b", "z"]
