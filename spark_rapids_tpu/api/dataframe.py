@@ -382,8 +382,9 @@ class DataFrameWriter:
         final = df._executed_plan()
         df._run_partitions(final)
         # surface write stats from whichever engine ran the command
+        from spark_rapids_tpu.execs.mesh_execs import MeshWriteFilesExec
         for node in _iter_execs(final):
-            if isinstance(node, CpuWriteFilesExec):
+            if isinstance(node, (CpuWriteFilesExec, MeshWriteFilesExec)):
                 return node.stats
         return None
 

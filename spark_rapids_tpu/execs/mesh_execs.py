@@ -382,10 +382,17 @@ class MeshShuffleExchangeExec(MeshExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
         from spark_rapids_tpu.execs.exchange_execs import (HashPartitioning,
+                                                           RangePartitioning,
                                                            RoundRobinPartitioning)
         part = self.partitioning
         n_dev = int(self.mesh.devices.size)
         for mb in self.children[0].execute(ctx):
+            if isinstance(part, RangePartitioning):
+                out = _range_repartition(mb, part.orders,
+                                         ctx.string_max_bytes)
+                self.count_output(out.num_rows)
+                yield out
+                continue
             if isinstance(part, HashPartitioning):
                 builder = _hash_pid_builder(part.keys, n_dev)
             elif isinstance(part, RoundRobinPartitioning):
@@ -401,6 +408,203 @@ class MeshShuffleExchangeExec(MeshExec):
                 builder, smax=ctx.string_max_bytes)
             self.count_output(out.num_rows)
             yield out
+
+
+# ------------------------------------------------------------------ expand
+class MeshExpandExec(MeshExec):
+    """Expand (rollup/cube/grouping sets) per shard: every projection list
+    evaluates against the shard's rows and the results stack locally —
+    no cross-shard movement at all (GpuExpandExec.scala runs the same
+    projections per task; here a task is a shard). Output order per shard is
+    projection-major, matching the single-device exec's batch-per-projection
+    order."""
+
+    def __init__(self, projections, child: PhysicalExec, output: Schema,
+                 mesh: Mesh):
+        super().__init__((child,), output, mesh)
+        self.projections = projections
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        mb = self._one_child_batch(ctx)
+        cap = mb.local_capacity
+        schema = self.children[0].output
+        smax = ctx.string_max_bytes
+        nproj = len(self.projections)
+        max_rows = int(mb.rows_per_shard.max(initial=0))
+        # never above nproj*cap (the stacked array length): key and shape
+        # must agree for the compile-cache bucketing to work
+        out_cap = max(min(bucket_capacity(nproj * max_rows), nproj * cap), 1)
+        key = ("mexpand", self.projections, schema, cap, out_cap, smax)
+
+        def build(projs=self.projections, schema=schema, cap=cap,
+                  out_cap=out_cap, smax=smax):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                ectx = _shard_ectx(colvs, cap, smax)
+                live = jnp.arange(cap, dtype=np.int32) < rows[0]
+                # per projection: one (data, validity, lengths) per out column
+                parts = [[colv_to_column(e.eval(ectx), jnp, cap, smax)
+                          for e in plist] for plist in projs]
+                glive = jnp.tile(live, len(projs))
+                order = jnp.argsort(~glive, stable=True)[:out_cap]
+                res = []
+                for ci in range(len(parts[0])):
+                    datas = [p[ci][0] for p in parts]
+                    if datas[0].ndim == 2:  # strings: pad to the max width
+                        w = max(d.shape[1] for d in datas)
+                        datas = [jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+                                 for d in datas]
+                    res.append(jnp.concatenate(datas)[order])
+                    res.append(jnp.concatenate(
+                        [p[ci][1] for p in parts])[order])
+                    if parts[0][ci][2] is not None:
+                        res.append(jnp.concatenate(
+                            [p[ci][2] for p in parts])[order])
+                n = (rows[0] * np.int32(len(projs))).astype(np.int32)
+                return (n[None],) + tuple(res)
+            return fn
+
+        nout = flat_len(self.output)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
+                        (P(DATA_AXIS),) + _specs(nout))
+        res = fn(mb.rows_dev(), *flatten_mesh(mb))
+        rows = np.asarray(res[0]).astype(np.int32)
+        out = MeshBatch(self.output, mesh_columns(self.output, res[1:]),
+                        rows, self.mesh)
+        self.count_output(out.num_rows)
+        yield out
+
+
+class MeshGenerateExec(MeshExpandExec):
+    """Explode/posexplode per shard — the generate-as-expand lowering
+    (GpuGenerateExec.scala), sharded."""
+
+
+# ------------------------------------------------------------------ window
+class MeshWindowExec(MeshExec):
+    """Distributed window: hash-repartition by the window partition keys so
+    every partition group lands whole on one shard, then evaluate the shared
+    sorted-window kernel per shard (GpuWindowExec.scala distributed by
+    Spark's required child distribution — ClusteredDistribution(part_keys) —
+    which is exactly a key-hash exchange)."""
+
+    def __init__(self, wexprs: Tuple[Expression, ...], child: PhysicalExec,
+                 mesh: Mesh):
+        from spark_rapids_tpu.execs.window_execs import window_output_schema
+        super().__init__((child,), window_output_schema(child.output, wexprs),
+                         mesh)
+        self.wexprs = wexprs
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
+        from spark_rapids_tpu.execs.window_execs import evaluate_window
+        mb = self._one_child_batch(ctx)
+        n_dev = mb.n_dev
+        smax = ctx.string_max_bytes
+        first = (self.wexprs[0].c if isinstance(self.wexprs[0], Alias)
+                 else self.wexprs[0])
+        part_exprs = tuple(first.part_keys)
+        assert part_exprs, "unpartitioned window must gather (rewrite bug)"
+        if n_dev > 1:
+            mb = _mesh_repartition(
+                mb, ("mwindow_part", part_exprs, mb.schema,
+                     mb.local_capacity),
+                _hash_pid_builder(part_exprs, n_dev), smax=smax)
+        cap = mb.local_capacity
+        schema = self.children[0].output
+        key = ("mwindow", self.wexprs, schema, cap, smax)
+
+        def build(wexprs=self.wexprs, schema=schema, cap=cap, smax=smax):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(schema, flat)
+                out = evaluate_window(jnp, colvs, wexprs, rows[0], cap, smax)
+                return tuple(flatten_colvs(out))
+            return fn
+
+        nout = flat_len(self.output)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
+                        _specs(nout))
+        res = fn(mb.rows_dev(), *flatten_mesh(mb))
+        out = MeshBatch(self.output, mesh_columns(self.output, res),
+                        mb.rows_per_shard, self.mesh)
+        self.count_output(out.num_rows)
+        yield out
+
+
+# ------------------------------------------------------------------ writes
+class MeshWriteFilesExec(MeshExec):
+    """Distributed file write: each shard's rows download and encode as one
+    writer task (one part file per shard, like one file per Spark task —
+    GpuDataWritingCommandExec.scala:94 / GpuFileFormatWriter), sharing the
+    single commit protocol. No gather: per-shard host staging only."""
+
+    def __init__(self, spec, child: PhysicalExec, mesh: Mesh):
+        super().__init__((child,), Schema([]), mesh)
+        self.spec = spec
+        from spark_rapids_tpu.io.writer import WriteStats
+        self.stats = WriteStats()
+
+    def execute(self, ctx: ExecContext):
+        import time
+        from spark_rapids_tpu.io.write_exec import (make_task_writer,
+                                                    total_output_bytes)
+        from spark_rapids_tpu.io.writer import (DynamicPartitionDataWriter,
+                                                FileCommitProtocol,
+                                                WriteStats,
+                                                resolve_save_mode)
+        t0 = time.perf_counter()
+        self.stats = WriteStats()
+        if resolve_save_mode(self.spec.path, self.spec.mode) is None:
+            return
+        mb = self._one_child_batch(ctx)
+        committer = FileCommitProtocol(self.spec.path)
+        committer.setup_job()
+        child_schema = self.children[0].output
+        partitions_seen = set()
+        try:
+            for d, table in enumerate(_shard_tables(mb)):
+                writer = make_task_writer(self.spec, child_schema, committer,
+                                          d)
+                if table.num_rows:
+                    writer.write(table)
+                writer.close()
+                self.stats.num_files += writer.files_written
+                self.stats.num_rows += writer.rows_written
+                if isinstance(writer, DynamicPartitionDataWriter):
+                    partitions_seen |= writer.partitions_seen
+        except Exception:
+            committer.abort_job()
+            raise
+        committer.commit_job()
+        self.stats.num_partitions = len(partitions_seen)
+        self.stats.num_bytes = total_output_bytes(self.spec.path)
+        self.stats.write_time_s += time.perf_counter() - t0
+        return
+        yield  # pragma: no cover — generator
+
+
+def _shard_tables(mb: MeshBatch):
+    """Per-shard arrow tables, pulling ONE shard's buffers to host at a time
+    (per-task download; never the whole mesh batch)."""
+    dev_order = {d: i for i, d in enumerate(mb.mesh.devices.flat)}
+    for d in range(mb.n_dev):
+        n = int(mb.rows_per_shard[d])
+        cols = []
+        for c in mb.columns:
+            parts = {}
+            for nm, arr in (("data", c.data), ("validity", c.validity),
+                            ("lengths", c.lengths)):
+                if arr is None:
+                    parts[nm] = None
+                    continue
+                shard = next(s for s in arr.addressable_shards
+                             if dev_order[s.device] == d)
+                parts[nm] = np.asarray(shard.data)
+            cols.append(ColV(c.dtype, parts["data"], parts["validity"],
+                             parts["lengths"]))
+        from spark_rapids_tpu.execs.cpu_execs import _colvs_to_host
+        yield _colvs_to_host(mb.schema, cols, n).to_arrow()
 
 
 # ------------------------------------------------------------------ aggregate
@@ -679,50 +883,19 @@ class MeshSortExec(MeshExec):
     composition)."""
 
     def __init__(self, orders: Tuple[SortOrder, ...], child: PhysicalExec,
-                 mesh: Mesh):
+                 mesh: Mesh, pre_partitioned: bool = False):
         super().__init__((child,), child.output, mesh)
         self.orders = orders
+        #: child is already range-partitioned on these orders (an explicit
+        #: RangePartitioning exchange below) — skip the redundant repartition
+        self.pre_partitioned = pre_partitioned
 
     def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
-        from spark_rapids_tpu.execs.exchange_execs import (_sample_bounds,
-                                                           range_partition_ids)
         mb = self._one_child_batch(ctx)
-        n_dev = mb.n_dev
         smax = ctx.string_max_bytes
         schema = self.output
-        if mb.num_rows and n_dev > 1:
-            bounds = self._sampled_bounds(mb, smax)
-            if bounds is not None:
-                bflat = []
-                for v in bounds:
-                    for a in flatten_colvs([v]):
-                        bflat.append(jax.device_put(
-                            np.asarray(a), NamedSharding(self.mesh, P())))
-                nb = len(bflat)
-                bschema = tuple(v.dtype for v in bounds)
-                nbound = bounds[0].validity.shape[0]
-
-                def pid(colvs, ectx, extra, orders=self.orders,
-                        bschema=bschema):
-                    bnd = []
-                    i = 0
-                    for dt in bschema:
-                        if dt is DType.STRING:
-                            bnd.append(ColV(dt, extra[i], extra[i + 1],
-                                            extra[i + 2]))
-                            i += 3
-                        else:
-                            bnd.append(ColV(dt, extra[i], extra[i + 1]))
-                            i += 2
-                    row_keys = [o.child.eval(ectx) for o in orders]
-                    return range_partition_ids(jnp, orders, row_keys, bnd,
-                                               ectx.capacity)
-
-                mb = _mesh_repartition(
-                    mb, ("msort_part", self.orders, schema,
-                         mb.local_capacity, nbound),
-                    pid, extra_flat=tuple(bflat), n_extra=nb, smax=smax)
-
+        if not self.pre_partitioned:
+            mb = _range_repartition(mb, self.orders, smax)
         cap = mb.local_capacity
         key = ("msort", self.orders, schema, cap, smax)
 
@@ -748,51 +921,90 @@ class MeshSortExec(MeshExec):
         self.count_output(out.num_rows)
         yield out
 
-    def _sampled_bounds(self, mb: MeshBatch, smax: int):
-        """Evaluate the order keys per shard, pull an evenly spaced sample to
-        the host, derive n_dev-1 range bounds (SamplingUtils role)."""
-        from spark_rapids_tpu.execs.exchange_execs import _sample_bounds
-        cap = mb.local_capacity
-        schema = mb.schema
-        k = min(_SAMPLE_PER_SHARD, cap)
-        key = ("msort_sample", self.orders, schema, cap, k, smax)
+def _mesh_sampled_bounds(mb: MeshBatch, orders, smax: int):
+    """Evaluate the order keys per shard, pull an evenly spaced sample to
+    the host, derive n_dev-1 range bounds (SamplingUtils role)."""
+    from spark_rapids_tpu.execs.exchange_execs import _sample_bounds
+    cap = mb.local_capacity
+    schema = mb.schema
+    k = min(_SAMPLE_PER_SHARD, cap)
+    key = ("msort_sample", orders, schema, cap, k, smax)
 
-        def build(orders=self.orders, schema=schema, cap=cap, k=k, smax=smax):
-            def fn(rows, *flat):
-                colvs = unflatten_colvs(schema, flat)
-                ectx = EvalCtx(jnp, colvs, cap, smax)
-                keys = [o.child.eval(ectx) for o in orders]
-                idx = jnp.asarray(
-                    np.linspace(0, cap - 1, k).astype(np.int32))
-                alive = idx < rows[0]
-                outs = [alive]
-                for v in keys:
-                    v = bk.as_column(jnp, v, cap)
-                    outs.extend(flatten_colvs([bk.take_colv(jnp, v, idx)]))
-                return tuple(outs)
-            return fn
+    def build(orders=orders, schema=schema, cap=cap, k=k, smax=smax):
+        def fn(rows, *flat):
+            colvs = unflatten_colvs(schema, flat)
+            ectx = EvalCtx(jnp, colvs, cap, smax)
+            keys = [o.child.eval(ectx) for o in orders]
+            idx = jnp.asarray(
+                np.linspace(0, cap - 1, k).astype(np.int32))
+            alive = idx < rows[0]
+            outs = [alive]
+            for v in keys:
+                v = bk.as_column(jnp, v, cap)
+                outs.extend(flatten_colvs([bk.take_colv(jnp, v, idx)]))
+            return tuple(outs)
+        return fn
 
-        n_keys_flat = sum(3 if o.child.dtype() is DType.STRING else 2
-                          for o in self.orders)
-        fn = _shard_jit(self.mesh, key, build,
-                        (P(DATA_AXIS),) + _specs(flat_len(schema)),
-                        _specs(1 + n_keys_flat))
-        res = [np.asarray(a) for a in fn(mb.rows_dev(), *flatten_mesh(mb))]
-        alive = res[0]
-        if not alive.any():
-            return None
-        keys = []
-        i = 1
-        for o in self.orders:
-            dt = o.child.dtype()
+    n_keys_flat = sum(3 if o.child.dtype() is DType.STRING else 2
+                      for o in orders)
+    fn = _shard_jit(mb.mesh, key, build,
+                    (P(DATA_AXIS),) + _specs(flat_len(schema)),
+                    _specs(1 + n_keys_flat))
+    res = [np.asarray(a) for a in fn(mb.rows_dev(), *flatten_mesh(mb))]
+    alive = res[0]
+    if not alive.any():
+        return None
+    keys = []
+    i = 1
+    for o in orders:
+        dt = o.child.dtype()
+        if dt is DType.STRING:
+            keys.append(ColV(dt, res[i][alive], res[i + 1][alive],
+                             res[i + 2][alive]))
+            i += 3
+        else:
+            keys.append(ColV(dt, res[i][alive], res[i + 1][alive]))
+            i += 2
+    return _sample_bounds(orders, [keys], mb.n_dev)
+
+
+def _range_repartition(mb: MeshBatch, orders, smax: int) -> MeshBatch:
+    """Sample-based range repartition over ICI: ascending shard index =
+    ascending key range (GpuRangePartitioning + GpuRangePartitioner role).
+    No-op on a single-device mesh or an empty batch."""
+    from spark_rapids_tpu.execs.exchange_execs import range_partition_ids
+    orders = tuple(orders)
+    if not mb.num_rows or mb.n_dev < 2:
+        return mb
+    bounds = _mesh_sampled_bounds(mb, orders, smax)
+    if bounds is None:
+        return mb
+    bflat = []
+    for v in bounds:
+        for a in flatten_colvs([v]):
+            bflat.append(jax.device_put(
+                np.asarray(a), NamedSharding(mb.mesh, P())))
+    nb = len(bflat)
+    bschema = tuple(v.dtype for v in bounds)
+    nbound = bounds[0].validity.shape[0]
+
+    def pid(colvs, ectx, extra, orders=orders, bschema=bschema):
+        bnd = []
+        i = 0
+        for dt in bschema:
             if dt is DType.STRING:
-                keys.append(ColV(dt, res[i][alive], res[i + 1][alive],
-                                 res[i + 2][alive]))
+                bnd.append(ColV(dt, extra[i], extra[i + 1], extra[i + 2]))
                 i += 3
             else:
-                keys.append(ColV(dt, res[i][alive], res[i + 1][alive]))
+                bnd.append(ColV(dt, extra[i], extra[i + 1]))
                 i += 2
-        return _sample_bounds(self.orders, [keys], mb.n_dev)
+        row_keys = [o.child.eval(ectx) for o in orders]
+        return range_partition_ids(jnp, orders, row_keys, bnd,
+                                   ectx.capacity)
+
+    return _mesh_repartition(
+        mb, ("msort_part", orders, mb.schema, mb.local_capacity, nbound),
+        pid, extra_flat=tuple(bflat), n_extra=nb, smax=smax)
 
 
 # ------------------------------------------------------------------ limit/union

@@ -35,6 +35,26 @@ class WriteSpec:
         return dict(self.options)
 
 
+def make_task_writer(spec: WriteSpec, child_schema: Schema,
+                     committer: FileCommitProtocol, task_id: int):
+    """One writer per task (single-directory or dynamic-partition), shared by
+    the single-device and mesh write execs."""
+    if spec.partition_by:
+        return DynamicPartitionDataWriter(
+            spec.fmt, child_schema, spec.partition_by, committer, task_id,
+            spec.options_dict, spec.max_records_per_file)
+    return SingleDirectoryDataWriter(
+        spec.fmt, child_schema, committer, task_id, spec.options_dict,
+        spec.max_records_per_file)
+
+
+def total_output_bytes(path: str) -> int:
+    import os
+    return sum(os.path.getsize(os.path.join(d, f))
+               for d, _, fs in os.walk(path) for f in fs
+               if not f.startswith("_"))
+
+
 class CpuWriteFilesExec(PhysicalExec):
     """Write command exec: produces no rows; ``stats`` carries the write
     result (GpuDataWritingCommandExec analog)."""
@@ -47,15 +67,8 @@ class CpuWriteFilesExec(PhysicalExec):
         self._skipped = False
 
     def _task_writer(self, task_id: int):
-        child_schema = self.children[0].output
-        if self.spec.partition_by:
-            return DynamicPartitionDataWriter(
-                self.spec.fmt, child_schema, self.spec.partition_by,
-                self._committer, task_id, self.spec.options_dict,
-                self.spec.max_records_per_file)
-        return SingleDirectoryDataWriter(
-            self.spec.fmt, child_schema, self._committer, task_id,
-            self.spec.options_dict, self.spec.max_records_per_file)
+        return make_task_writer(self.spec, self.children[0].output,
+                                self._committer, task_id)
 
     def _batch_table(self, batch):
         return batch.to_arrow()
@@ -85,11 +98,7 @@ class CpuWriteFilesExec(PhysicalExec):
             self.stats.num_partitions += len(writer.partitions_seen)
         if ctx.partition_id == ctx.num_partitions - 1:
             self._committer.commit_job()
-            import os
-            self.stats.num_bytes = sum(
-                os.path.getsize(os.path.join(d, f))
-                for d, _, fs in os.walk(self.spec.path) for f in fs
-                if not f.startswith("_"))
+            self.stats.num_bytes = total_output_bytes(self.spec.path)
         self.stats.write_time_s += time.perf_counter() - t0
         return
         yield  # pragma: no cover — makes this a generator

@@ -17,7 +17,11 @@ Lowering rules:
   shape for group-by results);
 - shuffled hash joins repartition both sides by key hash over the mesh;
   broadcast hash joins replicate the build batch;
-- unsupported operators (window, expand/generate, nested-loop forms, writes)
+- expand/generate run per shard (no movement); windows hash-repartition by
+  their partition keys then evaluate per shard; writes emit one part file
+  per shard through the shared commit protocol; range partitioning
+  repartitions by sampled bounds;
+- unsupported operators (unpartitioned windows, nested-loop join forms)
   fall back to single-device execution behind a gather — correctness first,
   with the boundary explicit in the plan.
 """
@@ -58,6 +62,8 @@ def _gathered(node: PhysicalExec, mesh) -> PhysicalExec:
         return te.HostToDeviceExec(node.children[0])
     if isinstance(node, me.MeshFromDeviceExec):
         return node.children[0]
+    if isinstance(node, me.MeshWriteFilesExec):
+        return node  # produces no rows; nothing to gather
     return me.MeshGatherExec(node, mesh) if _is_mesh(node) else node
 
 
@@ -99,6 +105,30 @@ def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
     if isinstance(node, te.TpuFilterExec) and _is_mesh(kids[0]):
         return me.MeshFilterExec(node.condition, kids[0], mesh)
 
+    # ---- expand/generate ----------------------------------------------------
+    from spark_rapids_tpu.execs.expand_execs import TpuExpandExec
+    from spark_rapids_tpu.execs.generate_execs import TpuGenerateExec
+    if isinstance(node, TpuExpandExec) and _is_mesh(kids[0]):
+        cls = (me.MeshGenerateExec if isinstance(node, TpuGenerateExec)
+               else me.MeshExpandExec)
+        return cls(node.projections, kids[0], node.output, mesh)
+
+    # ---- window -------------------------------------------------------------
+    from spark_rapids_tpu.execs.window_execs import TpuWindowExec
+    from spark_rapids_tpu.exprs.misc import Alias
+    if isinstance(node, TpuWindowExec) and _is_mesh(kids[0]):
+        first = (node.wexprs[0].c if isinstance(node.wexprs[0], Alias)
+                 else node.wexprs[0])
+        if first.part_keys:
+            return me.MeshWindowExec(node.wexprs, kids[0], mesh)
+        # unpartitioned window: one global frame — single device, like
+        # Spark's single-partition requirement (falls through to gather)
+
+    # ---- writes -------------------------------------------------------------
+    from spark_rapids_tpu.io.write_exec import TpuWriteFilesExec
+    if isinstance(node, TpuWriteFilesExec) and _is_mesh(kids[0]):
+        return me.MeshWriteFilesExec(node.spec, kids[0], mesh)
+
     # ---- aggregation --------------------------------------------------------
     if isinstance(node, te.TpuHashAggregateExec) and _is_mesh(kids[0]):
         return me.MeshHashAggregateExec(node.grouping, node.aggregates,
@@ -136,7 +166,12 @@ def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
 
     # ---- sort/limit/union ---------------------------------------------------
     if isinstance(node, te.TpuSortExec) and _is_mesh(kids[0]):
-        return me.MeshSortExec(node.orders, kids[0], mesh)
+        from spark_rapids_tpu.execs.exchange_execs import RangePartitioning
+        pre = (isinstance(kids[0], me.MeshShuffleExchangeExec)
+               and isinstance(kids[0].partitioning, RangePartitioning)
+               and tuple(kids[0].partitioning.orders) == tuple(node.orders))
+        return me.MeshSortExec(node.orders, kids[0], mesh,
+                               pre_partitioned=pre)
     if isinstance(node, te.TpuLimitExec) and _is_mesh(kids[0]):
         return me.MeshLimitExec(node.n, kids[0], mesh)
     if isinstance(node, te.TpuUnionExec) and (
@@ -148,8 +183,10 @@ def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
 
     # ---- exchanges ----------------------------------------------------------
     if isinstance(node, TpuShuffleExchangeExec) and _is_mesh(kids[0]):
+        from spark_rapids_tpu.execs.exchange_execs import RangePartitioning
         part = node.partitioning
-        if isinstance(part, (HashPartitioning, RoundRobinPartitioning)):
+        if isinstance(part, (HashPartitioning, RoundRobinPartitioning,
+                             RangePartitioning)):
             return me.MeshShuffleExchangeExec(part, kids[0], mesh)
         return me.MeshGatherExec(kids[0], mesh)
     if isinstance(node, TpuBroadcastExchangeExec):
