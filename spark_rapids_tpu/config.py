@@ -218,6 +218,16 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+MESH_AGG_REPARTITION_THRESHOLD = _conf(
+    "sql.mesh.aggRepartitionThreshold", int, 8192,
+    "Distributed aggregations whose total partial-group count exceeds this "
+    "switch from all-gather-and-merge-everywhere to a hash repartition of the "
+    "partial buffers by key (each shard merges only its own key range) — the "
+    "partial/final split over a hash exchange the reference uses for "
+    "arbitrary-cardinality group-bys (aggregate.scala:227 + "
+    "GpuHashPartitioning). Small groupings keep the all-gather merge: one "
+    "collective, no repartition program.")
+
 # --------------------------------------------------------------------------------------
 # Memory / scheduling (analog of spark.rapids.memory.*)
 # --------------------------------------------------------------------------------------

@@ -12,8 +12,10 @@ on device), this engine runs every operator as ONE SPMD program over a
   (no host round trip, no serialization, no bounce buffers);
 - a broadcast exchange is buffer replication across the mesh (XLA
   all-gather), the GpuBroadcastExchangeExec role;
-- aggregation is partial-per-shard -> all-gather -> replicated merge
-  (aggregate.scala Partial/Final modes fused into one program).
+- aggregation is partial-per-shard, then all-gather + replicated merge for
+  small groupings or a key-hash repartition + per-shard merge for large ones
+  (aggregate.scala Partial/Final modes over GpuHashPartitioning), with the
+  output staying mesh-sharded.
 
 Dynamic output sizes (filter/join cardinality) cross the SPMD boundary as
 per-shard row-count vectors — one tiny host sync per operator, amortized over
@@ -587,6 +589,7 @@ class MeshWriteFilesExec(MeshExec):
 def _shard_tables(mb: MeshBatch):
     """Per-shard arrow tables, pulling ONE shard's buffers to host at a time
     (per-task download; never the whole mesh batch)."""
+    from spark_rapids_tpu.execs.cpu_execs import _colvs_to_host
     dev_order = {d: i for i, d in enumerate(mb.mesh.devices.flat)}
     for d in range(mb.n_dev):
         n = int(mb.rows_per_shard[d])
@@ -603,18 +606,30 @@ def _shard_tables(mb: MeshBatch):
                 parts[nm] = np.asarray(shard.data)
             cols.append(ColV(c.dtype, parts["data"], parts["validity"],
                              parts["lengths"]))
-        from spark_rapids_tpu.execs.cpu_execs import _colvs_to_host
         yield _colvs_to_host(mb.schema, cols, n).to_arrow()
 
 
 # ------------------------------------------------------------------ aggregate
 class MeshHashAggregateExec(MeshExec):
-    """Distributed aggregation as ONE SPMD program: per-shard partial
-    aggregation (Partial mode), all-gather of partial keys+buffers over ICI,
-    replicated merge (Final mode). Output is a small single-device batch —
-    the natural shape for everything downstream of a group-by."""
+    """Distributed aggregation, mesh in -> mesh out (post-agg subtrees stay
+    distributed). Three stages:
 
-    is_mesh = False  # produces a plain DeviceBatch
+    1. Per-shard partial aggregation (Partial mode, aggregate.scala) with the
+       same grouping-mode escalation as the single-device exec: sort-free
+       one-hot -> hash-ordered -> exact lexsort, each re-run only on a flagged
+       collision/overflow (ORed across the mesh).
+    2. One host sync of the per-shard partial group counts picks the merge
+       strategy.
+    3a. Small groupings: all-gather the partials over ICI, merge replicated
+        (Final mode), and each shard keeps a contiguous slice of the merged
+        groups — the output is already evenly mesh-sharded.
+    3b. Large groupings (total partials > sql.mesh.aggRepartitionThreshold):
+        hash-repartition the PARTIAL key+buffer rows by key over ICI
+        (all_to_all) so equal keys collocate, then each shard merges only its
+        own key range — the reference's partial/final split over a hash
+        exchange (aggregate.scala:227 + GpuHashPartitioning), which scales to
+        arbitrary group cardinality with no replicated blowup.
+    """
 
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...], child: PhysicalExec,
@@ -625,9 +640,19 @@ class MeshHashAggregateExec(MeshExec):
         self.aggregates = aggregates
         self.pre_filter = pre_filter
 
-    def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+    def _partial_schema(self, fns) -> Schema:
+        from spark_rapids_tpu.columnar.dtypes import Field
+        fields = [Field(f"_k{i}", e.dtype(), e.nullable())
+                  for i, e in enumerate(self.grouping)]
+        for fi, fn in enumerate(fns):
+            for bi, spec in enumerate(fn.buffer_specs()):
+                fields.append(Field(f"_b{fi}_{bi}", spec.dtype, True))
+        return Schema(fields)
+
+    def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
         from spark_rapids_tpu.ops.aggregate import (group_aggregate,
-                                                    merge_aggregate)
+                                                    grouping_modes)
+        from spark_rapids_tpu import config as cfg
         mb = self._one_child_batch(ctx)
         cap = mb.local_capacity
         schema = self.children[0].output
@@ -635,11 +660,15 @@ class MeshHashAggregateExec(MeshExec):
         n_dev = mb.n_dev
         fns = tuple(a.c if isinstance(a, Alias) else a
                     for a in self.aggregates)
+        pschema = self._partial_schema(fns)
+        npartial = flat_len(pschema)
         key = ("magg", self.grouping, fns, self.pre_filter, schema, cap, smax)
+        in_specs = (P(DATA_AXIS),) + _specs(flat_len(schema))
 
-        def build(mode):
+        # ---- stage 1: per-shard partial aggregation (escalating modes) ----
+        def build_partial(mode):
             def make(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
-                     smax=smax, pre=self.pre_filter, n_dev=n_dev, mode=mode):
+                     smax=smax, pre=self.pre_filter, mode=mode):
                 def fn(rows, *flat):
                     colvs = unflatten_colvs(schema, flat)
                     ectx = _shard_ectx(colvs, cap, smax)
@@ -653,18 +682,9 @@ class MeshHashAggregateExec(MeshExec):
                         jnp, ectx, keys_, fns, rows[0], cap, evaluate=False,
                         grouping=mode, extra_mask=mask)
                     key_cols, buf_cols, ng = res[:3]
-                    pcap = (key_cols[0].validity.shape[0] if key_cols
-                            else buf_cols[0].validity.shape[0])
-                    galive = jax.lax.all_gather(
-                        jnp.arange(pcap, dtype=np.int32) < ng, DATA_AXIS,
-                        tiled=True)
-                    gk = [_gather_colv(k) for k in key_cols]
-                    gb = [_gather_colv(b) for b in buf_cols]
-                    out_keys, out_res, total = merge_aggregate(
-                        jnp, gk, gb, fns, galive, pcap * n_dev)
-                    out = tuple(flatten_colvs(list(out_keys)
-                                              + list(out_res))) + (total,)
-                    if mode == "hash":
+                    out = (ng[None].astype(np.int32),) + tuple(
+                        flatten_colvs(list(key_cols) + list(buf_cols)))
+                    if mode in ("hash", "onehot"):
                         # any shard's collision poisons the whole result:
                         # OR across the mesh, replicated to every device
                         bad = jax.lax.psum(res[3].astype(np.int32),
@@ -674,30 +694,123 @@ class MeshHashAggregateExec(MeshExec):
                 return fn
             return make
 
-        nout = flat_len(self.output)
-        in_specs = (P(DATA_AXIS),) + _specs(flat_len(schema))
-        # hash-ordered grouping first (same fast path as the single-device
-        # aggregate); the exact lexsort program re-runs only on a flagged
-        # 64-bit collision or group-cap overflow
-        if self.grouping:
-            fn = _shard_jit(self.mesh, key + ("hash",), build("hash"),
-                            in_specs, _specs(nout, P()) + (P(), P()))
+        modes = (grouping_modes(self.grouping, fns) if self.grouping
+                 else ["sort"])
+        for mode in modes:
+            flagged_specs = ((P(),) if mode in ("hash", "onehot") else ())
+            fn = _shard_jit(
+                self.mesh, key + ("partial", mode), build_partial(mode),
+                in_specs,
+                (P(DATA_AXIS),) + _specs(npartial) + flagged_specs)
             res = fn(mb.rows_dev(), *flatten_mesh(mb))
-            collided = bool(res[-1])
-            res = res[:-1]
+            if mode in ("hash", "onehot"):
+                if not bool(res[-1]):
+                    res = res[:-1]
+                    break
+            else:
+                break
+        ng = np.asarray(res[0]).astype(np.int32)
+        partial = MeshBatch(pschema, mesh_columns(pschema, res[1:]), ng,
+                            self.mesh)
+        total = int(ng.sum())
+
+        threshold = ctx.conf.get(cfg.MESH_AGG_REPARTITION_THRESHOLD)
+        if self.grouping and total > threshold:
+            out = self._merge_repartitioned(partial, fns, smax)
         else:
-            collided = True  # no-key aggregation: sort mode is already cheap
-        if collided:
-            fn = _shard_jit(self.mesh, key + ("sort",), build("sort"),
-                            in_specs, _specs(nout, P()) + (P(),))
-            res = fn(mb.rows_dev(), *flatten_mesh(mb))
-        n = int(res[-1])
-        dev = jax.devices()[0]
-        placed = jax.device_put(list(res[:-1]), dev)
-        from spark_rapids_tpu.execs.tpu_execs import _to_batch
-        out = _to_batch(self.output, placed, n)
-        self.count_output(n)
+            out = self._merge_all_gather(partial, fns, total, smax)
+        self.count_output(out.num_rows)
         yield out
+
+    # ---- stage 3a: all-gather + replicated merge + slice ------------------
+    def _merge_all_gather(self, partial: MeshBatch, fns, total: int,
+                          smax: int) -> MeshBatch:
+        from spark_rapids_tpu.ops.aggregate import merge_aggregate
+        n_dev = partial.n_dev
+        pcap = partial.local_capacity
+        pschema = partial.schema
+        nkeys = len(self.grouping)
+        # `total` (sum of per-shard partial counts) upper-bounds the merged
+        # group count, so `per` is a safe static slice stride; the true
+        # merged total comes back from the program and trims rows_per_shard
+        per = -(-total // n_dev) if total else 0
+        out_cap = max(bucket_capacity(per), 1)
+        key = ("magg_merge_ag", self.grouping, fns, pschema, pcap, out_cap,
+               smax, per)
+
+        def build(fns=fns, pschema=pschema, pcap=pcap, out_cap=out_cap,
+                  nkeys=nkeys, n_dev=n_dev, per=per):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(pschema, flat)
+                galive = jax.lax.all_gather(
+                    jnp.arange(pcap, dtype=np.int32) < rows[0], DATA_AXIS,
+                    tiled=True)
+                g = [_gather_colv(v) for v in colvs]
+                out_keys, out_res, merged_n = merge_aggregate(
+                    jnp, g[:nkeys], g[nkeys:], fns, galive, pcap * n_dev)
+                d = jax.lax.axis_index(DATA_AXIS).astype(np.int32)
+                idx = jnp.clip(d * np.int32(per)
+                               + jnp.arange(out_cap, dtype=np.int32),
+                               0, pcap * n_dev - 1)
+                outs = [merged_n.astype(np.int32)]
+                for v in out_keys + out_res:
+                    outs.append(v.data[idx])
+                    outs.append(v.validity[idx])
+                    if v.lengths is not None:
+                        outs.append(v.lengths[idx])
+                return tuple(outs)
+            return fn
+
+        nout = flat_len(self.output)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(pschema)),
+                        (P(),) + _specs(nout))
+        res = fn(partial.rows_dev(), *flatten_mesh(partial))
+        merged_total = int(res[0])
+        rows = np.asarray([max(0, min(per, merged_total - d * per))
+                           for d in range(n_dev)], dtype=np.int32)
+        return MeshBatch(self.output, mesh_columns(self.output, res[1:]),
+                         rows, self.mesh)
+
+    # ---- stage 3b: hash repartition partials + per-shard merge ------------
+    def _merge_repartitioned(self, partial: MeshBatch, fns,
+                             smax: int) -> MeshBatch:
+        from spark_rapids_tpu.ops.aggregate import merge_aggregate
+        from spark_rapids_tpu.exprs.core import BoundReference
+        n_dev = partial.n_dev
+        pschema = partial.schema
+        nkeys = len(self.grouping)
+        key_refs = tuple(
+            BoundReference(i, f.dtype, f.nullable)
+            for i, f in enumerate(pschema.fields[:nkeys]))
+        partial = _mesh_repartition(
+            partial, ("magg_part", key_refs, pschema,
+                      partial.local_capacity),
+            _hash_pid_builder(key_refs, n_dev), smax=smax)
+        pcap = partial.local_capacity
+        key = ("magg_merge_part", self.grouping, fns, pschema, pcap, smax)
+
+        def build(fns=fns, pschema=pschema, pcap=pcap, nkeys=nkeys):
+            def fn(rows, *flat):
+                colvs = unflatten_colvs(pschema, flat)
+                alive_n = rows[0]
+                out_keys, out_res, ng = merge_aggregate(
+                    jnp, colvs[:nkeys], colvs[nkeys:], fns, alive_n, pcap)
+                outs = [ng[None].astype(np.int32)]
+                for v in out_keys + out_res:
+                    outs.extend(flatten_colvs([v]))
+                return tuple(outs)
+            return fn
+
+        nout = flat_len(self.output)
+        fn = _shard_jit(self.mesh, key, build,
+                        (P(DATA_AXIS),) + _specs(flat_len(pschema)),
+                        (P(DATA_AXIS),) + _specs(nout))
+        res = fn(partial.rows_dev(), *flatten_mesh(partial))
+        rows = np.asarray(res[0]).astype(np.int32)
+        out = MeshBatch(self.output, mesh_columns(self.output, res[1:]),
+                        rows, self.mesh)
+        return _maybe_shrink(out)
 
 
 def _gather_colv(v: ColV) -> ColV:

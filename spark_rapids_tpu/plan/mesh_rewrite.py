@@ -12,9 +12,10 @@ Lowering rules:
 - upload transitions become mesh scatters; download boundaries gather;
 - project/filter/sort/limit/union/exchange run per shard (ICI repartition
   where rows must move);
-- hash aggregation becomes partial-per-shard + all-gather + merge, returning
-  a small single-device batch (post-agg plans run single-device, the right
-  shape for group-by results);
+- hash aggregation is partial-per-shard, then either all-gather + replicated
+  merge (small groupings, each shard keeping a slice) or a hash repartition
+  of the partials + per-shard merge (large groupings) — mesh in, mesh out,
+  so post-aggregation subtrees stay distributed;
 - shuffled hash joins repartition both sides by key hash over the mesh;
   broadcast hash joins replicate the build batch;
 - expand/generate run per shard (no movement); windows hash-repartition by

