@@ -66,20 +66,31 @@ class _CsvScanBase(LeafExec):
 
     scan_partitions: int = 1
 
+    is_file_scan = True
+
     @property
     def num_partitions(self) -> int:
         return self.scan_partitions
 
-    def _iter_arrow(self, ctx: ExecContext):
-        from spark_rapids_tpu.io.datasource import (append_partition_columns,
-                                                    assigned_files)
-        if ctx.partition_id >= self.scan_partitions:
-            return
-        for pf in assigned_files(self.files, ctx.partition_id,
-                                 self.scan_partitions):
+    def file_row_counts(self):
+        """CSV has no row-count metadata; shard-local mesh reads fall back
+        to the read-then-scatter path."""
+        return None
+
+    def iter_tables_for_files(self, files):
+        from spark_rapids_tpu.io.datasource import append_partition_columns
+        for pf in files:
             t = _read_table(pf.path, self.data_schema, self.options)
             yield append_partition_columns(t, self.partition_schema,
                                            pf.partition_values)
+
+    def _iter_arrow(self, ctx: ExecContext):
+        from spark_rapids_tpu.io.datasource import assigned_files
+        if ctx.partition_id >= self.scan_partitions:
+            return
+        yield from self.iter_tables_for_files(
+            assigned_files(self.files, ctx.partition_id,
+                           self.scan_partitions))
 
 
 class CpuCsvScanExec(_CsvScanBase):

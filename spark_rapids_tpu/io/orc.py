@@ -9,6 +9,8 @@ pruning predicate evaluator is shared with parquet
 (datasource.stats_may_contain)."""
 from __future__ import annotations
 
+import os
+from functools import lru_cache
 from typing import Iterator, List, Sequence, Tuple
 
 import pyarrow as pa
@@ -24,19 +26,30 @@ from spark_rapids_tpu.io.datasource import (PartitionedFile,
                                             evolve_schema, stats_may_contain)
 
 
+def _orc_meta(path: str):
+    """Cached native metadata parse keyed by file state: the sizing pass
+    (file_row_counts), stripe clipping, and the read pass share ONE parse."""
+    st = os.stat(path)
+    return _orc_meta_cached(path, st.st_mtime_ns, st.st_size)
+
+
+@lru_cache(maxsize=512)
+def _orc_meta_cached(path: str, mtime_ns: int, size: int):
+    from spark_rapids_tpu.io.orc_meta import read_orc_meta
+    return read_orc_meta(path)
+
+
 def clip_stripes(path: str, filters: Sequence[Expression],
-                 nstripes: int) -> List[int]:
+                 nstripes: int, meta=None) -> List[int]:
     """Stripes whose statistics say they may contain matching rows (the
-    OrcFilters SARG clipping analog). No stats or no filters keeps all.
-    One footer parse total — the reader's own ORCFile handle supplies
-    ``nstripes``."""
+    OrcFilters SARG clipping analog). No stats or no filters keeps all."""
     if not filters:
         return list(range(nstripes))
-    try:
-        from spark_rapids_tpu.io.orc_meta import read_orc_meta
-        meta = read_orc_meta(path)
-    except Exception:
-        return list(range(nstripes))
+    if meta is None:
+        try:
+            meta = _orc_meta(path)
+        except Exception:
+            return list(range(nstripes))
     if len(meta.stripe_stats) != nstripes:
         return list(range(nstripes))
     kept = []
@@ -68,21 +81,43 @@ class _OrcScanBase(LeafExec):
 
     scan_partitions: int = 1
 
+    is_file_scan = True
+
     @property
     def num_partitions(self) -> int:
         return self.scan_partitions
 
-    def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
-        from spark_rapids_tpu.io.datasource import assigned_files
-        if ctx.partition_id >= self.scan_partitions:
-            return
-        for pf in assigned_files(self.files, ctx.partition_id,
-                                 self.scan_partitions):
+    def file_row_counts(self):
+        """Exact per-file row counts after stripe pruning, from the native
+        metadata walker only (no data read, one parse per file state)."""
+        counts = []
+        for pf in self.files:
+            try:
+                meta = _orc_meta(pf.path)
+            except Exception:
+                return None
+            ns = len(meta.stripes)
+            if ns == 0:
+                if meta.num_rows:
+                    return None  # stripe list didn't parse; sizes unknown
+                counts.append(0)
+                continue
+            stripes = clip_stripes(pf.path, self.filters, ns, meta=meta)
+            counts.append(sum(meta.stripes[i].num_rows for i in stripes))
+        return counts
+
+    def iter_tables_for_files(self, files) -> Iterator[pa.Table]:
+        for pf in files:
             f = po.ORCFile(pf.path)
             file_cols = set(f.schema.names)
             want = [fl.name for fl in self.data_schema
                     if fl.name in file_cols]
-            stripes = clip_stripes(pf.path, self.filters, f.nstripes)
+            try:
+                meta = _orc_meta(pf.path)
+            except Exception:
+                meta = None
+            stripes = clip_stripes(pf.path, self.filters, f.nstripes,
+                                   meta=meta)
             # chunk stripes to the rows/bytes budgets
             # (populateCurrentBlockChunk analog): small stripes coalesce
             # into one decode, huge ones go alone
@@ -105,6 +140,14 @@ class _OrcScanBase(LeafExec):
         t = evolve_schema(pa.Table.from_batches(batches), self.data_schema)
         return append_partition_columns(t, self.partition_schema,
                                         pf.partition_values)
+
+    def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
+        from spark_rapids_tpu.io.datasource import assigned_files
+        if ctx.partition_id >= self.scan_partitions:
+            return
+        yield from self.iter_tables_for_files(
+            assigned_files(self.files, ctx.partition_id,
+                           self.scan_partitions))
 
 
 class CpuOrcScanExec(_OrcScanBase):

@@ -12,6 +12,8 @@ missing columns with nulls (evolveSchemaIfNeededAndClose, :520); hive partition
 values are appended per batch (ColumnarPartitionReaderWithPartitionValues)."""
 from __future__ import annotations
 
+import os
+from functools import lru_cache
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
@@ -62,12 +64,30 @@ def clip_row_groups(pf: pq.ParquetFile,
     return kept
 
 
+@lru_cache(maxsize=512)
+def _clipped_groups_cached(path: str, mtime_ns: int, size: int,
+                           filters: Tuple[Expression, ...]):
+    """One footer parse per (file state, filters): the pruned row-group list
+    and its exact row count, shared by the sizing pass (file_row_counts) and
+    the read pass so metadata is never re-parsed per pass."""
+    pf = pq.ParquetFile(path)
+    groups = clip_row_groups(pf, filters)
+    rows = sum(pf.metadata.row_group(i).num_rows for i in groups)
+    return tuple(groups), rows
+
+
+def clipped_groups(path: str, filters: Tuple[Expression, ...]):
+    st = os.stat(path)
+    return _clipped_groups_cached(path, st.st_mtime_ns, st.st_size,
+                                  tuple(filters))
+
+
 def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
                       partition_schema: Schema,
                       filters: Sequence[Expression],
                       max_rows: int, max_bytes: int) -> Iterator[pa.Table]:
     pf = pq.ParquetFile(f.path)
-    groups = clip_row_groups(pf, filters)
+    groups = list(clipped_groups(f.path, tuple(filters))[0])
     if not groups:
         return
     md = pf.metadata
@@ -121,18 +141,33 @@ class _ParquetScanBase(LeafExec):
     #: 1 = the whole scan runs in partition 0
     scan_partitions: int = 1
 
+    #: marks execs whose input is a partitioned file list that shard-local
+    #: mesh reads can split (GpuParquetScan's per-task partition readers)
+    is_file_scan = True
+
     @property
     def num_partitions(self) -> int:
         return self.scan_partitions
 
-    def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
-        if ctx.partition_id >= self.scan_partitions:
-            return
-        for f in assigned_files(self.files, ctx.partition_id,
-                                self.scan_partitions):
+    def file_row_counts(self) -> Optional[List[int]]:
+        """Exact per-file row counts after row-group pruning, from footer
+        metadata only (no data read) — sizes shard-local mesh reads."""
+        return [clipped_groups(f.path, tuple(self.filters))[1]
+                for f in self.files]
+
+    def iter_tables_for_files(self, files: Sequence[PartitionedFile]
+                              ) -> Iterator[pa.Table]:
+        for f in files:
             yield from _iter_file_tables(
                 f, self.data_schema, self.partition_schema, self.filters,
                 self.max_batch_rows, self.max_batch_bytes)
+
+    def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
+        if ctx.partition_id >= self.scan_partitions:
+            return
+        yield from self.iter_tables_for_files(
+            assigned_files(self.files, ctx.partition_id,
+                           self.scan_partitions))
 
 
 class CpuParquetScanExec(_ParquetScanBase):

@@ -88,6 +88,20 @@ def mesh_columns(schema: Schema, flat) -> Tuple[DeviceColumn, ...]:
     return tuple(cols)
 
 
+def staged_column_arrays(dtype: DType, col, string_max_bytes: int):
+    """Chunk-normalize one arrow column and stage it to
+    (data, validity, lengths) numpy arrays, validity defaulting to all-true
+    — the single staging path for every host->mesh upload."""
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if isinstance(arr, pa.ChunkedArray):
+        arr = (arr.chunk(0) if arr.num_chunks == 1
+               else pa.concat_arrays(arr.chunks))
+    data, validity, lengths = _arrow_to_staged(dtype, arr, string_max_bytes)
+    if validity is None:
+        validity = np.ones(len(arr), dtype=bool)
+    return data, validity, lengths
+
+
 def scatter_arrow(table: pa.Table, mesh: Mesh, string_max_bytes: int
                   ) -> MeshBatch:
     """Host arrow table -> mesh batch: rows split contiguously across shards
@@ -108,14 +122,9 @@ def scatter_arrow(table: pa.Table, mesh: Mesh, string_max_bytes: int
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     cols: List[DeviceColumn] = []
     for i, f in enumerate(schema):
-        arr = table.column(i).combine_chunks()
-        if isinstance(arr, pa.ChunkedArray):
-            arr = (arr.chunk(0) if arr.num_chunks == 1
-                   else pa.concat_arrays(arr.chunks))
-        data, validity, lengths = _arrow_to_staged(f.dtype, arr,
-                                                   string_max_bytes)
-        if validity is None:
-            validity = np.ones(n, dtype=bool)
+        data, validity, lengths = staged_column_arrays(f.dtype,
+                                                       table.column(i),
+                                                       string_max_bytes)
         gdata = np.zeros((total,) + data.shape[1:], dtype=data.dtype)
         gvalid = np.zeros(total, dtype=bool)
         glen = (np.zeros(total, dtype=np.int32) if lengths is not None

@@ -61,6 +61,11 @@ def _gathered(node: PhysicalExec, mesh) -> PhysicalExec:
     if isinstance(node, me.MeshScatterExec):
         # scatter-then-gather is a plain upload: collapse the round trip
         return te.HostToDeviceExec(node.children[0])
+    if isinstance(node, me.MeshFileScatterExec):
+        # a gathered file scan is just the chunked single-device scan
+        scan = node.children[0]
+        return (scan if getattr(scan, "is_device", False)
+                else te.HostToDeviceExec(scan))
     if isinstance(node, me.MeshFromDeviceExec):
         return node.children[0]
     if isinstance(node, me.MeshWriteFilesExec):
@@ -90,8 +95,16 @@ def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
 
     kids = [_rewrite(c, mesh) for c in node.children]
 
+    # ---- scans --------------------------------------------------------------
+    if getattr(node, "is_file_scan", False) and getattr(node, "is_device",
+                                                        False):
+        # device file scan: shard-local reads straight onto the mesh
+        return me.MeshFileScatterExec(node, mesh)
+
     # ---- transitions --------------------------------------------------------
     if isinstance(node, te.HostToDeviceExec):
+        if getattr(kids[0], "is_file_scan", False):
+            return me.MeshFileScatterExec(kids[0], mesh)
         return me.MeshScatterExec(kids[0], mesh)
     if isinstance(node, te.DeviceToHostExec):
         return te.DeviceToHostExec(_gathered(kids[0], mesh))
