@@ -218,6 +218,33 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+SHUFFLE_FETCH_TIMEOUT = _conf(
+    "shuffle.fetch.timeoutSeconds", int, 300,
+    "How long a reduce-side reader waits for remote shuffle blocks before "
+    "raising ShuffleFetchFailedError (the stage-retry signal). Cold cluster "
+    "executors pay first-compile latency on the serving path, so this "
+    "defaults well above the transfer time itself.")
+
+CLUSTER_EXECUTORS = _conf(
+    "sql.cluster.numExecutors", int, 0,
+    "Multi-executor query execution: plans split into shuffle stages at "
+    "exchange boundaries and tasks run across this many executors, each with "
+    "its own shuffle environment (tiered stores + catalogs + transport "
+    "server). Exchanges write through the caching shuffle writer and reducers "
+    "fetch local blocks from their catalog and remote blocks via the "
+    "transport client — the load-bearing RapidsShuffleInternalManager path "
+    "(RapidsShuffleInternalManager.scala:194, RapidsCachingReader.scala). "
+    "0 disables (single-process engine). Mutually exclusive with mesh "
+    "execution.")
+
+CLUSTER_PROCESS_EXECUTORS = _conf(
+    "sql.cluster.processExecutors", bool, False,
+    "Run each cluster executor as its own OS process (daemon spawned per "
+    "executor, tasks dispatched over a control socket, shuffle data over the "
+    "TCP transport) instead of in-process executors — the cross-host "
+    "topology. Requires the TCP shuffle transport; a registry directory is "
+    "created automatically when not configured.")
+
 MESH_AGG_REPARTITION_THRESHOLD = _conf(
     "sql.mesh.aggRepartitionThreshold", int, 8192,
     "Distributed aggregations whose total partial-group count exceeds this "

@@ -21,7 +21,7 @@ class ExecContext:
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
                  num_partitions: int = 1, device_manager=None,
-                 cleanups: Optional[list] = None):
+                 cleanups: Optional[list] = None, cluster_shuffle=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
@@ -29,6 +29,9 @@ class ExecContext:
         #: shared across the partitions of one action; run by the caller when
         #: the query finishes (shuffle unregistration etc.)
         self.cleanups = cleanups
+        #: cluster-task wiring (executor shuffle env + dep map statuses) for
+        #: ClusterShuffleReadExec leaves; None outside cluster execution
+        self.cluster_shuffle = cluster_shuffle
 
     @property
     def string_max_bytes(self) -> int:

@@ -293,6 +293,26 @@ class ShuffleExchangeExecBase(PhysicalExec):
         #: MapStatus sizes that drive AQE decisions)
         self._part_rows: Dict[int, int] = {}
 
+    def __getstate__(self):
+        # cluster tasks receive pickled exchanges; map state is per-process
+        state = dict(self.__dict__)
+        state["_lock"] = None
+        state["_map_done"] = False
+        state["_part_rows"] = {}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def __copy__(self):
+        # copy.copy (with_children/transform_up rewrites) must PRESERVE map
+        # state — only pickling resets it (adaptive reuses executed
+        # exchanges through copies; a reset would re-run the whole map)
+        new = self.__class__.__new__(self.__class__)
+        new.__dict__.update(self.__dict__)
+        return new
+
     @property
     def num_partitions(self) -> int:
         return self.partitioning.num_partitions
@@ -325,7 +345,8 @@ def _child_contexts(child: PhysicalExec, ctx: ExecContext) -> Iterator[ExecConte
         yield ExecContext(ctx.conf, partition_id=p,
                           num_partitions=child_parts,
                           device_manager=ctx.device_manager,
-                          cleanups=ctx.cleanups)
+                          cleanups=ctx.cleanups,
+                          cluster_shuffle=ctx.cluster_shuffle)
 
 
 class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
@@ -472,6 +493,44 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                 yield batch
 
     # ---- map side ------------------------------------------------------------
+    def iter_map_pieces(self, ctx: ExecContext,
+                        partition_ids=None) -> Iterator[Tuple[int, int, DeviceBatch]]:
+        """(source_partition, reduce_pid, sub_batch) triples — THE map-side
+        partition protocol, shared by the single-process engine and cluster
+        map tasks. Range partitioning stages the requested partitions and
+        samples bounds first (the SamplingUtils pass); everything else
+        splits each batch as it is produced, so peak footprint is one batch
+        plus the spillable shuffle cache."""
+        part = self.partitioning
+        n = part.num_partitions
+        child = self.children[0]
+
+        def contexts():
+            for cctx in self._child_contexts(ctx):
+                if partition_ids is None or \
+                        cctx.partition_id in partition_ids:
+                    yield cctx
+
+        if isinstance(part, RangePartitioning):
+            staged = [(cctx.partition_id, bi, db)
+                      for cctx in contexts()
+                      for bi, db in enumerate(child.execute(cctx))]
+            bounds = self._device_bounds(ctx, part, staged, n)
+            for map_p, bi, db in staged:
+                if db.num_rows == 0:
+                    continue
+                for j, sub in self._split_batch(ctx, part, db, 0, n, bounds):
+                    yield map_p, j, sub
+            return
+        for cctx in contexts():
+            for bi, db in enumerate(child.execute(cctx)):
+                if db.num_rows == 0:
+                    continue
+                offset = _round_robin_offset(part, cctx.partition_id, bi)
+                for j, sub in self._split_batch(ctx, part, db, offset, n,
+                                                None):
+                    yield cctx.partition_id, j, sub
+
     def _run_map(self, ctx: ExecContext) -> None:
         from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
         from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout,
@@ -484,38 +543,14 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         if ctx.cleanups is not None:
             ctx.cleanups.append(
                 lambda: env.shuffle_catalog.remove_shuffle(sid))
-        n = self.partitioning.num_partitions
-        part = self.partitioning
-
-        # only range partitioning stages the child (bounds need a global
-        # sample); the rest split-and-cache each batch as it is produced, so
-        # peak footprint is one batch plus the spillable shuffle cache
-        bounds = None
-        if isinstance(part, RangePartitioning):
-            staged = [(cctx.partition_id, bi, db)
-                      for cctx in self._child_contexts(ctx)
-                      for bi, db in enumerate(self.children[0].execute(cctx))]
-            bounds = self._device_bounds(ctx, part, staged, n)
-            batches = iter(staged)
-        else:
-            batches = ((cctx.partition_id, bi, db)
-                       for cctx in self._child_contexts(ctx)
-                       for bi, db in enumerate(self.children[0].execute(cctx)))
-
-        map_id = 0
-        for map_p, bi, db in batches:
-            if db.num_rows == 0:
-                continue
-            offset = _round_robin_offset(part, map_p, bi)
-            for j, sub in self._split_batch(ctx, part, db, offset, n, bounds):
-                sub = uniform_string_batch(sub)
-                layout = DevicePackLayout.for_batch_shape(
-                    sub.schema, sub.capacity, batch_string_max(sub))
-                meta = layout_to_meta(layout, sub.num_rows)
-                env.shuffle_catalog.add_batch(
-                    ShuffleBlockId(sid, map_id, j), sub, meta)
-                self._part_rows[j] = self._part_rows.get(j, 0) + sub.num_rows
-            map_id += 1
+        for map_p, j, sub in self.iter_map_pieces(ctx):
+            sub = uniform_string_batch(sub)
+            layout = DevicePackLayout.for_batch_shape(
+                sub.schema, sub.capacity, batch_string_max(sub))
+            meta = layout_to_meta(layout, sub.num_rows)
+            env.shuffle_catalog.add_batch(
+                ShuffleBlockId(sid, map_p, j), sub, meta)
+            self._part_rows[j] = self._part_rows.get(j, 0) + sub.num_rows
 
     def _split_batch(self, ctx, part, db: DeviceBatch, offset: int, n: int,
                      bounds):
@@ -620,6 +655,25 @@ class BroadcastExchangeExecBase(PhysicalExec):
         super().__init__((child,), child.output)
         self._lock = threading.Lock()
         self._cached = None
+
+    def __getstate__(self):
+        # plans ship to cluster executors by pickle: the lock is process-local
+        # and the cached build batch must never ride the control plane
+        state = dict(self.__dict__)
+        state["_lock"] = None
+        state["_cached"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def __copy__(self):
+        # copy.copy preserves the cached build (plan rewrites above an
+        # executed broadcast must not rebuild it); only pickling drops it
+        new = self.__class__.__new__(self.__class__)
+        new.__dict__.update(self.__dict__)
+        return new
 
     @property
     def num_partitions(self) -> int:

@@ -1,0 +1,496 @@
+"""Multi-executor query execution over the shuffle-manager stack.
+
+The load-bearing path for the accelerated shuffle protocol: a physical plan
+is split into shuffle stages at exchange boundaries (Spark's DAGScheduler
+role), map tasks run across executors writing each reduce partition's device
+batches through the CachingShuffleWriter into that executor's spillable
+shuffle catalog (RapidsShuffleInternalManager.scala:194 getWriter ->
+RapidsCachingWriter), and reduce-side reads serve local blocks from the
+catalog and fetch remote blocks through the transport client
+(RapidsCachingReader.scala + RapidsShuffleIterator) — in-process fabric or
+real TCP sockets, including executors in separate OS processes.
+
+Contrast with the mesh engine (execs/mesh_execs.py): there an exchange is an
+XLA collective inside one SPMD program; here it is the reference's
+pull-based, executor-to-executor protocol. Both produce identical results —
+tests assert query equality across the two paths and the single-process
+engine.
+
+Range partitioning runs its map stage as ONE task (bounds need a global
+sample; the reference pays a separate sampling job for the same reason —
+SamplingUtils) — the reduce side still fans out across executors.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
+from spark_rapids_tpu.shuffle.manager import (CachingShuffleReader,
+                                              CachingShuffleWriter, MapStatus,
+                                              MapOutputTracker, ShuffleEnv)
+
+_TCP_TRANSPORT = "spark_rapids_tpu.shuffle.tcp.TcpTransport"
+
+
+# ------------------------------------------------------------------ plan split
+class ClusterShuffleReadExec(LeafExec):
+    """Reduce-side leaf standing in for an exchange: reads one partition of a
+    parent stage's shuffle through the executor's caching reader (the
+    ShuffledBatchRDD + RapidsCachingReader composition)."""
+
+    is_device = True
+
+    def __init__(self, stage_index: int, output: Schema, num_parts: int):
+        super().__init__(output)
+        self.stage_index = stage_index
+        self.num_parts = num_parts
+        self.shuffle_id: Optional[int] = None  # driver assigns pre-pickle
+
+    @property
+    def num_partitions(self) -> int:
+        return self.num_parts
+
+    def execute(self, ctx: ExecContext):
+        cs = getattr(ctx, "cluster_shuffle", None)
+        assert cs is not None, "cluster shuffle read outside a cluster task"
+        tracker = MapOutputTracker()
+        tracker.register_shuffle(self.shuffle_id)
+        for st in cs.statuses[self.shuffle_id]:
+            tracker.register_map_output(self.shuffle_id, st)
+        reader = CachingShuffleReader(cs.env, tracker, self.shuffle_id,
+                                      ctx.partition_id)
+        for batch in reader.read():
+            self.count_output(batch.num_rows)
+            yield batch
+
+
+@dataclass
+class _Stage:
+    index: int
+    #: exchange exec (shuffle stages) or the final plan (result stage); its
+    #: subtree may contain ClusterShuffleReadExec leaves for dep stages
+    root: PhysicalExec
+    is_result: bool
+    deps: List[int] = field(default_factory=list)
+    shuffle_id: Optional[int] = None
+    num_tasks: int = 1
+    statuses: List[MapStatus] = field(default_factory=list)
+    #: result stage only: collected tables in partition order
+    result_tables: List = field(default_factory=list)
+
+
+def split_stages(final: PhysicalExec) -> Optional[List[_Stage]]:
+    """Cut the plan at device shuffle-exchange boundaries. Returns None when
+    the plan has exchanges the cluster cannot stage (CPU exchanges), handing
+    execution back to the single-process engine."""
+    from spark_rapids_tpu.execs.exchange_execs import (
+        CpuShuffleExchangeExec, RangePartitioning, TpuShuffleExchangeExec)
+    stages: List[_Stage] = []
+
+    def walk(node: PhysicalExec, deps: List[int]) -> PhysicalExec:
+        if isinstance(node, CpuShuffleExchangeExec):
+            raise _Unstageable()
+        if isinstance(node, TpuShuffleExchangeExec):
+            child_deps: List[int] = []
+            new_child = walk(node.children[0], child_deps)
+            exchange = node.with_children([new_child])
+            idx = len(stages)
+            n_parts = exchange.partitioning.num_partitions
+            single_task = isinstance(exchange.partitioning,
+                                     RangePartitioning)
+            stage = _Stage(idx, exchange, is_result=False, deps=child_deps,
+                           num_tasks=(1 if single_task
+                                      else max(1, new_child.num_partitions)))
+            stages.append(stage)
+            deps.append(idx)
+            return ClusterShuffleReadExec(idx, exchange.output, n_parts)
+        new_kids = [walk(c, deps) for c in node.children]
+        if any(a is not b for a, b in zip(new_kids, node.children)):
+            return node.with_children(new_kids)
+        return node
+
+    class _Unstageable(Exception):
+        pass
+
+    try:
+        result_deps: List[int] = []
+        new_final = walk(final, result_deps)
+    except _Unstageable:
+        return None
+    result = _Stage(len(stages), new_final, is_result=True, deps=result_deps,
+                    num_tasks=max(1, new_final.num_partitions))
+    stages.append(result)
+    return stages
+
+
+# ------------------------------------------------------------------ tasks
+@dataclass
+class ClusterTaskContext:
+    env: ShuffleEnv
+    statuses: Dict[int, List[MapStatus]]
+
+
+@dataclass
+class _TaskSpec:
+    kind: str                        # "map" | "result"
+    plan_blob: bytes                 # pickled stage root
+    partitions: Tuple[int, ...]      # partition ids this task runs
+    num_source_parts: int
+    shuffle_id: Optional[int]
+    num_reduce_parts: int
+    dep_statuses: Dict[int, List[MapStatus]]
+    conf: TpuConf
+
+
+def _run_task(env: ShuffleEnv, spec: _TaskSpec) -> bytes:
+    """Execute one task against this executor's shuffle env. Returns pickled
+    [MapStatus...] for map tasks or arrow-IPC table bytes for result tasks."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    root = pickle.loads(spec.plan_blob)
+    dm = DeviceManager.initialize(spec.conf)
+    cleanups: List = []
+    cs = ClusterTaskContext(env, spec.dep_statuses)
+
+    def make_ctx(p: int) -> ExecContext:
+        ctx = ExecContext(spec.conf, partition_id=p,
+                          num_partitions=spec.num_source_parts,
+                          device_manager=dm, cleanups=cleanups)
+        ctx.cluster_shuffle = cs
+        return ctx
+
+    try:
+        if spec.kind == "map":
+            statuses = [
+                _map_one_partition(root, make_ctx(p), p, env,
+                                   spec.shuffle_id, spec.num_reduce_parts)
+                for p in spec.partitions]
+            return pickle.dumps(statuses)
+        # result tasks keep (partition_id, ipc bytes) so the driver can
+        # reassemble global partition order (sorted output depends on it)
+        out: List[Tuple[int, bytes]] = []
+        schema = root.output.to_pa()
+        for p in spec.partitions:
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, schema) as w:
+                for b in root.execute(make_ctx(p)):
+                    w.write_table(b.to_arrow().cast(schema))
+            out.append((p, sink.getvalue().to_pybytes()))
+        return pickle.dumps(out)
+    finally:
+        for fn in cleanups:
+            fn()
+
+
+def _map_one_partition(exchange, ctx: ExecContext, p: int, env: ShuffleEnv,
+                       shuffle_id: int, n_reduce: int) -> MapStatus:
+    """The map side of one source partition: the exchange's own map-piece
+    protocol (iter_map_pieces — shared with the single-process engine),
+    cached through the caching writer (RapidsCachingWriter.write). A
+    range-partitioned stage runs as one task, so it maps EVERY source
+    partition here (bounds need the global sample)."""
+    from spark_rapids_tpu.execs.exchange_execs import RangePartitioning
+    tracker = MapOutputTracker()  # local; the real one lives on the driver
+    tracker.register_shuffle(shuffle_id)
+    writer = CachingShuffleWriter(env, tracker, shuffle_id, map_id=p,
+                                  num_partitions=n_reduce)
+    wanted = (None if isinstance(exchange.partitioning, RangePartitioning)
+              else (p,))
+    return writer.write(
+        (j, sub) for _, j, sub in exchange.iter_map_pieces(ctx, wanted))
+
+
+# ------------------------------------------------------------------ executors
+class InProcessExecutor:
+    """One executor inside the driver process: its own shuffle env (stores,
+    catalog, transport server); tasks run on the caller thread pool."""
+
+    def __init__(self, executor_id: str, conf: TpuConf, disk_dir: str):
+        self.executor_id = executor_id
+        self.env = ShuffleEnv(executor_id, conf, disk_dir=disk_dir)
+
+    def submit(self, spec: _TaskSpec) -> bytes:
+        return _run_task(self.env, spec)
+
+    def cleanup_shuffle(self, shuffle_id: int) -> None:
+        self.env.shuffle_catalog.remove_shuffle(shuffle_id)
+
+    def close(self) -> None:
+        self.env.close()
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    blob = pickle.dumps(obj)
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("executor control socket closed")
+        hdr += chunk
+    n = struct.unpack(">I", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("executor control socket closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class ProcessExecutor:
+    """One executor in its own OS process: the daemon builds a ShuffleEnv on
+    the TCP transport and serves tasks over a control socket. Shuffle DATA
+    never touches the control plane — it rides the shuffle TCP sockets
+    between executor processes (metadata-via-driver, data-P2P, the
+    reference's split)."""
+
+    def __init__(self, executor_id: str, conf: TpuConf):
+        self.executor_id = executor_id
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "spark_rapids_tpu.parallel.executor_daemon",
+             "--executor-id", executor_id, "--control-port", str(port)],
+            env=env)
+        listener.settimeout(60)
+        self.sock, _ = listener.accept()
+        listener.close()
+        self._lock = threading.Lock()
+        _send_msg(self.sock, {"type": "init", "conf": conf})
+        resp = _recv_msg(self.sock)
+        if resp.get("type") != "ready":
+            raise RuntimeError(f"executor {executor_id} failed to start: "
+                               f"{resp}")
+
+    def submit(self, spec: _TaskSpec) -> bytes:
+        with self._lock:
+            _send_msg(self.sock, {"type": "task", "spec": spec})
+            resp = _recv_msg(self.sock)
+        if resp["type"] == "error":
+            raise RuntimeError(
+                f"task failed on {self.executor_id}: {resp['message']}")
+        return resp["blob"]
+
+    def cleanup_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            _send_msg(self.sock, {"type": "cleanup",
+                                  "shuffle_id": shuffle_id})
+            _recv_msg(self.sock)
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                _send_msg(self.sock, {"type": "stop"})
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class _Unpicklable(Exception):
+    """A stage subtree cannot ship to executors (e.g. a lambda UDF)."""
+
+
+# ------------------------------------------------------------------ scheduler
+class ClusterScheduler:
+    """Stage-by-stage driver (the DAGScheduler role): map stages fan tasks
+    across executors and register MapStatus with the driver tracker; the
+    result stage's arrow output returns to the caller."""
+
+    def __init__(self, conf: TpuConf):
+        self._owned_dirs: List[str] = []
+        self.conf = self._prepare_conf(conf)
+        self.n = conf.get(cfg.CLUSTER_EXECUTORS)
+        self._tmp = tempfile.mkdtemp(prefix="spark-rapids-tpu-cluster-")
+        self._owned_dirs.append(self._tmp)
+        if conf.get(cfg.CLUSTER_PROCESS_EXECUTORS):
+            self.executors = [ProcessExecutor(f"exec-{i}", self.conf)
+                              for i in range(self.n)]
+        else:
+            self.executors = [
+                InProcessExecutor(f"exec-{i}", self.conf,
+                                  os.path.join(self._tmp, f"exec-{i}"))
+                for i in range(self.n)]
+        self._next_shuffle = 0
+        atexit.register(self.close)
+
+    def _prepare_conf(self, conf: TpuConf) -> TpuConf:
+        extra = {}
+        if conf.get(cfg.CLUSTER_PROCESS_EXECUTORS):
+            if not conf.get_raw("spark.rapids.tpu.shuffle.transport.class"):
+                extra["spark.rapids.tpu.shuffle.transport.class"] = \
+                    _TCP_TRANSPORT
+            if not conf.shuffle_tcp_registry:
+                reg = tempfile.mkdtemp(prefix="spark-rapids-tpu-registry-")
+                self._owned_dirs.append(reg)
+                extra["spark.rapids.tpu.shuffle.tcp.registryDir"] = reg
+        return conf.with_overrides(extra) if extra else conf
+
+    def _widen_scans(self, plan: PhysicalExec) -> PhysicalExec:
+        """File scans default to one scan task; spread multi-file scans
+        across the executors (FilePartition planning)."""
+        import copy
+
+        def fix(node: PhysicalExec) -> PhysicalExec:
+            files = getattr(node, "files", None)
+            if getattr(node, "is_file_scan", False) and files:
+                n = min(len(files), 2 * len(self.executors))
+                if n > 1 and node.scan_partitions == 1:
+                    node = copy.copy(node)
+                    node.scan_partitions = n
+            return node
+        return plan.transform_up(fix)
+
+    def run(self, final: PhysicalExec) -> Optional[List[pa.Table]]:
+        """Execute the plan across the cluster; None = plan not stageable
+        (caller falls back to the single-process engine)."""
+        final = self._widen_scans(final)
+        stages = split_stages(final)
+        if stages is None:
+            return None
+        self.last_stages = stages  # introspection for tests/explain
+        shuffle_ids: List[int] = []
+        try:
+            for stage in stages:
+                if not stage.is_result:
+                    stage.shuffle_id = self._next_shuffle
+                    self._next_shuffle += 1
+                    shuffle_ids.append(stage.shuffle_id)
+                self._run_stage(stage, stages)
+            result = stages[-1]
+            return result.result_tables
+        except _Unpicklable:
+            # an unpicklable plan (e.g. lambda UDFs) cannot ship to
+            # executors: fall back to the single-process engine
+            return None
+        finally:
+            for sid in shuffle_ids:
+                for ex in self.executors:
+                    try:
+                        ex.cleanup_shuffle(sid)
+                    except Exception:
+                        pass
+
+    def _run_stage(self, stage: _Stage, stages: List[_Stage]) -> None:
+        # resolve dep shuffle ids into the read leaves, then pickle
+        dep_statuses: Dict[int, List[MapStatus]] = {}
+
+        def fix(node: PhysicalExec) -> PhysicalExec:
+            if isinstance(node, ClusterShuffleReadExec):
+                dep = stages[node.stage_index]
+                node.shuffle_id = dep.shuffle_id
+                dep_statuses[dep.shuffle_id] = dep.statuses
+            return node
+
+        root = stage.root.transform_up(fix)
+        try:
+            blob = pickle.dumps(root)
+        except Exception as e:  # lambda UDFs etc.: hand back to local engine
+            raise _Unpicklable(str(e)) from e
+        if stage.is_result:
+            num_source = stage.num_tasks
+        else:
+            num_source = max(1, root.children[0].num_partitions)
+        assignments: List[Tuple[int, List[int]]] = []
+        for i, ex in enumerate(self.executors):
+            parts = list(range(i, stage.num_tasks, len(self.executors)))
+            if parts:
+                assignments.append((i, parts))
+
+        specs = []
+        for i, parts in assignments:
+            specs.append((i, _TaskSpec(
+                kind="result" if stage.is_result else "map",
+                plan_blob=blob, partitions=tuple(parts),
+                num_source_parts=num_source,
+                shuffle_id=stage.shuffle_id,
+                num_reduce_parts=(0 if stage.is_result else
+                                  stage.root.partitioning.num_partitions),
+                dep_statuses=dep_statuses, conf=self.conf)))
+
+        results: List[Optional[bytes]] = [None] * len(specs)
+        errors: List[Exception] = []
+
+        def run(slot: int, exec_idx: int, spec: _TaskSpec):
+            try:
+                results[slot] = self.executors[exec_idx].submit(spec)
+            except Exception as e:  # surfaced after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(s, i, spec))
+                   for s, (i, spec) in enumerate(specs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        if stage.is_result:
+            per_part: List[Tuple[int, bytes]] = []
+            for blob_out in results:
+                if blob_out:
+                    per_part.extend(pickle.loads(blob_out))
+            tables: List[pa.Table] = []
+            for _, ipc in sorted(per_part, key=lambda x: x[0]):
+                with pa.ipc.open_stream(pa.BufferReader(ipc)) as r:
+                    tables.append(r.read_all())
+            stage.result_tables = tables
+        else:
+            statuses: List[MapStatus] = []
+            for blob_out in results:
+                statuses.extend(pickle.loads(blob_out))
+            stage.statuses = statuses
+
+    def close(self) -> None:
+        import shutil
+        for ex in self.executors:
+            try:
+                ex.close()
+            except Exception:
+                pass
+        self.executors = []
+        for d in self._owned_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        self._owned_dirs = []
+
+
+def cluster_scheduler_for(session) -> ClusterScheduler:
+    """One scheduler (and executor set) per session, created lazily."""
+    sched = getattr(session, "_cluster_scheduler", None)
+    if sched is None or sched.n != session.conf.get(cfg.CLUSTER_EXECUTORS) \
+            or not sched.executors:
+        if sched is not None:
+            sched.close()
+        sched = ClusterScheduler(session.conf)
+        session._cluster_scheduler = sched
+    return sched

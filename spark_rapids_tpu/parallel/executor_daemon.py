@@ -1,0 +1,81 @@
+"""Cluster executor daemon: one OS process per executor.
+
+Spawned by ProcessExecutor (parallel/cluster.py) with a control port; builds
+a ShuffleEnv on the configured transport (TCP for cross-process topologies)
+and serves tasks until told to stop. The control socket carries only task
+specs and results — shuffle DATA moves executor-to-executor over the shuffle
+transport's own sockets (the reference's metadata-via-driver / data-P2P
+split, RapidsShuffleInternalManager.scala).
+
+The executor-plugin-init analog (Plugin.scala RapidsExecutorPlugin): a fatal
+init error exits the process, which the driver surfaces as a failed start.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import tempfile
+import traceback
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--executor-id", required=True)
+    ap.add_argument("--control-port", type=int, required=True)
+    args = ap.parse_args()
+
+    # the TPU plugin's sitecustomize force-resets jax_platforms at interpreter
+    # start, overriding JAX_PLATFORMS; pin the requested platform back before
+    # any backend initializes (a busy chip tunnel would hang executor startup)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    sock = socket.create_connection(("127.0.0.1", args.control_port),
+                                    timeout=60)
+    sock.settimeout(None)  # connect bound only; serving blocks indefinitely
+    from spark_rapids_tpu.parallel.cluster import (_recv_msg, _run_task,
+                                                   _send_msg)
+    from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+
+    env = None
+    spill_dir = tempfile.mkdtemp(prefix=f"spill-{args.executor_id}-")
+    try:
+        msg = _recv_msg(sock)
+        assert msg["type"] == "init", msg
+        conf = msg["conf"]
+        env = ShuffleEnv(args.executor_id, conf, disk_dir=spill_dir)
+        _send_msg(sock, {"type": "ready"})
+        while True:
+            msg = _recv_msg(sock)
+            kind = msg["type"]
+            if kind == "stop":
+                return 0
+            if kind == "cleanup":
+                env.shuffle_catalog.remove_shuffle(msg["shuffle_id"])
+                _send_msg(sock, {"type": "ok"})
+                continue
+            if kind == "task":
+                try:
+                    blob = _run_task(env, msg["spec"])
+                    _send_msg(sock, {"type": "done", "blob": blob})
+                except Exception:
+                    _send_msg(sock, {"type": "error",
+                                     "message": traceback.format_exc()})
+                continue
+            _send_msg(sock, {"type": "error",
+                             "message": f"unknown control message {kind!r}"})
+    except (ConnectionError, EOFError):
+        return 0
+    finally:
+        if env is not None:
+            env.close()
+        import shutil
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

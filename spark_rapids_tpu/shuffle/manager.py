@@ -182,13 +182,15 @@ class CachingShuffleReader:
 
     def __init__(self, env: ShuffleEnv, tracker: MapOutputTracker,
                  shuffle_id: int, partition_id: int, semaphore=None,
-                 timeout: float = 120.0):
+                 timeout: Optional[float] = None):
+        from spark_rapids_tpu import config as _cfg
         self.env = env
         self.tracker = tracker
         self.shuffle_id = shuffle_id
         self.partition_id = partition_id
         self.semaphore = semaphore
-        self.timeout = timeout
+        self.timeout = (timeout if timeout is not None
+                        else float(env.conf.get(_cfg.SHUFFLE_FETCH_TIMEOUT)))
 
     def read(self):
         """Yields DeviceBatch for this reduce partition."""

@@ -204,7 +204,11 @@ class TcpTransport(ShuffleTransport):
             fn = self._progress.get()
             if fn is None:
                 return
-            fn()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — completions must keep flowing
+                import traceback
+                traceback.print_exc()
 
     def _progress_put(self, fn: Callable[[], None]) -> None:
         self._progress.put(fn)
@@ -229,7 +233,11 @@ class TcpTransport(ShuffleTransport):
             fn = self._work.get()
             if fn is None:
                 return
-            fn()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a handler error must not
+                import traceback  # kill the worker (peers would hang)
+                traceback.print_exc()
 
     def _accept_loop(self) -> None:
         while True:

@@ -32,6 +32,14 @@ class Metric:
         self._value = 0
         self._lock = threading.Lock()
 
+    def __getstate__(self):
+        # plans ship to cluster executors by pickle; the lock is process-local
+        return (self.name, self.unit, self._value)
+
+    def __setstate__(self, state):
+        self.name, self.unit, self._value = state
+        self._lock = threading.Lock()
+
     def add(self, v: int) -> None:
         with self._lock:
             self._value += v

