@@ -259,18 +259,10 @@ class DataFrame:
         cpu_plan = plan_physical(self._plan, self.session.conf)
         overrides = TpuOverrides(self.session.conf)
         final = overrides.apply(cpu_plan)
-        mesh_note = ""
         if self.session.conf.get(_cfg.MESH_ENABLED):
-            if self.session.conf.get(_cfg.ADAPTIVE_ENABLED):
-                mesh_note = (
-                    "\n! mesh execution disabled: "
-                    "spark.rapids.tpu.sql.adaptive.enabled is set (AQE "
-                    "re-plans around host-side exchanges; turn one of the "
-                    "two off)")
-            else:
-                from spark_rapids_tpu.plan.mesh_rewrite import mesh_rewrite
-                final = mesh_rewrite(final, self.session.conf)
-        self.session.last_explain = overrides.last_explain + mesh_note
+            from spark_rapids_tpu.plan.mesh_rewrite import mesh_rewrite
+            final = mesh_rewrite(final, self.session.conf)
+        self.session.last_explain = overrides.last_explain
         self.session.last_plan = final
         return final
 
@@ -292,7 +284,13 @@ class DataFrame:
             # device-admission throttle for the whole task (GpuSemaphore analog)
             with dm.semaphore.held():
                 from spark_rapids_tpu import config as _cfg
-                if self.session.conf.get(_cfg.ADAPTIVE_ENABLED):
+                if self.session.conf.get(_cfg.ADAPTIVE_ENABLED) and \
+                        not any(getattr(nd, "is_mesh", False)
+                                for nd in _iter_execs(final)):
+                    # mesh operators adapt inside their execs (observed
+                    # sizes precede every exchange program); the host-side
+                    # stage rewrite runs whenever the plan actually stayed
+                    # on host exchanges (incl. mesh.enabled on one device)
                     from spark_rapids_tpu.plan.adaptive import adaptive_rewrite
                     stage_ctx = ExecContext(self.session.conf, partition_id=0,
                                             num_partitions=1,

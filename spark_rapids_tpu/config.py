@@ -210,9 +210,13 @@ MESH_ENABLED = _conf(
     "sharded across the mesh data axis with exchanges as ICI collectives "
     "(all_to_all repartition, all-gather broadcast/merge) — the role the "
     "reference fills with one-task-per-GPU executors plus the UCX accelerated "
-    "shuffle (RapidsShuffleInternalManager). Incompatible with "
-    "sql.adaptive.enabled: when both are set, mesh lowering is skipped and "
-    "the explain output says so.")
+    "shuffle (RapidsShuffleInternalManager). With sql.adaptive.enabled, mesh "
+    "shuffled joins switch to broadcast at runtime when a build side "
+    "materializes under broadcastJoinThreshold (observed size, not an "
+    "estimate — every mesh exchange counts before it compiles, so there is "
+    "no host-side re-planning pass to run). Mesh aggregations always pick "
+    "their merge strategy from actual partial-group counts "
+    "(sql.mesh.aggRepartitionThreshold), adaptive flag or not.")
 
 MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,

@@ -928,6 +928,18 @@ class MeshHashAggregateExec(MeshExec):
         return _maybe_shrink(out)
 
 
+def _mesh_batch_bytes(mb: MeshBatch) -> int:
+    """Actual data bytes of the LIVE rows (per-row width x true row count) —
+    the MapOutputStatistics role for runtime join adaptivity."""
+    row_bytes = 0
+    for c in mb.columns:
+        width = int(np.prod(c.data.shape[1:])) if c.data.ndim > 1 else 1
+        row_bytes += c.data.dtype.itemsize * width + 1  # + validity byte
+        if c.lengths is not None:
+            row_bytes += 4
+    return int(mb.num_rows) * row_bytes
+
+
 def _gather_colv(v: ColV) -> ColV:
     data = jax.lax.all_gather(v.data, DATA_AXIS, tiled=True)
     validity = jax.lax.all_gather(v.validity, DATA_AXIS, tiled=True)
@@ -1036,18 +1048,79 @@ class MeshHashJoinBase(MeshExec):
                         rows, mesh)
         return _maybe_shrink(out)
 
+    def _broadcast_join(self, ctx: ExecContext, stream: MeshBatch,
+                        db: DeviceBatch, bi: int) -> MeshBatch:
+        """Replicate the single-device build batch ``db`` across the mesh
+        (side ``bi``) and join against the sharded stream — the one
+        broadcast-join call convention, shared by the planned broadcast exec
+        and the adaptive switch."""
+        from spark_rapids_tpu.execs.tpu_execs import _flatten
+        rep = replicate_device_batch(db, self.mesh)
+        rep_rows = jax.device_put(
+            np.asarray([db.num_rows], dtype=np.int32),
+            NamedSharding(self.mesh, P()))
+        if bi == 1:
+            return self._local_join(
+                ctx, flatten_mesh(stream), _flatten(rep),
+                stream.rows_dev(), rep_rows,
+                self.children[0].output, self.children[1].output,
+                stream.local_capacity, db.capacity, r_replicated=True)
+        return self._local_join(
+            ctx, _flatten(rep), flatten_mesh(stream),
+            rep_rows, stream.rows_dev(),
+            self.children[0].output, self.children[1].output,
+            db.capacity, stream.local_capacity,
+            r_replicated=False, l_replicated=True)
+
 
 class MeshShuffledHashJoinExec(MeshHashJoinBase):
     """Shuffled equi-join: both sides hash-repartitioned by join key over the
     mesh (one all_to_all each), then joined per shard (the
     GpuShuffledHashJoinExec + RapidsCachingWriter/Reader path, with the whole
-    exchange riding ICI)."""
+    exchange riding ICI).
+
+    Adaptive (sql.adaptive.enabled): the join sees both sides' TRUE
+    materialized sizes before any exchange compiles — when a legal build
+    side lands under broadcastJoinThreshold, the join switches to the
+    broadcast form (replicate the small side, zero stream movement), the
+    GpuCustomShuffleReaderExec + DynamicJoinSelection payoff without a
+    host-side re-planning pass."""
+
+    #: set by execute() when AQE switched this join to broadcast (plan
+    #: introspection for tests/explain)
+    adapted_broadcast = False
+
+    def _adaptive_broadcast(self, ctx: ExecContext, lb: MeshBatch,
+                            rb: MeshBatch) -> Optional[MeshBatch]:
+        from spark_rapids_tpu import config as cfg_
+        if not ctx.conf.get(cfg_.ADAPTIVE_ENABLED):
+            return None
+        threshold = ctx.conf.get(cfg_.BROADCAST_JOIN_THRESHOLD)
+        sides = []
+        if self.how in ("inner", "left", "left_semi", "left_anti", "cross"):
+            sides.append(1)
+        if self.how in ("inner", "right", "cross"):
+            sides.append(0)
+        for bi in sides:
+            bb = (lb, rb)[bi]
+            if _mesh_batch_bytes(bb) > threshold:
+                continue
+            stream = (lb, rb)[1 - bi]
+            out = self._broadcast_join(ctx, stream, gather_mesh(bb), bi)
+            self.adapted_broadcast = True
+            return out
+        return None
 
     def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
         n_dev = int(self.mesh.devices.size)
         lb = self._one_child_batch(ctx, 0)
         rb = self._one_child_batch(ctx, 1)
         smax = ctx.string_max_bytes
+        adapted = self._adaptive_broadcast(ctx, lb, rb)
+        if adapted is not None:
+            self.count_output(adapted.num_rows)
+            yield adapted
+            return
         lb = _mesh_repartition(
             lb, ("mjoin_lpart", tuple(self.left_keys), lb.schema,
                  lb.local_capacity),
@@ -1073,32 +1146,14 @@ class MeshBroadcastHashJoinExec(MeshHashJoinBase):
     all (GpuBroadcastHashJoinExec analog)."""
 
     def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
-        from spark_rapids_tpu.execs.tpu_execs import (_flatten,
-                                                      concat_device_batches)
+        from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
         bi = 0 if self.build_side == "left" else 1
         si = 1 - bi
         stream = self._one_child_batch(ctx, si)
         build_batches = list(self.children[bi].execute(ctx))
         db = concat_device_batches(build_batches, self.children[bi].output,
                                    ctx.string_max_bytes)
-        rep = replicate_device_batch(db, self.mesh)
-        rep_rows = jax.device_put(
-            np.asarray([db.num_rows], dtype=np.int32),
-            NamedSharding(self.mesh, P()))
-        if bi == 1:
-            out = self._local_join(ctx, flatten_mesh(stream), _flatten(rep),
-                                   stream.rows_dev(), rep_rows,
-                                   self.children[0].output,
-                                   self.children[1].output,
-                                   stream.local_capacity, db.capacity,
-                                   r_replicated=True)
-        else:
-            out = self._local_join(ctx, _flatten(rep), flatten_mesh(stream),
-                                   rep_rows, stream.rows_dev(),
-                                   self.children[0].output,
-                                   self.children[1].output,
-                                   db.capacity, stream.local_capacity,
-                                   r_replicated=False, l_replicated=True)
+        out = self._broadcast_join(ctx, stream, db, bi)
         self.count_output(out.num_rows)
         yield out
 

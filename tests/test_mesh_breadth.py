@@ -291,3 +291,84 @@ def test_mesh_csv_scan_falls_back_to_scatter(tmp_path, eight_devices):
     s = TpuSession(MESH_CONF)
     out = s.read.option("header", "true").csv(str(tmp_path)).collect()
     assert out.num_rows == 150
+
+
+# ---------------------------------------------------------- AQE on the mesh
+def _iter_plan(node):
+    yield node
+    for c in node.children:
+        yield from _iter_plan(c)
+
+
+def test_mesh_adaptive_broadcast_switch(eight_devices):
+    """Plan-time estimates say 'big build side' (shuffled join); at runtime
+    the filtered build materializes tiny — with AQE on, the mesh join must
+    switch to the broadcast form from the OBSERVED size and still match."""
+    rng = np.random.default_rng(67)
+    n = 30000
+    fact = pa.table({"k": rng.integers(0, 2000, n).astype(np.int64),
+                     "v": rng.integers(0, 100, n).astype(np.int64)})
+    dim = pa.table({
+        "k": np.arange(2000, dtype=np.int64),
+        # wide payload so the plan-time size estimate exceeds the threshold
+        "pad": pa.array(["x" * 200] * 2000),
+        "grp": pa.array([int(i % 7) for i in range(2000)],
+                        type=pa.int64()),
+    })
+
+    def q(s):
+        d = s.create_dataframe(dim).filter(F.col("grp") == 3) \
+             .select("k", "grp")
+        return s.create_dataframe(fact).join(d, "k") \
+                .groupBy("grp").agg(F.sum("v").alias("sv"))
+
+    threshold = str(64 * 1024)  # 64 KB: over the filtered build, under dim
+    base = {**MESH_CONF,
+            "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": threshold}
+    s = TpuSession({**base, "spark.rapids.tpu.sql.adaptive.enabled": "true"})
+    out = q(s).collect()
+    joins = [nd for nd in _iter_plan(s.last_plan)
+             if type(nd).__name__ == "MeshShuffledHashJoinExec"]
+    assert joins, s.last_plan.tree_string()
+    assert any(j.adapted_broadcast for j in joins), (
+        "AQE should have switched the small observed build to broadcast")
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = q(cpu).collect()
+    assert_tables_equal(exp, out, ignore_order=True)
+
+    # same query, AQE off: no switch
+    s2 = TpuSession({**base,
+                     "spark.rapids.tpu.sql.adaptive.enabled": "false"})
+    out2 = q(s2).collect()
+    joins2 = [nd for nd in _iter_plan(s2.last_plan)
+              if type(nd).__name__ == "MeshShuffledHashJoinExec"]
+    assert joins2 and not any(j.adapted_broadcast for j in joins2)
+    assert_tables_equal(exp, out2, ignore_order=True)
+
+
+def test_mesh_adaptive_right_join_switch(eight_devices):
+    """Broadcasting the LEFT side (legal for right joins) also adapts."""
+    rng = np.random.default_rng(71)
+    # big at plan time (~800 KB estimate -> shuffled join), tiny at runtime
+    # after the filter (~8 KB observed -> adaptive broadcast-left)
+    left = pa.table({"k": np.arange(4000, dtype=np.int64),
+                     "pad": pa.array(["y" * 200] * 4000)})
+    big = pa.table({"k": rng.integers(0, 40, 20000).astype(np.int64),
+                    "v": rng.integers(0, 9, 20000).astype(np.int64)})
+
+    def q(s):
+        l = s.create_dataframe(left).filter(F.col("k") < 40)
+        return l.join(s.create_dataframe(big), "k", "right") \
+                .groupBy("k").agg(F.count("v").alias("c"))
+
+    conf = {**MESH_CONF,
+            "spark.rapids.tpu.sql.adaptive.enabled": "true",
+            "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "100000"}
+    s = TpuSession(conf)
+    out = q(s).collect()
+    joins = [nd for nd in _iter_plan(s.last_plan)
+             if type(nd).__name__ == "MeshShuffledHashJoinExec"]
+    assert joins and any(j.adapted_broadcast for j in joins), (
+        "the broadcast-left (bi==0) adaptive path should have fired")
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    assert_tables_equal(q(cpu).collect(), out, ignore_order=True)

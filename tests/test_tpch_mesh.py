@@ -20,6 +20,9 @@ MESH_CONF = {
     "spark.rapids.tpu.sql.mesh.enabled": "true",
     "spark.rapids.tpu.sql.exec.NestedLoopJoin": "true",
     "spark.rapids.tpu.sql.exec.CartesianProduct": "true",
+    # AQE rides the mesh: joins may switch to broadcast from observed sizes
+    # mid-query — all 22 queries must still match the CPU engine
+    "spark.rapids.tpu.sql.adaptive.enabled": "true",
 }
 
 
