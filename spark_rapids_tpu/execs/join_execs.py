@@ -36,6 +36,20 @@ from spark_rapids_tpu.ops import batch_kernels as bk
 from spark_rapids_tpu.ops import join as jk
 
 
+def legal_broadcast_sides(how: str) -> List[int]:
+    """Side indices (1=right first, the cheaper default) that may legally be
+    the broadcast build for this join type: an outer/preserved side cannot be
+    the build side — its unmatched rows would be emitted once per stream
+    partition (Spark's BuildSide legality rules). THE single source for the
+    planner, host AQE, and mesh AQE."""
+    sides = []
+    if how in ("inner", "left", "left_semi", "left_anti", "cross"):
+        sides.append(1)
+    if how in ("inner", "right", "cross"):
+        sides.append(0)
+    return sides
+
+
 def _eval_keys(xp, colvs, capacity, smax, key_exprs) -> List[ColV]:
     ectx = EvalCtx(xp, colvs, capacity, smax)
     return [e.eval(ectx) for e in key_exprs]

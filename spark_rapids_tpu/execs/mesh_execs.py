@@ -1095,13 +1095,9 @@ class MeshShuffledHashJoinExec(MeshHashJoinBase):
         from spark_rapids_tpu import config as cfg_
         if not ctx.conf.get(cfg_.ADAPTIVE_ENABLED):
             return None
+        from spark_rapids_tpu.execs.join_execs import legal_broadcast_sides
         threshold = ctx.conf.get(cfg_.BROADCAST_JOIN_THRESHOLD)
-        sides = []
-        if self.how in ("inner", "left", "left_semi", "left_anti", "cross"):
-            sides.append(1)
-        if self.how in ("inner", "right", "cross"):
-            sides.append(0)
-        for bi in sides:
+        for bi in legal_broadcast_sides(self.how):
             bb = (lb, rb)[bi]
             if _mesh_batch_bytes(bb) > threshold:
                 continue

@@ -271,6 +271,14 @@ def sort_colvs(xp, passes: Sequence, colvs: Sequence[ColV],
             payloads.append(a)
         return slot_of[key]
 
+    def _is_half(a) -> bool:
+        # 4-byte payloads pair up into u64 words: sort cost is per OPERAND
+        # (~equal for u32 and u64 on TPU), so two halves in one word halve
+        # the payload movement of every narrow column
+        return (getattr(a, "dtype", None) is not None
+                and a.ndim == 1 and a.dtype.itemsize == 4
+                and a.dtype.kind in "iuf")
+
     def add_bool(a):
         key = id(a)
         if key not in bool_slot:
@@ -304,32 +312,68 @@ def sort_colvs(xp, passes: Sequence, colvs: Sequence[ColV],
             word = piece if word is None else word | piece
         packed_bools.append(word)
 
-    all_payloads = payloads + packed_bools
-    if len(all_payloads) + len(passes) > MAX_SORT_PAYLOADS:
+    import jax.lax as _lax
+
+    def _u32(a):
+        return (a if a.dtype == np.uint32
+                else _lax.bitcast_convert_type(a, np.uint32))
+
+    def _from_u32(a, dtype):
+        return (a if dtype == np.uint32
+                else _lax.bitcast_convert_type(a, dtype))
+
+    halves = [i for i, a in enumerate(payloads) if _is_half(a)]
+    fulls = [i for i, a in enumerate(payloads) if not _is_half(a)]
+    n_ops = len(fulls) + (len(halves) + 1) // 2
+    if n_ops + n_bool_words + len(passes) > MAX_SORT_PAYLOADS:
         # too many operands for a fast compile: one sort for the permutation,
-        # then gathers (the pre-variadic pattern)
+        # then gathers (the pre-variadic pattern); checked BEFORE any packing
+        # work is traced
         cap = passes[0].shape[0]
         iota = xp.arange(cap, dtype=np.int32)
         _, (order,) = multi_sort(xp, passes, [iota])
         return ([take_colv(xp, v, order) for v in colvs],
                 [e[order] for e in extras])
 
+    operands = [payloads[i] for i in fulls]
+    for w in range(0, len(halves), 2):
+        word = _u32(payloads[halves[w]]).astype(np.uint64) << np.uint64(32)
+        if w + 1 < len(halves):
+            word = word | _u32(payloads[halves[w + 1]]).astype(np.uint64)
+        operands.append(word)
+
+    all_payloads = operands + packed_bools
+
     _, sp = multi_sort(xp, passes, all_payloads)
+    recovered: List = [None] * len(payloads)
+    for k, i in enumerate(fulls):
+        recovered[i] = sp[k]
+    base = len(fulls)
+    for w in range(0, len(halves), 2):
+        word = sp[base + w // 2]
+        recovered[halves[w]] = _from_u32(
+            (word >> np.uint64(32)).astype(np.uint32),
+            payloads[halves[w]].dtype)
+        if w + 1 < len(halves):
+            recovered[halves[w + 1]] = _from_u32(
+                word.astype(np.uint32), payloads[halves[w + 1]].dtype)
+    n_operands = len(operands)
     sorted_bools = []
     for w in range(n_bool_words):
-        word = sp[len(payloads) + w]
+        word = sp[n_operands + w]
         sorted_bools.extend(
             ((word >> np.uint64(i)) & np.uint64(1)).astype(bool)
             for i in range(min(64, len(bools) - w * 64)))
     out = []
     for dt, word_slots, W, data_slot, valid_slot in specs:
         if word_slots is not None:
-            data = _unpack_bytes(xp, [sp[s] for s in word_slots], W)
+            data = _unpack_bytes(xp, [recovered[s] for s in word_slots], W)
             out.append(ColV(dt, data, sorted_bools[valid_slot],
-                            sp[data_slot]))
+                            recovered[data_slot]))
         else:
-            out.append(ColV(dt, sp[data_slot], sorted_bools[valid_slot]))
-    sorted_extras = [sorted_bools[s] if kind == "b" else sp[s]
+            out.append(ColV(dt, recovered[data_slot],
+                            sorted_bools[valid_slot]))
+    sorted_extras = [sorted_bools[s] if kind == "b" else recovered[s]
                      for kind, s in extra_slots]
     return out, sorted_extras
 

@@ -221,13 +221,9 @@ def _try_broadcast_switch(join, stats, threshold: int):
     DynamicJoinSelection is after."""
     from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
                                                    TpuBroadcastHashJoinExec)
+    from spark_rapids_tpu.execs.join_execs import legal_broadcast_sides
     how = join.how
-    sides = []
-    if how in ("inner", "left", "left_semi", "left_anti", "cross"):
-        sides.append(1)
-    if how in ("inner", "right", "cross"):
-        sides.append(0)
-    for bi in sides:
+    for bi in legal_broadcast_sides(how):
         build = _unwrap_single(join.children[bi])
         sz = stats(build)
         if sz is None or sum(sz) > threshold:

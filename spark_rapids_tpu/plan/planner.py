@@ -219,10 +219,10 @@ def _select_join(left: PhysicalExec, right: PhysicalExec, how: str,
         sz = side.size_estimate()
         return sz is not None and sz <= threshold
 
-    # an outer side cannot be the build side: its unmatched rows would be
-    # emitted once per stream partition (Spark's BuildSide legality rules)
-    can_build_right = how in ("inner", "left", "left_semi", "left_anti", "cross")
-    can_build_left = how in ("inner", "right", "cross")
+    from spark_rapids_tpu.execs.join_execs import legal_broadcast_sides
+    _sides = legal_broadcast_sides(how)
+    can_build_right = 1 in _sides
+    can_build_left = 0 in _sides
     if not lkeys:
         if how not in ("inner", "cross"):
             raise NotImplementedError(
