@@ -240,18 +240,97 @@ def _bench_tpcxbb(scale: float, qname: str, iters: int) -> dict:
             "vs_baseline": round(rps / (n_rows / cpu_time), 3)}
 
 
+#: representative TPC-DS subset for the suite benchmark: scans + star joins
+#: + aggregations + windows across the three sales channels
+TPCDS_BENCH_QUERIES = ("q3", "q7", "q19", "q27", "q34", "q42", "q52", "q55",
+                       "q68", "q96")
+
+
+def _bench_query_suite(suite: str, scale: float, iters: int) -> dict:
+    """Suite-level device perf: per-query warm times on the TPU engine and a
+    geomean queries/hr headline (BASELINE.json's TPCx-BB unit). The scan
+    cache keeps tables device-resident across queries, so warm times measure
+    the compute path, not the host link."""
+    import math
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+
+    if suite == "tpcds":
+        from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+        from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+        names = [q for q in TPCDS_BENCH_QUERIES if q in QUERIES]
+    else:
+        from spark_rapids_tpu.benchmarks.tpcxbb_data import gen_all
+        from spark_rapids_tpu.benchmarks.tpcxbb_queries import QUERIES
+        names = sorted(QUERIES, key=lambda q: int(q[1:]))
+    tables = gen_all(scale=scale, seed=42)
+
+    cpu_sess = TpuSession({**BENCH_CONF,
+                           "spark.rapids.tpu.sql.enabled": "false"})
+    cpu_dfs = {k: cpu_sess.create_dataframe(v) for k, v in tables.items()}
+    tpu_sess = TpuSession(BENCH_CONF)
+    tpu_dfs = {k: tpu_sess.create_dataframe(v) for k, v in tables.items()}
+
+    per_query = {}
+    tpu_times, cpu_times = [], []
+    for q in names:
+        query = QUERIES[q]
+        # identical treatment on both engines: one discarded warm-up run,
+        # then best-of-iters (no cold-start asymmetry in vs_baseline)
+        cpu_rows = query(cpu_dfs).collect().num_rows
+        cpu_s = None
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            cpu_rows = query(cpu_dfs).collect().num_rows
+            dt = time.perf_counter() - t0
+            cpu_s = dt if cpu_s is None else min(cpu_s, dt)
+        tpu_rows = query(tpu_dfs).collect().num_rows    # warm: compile+cache
+        best = None
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            tpu_rows = query(tpu_dfs).collect().num_rows
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        assert tpu_rows == cpu_rows, f"{q}: {tpu_rows} != {cpu_rows}"
+        per_query[q] = {"tpu_s": round(best, 4), "cpu_s": round(cpu_s, 4),
+                        "rows": tpu_rows}
+        tpu_times.append(best)
+        cpu_times.append(cpu_s)
+
+    geo = math.exp(sum(math.log(t) for t in tpu_times) / len(tpu_times))
+    cpu_geo = math.exp(sum(math.log(t) for t in cpu_times) / len(cpu_times))
+    return {
+        "metric": f"{suite}_geomean_queries_per_hour",
+        "value": round(3600.0 / geo, 1),
+        "unit": "queries/hr",
+        "vs_baseline": round(cpu_geo / geo, 3),
+        "breakdown": {
+            "scale": scale,
+            "queries": len(names),
+            "geomean_s": round(geo, 4),
+            "cpu_geomean_s": round(cpu_geo, 4),
+            "per_query": per_query,
+        },
+    }
+
+
 def main() -> None:
     suite = os.environ.get("BENCH_SUITE", "tpch")
-    default_scale = "1.0" if suite == "tpch" else "0.05"
+    default_scale = {"tpch": "1.0", "tpcds": "0.5"}.get(suite, "0.05")
     scale = float(os.environ.get("BENCH_SCALE", default_scale))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     if suite == "tpch":
         out = _bench_tpch_q1(scale, iters)
+    elif suite == "tpcds":
+        out = _bench_query_suite("tpcds", scale, iters)
+    elif suite == "tpcxbb_suite":
+        out = _bench_query_suite("tpcxbb", scale, iters)
     elif suite == "tpcxbb":
         out = _bench_tpcxbb(scale, os.environ.get("BENCH_QUERY", "q5"),
                             iters)
     else:
-        raise SystemExit(f"unknown BENCH_SUITE {suite!r} (tpch | tpcxbb)")
+        raise SystemExit(f"unknown BENCH_SUITE {suite!r} "
+                         "(tpch | tpcds | tpcxbb | tpcxbb_suite)")
     print(json.dumps(out))
 
 

@@ -455,3 +455,20 @@ def atanh(c: Union[str, Column]) -> Column:
 def log_base(base: float, c: Union[str, Column]) -> Column:
     from spark_rapids_tpu.exprs.math import Logarithm
     return Column(Logarithm(Literal.of(float(base)), _c(c)))
+
+
+def input_file_name() -> Column:
+    """Path of the file the row was read from (GpuInputFileBlock analog);
+    hidden scan metadata columns carry the value per batch."""
+    from spark_rapids_tpu.exprs.misc import InputFileName
+    return Column(InputFileName())
+
+
+def input_file_block_start() -> Column:
+    from spark_rapids_tpu.exprs.misc import InputFileBlockStart
+    return Column(InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    from spark_rapids_tpu.exprs.misc import InputFileBlockLength
+    return Column(InputFileBlockLength())

@@ -170,9 +170,16 @@ class BoundReference(Expression):
 
 def bind_expression(expr: Expression, schema: Schema) -> Expression:
     """Replace UnresolvedAttribute with BoundReference (GpuBindReferences analog)."""
+    from spark_rapids_tpu.exprs.misc import _InputFileMeta
+
     def rec(e: Expression) -> Expression:
         if isinstance(e, UnresolvedAttribute):
             i = schema.index_of(e.name)
+            f = schema[i]
+            return BoundReference(i, f.dtype, f.nullable, f.name)
+        if isinstance(e, _InputFileMeta) and e._col in schema.names():
+            # input-file metadata marker -> the scan's hidden column
+            i = schema.index_of(e._col)
             f = schema[i]
             return BoundReference(i, f.dtype, f.nullable, f.name)
         return e.map_children(rec)

@@ -149,3 +149,58 @@ class NormalizeNaNAndZero(UnaryExpression):
         d = child.data
         d = xp.where(xp.isnan(d), xp.asarray(np.nan, dtype=d.dtype), d)
         return xp.where(d == 0, xp.asarray(0.0, dtype=d.dtype), d)
+
+
+# ---------------------------------------------------------------- input file
+#: hidden column names a file scan emits when the plan references input-file
+#: metadata (GpuInputFileBlock.scala: InputFileName / InputFileBlockStart /
+#: InputFileBlockLength riding the scan's per-file metadata)
+INPUT_FILE_NAME_COL = "__input_file_name"
+INPUT_FILE_START_COL = "__input_file_block_start"
+INPUT_FILE_LENGTH_COL = "__input_file_block_length"
+
+
+@dataclass(frozen=True)
+class _InputFileMeta(Expression):
+    """Marker expression resolved at bind time to the scan's hidden metadata
+    column. Rows not produced by a file scan get '' / -1 (Spark's defaults
+    from InputFileBlockHolder)."""
+
+    _col = ""
+
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        raise TypeError(
+            f"{type(self).__name__} must be bound against a file scan "
+            "(no file-scan source below this expression)")
+
+    @property
+    def name_hint(self) -> str:
+        import re
+        return re.sub(r"(?<!^)(?=[A-Z])", "_", type(self).__name__).lower()
+
+
+@dataclass(frozen=True)
+class InputFileName(_InputFileMeta):
+    _col = INPUT_FILE_NAME_COL
+
+    def dtype(self) -> DType:
+        return DType.STRING
+
+
+@dataclass(frozen=True)
+class InputFileBlockStart(_InputFileMeta):
+    _col = INPUT_FILE_START_COL
+
+    def dtype(self) -> DType:
+        return DType.LONG
+
+
+@dataclass(frozen=True)
+class InputFileBlockLength(_InputFileMeta):
+    _col = INPUT_FILE_LENGTH_COL
+
+    def dtype(self) -> DType:
+        return DType.LONG

@@ -52,13 +52,12 @@ def _read_table(path: str, schema: Schema, options: Dict[str, str]) -> pa.Table:
 class _CsvScanBase(LeafExec):
     def __init__(self, files, schema: Schema, options: Dict[str, str],
                  partition_schema: Schema = Schema([])):
+        from spark_rapids_tpu.io.datasource import scan_data_schema
         super().__init__(schema)
         self.files = tuple(files)
         self.options = options
         self.partition_schema = partition_schema
-        part_names = {f.name for f in partition_schema}
-        self.data_schema = Schema([f for f in schema
-                                   if f.name not in part_names])
+        self.data_schema = scan_data_schema(schema, partition_schema)
 
     @property
     def paths(self) -> Tuple[str, ...]:
@@ -78,11 +77,13 @@ class _CsvScanBase(LeafExec):
         return None
 
     def iter_tables_for_files(self, files):
-        from spark_rapids_tpu.io.datasource import append_partition_columns
+        from spark_rapids_tpu.io.datasource import (append_partition_columns,
+                                                    fill_file_meta)
         for pf in files:
             t = _read_table(pf.path, self.data_schema, self.options)
-            yield append_partition_columns(t, self.partition_schema,
-                                           pf.partition_values)
+            t = append_partition_columns(t, self.partition_schema,
+                                         pf.partition_values)
+            yield fill_file_meta(t, pf, self.output)
 
     def _iter_arrow(self, ctx: ExecContext):
         from spark_rapids_tpu.io.datasource import assigned_files

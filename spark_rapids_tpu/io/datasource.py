@@ -252,3 +252,51 @@ def assigned_files(files: Sequence[PartitionedFile], partition_id: int,
     round-robined over the scan's partitions."""
     return [f for i, f in enumerate(files)
             if i % num_scan_partitions == partition_id]
+
+
+def _meta_names():
+    from spark_rapids_tpu.exprs.misc import (INPUT_FILE_LENGTH_COL,
+                                             INPUT_FILE_NAME_COL,
+                                             INPUT_FILE_START_COL)
+    return (INPUT_FILE_NAME_COL, INPUT_FILE_START_COL, INPUT_FILE_LENGTH_COL)
+
+
+def scan_data_schema(schema, partition_schema):
+    """The columns a scan actually READS: the output schema minus partition
+    columns (appended from directory values) and minus the hidden input-file
+    metadata columns (appended per file). One rule for every format."""
+    skip = {f.name for f in partition_schema} | set(_meta_names())
+    return Schema([f for f in schema if f.name not in skip])
+
+
+def fill_file_meta(table: pa.Table, pf: "PartitionedFile",
+                   output_schema) -> pa.Table:
+    """Append the scan's hidden input-file metadata columns when the exec's
+    output asks for them: path, block start (0: splits are whole files),
+    block length (file size). GpuInputFileBlock.scala's InputFileBlockHolder
+    role — the values ride the batch instead of a thread-local."""
+    name_col, start_col, len_col = _meta_names()
+    if name_col not in output_schema.names():
+        return table
+    import numpy as np
+    n = table.num_rows
+    size = _file_size_cached(pf.path)
+    table = table.append_column(
+        pa.field(name_col, pa.string(), nullable=False),
+        pa.DictionaryArray.from_arrays(
+            np.zeros(n, dtype=np.int32),
+            pa.array([pf.path])).cast(pa.string()))
+    for col, val in ((start_col, 0), (len_col, size)):
+        table = table.append_column(
+            pa.field(col, pa.int64(), nullable=False),
+            pa.array(np.full(n, val, dtype=np.int64)))
+    return table
+
+
+def _file_size_cached(path: str) -> int:
+    sizes = _file_size_cached.__dict__.setdefault("sizes", {})
+    if path not in sizes:
+        if len(sizes) > 4096:
+            sizes.clear()
+        sizes[path] = os.path.getsize(path)
+    return sizes[path]

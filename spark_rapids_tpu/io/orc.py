@@ -65,12 +65,11 @@ class _OrcScanBase(LeafExec):
                  filters: Tuple[Expression, ...] = (),
                  max_batch_rows: int = 1 << 20,
                  max_batch_bytes: int = 1 << 31):
+        from spark_rapids_tpu.io.datasource import scan_data_schema
         super().__init__(schema)
         self.files = files
         self.partition_schema = partition_schema
-        part_names = {f.name for f in partition_schema}
-        self.data_schema = Schema([f for f in schema
-                                   if f.name not in part_names])
+        self.data_schema = scan_data_schema(schema, partition_schema)
         self.filters = filters
         self.max_batch_rows = max_batch_rows
         self.max_batch_bytes = max_batch_bytes
@@ -137,9 +136,11 @@ class _OrcScanBase(LeafExec):
 
     def _emit(self, batches: List[pa.RecordBatch],
               pf: PartitionedFile) -> pa.Table:
+        from spark_rapids_tpu.io.datasource import fill_file_meta
         t = evolve_schema(pa.Table.from_batches(batches), self.data_schema)
-        return append_partition_columns(t, self.partition_schema,
-                                        pf.partition_values)
+        t = append_partition_columns(t, self.partition_schema,
+                                     pf.partition_values)
+        return fill_file_meta(t, pf, self.output)
 
     def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
         from spark_rapids_tpu.io.datasource import assigned_files

@@ -27,6 +27,7 @@ from spark_rapids_tpu.exprs.core import Expression
 from spark_rapids_tpu.io.datasource import (ColumnStats, PartitionedFile,
                                             append_partition_columns,
                                             assigned_files, evolve_schema,
+                                            fill_file_meta,
                                             stats_may_contain)
 
 
@@ -116,12 +117,11 @@ class _ParquetScanBase(LeafExec):
                  filters: Tuple[Expression, ...] = (),
                  max_batch_rows: int = 1 << 20,
                  max_batch_bytes: int = 1 << 31):
+        from spark_rapids_tpu.io.datasource import scan_data_schema
         super().__init__(schema)
         self.files = files
         self.partition_schema = partition_schema
-        part_names = {f.name for f in partition_schema}
-        self.data_schema = Schema([f for f in schema
-                                   if f.name not in part_names])
+        self.data_schema = scan_data_schema(schema, partition_schema)
         self.filters = filters
         self.max_batch_rows = max_batch_rows
         self.max_batch_bytes = max_batch_bytes
@@ -158,9 +158,10 @@ class _ParquetScanBase(LeafExec):
     def iter_tables_for_files(self, files: Sequence[PartitionedFile]
                               ) -> Iterator[pa.Table]:
         for f in files:
-            yield from _iter_file_tables(
-                f, self.data_schema, self.partition_schema, self.filters,
-                self.max_batch_rows, self.max_batch_bytes)
+            for t in _iter_file_tables(
+                    f, self.data_schema, self.partition_schema, self.filters,
+                    self.max_batch_rows, self.max_batch_bytes):
+                yield fill_file_meta(t, f, self.output)
 
     def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
         if ctx.partition_id >= self.scan_partitions:

@@ -54,9 +54,20 @@ class FileScan(LogicalPlan):
     #: hive-partition discovery results (io.datasource.PartitionedFile)
     files: Tuple = ()
     partition_schema: Schema = field(default_factory=lambda: Schema([]))
+    #: emit hidden per-file metadata columns (set by the planner when the
+    #: query references input_file_name()/block exprs — GpuInputFileBlock)
+    with_file_meta: bool = False
 
     def schema(self) -> Schema:
-        return self.read_schema
+        if not self.with_file_meta:
+            return self.read_schema
+        from spark_rapids_tpu.exprs.misc import (INPUT_FILE_LENGTH_COL,
+                                                 INPUT_FILE_NAME_COL,
+                                                 INPUT_FILE_START_COL)
+        return Schema(list(self.read_schema.fields) + [
+            Field(INPUT_FILE_NAME_COL, DType.STRING, False),
+            Field(INPUT_FILE_START_COL, DType.LONG, False),
+            Field(INPUT_FILE_LENGTH_COL, DType.LONG, False)])
 
 
 @dataclass

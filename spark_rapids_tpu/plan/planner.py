@@ -25,7 +25,100 @@ def plan_physical(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
     if conf.get(cfg.UDF_COMPILER_ENABLED):
         from spark_rapids_tpu.udf import compile_plan_udfs
         plan = compile_plan_udfs(plan)
+    plan = _resolve_input_file_meta(plan)
     return ensure_requirements(_plan_node(plan, conf))
+
+
+def _resolve_input_file_meta(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    """When any expression references input-file metadata
+    (InputFileName/BlockStart/BlockLength), flip every file scan below to
+    emit the hidden per-file columns; binding then resolves the markers to
+    those columns (GpuInputFileBlock.scala riding the scan's metadata)."""
+    import dataclasses
+    from spark_rapids_tpu.exprs.core import Expression
+    from spark_rapids_tpu.exprs.misc import _InputFileMeta
+
+    def expr_has(e: Expression) -> bool:
+        if isinstance(e, _InputFileMeta):
+            return True
+        return any(expr_has(c) for c in e.children)
+
+    def any_exprs(obj, depth=0) -> bool:
+        if isinstance(obj, Expression):
+            return expr_has(obj)
+        if depth > 3:
+            return False
+        if isinstance(obj, (tuple, list)):
+            return any(any_exprs(x, depth + 1) for x in obj)
+        if dataclasses.is_dataclass(obj) and not isinstance(
+                obj, (lp.LogicalPlan, type)):
+            return any(any_exprs(getattr(obj, f.name), depth + 1)
+                       for f in dataclasses.fields(obj))
+        return False
+
+    def node_uses_meta(node: lp.LogicalPlan) -> bool:
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                continue
+            if any_exprs(v):
+                return True
+        return any(node_uses_meta(c) for c in node.children)
+
+    if not node_uses_meta(plan):
+        return plan
+
+    from spark_rapids_tpu.exprs.core import UnresolvedAttribute
+    from spark_rapids_tpu.exprs.literals import Literal
+    from spark_rapids_tpu.exprs.misc import (Alias, INPUT_FILE_LENGTH_COL,
+                                             INPUT_FILE_NAME_COL,
+                                             INPUT_FILE_START_COL)
+    from spark_rapids_tpu.columnar.dtypes import DType
+    meta_cols = (INPUT_FILE_NAME_COL, INPUT_FILE_START_COL,
+                 INPUT_FILE_LENGTH_COL)
+
+    def with_default_meta(child: lp.LogicalPlan) -> lp.LogicalPlan:
+        """Union branches without a file scan get Spark's defaults ('' / -1,
+        InputFileBlockHolder's initial state) so branch schemas align."""
+        exprs = [Alias(UnresolvedAttribute(n), n)
+                 for n in child.schema().names()]
+        exprs.append(Alias(Literal("", DType.STRING), INPUT_FILE_NAME_COL))
+        exprs.append(Alias(Literal(-1, DType.LONG), INPUT_FILE_START_COL))
+        exprs.append(Alias(Literal(-1, DType.LONG), INPUT_FILE_LENGTH_COL))
+        return lp.Project(tuple(exprs), child)
+
+    def flip(node: lp.LogicalPlan) -> lp.LogicalPlan:
+        if isinstance(node, lp.FileScan):
+            return dataclasses.replace(node, with_file_meta=True)
+        kids = [flip(c) for c in node.children]
+        if isinstance(node, lp.Union):
+            # every branch must agree on the hidden columns
+            if any(meta_cols[0] in k.schema().names() for k in kids):
+                kids = [k if meta_cols[0] in k.schema().names()
+                        else with_default_meta(k) for k in kids]
+        if all(a is b for a, b in zip(kids, node.children)):
+            return node
+        reps = {}
+        ki = iter(kids)
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                reps[f.name] = next(ki)
+            elif isinstance(v, tuple) and v and all(
+                    isinstance(x, lp.LogicalPlan) for x in v):
+                reps[f.name] = tuple(next(ki) for _ in v)
+        return dataclasses.replace(node, **reps)
+
+    out = flip(plan)
+    # the hidden columns must never surface in user-visible output (they
+    # exist only for the markers to bind against): strip any that reached
+    # the root — incl. join-duplicate renames (__input_file_name_1 ...)
+    root_names = out.schema().names()
+    visible = [n for n in root_names if not n.startswith("__input_file_")]
+    if len(visible) != len(root_names):
+        out = lp.Project(tuple(Alias(UnresolvedAttribute(n), n)
+                               for n in visible), out)
+    return out
 
 
 def ensure_requirements(plan: PhysicalExec) -> PhysicalExec:
@@ -81,19 +174,20 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.io.datasource import PartitionedFile
         files = plan.files or tuple(PartitionedFile(p) for p in plan.paths)
+        scan_schema = plan.schema()   # + hidden input-file meta when asked
         if plan.fmt == "parquet":
             return CpuParquetScanExec(
-                files, plan.read_schema, plan.partition_schema, plan.filters,
+                files, scan_schema, plan.partition_schema, plan.filters,
                 conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS),
                 conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         if plan.fmt == "csv":
             from spark_rapids_tpu.io.csv import CpuCsvScanExec
-            return CpuCsvScanExec(files, plan.read_schema, dict(plan.options),
+            return CpuCsvScanExec(files, scan_schema, dict(plan.options),
                                   plan.partition_schema)
         if plan.fmt == "orc":
             from spark_rapids_tpu.io.orc import CpuOrcScanExec
             return CpuOrcScanExec(
-                files, plan.read_schema, plan.partition_schema, plan.filters,
+                files, scan_schema, plan.partition_schema, plan.filters,
                 conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS),
                 conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES))
         raise ValueError(f"unsupported format {plan.fmt}")

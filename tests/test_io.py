@@ -283,3 +283,91 @@ def test_orc_stripe_pruning_and_chunking(tmp_path):
 
     cpu = assert_tpu_and_cpu_equal(build2)
     assert cpu.num_rows == 10_000
+
+
+# ----------------------------------------------------- input-file metadata
+def test_input_file_name_and_block(tmp_path):
+    """input_file_name/block_start/block_length ride the scan's per-file
+    metadata (GpuInputFileBlock.scala)."""
+    import os
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+    paths = []
+    for i in range(3):
+        t = pa.table({"k": np.arange(i * 10, i * 10 + 10, dtype=np.int64)})
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    s = TpuSession()
+    out = s.read.parquet(str(tmp_path)).select(
+        "k", F.input_file_name().alias("fn"),
+        F.input_file_block_start().alias("bs"),
+        F.input_file_block_length().alias("bl")).collect()
+    rows = {r["k"]: r for r in out.to_pylist()}
+    assert rows[5]["fn"].endswith("f0.parquet")
+    assert rows[15]["fn"].endswith("f1.parquet")
+    assert rows[25]["fn"].endswith("f2.parquet")
+    assert all(r["bs"] == 0 for r in rows.values())
+    assert rows[5]["bl"] == os.path.getsize(paths[0])
+    # CPU-vs-TPU parity incl. aggregation over the metadata
+    assert_tpu_and_cpu_equal(
+        lambda sess: sess.read.parquet(str(tmp_path))
+            .groupBy(F.input_file_name().alias("fn"))
+            .agg(F.count("k").alias("c")),
+        ignore_order=True)
+
+
+def test_input_file_name_requires_file_scan():
+    import pyarrow as _pa
+    import pytest as _pytest
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    s = TpuSession()
+    df = s.create_dataframe(_pa.table({"a": [1, 2]})).select(
+        F.input_file_name().alias("f"))
+    with _pytest.raises(Exception, match="file scan|unresolved|bound"):
+        df.collect()
+
+
+def test_input_file_meta_csv_and_orc(tmp_path):
+    import numpy as np
+    import pyarrow.orc as po_orc
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    t = pa.table({"v": np.arange(20, dtype=np.int64)})
+    po_orc.write_table(t, str(tmp_path / "a.orc"))
+    s = TpuSession()
+    out = s.read.orc(str(tmp_path)).select(
+        F.input_file_name().alias("fn")).collect()
+    assert out.num_rows == 20
+    assert all(x.endswith("a.orc") for x in out.column("fn").to_pylist())
+
+
+def test_input_file_meta_hidden_columns_do_not_leak(tmp_path):
+    """Meta referenced only in a filter: the hidden columns must not surface
+    in the collected schema; a union with a non-file source gets Spark's
+    '' / -1 defaults."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    for i in range(2):
+        pq.write_table(
+            pa.table({"k": np.arange(i * 5, i * 5 + 5, dtype=np.int64)}),
+            str(tmp_path / f"f{i}.parquet"))
+    s = TpuSession()
+    out = s.read.parquet(str(tmp_path)).filter(
+        F.input_file_name().contains("f0")).collect()
+    assert out.column_names == ["k"], out.column_names
+    assert sorted(out.column("k").to_pylist()) == [0, 1, 2, 3, 4]
+    # union with an in-memory source: defaults align the branches
+    u = s.read.parquet(str(tmp_path)).union(
+        s.create_dataframe(pa.table({"k": pa.array([99], pa.int64())})))
+    got = u.select("k", F.input_file_name().alias("fn")).collect()
+    by_k = dict(zip(got.column("k").to_pylist(),
+                    got.column("fn").to_pylist()))
+    assert by_k[99] == ""
+    assert by_k[0].endswith("f0.parquet")
