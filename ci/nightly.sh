@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Nightly pipeline (jenkins/spark-tests.sh analog): the FULL suite including
+# the benchmark-correctness runs (TPC-H/DS/xBB/Mortgage, mesh TPC-H/scale,
+# cluster two-process), then device benchmarks when a TPU is attached.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+echo "== full suite (incl. slow) =="
+python -m pytest tests/ -q
+
+if [ "${RUN_TPU_BENCH:-0}" = "1" ]; then
+    echo "== device benchmarks (real chip) =="
+    unset JAX_PLATFORMS
+    python bench.py
+    BENCH_SUITE=tpcds python bench.py
+fi
+echo "NIGHTLY OK"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Premerge gate (jenkins/Jenkinsfile.premerge analog): fast correctness on
+# an 8-device virtual CPU mesh — no TPU hardware needed, suitable for every
+# pull request.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+echo "== config docs in sync =="
+python - << 'PY'
+from spark_rapids_tpu import config
+import pathlib
+assert pathlib.Path("docs/configs.md").read_text() == config.generate_docs(), \
+    "docs/configs.md stale: run python -m spark_rapids_tpu.config docs/configs.md"
+print("ok")
+PY
+
+echo "== fast suite (slow markers excluded) =="
+python -m pytest tests/ -x -q -m "not slow"
+
+echo "== API surface validation =="
+python -m spark_rapids_tpu.api_validation
+
+echo "== multichip dry-run (8 virtual devices) =="
+python - << 'PY'
+import importlib.util
+spec = importlib.util.spec_from_file_location("__graft_entry__", "__graft_entry__.py")
+g = importlib.util.module_from_spec(spec); spec.loader.exec_module(g)
+g.dryrun_multichip(8)
+print("ok")
+PY
+echo "PREMERGE OK"

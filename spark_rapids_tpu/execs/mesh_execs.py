@@ -51,6 +51,10 @@ from spark_rapids_tpu.parallel.mesh_batch import (MeshBatch, flatten_mesh,
 
 _SAMPLE_PER_SHARD = 512
 
+#: per-process log of mesh exchange sizings (count pre-pass results): the
+#: MapOutputStatistics analog, consumed by skew/capacity tests and debugging
+EXCHANGE_STATS: list = []
+
 
 def _shard_jit(mesh: Mesh, key: Tuple, builder, in_specs, out_specs):
     """Cached jit(shard_map(...)) keyed like the single-chip program cache."""
@@ -425,6 +429,14 @@ def _mesh_repartition(mb: MeshBatch, op_key: Tuple, pid_builder,
     chunk_cap = max(bucket_capacity(int(cmat.max(initial=0))), 1)
     recv = cmat.sum(axis=0).astype(np.int32)
     out_cap = max(bucket_capacity(int(recv.max(initial=0))), 1)
+    # observability: the count pre-pass result that sized this exchange (the
+    # MapOutputStatistics role — skew/capacity-growth tests assert on it)
+    EXCHANGE_STATS.append({
+        "op": op_key[0], "chunk_cap": chunk_cap, "out_cap": out_cap,
+        "in_cap": cap, "recv_max": int(recv.max(initial=0)),
+        "recv_min": int(recv.min(initial=0)), "rows": int(mb.num_rows)})
+    if len(EXCHANGE_STATS) > 256:
+        del EXCHANGE_STATS[:128]
 
     def build_exchange(chunk_cap=chunk_cap, out_cap=out_cap):
         def fn(rows, *args):

@@ -159,6 +159,15 @@ INPUT_FILE_NAME_COL = "__input_file_name"
 INPUT_FILE_START_COL = "__input_file_block_start"
 INPUT_FILE_LENGTH_COL = "__input_file_block_length"
 
+#: THE spec of the hidden trio — (name, dtype, non-scan default) — shared by
+#: FileScan.schema(), the planner's union defaults, and the scan fill, so a
+#: fourth column or dtype change is one edit
+INPUT_FILE_META_SPEC = (
+    (INPUT_FILE_NAME_COL, DType.STRING, ""),
+    (INPUT_FILE_START_COL, DType.LONG, -1),
+    (INPUT_FILE_LENGTH_COL, DType.LONG, -1),
+)
+
 
 @dataclass(frozen=True)
 class _InputFileMeta(Expression):

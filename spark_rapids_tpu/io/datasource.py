@@ -280,7 +280,9 @@ def fill_file_meta(table: pa.Table, pf: "PartitionedFile",
         return table
     import numpy as np
     n = table.num_rows
-    size = _file_size_cached(pf.path)
+    # one stat syscall per batch — cheap, and never stale when a file at the
+    # same path is rewritten between queries
+    size = os.path.getsize(pf.path)
     table = table.append_column(
         pa.field(name_col, pa.string(), nullable=False),
         pa.DictionaryArray.from_arrays(
@@ -291,12 +293,3 @@ def fill_file_meta(table: pa.Table, pf: "PartitionedFile",
             pa.field(col, pa.int64(), nullable=False),
             pa.array(np.full(n, val, dtype=np.int64)))
     return table
-
-
-def _file_size_cached(path: str) -> int:
-    sizes = _file_size_cached.__dict__.setdefault("sizes", {})
-    if path not in sizes:
-        if len(sizes) > 4096:
-            sizes.clear()
-        sizes[path] = os.path.getsize(path)
-    return sizes[path]

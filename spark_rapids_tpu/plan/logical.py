@@ -61,13 +61,10 @@ class FileScan(LogicalPlan):
     def schema(self) -> Schema:
         if not self.with_file_meta:
             return self.read_schema
-        from spark_rapids_tpu.exprs.misc import (INPUT_FILE_LENGTH_COL,
-                                                 INPUT_FILE_NAME_COL,
-                                                 INPUT_FILE_START_COL)
+        from spark_rapids_tpu.exprs.misc import INPUT_FILE_META_SPEC
         return Schema(list(self.read_schema.fields) + [
-            Field(INPUT_FILE_NAME_COL, DType.STRING, False),
-            Field(INPUT_FILE_START_COL, DType.LONG, False),
-            Field(INPUT_FILE_LENGTH_COL, DType.LONG, False)])
+            Field(name, dtype, False)
+            for name, dtype, _default in INPUT_FILE_META_SPEC])
 
 
 @dataclass

@@ -70,33 +70,42 @@ def _resolve_input_file_meta(plan: lp.LogicalPlan) -> lp.LogicalPlan:
 
     from spark_rapids_tpu.exprs.core import UnresolvedAttribute
     from spark_rapids_tpu.exprs.literals import Literal
-    from spark_rapids_tpu.exprs.misc import (Alias, INPUT_FILE_LENGTH_COL,
-                                             INPUT_FILE_NAME_COL,
-                                             INPUT_FILE_START_COL)
-    from spark_rapids_tpu.columnar.dtypes import DType
-    meta_cols = (INPUT_FILE_NAME_COL, INPUT_FILE_START_COL,
-                 INPUT_FILE_LENGTH_COL)
+    from spark_rapids_tpu.exprs.misc import Alias, INPUT_FILE_META_SPEC
+    meta_cols = tuple(n for n, _d, _v in INPUT_FILE_META_SPEC)
 
     def with_default_meta(child: lp.LogicalPlan) -> lp.LogicalPlan:
         """Union branches without a file scan get Spark's defaults ('' / -1,
         InputFileBlockHolder's initial state) so branch schemas align."""
         exprs = [Alias(UnresolvedAttribute(n), n)
                  for n in child.schema().names()]
-        exprs.append(Alias(Literal("", DType.STRING), INPUT_FILE_NAME_COL))
-        exprs.append(Alias(Literal(-1, DType.LONG), INPUT_FILE_START_COL))
-        exprs.append(Alias(Literal(-1, DType.LONG), INPUT_FILE_LENGTH_COL))
+        exprs.extend(Alias(Literal(default, dtype), name)
+                     for name, dtype, default in INPUT_FILE_META_SPEC)
         return lp.Project(tuple(exprs), child)
 
     def flip(node: lp.LogicalPlan) -> lp.LogicalPlan:
         if isinstance(node, lp.FileScan):
             return dataclasses.replace(node, with_file_meta=True)
         kids = [flip(c) for c in node.children]
+        extended = False
+        if isinstance(node, lp.Project):
+            # thread the hidden columns THROUGH intervening projections so
+            # metadata above a select()/withColumn() still resolves
+            have = set(kids[0].schema().names())
+            mine = {e.name_hint for e in node.exprs}
+            passthrough = tuple(
+                Alias(UnresolvedAttribute(n), n) for n in meta_cols
+                if n in have and n not in mine)
+            if passthrough:
+                node = dataclasses.replace(
+                    node, exprs=tuple(node.exprs) + passthrough)
+                extended = True
         if isinstance(node, lp.Union):
             # every branch must agree on the hidden columns
             if any(meta_cols[0] in k.schema().names() for k in kids):
                 kids = [k if meta_cols[0] in k.schema().names()
                         else with_default_meta(k) for k in kids]
-        if all(a is b for a, b in zip(kids, node.children)):
+        if not extended and all(
+                a is b for a, b in zip(kids, node.children)):
             return node
         reps = {}
         ki = iter(kids)

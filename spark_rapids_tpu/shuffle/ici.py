@@ -137,6 +137,7 @@ def ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
     overflow flag, and re-runs with a doubled chunk capacity until no row was
     clamped (the detect-and-re-run pattern of the aggregation hash fast
     path). Returns (out_rows [n_dev], flat resharded columns)."""
+    global RERUN_COUNT
     chunk = chunk_capacity or local_capacity
     while True:
         fn = build_ici_repartition(mesh, schema, local_capacity,
@@ -150,3 +151,9 @@ def ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
                 "local_capacity — impossible unless inputs violate the "
                 "padding invariant")
         chunk = min(chunk * 2, local_capacity)
+        RERUN_COUNT += 1
+
+
+#: process-wide count of overflow-triggered re-runs (fault-path
+#: observability; tests assert the detect-and-re-run loop really fires)
+RERUN_COUNT = 0

@@ -371,3 +371,34 @@ def test_input_file_meta_hidden_columns_do_not_leak(tmp_path):
                     got.column("fn").to_pylist()))
     assert by_k[99] == ""
     assert by_k[0].endswith("f0.parquet")
+
+
+def test_input_file_meta_through_projections(tmp_path):
+    """The hidden columns thread through intermediate select()s, including
+    union branches that project over a scan."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    for i in range(2):
+        pq.write_table(
+            pa.table({"k": np.arange(i * 5, i * 5 + 5, dtype=np.int64),
+                      "x": np.full(5, i, dtype=np.int64)}),
+            str(tmp_path / f"f{i}.parquet"))
+    s = TpuSession()
+    # metadata above an intermediate projection
+    out = s.read.parquet(str(tmp_path)).select("k").select(
+        "k", F.input_file_name().alias("fn")).collect()
+    assert out.column_names == ["k", "fn"]
+    by_k = dict(zip(out.column("k").to_pylist(),
+                    out.column("fn").to_pylist()))
+    assert by_k[0].endswith("f0.parquet") and by_k[9].endswith("f1.parquet")
+    # union branch whose scan sits under a projection keeps the REAL path
+    u = (s.read.parquet(str(tmp_path)).select("k")
+         .union(s.create_dataframe(
+             pa.table({"k": pa.array([99], pa.int64())})))
+         .select("k", F.input_file_name().alias("fn")))
+    got = dict(zip(u.collect().column("k").to_pylist(),
+                   u.collect().column("fn").to_pylist()))
+    assert got[99] == ""
+    assert got[3].endswith("f0.parquet")

@@ -25,6 +25,13 @@ _TIES = {"q3", "q7", "q19", "q34", "q42", "q43", "q46", "q52", "q55", "q59",
          "q70", "q72", "q74", "q75", "q77", "q78", "q80", "q81", "q83",
          "q84", "q85", "q91", "q95"}
 
+# Queries whose predicates the synthetic generator does not qualify at this
+# scale (verified empty on the CPU engine at SF 0.01 AND 0.04): these cannot
+# carry a row floor yet — every OTHER query must return rows (default floor
+# 1, so a query pruned to nothing by a regression can no longer pass
+# vacuously).
+_KNOWN_EMPTY = {"q4", "q8", "q54", "q58", "q66", "q73", "q78", "q83", "q91"}
+
 _MIN_ROWS = {"q3": 1, "q7": 1, "q19": 1, "q34": 1, "q42": 1, "q43": 1,
              "q46": 1, "q52": 1, "q55": 1, "q59": 10, "q65": 1, "q68": 1,
              "q79": 10, "q89": 10, "q96": 1, "q98": 10,
@@ -75,7 +82,8 @@ def test_tpcds_query_matches_cpu(qname, tables):
         conf=BENCH_CONF,
         ignore_order=qname in _TIES,
         approx_float=1e-9)
-    assert cpu.num_rows >= _MIN_ROWS.get(qname, 0), (
+    floor = 0 if qname in _KNOWN_EMPTY else _MIN_ROWS.get(qname, 1)
+    assert cpu.num_rows >= floor, (
         f"{qname} returned {cpu.num_rows} rows; the generator no longer "
         f"qualifies rows for its predicates")
     check = _SCALAR_CHECK.get(qname)
