@@ -217,25 +217,62 @@ def split_by_pid(xp, colvs: Sequence[ColV], pids, num_rows, n: int):
 def _slice_padded(colvs: Sequence[ColV], schema: Schema, start: int,
                   cnt: int) -> DeviceBatch:
     """One contiguous slice of partition-major columns -> a fresh DeviceBatch
-    (live rows first, re-bucketed capacity, zero padding)."""
+    (live rows first, re-bucketed capacity, zero padding).
+
+    Runs as ONE jitted program keyed by the OUTPUT bucket only —
+    ``start``/``cnt`` are device arguments (dynamic_slice + mask), so every
+    partition of every exchange with the same shape bucket reuses one
+    compiled slice instead of dispatching per-column eager ops."""
     cap = bucket_capacity(cnt)
-    pad = cap - cnt
+    key = ("slice_padded", schema, colvs[0].validity.shape[0] if colvs else 0,
+           cap, tuple(v.data.shape[1:] for v in colvs))
+
+    def build(schema=schema, cap=cap,
+              in_cap=colvs[0].validity.shape[0] if colvs else 0):
+        def fn(start, cnt, *flat):
+            cols = _unflatten_colvs(schema, flat)
+            live = jnp.arange(cap, dtype=np.int32) < cnt
+            # a slice starting near the tail would be clamped by XLA and
+            # misalign rows: extend the source by `cap` zero rows so every
+            # in-range start stays exact
+            s = jnp.clip(start, 0, in_cap)
+
+            def ext(a):
+                return jnp.concatenate(
+                    [a, jnp.zeros((cap,) + a.shape[1:], a.dtype)], axis=0)
+
+            outs = []
+            for v in cols:
+                data = jax.lax.dynamic_slice_in_dim(ext(v.data), s, cap, 0)
+                data = jnp.where(
+                    live.reshape((cap,) + (1,) * (data.ndim - 1)), data, 0)
+                validity = jnp.logical_and(
+                    jax.lax.dynamic_slice_in_dim(ext(v.validity), s, cap, 0),
+                    live)
+                outs.append(data)
+                outs.append(validity)
+                if v.lengths is not None:
+                    outs.append(jnp.where(
+                        live,
+                        jax.lax.dynamic_slice_in_dim(ext(v.lengths), s, cap,
+                                                     0),
+                        0))
+            return tuple(outs)
+        return fn
+
+    from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+    import jax
+    fn = _cached_jit(key, build)
+    res = fn(np.int32(start), np.int32(cnt), *flatten_colvs(list(colvs)))
     cols = []
-    for f, v in zip(schema, colvs):
-        data = v.data[start:start + cnt]
-        validity = v.validity[start:start + cnt]
-        if pad:
-            data = jnp.concatenate(
-                [data, jnp.zeros((pad,) + data.shape[1:], data.dtype)], axis=0)
-            validity = jnp.concatenate([validity, jnp.zeros(pad, bool)], axis=0)
+    i = 0
+    for f in schema:
         if f.dtype is DType.STRING:
-            lengths = v.lengths[start:start + cnt]
-            if pad:
-                lengths = jnp.concatenate(
-                    [lengths, jnp.zeros(pad, lengths.dtype)], axis=0)
-            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+            cols.append(DeviceColumn(f.dtype, res[i], res[i + 1], res[i + 2]))
+            i += 3
         else:
-            cols.append(DeviceColumn(f.dtype, data, validity))
+            cols.append(DeviceColumn(f.dtype, res[i], res[i + 1]))
+            i += 2
     return DeviceBatch(schema, tuple(cols), cnt)
 
 
