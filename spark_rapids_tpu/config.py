@@ -335,8 +335,9 @@ SHUFFLE_BOUNCE_BUFFER_COUNT = _conf(
 
 SHUFFLE_COMPRESSION_CODEC = _conf(
     "shuffle.compression.codec", str, "none",
-    "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), zlib "
-    "(analog of spark.rapids.shuffle.compression.codec).")
+    "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), "
+    "zlib, zstd (fastest real codec; the right choice for network-bound DCN "
+    "shuffles) — analog of spark.rapids.shuffle.compression.codec.")
 
 SHUFFLE_PARTITIONING_MAX_CPU_BATCH = _conf(
     "shuffle.partitioning.maxCpuBatchSize", int, 1 << 31,

@@ -1,0 +1,83 @@
+"""TPU resource discovery (ExclusiveModeGpuDiscoveryPlugin analog,
+ExclusiveModeGpuDiscoveryPlugin.scala).
+
+Spark executors discover accelerators through a resource-discovery script
+that prints a JSON document {"name": ..., "addresses": [...]}; the
+reference's plugin additionally picks an UNUSED GPU by taking an exclusive
+OS-level lock per device so co-located executors never share a chip. This
+module is both: ``python -m spark_rapids_tpu.discovery`` prints the
+discovery JSON, and ``acquire_exclusive()`` flock-claims one visible TPU
+device for the calling process (released on process exit).
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+def visible_devices() -> List[str]:
+    """Addresses of the visible TPU devices (device ids as strings)."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:  # CPU-only host (tests): virtual addresses
+        devs = jax.devices()
+    return [str(d.id) for d in devs]
+
+
+def _lock_dir() -> str:
+    d = os.path.join(tempfile.gettempdir(), "spark-rapids-tpu-locks")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@dataclass
+class DeviceClaim:
+    address: str
+    _fh: object
+
+    def release(self) -> None:
+        try:
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+        except OSError:
+            pass
+
+
+def acquire_exclusive(addresses: Optional[List[str]] = None,
+                      lock_dir: Optional[str] = None
+                      ) -> Optional[DeviceClaim]:
+    """Claim ONE unused device via a per-device exclusive flock (the
+    exclusive-mode selection loop of the reference's discovery plugin).
+    Returns None when every visible device is already claimed. A lock file
+    we cannot even open (another user's claim on a shared host) counts as
+    claimed; the holder's recorded PID is only written AFTER the lock is
+    ours (append mode never truncates a holder's record)."""
+    d = lock_dir or _lock_dir()
+    for addr in addresses if addresses is not None else visible_devices():
+        try:
+            fh = open(os.path.join(d, f"tpu-{addr}.lock"), "a")
+        except OSError:
+            continue  # unreadable/unwritable lock = someone else's device
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fh.truncate(0)
+            fh.write(str(os.getpid()))
+            fh.flush()
+            return DeviceClaim(addr, fh)
+        except OSError:
+            fh.close()
+    return None
+
+
+def main() -> int:
+    """Spark resource-discovery script protocol: one JSON line."""
+    print(json.dumps({"name": "tpu", "addresses": visible_devices()}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -60,11 +60,43 @@ class ZlibCodec(TableCompressionCodec):
         return out
 
 
+class ZstdCodec(TableCompressionCodec):
+    """zstd at low level: ~5-10x zlib's speed at similar ratios — the right
+    default for a network-bound DCN shuffle (the reference ships only the
+    copy pseudo-codec in-repo; real codecs live in cuDF).
+
+    (De)compressor objects are built PER CALL: zstandard contexts are not
+    thread-safe and the shuffle server runs request handlers on a worker
+    pool, all sharing the registry's codec instance."""
+
+    name = "zstd"
+
+    def __init__(self, level: int = 1):
+        import zstandard
+        self._zstd = zstandard
+        self.level = level
+
+    def compress(self, buf: bytes) -> bytes:
+        return self._zstd.ZstdCompressor(level=self.level).compress(buf)
+
+    def decompress(self, buf: bytes, uncompressed_size: int) -> bytes:
+        out = self._zstd.ZstdDecompressor().decompress(
+            buf, max_output_size=uncompressed_size)
+        if len(out) != uncompressed_size:
+            raise ValueError(f"zstd decompressed to {len(out)}, expected "
+                             f"{uncompressed_size}")
+        return out
+
+
 _REGISTRY: Dict[str, TableCompressionCodec] = {
     "copy": CopyCodec(),
     "zlib": ZlibCodec(),
     "none": CopyCodec(),
 }
+try:
+    _REGISTRY["zstd"] = ZstdCodec()
+except ImportError:  # zstandard not installed: registry omits it
+    pass
 
 
 def get_codec(name: str) -> TableCompressionCodec:

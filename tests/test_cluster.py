@@ -172,3 +172,33 @@ def test_cluster_two_os_processes_tpch(tmp_path):
         assert len(execs) == 2, f"both processes must do map work: {execs}"
     finally:
         s._cluster_scheduler.close()
+
+
+@pytest.mark.slow
+def test_cluster_tpcds_queries(tmp_path):
+    """TPC-DS star joins + rollups through the multi-executor stage
+    scheduler (in-process executors, real shuffle protocol)."""
+    from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+    tables = gen_all(0.01, seed=0)
+    conf = {
+        "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+        "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+        "spark.rapids.tpu.sql.hasNans": "false",
+    }
+    s = TpuSession(conf)
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    try:
+        for q in ("q3", "q27", "q42", "q96"):
+            dfs = {k: s.create_dataframe(v).repartition(2)
+                   for k, v in tables.items()}
+            cdfs = {k: cpu.create_dataframe(v).repartition(2)
+                    for k, v in tables.items()}
+            out = QUERIES[q](dfs).collect()
+            exp = QUERIES[q](cdfs).collect()
+            assert_tables_equal(exp, out, ignore_order=True,
+                                approx_float=1e-9)
+    finally:
+        if getattr(s, "_cluster_scheduler", None):
+            s._cluster_scheduler.close()

@@ -1,0 +1,37 @@
+"""TPU resource discovery (ExclusiveModeGpuDiscoveryPlugin analog)."""
+import json
+import subprocess
+import sys
+
+from spark_rapids_tpu import discovery
+
+
+def test_discovery_script_protocol():
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.discovery"],
+        capture_output=True, text=True, check=True,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": ":".join(sys.path)})
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["name"] == "tpu"
+    assert len(doc["addresses"]) >= 1
+
+
+def test_exclusive_claims_do_not_collide(tmp_path):
+    d = str(tmp_path)   # isolated lock dir: parallel suites must not collide
+    addrs = ["91", "92"]
+    a = discovery.acquire_exclusive(addrs, lock_dir=d)
+    b = discovery.acquire_exclusive(addrs, lock_dir=d)
+    c = discovery.acquire_exclusive(addrs, lock_dir=d)
+    try:
+        assert a is not None and b is not None
+        assert {a.address, b.address} == set(addrs)
+        assert c is None  # everything claimed
+    finally:
+        for claim in (a, b):
+            if claim:
+                claim.release()
+    # released devices are claimable again
+    again = discovery.acquire_exclusive(addrs, lock_dir=d)
+    assert again is not None
+    again.release()
