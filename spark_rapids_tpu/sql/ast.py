@@ -149,6 +149,25 @@ class OrderItem(Node):
 
 
 @dataclass(frozen=True)
+class WindowSpecNode(Node):
+    """OVER (...) spec: frame bounds use None for UNBOUNDED, ints otherwise
+    (negative = preceding, 0 = current row, positive = following)."""
+    partition_by: Tuple[Node, ...] = ()
+    order_by: Tuple["OrderItem", ...] = ()
+    frame_type: Optional[str] = None       # "rows" | "range" | None=default
+    frame_lower: Optional[int] = None
+    frame_upper: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class WindowFuncCall(Node):
+    """fn(...) OVER (spec) — ranking functions, lead/lag, or an aggregate
+    evaluated as a window aggregate."""
+    func: "FuncCall"
+    spec: WindowSpecNode
+
+
+@dataclass(frozen=True)
 class Select(Node):
     items: Tuple[SelectItem, ...]          # empty = SELECT *
     relations: Tuple[Node, ...]            # TableRef/SubqueryRef/JoinItem
@@ -159,3 +178,5 @@ class Select(Node):
     limit: Optional[int]
     distinct: bool = False
     select_star: bool = False
+    #: groupby | rollup | cube (GROUP BY ROLLUP(...)/CUBE(...))
+    group_mode: str = "groupby"

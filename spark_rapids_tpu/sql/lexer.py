@@ -24,8 +24,15 @@ KEYWORDS = {
     "null", "case", "when", "then", "else", "end", "join", "inner", "left",
     "right", "full", "outer", "cross", "semi", "anti", "on", "distinct",
     "asc", "desc", "union", "all", "date", "interval", "extract", "cast",
-    "substring", "true", "false", "for",
+    "substring", "true", "false", "for", "over", "partition", "rows",
+    "unbounded", "preceding", "following", "current", "row", "rollup",
+    "cube", "range",
 }
+
+#: window/grouping words are NON-reserved (Spark keeps them usable as
+#: identifiers): the parser falls back to identifier where one is expected
+SOFT_KEYWORDS = {"over", "partition", "rows", "unbounded", "preceding",
+                 "following", "current", "row", "rollup", "cube", "range"}
 
 _OPS = ["<>", "!=", ">=", "<=", "||", "=", "<", ">", "(", ")", ",", "+",
         "-", "*", "/", ".", "%"]

@@ -106,11 +106,16 @@ class Parser:
                 relations.append(A.JoinItem(how, rel, cond))
         where = self.expr() if self.eat_kw("where") else None
         group_by: List[A.Node] = []
+        group_mode = "groupby"
         if self.eat_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
-            while self.eat_op(","):
-                group_by.append(self.expr())
+            if self.at_kw("rollup", "cube"):
+                group_mode = self.next().value
+                self.expect_op("(")
+                group_by.extend(self._expr_list())
+                self.expect_op(")")
+            else:
+                group_by.extend(self._expr_list())
         having = self.expr() if self.eat_kw("having") else None
         order_by: List[A.OrderItem] = []
         if self.eat_kw("order"):
@@ -133,7 +138,77 @@ class Parser:
             limit = int(t.value)
         return A.Select(tuple(items), tuple(relations), where,
                         tuple(group_by), having, tuple(order_by), limit,
-                        distinct, select_star)
+                        distinct, select_star, group_mode)
+
+    def _window_spec(self) -> A.WindowSpecNode:
+        """OVER ( [PARTITION BY e,...] [ORDER BY e [ASC|DESC],...]
+        [ROWS|RANGE [BETWEEN bound AND bound | bound]] )"""
+        self.expect_kw("over")
+        self.expect_op("(")
+        part: List[A.Node] = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            part = self._expr_list()
+        orders: List[A.OrderItem] = []
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.eat_kw("desc"):
+                    asc = False
+                else:
+                    self.eat_kw("asc")
+                orders.append(A.OrderItem(e, asc))
+                if not self.eat_op(","):
+                    break
+        ftype = None
+        lower = upper = None
+        if self.at_kw("rows", "range"):
+            ftype = self.next().value
+            pos = self.peek().pos
+            if self.eat_kw("between"):
+                lo = self._frame_bound()
+                self.expect_kw("and")
+                hi = self._frame_bound()
+            else:
+                lo = self._frame_bound()
+                hi = 0          # single-bound form: .. AND CURRENT ROW
+                if lo == "ub_fol" or (isinstance(lo, (int, float))
+                                      and lo > 0):
+                    raise SqlError(
+                        f"a single frame bound must be PRECEDING or "
+                        f"CURRENT ROW (at {pos})")
+            if lo == "ub_fol" or hi == "ub_pre":
+                raise SqlError(f"inverted frame direction at {pos}")
+            lower = None if lo == "ub_pre" else lo
+            upper = None if hi == "ub_fol" else hi
+            if isinstance(lower, (int, float)) and \
+                    isinstance(upper, (int, float)) and lower > upper:
+                raise SqlError(f"frame lower bound exceeds upper at {pos}")
+        self.expect_op(")")
+        return A.WindowSpecNode(tuple(part), tuple(orders), ftype, lower,
+                                upper)
+
+    def _frame_bound(self):
+        """'ub_pre'/'ub_fol' for unbounded; 0 = current row; negative =
+        preceding, positive = following (floats allowed for RANGE)."""
+        if self.eat_kw("unbounded"):
+            if self.eat_kw("preceding"):
+                return "ub_pre"
+            self.expect_kw("following")
+            return "ub_fol"
+        if self.eat_kw("current"):
+            self.expect_kw("row")
+            return 0
+        t = self.next()
+        if t.kind != "NUMBER":
+            raise SqlError(f"expected frame bound at {t.pos}")
+        n = float(t.value) if "." in t.value else int(t.value)
+        if self.eat_kw("preceding"):
+            return -n
+        self.expect_kw("following")
+        return n
 
     def _join_kind(self) -> Optional[str]:
         if self.at_kw("join"):
@@ -175,8 +250,17 @@ class Parser:
             alias = self._ident()
         return A.TableRef(name, alias)
 
+    def _expr_list(self) -> list:
+        out = [self.expr()]
+        while self.eat_op(","):
+            out.append(self.expr())
+        return out
+
     def _ident(self) -> str:
+        from spark_rapids_tpu.sql.lexer import SOFT_KEYWORDS
         t = self.next()
+        if t.kind == "KEYWORD" and t.value in SOFT_KEYWORDS:
+            return t.value  # non-reserved word used as an identifier
         if t.kind != "IDENT":
             raise SqlError(f"expected identifier, got {t.value!r} at {t.pos}")
         return t.value
@@ -355,21 +439,29 @@ class Parser:
                 if self.at_op("*"):
                     self.next()
                     self.expect_op(")")
-                    return A.FuncCall(name.lower(), (), distinct, star=True)
-                if self.at_op(")"):
+                    call = A.FuncCall(name.lower(), (), distinct, star=True)
+                elif self.at_op(")"):
                     self.next()
-                    return A.FuncCall(name.lower(), (), distinct)
-                args = [self.expr()]
-                while self.eat_op(","):
-                    args.append(self.expr())
-                self.expect_op(")")
-                return A.FuncCall(name.lower(), tuple(args), distinct)
+                    call = A.FuncCall(name.lower(), (), distinct)
+                else:
+                    args = [self.expr()]
+                    while self.eat_op(","):
+                        args.append(self.expr())
+                    self.expect_op(")")
+                    call = A.FuncCall(name.lower(), tuple(args), distinct)
+                if self.at_kw("over"):
+                    return A.WindowFuncCall(call, self._window_spec())
+                return call
             # qualified column a.b
             if self.at_op(".") and self.peek(1).kind == "IDENT":
                 self.next()
                 col = self._ident()
                 return A.ColRef(col, qualifier=name)
             return A.ColRef(name)
+        from spark_rapids_tpu.sql.lexer import SOFT_KEYWORDS
+        if t.kind == "KEYWORD" and t.value in SOFT_KEYWORDS:
+            self.next()
+            return A.ColRef(t.value)
         raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
 
     def _case(self) -> A.Node:

@@ -118,6 +118,12 @@ def _has_subquery(node: A.Node) -> bool:
 def _has_agg(node: A.Node) -> bool:
     if isinstance(node, A.FuncCall) and node.name in _AGGS:
         return True
+    if isinstance(node, A.WindowFuncCall):
+        # the window's own function is not a query aggregate, but aggregates
+        # in its ARGS or SPEC are (rank() OVER (ORDER BY sum(v)) without
+        # GROUP BY is a global aggregate) — mirror collect()
+        return any(_has_agg(a) for a in node.func.args) or \
+            _has_agg(node.spec)
     if isinstance(node, (A.ScalarSubquery, A.ExistsSubquery, A.InSubquery)):
         return False
     for f in getattr(node, "__dataclass_fields__", {}):
@@ -221,6 +227,8 @@ def to_column(node: A.Node, scope: Scope) -> Column:
         return ~c if node.op == "not" else -c
     if isinstance(node, A.FuncCall):
         return _func(node, scope)
+    if isinstance(node, A.WindowFuncCall):
+        return _window_func(node, scope)
     if isinstance(node, A.CaseWhen):
         w = None
         for cond, val in node.branches:
@@ -374,6 +382,59 @@ def _func(node: A.FuncCall, scope: Scope) -> Column:
     if name in _FUNCS:
         return _FUNCS[name]([to_column(a, scope) for a in node.args])
     raise SqlError(f"unknown function {name!r}")
+
+
+_WINDOW_FUNCS = {"row_number": "row_number", "rank": "rank",
+                 "dense_rank": "dense_rank", "percent_rank": "percent_rank",
+                 "cume_dist": "cume_dist"}
+
+
+def _window_func(node: A.WindowFuncCall, scope: Scope) -> Column:
+    """fn(...) OVER (...) -> the api window machinery (WindowExpression is
+    then extracted into an lp.Window by DataFrame.select, Catalyst's
+    ExtractWindowExpressions shape)."""
+    from spark_rapids_tpu.api.window import WindowSpec
+    from spark_rapids_tpu.exprs.misc import SortOrder
+    from spark_rapids_tpu.exprs.windows import WindowFrame
+
+    sp = node.spec
+    part = tuple(to_column(e, scope).expr for e in sp.partition_by)
+    orders = tuple(
+        SortOrder(to_column(o.expr, scope).expr, o.ascending, o.ascending)
+        for o in sp.order_by)
+    frame = (WindowFrame(sp.frame_type, sp.frame_lower, sp.frame_upper)
+             if sp.frame_type is not None else None)
+    spec = WindowSpec(part, orders, frame)
+
+    f = node.func
+    if not isinstance(f, A.FuncCall):
+        raise SqlError(
+            "the aggregate under OVER also appears as a plain aggregate; "
+            "alias the plain aggregate and window over the alias instead")
+    if f.name in _WINDOW_FUNCS:
+        fn = getattr(F, _WINDOW_FUNCS[f.name])()
+    elif f.name == "ntile":
+        if len(f.args) != 1 or not isinstance(f.args[0], A.Lit):
+            raise SqlError("ntile(n) needs an integer literal")
+        fn = F.ntile(int(f.args[0].value))
+    elif f.name in ("lead", "lag"):
+        arg = to_column(f.args[0], scope)
+        offset = 1
+        default = None
+        if len(f.args) > 1:
+            if not isinstance(f.args[1], A.Lit):
+                raise SqlError(f"{f.name} offset must be a literal")
+            offset = int(f.args[1].value)
+        if len(f.args) > 2:
+            if not isinstance(f.args[2], A.Lit):
+                raise SqlError(f"{f.name} default must be a literal")
+            default = f.args[2].value
+        fn = (F.lead if f.name == "lead" else F.lag)(arg, offset, default)
+    elif f.name in _AGGS:
+        fn = _func(f, scope)
+    else:
+        raise SqlError(f"unknown window function {f.name!r}")
+    return fn.over(spec)
 
 
 # ---------------------------------------------------------------------------
@@ -683,7 +744,8 @@ class SqlPlanner:
             stmt, inner_scope, outer_scope)
         inner_stmt = A.Select(
             stmt.items, stmt.relations, _and_all(inner_conjs), stmt.group_by,
-            stmt.having, (), None, stmt.distinct, stmt.select_star)
+            stmt.having, (), None, stmt.distinct, stmt.select_star,
+            stmt.group_mode)
         sub_df, scope2 = self._plan_from_where(inner_stmt)
         return sub_df, scope2, eq_pairs, other
 
@@ -935,6 +997,14 @@ class SqlPlanner:
         aggs: Dict[A.Node, str] = {}
 
         def collect(n):
+            if isinstance(n, A.WindowFuncCall):
+                # the window's own function is evaluated post-aggregation;
+                # only aggregates INSIDE it (its args / its spec) are query
+                # aggregates needing hidden columns
+                for a in n.func.args:
+                    collect(a)
+                collect(n.spec)
+                return
             if isinstance(n, A.FuncCall) and n.name in _AGGS:
                 if n not in aggs:
                     aggs[n] = self._name("a")
@@ -963,8 +1033,12 @@ class SqlPlanner:
 
         agg_cols = [to_column(ast, scope).alias(name)
                     for ast, name in aggs.items()]
-        grouped = df.groupBy(*key_cols).agg(*agg_cols) if key_cols else \
-            df.agg(*agg_cols)
+        if key_cols:
+            by = {"groupby": df.groupBy, "rollup": df.rollup,
+                  "cube": df.cube}[stmt.group_mode]
+            grouped = by(*key_cols).agg(*agg_cols)
+        else:
+            grouped = df.agg(*agg_cols)
 
         # 3. post-agg scope: group columns stay addressable by qualified or
         # plain name, agg results by their hidden names

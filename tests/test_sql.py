@@ -190,3 +190,87 @@ def test_sql_corr_covar(mini):
         "select corr(k, v) as c, covar_pop(k, v) as cp from t "
         "where v is not null").collect()
     assert got.num_rows == 1 and got.column("c")[0].as_py() is not None
+
+
+# ----------------------------------------------------------- windows & rollup
+def test_sql_window_functions():
+    """ROW_NUMBER/RANK/SUM OVER (PARTITION BY ... ORDER BY ...) through the
+    SQL frontend must match the DataFrame window API."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, Window
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+    rng = np.random.default_rng(101)
+    t = pa.table({"k": rng.integers(0, 6, 500).astype(np.int64),
+                  "v": rng.integers(0, 1000, 500).astype(np.int64)})
+    s = TpuSession()
+    s.create_dataframe(t).createOrReplaceTempView("t")
+    out = s.sql(
+        "select k, v, row_number() over (partition by k order by v, v + k)"
+        " as rn, rank() over (partition by k order by v) as rk,"
+        " sum(v) over (partition by k order by v"
+        "              rows between unbounded preceding and current row)"
+        " as rsum, lag(v, 1) over (partition by k order by v, v * 2) as pv"
+        " from t").collect()
+    w = Window.partitionBy("k").orderBy("v", (F.col("v") + F.col("k")))
+    wr = Window.partitionBy("k").orderBy("v")
+    ws = Window.partitionBy("k").orderBy("v").rowsBetween(
+        Window.unboundedPreceding, Window.currentRow)
+    wl = Window.partitionBy("k").orderBy("v", (F.col("v") * 2))
+    exp = s.create_dataframe(t).select(
+        "k", "v",
+        F.row_number().over(w).alias("rn"),
+        F.rank().over(wr).alias("rk"),
+        F.sum("v").over(ws).alias("rsum"),
+        F.lag("v", 1).over(wl).alias("pv")).collect()
+    assert_tables_equal(exp, out, ignore_order=True)
+
+
+def test_sql_window_over_aggregate():
+    """rank() OVER (ORDER BY sum(x)) after GROUP BY — the windows-after-
+    aggregation shape TPC-DS leans on (q67-class)."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, Window
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+    rng = np.random.default_rng(103)
+    t = pa.table({"g": rng.integers(0, 10, 400).astype(np.int64),
+                  "b": rng.integers(0, 3, 400).astype(np.int64),
+                  "v": rng.integers(0, 100, 400).astype(np.int64)})
+    s = TpuSession()
+    s.create_dataframe(t).createOrReplaceTempView("t2")
+    out = s.sql(
+        "select g, b, sum(v) as sv,"
+        " rank() over (partition by b order by sum(v) desc) as rk"
+        " from t2 group by g, b").collect()
+    w = Window.partitionBy("b").orderBy(F.col("sv").desc())
+    exp = (s.create_dataframe(t).groupBy("g", "b")
+           .agg(F.sum("v").alias("sv"))
+           .select("g", "b", "sv", F.rank().over(w).alias("rk"))).collect()
+    assert_tables_equal(exp, out, ignore_order=True)
+
+
+def test_sql_rollup_and_cube():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+    rng = np.random.default_rng(107)
+    t = pa.table({"a": rng.integers(0, 4, 300).astype(np.int64),
+                  "b": rng.integers(0, 3, 300).astype(np.int64),
+                  "v": rng.integers(0, 50, 300).astype(np.int64)})
+    s = TpuSession()
+    s.create_dataframe(t).createOrReplaceTempView("t3")
+    out = s.sql("select a, b, sum(v) as sv, count(v) as c from t3"
+                " group by rollup(a, b)").collect()
+    exp = (s.create_dataframe(t).rollup("a", "b")
+           .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))).collect()
+    assert_tables_equal(exp, out, ignore_order=True)
+    out_c = s.sql("select a, b, max(v) as mv from t3"
+                  " group by cube(a, b)").collect()
+    exp_c = (s.create_dataframe(t).cube("a", "b")
+             .agg(F.max("v").alias("mv"))).collect()
+    assert_tables_equal(exp_c, out_c, ignore_order=True)
