@@ -162,6 +162,12 @@ class DataFrame:
                "leftanti": "left_anti", "anti": "left_anti",
                "leftouter": "left", "rightouter": "right",
                "outer": "full", "fullouter": "full"}.get(how, how)
+        if isinstance(on, Column):
+            # pyspark's df.join(other, df.a == other.b) equality form:
+            # conjunctions of EqualTo over plain column refs become key
+            # pairs; anything else needs the explicit pair form (list(on)
+            # on a Column would loop forever through getItem)
+            on = _column_condition_to_pairs(on.expr)
         raw = [on] if isinstance(on, str) else list(on)
         if any(isinstance(k, tuple) for k in raw):
             if not all(isinstance(k, tuple) for k in raw):
@@ -403,6 +409,32 @@ class DataFrameWriter:
 
     def csv(self, path: str):
         return self._save("csv", path)
+
+
+def _column_condition_to_pairs(e) -> List[tuple]:
+    """EqualTo conjunctions over column refs -> [(left_name, right_name)...];
+    raises a clear TypeError for anything richer."""
+    from spark_rapids_tpu.exprs.predicates import And, EqualTo
+    from spark_rapids_tpu.exprs.core import BoundReference
+
+    def name_of(x):
+        if isinstance(x, UnresolvedAttribute):
+            return x.name
+        if isinstance(x, BoundReference) and x.ref_name:
+            return x.ref_name
+        return None
+
+    if isinstance(e, And):
+        return (_column_condition_to_pairs(e.l)
+                + _column_condition_to_pairs(e.r))
+    if isinstance(e, EqualTo):
+        a, b = name_of(e.l), name_of(e.r)
+        if a and b:
+            return [(a, b)]
+    raise TypeError(
+        "join(on=Column) supports only equality conjunctions of plain "
+        "columns (df.a == other.b [& ...]); use string keys or "
+        "(left, right) pairs otherwise")
 
 
 def _iter_execs(plan: PhysicalExec):

@@ -180,3 +180,6 @@ class Select(Node):
     select_star: bool = False
     #: groupby | rollup | cube (GROUP BY ROLLUP(...)/CUBE(...))
     group_mode: str = "groupby"
+    #: WITH clause: (name, query) in definition order (non-recursive; later
+    #: CTEs may reference earlier ones)
+    ctes: Tuple[Tuple[str, "Select"], ...] = ()

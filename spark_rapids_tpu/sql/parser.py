@@ -490,9 +490,23 @@ class Parser:
 
 
 def parse_sql(text: str) -> A.Select:
+    import dataclasses
     p = Parser(text)
+    ctes = []
+    if p.eat_kw("with"):
+        while True:
+            name = p._ident().lower()
+            p.eat_kw("as")
+            p.expect_op("(")
+            q = p.parse_select()
+            p.expect_op(")")
+            ctes.append((name, q))
+            if not p.eat_op(","):
+                break
     stmt = p.parse_select()
     if p.peek().kind != "EOF":
         t = p.peek()
         raise SqlError(f"trailing input at {t.pos}: {t.value!r}")
+    if ctes:
+        stmt = dataclasses.replace(stmt, ctes=tuple(ctes))
     return stmt

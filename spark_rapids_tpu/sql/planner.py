@@ -453,6 +453,10 @@ class SqlPlanner:
     def __init__(self, session):
         self.session = session
         self._hidden = 0
+        #: WITH-clause bindings: name -> (planned DataFrame, output names);
+        #: planned lazily on first reference, shared across references
+        self._ctes: Dict[str, A.Select] = {}
+        self._cte_plans: Dict[str, tuple] = {}
 
     def _name(self, stem: str) -> str:
         self._hidden += 1
@@ -461,6 +465,17 @@ class SqlPlanner:
     # ---- entry -------------------------------------------------------------
     def plan(self, stmt: A.Select, outer: Optional[Scope] = None):
         """Plan one SELECT. Returns (DataFrame, output column names)."""
+        for name, q in stmt.ctes:
+            self._ctes[name] = q     # later CTEs may reference earlier ones
+        if not stmt.relations:
+            # FROM-less SELECT (constants): plan over a one-row dummy
+            # relation (Spark's OneRowRelation)
+            import pyarrow as pa
+            one = self.session.create_dataframe(
+                pa.table({"__one": pa.array([1], pa.int64())}))
+            rels = [_Rel("__one_row", one, ["__one"])]
+            scope = Scope([])
+            return self._project_phase(stmt, one, scope, outer)
         rels = self._relations(stmt)
         scope = Scope([(r.alias, r.raw_cols) for r in rels])
 
@@ -512,7 +527,22 @@ class SqlPlanner:
             rels.append(self._load_relation(rel))
         return rels
 
+    def _cte(self, name: str):
+        """Planned (df, names) of a WITH binding, cached per statement so
+        every reference shares one logical subtree (exchange reuse)."""
+        key = name.lower()
+        if key not in self._cte_plans:
+            q = self._ctes[key]
+            self._cte_plans[key] = self.plan(q)
+        return self._cte_plans[key]
+
     def _load_relation(self, rel: A.Node) -> _Rel:
+        if isinstance(rel, A.TableRef) and rel.name.lower() in self._ctes:
+            sub, out_names = self._cte(rel.name)
+            alias = rel.alias or rel.name
+            pref = sub.select(*[col(c).alias(f"{alias}.{c}")
+                                for c in out_names])
+            return _Rel(alias, pref, out_names)
         if isinstance(rel, A.TableRef):
             df = self.session.table(rel.name)
             alias = rel.alias or rel.name
@@ -762,6 +792,8 @@ class SqlPlanner:
 
     def _relation_cols(self, rel: A.Node) -> List[str]:
         if isinstance(rel, A.TableRef):
+            if rel.name.lower() in self._ctes:
+                return list(self._cte(rel.name)[1])
             return list(self.session.table(rel.name).columns)
         if isinstance(rel, A.SubqueryRef):
             # output names of the derived table (plan-time only, no exec)

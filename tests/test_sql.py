@@ -274,3 +274,66 @@ def test_sql_rollup_and_cube():
     exp_c = (s.create_dataframe(t).cube("a", "b")
              .agg(F.max("v").alias("mv"))).collect()
     assert_tables_equal(exp_c, out_c, ignore_order=True)
+
+
+def test_sql_with_ctes():
+    """WITH clause: chained CTEs, multiple references, view shadowing."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+    rng = np.random.default_rng(109)
+    t = pa.table({"k": rng.integers(0, 8, 600).astype(np.int64),
+                  "v": rng.integers(0, 100, 600).astype(np.int64)})
+    s = TpuSession()
+    s.create_dataframe(t).createOrReplaceTempView("base")
+    out = s.sql(
+        "with sums as (select k, sum(v) as sv from base group by k),"
+        "     big as (select k, sv from sums where sv > 3000)"
+        " select a.k, a.sv, b.sv as sv2 from big a join big b on a.k = b.k"
+        " order by a.k").collect()
+    df = s.create_dataframe(t).groupBy("k").agg(F.sum("v").alias("sv")) \
+         .filter(F.col("sv") > 3000)
+    exp = (df.select(F.col("k"), F.col("sv"))
+             .join(df.select(F.col("k").alias("k2"),
+                             F.col("sv").alias("sv2")),
+                   F.col("k") == F.col("k2"))
+             .select("k", "sv", "sv2").sort("k")).collect()
+    assert_tables_equal(exp, out)
+    # a CTE shadows a same-named view
+    s.create_dataframe(pa.table({"x": pa.array([1], pa.int64())})) \
+     .createOrReplaceTempView("shadow")
+    got = s.sql("with shadow as (select 2 as x) "
+                "select x from shadow").collect()
+    assert got.column("x").to_pylist() == [2]
+
+
+def test_sql_cte_with_window_rollup_combo():
+    """A TPC-DS-shaped statement: CTE -> rollup -> window over aggregate."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.testing import assert_tables_equal
+    rng = np.random.default_rng(113)
+    t = pa.table({"cat": rng.integers(0, 4, 800).astype(np.int64),
+                  "brand": rng.integers(0, 5, 800).astype(np.int64),
+                  "sales": rng.integers(1, 500, 800).astype(np.int64)})
+    s = TpuSession()
+    s.create_dataframe(t).createOrReplaceTempView("sales_t")
+    out = s.sql(
+        "with results as ("
+        "  select cat, brand, sum(sales) as s"
+        "  from sales_t group by rollup(cat, brand))"
+        " select cat, brand, s,"
+        "        rank() over (partition by cat order by s desc) as rk"
+        " from results order by cat, rk, brand").collect()
+    assert out.num_rows > 0
+    # spot-check: within each cat, rk follows descending s
+    rows = out.to_pylist()
+    by_cat = {}
+    for r in rows:
+        by_cat.setdefault(r["cat"], []).append(r)
+    for cat, rs in by_cat.items():
+        svals = [r["s"] for r in sorted(rs, key=lambda r: r["rk"])]
+        assert svals == sorted(svals, reverse=True), (cat, svals)
