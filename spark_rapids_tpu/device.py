@@ -20,11 +20,34 @@ jax.config.update("jax_enable_x64", True)
 # restarts (measured ~20x on repeated first-compiles over the remote-chip
 # tunnel, where a single variadic-sort program can take minutes to build).
 # Opt out with SPARK_RAPIDS_TPU_COMPILE_CACHE=off; relocate with =<dir>.
+#
+# The directory is keyed by a HOST-CPU signature: XLA:CPU entries embed AOT
+# machine features, and deserializing one compiled under a different
+# feature set (e.g. a remote compile helper) can SIGSEGV outright — a
+# heterogeneous fleet must never share one cache directory.
+
+
+def _host_cpu_sig() -> str:
+    import hashlib
+    import platform
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(
+        (platform.machine() + flags).encode()).hexdigest()[:10]
+
+
 _cache = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE", "")
 if _cache.lower() != "off":
     if not _cache:
         _cache = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".jax_cache")
+            os.path.abspath(__file__))), f".jax_cache-{_host_cpu_sig()}")
     try:
         os.makedirs(_cache, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache)

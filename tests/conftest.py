@@ -31,3 +31,17 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
     return devs
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled-executable memory between test modules: one process
+    accumulates thousands of XLA programs across the suite, and LLVM
+    compiles near the end of the run can die under that heap pressure.
+    The persistent on-disk cache keeps recompiles cheap."""
+    yield
+    import jax
+    jax.clear_caches()
+    from spark_rapids_tpu.execs import tpu_execs, evaluator
+    tpu_execs._JIT_CACHE.clear() if hasattr(tpu_execs, "_JIT_CACHE") else None
+    evaluator._JIT_CACHE.clear()
