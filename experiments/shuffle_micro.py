@@ -1,0 +1,225 @@
+"""Microbenchmarks for the shuffle reorder redesign (round 4).
+
+Measures the primitives that bound any partition-reorder design on this
+chip, so the kernel architecture is chosen from data:
+
+  copy      — pure HBM streaming bound (elementwise copy of the batch)
+  sortg     — global variadic sort (the round-3 kernel's cost model)
+  sortw     — windowed sort: lax.sort over (windows, W) batch dims
+  gather    — row gather rate vs row width (the 75M rows/s claim)
+  bgather   — block gather: (cap/B, B*L) reshaped row gather
+  cumsum    — windowed rank computation (n one-hot cumsums over pids)
+  taw       — take_along_axis within windows (3D row-granular spread)
+
+Usage: python experiments/shuffle_micro.py copy sortg sortw ...
+"""
+import builtins
+import functools
+import sys
+import time
+
+print = functools.partial(builtins.print, flush=True)
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+
+def sync(x):
+    leaf = jax.tree_util.tree_leaves(x)[-1]
+    np.asarray(leaf.ravel()[:1])
+    return x
+
+
+def timeit(fn, *args, iters=5):
+    res = sync(fn(*args))          # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        res = fn(*args)
+    sync(res)
+    return (time.perf_counter() - t0) / iters
+
+
+CAP = 8 * 1024 * 1024          # rows, the Q1 bucket
+N_OPS = 10                     # u64 payload operands ≈ 640 MB batch
+
+
+def make_payloads(k=N_OPS, cap=CAP):
+    # generated ON DEVICE: host->device uploads over the tunnel would
+    # dominate the benchmark setup
+    @jax.jit
+    def gen():
+        i = jnp.arange(cap, dtype=jnp.uint64)
+        return tuple((i * np.uint64(0x9E3779B97F4A7C15) + np.uint64(j))
+                     for j in range(k))
+    return list(sync(gen()))
+
+
+def make_pids(cap=CAP, n=8):
+    @jax.jit
+    def gen():
+        i = jnp.arange(cap, dtype=jnp.uint32)
+        h = (i * np.uint32(0x85EBCA6B)) ^ (i >> np.uint32(13))
+        return (h % np.uint32(n)).astype(jnp.int32)
+    return sync(gen())
+
+
+def bench_copy():
+    ps = make_payloads()
+
+    @jax.jit
+    def f(*ops):
+        return tuple(o + np.uint64(1) for o in ops)
+
+    dt = timeit(f, *ps)
+    gb = N_OPS * CAP * 8 / 1e9
+    print(f"copy: {dt*1e3:.1f} ms  {gb/dt:.1f} GB/s (r+w {2*gb/dt:.1f})")
+
+
+def bench_sortg():
+    ps = make_payloads()
+    pid = make_pids()
+
+    @jax.jit
+    def f(k, *ops):
+        return jax.lax.sort((k,) + ops, num_keys=1, is_stable=True)
+
+    dt = timeit(f, pid, *ps)
+    gb = N_OPS * CAP * 8 / 1e9
+    print(f"sortg[{N_OPS} ops]: {dt*1e3:.1f} ms  {gb/dt:.2f} GB/s payload")
+
+    @jax.jit
+    def f1(k, o):
+        return jax.lax.sort((k, o), num_keys=1, is_stable=True)
+
+    dt1 = timeit(f1, pid, ps[0])
+    print(f"sortg[1 op]: {dt1*1e3:.1f} ms")
+
+
+def bench_sortw():
+    ps = make_payloads()
+    pid = make_pids()
+    for W in (512, 2048, 8192, 65536):
+        wn = CAP // W
+        k2 = pid.reshape(wn, W)
+        ops2 = tuple(p.reshape(wn, W) for p in ps)
+
+        @jax.jit
+        def f(k, *ops):
+            return jax.lax.sort((k,) + ops, num_keys=1, is_stable=True,
+                                dimension=1)
+
+        dt = timeit(f, k2, *ops2)
+        gb = N_OPS * CAP * 8 / 1e9
+        print(f"sortw[W={W}]: {dt*1e3:.1f} ms  {gb/dt:.2f} GB/s payload")
+
+
+def _device_matrix(rows, L):
+    @jax.jit
+    def gen():
+        i = jnp.arange(rows, dtype=jnp.int32)[:, None]
+        j = jnp.arange(L, dtype=jnp.int32)[None, :]
+        return i * np.int32(2654435761) + j
+    return sync(gen())
+
+
+def _device_perm(n):
+    """Pseudo-random permutation on device: sort random keys, carry iota."""
+    @jax.jit
+    def gen():
+        i = jnp.arange(n, dtype=jnp.uint32)
+        key = i * np.uint32(0x9E3779B9) ^ (i >> np.uint32(16))
+        _, perm = jax.lax.sort((key, i.astype(jnp.int32)), num_keys=1)
+        return perm
+    return sync(gen())
+
+
+def bench_gather():
+    for L in (8, 32, 128, 256):
+        rows = CAP // 8                 # 1M rows to keep it quick
+        m = _device_matrix(rows, L)
+        idx = _device_perm(rows)
+
+        @jax.jit
+        def f(mm, ii):
+            return jnp.take(mm, ii, axis=0)
+
+        dt = timeit(f, m, idx)
+        print(f"gather[L={L}]: {dt*1e3:.1f} ms  {rows/dt/1e6:.1f} Mrows/s  "
+              f"{rows*L*4/dt/1e9:.1f} GB/s")
+
+
+def bench_bgather():
+    L = 28                      # i32 lanes per row (Q1-ish)
+    for B in (8, 16, 32):
+        blocks = CAP // B
+        m = _device_matrix(blocks, B * L)
+        idx = _device_perm(blocks)
+
+        @jax.jit
+        def f(mm, ii):
+            return jnp.take(mm, ii, axis=0)
+
+        dt = timeit(f, m, idx)
+        print(f"bgather[B={B}]: {dt*1e3:.1f} ms  {blocks/dt/1e6:.1f} "
+              f"Mblk/s  {CAP*L*4/dt/1e9:.1f} GB/s")
+
+
+def bench_cumsum():
+    pid = make_pids()
+    n = 8
+    for W in (512, 2048, 8192):
+        wn = CAP // W
+        p2 = pid.reshape(wn, W)
+
+        @jax.jit
+        def f(p):
+            rank = jnp.zeros_like(p)
+            counts = []
+            for j in range(n):
+                oh = (p == j).astype(jnp.int32)
+                cs = jnp.cumsum(oh, axis=1)
+                rank = jnp.where(p == j, cs - 1, rank)
+                counts.append(cs[:, -1])
+            return rank, jnp.stack(counts, axis=1)
+
+        dt = timeit(f, p2)
+        print(f"cumsum[W={W}]: {dt*1e3:.1f} ms")
+
+
+def bench_taw():
+    L = 28
+    for W in (512, 2048):
+        wn = CAP // W
+        m = sync(jax.jit(lambda: _device_matrix(CAP, L).reshape(wn, W, L))())
+
+        @jax.jit
+        def gen_idx():
+            i = jnp.arange(W, dtype=jnp.uint32)[None, :]
+            w = jnp.arange(wn, dtype=jnp.uint32)[:, None]
+            key = (i * np.uint32(0x9E3779B9) + w * np.uint32(40503)) \
+                & np.uint32(0xFFFFFF)
+            _, perm = jax.lax.sort(
+                (key, jnp.broadcast_to(i.astype(jnp.int32), (wn, W))),
+                num_keys=1, dimension=1)
+            return perm
+        idx = sync(gen_idx())
+
+        @jax.jit
+        def f(mm, ii):
+            return jnp.take_along_axis(mm, ii[:, :, None], axis=1)
+
+        dt = timeit(f, m, idx)
+        print(f"taw[W={W}]: {dt*1e3:.1f} ms  {CAP/dt/1e6:.1f} Mrows/s")
+
+
+def main():
+    which = sys.argv[1:] or ["copy", "sortg"]
+    for name in which:
+        globals()[f"bench_{name}"]()
+
+
+if __name__ == "__main__":
+    main()

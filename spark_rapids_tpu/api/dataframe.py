@@ -275,9 +275,11 @@ class DataFrame:
     def _run_partitions(self, final: PhysicalExec) -> List[pa.Table]:
         from spark_rapids_tpu.memory.device_manager import DeviceManager
         from spark_rapids_tpu import config as _cfg
+        # cluster + adaptive compose: the stage scheduler coalesces reduce
+        # tasks from observed MapStatus sizes (parallel/cluster.py
+        # _coalesce_stage_reads — the GpuCustomShuffleReaderExec role)
         if (self.session.conf.get(_cfg.CLUSTER_EXECUTORS) >= 1
-                and not self.session.conf.get(_cfg.MESH_ENABLED)
-                and not self.session.conf.get(_cfg.ADAPTIVE_ENABLED)):
+                and not self.session.conf.get(_cfg.MESH_ENABLED)):
             from spark_rapids_tpu.parallel.cluster import cluster_scheduler_for
             tables = cluster_scheduler_for(self.session).run(final)
             if tables is not None:

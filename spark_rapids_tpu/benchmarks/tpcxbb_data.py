@@ -88,6 +88,13 @@ def gen_warehouse(scale: float, seed: int) -> pa.Table:
     })
 
 
+#: BigBench page taxonomy (the spec's wp_type domain): q4 looks for 'order'
+#: pages without a following 'confirmation', q8 for 'review' pages before a
+#: purchase — cycled so every type exists at every scale
+_PAGE_TYPES = np.array(["ad", "dynamic", "feedback", "general", "order",
+                        "protected", "review", "welcome", "confirmation"])
+
+
 def gen_web_page(scale: float, seed: int) -> pa.Table:
     n = n_web_page(scale)
     rng = np.random.default_rng(seed + 31)
@@ -100,6 +107,7 @@ def gen_web_page(scale: float, seed: int) -> pa.Table:
             "AAAAAAAA", np.char.zfill(sk.astype(str), 8))),
         "wp_char_count": pa.array(chars),
         "wp_link_count": pa.array(rng.integers(2, 25, n).astype(np.int32)),
+        "wp_type": pa.array(_PAGE_TYPES[(sk - 1) % len(_PAGE_TYPES)]),
     })
 
 

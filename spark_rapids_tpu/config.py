@@ -249,6 +249,15 @@ CLUSTER_PROCESS_EXECUTORS = _conf(
     "topology. Requires the TCP shuffle transport; a registry directory is "
     "created automatically when not configured.")
 
+CLUSTER_TASK_SLOTS = _conf(
+    "sql.cluster.taskSlots", int, 4,
+    "Concurrent tasks per cluster executor: a stage fans one task per "
+    "partition and each executor runs up to this many at once, so stage "
+    "wall-clock scales with partitions rather than executors (the "
+    "executor-cores role in Spark's task model). Device admission within "
+    "each executor is still gated by the concurrentTpuTasks semaphore "
+    "(GpuSemaphore.scala:74).", checker=_positive("cluster.taskSlots"))
+
 MESH_AGG_REPARTITION_THRESHOLD = _conf(
     "sql.mesh.aggRepartitionThreshold", int, 8192,
     "Distributed aggregations whose total partial-group count exceeds this "

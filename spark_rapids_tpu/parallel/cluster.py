@@ -60,10 +60,15 @@ class ClusterShuffleReadExec(LeafExec):
         self.stage_index = stage_index
         self.num_parts = num_parts
         self.shuffle_id: Optional[int] = None  # driver assigns pre-pickle
+        #: AQE partition coalescing (GpuCustomShuffleReaderExec.scala:122
+        #: role on the cluster path): when set, consumer partition i reads
+        #: the contiguous exchange partitions ``specs[i]`` — built by the
+        #: driver from OBSERVED MapStatus sizes after the map stage ran
+        self.specs: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     @property
     def num_partitions(self) -> int:
-        return self.num_parts
+        return len(self.specs) if self.specs is not None else self.num_parts
 
     def execute(self, ctx: ExecContext):
         cs = getattr(ctx, "cluster_shuffle", None)
@@ -72,11 +77,14 @@ class ClusterShuffleReadExec(LeafExec):
         tracker.register_shuffle(self.shuffle_id)
         for st in cs.statuses[self.shuffle_id]:
             tracker.register_map_output(self.shuffle_id, st)
-        reader = CachingShuffleReader(cs.env, tracker, self.shuffle_id,
-                                      ctx.partition_id)
-        for batch in reader.read():
-            self.count_output(batch.num_rows)
-            yield batch
+        pids = (self.specs[ctx.partition_id] if self.specs is not None
+                else (ctx.partition_id,))
+        for pid in pids:
+            reader = CachingShuffleReader(cs.env, tracker, self.shuffle_id,
+                                          pid)
+            for batch in reader.read():
+                self.count_output(batch.num_rows)
+                yield batch
 
 
 @dataclass
@@ -260,7 +268,13 @@ class ProcessExecutor:
     the TCP transport and serves tasks over a control socket. Shuffle DATA
     never touches the control plane — it rides the shuffle TCP sockets
     between executor processes (metadata-via-driver, data-P2P, the
-    reference's split)."""
+    reference's split).
+
+    The control protocol is ASYNC: every request carries an id, the daemon
+    runs tasks on its own threads, and a reader thread here routes responses
+    back by id — so N tasks can be in flight per executor at once (the
+    reference's task model: many concurrent tasks per executor, device
+    entry gated by GpuSemaphore, not by the dispatch channel)."""
 
     def __init__(self, executor_id: str, conf: TpuConf):
         self.executor_id = executor_id
@@ -281,31 +295,75 @@ class ProcessExecutor:
         listener.settimeout(60)
         self.sock, _ = listener.accept()
         listener.close()
-        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending: Dict[int, list] = {}    # id -> [Event, response]
+        self._dead = False                     # set when the reader exits
+        self._ids = iter(range(1, 1 << 62))
         _send_msg(self.sock, {"type": "init", "conf": conf})
         resp = _recv_msg(self.sock)
         if resp.get("type") != "ready":
             raise RuntimeError(f"executor {executor_id} failed to start: "
                                f"{resp}")
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"{executor_id}-control-reader")
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                resp = _recv_msg(self.sock)
+                with self._pending_lock:
+                    slot = self._pending.pop(resp.get("id"), None)
+                if slot is not None:
+                    slot[1] = resp
+                    slot[0].set()
+        except (ConnectionError, OSError, EOFError):
+            # executor died / socket closed: fail every in-flight request —
+            # and every FUTURE one (the _dead flag; a send into a half-closed
+            # socket can succeed, so waiting on a response would hang)
+            with self._pending_lock:
+                self._dead = True
+                slots = list(self._pending.values())
+                self._pending.clear()
+            for slot in slots:
+                slot[1] = self._lost_response()
+                slot[0].set()
+
+    def _lost_response(self) -> dict:
+        return {"type": "error",
+                "message": f"executor {self.executor_id} connection lost"}
+
+    def _request(self, msg: dict) -> dict:
+        rid = next(self._ids)
+        slot = [threading.Event(), None]
+        with self._pending_lock:
+            if self._dead:
+                return self._lost_response()
+            self._pending[rid] = slot
+        try:
+            with self._send_lock:
+                _send_msg(self.sock, {**msg, "id": rid})
+        except (ConnectionError, OSError):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            return self._lost_response()
+        slot[0].wait()
+        return slot[1]
 
     def submit(self, spec: _TaskSpec) -> bytes:
-        with self._lock:
-            _send_msg(self.sock, {"type": "task", "spec": spec})
-            resp = _recv_msg(self.sock)
+        resp = self._request({"type": "task", "spec": spec})
         if resp["type"] == "error":
             raise RuntimeError(
                 f"task failed on {self.executor_id}: {resp['message']}")
         return resp["blob"]
 
     def cleanup_shuffle(self, shuffle_id: int) -> None:
-        with self._lock:
-            _send_msg(self.sock, {"type": "cleanup",
-                                  "shuffle_id": shuffle_id})
-            _recv_msg(self.sock)
+        self._request({"type": "cleanup", "shuffle_id": shuffle_id})
 
     def close(self) -> None:
         try:
-            with self._lock:
+            with self._send_lock:
                 _send_msg(self.sock, {"type": "stop"})
             self.sock.close()
         except OSError:
@@ -400,60 +458,86 @@ class ClusterScheduler:
                     except Exception:
                         pass
 
+    def _coalesce_stage_reads(self, stage: _Stage, stages: List[_Stage],
+                              leaves: List[ClusterShuffleReadExec],
+                              root: PhysicalExec) -> None:
+        """AQE partition coalescing on the cluster path: group contiguous
+        small reduce partitions of the stage's dep shuffles into single
+        reduce tasks using the OBSERVED per-partition MapStatus sizes
+        (GpuCustomShuffleReaderExec.scala:122 + coalesceShufflePartitions).
+        All read leaves of one stage get IDENTICAL specs — a co-partitioned
+        join's sides stay aligned, and contiguous grouping preserves
+        range-partition order."""
+        if not leaves or not self.conf.get(cfg.ADAPTIVE_ENABLED):
+            return
+        n = leaves[0].num_parts
+        if n <= 1 or any(lf.num_parts != n for lf in leaves):
+            return
+        sizes = [0] * n
+        for lf in leaves:
+            dep = stages[lf.stage_index]
+            if not dep.statuses:
+                return
+            for st in dep.statuses:
+                for j, s in enumerate(st.partition_sizes):
+                    sizes[j] += s
+        from spark_rapids_tpu.plan.adaptive import coalesce_specs
+        specs = coalesce_specs(
+            sizes, self.conf.get(cfg.ADAPTIVE_ADVISORY_PARTITION_BYTES))
+        if len(specs) >= n:
+            return
+        for lf in leaves:
+            lf.specs = specs
+        # a sibling source with MORE partitions than the coalesced reads
+        # (e.g. a widened file scan under a union) would make the stage fan
+        # past len(specs) and index out of range — coalescing only applies
+        # when the reads govern the stage's partitioning
+        src = root if stage.is_result else root.children[0]
+        if src.num_partitions != len(specs):
+            for lf in leaves:
+                lf.specs = None
+
     def _run_stage(self, stage: _Stage, stages: List[_Stage]) -> None:
+        from spark_rapids_tpu.execs.exchange_execs import RangePartitioning
         # resolve dep shuffle ids into the read leaves, then pickle
         dep_statuses: Dict[int, List[MapStatus]] = {}
+        leaves: List[ClusterShuffleReadExec] = []
 
         def fix(node: PhysicalExec) -> PhysicalExec:
             if isinstance(node, ClusterShuffleReadExec):
                 dep = stages[node.stage_index]
                 node.shuffle_id = dep.shuffle_id
                 dep_statuses[dep.shuffle_id] = dep.statuses
+                leaves.append(node)
             return node
 
         root = stage.root.transform_up(fix)
+        self._coalesce_stage_reads(stage, stages, leaves, root)
+        # task count reflects post-coalesce partitioning (a dep's observed
+        # sizes may have shrunk this stage's input partition count)
+        if stage.is_result:
+            stage.num_tasks = max(1, root.num_partitions)
+            num_source = stage.num_tasks
+        else:
+            num_source = max(1, root.children[0].num_partitions)
+            single_task = isinstance(root.partitioning, RangePartitioning)
+            stage.num_tasks = 1 if single_task else num_source
         try:
             blob = pickle.dumps(root)
         except Exception as e:  # lambda UDFs etc.: hand back to local engine
             raise _Unpicklable(str(e)) from e
-        if stage.is_result:
-            num_source = stage.num_tasks
-        else:
-            num_source = max(1, root.children[0].num_partitions)
-        assignments: List[Tuple[int, List[int]]] = []
-        for i, ex in enumerate(self.executors):
-            parts = list(range(i, stage.num_tasks, len(self.executors)))
-            if parts:
-                assignments.append((i, parts))
 
-        specs = []
-        for i, parts in assignments:
-            specs.append((i, _TaskSpec(
-                kind="result" if stage.is_result else "map",
-                plan_blob=blob, partitions=tuple(parts),
-                num_source_parts=num_source,
-                shuffle_id=stage.shuffle_id,
-                num_reduce_parts=(0 if stage.is_result else
-                                  stage.root.partitioning.num_partitions),
-                dep_statuses=dep_statuses, conf=self.conf)))
+        tasks = [_TaskSpec(
+            kind="result" if stage.is_result else "map",
+            plan_blob=blob, partitions=(p,),
+            num_source_parts=num_source,
+            shuffle_id=stage.shuffle_id,
+            num_reduce_parts=(0 if stage.is_result else
+                              stage.root.partitioning.num_partitions),
+            dep_statuses=dep_statuses, conf=self.conf)
+            for p in range(stage.num_tasks)]
 
-        results: List[Optional[bytes]] = [None] * len(specs)
-        errors: List[Exception] = []
-
-        def run(slot: int, exec_idx: int, spec: _TaskSpec):
-            try:
-                results[slot] = self.executors[exec_idx].submit(spec)
-            except Exception as e:  # surfaced after join
-                errors.append(e)
-
-        threads = [threading.Thread(target=run, args=(s, i, spec))
-                   for s, (i, spec) in enumerate(specs)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        results = self._run_tasks(tasks)
 
         if stage.is_result:
             per_part: List[Tuple[int, bytes]] = []
@@ -470,6 +554,51 @@ class ClusterScheduler:
             for blob_out in results:
                 statuses.extend(pickle.loads(blob_out))
             stage.statuses = statuses
+
+    def _run_tasks(self, tasks: List[_TaskSpec]) -> List[Optional[bytes]]:
+        """Run one stage's tasks across the executors: a shared work queue
+        drained by ``taskSlots`` worker threads per executor, so up to
+        numExecutors * taskSlots tasks are in flight and stage wall-clock
+        scales with partitions, not executors. Errors fail the stage fast
+        (remaining queued tasks are abandoned; Spark's task-retry story is
+        stage re-execution via lineage, SURVEY.md §5)."""
+        import collections
+        # tasks pin to executors round-robin (Spark's locality preference:
+        # an executor's map outputs stay in ITS shuffle catalog, so spreading
+        # map tasks keeps reduce reads mostly local); each executor drains
+        # its queue with `taskSlots` concurrent workers
+        n_ex = len(self.executors)
+        queues = [collections.deque() for _ in range(n_ex)]
+        for idx, spec in enumerate(tasks):
+            queues[idx % n_ex].append((idx, spec))
+        qlock = threading.Lock()
+        results: List[Optional[bytes]] = [None] * len(tasks)
+        errors: List[Exception] = []
+        slots = max(1, self.conf.get(cfg.CLUSTER_TASK_SLOTS))
+
+        def worker(home: int, ex) -> None:
+            while not errors:
+                with qlock:
+                    if not queues[home]:
+                        return
+                    idx, spec = queues[home].popleft()
+                try:
+                    results[idx] = ex.submit(spec)
+                except Exception as e:       # surfaced after join
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, args=(i, ex),
+                                    name=f"task-slot-{i}-{s}")
+                   for i, ex in enumerate(self.executors)
+                   for s in range(min(slots, len(queues[i])))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
 
     def close(self) -> None:
         import shutil

@@ -17,6 +17,7 @@ import os
 import socket
 import sys
 import tempfile
+import threading
 import traceback
 
 
@@ -49,25 +50,41 @@ def main() -> int:
         conf = msg["conf"]
         env = ShuffleEnv(args.executor_id, conf, disk_dir=spill_dir)
         _send_msg(sock, {"type": "ready"})
+
+        # responses interleave across concurrent task threads: serialize the
+        # socket writes; the driver routes them back by id
+        send_lock = threading.Lock()
+
+        def send(obj) -> None:
+            with send_lock:
+                _send_msg(sock, obj)
+
         while True:
             msg = _recv_msg(sock)
             kind = msg["type"]
+            rid = msg.get("id")
             if kind == "stop":
                 return 0
             if kind == "cleanup":
                 env.shuffle_catalog.remove_shuffle(msg["shuffle_id"])
-                _send_msg(sock, {"type": "ok"})
+                send({"type": "ok", "id": rid})
                 continue
             if kind == "task":
-                try:
-                    blob = _run_task(env, msg["spec"])
-                    _send_msg(sock, {"type": "done", "blob": blob})
-                except Exception:
-                    _send_msg(sock, {"type": "error",
-                                     "message": traceback.format_exc()})
+                # one thread per in-flight task (the driver bounds in-flight
+                # tasks to taskSlots per executor; device entry inside the
+                # task is gated by the admission semaphore)
+                def run(spec=msg["spec"], rid=rid) -> None:
+                    try:
+                        blob = _run_task(env, spec)
+                        send({"type": "done", "blob": blob, "id": rid})
+                    except Exception:
+                        send({"type": "error", "id": rid,
+                              "message": traceback.format_exc()})
+
+                threading.Thread(target=run, daemon=True).start()
                 continue
-            _send_msg(sock, {"type": "error",
-                             "message": f"unknown control message {kind!r}"})
+            send({"type": "error", "id": rid,
+                  "message": f"unknown control message {kind!r}"})
     except (ConnectionError, EOFError):
         return 0
     finally:

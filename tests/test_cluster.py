@@ -142,6 +142,96 @@ def test_cluster_range_exchange_sort_order():
     s._cluster_scheduler.close()
 
 
+def test_cluster_aqe_coalesces_skewed_reduce_tasks():
+    """AQE partition coalescing on the cluster path
+    (GpuCustomShuffleReaderExec.scala:122 role): a skewed shuffle whose
+    observed MapStatus sizes show mostly-tiny reduce partitions runs FEWER
+    reduce tasks than partitions, with identical results."""
+    rng = np.random.default_rng(31)
+    n = 30000
+    # heavy skew: ~95% of rows hash to one key, the rest spread thin
+    k = np.where(rng.random(n) < 0.95, 7,
+                 rng.integers(0, 4000, n)).astype(np.int64)
+    t = pa.table({"k": k, "v": rng.integers(-50, 50, n).astype(np.int64)})
+
+    def q(sess):
+        return (sess.create_dataframe(t).repartition(16, "k")
+                .groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("v").alias("c")).sort("k"))
+
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = q(cpu).collect()
+
+    s = TpuSession({
+        **CLUSTER_CONF,
+        "spark.rapids.tpu.sql.adaptive.enabled": "true",
+        # advisory sized so the tiny partitions group but the stage still
+        # runs more than one reduce task
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes":
+            "65536",
+    })
+    out = q(s).collect()
+    assert_tables_equal(exp, out)
+    sched = s._cluster_scheduler
+    try:
+        stages = sched.last_stages
+        # the stage consuming the 16-partition repartition exchange must
+        # have fewer tasks than reduce partitions (observed-size grouping)
+        consumer = stages[1]
+        assert consumer.num_tasks < 16, (
+            f"expected coalesced reduce tasks, got {consumer.num_tasks}")
+        assert consumer.num_tasks >= 1
+    finally:
+        sched.close()
+
+
+def test_cluster_task_slots_run_concurrently():
+    """Per-executor task parallelism: with numExecutors=1 and taskSlots>1, a
+    stage's tasks overlap in time inside the executor (stage parallelism
+    scales with partitions, not executors)."""
+    import threading as _threading
+    import time as _time
+
+    from spark_rapids_tpu.parallel import cluster as cl
+
+    active = {"now": 0, "peak": 0}
+    lock = _threading.Lock()
+    orig = cl._run_task
+
+    def traced(env, spec):
+        with lock:
+            active["now"] += 1
+            active["peak"] = max(active["peak"], active["now"])
+        try:
+            _time.sleep(0.05)      # widen the overlap window
+            return orig(env, spec)
+        finally:
+            with lock:
+                active["now"] -= 1
+
+    rng = np.random.default_rng(37)
+    t = pa.table({"k": rng.integers(0, 500, 20000).astype(np.int64),
+                  "v": rng.integers(0, 100, 20000).astype(np.int64)})
+    s = TpuSession({
+        "spark.rapids.tpu.sql.cluster.numExecutors": "1",
+        "spark.rapids.tpu.sql.cluster.taskSlots": "4",
+    })
+    cl._run_task = traced
+    try:
+        out = (s.create_dataframe(t).repartition(8, "k")
+               .groupBy("k").agg(F.sum("v").alias("sv")).sort("k")).collect()
+    finally:
+        cl._run_task = orig
+        s._cluster_scheduler.close()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.create_dataframe(t).repartition(8, "k")
+           .groupBy("k").agg(F.sum("v").alias("sv")).sort("k")).collect()
+    assert_tables_equal(exp, out)
+    assert active["peak"] >= 2, (
+        f"tasks never overlapped in the single executor: peak="
+        f"{active['peak']}")
+
+
 @pytest.mark.slow
 def test_cluster_two_os_processes_tpch(tmp_path):
     """End-to-end TPC-H query across two OS-process executors: control plane

@@ -329,6 +329,60 @@ def test_range_frame_int64_precision_above_2_53():
     assert out.column("c").to_pylist() == [1, 1]
 
 
+def test_range_frame_date_order_key():
+    """RANGE offsets over a DATE order key, counted in days (the
+    GpuWindowExpression.scala:198-199 aggregateWindowsOverTimeRanges role —
+    order-key domain is the native int32 day count, no float rounding)."""
+    import datetime
+    t = pa.table({
+        "g": ["x"] * 5,
+        "d": pa.array([datetime.date(2020, 1, 1), datetime.date(2020, 1, 2),
+                       datetime.date(2020, 1, 5), datetime.date(2020, 1, 6),
+                       None], type=pa.date32()),
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+    })
+
+    def build(s):
+        w = Window.partitionBy("g").orderBy("d").rangeBetween(-1, 1)
+        return s.create_dataframe(t).select(
+            "d", F.sum("v").over(w).alias("s"))
+    out = assert_tpu_and_cpu_equal(build, conf=CONF)
+    rows = dict(zip(out.column("d").to_pylist(), out.column("s").to_pylist()))
+    # 1/1 and 1/2 are within a day of each other; 1/5 and 1/6 likewise; the
+    # null-keyed row's frame is its (null) peer group only
+    assert rows[datetime.date(2020, 1, 1)] == 3.0
+    assert rows[datetime.date(2020, 1, 2)] == 3.0
+    assert rows[datetime.date(2020, 1, 5)] == 7.0
+    assert rows[datetime.date(2020, 1, 6)] == 7.0
+    assert rows[None] == 5.0
+
+
+def test_range_frame_timestamp_order_key():
+    """RANGE offsets over a TIMESTAMP order key, in microseconds (time-range
+    frames over timestamps, GpuWindowExpression.scala:198-199)."""
+    import datetime
+    ts = [datetime.datetime(2020, 1, 1, 0, 0, s) for s in (0, 1, 2, 3)]
+    t = pa.table({
+        "g": ["x"] * 5,
+        "ts": pa.array(ts + [None], type=pa.timestamp("us")),
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+    })
+
+    def build(s):
+        w = (Window.partitionBy("g").orderBy("ts")
+             .rangeBetween(-1_000_000, 1_000_000))    # ±1 second
+        return s.create_dataframe(t).select(
+            "ts", F.count("v").over(w).alias("c"))
+    out = assert_tpu_and_cpu_equal(build, conf=CONF)
+    # the engine returns UTC-aware timestamps (Spark's UTC-only semantics)
+    keys = [(v.replace(tzinfo=None) if v is not None else None)
+            for v in out.column("ts").to_pylist()]
+    rows = dict(zip(keys, out.column("c").to_pylist()))
+    assert rows[ts[0]] == 2 and rows[ts[1]] == 3
+    assert rows[ts[2]] == 3 and rows[ts[3]] == 2
+    assert rows[None] == 1      # count(v) over the null row's peer frame
+
+
 def test_range_frame_inf_nan_null_keys():
     t = pa.table({"g": ["x"] * 4,
                   "v": pa.array([None, float("-inf"), float("inf"),
