@@ -1,0 +1,246 @@
+"""Probe which primitives lower in Pallas TPU kernels on this backend."""
+import builtins
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print = functools.partial(builtins.print, flush=True)
+
+W, L, Q = 256, 128, 64
+
+
+def probe(name, kernel, out_shape, *args):
+    try:
+        @jax.jit
+        def f(*a):
+            return pl.pallas_call(
+                kernel, out_shape=out_shape,
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)
+                          for _ in args],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(*a)
+        res = np.asarray(f(*args))
+        print(f"PROBE {name}: OK {res.ravel()[:3]}")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:110]
+        print(f"PROBE {name}: FAIL {type(e).__name__} {msg}")
+
+
+d = jnp.asarray(np.arange(W * L, dtype=np.int32).reshape(W, L))
+idx = jnp.asarray((np.arange(Q, dtype=np.int32) * 37) % W)
+v = jnp.asarray(np.arange(W, dtype=np.int32))
+
+probe("take_rows", lambda dr, ir, o: o.__setitem__(
+    slice(None), jnp.take(dr[:], ir[:], axis=0)),
+    jax.ShapeDtypeStruct((Q, L), jnp.int32), d, idx)
+
+probe("take_along0", lambda dr, ir, o: o.__setitem__(
+    slice(None), jnp.take_along_axis(dr[:], ir[:][:, None], axis=0)),
+    jax.ShapeDtypeStruct((Q, L), jnp.int32), d, idx)
+
+probe("assoc_scan", lambda vr, o: o.__setitem__(
+    slice(None), jax.lax.associative_scan(jnp.add, vr[:])),
+    jax.ShapeDtypeStruct((W,), jnp.int32), v)
+
+probe("cumsum2d", lambda dr, o: o.__setitem__(
+    slice(None), jnp.cumsum(dr[:], axis=1)),
+    jax.ShapeDtypeStruct((W, L), jnp.int32), d)
+
+probe("searchsorted", lambda vr, ir, o: o.__setitem__(
+    slice(None), jnp.searchsorted(vr[:], ir[:]).astype(jnp.int32)),
+    jax.ShapeDtypeStruct((Q,), jnp.int32), v, idx)
+
+probe("sort1d", lambda vr, o: o.__setitem__(
+    slice(None), jnp.sort(vr[:])),
+    jax.ShapeDtypeStruct((W,), jnp.int32), v)
+
+# manual log-step prefix sum via roll + iota mask
+def prefix_roll(vr, o):
+    x = vr[:]
+    k = 1
+    while k < W:
+        shifted = pltpu.roll(x, k, 0)
+        keep = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0).squeeze(-1) >= k
+        x = x + jnp.where(keep, shifted, 0)
+        k *= 2
+    o[:] = x
+
+probe("prefix_roll", prefix_roll, jax.ShapeDtypeStruct((W,), jnp.int32), v)
+
+
+# dynamic one-hot from an externally supplied rank vector (no cumsum)
+def onehot_ext(rr, dr, o):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Q, W), 0)
+    oh = (rows == rr[:][None, :]).astype(jnp.int8)
+    o[:] = jax.lax.dot_general(oh, dr[:].astype(jnp.int8),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+rank = jnp.asarray((np.arange(W, dtype=np.int32) * 13) % Q)
+probe("onehot_ext", onehot_ext, jax.ShapeDtypeStruct((Q, L), jnp.int32),
+      rank, d)
+
+
+def probe_dynstore():
+    Q2, L2 = 128, 128
+    quota = 1024
+
+    def mk(align):
+        def kernel(d_ref, b_ref, o_ref):
+            base = b_ref[0]
+            if align:
+                base = pl.multiple_of((base // 8) * 8, 8)
+            o_ref[pl.ds(base, Q2), :] = d_ref[:]
+        return kernel
+
+    d = jnp.asarray(np.arange(Q2 * L2, dtype=np.int32).reshape(Q2, L2))
+    for align, base in ((True, 48), (False, 37)):
+        try:
+            @jax.jit
+            def f(dd, bb):
+                return pl.pallas_call(
+                    mk(align),
+                    out_shape=jax.ShapeDtypeStruct((quota, L2), jnp.int32),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                              pl.BlockSpec(memory_space=pltpu.SMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(dd, bb)
+            res = np.asarray(f(d, jnp.asarray([base], np.int32)))
+            got = res[base if not align else (base // 8) * 8]
+            print(f"PROBE dynstore[align={align}]: OK {got[:2]}")
+        except Exception as e:
+            print(f"PROBE dynstore[align={align}]: FAIL "
+                  f"{type(e).__name__} {str(e).splitlines()[0][:90]}")
+
+
+probe_dynstore()
+
+
+def probe_u8_4d():
+    Q2, L2, quota, n = 128, 112, 1024, 8
+
+    def kernel(d_ref, b_ref, o_ref):
+        base = b_ref[0]
+        for j in range(n):
+            o_ref[j, 0, pl.ds(base, Q2), :] = d_ref[:] + jnp.uint8(j)
+
+    d = jnp.asarray(np.arange(Q2 * L2, dtype=np.int32).reshape(Q2, L2)
+                    .astype(np.uint8))
+    try:
+        @jax.jit
+        def f(dd, bb):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((n, 4, quota, L2), jnp.uint8),
+                grid=(4,),
+                in_specs=[pl.BlockSpec((Q2, L2), lambda g: (0, 0),
+                                       memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pltpu.SMEM)],
+                out_specs=pl.BlockSpec((n, 1, quota, L2),
+                                       lambda g: (0, g, 0, 0),
+                                       memory_space=pltpu.VMEM))(dd, bb)
+        res = np.asarray(f(d, jnp.asarray([37], np.int32)))
+        print(f"PROBE u8_4d: OK {res[3, 2, 37, :2]}")
+    except Exception as e:
+        print(f"PROBE u8_4d: FAIL {type(e).__name__} "
+              f"{str(e).splitlines()[0][:100]}")
+
+
+probe_u8_4d()
+
+
+def probe_variants():
+    Q2, quota = 128, 1024
+
+    def run_case(name, dtype, L2, ndim, dynamic):
+        def kernel(d_ref, b_ref, o_ref):
+            base = b_ref[0] if dynamic else 64
+            sl = pl.ds(base, Q2)
+            if ndim == 4:
+                o_ref[0, 0, sl, :] = d_ref[:]
+            else:
+                o_ref[sl, :] = d_ref[:]
+        d = jnp.asarray(np.arange(Q2 * L2, dtype=np.int32).reshape(Q2, L2)
+                        .astype(dtype))
+        shape = ((2, 2, quota, L2) if ndim == 4 else (quota, L2))
+        try:
+            @jax.jit
+            def f(dd, bb):
+                return pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct(shape, dtype),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                              pl.BlockSpec(memory_space=pltpu.SMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(dd, bb)
+            np.asarray(f(d, jnp.asarray([37], np.int32)))
+            print(f"PROBE v[{name}]: OK")
+        except Exception as e:
+            print(f"PROBE v[{name}]: FAIL {type(e).__name__} "
+                  f"{str(e).splitlines()[0][:80]}")
+
+    import numpy as _np
+    run_case("i32_2d_dyn", _np.int32, 112, 2, True)
+    run_case("u8_2d_dyn_L128", _np.uint8, 128, 2, True)
+    run_case("u8_2d_dyn_L112", _np.uint8, 112, 2, True)
+    run_case("u8_2d_static", _np.uint8, 128, 2, False)
+    run_case("i32_4d_dyn", _np.int32, 128, 4, True)
+    run_case("u8_4d_dyn", _np.uint8, 128, 4, True)
+
+
+probe_variants()
+
+
+def probe_u8_aligned():
+    Q2, L2, quota = 128, 128, 1024
+
+    def mk(align_mult):
+        def kernel(d_ref, b_ref, o_ref):
+            base = b_ref[0]
+            base = pl.multiple_of((base // align_mult) * align_mult,
+                                  align_mult)
+            o_ref[pl.ds(base, Q2), :] = d_ref[:]
+        return kernel
+
+    d = jnp.asarray((np.arange(Q2 * L2) % 251).reshape(Q2, L2)
+                    .astype(np.uint8))
+    for mult in (8, 32):
+        try:
+            @jax.jit
+            def f(dd, bb, mult=mult):
+                return pl.pallas_call(
+                    mk(mult),
+                    out_shape=jax.ShapeDtypeStruct((quota, L2), jnp.uint8),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                              pl.BlockSpec(memory_space=pltpu.SMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(dd, bb)
+            res = np.asarray(f(d, jnp.asarray([96], np.int32)))
+            print(f"PROBE u8_aligned[{mult}]: OK {res[96, :2]}")
+        except Exception as e:
+            print(f"PROBE u8_aligned[{mult}]: FAIL {type(e).__name__} "
+                  f"{str(e).splitlines()[0][:80]}")
+
+    # aligned dynamic u8 READ
+    def rk(d_ref, b_ref, o_ref):
+        base = pl.multiple_of((b_ref[0] // 32) * 32, 32)
+        o_ref[:] = d_ref[pl.ds(base, 32), :]
+    big = jnp.asarray((np.arange(quota * L2) % 249).reshape(quota, L2)
+                      .astype(np.uint8))
+    try:
+        @jax.jit
+        def g(dd, bb):
+            return pl.pallas_call(
+                rk, out_shape=jax.ShapeDtypeStruct((32, L2), jnp.uint8),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pltpu.SMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(dd, bb)
+        res = np.asarray(g(big, jnp.asarray([96], np.int32)))
+        ok = (res == np.asarray(big)[96:128]).all()
+        print(f"PROBE u8_dynread[32]: OK match={ok}")
+    except Exception as e:
+        print(f"PROBE u8_dynread[32]: FAIL {type(e).__name__} "
+              f"{str(e).splitlines()[0][:80]}")
+
+
+probe_u8_aligned()

@@ -119,9 +119,9 @@ def bench_sortw():
 def _device_matrix(rows, L):
     @jax.jit
     def gen():
-        i = jnp.arange(rows, dtype=jnp.int32)[:, None]
-        j = jnp.arange(L, dtype=jnp.int32)[None, :]
-        return i * np.int32(2654435761) + j
+        i = jnp.arange(rows, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(L, dtype=jnp.uint32)[None, :]
+        return (i * np.uint32(2654435761) + j).astype(jnp.int32)
     return sync(gen())
 
 
@@ -167,6 +167,30 @@ def bench_bgather():
               f"Mblk/s  {CAP*L*4/dt/1e9:.1f} GB/s")
 
 
+def bench_cumsum2():
+    """Packed ranks: 8 per-pid running counts in TWO i64 cumsums (16-bit
+    lanes, counts < W <= 65536) instead of 8 separate i32 cumsums."""
+    pid = make_pids()
+    for W in (512, 2048):
+        wn = CAP // W
+        p2 = pid.reshape(wn, W)
+
+        @jax.jit
+        def f(p):
+            lane = (p % 4).astype(jnp.int64) * np.int64(16)
+            one = jnp.left_shift(np.int64(1), lane)
+            w0 = jnp.where(p < 4, one, np.int64(0))
+            w1 = jnp.where(p >= 4, one, np.int64(0))
+            c0 = jnp.cumsum(w0, axis=1)
+            c1 = jnp.cumsum(w1, axis=1)
+            sel = jnp.where(p < 4, c0, c1)
+            rank = (jnp.right_shift(sel, lane) & np.int64(0xFFFF)) - 1
+            return rank.astype(jnp.int32), c0[:, -1], c1[:, -1]
+
+        dt = timeit(f, p2)
+        print(f"cumsum2[W={W}]: {dt*1e3:.1f} ms")
+
+
 def bench_cumsum():
     pid = make_pids()
     n = 8
@@ -187,6 +211,59 @@ def bench_cumsum():
 
         dt = timeit(f, p2)
         print(f"cumsum[W={W}]: {dt*1e3:.1f} ms")
+
+
+def bench_bgu64():
+    """Per-operand u64 block gather: (cap/B, B) u64 rows (B u64 = 2B i32
+    lanes) — if tile-efficient at B>=64, the merge phase needs NO stacking
+    pass."""
+    for B in (16, 32, 64, 128):
+        blocks = CAP // B
+
+        @jax.jit
+        def gen(B=B, blocks=blocks):
+            i = jnp.arange(blocks, dtype=jnp.uint64)[:, None]
+            j = jnp.arange(B, dtype=jnp.uint64)[None, :]
+            return i * np.uint64(0x9E3779B97F4A7C15) + j
+        m = sync(gen())
+        idx = _device_perm(blocks)
+
+        @jax.jit
+        def f(mm, ii):
+            return jnp.take(mm, ii, axis=0)
+
+        dt = timeit(f, m, idx)
+        print(f"bgu64[B={B}]: {dt*1e3:.1f} ms  {blocks/dt/1e6:.2f} Mblk/s  "
+              f"{CAP*8/dt/1e9:.1f} GB/s/operand")
+
+
+def bench_taw10():
+    """Windowed take_along_axis applied to 10 u64 operands with ONE shared
+    per-window permutation (the sort-free spread candidate)."""
+    ops = make_payloads()
+    for W in (512, 2048):
+        wn = CAP // W
+        ops2 = tuple(o.reshape(wn, W) for o in ops)
+
+        @jax.jit
+        def gen_idx(wn=wn, W=W):
+            i = jnp.arange(W, dtype=jnp.uint32)[None, :]
+            w = jnp.arange(wn, dtype=jnp.uint32)[:, None]
+            key = (i * np.uint32(0x9E3779B9) + w * np.uint32(40503)) \
+                & np.uint32(0xFFFFFF)
+            _, perm = jax.lax.sort(
+                (key, jnp.broadcast_to(i.astype(jnp.int32), (wn, W))),
+                num_keys=1, dimension=1)
+            return perm
+        idx = sync(gen_idx())
+
+        @jax.jit
+        def f(ii, *ops):
+            return tuple(jnp.take_along_axis(o, ii, axis=1) for o in ops)
+
+        dt = timeit(f, idx, *ops2)
+        gb = N_OPS * CAP * 8 / 1e9
+        print(f"taw10[W={W}]: {dt*1e3:.1f} ms  {gb/dt:.2f} GB/s")
 
 
 def bench_taw():

@@ -273,6 +273,12 @@ def gen_household_demographics() -> pa.Table:
     })
 
 
+#: dsdgen's syllable name pool — shared with the TPCx-BB review generator so
+#: store mentions in review content stay joinable against s_store_name
+STORE_NAMES = ("ought", "able", "pri", "ese", "anti", "cally", "ation",
+               "eing")
+
+
 def gen_store(scale: float, seed: int) -> pa.Table:
     n = n_store(scale)
     rng = np.random.default_rng(seed + 14)
@@ -281,9 +287,7 @@ def gen_store(scale: float, seed: int) -> pa.Table:
         "s_store_sk": pa.array(sk),
         "s_store_id": pa.array(np.char.add("AAAAAAAA",
                                            np.char.zfill(sk.astype(str), 8))),
-        "s_store_name": pa.array(np.array(
-            ["ought", "able", "pri", "ese", "anti", "cally", "ation", "eing"]
-        )[(sk - 1) % 8]),
+        "s_store_name": pa.array(np.array(STORE_NAMES)[(sk - 1) % 8]),
         "s_number_employees": pa.array(rng.integers(200, 301, n).astype(np.int32)),
         # cycle the value pools so every city/county/offset the queries filter
         # on exists even with a handful of stores

@@ -34,7 +34,7 @@ from spark_rapids_tpu.benchmarks.tpcds_data import (
     _D0, _DAYS, _EPOCH, _SK0, _null_some, _price_lines, gen_customer,
     gen_customer_address, gen_customer_demographics, gen_date_dim,
     gen_household_demographics, gen_item, gen_promotion, gen_store,
-    gen_store_sales, gen_time_dim, n_customer, n_item)
+    gen_store_sales, gen_time_dim, n_customer, n_item, STORE_NAMES)
 
 
 def date_sk(d: datetime.date) -> int:
@@ -111,17 +111,49 @@ def gen_web_page(scale: float, seed: int) -> pa.Table:
     })
 
 
-_REVIEW_WORDS = np.array(["great", "poor", "solid", "broken", "love", "hate",
-                          "fast", "slow", "works", "failed", "classic",
-                          "value", "cheap", "premium"])
+#: sentiment vocabulary for the review-NLP queries (q10/q18/q19 classify
+#: sentences by word-list matching — the spec's sentiment lexicon role)
+POSITIVE_WORDS = ("great", "love", "works", "premium", "solid", "fast")
+NEGATIVE_WORDS = ("poor", "broken", "hate", "slow", "failed", "cheap")
+_REVIEW_WORDS = np.array(POSITIVE_WORDS + NEGATIVE_WORDS
+                         + ("classic", "value"))
+_REVIEW_NOUNS = np.array(["product", "item", "quality", "packaging"])
+#: competitor names q27's entity extraction looks for
+COMPETITOR_COMPANIES = ("Acme", "Globex", "Initech", "Vandelay", "Hooli")
 
 
 def gen_product_reviews(scale: float, seed: int) -> pa.Table:
+    """Reviews with 1-3 short sentences ('. '-separated). Sentence kinds:
+
+    - plain:   "<word> <noun>"                    (q10/q19 sentiment)
+    - store:   "<word> service at store <name>"   (q18: mentions a store by
+                name; <name> drawn from gen_store's s_store_name domain)
+    - company: "<word> compared to <Company>"     (q27 entity extraction)
+    """
     n = n_reviews(scale)
     rng = np.random.default_rng(seed + 32)
     sk = np.arange(1, n + 1, dtype=np.int64)
-    w = lambda: _REVIEW_WORDS[rng.integers(0, len(_REVIEW_WORDS), n)]  # noqa: E731
-    content = np.char.add(np.char.add(w(), " "), np.char.add(w(), " product"))
+    stores = np.array(STORE_NAMES)
+    companies = np.array(COMPETITOR_COMPANIES)
+
+    def sentence():
+        w = _REVIEW_WORDS[rng.integers(0, len(_REVIEW_WORDS), n)]
+        kind = rng.random(n)
+        plain = np.char.add(np.char.add(w, " "),
+                            _REVIEW_NOUNS[rng.integers(
+                                0, len(_REVIEW_NOUNS), n)])
+        store = np.char.add(np.char.add(w, " service at store "),
+                            stores[rng.integers(0, len(stores), n)])
+        comp = np.char.add(np.char.add(w, " compared to "),
+                           companies[rng.integers(0, len(companies), n)])
+        return np.where(kind < 0.2, store, np.where(kind < 0.4, comp, plain))
+
+    content = sentence()
+    for extra in range(2):
+        more = rng.random(n) < 0.5
+        content = np.where(
+            more, np.char.add(np.char.add(content, ". "), sentence()),
+            content)
     return pa.table({
         "pr_review_sk": pa.array(sk),
         "pr_review_rating": pa.array(rng.integers(1, 6, n).astype(np.int32)),
@@ -259,12 +291,23 @@ def gen_web_clickstreams(scale: float, seed: int,
                          store_sales: pa.Table) -> pa.Table:
     """Random browsing plus a replay slice: every 4th store-sales line was
     viewed logged-in 1-30 days before purchase with no sale recorded (q12's
-    view-then-buy window; q5 profiles clicks per user)."""
+    view-then-buy window; q5 profiles clicks per user).
+
+    Random clicks are BURSTY per user — each click lands near one of the
+    user's few session anchors (deterministic anchor date/minute), so the
+    60-minute sessionization queries (q2/q4/q8/q30) find real multi-click
+    sessions the way dsdgen's clickstream does. Item popularity is skewed
+    (u^2 mapping) so pair/co-view queries have frequent items."""
     rng = np.random.default_rng(seed + 36)
     n = n_clicks(scale)
-    item = rng.integers(1, n_item(scale) + 1, n).astype(np.int64)
+    item = (np.minimum(rng.random(n) ** 2 * n_item(scale),
+                       n_item(scale) - 1) + 1).astype(np.int64)
     user = rng.integers(1, n_customer(scale) + 1, n).astype(np.int64)
-    date = (rng.integers(0, _DAYS, n) + _SK0).astype(np.int64)
+    anchor = rng.integers(0, 3, n)
+    a_date = (user * 131 + anchor * 211) % _DAYS + _SK0
+    a_min = (user * 97 + anchor * 311) % 1380
+    date = a_date.astype(np.int64)
+    minute = (a_min + rng.integers(0, 45, n)).astype(np.int64)
     sales = rng.integers(1, 1_000_000, n).astype(np.int64)
     # ~60% of random clicks are views (no sale), ~25% anonymous
     view = rng.random(n) < 0.6
@@ -281,6 +324,8 @@ def gen_web_clickstreams(scale: float, seed: int,
     user = np.concatenate([user, ss_cust[ok].astype(np.int64)])
     date = np.concatenate(
         [date, (ss_date[ok] - rng.integers(1, 31, m)).astype(np.int64)])
+    minute = np.concatenate(
+        [minute, rng.integers(0, 1440, m).astype(np.int64)])
     sales = np.concatenate([sales, np.zeros(m, dtype=np.int64)])
     view = np.concatenate([view, np.ones(m, dtype=bool)])
     anon = np.concatenate([anon, np.zeros(m, dtype=bool)])
@@ -288,8 +333,7 @@ def gen_web_clickstreams(scale: float, seed: int,
 
     return pa.table({
         "wcs_click_date_sk": pa.array(date),
-        "wcs_click_time_sk": pa.array(
-            rng.integers(0, 1440, n).astype(np.int64)),
+        "wcs_click_time_sk": pa.array(minute),
         "wcs_sales_sk": pa.array(sales, mask=view),
         "wcs_item_sk": _null_some(rng, item, 0.03),
         "wcs_web_page_sk": pa.array(

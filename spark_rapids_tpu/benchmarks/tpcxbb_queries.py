@@ -2,10 +2,13 @@
 
 Reference analog: TpcxbbLikeSpark.scala Q1Like..Q30Like
 (integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala:785-2069). The reference
-ships the 30 BigBench queries as raw SQL through Catalyst and marks 11 of them
-unsupported (UDTF/UDF/python: q1-q4, q8, q10, q18, q19, q27, q29, q30); this
-module carries the same 19 supported queries as their standard DataFrame
-translations, with the same predicates, groupings and orderings.
+implements 19 of the 30 BigBench queries and REJECTS the other 11
+(UnsupportedOperationException for UDTF/UDF/python: q1-q4, q8, q10, q18,
+q19, q27, q29, q30). This module runs ALL 30: the reference's 19 as their
+standard DataFrame translations with the same predicates, groupings and
+orderings, and the 11 rejected ones re-expressed with engine primitives —
+sessionization as a lag-gap cumulative-sum window, path analysis as lag
+projections, sentiment/NER as word-list matching over split sentences.
 
 Constant adaptations to the generator's 1998-2003 calendar and small-scale
 dimensions are noted inline (the reference's constants assume vendor dsdgen
@@ -551,10 +554,305 @@ def q28(t):
             .sort("pr_review_sk"))
 
 
+# ---------------------------------------------------------------------------
+# The 11 queries the reference REJECTS (TpcxbbLikeSpark.scala:785-807,
+# 1015-1019, 1097-1101, 1455-1478, 1993-2002, 2059-2069 all throw
+# UnsupportedOperationException for UDTF/UDF/python). Here they run: the
+# spec's UDTF sessionization is a lag-gap cumulative-sum window, its python
+# path analysis is lag projections, and its sentiment/NER UDFs are word-list
+# matching over split sentences (masked string kernels) — all riding the
+# normal acceleration path. Constants adapt to the generator's scale as
+# noted inline; the query *shapes* follow the public BigBench spec.
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.benchmarks.tpcxbb_data import (COMPETITOR_COMPANIES,
+                                                     NEGATIVE_WORDS,
+                                                     POSITIVE_WORDS)
+
+
+def _sessionize(clicks):
+    """Session ids over each user's ordered clickstream: a new session when
+    >60 minutes pass between clicks (the spec's 'sessionize' UDTF role:
+    lag gap flag -> running sum). Timestamps are minutes since the epoch
+    (click_time_sk is minute-of-day in this generator)."""
+    from spark_rapids_tpu.api import Window
+    w = Window.partitionBy("wcs_user_sk").orderBy("ts")
+    cum = w.rowsBetween(Window.unboundedPreceding, Window.currentRow)
+    gap = col("ts") - F.lag("ts", 1).over(w)
+    return (clicks.filter(col("wcs_user_sk").isNotNull())
+            .withColumn("ts", col("wcs_click_date_sk") * 1440
+                        + col("wcs_click_time_sk"))
+            .withColumn("new_s",
+                        when(gap.isNull() | (gap > 60), 1).otherwise(0))
+            .withColumn("session_id", F.sum("new_s").over(cum)))
+
+
+def _sentences(reviews):
+    """One row per review sentence ('. '-separated; the fused split-part
+    kernel feeds a created-array explode, so the array never materializes)."""
+    def part(i):
+        return F.split(col("pr_review_content"), "\\. ")[i]
+    return (reviews
+            .select("pr_review_sk", "pr_item_sk",
+                    F.explode(F.array(part(0), part(1), part(2)))
+                    .alias("sentence"))
+            .filter(col("sentence").isNotNull() & (col("sentence") != "")))
+
+
+def _pair_counts(df, basket_cols, item_col, out1, out2, min_cnt=0):
+    """Co-occurrence pair counts shared by q1/q29/q30: distinct
+    (basket, item) rows self-joined on the basket key(s), deduped with
+    item1 < item2, counted, ordered count-desc with id tiebreaks."""
+    df = df.select(*basket_cols, item_col).distinct()
+    aliased = df.select(
+        *[col(c).alias(f"_b{i}") for i, c in enumerate(basket_cols)],
+        col(item_col).alias(out2))
+    pairs = (df.join(aliased, [(c, f"_b{i}")
+                               for i, c in enumerate(basket_cols)])
+             .filter(col(item_col) < col(out2)))
+    out = (pairs.groupBy(col(item_col).alias(out1), out2)
+           .agg(F.count(lit(1)).alias("cnt")))
+    if min_cnt > 1:
+        out = out.filter(col("cnt") >= min_cnt)
+    return out.sort(col("cnt").desc(), out1, out2).limit(100)
+
+
+def _first_word(c, words):
+    """First word of ``words`` contained in ``c`` ('' when none) — the
+    sentiment-lexicon match as a masked when-chain, not NLP."""
+    e = None
+    for w_ in words:
+        e = (when(c.contains(w_), w_) if e is None
+             else e.when(c.contains(w_), w_))
+    return e.otherwise("")
+
+
+def q1(t):
+    """Top items sold together in one store basket (spec: self-join on
+    ss_ticket_number over category-filtered items; pair-count floor lowered
+    from the spec's 50 to 3 for generator scales)."""
+    cat = (t["item"].filter(col("i_category_id").isin(1, 2, 3))
+           .select("i_item_sk"))
+    ss = (t["store_sales"].filter(col("ss_store_sk").isNotNull())
+          .join(cat, [("ss_item_sk", "i_item_sk")]))
+    return _pair_counts(ss, ["ss_ticket_number"], "ss_item_sk",
+                        "item_sk_1", "item_sk_2", min_cnt=3)
+
+
+def q2(t):
+    """Top 30 items viewed in the same online session as a target item
+    (spec: sessionize UDTF + pair expansion; target item adapted to the
+    generator's dense small item domain)."""
+    target = 5
+    s = (_sessionize(t["web_clickstreams"])
+         .filter(col("wcs_item_sk").isNotNull())
+         .select("wcs_user_sk", "session_id", "wcs_item_sk").distinct())
+    hit = (s.filter(col("wcs_item_sk") == target)
+           .select(col("wcs_user_sk").alias("u"),
+                   col("session_id").alias("sid")).distinct())
+    return (s.join(hit, [("wcs_user_sk", "u"), ("session_id", "sid")])
+            .filter(col("wcs_item_sk") != target)
+            .groupBy(col("wcs_item_sk").alias("item_sk"))
+            .agg(F.count(lit(1)).alias("cnt"))
+            .sort(col("cnt").desc(), "item_sk").limit(30))
+
+
+def q3(t):
+    """Items viewed within the 5 preceding clicks (and 10 days) before a
+    purchase of an item in categories 2/3 (the spec's python path-analysis
+    as lag projections over the user-ordered stream)."""
+    from spark_rapids_tpu.api import Window
+    w = Window.partitionBy("wcs_user_sk").orderBy("ts")
+    c = (t["web_clickstreams"]
+         .filter(col("wcs_user_sk").isNotNull()
+                 & col("wcs_item_sk").isNotNull())
+         .withColumn("ts", col("wcs_click_date_sk") * 1440
+                     + col("wcs_click_time_sk")))
+    # all five lag pairs in ONE windowed projection (the window sort runs
+    # once), then unpivoted by a union of narrow selects
+    wide = c.select(
+        "wcs_user_sk", "wcs_click_date_sk", "wcs_sales_sk", "wcs_item_sk",
+        *[e for k in range(1, 6) for e in
+          (F.lag("wcs_item_sk", k).over(w).alias(f"vi{k}"),
+           F.lag("wcs_click_date_sk", k).over(w).alias(f"vd{k}"))])
+    lags = None
+    for k in range(1, 6):
+        lk = wide.select(
+            "wcs_user_sk", "wcs_click_date_sk", "wcs_sales_sk",
+            "wcs_item_sk", col(f"vi{k}").alias("viewed_item"),
+            col(f"vd{k}").alias("viewed_date"))
+        lags = lk if lags is None else lags.union(lk)
+    cat = (t["item"].filter(col("i_category_id").isin(2, 3))
+           .select("i_item_sk"))
+    return (lags.filter(col("wcs_sales_sk").isNotNull()
+                        & col("viewed_item").isNotNull()
+                        & (col("wcs_click_date_sk") - col("viewed_date")
+                           <= 10))
+            .join(cat, [("wcs_item_sk", "i_item_sk")])
+            .groupBy(col("viewed_item").alias("lastviewed_item"))
+            .agg(F.count(lit(1)).alias("cnt"))
+            .sort(col("cnt").desc(), "lastviewed_item").limit(30))
+
+
+def q4(t):
+    """Shopping-cart abandonment: sessions that visited an 'order' page but
+    no 'confirmation' page and recorded no purchase; average pages per
+    abandoned session (spec: sessionize + python session filter)."""
+    s = (_sessionize(t["web_clickstreams"])
+         .join(t["web_page"], [("wcs_web_page_sk", "wp_web_page_sk")]))
+    flag = lambda c: F.sum(when(c, 1).otherwise(0))  # noqa: E731
+    per = (s.groupBy("wcs_user_sk", "session_id")
+           .agg(flag(col("wp_type") == "order").alias("n_order"),
+                flag(col("wp_type") == "confirmation").alias("n_conf"),
+                flag(col("wcs_sales_sk").isNotNull()).alias("n_buy"),
+                F.count(lit(1)).alias("pages")))
+    return (per.filter((col("n_order") > 0) & (col("n_conf") == 0)
+                       & (col("n_buy") == 0))
+            .agg(F.sum(col("pages") * 1.0).alias("total_pages"),
+                 F.count(lit(1)).alias("abandoned_sessions"))
+            .select((col("total_pages") / col("abandoned_sessions"))
+                    .alias("avg_pages_per_abandoned_session"),
+                    "abandoned_sessions"))
+
+
+def q8(t):
+    """Sales impact of review reading: purchases in sessions where a
+    'review' page view happened earlier vs all other purchases (spec:
+    python session scan; here a session-level min-ts semi profile)."""
+    s = (_sessionize(t["web_clickstreams"])
+         .join(t["web_page"], [("wcs_web_page_sk", "wp_web_page_sk")]))
+    first_review = (s.filter(col("wp_type") == "review")
+                    .groupBy(col("wcs_user_sk").alias("u"),
+                             col("session_id").alias("sid"))
+                    .agg(F.min("ts").alias("first_review_ts")))
+    buys = s.filter(col("wcs_sales_sk").isNotNull()
+                    & col("wcs_item_sk").isNotNull())
+    flagged = (buys.join(first_review,
+                         [("wcs_user_sk", "u"), ("session_id", "sid")],
+                         how="left")
+               .withColumn("after_review",
+                           when(col("first_review_ts").isNotNull()
+                                & (col("ts") > col("first_review_ts")),
+                                1).otherwise(0)))
+    return (flagged.join(t["item"].select("i_item_sk", "i_current_price"),
+                         [("wcs_item_sk", "i_item_sk")])
+            .groupBy("after_review")
+            .agg(F.count(lit(1)).alias("purchases"),
+                 F.sum("i_current_price").alias("amount"))
+            .sort("after_review"))
+
+
+def q10(t):
+    """Sentence-level review sentiment (the spec's sentiment UDF as
+    word-list matching over split sentences)."""
+    sent = _sentences(t["product_reviews"])
+    pos = _first_word(col("sentence"), POSITIVE_WORDS)
+    neg = _first_word(col("sentence"), NEGATIVE_WORDS)
+    return (sent.withColumn("pos_w", pos).withColumn("neg_w", neg)
+            .filter((col("pos_w") != "") | (col("neg_w") != ""))
+            .select("pr_item_sk",
+                    col("sentence").alias("review_sentence"),
+                    when(col("pos_w") != "", "POS").otherwise("NEG")
+                    .alias("sentiment"),
+                    when(col("pos_w") != "", col("pos_w"))
+                    .otherwise(col("neg_w")).alias("sentiment_word"))
+            .sort("pr_item_sk", "review_sentence", "sentiment_word"))
+
+
+def q18(t):
+    """Stores with declining sales + negative review sentences naming them
+    (spec: per-store linear regression, then sentence-level NER on the store
+    name; the mention is extracted with the split-part kernel and
+    equi-joined on s_store_name)."""
+    daily = (t["store_sales"]
+             .filter(col("ss_store_sk").isNotNull()
+                     & col("ss_sold_date_sk").isNotNull())
+             .groupBy("ss_store_sk", "ss_sold_date_sk")
+             .agg(F.sum("ss_net_paid").alias("s")))
+    x = col("ss_sold_date_sk") * 1.0
+    reg = (daily.groupBy("ss_store_sk")
+           .agg(F.count(lit(1)).alias("n"),
+                F.sum(x).alias("sx"), F.sum("s").alias("sy"),
+                F.sum(x * col("s")).alias("sxy"),
+                F.sum(x * x).alias("sxx")))
+    slope = ((col("n") * col("sxy") - col("sx") * col("sy"))
+             / (col("n") * col("sxx") - col("sx") * col("sx")))
+    declining = (reg.withColumn("slope", slope)
+                 .filter(col("slope") < 0)
+                 .join(t["store"], [("ss_store_sk", "s_store_sk")])
+                 .select(col("s_store_name").alias("store_name")).distinct())
+    sent = _sentences(t["product_reviews"])
+    hits = (sent
+            .withColumn("neg_word", _first_word(col("sentence"),
+                                                NEGATIVE_WORDS))
+            .filter((col("neg_word") != "")
+                    & col("sentence").contains(" at store "))
+            .withColumn("mention", F.substring_index(col("sentence"),
+                                                     " at store ", -1)))
+    return (hits.join(declining, [("mention", "store_name")])
+            .select(col("mention").alias("store_name"), "pr_review_sk",
+                    "sentence", "neg_word")
+            .sort("store_name", "pr_review_sk", "sentence"))
+
+
+def q19(t):
+    """Negative review sentences for items with returns in BOTH channels
+    (spec: return-heavy item selection + sentiment UDF; the week filter is
+    dropped — the generator links returns uniformly over the year)."""
+    sr = (t["store_returns"].groupBy(col("sr_item_sk").alias("item_sk"))
+          .agg(F.sum("sr_return_quantity").alias("sr_qty")))
+    wr = (t["web_returns"].groupBy(col("wr_item_sk").alias("item_sk2"))
+          .agg(F.count(lit(1)).alias("wr_cnt")))
+    heavy = (sr.join(wr, [("item_sk", "item_sk2")])
+             .filter((col("sr_qty") >= 1) & (col("wr_cnt") >= 1))
+             .select("item_sk"))
+    sent = _sentences(t["product_reviews"])
+    return (sent
+            .withColumn("neg_word", _first_word(col("sentence"),
+                                                NEGATIVE_WORDS))
+            .filter(col("neg_word") != "")
+            .join(heavy, [("pr_item_sk", "item_sk")])
+            .select("pr_item_sk", "pr_review_sk", "sentence", "neg_word")
+            .sort("pr_item_sk", "pr_review_sk", "sentence"))
+
+
+def q27(t):
+    """Competitor-company extraction from review sentences (the spec's NER
+    UDF: extract the entity after 'compared to' and keep known companies)."""
+    sent = _sentences(t["product_reviews"])
+    return (sent.filter(col("sentence").contains(" compared to "))
+            .withColumn("company", F.substring_index(col("sentence"),
+                                                     " compared to ", -1))
+            .filter(col("company").isin(*COMPETITOR_COMPANIES))
+            .select("pr_review_sk", "pr_item_sk", "company", "sentence")
+            .sort("pr_review_sk", "company", "sentence"))
+
+
+def q29(t):
+    """Top category pairs co-sold in one web order (spec: self-join on
+    ws_order_number at category level)."""
+    ws = (t["web_sales"]
+          .join(t["item"].select("i_item_sk", "i_category_id"),
+                [("ws_item_sk", "i_item_sk")]))
+    return _pair_counts(ws, ["ws_order_number"], "i_category_id",
+                        "category_id_1", "category_id_2")
+
+
+def q30(t):
+    """Top category pairs viewed in the same online session (q2's
+    sessionization at category level — the spec's second UDTF use)."""
+    s = (_sessionize(t["web_clickstreams"])
+         .filter(col("wcs_item_sk").isNotNull())
+         .join(t["item"].select("i_item_sk", "i_category_id"),
+               [("wcs_item_sk", "i_item_sk")]))
+    return _pair_counts(s, ["wcs_user_sk", "session_id"], "i_category_id",
+                        "category_id_1", "category_id_2")
+
+
 QUERIES: Dict[str, object] = {
     name: fn for name, fn in list(globals().items())
     if name.startswith("q") and name[1:].isdigit() and callable(fn)}
 
-#: queries the reference marks unsupported (UDTF/UDF/python)
-UNSUPPORTED = ("q1", "q2", "q3", "q4", "q8", "q10", "q18", "q19", "q27",
-               "q29", "q30")
+#: the reference rejects these 11 (UDTF/UDF/python,
+#: TpcxbbLikeSpark.scala:785-2069); this engine runs all 30
+UNSUPPORTED = ()
