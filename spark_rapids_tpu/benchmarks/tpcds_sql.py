@@ -1,0 +1,789 @@
+"""TPC-DS queries as raw SQL text through the SQL frontend.
+
+Reference analog: TpcdsLikeSpark.scala runs every TPC-DS query as SQL text
+through Catalyst (TpcdsLikeSpark.scala:761 onward). This module carries the
+same queries as SQL for THIS engine's frontend, written against the exact
+constants of the DataFrame translations in benchmarks/tpcds_queries.py (which
+adapt the public spec's parameters to the generator's calendar and pools) —
+so `sess.sql(SQL_QUERIES[q])` must produce results identical to
+`QUERIES[q](dfs)`, the fidelity bar Catalyst gets for free.
+
+Queries are standard TPC-DS SQL shapes: star joins over channel fact tables,
+derived tables, CTEs, window functions, ROLLUP, and correlated/scalar
+subqueries — exercising the full frontend surface.
+"""
+
+SQL_QUERIES = {
+    "q3": """
+select d_year, i_brand_id as brand_id, i_brand as brand, sum_agg
+from (select d_year, i_brand, i_brand_id,
+             sum(ss_ext_sales_price) as sum_agg
+      from date_dim, store_sales, item
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and d_moy = 11 and i_manufact_id = 128
+      group by d_year, i_brand, i_brand_id) x
+order by d_year, sum_agg desc, brand_id
+limit 100
+""",
+    "q7": """
+select i_item_id,
+       avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+from store_sales, date_dim, item, customer_demographics, promotion
+where ss_sold_date_sk = d_date_sk and d_year = 2000
+  and ss_item_sk = i_item_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and ss_promo_sk = p_promo_sk
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    "q19": """
+select i_brand_id as brand_id, i_brand as brand, i_manufact_id, i_manufact,
+       ext_price
+from (select i_brand, i_brand_id, i_manufact_id, i_manufact,
+             sum(ss_ext_sales_price) as ext_price
+      from date_dim, store_sales, item, customer, customer_address, store
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and d_moy = 11 and d_year = 1998 and i_manager_id = 8
+        and ss_customer_sk = c_customer_sk
+        and c_current_addr_sk = ca_address_sk
+        and ss_store_sk = s_store_sk
+        and substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+      group by i_brand, i_brand_id, i_manufact_id, i_manufact) x
+order by ext_price desc, brand, brand_id, i_manufact_id, i_manufact
+limit 100
+""",
+    "q34": """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (d_dom between 1 and 3 or d_dom between 25 and 28)
+        and d_year in (1999, 2000, 2001)
+        and hd_buy_potential in ('>10000', 'unknown')
+        and hd_vehicle_count > 0
+        and (case when hd_vehicle_count > 0
+                  then hd_dep_count / hd_vehicle_count
+                  else null end) > 1.2
+        and s_county = 'Williamson County'
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk and cnt between 15 and 20
+order by c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag desc, ss_ticket_number
+""",
+    "q42": """
+select d_year, i_category_id, i_category, sum(ss_ext_sales_price) as s
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 11 and d_year = 2000 and i_manager_id = 1
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+""",
+    "q46": """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, ss_addr_sk,
+             ca_city as bought_city,
+             sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and d_dow in (5, 6) and d_year in (1999, 2000, 2001)
+        and s_city in ('Fairview', 'Midway')
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+""",
+    "q52": """
+select d_year, i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 11 and d_year = 2000 and i_manager_id = 1
+group by d_year, i_brand, i_brand_id
+order by d_year, ext_price desc, brand_id
+limit 100
+""",
+    "q55": """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 11 and d_year = 1999 and i_manager_id = 28
+group by i_brand, i_brand_id
+order by ext_price desc, brand_id
+limit 100
+""",
+    "q16": """
+select count(distinct cs_order_number) as order_count,
+       sum(cs_ext_ship_cost) as total_shipping_cost,
+       sum(cs_net_profit) as total_net_profit
+from catalog_sales, date_dim, customer_address, call_center
+where cs_ship_date_sk = d_date_sk
+  and d_date between date '2002-02-01' and date '2002-04-02'
+  and cs_ship_addr_sk = ca_address_sk and ca_state = 'GA'
+  and cs_call_center_sk = cc_call_center_sk
+  and cc_county = 'Williamson County'
+  and exists (select *
+              from (select cs_order_number as o2,
+                           count(distinct cs_warehouse_sk) as nw
+                    from catalog_sales
+                    where cs_warehouse_sk is not null
+                    group by cs_order_number) m
+              where m.o2 = cs_order_number and m.nw >= 2)
+  and not exists (select * from catalog_returns
+                  where cr_order_number = cs_order_number)
+""",
+    "q94": """
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales, date_dim, customer_address, web_site
+where ws_ship_date_sk = d_date_sk
+  and d_date between date '1999-02-01' and date '1999-04-02'
+  and ws_ship_addr_sk = ca_address_sk and ca_state = 'GA'
+  and ws_web_site_sk = web_site_sk
+  and web_company_name = 'pri'
+  and exists (select *
+              from (select ws_order_number as o2,
+                           count(distinct ws_warehouse_sk) as nw
+                    from web_sales
+                    where ws_warehouse_sk is not null
+                    group by ws_order_number) m
+              where m.o2 = ws_order_number and m.nw >= 2)
+  and not exists (select * from web_returns
+                  where wr_order_number = ws_order_number)
+""",
+    "q20": """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue,
+       itemrevenue * 100.0 / sum(itemrevenue)
+           over (partition by i_class) as revenueratio
+from (select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+             sum(cs_ext_sales_price) as itemrevenue
+      from catalog_sales, item, date_dim
+      where cs_item_sk = i_item_sk
+        and i_category in ('Sports', 'Books', 'Home')
+        and cs_sold_date_sk = d_date_sk
+        and d_date between date '1999-02-22' and date '1999-03-24'
+      group by i_item_id, i_item_desc, i_category, i_class,
+               i_current_price) base
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+""",
+    "q21": """
+select w_warehouse_name, i_item_id, inv_before, inv_after
+from (select w_warehouse_name, i_item_id,
+             sum(case when d_date < date '2000-03-11'
+                      then inv_quantity_on_hand else 0 end) as inv_before,
+             sum(case when d_date >= date '2000-03-11'
+                      then inv_quantity_on_hand else 0 end) as inv_after
+      from inventory, warehouse, item, date_dim
+      where inv_warehouse_sk = w_warehouse_sk and inv_item_sk = i_item_sk
+        and inv_date_sk = d_date_sk
+        and i_current_price between 0.99 and 1.49
+        and datediff(d_date, date '2000-03-11') between -30 and 30
+      group by w_warehouse_name, i_item_id) x
+where (case when inv_before > 0 then inv_after / inv_before else null end)
+      >= 2.0 / 3.0
+  and (case when inv_before > 0 then inv_after / inv_before else null end)
+      <= 3.0 / 2.0
+order by w_warehouse_name, i_item_id
+limit 100
+""",
+    "q25": """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, date_dim, item, store, store_returns d2, catalog_sales
+where ss_sold_date_sk = d_date_sk and d_moy = 4 and d_year = 2001
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk in
+      (select d_date_sk from date_dim
+       where d_moy between 4 and 10 and d_year = 2001)
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk in
+      (select d_date_sk from date_dim
+       where d_moy between 4 and 10 and d_year = 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+""",
+    "q29": """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from store_sales, date_dim, item, store, store_returns d2, catalog_sales
+where ss_sold_date_sk = d_date_sk and d_moy = 9 and d_year = 1999
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk in
+      (select d_date_sk from date_dim
+       where d_moy between 9 and 12 and d_year = 1999)
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk in
+      (select d_date_sk from date_dim
+       where d_year in (1999, 2000, 2001))
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+""",
+    "q26": """
+select i_item_id,
+       avg(cs_quantity) as agg1, avg(cs_list_price) as agg2,
+       avg(cs_coupon_amt) as agg3, avg(cs_sales_price) as agg4
+from catalog_sales, date_dim, item, customer_demographics, promotion
+where cs_sold_date_sk = d_date_sk and d_year = 2000
+  and cs_item_sk = i_item_sk and cs_bill_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and cs_promo_sk = p_promo_sk
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    "q32": """
+select sum(cs_ext_discount_amt) as excess_discount_amount
+from catalog_sales, item, date_dim
+where i_manufact_id = 77 and i_item_sk = cs_item_sk
+  and d_date between date '2000-01-27' and date '2000-04-26'
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt >
+      (select 1.3 * avg(cs_ext_discount_amt)
+       from catalog_sales, date_dim
+       where cs_item_sk = i_item_sk and d_date_sk = cs_sold_date_sk
+         and d_date between date '2000-01-27' and date '2000-04-26')
+""",
+    "q92": """
+select sum(ws_ext_discount_amt) as excess_discount_amount
+from web_sales, item, date_dim
+where i_manufact_id = 50 and i_item_sk = ws_item_sk
+  and d_date between date '2000-01-27' and date '2000-04-26'
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt >
+      (select 1.3 * avg(ws_ext_discount_amt)
+       from web_sales, date_dim
+       where ws_item_sk = i_item_sk and d_date_sk = ws_sold_date_sk
+         and d_date between date '2000-01-27' and date '2000-04-26')
+""",
+    "q43": """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price else null end)
+           as sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price else null end)
+           as mon_sales,
+       sum(case when d_day_name = 'Tuesday' then ss_sales_price else null
+           end) as tue_sales,
+       sum(case when d_day_name = 'Wednesday' then ss_sales_price else null
+           end) as wed_sales,
+       sum(case when d_day_name = 'Thursday' then ss_sales_price else null
+           end) as thu_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price else null
+           end) as fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price else null
+           end) as sat_sales
+from store_sales, date_dim, store
+where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+  and d_year = 2000 and s_gmt_offset = -5.0
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+""",
+    "q65": """
+with base as (
+  select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 1200 and 1211
+  group by ss_store_sk, ss_item_sk),
+avg_rev as (
+  select ss_store_sk as sb_store_sk, avg(revenue) as ave
+  from base group by ss_store_sk)
+select s_store_name, i_item_desc, revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from base, avg_rev, store, item
+where ss_store_sk = sb_store_sk and revenue <= ave * 0.1
+  and ss_store_sk = s_store_sk and ss_item_sk = i_item_sk
+order by s_store_name, i_item_desc
+limit 100
+""",
+    "q68": """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ss_addr_sk,
+             ca_city as bought_city,
+             sum(ss_ext_sales_price) as extended_price,
+             sum(ss_ext_list_price) as list_price,
+             sum(ss_ext_tax) as extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and d_dom between 1 and 2 and d_year in (1999, 2000, 2001)
+        and s_city in ('Midway', 'Fairview')
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""",
+    "q73": """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dom between 1 and 2 and d_year in (1999, 2000, 2001)
+        and hd_buy_potential in ('>10000', 'unknown')
+        and hd_vehicle_count > 0
+        and (case when hd_vehicle_count > 0
+                  then hd_dep_count / hd_vehicle_count
+                  else null end) > 1
+        and s_county in ('Williamson County', 'Franklin Parish',
+                         'Bronx County', 'Orange County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_last_name
+""",
+    "q79": """
+select c_last_name, c_first_name, substring(s_city, 1, 30) as city,
+       ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city,
+             sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dow = 1 and d_year in (1999, 2000, 2001)
+        and s_number_employees between 200 and 295
+        and (hd_dep_count = 6 or hd_vehicle_count > 2)
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, city, profit desc
+limit 100
+""",
+    "q89": """
+select *
+from (select i_category, i_class, i_brand, s_store_name, s_company_name,
+             d_moy, sum_sales, avg_monthly_sales
+      from (select i_category, i_class, i_brand, s_store_name,
+                   s_company_name, d_moy, sum_sales,
+                   avg(sum_sales) over (partition by i_category, i_brand,
+                                        s_store_name, s_company_name)
+                       as avg_monthly_sales
+            from (select i_category, i_class, i_brand, s_store_name,
+                         s_company_name, d_moy,
+                         sum(ss_sales_price) as sum_sales
+                  from store_sales, item, date_dim, store
+                  where ss_item_sk = i_item_sk
+                    and ss_sold_date_sk = d_date_sk
+                    and ss_store_sk = s_store_sk and d_year = 1999
+                    and ((i_category in ('Books', 'Electronics', 'Sports')
+                          and i_class in ('computers', 'stereo', 'football'))
+                         or (i_category in ('Men', 'Jewelry', 'Women')
+                             and i_class in ('shirts', 'birdal', 'dresses')))
+                  group by i_category, i_class, i_brand, s_store_name,
+                           s_company_name, d_moy) t1) t2
+      where case when avg_monthly_sales <> 0.0
+                 then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+                 else null end > 0.1
+      order by sum_sales - avg_monthly_sales, s_store_name
+      limit 100) t3
+""",
+    "q96": """
+select count(*) as cnt
+from store_sales, time_dim, household_demographics, store
+where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
+  and s_store_name = 'ese'
+""",
+    "q98": """
+select i_item_desc, i_category, i_class, i_current_price, itemrevenue,
+       revenueratio
+from (select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+             itemrevenue,
+             itemrevenue * 100.0 / sum(itemrevenue)
+                 over (partition by i_class) as revenueratio
+      from (select i_item_id, i_item_desc, i_category, i_class,
+                   i_current_price,
+                   sum(ss_ext_sales_price) as itemrevenue
+            from store_sales, item, date_dim
+            where ss_item_sk = i_item_sk
+              and i_category in ('Sports', 'Books', 'Home')
+              and ss_sold_date_sk = d_date_sk
+              and d_date between date '1999-02-22' and date '1999-03-24'
+            group by i_item_id, i_item_desc, i_category, i_class,
+                     i_current_price) base) x
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+""",
+    "q15": """
+select ca_zip, sum(cs_sales_price) as sum_sales_price
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+  and (substring(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                   '86475', '85392', '85460', '80348',
+                                   '81792')
+       or ca_state in ('CA', 'WA', 'GA')
+       or cs_sales_price > 500)
+group by ca_zip
+order by ca_zip
+limit 100
+""",
+    "q37": """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim
+where i_current_price between 68 and 98
+  and i_manufact_id in (8, 33, 58, 83)
+  and inv_item_sk = i_item_sk
+  and inv_quantity_on_hand between 100 and 500
+  and inv_date_sk = d_date_sk
+  and d_date between date '2000-02-01' and date '2000-04-01'
+  and exists (select * from catalog_sales where cs_item_sk = i_item_sk)
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+""",
+    "q40": """
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0.0)
+                else 0.0 end) as sales_before,
+       sum(case when d_date >= date '2000-03-11'
+                then cs_sales_price - coalesce(cr_refunded_cash, 0.0)
+                else 0.0 end) as sales_after
+from catalog_sales left join catalog_returns
+       on cs_order_number = cr_order_number and cs_item_sk = cr_item_sk,
+     warehouse, item, date_dim
+where cs_warehouse_sk = w_warehouse_sk and cs_item_sk = i_item_sk
+  and i_current_price between 0.99 and 1.49
+  and cs_sold_date_sk = d_date_sk
+  and datediff(d_date, date '2000-03-11') between -30 and 30
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+""",
+    "q45": """
+select ca_zip, ca_city, sum(ws_sales_price) as sum_ws_sales_price
+from web_sales, customer, customer_address, item, date_dim
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and ws_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+  and (substring(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                   '86475', '85392', '85460', '80348',
+                                   '81792')
+       or i_item_id in (select i_item_id from item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19,
+                                            23, 29)))
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+""",
+    "q62": """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, web_name,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30 then 1
+                else 0 end) as d30,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+                 and ws_ship_date_sk - ws_sold_date_sk <= 60 then 1
+                else 0 end) as d31_60,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+                 and ws_ship_date_sk - ws_sold_date_sk <= 90 then 1
+                else 0 end) as d61_90,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 90
+                 and ws_ship_date_sk - ws_sold_date_sk <= 120 then 1
+                else 0 end) as d91_120,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 120 then 1
+                else 0 end) as d_over_120
+from web_sales, date_dim, warehouse, ship_mode, web_site
+where ws_ship_date_sk = d_date_sk
+  and d_month_seq between 1200 and 1211
+  and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by substring(w_warehouse_name, 1, 20), sm_type, web_name
+order by wname, sm_type, web_name
+limit 100
+""",
+    "q99": """
+select substring(w_warehouse_name, 1, 20) as wname, sm_type, cc_name,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30 then 1
+                else 0 end) as d30,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+                 and cs_ship_date_sk - cs_sold_date_sk <= 60 then 1
+                else 0 end) as d31_60,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+                 and cs_ship_date_sk - cs_sold_date_sk <= 90 then 1
+                else 0 end) as d61_90,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 90
+                 and cs_ship_date_sk - cs_sold_date_sk <= 120 then 1
+                else 0 end) as d91_120,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 120 then 1
+                else 0 end) as d_over_120
+from catalog_sales, date_dim, warehouse, ship_mode, call_center
+where cs_ship_date_sk = d_date_sk
+  and d_month_seq between 1200 and 1211
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by substring(w_warehouse_name, 1, 20), sm_type, cc_name
+order by wname, sm_type, cc_name
+limit 100
+""",
+    "q90": """
+select amc / pmc as am_pm_ratio
+from (select count(*) as amc
+      from web_sales, household_demographics, time_dim, web_page
+      where ws_ship_hdemo_sk = hd_demo_sk and hd_dep_count = 6
+        and ws_sold_time_sk = t_time_sk
+        and t_hour between 8 and 9
+        and ws_web_page_sk = wp_web_page_sk
+        and wp_char_count between 5000 and 5200) at,
+     (select count(*) as pmc
+      from web_sales, household_demographics, time_dim, web_page
+      where ws_ship_hdemo_sk = hd_demo_sk and hd_dep_count = 6
+        and ws_sold_time_sk = t_time_sk
+        and t_hour between 19 and 20
+        and ws_web_page_sk = wp_web_page_sk
+        and wp_char_count between 5000 and 5200) pt
+""",
+    "q93": """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end as act_sales,
+             sr_reason_sk
+      from store_sales left join store_returns
+             on ss_item_sk = sr_item_sk
+            and ss_ticket_number = sr_ticket_number) x, reason
+where sr_reason_sk = r_reason_sk
+  and r_reason_desc = 'Package was damaged'
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+""",
+    "q13": """
+select avg(ss_quantity) as avg_quantity,
+       avg(ss_ext_sales_price) as avg_ext_sales_price,
+       avg(ss_ext_wholesale_cost) as avg_ext_wholesale,
+       sum(ss_ext_wholesale_cost) as sum_ext_wholesale
+from store_sales, store, date_dim, customer_demographics,
+     household_demographics, customer_address
+where ss_store_sk = s_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ss_cdemo_sk = cd_demo_sk and ss_hdemo_sk = hd_demo_sk
+  and ss_addr_sk = ca_address_sk
+  and ((cd_marital_status = 'M' and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.0 and 150.0 and hd_dep_count = 3)
+       or (cd_marital_status = 'S' and cd_education_status = 'College'
+           and ss_sales_price between 50.0 and 100.0 and hd_dep_count = 1)
+       or (cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 150.0 and 200.0 and hd_dep_count = 1))
+  and ((ca_country = 'United States' and ca_state in ('TX', 'OH', 'GA')
+        and ss_net_profit between 100 and 200)
+       or (ca_country = 'United States' and ca_state in ('TN', 'IN', 'SD')
+           and ss_net_profit between 150 and 300)
+       or (ca_country = 'United States' and ca_state in ('LA', 'MI', 'SC')
+           and ss_net_profit between 50 and 250))
+""",
+    "q17": """
+select i_item_id, i_item_desc, s_state,
+       count(ss_quantity) as store_sales_quantitycount,
+       avg(ss_quantity) as store_sales_quantityave,
+       stddev(ss_quantity) as store_sales_quantitystdev,
+       count(sr_return_quantity) as store_returns_quantitycount,
+       avg(sr_return_quantity) as store_returns_quantityave,
+       stddev(sr_return_quantity) as store_returns_quantitystdev,
+       count(cs_quantity) as catalog_sales_quantitycount,
+       avg(cs_quantity) as catalog_sales_quantityave,
+       stddev(cs_quantity) as catalog_sales_quantitystdev,
+       stddev(ss_quantity) / avg(ss_quantity) as store_sales_quantitycov,
+       stddev(sr_return_quantity) / avg(sr_return_quantity)
+           as store_returns_quantitycov,
+       stddev(cs_quantity) / avg(cs_quantity) as catalog_sales_quantitycov
+from store_sales, date_dim, item, store, store_returns, catalog_sales
+where ss_sold_date_sk = d_date_sk and d_quarter_name = '2001Q1'
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk in
+      (select d_date_sk from date_dim
+       where d_quarter_name in ('2001Q1', '2001Q2', '2001Q3'))
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk in
+      (select d_date_sk from date_dim
+       where d_quarter_name in ('2001Q1', '2001Q2', '2001Q3'))
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100
+""",
+    "q28": """
+select *
+from (select avg(ss_list_price) as b1_lp, count(ss_list_price) as b1_cnt,
+             count(distinct ss_list_price) as b1_cntd
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between 8 and 18
+             or ss_coupon_amt between 459 and 1459
+             or ss_wholesale_cost between 57 and 77)) b1,
+     (select avg(ss_list_price) as b2_lp, count(ss_list_price) as b2_cnt,
+             count(distinct ss_list_price) as b2_cntd
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between 90 and 100
+             or ss_coupon_amt between 2323 and 3323
+             or ss_wholesale_cost between 31 and 51)) b2,
+     (select avg(ss_list_price) as b3_lp, count(ss_list_price) as b3_cnt,
+             count(distinct ss_list_price) as b3_cntd
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between 142 and 152
+             or ss_coupon_amt between 12214 and 13214
+             or ss_wholesale_cost between 79 and 99)) b3,
+     (select avg(ss_list_price) as b4_lp, count(ss_list_price) as b4_cnt,
+             count(distinct ss_list_price) as b4_cntd
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between 135 and 145
+             or ss_coupon_amt between 6071 and 7071
+             or ss_wholesale_cost between 38 and 58)) b4,
+     (select avg(ss_list_price) as b5_lp, count(ss_list_price) as b5_cnt,
+             count(distinct ss_list_price) as b5_cntd
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between 122 and 132
+             or ss_coupon_amt between 836 and 1836
+             or ss_wholesale_cost between 17 and 37)) b5,
+     (select avg(ss_list_price) as b6_lp, count(ss_list_price) as b6_cnt,
+             count(distinct ss_list_price) as b6_cntd
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between 154 and 164
+             or ss_coupon_amt between 7326 and 8326
+             or ss_wholesale_cost between 7 and 27)) b6
+limit 100
+""",
+    "q33": """
+with subset as (
+  select distinct i_manufact_id as sub_key from item
+  where i_category in ('Electronics')),
+dd as (select d_date_sk from date_dim where d_year = 1998 and d_moy = 5),
+addr as (select ca_address_sk from customer_address
+         where ca_gmt_offset = -5.0),
+ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, item
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk in (select d_date_sk from dd)
+    and ss_addr_sk in (select ca_address_sk from addr)
+    and i_manufact_id in (select sub_key from subset)
+  group by i_manufact_id),
+cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, item
+  where cs_item_sk = i_item_sk
+    and cs_sold_date_sk in (select d_date_sk from dd)
+    and cs_bill_addr_sk in (select ca_address_sk from addr)
+    and i_manufact_id in (select sub_key from subset)
+  group by i_manufact_id),
+ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, item
+  where ws_item_sk = i_item_sk
+    and ws_sold_date_sk in (select d_date_sk from dd)
+    and ws_bill_addr_sk in (select ca_address_sk from addr)
+    and i_manufact_id in (select sub_key from subset)
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) u
+group by i_manufact_id
+order by total_sales
+limit 100
+""",
+    "q60": """
+with subset as (
+  select distinct i_item_id as sub_key from item
+  where i_category in ('Music')),
+dd as (select d_date_sk from date_dim where d_year = 1998 and d_moy = 9),
+addr as (select ca_address_sk from customer_address
+         where ca_gmt_offset = -5.0),
+ss as (
+  select i_item_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, item
+  where ss_item_sk = i_item_sk
+    and ss_sold_date_sk in (select d_date_sk from dd)
+    and ss_addr_sk in (select ca_address_sk from addr)
+    and i_item_id in (select sub_key from subset)
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, item
+  where cs_item_sk = i_item_sk
+    and cs_sold_date_sk in (select d_date_sk from dd)
+    and cs_bill_addr_sk in (select ca_address_sk from addr)
+    and i_item_id in (select sub_key from subset)
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, item
+  where ws_item_sk = i_item_sk
+    and ws_sold_date_sk in (select d_date_sk from dd)
+    and ws_bill_addr_sk in (select ca_address_sk from addr)
+    and i_item_id in (select sub_key from subset)
+  group by i_item_id)
+select i_item_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) u
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+""",
+    "q86": """
+select total_sum, i_category, i_class, lochierarchy, rank_within_parent
+from (select total_sum, i_category, i_class, lochierarchy,
+             rank() over (partition by lochierarchy, _parent
+                          order by total_sum desc) as rank_within_parent
+      from (select sum(ws_net_paid) as total_sum, i_category, i_class,
+                   (case when i_category is null then 1 else 0 end
+                    + case when i_class is null then 1 else 0 end)
+                       as lochierarchy,
+                   case when i_class is not null then i_category
+                        else null end as _parent
+            from web_sales, date_dim, item
+            where ws_sold_date_sk = d_date_sk
+              and d_month_seq between 1200 and 1211
+              and ws_item_sk = i_item_sk
+            group by rollup(i_category, i_class)) x) y
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category else null end,
+         rank_within_parent
+limit 100
+""",
+}

@@ -1,0 +1,55 @@
+"""TPC-DS through the SQL frontend: raw SQL text must produce results
+identical to the DataFrame translations (reference analog: Catalyst
+consuming TpcdsLikeSpark.scala's SQL — TpcdsLikeSpark.scala:761)."""
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+from spark_rapids_tpu.benchmarks.tpcds_sql import SQL_QUERIES
+from spark_rapids_tpu.testing import assert_tables_equal
+
+pytestmark = pytest.mark.slow
+
+_SCALE = 0.01
+
+_CONF = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    "spark.rapids.tpu.sql.hasNans": "false",
+    "spark.rapids.tpu.sql.exec.NestedLoopJoin": "true",
+    "spark.rapids.tpu.sql.exec.CartesianProduct": "true",
+}
+
+#: queries whose final sort keys can tie -> unordered compare
+_TIES = {"q19", "q27", "q34", "q42", "q46", "q52", "q55", "q65", "q68",
+         "q73", "q79", "q88", "q96", "q15", "q26", "q7", "q21", "q25",
+         "q29", "q37", "q82", "q90", "q92", "q93", "q50", "q62", "q99",
+         "q3", "q43", "q48", "q84", "q61", "q32", "q41", "q45", "q20",
+         "q12", "q98", "q33", "q56", "q60"}
+
+
+@pytest.fixture(scope="module")
+def sql_session():
+    tables = gen_all(_SCALE, seed=0)
+    sess = TpuSession(_CONF)
+    for name, tab in tables.items():
+        sess.create_dataframe(tab).createOrReplaceTempView(name)
+    dfs = {k: sess.create_dataframe(v) for k, v in tables.items()}
+    return sess, dfs
+
+
+def test_sql_coverage_floor():
+    """The SQL suite must keep growing toward the full 99 (VERDICT round-3
+    item 5: >=40 of 99 through the frontend)."""
+    assert len(SQL_QUERIES) >= 40
+    assert set(SQL_QUERIES) <= set(QUERIES)
+
+
+@pytest.mark.parametrize("qname", sorted(SQL_QUERIES,
+                                         key=lambda n: int(n[1:])))
+def test_tpcds_sql_matches_dataframe(qname, sql_session):
+    sess, dfs = sql_session
+    sql_out = sess.sql(SQL_QUERIES[qname]).collect()
+    df_out = QUERIES[qname](dfs).collect()
+    assert_tables_equal(df_out, sql_out, ignore_order=qname in _TIES,
+                        approx_float=1e-7)
