@@ -1,0 +1,159 @@
+"""Bisect which part of the partition kernel fails Mosaic legalization
+under jax_enable_x64 (func.return)."""
+import builtins
+import functools
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401  (x64 on)
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print = functools.partial(builtins.print, flush=True)
+
+W, G, n, L = 512, 8, 8, 112
+groups = 2
+q_w, quota = 128, 1024
+seg_rows = q_w + 32
+cap = groups * G * W
+
+
+def specs():
+    grid = (groups, G)
+    z = np.int32(0)
+    in_specs = [
+        pl.BlockSpec((1, G, W), lambda g, wg: (g, z, z),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W, L), lambda g, wg: (g, wg, z),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_specs = (
+        pl.BlockSpec((n, 1, quota, L), lambda g, wg: (z, g, z, z),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n, 128), lambda g, wg: (g, z, z),
+                     memory_space=pltpu.VMEM),
+    )
+    out_shapes = (
+        jax.ShapeDtypeStruct((n, groups, quota, L), jnp.uint8),
+        jax.ShapeDtypeStruct((groups, n, 128), jnp.int32),
+    )
+    return grid, in_specs, out_specs, out_shapes
+
+
+def run(kernel, name):
+    grid, in_specs, out_specs, out_shapes = specs()
+    try:
+        @jax.jit
+        def f(pid, data):
+            return pl.pallas_call(
+                kernel, out_shape=out_shapes, grid=grid,
+                in_specs=in_specs, out_specs=out_specs,
+                scratch_shapes=[pltpu.SMEM((n,), jnp.int32),
+                                pltpu.VMEM((G * n, W), jnp.int32)],
+            )(pid.reshape(groups, G, W), data.reshape(groups, G * W, L))
+        pid = jnp.zeros((cap,), jnp.int32)
+        data = jnp.zeros((cap, L), jnp.uint8)
+        out = f(pid, data)
+        np.asarray(out[1][:1])
+        print(f"STAGE {name}: OK")
+        return True
+    except Exception as e:
+        msg = str(e)
+        key = ("legalize" if "legalize" in msg else
+               msg.splitlines()[0][:80])
+        print(f"STAGE {name}: FAIL {key}")
+        return False
+
+
+def kA(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+    out_ref[...] = jnp.zeros((n, 1, quota, L), jnp.uint8)
+    cnt_ref[...] = jnp.zeros((1, n, 128), jnp.int32)
+
+
+def kB(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+    wg = pl.program_id(1)
+
+    @pl.when(wg == np.int32(0))
+    def _():
+        r_i = jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+        c_i = jax.lax.broadcasted_iota(jnp.int32, (W, W), 1)
+        tri = (c_i <= r_i).astype(jnp.int8)
+        pids = pid_ref[0]
+        jj = jax.lax.broadcasted_iota(jnp.int32, (G, n, W), 1)
+        m = (pids[:, None, :] == jj).astype(jnp.int8)
+        m2 = m.reshape(G * n, W)
+        cs = jax.lax.dot_general(m2, tri, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.int32)
+        cs_ref[:] = cs
+        for j in range(n):
+            run_ref[j] = 0
+        cnt_ref[...] = jnp.zeros((1, n, 128), jnp.int32)
+    out_ref[...] = jnp.zeros((n, 1, quota, L), jnp.uint8)
+
+
+def kC(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+    kB(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref)
+    wg = pl.program_id(1)
+    p = pid_ref[0, wg, :]
+    d8 = data_ref[0].astype(jnp.int8)
+    cs_w = cs_ref[pl.ds(wg * np.int32(n), n), :]
+    rank = jnp.sum(jnp.where(p[None, :] ==
+                             jax.lax.broadcasted_iota(jnp.int32, (n, W), 0),
+                             cs_w, np.int32(0)),
+                   axis=0, dtype=jnp.int32) - np.int32(1)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n * seg_rows, W), 0)
+    stack = jnp.full((W,), -1, jnp.int32)
+    for j in range(n):
+        stack = jnp.where(p == np.int32(j),
+                          rank + np.int32(j * seg_rows), stack)
+    oh = (rows == stack[None, :]).astype(jnp.int8)
+    segs = jax.lax.dot_general(oh, d8, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+    segs = (segs & 255).astype(jnp.uint8)
+    out_ref[0, 0, pl.ds(np.int32(0), seg_rows), :] = segs[:seg_rows, :]
+
+
+def kD(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+    wg = pl.program_id(1)
+    base_max = np.int32((quota - seg_rows) // 32 * 32)
+    for j in range(n):
+        run = run_ref[j]
+        base = jnp.minimum((run // np.int32(32)) * np.int32(32), base_max)
+        off = run - base
+        bb = pl.multiple_of(base, 32)
+        old = out_ref[j, 0, pl.ds(bb, 32), :]
+        head = jax.lax.broadcasted_iota(jnp.int32, (32, 1), 0) < off
+        seg = jnp.zeros((seg_rows, L), jnp.uint8)
+        seg = jnp.concatenate(
+            [jnp.where(head, old, seg[:32]), seg[32:]], axis=0)
+        out_ref[j, 0, pl.ds(bb, seg_rows), :] = seg
+        run_ref[j] = run + np.int32(1)
+    cnt_ref[...] = jnp.zeros((1, n, 128), jnp.int32)
+
+
+def kE(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+    wg = pl.program_id(1)
+    ovf = jnp.int32(0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 128), 2)
+
+    @pl.when(wg == np.int32(G - 1))
+    def _publish():
+        counts = jnp.stack([run_ref[j] for j in range(n)])
+        stats = jnp.where(lane == np.int32(0), counts[None, :, None],
+                          jnp.where(lane == np.int32(1), ovf, np.int32(0)))
+        cnt_ref[...] = jnp.maximum(stats, cnt_ref[...])
+
+    @pl.when(jnp.logical_and(ovf > np.int32(0), wg < np.int32(G - 1)))
+    def _early():
+        cnt_ref[...] = jnp.maximum(
+            cnt_ref[...],
+            jnp.where(lane == np.int32(1), np.int32(1), np.int32(0)))
+    out_ref[...] = jnp.zeros((n, 1, quota, L), jnp.uint8)
+    for j in range(n):
+        run_ref[j] = 0
+
+
+for name, k in (("A", kA), ("B", kB), ("C", kC), ("D", kD), ("E", kE)):
+    run(k, name)

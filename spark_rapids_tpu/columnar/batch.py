@@ -83,7 +83,13 @@ class DeviceBatch:
             if isinstance(arr, pa.ChunkedArray):
                 arr = (arr.chunk(0) if arr.num_chunks == 1
                        else pa.concat_arrays(arr.chunks))
-            staged.append(_arrow_to_staged(f.dtype, arr, string_max_bytes))
+            d, v, l = _arrow_to_staged(f.dtype, arr, string_max_bytes)
+            # DOUBLE columns also ship their IEEE bit pattern: device f64
+            # STORAGE is true 64-bit but no device op can extract its bits
+            # (f64->u64 bitcast does not lower; arithmetic is ~49-bit), so
+            # the shuffle kernel's byte packing needs the host-made sibling
+            bits = d.view(np.uint64) if f.dtype is DType.DOUBLE else None
+            staged.append((d, v, l, bits))
         up = (jax.device_put(staged, device) if device is not None
               else jax.device_put(staged))
         # shared all-valid mask, on the same device as the data
@@ -92,18 +98,21 @@ class DeviceBatch:
             alive = jax.device_put(alive, device)
         pad = cap - n
         cols = []
-        for f, (d, v, l) in zip(schema, up):
+        for f, (d, v, l, bits) in zip(schema, up):
             if pad:
                 d = jnp.concatenate(
                     [d, jnp.zeros((pad,) + d.shape[1:], d.dtype)], axis=0)
                 if l is not None:
                     l = jnp.concatenate([l, jnp.zeros(pad, l.dtype)], axis=0)
+                if bits is not None:
+                    bits = jnp.concatenate(
+                        [bits, jnp.zeros(pad, bits.dtype)], axis=0)
             if v is not None:
                 validity = (jnp.concatenate([v, jnp.zeros(pad, jnp.bool_)])
                             if pad else v)
             else:
                 validity = alive
-            cols.append(DeviceColumn(f.dtype, d, validity, l))
+            cols.append(DeviceColumn(f.dtype, d, validity, l, bits))
         return DeviceBatch(schema, tuple(cols), n)
 
     def to_arrow(self) -> pa.Table:
@@ -114,12 +123,19 @@ class DeviceBatch:
         n = self.num_rows
         sliced = []
         for col in self.columns:
-            sliced.append((col.data[:n], col.validity[:n],
+            # DOUBLE columns with a bit sibling download the BITS: a device
+            # u64->f64 bitcast rounds to the emulated ~49-bit arithmetic
+            # precision, so the bits are the lossless representation
+            data = col.bits if col.bits is not None else col.data
+            sliced.append((data[:n], col.validity[:n],
                            col.lengths[:n] if col.lengths is not None else None))
         fetched = jax.device_get(sliced)
         arrays: List[pa.Array] = []
         for f, (data, validity, lengths) in zip(self.schema, fetched):
-            arrays.append(_numpy_to_arrow(f.dtype, np.asarray(data),
+            data = np.asarray(data)
+            if f.dtype is DType.DOUBLE and data.dtype == np.uint64:
+                data = data.view(np.float64)
+            arrays.append(_numpy_to_arrow(f.dtype, data,
                                           np.asarray(validity),
                                           None if lengths is None
                                           else np.asarray(lengths), n))

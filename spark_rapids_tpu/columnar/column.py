@@ -36,6 +36,12 @@ class DeviceColumn:
     data: jax.Array                  # [capacity] or [capacity, max_bytes] for strings
     validity: jax.Array              # bool[capacity]
     lengths: Optional[jax.Array] = None  # int32[capacity], strings only
+    #: DOUBLE columns only: the IEEE-754 bit pattern as uint64, kept from
+    #: upload time. The X64-rewritten backend cannot bitcast f64->u64 (only
+    #: u64->f64), so the accelerated shuffle's byte packing needs the bits
+    #: carried alongside; device-computed doubles instead ride an exact
+    #: three-float32 expansion (shuffle/partition_kernel.py).
+    bits: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
@@ -53,6 +59,8 @@ class DeviceColumn:
         total += self.validity.size
         if self.lengths is not None:
             total += self.lengths.size * 4
+        if self.bits is not None:
+            total += self.bits.size * 8
         return total
 
     def __post_init__(self):

@@ -222,6 +222,19 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+SHUFFLE_KERNEL_MODE = _conf(
+    "shuffle.kernel.mode", str, "auto",
+    "Map-side partition reorder strategy: 'auto' uses the fused Pallas "
+    "kernel (one streaming HBM pass: MXU one-hot spread into quota-padded "
+    "partition pieces, 25+ GB/s/chip measured vs 3.8 GB/s for the variadic "
+    "sort) on real TPU backends and the sort path elsewhere; 'interpret' "
+    "forces the kernel in Pallas interpreter mode (tests); 'off' always "
+    "uses the sort path. Overflowing quotas or non-packable batches fall "
+    "back to the sort path automatically.",
+    checker=lambda v: (None if v in ("auto", "interpret", "off")
+                       else f"shuffle.kernel.mode must be auto | interpret"
+                            f" | off, got {v!r}"))
+
 SHUFFLE_FETCH_TIMEOUT = _conf(
     "shuffle.fetch.timeoutSeconds", int, 300,
     "How long a reduce-side reader waits for remote shuffle blocks before "

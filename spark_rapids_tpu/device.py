@@ -11,10 +11,16 @@ applied exactly once:
 from __future__ import annotations
 
 import os
+import sys
 
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Deep traces (the fused shuffle kernel: jit -> pjit -> pallas, with x64
+# promotion wrappers on every op) legitimately exceed CPython's default
+# 1000-frame limit during tracing.
+sys.setrecursionlimit(max(sys.getrecursionlimit(), 20000))
 
 # Persistent XLA compilation cache: compiled executables survive process
 # restarts (measured ~20x on repeated first-compiles over the remote-chip

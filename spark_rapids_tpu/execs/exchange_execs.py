@@ -602,6 +602,14 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         if isinstance(part, SinglePartitioning) or n == 1:
             yield 0, db
             return
+        # fused Pallas reorder (shuffle/partition_kernel.py): one streaming
+        # HBM pass instead of the variadic sort; quota overflow, non-packable
+        # schemas or inexact f64 expansion fall back to the sort path below
+        if bounds is None:
+            pieces = self._kernel_split(ctx, part, db, offset, n)
+            if pieces is not None:
+                yield from pieces
+                return
         bounds_flat = tuple(flatten_colvs(bounds)) if bounds else ()
         nb = bounds[0].validity.shape[0] if bounds else 0
         key = ("exchange", part, schema, cap, smax, nb, offset)
@@ -643,6 +651,44 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
             if cnt == 0:
                 continue
             yield j, _slice_padded(sorted_cols, schema, int(offsets[j]), cnt)
+
+    def _kernel_split(self, ctx, part, db: DeviceBatch, offset: int, n: int):
+        """The fused-kernel split: compute pids (same hash/round-robin math
+        as the sort path), run pack+kernel, consolidate each partition into
+        one DeviceBatch. Returns None when the fast path does not apply —
+        the caller falls back to the sort-based reorder."""
+        from spark_rapids_tpu import config as _cfg
+        from spark_rapids_tpu.shuffle import partition_kernel as pk
+        mode = ctx.conf.get(_cfg.SHUFFLE_KERNEL_MODE)
+        if mode == "off":
+            return None
+        interpret = (mode == "interpret")
+        if not interpret and jax.default_backend() != "tpu":
+            return None
+        if isinstance(part, RangePartitioning):
+            return None                       # bounds path stays on sort
+        schema, cap, smax = db.schema, db.capacity, ctx.string_max_bytes
+        pid_key = ("exchange-pids", part, schema, cap, smax, offset)
+
+        def build(part=part, schema=schema, cap=cap, smax=smax,
+                  offset=offset):
+            def fn(*flat):
+                colvs = _unflatten_colvs(schema, flat)
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                return _compute_pids(jnp, part, ectx, cap, offset, None)
+            return fn
+
+        pids = _cached_jit(pid_key, build)(*_flatten(db))
+        res = pk.split_batch_kernel(db, pids, n, interpret=interpret)
+        if res is None:
+            return None
+        out, stats, spec, geom = res
+        pieces = []
+        for j in range(n):
+            sub = pk.consolidate(out, stats, j, spec, schema, geom)
+            if sub is not None:
+                pieces.append((j, sub))
+        return pieces
 
     def _device_bounds(self, ctx, part: RangePartitioning,
                        staged, n: int) -> Optional[List[ColV]]:

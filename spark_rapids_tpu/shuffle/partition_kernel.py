@@ -1,0 +1,571 @@
+"""Fused partition-reorder kernel: the accelerated map side of the device
+shuffle (GpuPartitioning.scala:44-75 contiguousSplit + Table.partition role).
+
+Round 3 reordered batches with a global variadic sort (3.8 GB/s on this
+chip). This module does it in ONE streaming HBM pass with a Pallas kernel:
+
+  pack     columns -> one (rows, L) byte matrix (XLA; u32 bitcasts fuse into
+           the concatenate — f64 uses upload-time bit siblings or an exact
+           three-float32 expansion, see below)
+  kernel   per 512-row window: partition ranks from a constant triangular
+           int8 matrix batched across the group in one wide MXU dot, then a
+           stacked one-hot int8 dot spreads the window's rows into
+           per-partition segments appended to quota-padded per-(group,
+           partition) staging blocks (25+ GB/s measured on chip)
+  pieces   per (group, partition) quota block + live-count sidecars;
+           `consolidate` block-gathers each partition's full 8-row blocks
+           plus a tiny row-gather of the per-group remainders into one
+           ordinary DeviceBatch (shuffles do not promise intra-partition
+           row order)
+
+Backend constraints discovered by probing (experiments/pallas_probe.py):
+cumsum/sort/gather do not lower in Mosaic TC kernels; the X64 rewriter
+cannot lower any 64-bit-element bitcast (f64->u64, i64->u32, signbit,
+frexp); f64 ARITHMETIC is ~49-bit sloppy while f64 STORAGE is true 64-bit;
+u64->f64 bitcast (the decode direction) works; unaligned uint8 dynamic
+stores crash Mosaic (int32 ones do not). The design routes around each:
+integers split to u32 by exact shifts, doubles ride as upload-time u64 bit
+siblings (decode is the working bitcast direction) or as an exact hi/mid/lo
+float32 expansion validated by an in-program flag, and segment appends use
+32-aligned stores with a blended boundary tile.
+
+Fallback: any overflow (quota or per-window) or f64-expansion inexactness
+flags the batch back to the sort path — correctness never depends on the
+fast path applying.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DType, Schema, bucket_capacity
+
+W = 512                    #: window rows (one spread dot per window)
+GROUP_WINDOWS = 64         #: windows per group (one output piece set each)
+BLOCK = 8                  #: consolidation block rows
+MAX_PARTS = 32             #: wider fan-outs fall back to the sort path
+
+
+# ------------------------------------------------------------------ pack spec
+@dataclass(frozen=True)
+class _ColPlan:
+    dtype: DType
+    kind: str          # u32x1 | u32x2 | f64bits | f64split3 | u8 | string
+    lane: int          # first byte lane of the data bytes
+    nbytes: int        # data byte lanes
+    smax: int = 0      # string byte width
+
+
+@dataclass(frozen=True)
+class PackSpec:
+    """Byte-matrix layout for one batch schema: per-column data lanes, then
+    one validity byte lane per column (order: all data, then validities)."""
+    plans: Tuple[_ColPlan, ...]
+    lanes: int
+
+    @staticmethod
+    def for_batch(batch: DeviceBatch) -> Optional["PackSpec"]:
+        plans: List[_ColPlan] = []
+        lane = 0
+        for f, c in zip(batch.schema, batch.columns):
+            dt = f.dtype
+            if dt is DType.STRING:
+                smax = c.data.shape[1]
+                plans.append(_ColPlan(dt, "string", lane, smax + 4, smax))
+                lane += smax + 4
+            elif dt is DType.DOUBLE:
+                if getattr(c, "bits", None) is not None:
+                    plans.append(_ColPlan(dt, "f64bits", lane, 8))
+                    lane += 8
+                else:
+                    plans.append(_ColPlan(dt, "f64split3", lane, 12))
+                    lane += 12
+            elif dt in (DType.LONG, DType.TIMESTAMP):
+                plans.append(_ColPlan(dt, "u32x2", lane, 8))
+                lane += 8
+            elif dt in (DType.INT, DType.DATE, DType.FLOAT):
+                plans.append(_ColPlan(dt, "u32x1", lane, 4))
+                lane += 4
+            elif dt in (DType.BOOLEAN, DType.BYTE):
+                plans.append(_ColPlan(dt, "u8", lane, 1))
+                lane += 1
+            elif dt is DType.SHORT:
+                plans.append(_ColPlan(dt, "u32x1", lane, 4))
+                lane += 4
+            else:
+                return None                       # NULL etc: sort path
+        return PackSpec(tuple(plans), lane + len(plans))
+
+
+def _u32_bytes(a) -> "jax.Array":
+    return jax.lax.bitcast_convert_type(a.astype(jnp.uint32), jnp.uint8)
+
+
+def _split3(x):
+    """Exact three-float32 expansion of device f64 (device arithmetic holds
+    ~48 significand bits, so hi+mid+lo is exact for every device-COMPUTED
+    value; `ok` is False for full-precision host-uploaded doubles, which
+    carry bit siblings instead)."""
+    hi = x.astype(jnp.float32)
+    r1 = x - hi.astype(jnp.float64)
+    mid = r1.astype(jnp.float32)
+    lo = (r1 - mid.astype(jnp.float64)).astype(jnp.float32)
+    rec = (hi.astype(jnp.float64) + mid.astype(jnp.float64)) \
+        + lo.astype(jnp.float64)
+    ok = jnp.all(jnp.where(jnp.isnan(x), jnp.isnan(rec), rec == x))
+    return hi, mid, lo, ok
+
+
+def pack_matrix(spec: PackSpec, batch_cols: Sequence, validities: Sequence):
+    """Columns -> ((rows, L) u8 matrix, exactness_ok scalar). Runs inside
+    the caller's jit; every bitcast/shift fuses into the one concatenate."""
+    pieces = []
+    ok = jnp.bool_(True)
+    for plan, c in zip(spec.plans, batch_cols):
+        if plan.kind == "string":
+            pieces.append(c.data)
+            pieces.append(_u32_bytes(c.lengths))
+        elif plan.kind == "f64bits":
+            bits = c.bits
+            pieces.append(_u32_bytes(bits & np.uint64(0xFFFFFFFF)))
+            pieces.append(_u32_bytes(bits >> np.uint64(32)))
+        elif plan.kind == "f64split3":
+            hi, mid, lo, good = _split3(c.data)
+            ok = jnp.logical_and(ok, good)
+            for part in (hi, mid, lo):
+                pieces.append(_u32_bytes(
+                    jax.lax.bitcast_convert_type(part, jnp.uint32)))
+        elif plan.kind == "u32x2":
+            x = c.data.astype(jnp.int64)
+            pieces.append(_u32_bytes(x & np.int64(0xFFFFFFFF)))
+            pieces.append(_u32_bytes(jnp.right_shift(x, np.int64(32))))
+        elif plan.kind == "u32x1":
+            if c.data.dtype == jnp.float32:
+                pieces.append(_u32_bytes(
+                    jax.lax.bitcast_convert_type(c.data, jnp.uint32)))
+            else:
+                pieces.append(_u32_bytes(c.data.astype(jnp.int64)
+                                         & np.int64(0xFFFFFFFF)))
+        elif plan.kind == "u8":
+            pieces.append(c.data.astype(jnp.uint8)[:, None])
+        else:
+            raise AssertionError(plan.kind)
+    for v in validities:
+        pieces.append(v.astype(jnp.uint8)[:, None])
+    return jnp.concatenate(pieces, axis=1), ok
+
+
+def unpack_columns(spec: PackSpec, schema: Schema, mat) -> List[DeviceColumn]:
+    """(rows, L) u8 matrix -> DeviceColumns (decode side; u64->f64 bitcast
+    is the direction this backend supports)."""
+    def u32(lane):
+        # arithmetic byte assembly, NOT bitcast_convert_type: bitcasting a
+        # lane SLICE of a u8 matrix silently zeroes low nibbles on this
+        # backend (pack's u32->u8 direction is fine and stays a bitcast)
+        b = [mat[:, lane + k].astype(jnp.uint32) for k in range(4)]
+        return (b[0] | (b[1] << np.uint32(8)) | (b[2] << np.uint32(16))
+                | (b[3] << np.uint32(24)))
+
+    def u64(lane):
+        lo = u32(lane).astype(jnp.uint64)
+        hi = u32(lane + 4).astype(jnp.uint64)
+        return lo | (hi << np.uint64(32))
+
+    nvals = len(spec.plans)
+    cols: List[DeviceColumn] = []
+    for i, (plan, f) in enumerate(zip(spec.plans, schema)):
+        validity = mat[:, spec.lanes - nvals + i] != 0
+        if plan.kind == "string":
+            data = mat[:, plan.lane:plan.lane + plan.smax]
+            lengths = u32(plan.lane + plan.smax).astype(jnp.int32)
+            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+            continue
+        if plan.kind == "f64bits":
+            data = jax.lax.bitcast_convert_type(u64(plan.lane), jnp.float64)
+        elif plan.kind == "f64split3":
+            hi = jax.lax.bitcast_convert_type(u32(plan.lane), jnp.float32)
+            mid = jax.lax.bitcast_convert_type(u32(plan.lane + 4),
+                                               jnp.float32)
+            lo = jax.lax.bitcast_convert_type(u32(plan.lane + 8),
+                                              jnp.float32)
+            data = (hi.astype(jnp.float64) + mid.astype(jnp.float64)) \
+                + lo.astype(jnp.float64)
+        elif plan.kind == "u32x2":
+            data = u64(plan.lane).astype(jnp.int64)
+            if f.dtype is DType.TIMESTAMP:
+                data = data.astype(jnp.int64)
+        elif plan.kind == "u32x1":
+            raw = u32(plan.lane)
+            if f.dtype is DType.FLOAT:
+                data = jax.lax.bitcast_convert_type(raw, jnp.float32)
+            else:
+                data = raw.astype(jnp.int32)
+        elif plan.kind == "u8":
+            raw = mat[:, plan.lane]
+            data = (raw != 0) if f.dtype is DType.BOOLEAN \
+                else raw.astype(jnp.int8)
+        else:
+            raise AssertionError(plan.kind)
+        if plan.kind == "f64bits":
+            col = DeviceColumn(f.dtype, data, validity)
+            object.__setattr__(col, "bits", u64(plan.lane))
+            cols.append(col)
+        else:
+            cols.append(DeviceColumn(f.dtype, data, validity))
+    return cols
+
+
+# ------------------------------------------------------------------ geometry
+@dataclass(frozen=True)
+class KernelGeom:
+    cap: int          # padded row count = groups * G * W
+    groups: int
+    G: int
+    n: int
+    q_w: int          # per-window per-partition segment bound
+    quota: int        # per-(group, partition) piece rows
+    L: int
+
+    @staticmethod
+    def plan(rows: int, n: int, L: int) -> "KernelGeom":
+        G = min(GROUP_WINDOWS, max(1, math.ceil(rows / W)))
+        gw = G * W
+        groups = max(1, math.ceil(rows / gw))
+        cap = groups * gw
+        q_w = min(W, max(64, 2 * math.ceil(W / n)))
+        q_w = (q_w + 7) // 8 * 8
+        seg = q_w + 32
+        quota = max(seg + 32,
+                    math.ceil(1.25 * gw / n))
+        quota = (quota + 511) // 512 * 512
+        return KernelGeom(cap, groups, G, n, q_w, quota, L)
+
+
+def _make_kernel(geom: KernelGeom):
+    G, n, q_w, quota, L = (geom.G, geom.n, geom.q_w, geom.quota, geom.L)
+    wn = geom.cap // W
+    groups = geom.groups
+    seg_rows = q_w + 32
+
+    def kernel(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
+        # 2D grid (group, window-in-group): index maps stay arithmetic-free
+        # (any jnp arithmetic on grid indices under jax_enable_x64 either
+        # recurses in dtype promotion or fails Mosaic legalization)
+        wg = pl.program_id(1)
+
+        @pl.when(wg == np.int32(0))
+        def _prepass():
+            # inclusive running per-partition counts for EVERY window of the
+            # group in one wide dot (a narrow n-lane dot per window would
+            # waste the MXU's 128 output lanes); cumsum does not lower
+            r_i = jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+            c_i = jax.lax.broadcasted_iota(jnp.int32, (W, W), 1)
+            tri = (c_i <= r_i).astype(jnp.int8)
+            pids = pid_ref[0]                       # (G, W)
+            jj = jax.lax.broadcasted_iota(jnp.int32, (G, n, W), 1)
+            m = (pids[:, None, :] == jj).astype(jnp.int8)
+            m2 = m.reshape(G * n, W)
+            cs = jax.lax.dot_general(m2, tri, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.int32)
+            cs_ref[:] = cs
+            for j in range(n):
+                run_ref[j] = 0
+            cnt_ref[...] = jnp.zeros((1, n, 128), jnp.int32)
+
+        p = pid_ref[0, wg, :]
+        d8 = data_ref[0].astype(jnp.int8)
+        cs_w = cs_ref[pl.ds(wg * np.int32(n), n), :]    # (n, W) inclusive
+        rank = jnp.sum(jnp.where(p[None, :] ==
+                                 jax.lax.broadcasted_iota(
+                                     jnp.int32, (n, W), 0),
+                                 cs_w, np.int32(0)),
+                       axis=0, dtype=jnp.int32) - np.int32(1)
+        base_max = np.int32((quota - seg_rows) // 32 * 32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (n * seg_rows, W), 0)
+        stack = jnp.full((W,), -1, jnp.int32)
+        bases, offs, cnts = [], [], []
+        for j in range(n):
+            run = run_ref[j]
+            base = jnp.minimum((run // np.int32(32)) * np.int32(32),
+                               base_max)
+            off = run - base
+            bases.append(base)
+            offs.append(off)
+            cnts.append(cs_w[j, W - 1])
+            stack = jnp.where(p == np.int32(j),
+                              rank + off + np.int32(j * seg_rows), stack)
+        oh = (rows == stack[None, :]).astype(jnp.int8)
+        segs = jax.lax.dot_general(oh, d8, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+        segs = (segs & 255).astype(jnp.uint8)
+
+        ovf = jnp.int32(0)
+        for j in range(n):
+            seg = segs[j * seg_rows:(j + 1) * seg_rows, :]
+            # u8 dynamic stores must be 32-aligned on this backend: write at
+            # the aligned floor (the one-hot already shifted rows by the
+            # residue) and blend the first tile with rows appended earlier
+            bb = pl.multiple_of(bases[j], 32)
+            old = out_ref[j, 0, pl.ds(bb, 32), :]
+            head = jax.lax.broadcasted_iota(jnp.int32, (32, 1), 0) < offs[j]
+            seg = jnp.concatenate(
+                [jnp.where(head, old, seg[:32]), seg[32:]], axis=0)
+            out_ref[j, 0, pl.ds(bb, seg_rows), :] = seg
+            over = jnp.logical_or(
+                cnts[j] > np.int32(q_w),
+                run_ref[j] + cnts[j] > np.int32(quota - seg_rows))
+            ovf = jnp.where(over, jnp.int32(1), ovf)
+            run_ref[j] = run_ref[j] + cnts[j]
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 128), 2)
+
+        @pl.when(wg == np.int32(G - 1))
+        def _publish():
+            counts = jnp.stack([run_ref[j] for j in range(n)])
+            stats = jnp.where(lane == np.int32(0), counts[None, :, None],
+                              jnp.where(lane == np.int32(1), ovf,
+                                        np.int32(0)))
+            cnt_ref[...] = jnp.maximum(stats, cnt_ref[...])
+
+        @pl.when(jnp.logical_and(ovf > np.int32(0),
+                                 wg < np.int32(G - 1)))
+        def _early_ovf():
+            cnt_ref[...] = jnp.maximum(
+                cnt_ref[...],
+                jnp.where(lane == np.int32(1), np.int32(1), np.int32(0)))
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((n, groups, quota, L), jnp.uint8),
+        jax.ShapeDtypeStruct((groups, n, 128), jnp.int32),
+    )
+    # index-map literals pinned to int32: weak-typed 0s trace as int64
+    # under jax_enable_x64 and the Mosaic func.return cannot legalize them
+    z = np.int32(0)
+    grid = (groups, G)
+    in_specs = [
+        pl.BlockSpec((1, G, W), lambda g, wg: (g, z, z),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W, L), lambda g, wg: (g, wg, z),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_specs = (
+        pl.BlockSpec((n, 1, quota, L), lambda g, wg: (z, g, z, z),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, n, 128), lambda g, wg: (g, z, z),
+                     memory_space=pltpu.VMEM),
+    )
+
+    def run(pid2d, data, interpret=False):
+        return pl.pallas_call(
+            kernel, out_shape=out_shapes, grid=grid,
+            in_specs=in_specs, out_specs=out_specs,
+            scratch_shapes=[pltpu.SMEM((n,), jnp.int32),
+                            pltpu.VMEM((G * n, W), jnp.int32)],
+            interpret=interpret,
+        )(pid2d.reshape(groups, G, W),
+          data.reshape(groups, G * W, L))
+    return run
+
+
+# ------------------------------------------------------------------ driver
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+_PROGRAMS: dict = {}
+
+
+def reorder_program(spec: PackSpec, geom: KernelGeom, cap: int,
+                    interpret: bool):
+    """The cached pack+kernel jit: fn(num_rows, pids, *flat) ->
+    (out, stats, pack_exact_ok). ``flat`` is `_deflate` order."""
+    key = ("pkern", spec, geom, cap, interpret)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    kern = _make_kernel(geom)
+
+    def fn(num_rows, pids, *flat):
+        cols = _reflate(spec, flat)
+        mat, ok = _pack(spec, cols)
+        # materialize the packed matrix as-is before it feeds the Pallas
+        # custom call: letting XLA fuse the bitcast/concatenate chain into
+        # the operand zeroes low nibbles of some lanes on this backend
+        mat = jax.lax.optimization_barrier(mat)
+        cap_in = mat.shape[0]
+        live = jnp.arange(cap_in, dtype=jnp.int32) < num_rows
+        pids2 = jnp.where(live, pids, np.int32(-1))
+        pad = geom.cap - cap_in
+        if pad:
+            mat = jnp.concatenate(
+                [mat, jnp.zeros((pad, geom.L), jnp.uint8)], axis=0)
+            pids2 = jnp.concatenate(
+                [pids2, jnp.full((pad,), -1, jnp.int32)])
+        out, stats = kern(pids2.reshape(geom.cap // W, W), mat,
+                          interpret=interpret)
+        return out, stats, ok
+
+    fn = jax.jit(fn)
+    _PROGRAMS[key] = fn
+    return fn
+
+
+def split_batch_kernel(batch: DeviceBatch, pids, n: int,
+                       interpret: Optional[bool] = None):
+    """Run pack+kernel for one batch. Returns (out, stats_host, spec, geom)
+    or None when the batch/partitioning is outside the fast path's envelope
+    (caller falls back to the sort path)."""
+    if n < 2 or n > MAX_PARTS:
+        return None
+    spec = PackSpec.for_batch(batch)
+    if spec is None:
+        return None
+    geom = KernelGeom.plan(batch.capacity, n, spec.lanes)
+    if interpret is None:
+        interpret = _use_interpret()
+    fn = reorder_program(spec, geom, batch.capacity, interpret)
+    out, stats, ok = fn(np.int32(batch.num_rows), pids,
+                        *_deflate(spec, batch))
+    stats_host = np.asarray(stats)
+    if not bool(np.asarray(ok)) or int(stats_host[:, :, 1].max()) > 0:
+        return None                    # inexact f64 expansion or overflow
+    return out, stats_host, spec, geom
+
+
+def _deflate(spec: PackSpec, batch: DeviceBatch) -> List:
+    flat: List = []
+    for plan, c in zip(spec.plans, batch.columns):
+        if plan.kind == "f64bits":
+            flat.append(c.bits)
+        else:
+            flat.append(c.data)
+        flat.append(c.validity)
+        if plan.kind == "string":
+            flat.append(c.lengths)
+    return flat
+
+
+class _PackCol:
+    __slots__ = ("data", "bits", "validity", "lengths")
+
+    def __init__(self, data, bits, validity, lengths):
+        self.data = data
+        self.bits = bits
+        self.validity = validity
+        self.lengths = lengths
+
+
+def _reflate(spec: PackSpec, flat) -> List[_PackCol]:
+    cols = []
+    i = 0
+    for plan in spec.plans:
+        main = flat[i]
+        validity = flat[i + 1]
+        i += 2
+        lengths = None
+        if plan.kind == "string":
+            lengths = flat[i]
+            i += 1
+        if plan.kind == "f64bits":
+            cols.append(_PackCol(None, main, validity, lengths))
+        else:
+            cols.append(_PackCol(main, None, validity, lengths))
+    return cols
+
+
+def _pack(spec: PackSpec, cols: Sequence[_PackCol]):
+    return pack_matrix(spec, cols, [c.validity for c in cols])
+
+
+def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
+                schema: Schema, geom: KernelGeom,
+                smax_uniform: bool = True) -> Optional[DeviceBatch]:
+    """Partition j's quota-padded pieces -> ONE DeviceBatch: block-gather of
+    every full 8-row block plus a tiny row-gather of per-group remainders
+    (shuffle makes no intra-partition order promise). Returns None for an
+    empty partition."""
+    counts = stats_host[:, j, 0].astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return None
+    quota = geom.quota
+    nb = counts // BLOCK
+    rem = counts - nb * BLOCK
+    qb = quota // BLOCK
+    # host-built gather indices (small: <= cap/8 block ids + <=7*groups rows)
+    block_idx = np.concatenate(
+        [g * qb + np.arange(nbg, dtype=np.int64)
+         for g, nbg in enumerate(nb)]) if nb.sum() else \
+        np.zeros(0, np.int64)
+    rem_idx = np.concatenate(
+        [g * quota + nbg * BLOCK + np.arange(r, dtype=np.int64)
+         for g, (nbg, r) in enumerate(zip(nb, rem)) if r]) if rem.sum() \
+        else np.zeros(0, np.int64)
+    bucket = bucket_capacity(total)
+    key = ("pconsol", spec, geom, j, int(block_idx.size), int(rem_idx.size),
+           bucket)
+
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        def build(nblocks=int(block_idx.size), nrem=int(rem_idx.size),
+                  bucket=bucket, j=j):
+            def f(out_arr, bidx, ridx):
+                x = out_arr[j].reshape(geom.groups * geom.quota, geom.L)
+                xb = x.reshape(geom.groups * geom.quota // BLOCK,
+                               BLOCK * geom.L)
+                full = jnp.take(xb, bidx, axis=0).reshape(
+                    nblocks * BLOCK, geom.L)
+                rows = jnp.take(x, ridx, axis=0)
+                mat = jnp.concatenate([full, rows], axis=0)
+                pad = bucket - (nblocks * BLOCK + nrem)
+                if pad:
+                    mat = jnp.concatenate(
+                        [mat, jnp.zeros((pad, geom.L), jnp.uint8)], axis=0)
+                # materialize before decoding: fusing the block gather into
+                # the lane-slice bitcasts zeroes low nibbles of some lanes
+                # on this backend (same bug class as the pack side)
+                mat = jax.lax.optimization_barrier(mat)
+                cols = unpack_columns(spec, schema, mat)
+                out_flat = []
+                for c in cols:
+                    out_flat.append(c.data)
+                    out_flat.append(c.validity)
+                    if c.lengths is not None:
+                        out_flat.append(c.lengths)
+                    b = getattr(c, "bits", None)
+                    if b is not None:
+                        out_flat.append(b)
+                return tuple(out_flat)
+            return jax.jit(f)
+        fn = build()
+        _PROGRAMS[key] = fn
+
+    res = fn(out, jnp.asarray(block_idx.astype(np.int32)),
+             jnp.asarray(rem_idx.astype(np.int32)))
+    cols: List[DeviceColumn] = []
+    i = 0
+    for plan, f in zip(spec.plans, schema):
+        data = res[i]
+        validity = res[i + 1]
+        i += 2
+        lengths = None
+        if plan.kind == "string":
+            lengths = res[i]
+            i += 1
+        col = DeviceColumn(f.dtype, data, validity, lengths)
+        if plan.kind == "f64bits":
+            object.__setattr__(col, "bits", res[i])
+            i += 1
+        cols.append(col)
+    return DeviceBatch(schema, tuple(cols), total)

@@ -183,3 +183,13 @@ class Select(Node):
     #: WITH clause: (name, query) in definition order (non-recursive; later
     #: CTEs may reference earlier ones)
     ctes: Tuple[Tuple[str, "Select"], ...] = ()
+
+
+@dataclass(frozen=True)
+class SetOp(Node):
+    """UNION [ALL] chain (left-folded). Members are full SELECTs; ORDER
+    BY/LIMIT written inside a member bind to that member."""
+    op: str                                # union_all | union
+    left: Node                             # Select | SetOp
+    right: Node                            # Select
+    ctes: Tuple[Tuple[str, "Select"], ...] = ()

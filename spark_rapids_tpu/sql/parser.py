@@ -71,6 +71,17 @@ class Parser:
                            f"at {self.peek().pos}")
 
     # ---- statement ---------------------------------------------------------
+    def parse_query(self) -> A.Node:
+        """SELECT (UNION [ALL] SELECT)* — the set-op chain derived tables
+        and CTE bodies accept (TpcdsLikeSpark's multi-channel unions)."""
+        q: A.Node = self.parse_select()
+        while self.at_kw("union"):
+            self.next()
+            all_ = self.eat_kw("all")
+            r = self.parse_select()
+            q = A.SetOp("union_all" if all_ else "union", q, r)
+        return q
+
     def parse_select(self) -> A.Select:
         self.expect_kw("select")
         distinct = self.eat_kw("distinct")
@@ -237,7 +248,7 @@ class Parser:
     def _relation(self) -> A.Node:
         if self.at_op("("):
             self.next()
-            q = self.parse_select()
+            q = self.parse_query()
             self.expect_op(")")
             self.eat_kw("as")
             alias = self._ident()
@@ -489,7 +500,7 @@ class Parser:
         return name
 
 
-def parse_sql(text: str) -> A.Select:
+def parse_sql(text: str) -> A.Node:
     import dataclasses
     p = Parser(text)
     ctes = []
@@ -498,12 +509,12 @@ def parse_sql(text: str) -> A.Select:
             name = p._ident().lower()
             p.eat_kw("as")
             p.expect_op("(")
-            q = p.parse_select()
+            q = p.parse_query()
             p.expect_op(")")
             ctes.append((name, q))
             if not p.eat_op(","):
                 break
-    stmt = p.parse_select()
+    stmt = p.parse_query()
     if p.peek().kind != "EOF":
         t = p.peek()
         raise SqlError(f"trailing input at {t.pos}: {t.value!r}")

@@ -463,10 +463,24 @@ class SqlPlanner:
         return f"__{stem}{self._hidden}"
 
     # ---- entry -------------------------------------------------------------
-    def plan(self, stmt: A.Select, outer: Optional[Scope] = None):
-        """Plan one SELECT. Returns (DataFrame, output column names)."""
+    def plan(self, stmt: A.Node, outer: Optional[Scope] = None):
+        """Plan one SELECT or UNION chain. Returns (DataFrame, names)."""
         for name, q in stmt.ctes:
             self._ctes[name] = q     # later CTEs may reference earlier ones
+        if isinstance(stmt, A.SetOp):
+            ldf, lnames = self.plan(stmt.left, outer)
+            rdf, rnames = self.plan(stmt.right, outer)
+            if len(lnames) != len(rnames):
+                raise SqlError(
+                    f"UNION arms have {len(lnames)} vs {len(rnames)} columns")
+            # positional union (SQL semantics): right arm renamed to the
+            # left arm's output names
+            rdf = rdf.select(*[col(rn).alias(ln)
+                               for rn, ln in zip(rnames, lnames)])
+            df = ldf.union(rdf)
+            if stmt.op == "union":      # UNION (distinct)
+                df = df.distinct()
+            return df, lnames
         if not stmt.relations:
             # FROM-less SELECT (constants): plan over a one-row dummy
             # relation (Spark's OneRowRelation)

@@ -1,0 +1,153 @@
+"""Fused partition-reorder kernel (shuffle/partition_kernel.py): pack ->
+Pallas kernel -> consolidate, in interpreter mode on the CPU backend (the
+real-chip numbers live in bench.py). The reorder must move every live row to
+exactly one partition piece bit-exactly; intra-partition ORDER is not
+promised (shuffle semantics)."""
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.shuffle import partition_kernel as pk
+
+
+def _table(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "l": pa.array(rng.integers(-2**62, 2**62, n), type=pa.int64()),
+        "i": pa.array(rng.integers(-2**31, 2**31 - 1, n), type=pa.int32()),
+        "d": pa.array(np.round(rng.standard_normal(n) * 1e6, 2)),
+        "s": pa.array([f"s{int(x)}" for x in rng.integers(0, 1000, n)]),
+        "b": pa.array(rng.random(n) < 0.5),
+        "dt": pa.array([datetime.date(2020, 1, 1)
+                        + datetime.timedelta(days=int(x))
+                        for x in rng.integers(0, 1000, n)],
+                       type=pa.date32()),
+        "ts": pa.array(rng.integers(0, 2**45, n), type=pa.timestamp("us")),
+    })
+
+
+def _with_nulls(t, seed=1):
+    rng = np.random.default_rng(seed)
+    cols = []
+    for name in t.column_names:
+        arr = t.column(name).combine_chunks()
+        mask = rng.random(len(arr)) < 0.1
+        cols.append(pa.array(arr.to_pylist(), type=arr.type,
+                             mask=mask))
+    return pa.table(dict(zip(t.column_names, cols)))
+
+
+def _run(table, n_parts, seed=3):
+    import jax.numpy as jnp
+    batch = DeviceBatch.from_arrow(table, string_max_bytes=16)
+    rng = np.random.default_rng(seed)
+    pids_np = rng.integers(0, n_parts, batch.capacity).astype(np.int32)
+    res = pk.split_batch_kernel(batch, jnp.asarray(pids_np), n_parts,
+                                interpret=True)
+    assert res is not None, "fast path unexpectedly refused the batch"
+    out, stats, spec, geom = res
+    pieces = {}
+    for j in range(n_parts):
+        sub = pk.consolidate(out, stats, j, spec, batch.schema, geom)
+        if sub is not None:
+            pieces[j] = sub.to_arrow()
+    return batch, pids_np, pieces
+
+
+def _rows_key(t):
+    """Order-independent multiset of row tuples (timestamps normalized —
+    the engine returns UTC-aware values, Spark's UTC-only semantics)."""
+    def norm(v):
+        return v.replace(tzinfo=None) if isinstance(v, datetime.datetime) \
+            else v
+    cols = [[norm(v) for v in t.column(i).to_pylist()]
+            for i in range(t.num_columns)]
+    return sorted(zip(*cols), key=repr)
+
+
+@pytest.mark.parametrize("n_parts", [2, 4, 8])
+def test_kernel_reorder_matches_reference(n_parts):
+    table = _table(700)
+    batch, pids, pieces = _run(table, n_parts)
+    live_pids = pids[:table.num_rows]
+    for j in range(n_parts):
+        want = table.filter(pa.array(live_pids == j))
+        got = pieces.get(j)
+        if want.num_rows == 0:
+            assert got is None or got.num_rows == 0
+            continue
+        assert got is not None and got.num_rows == want.num_rows, (
+            f"partition {j}: {got and got.num_rows} != {want.num_rows}")
+        assert _rows_key(got) == _rows_key(want), f"partition {j} differs"
+
+
+def test_kernel_reorder_with_nulls():
+    table = _with_nulls(_table(500, seed=7), seed=8)
+    batch, pids, pieces = _run(table, 4, seed=9)
+    live = pids[:table.num_rows]
+    total = sum(p.num_rows for p in pieces.values())
+    assert total == table.num_rows
+    for j in range(4):
+        want = table.filter(pa.array(live == j))
+        if want.num_rows:
+            assert _rows_key(pieces[j]) == _rows_key(want)
+
+
+def test_kernel_refuses_wide_fanout():
+    import jax.numpy as jnp
+    batch = DeviceBatch.from_arrow(_table(100), string_max_bytes=16)
+    pids = jnp.zeros(batch.capacity, jnp.int32)
+    assert pk.split_batch_kernel(batch, pids, pk.MAX_PARTS + 1,
+                                 interpret=True) is None
+
+
+def test_kernel_overflow_falls_back():
+    """Every row in one partition: the per-window segment bound (2x the
+    even share) must overflow and return None (caller uses the sort path)."""
+    import jax.numpy as jnp
+    table = _table(600)
+    batch = DeviceBatch.from_arrow(table, string_max_bytes=16)
+    pids = jnp.zeros(batch.capacity, jnp.int32)   # all -> partition 0
+    assert pk.split_batch_kernel(batch, pids, 8, interpret=True) is None
+
+
+def test_uploaded_doubles_carry_bit_siblings():
+    batch = DeviceBatch.from_arrow(_table(50), string_max_bytes=16)
+    dcol = batch.columns[2]
+    assert dcol.bits is not None
+    # the f64 view is the bitcast of the bits
+    assert np.asarray(dcol.data).view(np.uint64).tolist() == \
+        np.asarray(dcol.bits).tolist()
+
+
+def test_exchange_kernel_mode_matches_sort_path():
+    """The engine's device exchange through the fused kernel (interpreter
+    mode) must produce the same query results as the sort path."""
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    rng = np.random.default_rng(11)
+    n = 3000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+        "v": pa.array(np.round(rng.standard_normal(n) * 100, 2)),
+        "s": pa.array([f"x{int(i)}" for i in rng.integers(0, 30, n)]),
+    })
+
+    def q(sess):
+        return (sess.create_dataframe(t).repartition(4, "k")
+                .groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("s").alias("c"))
+                .sort("k"))
+
+    conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+    fast = TpuSession({**conf,
+                       "spark.rapids.tpu.shuffle.kernel.mode": "interpret"})
+    slow = TpuSession({**conf, "spark.rapids.tpu.shuffle.kernel.mode": "off"})
+    out_fast = q(fast).collect()
+    out_slow = q(slow).collect()
+    assert_tables_equal(out_slow, out_fast, approx_float=1e-9)

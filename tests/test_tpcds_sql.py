@@ -28,6 +28,26 @@ _TIES = {"q19", "q27", "q34", "q42", "q46", "q52", "q55", "q65", "q68",
          "q12", "q98", "q33", "q56", "q60"}
 
 
+_RAN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_cache_clear():
+    """40 SQL+DataFrame query pairs compile hundreds of XLA programs in ONE
+    module; the per-module clear (conftest) is not enough — LLVM compiles
+    near the end of the module die under the accumulated heap. Clear every
+    few queries; the persistent on-disk cache keeps recompiles cheap."""
+    yield
+    _RAN["n"] += 1
+    if _RAN["n"] % 6 == 0:
+        import jax
+        jax.clear_caches()
+        from spark_rapids_tpu.execs import evaluator, tpu_execs
+        if hasattr(tpu_execs, "_JIT_CACHE"):
+            tpu_execs._JIT_CACHE.clear()
+        evaluator._JIT_CACHE.clear()
+
+
 @pytest.fixture(scope="module")
 def sql_session():
     tables = gen_all(_SCALE, seed=0)
