@@ -414,7 +414,15 @@ def reorder_program(spec: PackSpec, geom: KernelGeom, cap: int,
                 [pids2, jnp.full((pad,), -1, jnp.int32)])
         out, stats = kern(pids2.reshape(geom.cap // W, W), mat,
                           interpret=interpret)
-        return out, stats, ok
+        # one SMALL host download serves counts + overflow + pack-ok: the
+        # tunnel round trip dominates, so ship a compact summary vector
+        # [ok, counts(groups*n), ovf_max] instead of the padded stats block
+        counts = stats[:, :, 0].reshape(-1)
+        ovf = jnp.max(stats[:, :, 1])
+        summary = jnp.concatenate(
+            [ok.astype(jnp.int32)[None], counts,
+             ovf.astype(jnp.int32)[None]])
+        return out, summary
 
     fn = jax.jit(fn)
     _PROGRAMS[key] = fn
@@ -435,11 +443,14 @@ def split_batch_kernel(batch: DeviceBatch, pids, n: int,
     if interpret is None:
         interpret = _use_interpret()
     fn = reorder_program(spec, geom, batch.capacity, interpret)
-    out, stats, ok = fn(np.int32(batch.num_rows), pids,
-                        *_deflate(spec, batch))
-    stats_host = np.asarray(stats)
-    if not bool(np.asarray(ok)) or int(stats_host[:, :, 1].max()) > 0:
+    out, summary = fn(np.int32(batch.num_rows), pids,
+                      *_deflate(spec, batch))
+    summary = np.asarray(summary)          # ONE small host round trip
+    ok, counts, ovf = summary[0], summary[1:-1], summary[-1]
+    if not ok or ovf > 0:
         return None                    # inexact f64 expansion or overflow
+    stats_host = np.zeros((geom.groups, geom.n, 2), np.int32)
+    stats_host[:, :, 0] = counts.reshape(geom.groups, geom.n)
     return out, stats_host, spec, geom
 
 
@@ -489,12 +500,16 @@ def _pack(spec: PackSpec, cols: Sequence[_PackCol]):
 
 
 def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
-                schema: Schema, geom: KernelGeom,
-                smax_uniform: bool = True) -> Optional[DeviceBatch]:
+                schema: Schema, geom: KernelGeom) -> Optional[DeviceBatch]:
     """Partition j's quota-padded pieces -> ONE DeviceBatch: block-gather of
-    every full 8-row block plus a tiny row-gather of per-group remainders
+    every full 8-row block plus a row-gather of per-group remainders
     (shuffle makes no intra-partition order promise). Returns None for an
-    empty partition."""
+    empty partition.
+
+    The program is SHAPE-STABLE: gather index vectors are padded to
+    power-of-two buckets and the partition index rides as data, so one
+    compiled program serves every partition of every exchange with this
+    geometry — per-exchange counts only change the (tiny) index uploads."""
     counts = stats_host[:, j, 0].astype(np.int64)
     total = int(counts.sum())
     if total == 0:
@@ -503,38 +518,54 @@ def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
     nb = counts // BLOCK
     rem = counts - nb * BLOCK
     qb = quota // BLOCK
-    # host-built gather indices (small: <= cap/8 block ids + <=7*groups rows)
-    block_idx = np.concatenate(
-        [g * qb + np.arange(nbg, dtype=np.int64)
-         for g, nbg in enumerate(nb)]) if nb.sum() else \
-        np.zeros(0, np.int64)
-    rem_idx = np.concatenate(
-        [g * quota + nbg * BLOCK + np.arange(r, dtype=np.int64)
-         for g, (nbg, r) in enumerate(zip(nb, rem)) if r]) if rem.sum() \
-        else np.zeros(0, np.int64)
-    bucket = bucket_capacity(total)
-    key = ("pconsol", spec, geom, j, int(block_idx.size), int(rem_idx.size),
-           bucket)
+    # vectorized index build: block b of group g -> flat block g*qb + b;
+    # remainder row r of group g -> flat row g*quota + nb[g]*BLOCK + r
+    nb_tot = int(nb.sum())
+    gid = np.repeat(np.arange(len(nb)), nb)
+    within = np.arange(nb_tot) - np.repeat(np.cumsum(nb) - nb, nb)
+    block_idx = (gid * qb + within).astype(np.int32)
+    rem_tot = int(rem.sum())
+    rgid = np.repeat(np.arange(len(rem)), rem)
+    rwithin = np.arange(rem_tot) - np.repeat(np.cumsum(rem) - rem, rem)
+    rem_idx = (rgid * quota + nb[rgid] * BLOCK + rwithin).astype(np.int32)
 
+    bucket = bucket_capacity(total)
+    bi_cap = bucket_capacity(max(1, nb_tot))
+    ri_cap = bucket_capacity(max(1, rem_tot))
+    # pad with repeats of slot 0: the gathered garbage rows land beyond the
+    # live prefix of the bucketed matrix (positional aliveness masks them)
+    bi = np.zeros(bi_cap, np.int32)
+    bi[:nb_tot] = block_idx
+    ri = np.zeros(ri_cap, np.int32)
+    ri[:rem_tot] = rem_idx
+
+    key = ("pconsol", spec, geom, bi_cap, ri_cap, bucket)
     fn = _PROGRAMS.get(key)
     if fn is None:
-        def build(nblocks=int(block_idx.size), nrem=int(rem_idx.size),
-                  bucket=bucket, j=j):
-            def f(out_arr, bidx, ridx):
-                x = out_arr[j].reshape(geom.groups * geom.quota, geom.L)
+        def build(bi_cap=bi_cap, ri_cap=ri_cap, bucket=bucket):
+            def f(out_arr, jv, nb8, bidx, ridx):
+                x = jax.lax.dynamic_index_in_dim(
+                    out_arr, jv, axis=0, keepdims=False)
+                x = x.reshape(geom.groups * geom.quota, geom.L)
                 xb = x.reshape(geom.groups * geom.quota // BLOCK,
                                BLOCK * geom.L)
                 full = jnp.take(xb, bidx, axis=0).reshape(
-                    nblocks * BLOCK, geom.L)
+                    bi_cap * BLOCK, geom.L)
                 rows = jnp.take(x, ridx, axis=0)
-                mat = jnp.concatenate([full, rows], axis=0)
-                pad = bucket - (nblocks * BLOCK + nrem)
-                if pad:
-                    mat = jnp.concatenate(
-                        [mat, jnp.zeros((pad, geom.L), jnp.uint8)], axis=0)
-                # materialize before decoding: fusing the block gather into
-                # the lane-slice bitcasts zeroes low nibbles of some lanes
-                # on this backend (same bug class as the pack side)
+                # contiguity under bucketed index shapes: write the padded
+                # full-block region first, then the remainder rows AT the
+                # live boundary (nb8 = true full-block rows) — remainder
+                # data overwrites the block padding, its own padding tail
+                # lands beyond the live prefix
+                work = jnp.zeros((bucket + bi_cap * BLOCK + ri_cap, geom.L),
+                                 jnp.uint8)
+                work = jax.lax.dynamic_update_slice(
+                    work, full, (np.int32(0), np.int32(0)))
+                work = jax.lax.dynamic_update_slice(
+                    work, rows, (nb8, np.int32(0)))
+                mat = work[:bucket]
+                # materialize before decoding: fusing the gather into the
+                # lane extraction corrupts lanes on this backend
                 mat = jax.lax.optimization_barrier(mat)
                 cols = unpack_columns(spec, schema, mat)
                 out_flat = []
@@ -551,8 +582,8 @@ def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
         fn = build()
         _PROGRAMS[key] = fn
 
-    res = fn(out, jnp.asarray(block_idx.astype(np.int32)),
-             jnp.asarray(rem_idx.astype(np.int32)))
+    res = fn(out, np.int32(j), np.int32(nb_tot * BLOCK),
+             jnp.asarray(bi), jnp.asarray(ri))
     cols: List[DeviceColumn] = []
     i = 0
     for plan, f in zip(spec.plans, schema):
@@ -563,9 +594,9 @@ def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
         if plan.kind == "string":
             lengths = res[i]
             i += 1
-        col = DeviceColumn(f.dtype, data, validity, lengths)
+        bits = None
         if plan.kind == "f64bits":
-            object.__setattr__(col, "bits", res[i])
+            bits = res[i]
             i += 1
-        cols.append(col)
+        cols.append(DeviceColumn(f.dtype, data, validity, lengths, bits))
     return DeviceBatch(schema, tuple(cols), total)
