@@ -222,6 +222,14 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+SCAN_PREFETCH_BATCHES = _conf(
+    "io.scan.prefetchBatches", int, 2,
+    "Device parquet scans decode and upload this many chunks ahead of the "
+    "consumer on a producer thread, overlapping host decode with the "
+    "asynchronous host->device transfer and device compute (the "
+    "bufferTime/gpuDecodeTime overlap in GpuParquetScan). 0 reads "
+    "serially.")
+
 SHUFFLE_KERNEL_MODE = _conf(
     "shuffle.kernel.mode", str, "auto",
     "Map-side partition reorder strategy: 'auto' uses the fused Pallas "

@@ -180,15 +180,52 @@ class CpuParquetScanExec(_ParquetScanBase):
 
 
 class TpuParquetScanExec(_ParquetScanBase):
-    """Host-staged read + single upload per batch into bucketed device buffers."""
+    """Host-staged read + single upload per batch into bucketed device
+    buffers. Cold scans PIPELINE: a producer thread decodes/stage-uploads
+    the next chunks while the consumer computes on the current one
+    (device_put is asynchronous, so chunk k+1's host decode overlaps chunk
+    k's transfer and compute — the bufferTime/gpuDecodeTime overlap of
+    GpuParquetScan.scala:342-478)."""
 
     is_device = True
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        for t in self._iter_arrow(ctx):
-            b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
-            self.count_output(b.num_rows)
-            yield b
+        from spark_rapids_tpu import config as _cfg
+        depth = ctx.conf.get(_cfg.SCAN_PREFETCH_BATCHES)
+        if depth <= 0:
+            for t in self._iter_arrow(ctx):
+                b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
+                self.count_output(b.num_rows)
+                yield b
+            return
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        smax = ctx.string_max_bytes
+
+        def produce() -> None:
+            try:
+                for t in self._iter_arrow(ctx):
+                    # staging + device_put happen HERE, ahead of the
+                    # consumer; the upload is already in flight when the
+                    # consumer dequeues the batch
+                    q.put(("b", DeviceBatch.from_arrow(t, smax)))
+            except BaseException as e:  # noqa: BLE001 - reraised below
+                q.put(("e", e))
+                return
+            q.put(("end", None))
+
+        worker = threading.Thread(target=produce, daemon=True,
+                                  name="parquet-scan-prefetch")
+        worker.start()
+        while True:
+            kind, val = q.get()
+            if kind == "end":
+                break
+            if kind == "e":
+                raise val
+            self.count_output(val.num_rows)
+            yield val
 
 
 def write_parquet(table: pa.Table, path: str, compression: str = "snappy") -> None:

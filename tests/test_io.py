@@ -402,3 +402,33 @@ def test_input_file_meta_through_projections(tmp_path):
                    u.collect().column("fn").to_pylist()))
     assert got[99] == ""
     assert got[3].endswith("f0.parquet")
+
+
+def test_parquet_scan_prefetch_matches_serial(tmp_path):
+    """The decode-ahead pipelined scan (io.scan.prefetchBatches) must
+    produce exactly the serial read's results."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    t = pa.table({"k": rng.integers(0, 50, n).astype(np.int64),
+                  "v": np.round(rng.standard_normal(n), 3),
+                  "s": pa.array([f"r{int(x)}" for x in
+                                 rng.integers(0, 90, n)])})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path, row_group_size=1500)
+
+    def q(prefetch):
+        sess = TpuSession({
+            "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+            "spark.rapids.tpu.sql.scanCache.enabled": "false",
+            "spark.rapids.tpu.io.scan.prefetchBatches": str(prefetch)})
+        return (sess.read.parquet(path).groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.count("s").alias("c"))
+                .sort("k")).collect()
+
+    assert_tables_equal(q(0), q(3), approx_float=1e-9)
