@@ -735,9 +735,65 @@ class SqlPlanner:
             return self._exists(df, scope, pred), scope
         if isinstance(pred, A.InSubquery):
             return self._in_subquery(df, scope, pred), scope
+        # IN subqueries embedded in a larger predicate (e.g. under OR —
+        # q45's zip-or-item-subset shape): existence join — left join a
+        # distinct flag and substitute `flag IS NOT NULL` (Catalyst's
+        # ExistenceJoin role)
+        df, scope, pred = self._existence_flags(df, scope, pred)
         # comparison containing scalar subqueries
         df, scope, pred = self._lift_scalars(df, scope, pred)
         return df.filter(to_column(pred, scope)), scope
+
+    def _existence_flags(self, df, scope, pred: A.Node):
+        """Replace each embedded UNCORRELATED `x IN (subquery)` with a
+        left-join existence flag reference. Null probe values produce a
+        null flag, which reads as FALSE — the same contract as the
+        DataFrame translations' `m_flag.isNotNull()`."""
+        import dataclasses
+
+        def walk(node):
+            nonlocal df, scope
+            if isinstance(node, A.InSubquery):
+                if node.negated:
+                    raise SqlError("NOT IN subqueries inside OR are not "
+                                   "supported (three-valued semantics)")
+                eq_pairs, other = self._correlation(node.query, scope)
+                if eq_pairs or other:
+                    raise SqlError("correlated IN subqueries inside OR are "
+                                   "not supported")
+                sub_df, names = self.plan(node.query)
+                if len(names) != 1:
+                    raise SqlError(
+                        "IN subquery must select exactly one column")
+                flag = self._name("exists")
+                key = self._name("ek")
+                sub_df = (sub_df.select(col(names[0]).alias(key))
+                          .dropDuplicates()
+                          .withColumn(flag, F.lit(1)))
+                oc, df = self._key_col(df, node.value, scope)
+                df = df.join(sub_df, [(oc, key)], "left")
+                scope.extras.append(flag)
+                return A.IsNull(A.ColRef(flag), True)
+            if not isinstance(node, A.Node) or \
+                    isinstance(node, (A.Select, A.SetOp, A.ScalarSubquery,
+                                      A.ExistsSubquery)):
+                return node
+            changes = {}
+            for f in node.__dataclass_fields__:
+                v = getattr(node, f)
+                if isinstance(v, A.Node):
+                    nv = walk(v)
+                    if nv is not v:
+                        changes[f] = nv
+                elif isinstance(v, tuple):
+                    nv = tuple(walk(x) if isinstance(x, A.Node) else x
+                               for x in v)
+                    if any(a is not b for a, b in zip(nv, v)):
+                        changes[f] = nv
+            return dataclasses.replace(node, **changes) if changes else node
+
+        new_pred = walk(pred)       # mutates df/scope via nonlocal FIRST
+        return df, scope, new_pred
 
     def _split_correlation(self, stmt: A.Select, inner_scope: Scope,
                            outer_scope: Scope):
