@@ -35,6 +35,40 @@ _QUERIES = ("q3", "q6", "q7", "q8", "q9", "q12", "q13", "q15", "q17",
             "q90", "q91", "q92", "q93", "q94", "q96", "q97", "q98", "q99")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_cache_writes():
+    """PJRT executable.serialize() of the heaviest mesh programs segfaults
+    under the suite's accumulated C++ heap (deterministically around the
+    37th query; each query passes in isolation). Disable persistent-cache
+    WRITES for this module — reads still serve cached programs."""
+    from jax._src import compilation_cache as cc
+    orig = cc.put_executable_and_time
+    cc.put_executable_and_time = lambda *a, **k: None
+    yield
+    cc.put_executable_and_time = orig
+
+
+_RAN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_cache_clear():
+    """Dozens of distributed query plans compile hundreds of XLA programs
+    in ONE module; free compiled-executable memory every few tests."""
+    yield
+    _RAN["n"] += 1
+    if _RAN["n"] % 4 == 0:
+        import gc
+
+        import jax
+        jax.clear_caches()
+        from spark_rapids_tpu.execs import evaluator, tpu_execs
+        if hasattr(tpu_execs, "_JIT_CACHE"):
+            tpu_execs._JIT_CACHE.clear()
+        evaluator._JIT_CACHE.clear()
+        gc.collect()
+
+
 @pytest.fixture(scope="module")
 def tables():
     return gen_all(_SCALE, seed=0)
