@@ -351,6 +351,7 @@ def _bench_query_suite(suite: str, scale: float, iters: int) -> dict:
     per_query = {}
     tpu_times, cpu_times = [], []
     for q in names:
+        print(f"[suite] {q} ...", file=sys.stderr, flush=True)
         query = QUERIES[q]
         # identical treatment on both engines: one discarded warm-up run,
         # then best-of-iters (no cold-start asymmetry in vs_baseline)
@@ -369,6 +370,8 @@ def _bench_query_suite(suite: str, scale: float, iters: int) -> dict:
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
         assert tpu_rows == cpu_rows, f"{q}: {tpu_rows} != {cpu_rows}"
+        print(f"[suite] {q} tpu={best:.3f}s cpu={cpu_s:.3f}s",
+              file=sys.stderr, flush=True)
         per_query[q] = {"tpu_s": round(best, 4), "cpu_s": round(cpu_s, 4),
                         "rows": tpu_rows}
         tpu_times.append(best)

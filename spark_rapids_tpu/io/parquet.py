@@ -190,8 +190,15 @@ class TpuParquetScanExec(_ParquetScanBase):
     is_device = True
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        import os as _os
+
         from spark_rapids_tpu import config as _cfg
         depth = ctx.conf.get(_cfg.SCAN_PREFETCH_BATCHES)
+        if (_os.cpu_count() or 1) < 2:
+            # decode-ahead needs a spare core: on a single-core host the
+            # producer thread only contends with the consumer (measured 18%
+            # SLOWER on the 1-core bench machine)
+            depth = 0
         if depth <= 0:
             for t in self._iter_arrow(ctx):
                 b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
