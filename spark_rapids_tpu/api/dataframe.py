@@ -259,10 +259,33 @@ class DataFrame:
         # restore the original column order
         return agg.select(*all_names)
 
+    # ---- caching -------------------------------------------------------------
+    def cache(self) -> "DataFrame":
+        """Mark this DataFrame's plan for caching (lazy, like Spark): the
+        first action materializes its batches into the spillable device
+        store; later plans containing this subtree scan the cache."""
+        return self.persist()
+
+    def persist(self, storage_level: Optional[str] = None) -> "DataFrame":
+        # every Spark storage level lands in the same tiered store here:
+        # DEVICE first, spilling host->disk under pressure
+        self.session.cache_manager.add(self._plan)
+        return self
+
+    def unpersist(self, blocking: bool = False) -> "DataFrame":
+        self.session.cache_manager.remove(self._plan)
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        return self.session.cache_manager.lookup(self._plan) is not None
+
     # ---- actions -------------------------------------------------------------
-    def _executed_plan(self) -> PhysicalExec:
+    def _executed_plan(self, prepared=None) -> PhysicalExec:
         from spark_rapids_tpu import config as _cfg
-        cpu_plan = plan_physical(self._plan, self.session.conf)
+        logical = (prepared if prepared is not None
+                   else self.session.cache_manager.prepare(self._plan))
+        cpu_plan = plan_physical(logical, self.session.conf)
         overrides = TpuOverrides(self.session.conf)
         final = overrides.apply(cpu_plan)
         if self.session.conf.get(_cfg.MESH_ENABLED):
@@ -272,7 +295,13 @@ class DataFrame:
         self.session.last_plan = final
         return final
 
-    def _run_partitions(self, final: PhysicalExec) -> List[pa.Table]:
+    def _run_partitions(self, final: PhysicalExec,
+                        capture_device: bool = False) -> List:
+        """Execute and collect per-partition results as arrow tables. With
+        ``capture_device`` (cache materialization), a single-process plan
+        whose root is the download transition instead returns the raw
+        DeviceBatches — the cache stores them without a device->host->device
+        round trip."""
         from spark_rapids_tpu.memory.device_manager import DeviceManager
         from spark_rapids_tpu import config as _cfg
         # cluster + adaptive compose: the stage scheduler coalesces reduce
@@ -306,6 +335,17 @@ class DataFrame:
                                             cleanups=cleanups)
                     final = adaptive_rewrite(final, stage_ctx)
                     self.session.last_plan = final
+                from spark_rapids_tpu.execs.tpu_execs import DeviceToHostExec
+                if (capture_device and isinstance(final, DeviceToHostExec)
+                        and not any(getattr(nd, "is_mesh", False)
+                                    for nd in _iter_execs(final))):
+                    final = final.children[0]   # keep batches device-resident
+                    for p in range(final.num_partitions):
+                        ctx = ExecContext(self.session.conf, partition_id=p,
+                                          num_partitions=final.num_partitions,
+                                          device_manager=dm, cleanups=cleanups)
+                        tables.extend(final.execute(ctx))
+                    return tables
                 for p in range(final.num_partitions):
                     ctx = ExecContext(self.session.conf, partition_id=p,
                                       num_partitions=final.num_partitions,
@@ -340,7 +380,9 @@ class DataFrame:
         return self._plan.schema().names()
 
     def explain(self, print_out: bool = True) -> str:
-        cpu_plan = plan_physical(self._plan, self.session.conf)
+        # substitute cached subtrees (no materialization: explain is free)
+        logical = self.session.cache_manager.substitute(self._plan)
+        cpu_plan = plan_physical(logical, self.session.conf)
         overrides = TpuOverrides(self.session.conf)
         final = overrides.apply(cpu_plan)
         text = overrides.last_explain + "\n\nPhysical plan:\n" + final.tree_string()
@@ -697,10 +739,18 @@ class TpuSession:
     RapidsDriverPlugin role: holds the conf, applies the overrides rule)."""
 
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        from spark_rapids_tpu.memory.df_cache import CacheManager
         self.conf = TpuConf(conf or {})
         self.last_explain: str = ""
         self.last_plan: Optional[PhysicalExec] = None
         self._views: Dict[str, DataFrame] = {}
+        self.cache_manager = CacheManager(self)
+
+    def clear_cache(self) -> None:
+        """Drop every cached DataFrame (spark.catalog.clearCache analog)."""
+        self.cache_manager.clear()
+
+    clearCache = clear_cache
 
     # ---- SQL frontend -----------------------------------------------------
     def table(self, name: str) -> "DataFrame":

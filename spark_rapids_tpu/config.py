@@ -107,6 +107,12 @@ IMPROVED_FLOAT_OPS = _conf(
     "sql.improvedFloatOps.enabled", bool, False,
     "Enable float ops (e.g. string cast of floats) that do not match Spark bit-for-bit.")
 
+CACHED_SCAN_ENABLED = _conf(
+    "sql.cachedScan.enabled", bool, True,
+    "Scan df.cache()/persist() data on the TPU. Cached batches live in the tiered "
+    "spillable store (device->host->disk); disabling this serves them to the CPU engine "
+    "instead. Analog of the reference accelerating Spark-cached data (HostColumnarToGpu).")
+
 SCAN_CACHE_ENABLED = _conf(
     "sql.scanCache.enabled", bool, True,
     "Keep device copies of scanned in-memory tables across actions, so repeated queries "

@@ -1,0 +1,103 @@
+"""Cached-table scan execs (InMemoryTableScanExec analog).
+
+The CPU form serves a cached DataFrame's buffers host-side; the overrides
+engine replaces it with the TPU form (plan/overrides.py rule, the role
+HostColumnarToGpu.scala:222 plays for Spark-cached data in the reference),
+which yields the device batches directly — zero-copy when the buffer is
+still in the DEVICE tier, a re-upload when it spilled to host/disk.
+
+Both forms read through the DeviceManager's BufferCatalog with the
+acquire/close refcount discipline (RapidsBufferStore.isAcquired), so a
+concurrent spill can't delete a disk file out from under a reader.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+
+
+class _CachedScanBase(LeafExec):
+    #: cached buffers live in THIS process's DeviceManager catalog; a cluster
+    #: executor process could never resolve them, so the stage scheduler must
+    #: hand plans containing this exec back to the single-process engine
+    #: (parallel/cluster.py split_stages checks this flag)
+    cluster_unstageable = True
+
+    def __init__(self, entry, output):
+        super().__init__(output)
+        self.entry = entry
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, len(self.entry.buffer_ids or ()))
+
+    def size_estimate(self):
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        ids = self.entry.buffer_ids
+        if not ids:
+            return None
+        catalog = DeviceManager.get().catalog
+        total = 0
+        for bid in ids:
+            buf = catalog.acquire(bid)
+            if buf is None:
+                return None
+            try:
+                total += buf.size_bytes
+            finally:
+                buf.close()
+        return total
+
+    def _acquire(self, ctx: ExecContext, partition_id: int):
+        ids = self.entry.buffer_ids
+        if ids is None:
+            raise RuntimeError(
+                "cached plan not materialized — cache scans must run through "
+                "CacheManager.prepare()")
+        if partition_id >= len(ids):
+            return None
+        dm = ctx.device_manager
+        if dm is None:
+            from spark_rapids_tpu.memory.device_manager import DeviceManager
+            dm = DeviceManager.get()
+        buf = dm.catalog.acquire(ids[partition_id])
+        if buf is None:
+            raise RuntimeError(
+                f"cached buffer {ids[partition_id]} missing from the catalog "
+                "(unpersisted concurrently?)")
+        return buf
+
+
+class CpuCachedScanExec(_CachedScanBase):
+    """CPU-engine cached scan: host-side view of the buffers (no device
+    traffic; a DEVICE-tier buffer downloads once)."""
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        buf = self._acquire(ctx, ctx.partition_id)
+        if buf is None:
+            return
+        try:
+            hb = buf.get_host_batch()
+        finally:
+            buf.close()
+        self.count_output(hb.num_rows)
+        yield hb
+
+
+class TpuCachedScanExec(_CachedScanBase):
+    """Device cached scan: zero-copy from the DEVICE tier, re-upload from
+    HOST/DISK."""
+
+    is_device = True
+
+    def execute(self, ctx: ExecContext) -> Iterator:
+        buf = self._acquire(ctx, ctx.partition_id)
+        if buf is None:
+            return
+        try:
+            db = buf.get_batch()
+        finally:
+            buf.close()
+        self.count_output(db.num_rows)
+        yield db

@@ -44,26 +44,37 @@ class BufferId:
         return (self.table_id << 20) | self.part_id
 
 
-def _flatten_device(batch: DeviceBatch) -> List:
-    out = []
+def _flatten_device(batch: DeviceBatch) -> Tuple[List, Tuple[bool, ...]]:
+    """Batch -> flat array list + per-column bits-sibling mask. DOUBLE columns
+    keep their uint64 bit-pattern sibling so a spill/restore round trip stays
+    bit-exact on backends where f64 is emulated (the sibling is the lossless
+    representation, columnar/column.py DeviceColumn.bits)."""
+    out, bits_mask = [], []
     for c in batch.columns:
         out.append(c.data)
         out.append(c.validity)
         if c.lengths is not None:
             out.append(c.lengths)
-    return out
+        has_bits = c.bits is not None
+        if has_bits:
+            out.append(c.bits)
+        bits_mask.append(has_bits)
+    return out, tuple(bits_mask)
 
 
-def _rebuild(schema: Schema, arrays: List, num_rows: int) -> DeviceBatch:
+def _rebuild(schema: Schema, arrays: List, num_rows: int,
+             bits_mask: Tuple[bool, ...] = ()) -> DeviceBatch:
     cols, i = [], 0
-    for f in schema:
+    for j, f in enumerate(schema):
+        has_bits = bool(bits_mask) and bits_mask[j]
         if f.dtype is DType.STRING:
             cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1],
                                      arrays[i + 2]))
             i += 3
         else:
-            cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1]))
-            i += 2
+            cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1],
+                                     bits=arrays[i + 2] if has_bits else None))
+            i += 2 + has_bits
     return DeviceBatch(schema, tuple(cols), num_rows)
 
 
@@ -74,7 +85,7 @@ class SpillableBuffer(Retainable):
 
     def __init__(self, buffer_id: BufferId, schema: Schema, num_rows: int,
                  tier: StorageTier, payload, size_bytes: int,
-                 spill_priority: float):
+                 spill_priority: float, bits_mask: Tuple[bool, ...] = ()):
         super().__init__()
         self.id = buffer_id
         self.schema = schema
@@ -83,17 +94,75 @@ class SpillableBuffer(Retainable):
         self.payload = payload          # device arrays | numpy arrays | file path
         self.size_bytes = size_bytes
         self.spill_priority = spill_priority
+        self.bits_mask = bits_mask      # per-column f64 bits-sibling presence
         self.owner_store = None         # set by BufferStore.add_buffer
 
     # ---- materialization -------------------------------------------------------
     def get_batch(self) -> DeviceBatch:
-        """Materialize as a device batch (uploading from host/disk if needed)."""
+        """Materialize as a device batch (uploading from host/disk if needed).
+        DOUBLE columns spilled with a bits sibling re-derive their f64 data
+        from the uploaded u64 (the supported bitcast direction is u64->f64,
+        columnar/column.py DeviceColumn.bits)."""
         import jax
+        import jax.numpy as jnp
         if self.tier == StorageTier.DEVICE:
-            return _rebuild(self.schema, self.payload, self.num_rows)
+            return _rebuild(self.schema, self.payload, self.num_rows,
+                            self.bits_mask)
         arrays = self._host_arrays()
-        return _rebuild(self.schema, [jax.device_put(a) for a in arrays],
-                        self.num_rows)
+        cols, i = [], 0
+        for j, f in enumerate(self.schema):
+            has_bits = bool(self.bits_mask) and self.bits_mask[j]
+            if f.dtype is DType.STRING:
+                cols.append(DeviceColumn(
+                    f.dtype, jax.device_put(arrays[i]),
+                    jax.device_put(arrays[i + 1]),
+                    jax.device_put(arrays[i + 2])))
+            elif has_bits:
+                bits = jax.device_put(arrays[i])
+                data = jax.lax.bitcast_convert_type(bits, jnp.float64)
+                cols.append(DeviceColumn(f.dtype, data,
+                                         jax.device_put(arrays[i + 1]),
+                                         bits=bits))
+            else:
+                cols.append(DeviceColumn(f.dtype, jax.device_put(arrays[i]),
+                                         jax.device_put(arrays[i + 1])))
+            i += 3 if f.dtype is DType.STRING else 2
+        return DeviceBatch(self.schema, tuple(cols), self.num_rows)
+
+    def get_host_batch(self, slice_rows: bool = True):
+        """Materialize host-side WITHOUT touching the device (the CPU engine's
+        view of a cached/spilled batch). Device-tier payloads download; host
+        and disk tiers rebuild in place — the flat layout is exactly
+        HostColumn's (data, validity, [lengths]). ``slice_rows=False`` keeps
+        the capacity padding (the shuffle wire format's TableMeta offsets
+        describe the padded arrays)."""
+        from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+        arrays = self._host_arrays()   # DEVICE tier: downloads via np.asarray
+        on_device = self.tier == StorageTier.DEVICE
+        n = self.num_rows if slice_rows else None
+        cols, i = [], 0
+        for j, f in enumerate(self.schema):
+            has_bits = bool(self.bits_mask) and self.bits_mask[j]
+            if f.dtype is DType.STRING:
+                # slice away bucket padding: the CPU engine expects exact-size
+                # columns (HostBatch.from_arrow shape)
+                cols.append(HostColumn(f.dtype, arrays[i][:n],
+                                       arrays[i + 1][:n], arrays[i + 2][:n]))
+                i += 3
+            elif has_bits:
+                # the u64 sibling is the lossless value on emulated-f64
+                # backends; prefer it host-side. Device layout carries it as
+                # a third array; host/disk layouts store ONLY the bits in the
+                # data slot (the f64 is derivable — half the spill footprint)
+                u64 = arrays[i + 2] if on_device else arrays[i]
+                cols.append(HostColumn(f.dtype, u64.view(np.float64)[:n],
+                                       arrays[i + 1][:n]))
+                i += 3 if on_device else 2
+            else:
+                cols.append(HostColumn(f.dtype, arrays[i][:n],
+                                       arrays[i + 1][:n]))
+                i += 2
+        return HostBatch(self.schema, tuple(cols), self.num_rows)
 
     def _host_arrays(self) -> List[np.ndarray]:
         if self.tier == StorageTier.HOST:
@@ -103,23 +172,44 @@ class SpillableBuffer(Retainable):
                 return [z[f"a{i}"] for i in range(len(z.files))]
         return [np.asarray(a) for a in self.payload]
 
+    def _compact_host_arrays(self) -> List[np.ndarray]:
+        """Host-layout arrays for spilling. DOUBLE columns with a u64 bits
+        sibling store ONLY the bits (in the data slot) — the f64 data is
+        derivable, so keeping both would double host RAM and disk footprint."""
+        arrays = self._host_arrays()
+        if self.tier != StorageTier.DEVICE or not any(self.bits_mask):
+            return arrays           # host/disk layouts are already compact
+        out, i = [], 0
+        for j, f in enumerate(self.schema):
+            has_bits = bool(self.bits_mask) and self.bits_mask[j]
+            if f.dtype is DType.STRING:
+                out.extend(arrays[i:i + 3])
+                i += 3
+            elif has_bits:
+                out.extend((arrays[i + 2], arrays[i + 1]))   # bits, validity
+                i += 3
+            else:
+                out.extend(arrays[i:i + 2])
+                i += 2
+        return out
+
     # ---- tier movement ---------------------------------------------------------
     def to_host(self) -> "SpillableBuffer":
-        arrays = self._host_arrays()
+        arrays = self._compact_host_arrays()
         size = sum(a.nbytes for a in arrays)
         return SpillableBuffer(self.id, self.schema, self.num_rows,
                                StorageTier.HOST, arrays, size,
-                               self.spill_priority)
+                               self.spill_priority, self.bits_mask)
 
     def to_disk(self, directory: str) -> "SpillableBuffer":
-        arrays = self._host_arrays()
+        arrays = self._compact_host_arrays()
         path = os.path.join(directory,
                             f"buf_{self.id.table_id}_{self.id.part_id}.npz")
         np.savez(path, **{f"a{i}": a for i, a in enumerate(arrays)})
         size = os.path.getsize(path)
         return SpillableBuffer(self.id, self.schema, self.num_rows,
                                StorageTier.DISK, path, size,
-                               self.spill_priority)
+                               self.spill_priority, self.bits_mask)
 
     def _on_release(self) -> None:
         if self.tier == StorageTier.DISK and isinstance(self.payload, str):
@@ -132,6 +222,8 @@ class SpillableBuffer(Retainable):
     @staticmethod
     def from_batch(buffer_id: BufferId, batch: DeviceBatch,
                    spill_priority: float = 0.0) -> "SpillableBuffer":
+        arrays, bits_mask = _flatten_device(batch)
         return SpillableBuffer(buffer_id, batch.schema, batch.num_rows,
-                               StorageTier.DEVICE, _flatten_device(batch),
-                               batch.device_size_bytes, spill_priority)
+                               StorageTier.DEVICE, arrays,
+                               batch.device_size_bytes, spill_priority,
+                               bits_mask)

@@ -1,0 +1,194 @@
+"""User-facing DataFrame cache: df.cache() / persist() riding the spillable
+store.
+
+Reference analogs: Spark's CacheManager + InMemoryRelation own the cached
+data and substitute matching logical subtrees at planning time; the reference
+plugin then accelerates *scanning* that cache (HostColumnarToGpu.scala:222
+uploads Spark-cached host batches, and SURVEY.md §4's pytest `cache` area
+covers the behavior). Here the cache IS the tiered store: the first action
+over a cached plan materializes its result batches into the DEVICE tier of
+the DeviceManager's store chain, where they spill device->host->disk under
+memory pressure like any other spillable buffer, and every later plan that
+contains an equal subtree scans those buffers instead of recomputing
+(execs/cache_execs.py serves them; plan/overrides.py keeps the scan on TPU).
+
+Matching is structural equality over the logical plan (dataclass equality;
+expressions are frozen dataclasses), the stand-in for Catalyst's
+``sameResult``. Materialization is lazy — ``cache()`` only marks the plan —
+and happens at the start of the first action whose plan uses the entry,
+which is observably when Spark's lazy cache fills too.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.memory.buffer import BufferId
+from spark_rapids_tpu.plan import logical as lp
+
+#: table_id namespace distinct from exec tables (execs) and shuffle blocks
+#: (shuffle/catalog.py starts at 1 << 20)
+_CACHE_IDS = itertools.count(1 << 28)
+
+
+def _map_logical_children(node: lp.LogicalPlan, fn) -> lp.LogicalPlan:
+    """Rebuild a logical dataclass node with fn applied to every child field
+    (children live under varying field names: child / left / right)."""
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, lp.LogicalPlan):
+            nv = fn(v)
+            if nv is not v:
+                changes[f.name] = nv
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+class CachedData:
+    """One cached logical plan + its materialized buffers (None until the
+    first use). The buffers stay registered in the DeviceManager catalog
+    until unpersist()."""
+
+    def __init__(self, logical: lp.LogicalPlan):
+        self.logical = logical
+        self.table_id = next(_CACHE_IDS)
+        self.buffer_ids: Optional[List[BufferId]] = None
+        self.lock = threading.Lock()
+
+    @property
+    def is_materialized(self) -> bool:
+        return self.buffer_ids is not None
+
+
+class CacheManager:
+    """Per-session registry of cached plans (Spark CacheManager analog)."""
+
+    def __init__(self, session):
+        self.session = session
+        self._entries: List[CachedData] = []
+        self._registry_lock = threading.Lock()
+
+    # ---- registration ----------------------------------------------------------
+    def add(self, logical: lp.LogicalPlan) -> CachedData:
+        with self._registry_lock:
+            e = self._lookup_locked(logical)
+            if e is None:
+                e = CachedData(logical)
+                self._entries.append(e)
+            return e
+
+    def _lookup_locked(self, logical) -> Optional[CachedData]:
+        for e in self._entries:
+            if e.logical == logical:
+                return e
+        return None
+
+    def lookup(self, logical: lp.LogicalPlan) -> Optional[CachedData]:
+        with self._registry_lock:
+            return self._lookup_locked(logical)
+
+    def remove(self, logical: lp.LogicalPlan) -> None:
+        with self._registry_lock:
+            e = self._lookup_locked(logical)
+            if e is not None:
+                self._entries.remove(e)
+        if e is not None:
+            self._free(e)
+
+    def clear(self) -> None:
+        with self._registry_lock:
+            entries, self._entries = self._entries, []
+        for e in entries:
+            self._free(e)
+
+    def _free(self, e: CachedData) -> None:
+        ids, e.buffer_ids = e.buffer_ids, None
+        if ids:
+            from spark_rapids_tpu.memory.device_manager import DeviceManager
+            catalog = DeviceManager.get().catalog
+            for bid in ids:
+                catalog.remove(bid)
+
+    # ---- planning-time substitution --------------------------------------------
+    def substitute(self, logical: lp.LogicalPlan,
+                   skip: Optional[CachedData] = None,
+                   used: Optional[List[CachedData]] = None) -> lp.LogicalPlan:
+        """Replace every subtree equal to a cached plan with a CachedRelation
+        (top-down: the largest cached subtree wins, like CacheManager's
+        useCachedData). ``skip`` excludes the entry being materialized from
+        matching itself. Does NOT materialize — safe for explain()."""
+        with self._registry_lock:
+            entries = list(self._entries)
+        if not entries:
+            return logical
+
+        def walk(node: lp.LogicalPlan) -> lp.LogicalPlan:
+            for e in entries:
+                if e is not skip and e.logical == node:
+                    if used is not None and e not in used:
+                        used.append(e)
+                    return lp.CachedRelation(e)
+            return _map_logical_children(node, walk)
+
+        return walk(logical)
+
+    def prepare(self, logical: lp.LogicalPlan) -> lp.LogicalPlan:
+        """Substitute cached subtrees and materialize the entries an action is
+        about to scan. Entries whose buffers vanished (DeviceManager was
+        reconfigured between actions) are re-materialized — Spark recomputes
+        lost cached partitions the same way."""
+        if not self._entries:
+            return logical
+        used: List[CachedData] = []
+        out = self.substitute(logical, used=used)
+        for e in used:
+            self._ensure_materialized(e)
+        return out
+
+    # ---- materialization -------------------------------------------------------
+    def _ensure_materialized(self, e: CachedData) -> None:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        with e.lock:
+            if e.buffer_ids is not None:
+                catalog = DeviceManager.get().catalog
+                live = set(catalog.ids())
+                if all(bid in live for bid in e.buffer_ids):
+                    return
+                e.buffer_ids = None     # lost (manager reconfigured): recompute
+            self._materialize(e)
+
+    def _materialize(self, e: CachedData) -> None:
+        from spark_rapids_tpu.columnar.batch import DeviceBatch
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        from spark_rapids_tpu.memory.store import CACHE_BUFFER_PRIORITY
+
+        # nested caches compose: materialize with every OTHER entry substituted
+        inner_used: List[CachedData] = []
+        logical = self.substitute(e.logical, skip=e, used=inner_used)
+        for dep in inner_used:
+            self._ensure_materialized(dep)
+        df = DataFrame(logical, self.session)
+        final = df._executed_plan(prepared=logical)
+        # device-final plans hand their DeviceBatches over directly (no
+        # download/re-upload); CPU-final, mesh, and cluster plans fall back
+        # to arrow tables
+        results = df._run_partitions(final, capture_device=True)
+
+        dm = DeviceManager.initialize(self.session.conf)
+        smax = self.session.conf.string_max_bytes
+        ids: List[BufferId] = []
+        try:
+            for i, r in enumerate(results):
+                batch = (r if isinstance(r, DeviceBatch)
+                         else DeviceBatch.from_arrow(r, smax))
+                bid = BufferId(e.table_id, i)
+                dm.device_store.add_batch(bid, batch, CACHE_BUFFER_PRIORITY)
+                ids.append(bid)
+        except Exception:
+            for bid in ids:
+                dm.catalog.remove(bid)
+            raise
+        e.buffer_ids = ids

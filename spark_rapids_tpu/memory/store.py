@@ -28,6 +28,9 @@ from spark_rapids_tpu.native import AddressSpaceAllocator, HashedPriorityQueue
 # SpillPriorities analog
 INPUT_BATCH_PRIORITY = 100.0
 OUTPUT_BATCH_PRIORITY = 50.0
+#: user-cached DataFrame batches (df.cache()): colder than active working
+#: batches, warmer than shuffle buffers — recomputable, but the user asked
+CACHE_BUFFER_PRIORITY = 25.0
 SHUFFLE_BUFFER_PRIORITY = 0.0
 
 

@@ -113,6 +113,10 @@ def split_stages(final: PhysicalExec) -> Optional[List[_Stage]]:
     def walk(node: PhysicalExec, deps: List[int]) -> PhysicalExec:
         if isinstance(node, CpuShuffleExchangeExec):
             raise _Unstageable()
+        if getattr(node, "cluster_unstageable", False):
+            # e.g. cached-table scans: their buffers live in the driver
+            # process's catalog and cannot ship to executors
+            raise _Unstageable()
         if isinstance(node, TpuShuffleExchangeExec):
             child_deps: List[int] = []
             new_child = walk(node.children[0], child_deps)

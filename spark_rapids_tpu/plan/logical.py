@@ -302,3 +302,17 @@ class Repartition(LogicalPlan):
 
     def schema(self) -> Schema:
         return self.child.schema()
+
+
+@dataclass
+class CachedRelation(LogicalPlan):
+    """A subtree replaced by its cached materialization (InMemoryRelation
+    analog — Spark's CacheManager swaps matching subtrees for the cached
+    plan; the reference accelerates scanning the cached columnar data,
+    HostColumnarToGpu.scala:222). ``entry`` is a memory.df_cache.CachedData;
+    identity equality on it is intended — two CachedRelations are the same
+    relation iff they reference the same cache entry."""
+    entry: object
+
+    def schema(self) -> Schema:
+        return self.entry.logical.schema()

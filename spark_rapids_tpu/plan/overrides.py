@@ -611,6 +611,23 @@ def _make_exchange_rules() -> List[ExecRule]:
                      _convert_broadcast_exchange)]
 
 
+def _convert_cached_scan(meta: ExecMeta, children) -> PhysicalExec:
+    from spark_rapids_tpu.execs.cache_execs import TpuCachedScanExec
+    return TpuCachedScanExec(meta.exec.entry, meta.exec.output)
+
+
+def _tag_cached_scan(meta: ExecMeta) -> None:
+    if not meta.conf.get(cfg.CACHED_SCAN_ENABLED):
+        meta.will_not_work("cached-table scanning on TPU is disabled "
+                           "(spark.rapids.tpu.sql.cachedScan.enabled)")
+
+
+def _make_cache_rules() -> List[ExecRule]:
+    from spark_rapids_tpu.execs.cache_execs import CpuCachedScanExec
+    return [ExecRule(CpuCachedScanExec, "cached table scan",
+                     _convert_cached_scan, tag=_tag_cached_scan)]
+
+
 _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_write_rules()
                                    + _make_join_rules()
                                    + _make_window_rules()
@@ -627,7 +644,7 @@ _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_write_rules()
     ExecRule(ce.CpuLimitExec, "row limit", _convert_limit),
     ExecRule(ce.CpuUnionExec, "union all", _convert_union),
     ExecRule(ce.CpuRangeExec, "sequence generation", _convert_range),
-]
+] + _make_cache_rules()
 
 EXEC_RULES: Dict[Type[PhysicalExec], ExecRule] = {r.cls: r for r in _EXEC_RULE_LIST}
 

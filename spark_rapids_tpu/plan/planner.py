@@ -179,6 +179,9 @@ def _plan_node(plan: lp.LogicalPlan, conf: TpuConf) -> PhysicalExec:
         return ce.CpuLocalScanExec(plan.table, conf.string_max_bytes)
     if isinstance(plan, lp.Range):
         return ce.CpuRangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, lp.CachedRelation):
+        from spark_rapids_tpu.execs.cache_execs import CpuCachedScanExec
+        return CpuCachedScanExec(plan.entry, plan.schema())
     if isinstance(plan, lp.FileScan):
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.io.datasource import PartitionedFile

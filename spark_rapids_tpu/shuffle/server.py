@@ -28,7 +28,6 @@ from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ServerConnection,
                                                 ShuffleTransport, Transaction,
                                                 TransactionStatus)
-from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.columnar.dtypes import DType
 from spark_rapids_tpu.memory.buffer import SpillableBuffer, StorageTier
 
@@ -47,24 +46,9 @@ def _pack_spillable(buf: SpillableBuffer) -> bytes:
             batch.schema, batch.capacity, batch_string_max(batch))
         packed = device_pack(batch, layout)
         return bytes(np.asarray(packed).tobytes())
-    arrays = buf._host_arrays()
-    hb = _host_batch_from_arrays(buf, arrays)
+    hb = buf.get_host_batch(slice_rows=False)
     raw, _ = pack_host_batch(hb)
     return raw
-
-
-def _host_batch_from_arrays(buf: SpillableBuffer, arrays) -> HostBatch:
-    cols = []
-    i = 0
-    for f in buf.schema:
-        if f.dtype is DType.STRING:
-            cols.append(HostColumn(f.dtype, arrays[i], arrays[i + 1],
-                                   arrays[i + 2]))
-            i += 3
-        else:
-            cols.append(HostColumn(f.dtype, arrays[i], arrays[i + 1]))
-            i += 2
-    return HostBatch(buf.schema, tuple(cols), buf.num_rows)
 
 
 class BufferSendState:

@@ -1,0 +1,265 @@
+"""df.cache()/persist(): cached plans materialize into the spillable store
+and later actions scan the buffers instead of recomputing.
+
+Reference behavior being mirrored: Spark's CacheManager substitutes cached
+subtrees with InMemoryRelation, and the reference plugin accelerates scanning
+that cache (HostColumnarToGpu.scala:222; pytest `cache` area, SURVEY.md §4).
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession, _iter_execs
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.execs.cache_execs import (CpuCachedScanExec,
+                                                TpuCachedScanExec)
+from spark_rapids_tpu.memory.buffer import StorageTier
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+
+
+def _sess(**conf):
+    return TpuSession(conf or None)
+
+
+def _table(n=1000):
+    return pa.table({
+        "k": [i % 7 for i in range(n)],
+        "v": [float(i) for i in range(n)],
+        "s": [f"row{i % 13}" for i in range(n)],
+    })
+
+
+def _sorted_pylist(t: pa.Table):
+    return sorted(t.to_pylist(), key=lambda r: tuple(str(v) for v in r.values()))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_manager():
+    DeviceManager.shutdown()
+    yield
+    DeviceManager.shutdown()
+
+
+def test_cache_serves_second_action_from_store():
+    sess = _sess()
+    df = sess.create_dataframe(_table()).filter(F.col("k") > 2).cache()
+    first = df.collect()
+    entry = sess.cache_manager.lookup(df._plan)
+    assert entry is not None and entry.is_materialized
+    assert entry.buffer_ids, "materialization produced no buffers"
+    # the executed plan now scans the cache on the TPU
+    second = df.collect()
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    assert _sorted_pylist(first) == _sorted_pylist(second)
+
+
+def test_derived_dataframe_reuses_cached_subtree():
+    sess = _sess()
+    base = sess.create_dataframe(_table()).withColumn(
+        "v2", F.col("v") * 2.0).cache()
+    base.count()                         # materialize
+    derived = base.groupBy("k").agg(F.sum("v2").alias("s2"))
+    got = derived.collect()
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    # oracle: same query without any caching
+    plain = _sess()
+    want = (plain.create_dataframe(_table())
+            .withColumn("v2", F.col("v") * 2.0)
+            .groupBy("k").agg(F.sum("v2").alias("s2"))).collect()
+    assert _sorted_pylist(got) == _sorted_pylist(want)
+
+
+def test_cache_is_lazy_until_first_action():
+    sess = _sess()
+    df = sess.create_dataframe(_table()).cache()
+    entry = sess.cache_manager.lookup(df._plan)
+    assert entry is not None and not entry.is_materialized
+    df.collect()
+    assert entry.is_materialized
+
+
+def test_cache_serves_without_recompute(tmp_path):
+    """Delete the source file after materialization: a recompute would fail,
+    a true cache read succeeds."""
+    path = str(tmp_path / "t.parquet")
+    import pyarrow.parquet as pq
+    pq.write_table(_table(200), path)
+    sess = _sess()
+    df = sess.read.parquet(path).cache()
+    want = _sorted_pylist(df.collect())
+    os.unlink(path)
+    got = _sorted_pylist(df.filter(F.col("k") >= 0).collect())
+    assert got == want
+
+
+def test_unpersist_frees_buffers_and_recomputes():
+    sess = _sess()
+    df = sess.create_dataframe(_table()).cache()
+    df.collect()
+    entry = sess.cache_manager.lookup(df._plan)
+    ids = list(entry.buffer_ids)
+    assert df.is_cached
+    df.unpersist()
+    assert not df.is_cached
+    catalog = DeviceManager.get().catalog
+    live = set(catalog.ids())
+    assert not any(bid in live for bid in ids)
+    # still correct, just recomputed (no cached scan in the plan)
+    df.collect()
+    assert not any(isinstance(n, (TpuCachedScanExec, CpuCachedScanExec))
+                   for n in _iter_execs(sess.last_plan))
+
+
+def test_cached_buffers_spill_and_still_serve():
+    """Squeeze the device budget so the cached batch spills down the chain;
+    the scan re-uploads from host/disk and results stay identical."""
+    sess = _sess()
+    df = sess.create_dataframe(_table(2000)).cache()
+    want = _sorted_pylist(df.collect())
+    entry = sess.cache_manager.lookup(df._plan)
+    dm = DeviceManager.get()
+    dm.device_store.spill_to_size(0)     # force everything down a tier
+    catalog = dm.catalog
+    for bid in entry.buffer_ids:
+        buf = catalog.acquire(bid)
+        assert buf is not None
+        assert buf.tier != StorageTier.DEVICE
+        buf.close()
+    got = _sorted_pylist(df.collect())
+    assert got == want
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+
+
+def test_cached_scan_cpu_fallback_matches():
+    """With cachedScan.enabled=false the cache is served to the CPU engine
+    (CpuCachedScanExec) and results match the TPU path."""
+    sess = _sess(**{"spark.rapids.tpu.sql.cachedScan.enabled": False})
+    df = sess.create_dataframe(_table()).filter(F.col("v") < 500).cache()
+    got = df.collect()
+    assert any(isinstance(n, CpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    assert not any(isinstance(n, TpuCachedScanExec)
+                   for n in _iter_execs(sess.last_plan))
+    on = _sess()
+    want = on.create_dataframe(_table()).filter(F.col("v") < 500).collect()
+    assert _sorted_pylist(got) == _sorted_pylist(want)
+
+
+def test_two_consumers_materialize_once():
+    sess = _sess()
+    df = sess.create_dataframe(_table()).cache()
+    a = df.groupBy("k").agg(F.count().alias("n")).collect()
+    entry = sess.cache_manager.lookup(df._plan)
+    ids_after_first = list(entry.buffer_ids)
+    b = df.groupBy("k").agg(F.count().alias("n")).collect()
+    assert list(entry.buffer_ids) == ids_after_first
+    assert _sorted_pylist(a) == _sorted_pylist(b)
+
+
+def test_cache_with_nulls_and_strings_roundtrip():
+    t = pa.table({
+        "k": pa.array([1, None, 3, None, 5], type=pa.int64()),
+        "s": pa.array(["a", None, "ccc", "", None]),
+        "d": pa.array([1.5, None, float("nan"), -0.0, 2.25]),
+    })
+    sess = _sess()
+    df = sess.create_dataframe(t).cache()
+    first = df.collect()
+    second = df.collect()   # served from cache
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    assert first.to_pydict().keys() == second.to_pydict().keys()
+    import math
+    for col in first.column_names:
+        fa, sa = first.column(col).to_pylist(), second.column(col).to_pylist()
+        for x, y in zip(fa, sa):
+            if isinstance(x, float) and isinstance(y, float) \
+                    and math.isnan(x) and math.isnan(y):
+                continue
+            assert x == y, (col, x, y)
+
+
+def test_clear_cache():
+    sess = _sess()
+    a = sess.create_dataframe(_table()).cache()
+    b = sess.range(100).cache()
+    a.collect(); b.collect()
+    sess.clear_cache()
+    assert not a.is_cached and not b.is_cached
+    live = set(DeviceManager.get().catalog.ids())
+    assert not live, f"cache buffers leaked: {live}"
+
+
+def test_cache_under_mesh_session():
+    """A mesh-enabled session still answers cached queries correctly (the
+    cached scan is a single-device leaf; mesh lowering must compose or
+    fall back, never corrupt)."""
+    sess = _sess(**{"spark.rapids.tpu.mesh.enabled": True})
+    df = sess.create_dataframe(_table()).cache()
+    want = _sorted_pylist(df.collect())
+    got = _sorted_pylist(df.collect())
+    assert got == want
+
+
+def test_cached_aggregate_feeds_join():
+    sess = _sess()
+    agg = (sess.create_dataframe(_table())
+           .groupBy("k").agg(F.avg("v").alias("av")).cache())
+    agg.collect()
+    dim = sess.create_dataframe(pa.table({"k": [0, 1, 2, 3, 4, 5, 6],
+                                          "name": list("abcdefg")}))
+    got = dim.join(agg, "k").collect()
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    plain = _sess()
+    want = plain.create_dataframe(pa.table(
+        {"k": [0, 1, 2, 3, 4, 5, 6], "name": list("abcdefg")})).join(
+        plain.create_dataframe(_table()).groupBy("k").agg(
+            F.avg("v").alias("av")), "k").collect()
+    assert _sorted_pylist(got) == _sorted_pylist(want)
+
+
+def test_cpu_cached_scan_keeps_long_strings():
+    """Regression: the CPU cached scan of a DEVICE-tier buffer must keep the
+    stored string width, not re-narrow to the default 256 bytes."""
+    long_s = "x" * 500
+    sess = _sess(**{"spark.rapids.tpu.sql.cachedScan.enabled": False,
+                    "spark.rapids.tpu.sql.string.maxBytes": 1024})
+    df = sess.create_dataframe(pa.table({"s": [long_s, "short"]})).cache()
+    got = df.collect()          # materializes, then CPU-scans the cache
+    got2 = df.collect()
+    assert any(isinstance(n, CpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    assert got.column("s").to_pylist() == [long_s, "short"]
+    assert got2.column("s").to_pylist() == [long_s, "short"]
+
+
+def test_materialization_captures_device_batches():
+    """Device-final plans hand their batches straight to the store — the
+    cached buffer starts in the DEVICE tier without an arrow round trip."""
+    sess = _sess()
+    df = sess.create_dataframe(_table()).filter(F.col("k") < 5).cache()
+    df.collect()
+    entry = sess.cache_manager.lookup(df._plan)
+    catalog = DeviceManager.get().catalog
+    for bid in entry.buffer_ids:
+        buf = catalog.acquire(bid)
+        assert buf.tier == StorageTier.DEVICE
+        buf.close()
+
+
+def test_cached_scan_falls_back_from_cluster():
+    """A cluster session still answers cached queries (single-process
+    fallback: the buffers live in the driver's catalog)."""
+    sess = _sess(**{"spark.rapids.tpu.cluster.executors": 2})
+    df = sess.create_dataframe(_table()).cache()
+    want = _sorted_pylist(df.collect())
+    got = _sorted_pylist(df.groupBy("k").agg(F.count().alias("n")).collect())
+    plain = _sess()
+    wantg = _sorted_pylist(plain.create_dataframe(_table())
+                           .groupBy("k").agg(F.count().alias("n")).collect())
+    assert got == wantg and want

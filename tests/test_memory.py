@@ -218,3 +218,34 @@ def test_host_arena_fragmentation_spills(tmp_path):
         buf = catalog.acquire(BufferId(i))
         assert buf is not None
         buf.close()
+
+
+def test_double_spill_is_compact_and_bit_exact(tmp_path):
+    """Regression (code review): DOUBLE columns with a u64 bits sibling spill
+    ONLY the bits (half the footprint), and survive host AND disk tiers
+    bit-exactly — including NaN payloads and -0.0."""
+    import math
+    import struct
+    vals = [1.5, -0.0, float("nan"), 1e-308, -math.inf, 3.141592653589793]
+    t = pa.table({"d": pa.array(vals, type=pa.float64())})
+    b = DeviceBatch.from_arrow(t, string_max_bytes=16)
+    from spark_rapids_tpu.memory.buffer import SpillableBuffer, StorageTier
+    buf = SpillableBuffer.from_batch(BufferId(991), b)
+    has_bits = any(buf.bits_mask)
+    host = buf.to_host()
+    if has_bits:
+        # compact layout: one u64 array + one validity per column, no f64 copy
+        assert len(host.payload) == 2
+        assert host.payload[0].dtype == np.uint64
+    disk = host.to_disk(str(tmp_path))
+
+    def bits_of(table):
+        col = table.column("d").to_pylist()
+        return [None if v is None else struct.pack("<d", v) for v in col]
+
+    want = bits_of(t)
+    for tier_buf in (host, disk):
+        got_dev = bits_of(tier_buf.get_batch().to_arrow())
+        got_host = bits_of(tier_buf.get_host_batch().to_arrow())
+        assert got_dev == want, tier_buf.tier
+        assert got_host == want, tier_buf.tier
