@@ -25,7 +25,9 @@ _TIES = {"q19", "q27", "q34", "q42", "q46", "q52", "q55", "q65", "q68",
          "q73", "q79", "q88", "q96", "q15", "q26", "q7", "q21", "q25",
          "q29", "q37", "q82", "q90", "q92", "q93", "q50", "q62", "q99",
          "q3", "q43", "q48", "q84", "q61", "q32", "q41", "q45", "q20",
-         "q12", "q98", "q33", "q56", "q60"}
+         "q12", "q98", "q33", "q56", "q60",
+         # non-unique sort keys (code review): ties may legally reorder
+         "q6", "q67"}
 
 
 _RAN = {"n": 0}
