@@ -2192,4 +2192,503 @@ from (select count(*) as h8_30_to_9 from store_sales
         and ss_store_sk in (select s_store_sk from store
                             where s_store_name = 'ese')) s8
 """,
+    "q14": """
+with items as (
+  select i_item_sk, i_brand_id, i_class as i_class_id_, i_category_id
+  from item),
+ssi as (
+  select distinct i_brand_id as sb, i_class_id_ as sc, i_category_id as sg
+  from store_sales, date_dim, items
+  where ss_sold_date_sk = d_date_sk and d_year in (1999, 2000)
+    and ss_item_sk = i_item_sk),
+csi as (
+  select distinct i_brand_id as cb, i_class_id_ as cc, i_category_id as cg
+  from catalog_sales, date_dim, items
+  where cs_sold_date_sk = d_date_sk and d_year in (1999, 2000)
+    and cs_item_sk = i_item_sk),
+wsi as (
+  select distinct i_brand_id as wb, i_class_id_ as wc, i_category_id as wg
+  from web_sales, date_dim, items
+  where ws_sold_date_sk = d_date_sk and d_year in (1999, 2000)
+    and ws_item_sk = i_item_sk),
+cross_ids as (
+  select sb, sc, sg from ssi
+  left semi join csi on sb = cb and sc = cc and sg = cg
+  left semi join wsi on sb = wb and sc = wc and sg = wg),
+cross_items as (
+  select i_item_sk from items
+  left semi join cross_ids
+  on i_brand_id = sb and i_class_id_ = sc and i_category_id = sg),
+avg_sales as (
+  select avg(v) as avg_v
+  from (select ss_quantity * ss_list_price as v
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk and d_year in (1999, 2000)
+        union all
+        select cs_quantity * cs_list_price as v
+        from catalog_sales, date_dim
+        where cs_sold_date_sk = d_date_sk and d_year in (1999, 2000)
+        union all
+        select ws_quantity * ws_list_price as v
+        from web_sales, date_dim
+        where ws_sold_date_sk = d_date_sk and d_year in (1999, 2000)) x),
+ch as (
+  select ss_item_sk as item, sum(ss_quantity * ss_list_price) as sales,
+         count(*) as number_sales
+  from store_sales
+  where ss_sold_date_sk in (select d_date_sk from date_dim
+                            where d_year = 2000 and d_moy = 11)
+    and ss_item_sk in (select i_item_sk from cross_items)
+  group by ss_item_sk)
+select sum(sales) as total_sales, sum(number_sales) as total_number
+from ch, avg_sales
+where sales > avg_v
+""",
+    "q36": """
+with rolled as (
+  select sum(ss_net_profit) as _num, sum(ss_ext_sales_price) as _den,
+         i_category, i_class
+  from store_sales, date_dim, store, item
+  where ss_sold_date_sk = d_date_sk and d_year = 2001
+    and ss_store_sk = s_store_sk and s_state = 'TN'
+    and ss_item_sk = i_item_sk
+  group by rollup(i_category, i_class)),
+tmp as (
+  select _num / _den as total_sum, i_category, i_class,
+         case when i_category is null then 1 else 0 end
+         + case when i_class is null then 1 else 0 end as lochierarchy,
+         case when i_class is not null then i_category
+              else null end as _parent
+  from rolled)
+select total_sum, i_category, i_class, lochierarchy,
+       rank() over (partition by lochierarchy, _parent
+                    order by total_sum asc) as rank_within_parent
+from tmp
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category else null end,
+         rank_within_parent
+limit 100
+""",
+    "q49": """
+with web_g as (
+  select item, return_ratio, currency_ratio,
+         rank() over (order by return_ratio) as return_rank,
+         rank() over (order by currency_ratio) as currency_rank
+  from (select ws_item_sk as item,
+               cast(sum(cast(coalesce(wr_return_quantity, 0) as long))
+                    as double) / sum(ws_quantity) as return_ratio,
+               sum(coalesce(wr_return_amt, 0.0)) / sum(ws_net_paid)
+                 as currency_ratio,
+               sum(ws_quantity) as sale_q
+        from web_sales
+             left join web_returns
+             on ws_order_number = wr_order_number
+                and ws_item_sk = wr_item_sk
+        where ws_sold_date_sk in (select d_date_sk from date_dim
+                                  where d_year = 2000 and d_moy = 12)
+          and ws_net_paid > 0
+        group by ws_item_sk) g
+  where sale_q > 0),
+cat_g as (
+  select item, return_ratio, currency_ratio,
+         rank() over (order by return_ratio) as return_rank,
+         rank() over (order by currency_ratio) as currency_rank
+  from (select cs_item_sk as item,
+               cast(sum(cast(coalesce(cr_return_quantity, 0) as long))
+                    as double) / sum(cs_quantity) as return_ratio,
+               sum(coalesce(cr_return_amount, 0.0)) / sum(cs_net_paid)
+                 as currency_ratio,
+               sum(cs_quantity) as sale_q
+        from catalog_sales
+             left join catalog_returns
+             on cs_order_number = cr_order_number
+                and cs_item_sk = cr_item_sk
+        where cs_sold_date_sk in (select d_date_sk from date_dim
+                                  where d_year = 2000 and d_moy = 12)
+          and cs_net_paid > 0
+        group by cs_item_sk) g
+  where sale_q > 0),
+store_g as (
+  select item, return_ratio, currency_ratio,
+         rank() over (order by return_ratio) as return_rank,
+         rank() over (order by currency_ratio) as currency_rank
+  from (select ss_item_sk as item,
+               cast(sum(cast(coalesce(sr_return_quantity, 0) as long))
+                    as double) / sum(ss_quantity) as return_ratio,
+               sum(coalesce(sr_return_amt, 0.0)) / sum(ss_net_paid)
+                 as currency_ratio,
+               sum(ss_quantity) as sale_q
+        from store_sales
+             left join store_returns
+             on ss_ticket_number = sr_ticket_number
+                and ss_item_sk = sr_item_sk
+        where ss_sold_date_sk in (select d_date_sk from date_dim
+                                  where d_year = 2000 and d_moy = 12)
+          and ss_net_paid > 0
+        group by ss_item_sk) g
+  where sale_q > 0)
+select channel, item, return_ratio, return_rank, currency_rank
+from (select 'wr' as channel, item, return_ratio, return_rank, currency_rank
+      from web_g where return_rank <= 10 or currency_rank <= 10
+      union all
+      select 'cr' as channel, item, return_ratio, return_rank, currency_rank
+      from cat_g where return_rank <= 10 or currency_rank <= 10
+      union all
+      select 'sr' as channel, item, return_ratio, return_rank, currency_rank
+      from store_g where return_rank <= 10 or currency_rank <= 10) u
+order by channel, return_rank, currency_rank, item
+limit 100
+""",
+    "q51": """
+with wss as (
+  select ws_item_sk as item_sk, d_date, sum(ws_sales_price) as daily
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk and d_month_seq between 1200 and 1211
+  group by ws_item_sk, d_date),
+sss as (
+  select ss_item_sk as item_sk, d_date, sum(ss_sales_price) as daily
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk and d_month_seq between 1200 and 1211
+  group by ss_item_sk, d_date),
+web as (
+  select item_sk, d_date,
+         sum(daily) over (partition by item_sk order by d_date
+                          rows between unbounded preceding and current row)
+           as web_cum
+  from wss),
+store as (
+  select item_sk as s_item, d_date as s_date,
+         sum(daily) over (partition by item_sk order by d_date
+                          rows between unbounded preceding and current row)
+           as store_cum
+  from sss)
+select item_sk, d_date, web_cum, store_cum
+from web, store
+where item_sk = s_item and d_date = s_date and web_cum > store_cum
+order by item_sk, d_date
+limit 100
+""",
+    "q59": """
+with wss as (
+  select d_week_seq, ss_store_sk,
+         sum(case when d_day_name = 'Sunday' then ss_sales_price
+             else null end) as sun_sales,
+         sum(case when d_day_name = 'Monday' then ss_sales_price
+             else null end) as mon_sales,
+         sum(case when d_day_name = 'Tuesday' then ss_sales_price
+             else null end) as tue_sales,
+         sum(case when d_day_name = 'Wednesday' then ss_sales_price
+             else null end) as wed_sales,
+         sum(case when d_day_name = 'Thursday' then ss_sales_price
+             else null end) as thu_sales,
+         sum(case when d_day_name = 'Friday' then ss_sales_price
+             else null end) as fri_sales,
+         sum(case when d_day_name = 'Saturday' then ss_sales_price
+             else null end) as sat_sales
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+  group by d_week_seq, ss_store_sk),
+weeks as (select distinct d_week_seq as wseq, d_month_seq from date_dim),
+y as (
+  select s_store_name as s_store_name1, d_week_seq as d_week_seq1,
+         s_store_id as s_store_id1, sun_sales as sun_sales1,
+         mon_sales as mon_sales1, tue_sales as tue_sales1,
+         wed_sales as wed_sales1, thu_sales as thu_sales1,
+         fri_sales as fri_sales1, sat_sales as sat_sales1
+  from wss, weeks, store
+  where d_week_seq = wseq and d_month_seq between 1212 and 1223
+    and ss_store_sk = s_store_sk),
+x as (
+  select s_store_name as s_store_name2, d_week_seq as d_week_seq2,
+         s_store_id as s_store_id2, sun_sales as sun_sales2,
+         mon_sales as mon_sales2, tue_sales as tue_sales2,
+         wed_sales as wed_sales2, thu_sales as thu_sales2,
+         fri_sales as fri_sales2, sat_sales as sat_sales2
+  from wss, weeks, store
+  where d_week_seq = wseq and d_month_seq between 1224 and 1235
+    and ss_store_sk = s_store_sk)
+select s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2 as sun_r, mon_sales1 / mon_sales2 as mon_r,
+       tue_sales1 / tue_sales2 as tue_r, wed_sales1 / wed_sales2 as wed_r,
+       thu_sales1 / thu_sales2 as thu_r, fri_sales1 / fri_sales2 as fri_r,
+       sat_sales1 / sat_sales2 as sat_r
+from y, x
+where s_store_id1 = s_store_id2 and d_week_seq1 = d_week_seq2 - 52
+order by s_store_name1, s_store_id1, d_week_seq1
+limit 100
+""",
+    "q78": """
+with ss as (
+  select ss_item_sk as ss_item, ss_customer_sk as ss_cust,
+         sum(ss_quantity) as ss_qty, sum(ss_wholesale_cost) as ss_wc,
+         sum(ss_sales_price) as ss_sp
+  from store_sales
+       left anti join store_returns
+       on ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk,
+       date_dim
+  where ss_sold_date_sk = d_date_sk and d_year = 2000
+  group by ss_item_sk, ss_customer_sk),
+ws as (
+  select ws_item_sk as ws_item, ws_bill_customer_sk as ws_cust,
+         sum(ws_quantity) as ws_qty, sum(ws_wholesale_cost) as ws_wc,
+         sum(ws_sales_price) as ws_sp
+  from web_sales
+       left anti join web_returns
+       on ws_order_number = wr_order_number and ws_item_sk = wr_item_sk,
+       date_dim
+  where ws_sold_date_sk = d_date_sk and d_year = 2000
+  group by ws_item_sk, ws_bill_customer_sk),
+cs as (
+  select cs_item_sk as cs_item, cs_bill_customer_sk as cs_cust,
+         sum(cs_quantity) as cs_qty, sum(cs_wholesale_cost) as cs_wc,
+         sum(cs_sales_price) as cs_sp
+  from catalog_sales
+       left anti join catalog_returns
+       on cs_order_number = cr_order_number and cs_item_sk = cr_item_sk,
+       date_dim
+  where cs_sold_date_sk = d_date_sk and d_year = 2000
+  group by cs_item_sk, cs_bill_customer_sk)
+select ss_item, ss_cust, ss_qty, ss_wc, ss_sp,
+       round(cast(ss_qty as double) / (ws_qty + cs_qty), 2) as ratio
+from ss, ws, cs
+where ss_item = ws_item and ss_cust = ws_cust
+  and ss_item = cs_item and ss_cust = cs_cust
+  and (ws_qty > 0 or cs_qty > 0)
+order by ss_item, ss_cust
+limit 100
+""",
+    "q80": """
+with ssr as (
+  select ss_store_sk as id, sum(ss_ext_sales_price) as sales,
+         sum(coalesce(sr_return_amt, 0.0)) as returns_amt,
+         sum(ss_net_profit) - sum(coalesce(sr_net_loss, 0.0)) as profit
+  from store_sales
+       left join store_returns
+       on ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk,
+       date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-01' and date '2000-08-30'
+    and ss_promo_sk in (select p_promo_sk from promotion
+                        where p_channel_tv = 'N')
+  group by ss_store_sk),
+csr as (
+  select cs_catalog_page_sk as id, sum(cs_ext_sales_price) as sales,
+         sum(coalesce(cr_return_amount, 0.0)) as returns_amt,
+         sum(cs_net_profit) - sum(coalesce(cr_net_loss, 0.0)) as profit
+  from catalog_sales
+       left join catalog_returns
+       on cs_order_number = cr_order_number and cs_item_sk = cr_item_sk,
+       date_dim
+  where cs_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-01' and date '2000-08-30'
+    and cs_promo_sk in (select p_promo_sk from promotion
+                        where p_channel_tv = 'N')
+  group by cs_catalog_page_sk),
+wsr as (
+  select ws_web_site_sk as id, sum(ws_ext_sales_price) as sales,
+         sum(coalesce(wr_return_amt, 0.0)) as returns_amt,
+         sum(ws_net_profit) - sum(coalesce(wr_net_loss, 0.0)) as profit
+  from web_sales
+       left join web_returns
+       on ws_order_number = wr_order_number and ws_item_sk = wr_item_sk,
+       date_dim
+  where ws_sold_date_sk = d_date_sk
+    and d_date between date '2000-08-01' and date '2000-08-30'
+    and ws_promo_sk in (select p_promo_sk from promotion
+                        where p_channel_tv = 'N')
+  group by ws_web_site_sk)
+select channel, id, sum(sales) as sales, sum(returns_amt) as returns_amt,
+       sum(profit) as profit
+from (select 'store channel' as channel, id, sales, returns_amt, profit
+      from ssr
+      union all
+      select 'catalog channel' as channel, id, sales, returns_amt, profit
+      from csr
+      union all
+      select 'web channel' as channel, id, sales, returns_amt, profit
+      from wsr) x
+group by rollup(channel, id)
+order by channel, id
+limit 100
+""",
+    "q81": """
+with ctr as (
+  select cr_returning_customer_sk as ctr_cust, ca_state as ctr_state,
+         sum(cr_return_amt_inc_tax) as ctr_total
+  from catalog_returns, date_dim, customer, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 2000
+    and cr_returning_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name, ca_city,
+       ca_zip, ctr_total
+from ctr ctr1, customer, customer_address
+where ctr1.ctr_total > (select avg(ctr_total) * 1.2 from ctr ctr2
+                        where ctr1.ctr_state = ctr2.ctr_state)
+  and ctr1.ctr_cust = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ca_state = 'GA'
+order by c_customer_id, c_salutation, c_first_name, c_last_name, ca_city,
+         ca_zip
+limit 100
+""",
+    "q82": """
+select distinct i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim
+where i_current_price between 62 and 92
+  and i_manufact_id in (8, 33, 58, 83)
+  and inv_item_sk = i_item_sk
+  and inv_quantity_on_hand between 100 and 500
+  and inv_date_sk = d_date_sk
+  and d_date between date '2000-05-25' and date '2000-07-24'
+  and i_item_sk in (select ss_item_sk from store_sales)
+order by i_item_id
+limit 100
+""",
+    "q83": """
+with dates as (
+  select d_date_sk from date_dim
+  where d_week_seq in (select d_week_seq from date_dim
+                       where d_date in (date '2000-06-30',
+                                        date '2000-09-27',
+                                        date '2000-11-17'))),
+sr_items as (
+  select i_item_id as sr_item_id, sum(sr_return_quantity) as sr_qty
+  from store_returns, item
+  where sr_returned_date_sk in (select d_date_sk from dates)
+    and sr_item_sk = i_item_sk
+  group by i_item_id),
+cr_items as (
+  select i_item_id as cr_item_id, sum(cr_return_quantity) as cr_qty
+  from catalog_returns, item
+  where cr_returned_date_sk in (select d_date_sk from dates)
+    and cr_item_sk = i_item_sk
+  group by i_item_id),
+wr_items as (
+  select i_item_id as wr_item_id, sum(wr_return_quantity) as wr_qty
+  from web_returns, item
+  where wr_returned_date_sk in (select d_date_sk from dates)
+    and wr_item_sk = i_item_sk
+  group by i_item_id)
+select sr_item_id as item_id, sr_qty,
+       sr_qty / cast(sr_qty + cr_qty + wr_qty as double) * 100 as sr_dev,
+       cr_qty,
+       cr_qty / cast(sr_qty + cr_qty + wr_qty as double) * 100 as cr_dev,
+       wr_qty,
+       wr_qty / cast(sr_qty + cr_qty + wr_qty as double) * 100 as wr_dev,
+       cast(sr_qty + cr_qty + wr_qty as double) / 3.0 as average
+from sr_items, cr_items, wr_items
+where sr_item_id = cr_item_id and sr_item_id = wr_item_id
+order by item_id, sr_qty
+limit 100
+""",
+    "q84": """
+select c_customer_id as customer_id, c_last_name, c_first_name
+from customer, customer_address, customer_demographics, store_returns
+where c_current_addr_sk = ca_address_sk and ca_city = 'Fairview'
+  and c_current_cdemo_sk = cd_demo_sk
+  and cd_demo_sk = sr_cdemo_sk
+order by customer_id
+limit 100
+""",
+    "q85": """
+select r_reason_desc, avg(ws_quantity) as avg_q,
+       avg(wr_refunded_cash) as avg_cash, avg(wr_fee) as avg_fee
+from web_returns, web_sales, date_dim, web_page, reason,
+     customer_demographics
+where wr_order_number = ws_order_number and wr_item_sk = ws_item_sk
+  and ws_sold_date_sk = d_date_sk and d_year = 2000
+  and ws_web_page_sk = wp_web_page_sk
+  and wr_reason_sk = r_reason_sk
+  and wr_refunded_cdemo_sk = cd_demo_sk
+  and ((cd_marital_status = 'M' and cd_education_status = 'Advanced Degree'
+        and ws_sales_price >= 100.0)
+       or (cd_marital_status = 'S' and cd_education_status = 'College'
+           and ws_sales_price >= 50.0)
+       or (cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+           and ws_sales_price >= 0.0))
+group by r_reason_desc
+order by r_reason_desc, avg_q, avg_cash, avg_fee
+limit 100
+""",
+    "q87": """
+select count(*) as cnt
+from (select distinct c_last_name, c_first_name, d_date
+      from store_sales, date_dim, customer
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1211
+        and ss_customer_sk = c_customer_sk) store_c
+     left anti join
+     (select distinct c_last_name as ln, c_first_name as fn, d_date as dt
+      from catalog_sales, date_dim, customer
+      where cs_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1211
+        and cs_bill_customer_sk = c_customer_sk) catalog_c
+     on c_last_name = ln and c_first_name = fn and d_date = dt
+     left anti join
+     (select distinct c_last_name as wl, c_first_name as wf, d_date as wd
+      from web_sales, date_dim, customer
+      where ws_sold_date_sk = d_date_sk
+        and d_month_seq between 1200 and 1211
+        and ws_bill_customer_sk = c_customer_sk) web_c
+     on c_last_name = wl and c_first_name = wf and d_date = wd
+""",
+    "q91": """
+select cc_call_center_id, cc_name, cc_manager, cd_marital_status,
+       cd_education_status, sum(cr_net_loss) as returns_loss
+from catalog_returns, date_dim, call_center, customer,
+     customer_demographics, household_demographics, customer_address
+where cr_returned_date_sk = d_date_sk and d_year = 1998 and d_moy = 11
+  and cr_call_center_sk = cc_call_center_sk
+  and cr_returning_customer_sk = c_customer_sk
+  and c_current_cdemo_sk = cd_demo_sk
+  and ((cd_marital_status = 'M' and cd_education_status = 'Unknown')
+       or (cd_marital_status = 'W'
+           and cd_education_status = 'Advanced Degree'))
+  and c_current_hdemo_sk = hd_demo_sk
+  and hd_buy_potential like 'Unknown%'
+  and c_current_addr_sk = ca_address_sk
+  and ca_gmt_offset = -7
+group by cc_call_center_id, cc_name, cc_manager, cd_marital_status,
+         cd_education_status
+order by returns_loss desc
+limit 100
+""",
+    "q95": """
+with multi_wh as (
+  select distinct won
+  from (select ws_order_number as won, ws_warehouse_sk as wwh
+        from web_sales) ws1,
+       (select ws_order_number as won2, ws_warehouse_sk as wwh2
+        from web_sales) ws2
+  where won = won2 and wwh <> wwh2)
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales, date_dim, customer_address
+where ws_ship_date_sk = d_date_sk
+  and d_date between date '1999-02-01' and date '1999-04-02'
+  and ws_ship_addr_sk = ca_address_sk and ca_state = 'GA'
+  and ws_order_number in (select won from multi_wh)
+  and ws_order_number in (select distinct wr_order_number from web_returns)
+""",
+    "q97": """
+with ssci as (
+  select distinct ss_customer_sk as s_cust, ss_item_sk as s_item
+  from store_sales
+  where ss_sold_date_sk in (select d_date_sk from date_dim
+                            where d_month_seq between 1200 and 1211)),
+csci as (
+  select distinct cs_bill_customer_sk as c_cust, cs_item_sk as c_item
+  from catalog_sales
+  where cs_sold_date_sk in (select d_date_sk from date_dim
+                            where d_month_seq between 1200 and 1211))
+select sum(case when s_item is not null and c_item is null
+           then 1 else 0 end) as store_only,
+       sum(case when s_item is null and c_item is not null
+           then 1 else 0 end) as catalog_only,
+       sum(case when s_item is not null and c_item is not null
+           then 1 else 0 end) as store_and_catalog
+from ssci full join csci on s_cust = c_cust and s_item = c_item
+""",
 }

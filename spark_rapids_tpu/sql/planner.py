@@ -1055,12 +1055,15 @@ class SqlPlanner:
             for alias, cols_ in scope.relations:
                 out_cols.extend((f"{alias}.{c}", c) for c in cols_)
             names = [n for _, n in out_cols]
-            final = self._order_limit(
-                stmt, df,
-                lambda d: d.select(*[col(q).alias(n) for q, n in out_cols]),
-                names, scope)
-            return (final.dropDuplicates() if stmt.distinct else final,
-                    names)
+
+            def star_final(d):
+                f = d.select(*[col(q).alias(n) for q, n in out_cols])
+                # DISTINCT before ORDER BY/LIMIT (SQL semantics; applying it
+                # after would reorder rows and drop past-limit groups)
+                return f.dropDuplicates() if stmt.distinct else f
+
+            final = self._order_limit(stmt, df, star_final, names, scope)
+            return final, names
 
         has_agg = bool(stmt.group_by) or any(_has_agg(i.expr) for i in items) \
             or (stmt.having is not None and _has_agg(stmt.having))
@@ -1069,12 +1072,16 @@ class SqlPlanner:
             if stmt.having is not None:
                 raise SqlError("HAVING without aggregation")
             sel_scope = scope if outer is None else scope.merged(outer)
-            final = self._order_limit(
-                stmt, df,
-                lambda d: d.select(*[to_column(i.expr, sel_scope).alias(n)
-                                     for i, n in zip(items, names)]),
-                names, sel_scope)
-            return (final.dropDuplicates() if stmt.distinct else final, names)
+
+            def plain_final(d):
+                f = d.select(*[to_column(i.expr, sel_scope).alias(n)
+                               for i, n in zip(items, names)])
+                # DISTINCT before ORDER BY/LIMIT (SQL semantics; applying it
+                # after would reorder rows and drop past-limit groups)
+                return f.dropDuplicates() if stmt.distinct else f
+
+            final = self._order_limit(stmt, df, plain_final, names, sel_scope)
+            return final, names
 
         return self._aggregate_phase(stmt, df, scope, items)
 
@@ -1199,6 +1206,12 @@ class SqlPlanner:
             if resolved_out:
                 final = make_final(pre_df).sort(*orders)
             else:
+                if stmt.distinct:
+                    # the pre-projection sort would be destroyed by the
+                    # dedup group-by; Spark rejects this shape too
+                    raise SqlError(
+                        "ORDER BY with SELECT DISTINCT must reference "
+                        "columns in the select list")
                 orders = []
                 for o in stmt.order_by:
                     e = _substitute(o.expr, table) if table else o.expr

@@ -337,3 +337,35 @@ def test_sql_cte_with_window_rollup_combo():
     for cat, rs in by_cat.items():
         svals = [r["s"] for r in sorted(rs, key=lambda r: r["rk"])]
         assert svals == sorted(svals, reverse=True), (cat, svals)
+
+
+def test_select_distinct_order_limit_semantics():
+    """Regression (q82): DISTINCT applies before ORDER BY and LIMIT — the
+    output must be deduplicated, fully sorted, and limited over the DISTINCT
+    groups (not over the raw duplicated rows)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    t = pa.table({"k": [3, 1, 2, 3, 1, 2, 3, 1], "v": [1] * 8})
+    sess.create_dataframe(t).createOrReplaceTempView("dups")
+    out = sess.sql("select distinct k from dups order by k").collect()
+    assert out.column("k").to_pylist() == [1, 2, 3]
+    out = sess.sql("select distinct k from dups order by k limit 2").collect()
+    assert out.column("k").to_pylist() == [1, 2]
+    # select * form
+    out = sess.sql("select distinct * from dups order by k, v").collect()
+    assert out.column("k").to_pylist() == [1, 2, 3]
+
+
+def test_select_distinct_order_by_hidden_column_rejected():
+    """Spark raises an analysis error for SELECT DISTINCT ordered by a
+    non-selected column (the dedup group-by cannot preserve that order)."""
+    import pyarrow as pa
+    import pytest as _pytest
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.sql.planner import SqlError
+    sess = TpuSession()
+    t = pa.table({"k": [1, 2], "v": [9, 8]})
+    sess.create_dataframe(t).createOrReplaceTempView("t2")
+    with _pytest.raises(SqlError, match="DISTINCT"):
+        sess.sql("select distinct k from t2 order by v")
