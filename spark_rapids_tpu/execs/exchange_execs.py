@@ -279,6 +279,10 @@ def _slice_padded(colvs: Sequence[ColV], schema: Schema, start: int,
 # ------------------------------------------------------------------ bounds
 _SAMPLE_TARGET = 4096
 
+#: sentinel distinguishing "cannot fuse pids into the kernel" (try the
+#: two-dispatch path) from "kernel path refused entirely" (None -> sort)
+_NOT_FUSABLE = object()
+
 
 def _sample_bounds(orders: Sequence[SortOrder], sampled: List[List[ColV]],
                    n: int) -> Optional[List[ColV]]:
@@ -469,11 +473,15 @@ def _round_robin_offset(part: Partitioning, map_partition: int,
 
 
 def _compute_pids(xp, part: Partitioning, ectx: EvalCtx, cap: int,
-                  offset: int, bounds: Optional[List[ColV]]):
+                  offset, bounds: Optional[List[ColV]]):
+    """``offset`` may be a python int or a traced int32 scalar — the fused
+    exchange program passes it as a RUNTIME argument so one compiled
+    program serves every round-robin batch offset."""
     if isinstance(part, SinglePartitioning) or part.num_partitions == 1:
         return xp.zeros(cap, dtype=np.int32)
     if isinstance(part, RoundRobinPartitioning):
-        return ((xp.arange(cap, dtype=np.int32) + np.int32(offset))
+        return ((xp.arange(cap, dtype=np.int32)
+                 + xp.asarray(offset).astype(np.int32))
                 % np.int32(part.num_partitions)).astype(np.int32)
     if isinstance(part, HashPartitioning):
         keys = [e.eval(ectx) for e in part.keys]
@@ -652,6 +660,62 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                 continue
             yield j, _slice_padded(sorted_cols, schema, int(offsets[j]), cnt)
 
+    def _fused_pids_split(self, ctx, part, db: DeviceBatch, offset: int,
+                          n: int, interpret: bool):
+        """ONE program for pids + pack + Pallas reorder (the engine analog
+        of bench.py's fused kernel measurement — separate pids/pack/kernel
+        dispatches were the warm exchange's dominant residue). Returns
+        _NOT_FUSABLE when the partitioning hashes a DOUBLE key: the fused
+        form would hash bitcast(bits) where the two-dispatch path hashes
+        the column's (emulated) f64 data, and those can disagree in the
+        low mantissa on this backend."""
+        from spark_rapids_tpu.shuffle import partition_kernel as pk
+        if isinstance(part, HashPartitioning):
+            try:
+                if any(k.dtype() is DType.DOUBLE for k in part.keys):
+                    return _NOT_FUSABLE
+            except TypeError:
+                return _NOT_FUSABLE
+        spec = pk.PackSpec.for_batch(db)
+        if spec is None or n < 2 or n > pk.MAX_PARTS:
+            return _NOT_FUSABLE
+        schema, cap, smax = db.schema, db.capacity, ctx.string_max_bytes
+        geom = pk.KernelGeom.plan(cap, n, spec.lanes)
+        # offset rides as a RUNTIME argument, not a cache-key component: a
+        # round-robin repartition cycles offsets per source batch, and each
+        # distinct key value would retrace the heavyweight pack+Pallas
+        # program (the pids math is shape-stable in offset)
+        key = ("exchange-fused", part, spec, geom, cap, smax, interpret)
+
+        def build(part=part, spec=spec, geom=geom, schema=schema, cap=cap,
+                  smax=smax, interpret=interpret):
+            inner = pk.reorder_program(spec, geom, cap, interpret)
+
+            def fn(num_rows, offset_rt, *flat):
+                # rebuild eval-ready columns from _deflate order (f64 data
+                # re-derived from the u64 bits sibling)
+                colvs, i = [], 0
+                for plan, f in zip(spec.plans, schema):
+                    main = flat[i]
+                    validity = flat[i + 1]
+                    i += 2
+                    lengths = None
+                    if plan.kind == "string":
+                        lengths = flat[i]
+                        i += 1
+                    data = (jax.lax.bitcast_convert_type(main, jnp.float64)
+                            if plan.kind == "f64bits" else main)
+                    colvs.append(ColV(f.dtype, data, validity, lengths))
+                ectx = EvalCtx(jnp, colvs, cap, smax)
+                pids = _compute_pids(jnp, part, ectx, cap, offset_rt, None)
+                return inner(num_rows, pids, *flat)
+            return fn
+
+        fn = _cached_jit(key, build)
+        out, summary = fn(np.int32(db.num_rows), np.int32(offset),
+                          *pk._deflate(spec, db))
+        return pk.finalize_split(out, summary, spec, geom)
+
     def _kernel_split(self, ctx, part, db: DeviceBatch, offset: int, n: int):
         """The fused-kernel split: compute pids (same hash/round-robin math
         as the sort path), run pack+kernel, consolidate each partition into
@@ -668,21 +732,28 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         if isinstance(part, RangePartitioning):
             return None                       # bounds path stays on sort
         schema, cap, smax = db.schema, db.capacity, ctx.string_max_bytes
-        pid_key = ("exchange-pids", part, schema, cap, smax, offset)
+        res = self._fused_pids_split(ctx, part, db, offset, n, interpret)
+        if res is _NOT_FUSABLE:
+            # two-dispatch fallback: separate pids program, then pack+kernel
+            pid_key = ("exchange-pids", part, schema, cap, smax, offset)
 
-        def build(part=part, schema=schema, cap=cap, smax=smax,
-                  offset=offset):
-            def fn(*flat):
-                colvs = _unflatten_colvs(schema, flat)
-                ectx = EvalCtx(jnp, colvs, cap, smax)
-                return _compute_pids(jnp, part, ectx, cap, offset, None)
-            return fn
+            def build(part=part, schema=schema, cap=cap, smax=smax,
+                      offset=offset):
+                def fn(*flat):
+                    colvs = _unflatten_colvs(schema, flat)
+                    ectx = EvalCtx(jnp, colvs, cap, smax)
+                    return _compute_pids(jnp, part, ectx, cap, offset, None)
+                return fn
 
-        pids = _cached_jit(pid_key, build)(*_flatten(db))
-        res = pk.split_batch_kernel(db, pids, n, interpret=interpret)
+            pids = _cached_jit(pid_key, build)(*_flatten(db))
+            res = pk.split_batch_kernel(db, pids, n, interpret=interpret)
         if res is None:
             return None
         out, stats, spec, geom = res
+        # per-partition consolidation: one shape-stable program serves every
+        # partition (fusing all partitions into one dispatch was tried and
+        # backed out — dispatches between host syncs pipeline, so it bought
+        # nothing and duplicated this logic; docs/perf-notes.md round 4)
         pieces = []
         for j in range(n):
             sub = pk.consolidate(out, stats, j, spec, schema, geom)

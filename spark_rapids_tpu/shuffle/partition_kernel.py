@@ -445,6 +445,15 @@ def split_batch_kernel(batch: DeviceBatch, pids, n: int,
     fn = reorder_program(spec, geom, batch.capacity, interpret)
     out, summary = fn(np.int32(batch.num_rows), pids,
                       *_deflate(spec, batch))
+    return finalize_split(out, summary, spec, geom)
+
+
+def finalize_split(out, summary, spec: PackSpec, geom: KernelGeom):
+    """Unpack the compact summary vector of a reorder run into host stats.
+    Returns (out, stats_host, spec, geom) or None on pack-inexact/overflow
+    (caller falls back to the sort path). Shared by the standalone kernel
+    entry above and the engine's fused pids+pack+kernel program
+    (execs/exchange_execs.py _kernel_split)."""
     summary = np.asarray(summary)          # ONE small host round trip
     ok, counts, ovf = summary[0], summary[1:-1], summary[-1]
     if not ok or ovf > 0:

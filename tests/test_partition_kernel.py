@@ -151,3 +151,45 @@ def test_exchange_kernel_mode_matches_sort_path():
     out_fast = q(fast).collect()
     out_slow = q(slow).collect()
     assert_tables_equal(out_slow, out_fast, approx_float=1e-9)
+
+
+def test_fused_program_shared_across_round_robin_offsets():
+    """Round-robin offsets ride as runtime arguments (code review): two
+    batches with different offsets must reuse ONE compiled fused program
+    and still land every row."""
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.execs import tpu_execs
+    from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+    from spark_rapids_tpu.execs.exchange_execs import (
+        RoundRobinPartitioning, TpuShuffleExchangeExec)
+
+    t = _table(600)
+    batch = DeviceBatch.from_arrow(t, string_max_bytes=16)
+
+    class _Leaf(LeafExec):
+        is_device = True
+
+        def execute(self, ctx):
+            yield batch
+
+    conf = TpuConf({"spark.rapids.tpu.shuffle.kernel.mode": "interpret",
+                    "spark.rapids.tpu.sql.string.maxBytes": 16})
+    ctx = ExecContext(conf)
+    ex = TpuShuffleExchangeExec(RoundRobinPartitioning(4),
+                                _Leaf(batch.schema))
+
+    def fused_keys():
+        return [k for k in tpu_execs._JIT_CACHE
+                if isinstance(k, tuple) and k and k[0] == "exchange-fused"]
+
+    r1 = ex._kernel_split(ctx, ex.partitioning, batch, 0, 4)
+    n_after_first = len(fused_keys())
+    r2 = ex._kernel_split(ctx, ex.partitioning, batch, 3, 4)
+    assert len(fused_keys()) == n_after_first, \
+        "new offset recompiled the fused exchange program"
+    assert sum(b.num_rows for _, b in r1) == batch.num_rows
+    assert sum(b.num_rows for _, b in r2) == batch.num_rows
+    # offset shifts rows between partitions but preserves the multiset
+    all1 = sorted(sum((_rows_key(b.to_arrow()) for _, b in r1), []), key=repr)
+    all2 = sorted(sum((_rows_key(b.to_arrow()) for _, b in r2), []), key=repr)
+    assert all1 == all2

@@ -22,17 +22,13 @@ MESH_CONF = {
     "spark.rapids.tpu.sql.exec.CartesianProduct": "true",
 }
 
-#: the round-3 verdict bar: >=60 of the 99 queries distributed over the
-#: mesh. Star joins, rollups (MeshExpandExec), windows (MeshWindowExec),
+#: round 4: ALL 99 queries distributed over the mesh (the reference
+#: distributes every exec it supports; round-3 verdict item 6 asked >=60).
+#: Star joins, rollups (MeshExpandExec), windows (MeshWindowExec),
 #: multi-channel unions, count-distinct, returns chains, inventory scans,
-#: shipping reports with (not) exists, scalar-subquery discounts
-_QUERIES = ("q3", "q6", "q7", "q8", "q9", "q12", "q13", "q15", "q17",
-            "q19", "q20", "q21", "q25", "q26", "q27", "q28", "q29", "q31",
-            "q32", "q33", "q34", "q36", "q37", "q40", "q42", "q43", "q45",
-            "q46", "q47", "q48", "q50", "q51", "q52", "q55", "q56", "q57",
-            "q59", "q60", "q61", "q62", "q63", "q65", "q66", "q67", "q68",
-            "q71", "q73", "q76", "q79", "q82", "q84", "q86", "q88", "q89",
-            "q90", "q91", "q92", "q93", "q94", "q96", "q97", "q98", "q99")
+#: shipping reports with (not) exists, scalar-subquery discounts,
+#: cross-year CTE self-joins, full-outer channel comparison
+_QUERIES = tuple(sorted(QUERIES, key=lambda n: int(n[1:])))
 
 
 @pytest.fixture(autouse=True, scope="module")
