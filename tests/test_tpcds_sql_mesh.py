@@ -1,0 +1,81 @@
+"""RAW SQL in, DISTRIBUTED execution out: TPC-DS SQL text through the
+frontend with the mesh enabled — the full reference pipeline analog
+(Catalyst parses TpcdsLikeSpark's SQL and the plugin distributes the
+physical plan; here sql/ parses, plan/mesh_rewrite distributes).
+
+A representative spread of shapes (star join, correlated avg subquery,
+CTE chains, rollup+rank, cumulative windows, anti joins, full outer) —
+the full 99 run distributed from their DataFrame forms in
+test_tpcds_mesh.py and as SQL single-device in test_tpcds_sql.py; this
+module pins the COMPOSITION."""
+import pytest
+
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+from spark_rapids_tpu.benchmarks.tpcds_sql import SQL_QUERIES
+from spark_rapids_tpu.testing import assert_tpu_and_cpu_equal
+
+pytestmark = pytest.mark.slow
+
+_SCALE = 0.01
+
+MESH_CONF = {
+    **BENCH_CONF,
+    "spark.rapids.tpu.sql.mesh.enabled": "true",
+    "spark.rapids.tpu.sql.adaptive.enabled": "true",
+    "spark.rapids.tpu.sql.exec.NestedLoopJoin": "true",
+    "spark.rapids.tpu.sql.exec.CartesianProduct": "true",
+}
+
+#: shape spread: q3 star join, q1 correlated avg, q2 CTE+union+ratio,
+#: q18 rollup, q47 windows+self-join, q51 cumulative frames, q69 anti,
+#: q82 distinct+semi, q88 8-way cross of scalar counts, q97 full outer
+_QUERIES = ("q3", "q1", "q2", "q18", "q47", "q51", "q69", "q82", "q88",
+            "q97")
+
+
+_RAN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_cache_clear():
+    yield
+    _RAN["n"] += 1
+    if _RAN["n"] % 4 == 0:
+        import gc
+
+        import jax
+        jax.clear_caches()
+        from spark_rapids_tpu.execs import evaluator, tpu_execs
+        if hasattr(tpu_execs, "_JIT_CACHE"):
+            tpu_execs._JIT_CACHE.clear()
+        evaluator._JIT_CACHE.clear()
+        gc.collect()
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_all(_SCALE, seed=0)
+
+
+def _sql_df(tables, qname):
+    def build(s):
+        for name, tab in tables.items():
+            s.create_dataframe(tab).createOrReplaceTempView(name)
+        return s.sql(SQL_QUERIES[qname])
+    return build
+
+
+@pytest.mark.parametrize("qname", _QUERIES)
+def test_tpcds_sql_on_mesh_matches_cpu(qname, tables, eight_devices):
+    assert_tpu_and_cpu_equal(_sql_df(tables, qname), conf=MESH_CONF,
+                             ignore_order=True, approx_float=1e-6)
+
+
+def test_sql_rollup_really_distributes(tables, eight_devices):
+    """The SQL-built rollup must lower to the mesh breadth operators, not
+    silently gather to one device."""
+    assert_tpu_and_cpu_equal(
+        _sql_df(tables, "q18"), conf=MESH_CONF, ignore_order=True,
+        approx_float=1e-6,
+        expect_tpu_execs=["MeshExpandExec", "MeshHashAggregateExec"])
