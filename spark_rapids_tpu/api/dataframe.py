@@ -12,10 +12,11 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import pyarrow as pa
 
 from spark_rapids_tpu.api.column import Column, _expr
-from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
 from spark_rapids_tpu.config import TpuConf
 from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
-from spark_rapids_tpu.exprs import Alias, SortOrder, UnresolvedAttribute
+from spark_rapids_tpu.exprs import (Alias, Coalesce, SortOrder,
+                                    UnresolvedAttribute)
 from spark_rapids_tpu.plan import logical as lp
 from spark_rapids_tpu.plan.overrides import TpuOverrides
 from spark_rapids_tpu.plan.planner import plan_physical
@@ -86,6 +87,53 @@ def _extract_windows(exprs, child: lp.LogicalPlan):
     for aliases in groups.values():
         node = lp.Window(tuple(aliases), node)
     return new_exprs, node
+
+
+class Row(dict):
+    """Collected row: dict with attribute access (pyspark Row analog)."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        return f"Row({inner})"
+
+
+def _show_cell(v, width: int) -> str:
+    s = "null" if v is None else str(v)
+    if width and len(s) > width:
+        # pyspark: plain cut below 4 chars, ellipsis otherwise
+        s = s[:width] if width < 4 else s[:width - 3] + "..."
+    return s
+
+
+def _null_safe_set_op(left: "DataFrame", right: "DataFrame",
+                      mode: str) -> "DataFrame":
+    """SQL set-operation semantics (distinct rows, nulls compare equal,
+    positional columns like Spark): tag each side, union, group by every
+    column — group keys dedup with null==null natively — and keep groups
+    by which sides contributed."""
+    from spark_rapids_tpu.api import functions as F
+    names = left.schema().names()
+    if len(names) != len(right.schema().names()):
+        raise ValueError(
+            f"set operation column-count mismatch: {names} vs "
+            f"{right.schema().names()}")
+    la = left.dropDuplicates().withColumn("__setf", F.lit(1))
+    rb = (right.toDF(*names).dropDuplicates()
+          .withColumn("__setf", F.lit(2)))
+    agg = (la.union(rb).groupBy(*names)
+           .agg(F.min("__setf").alias("__mn"),
+                F.max("__setf").alias("__mx")))
+    if mode == "intersect":
+        agg = agg.filter((F.col("__mn") == 1) & (F.col("__mx") == 2))
+    else:                                   # subtract / EXCEPT
+        agg = agg.filter(F.col("__mx") == 1)
+    return agg.select(*names)
 
 
 class DataFrame:
@@ -258,6 +306,190 @@ class DataFrame:
             return agg
         # restore the original column order
         return agg.select(*all_names)
+
+    # ---- row-level conveniences (pyspark user surface) -----------------------
+    def _rows(self) -> List["Row"]:
+        table = self.collect()
+        cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+        names = table.column_names
+        return [Row(zip(names, vals)) for vals in zip(*cols)] if cols else []
+
+    def take(self, n: int) -> List["Row"]:
+        return self.limit(n)._rows()
+
+    def head(self, n: Optional[int] = None):
+        """head() -> first Row or None; head(n) -> list of Rows (pyspark)."""
+        if n is None:
+            rows = self.take(1)
+            return rows[0] if rows else None
+        return self.take(n)
+
+    def first(self):
+        return self.head()
+
+    def show(self, n: int = 20, truncate: Union[bool, int] = True) -> None:
+        """Print the first n rows formatted as pyspark does."""
+        width = 20 if truncate is True else (0 if truncate is False
+                                             else int(truncate))
+        table = self.limit(n).collect()
+        names = table.column_names
+        cols = [[_show_cell(v, width) for v in table.column(i).to_pylist()]
+                for i in range(table.num_columns)]
+        widths = [max([len(nm)] + [len(v) for v in col])
+                  for nm, col in zip(names, cols)]
+        sep = "+" + "+".join("-" * w for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(nm.rjust(w) for nm, w in zip(names, widths))
+              + "|")
+        print(sep)
+        for r in range(table.num_rows):
+            print("|" + "|".join(cols[i][r].rjust(widths[i])
+                                 for i in range(len(names))) + "|")
+        print(sep)
+
+    def printSchema(self) -> None:
+        lines = ["root"]
+        for f in self.schema():
+            lines.append(f" |-- {f.name}: {f.dtype.value} "
+                         f"(nullable = {str(f.nullable).lower()})")
+        print("\n".join(lines))
+
+    def describe(self, *cols: str) -> "DataFrame":
+        """count/mean/stddev/min/max per column, values stringified in a
+        'summary' table (pyspark describe). One aggregation pass."""
+        from spark_rapids_tpu.api import functions as F
+        schema = self.schema()
+        names = list(cols) or [f.name for f in schema
+                               if f.dtype.is_numeric
+                               or f.dtype is DType.STRING]
+        stat_fns = {"count": F.count, "mean": F.avg, "stddev": F.stddev,
+                    "min": F.min, "max": F.max}
+        aggs = []
+        for nm in names:
+            dt = schema[schema.index_of(nm)].dtype
+            for stat, fn in stat_fns.items():
+                if stat in ("mean", "stddev") and not dt.is_numeric:
+                    continue
+                aggs.append(fn(nm).alias(f"{stat}__{nm}"))
+        out = self.agg(*aggs).collect()
+        vals = {c: out.column(c)[0].as_py() for c in out.column_names}
+        stats = []
+        for stat in stat_fns:
+            row = {"summary": stat}
+            for nm in names:
+                v = vals.get(f"{stat}__{nm}")
+                row[nm] = None if v is None else str(v)
+            stats.append(row)
+        return self.session.create_dataframe(pa.Table.from_pylist(stats))
+
+    def sample(self, withReplacement=None, fraction=None, seed=None
+               ) -> "DataFrame":
+        """Bernoulli sample WITHOUT replacement (rand(seed) < fraction).
+        Accepts both pyspark call forms: sample(fraction[, seed]) and
+        sample(withReplacement, fraction[, seed])."""
+        from spark_rapids_tpu.api import functions as F
+        if not isinstance(withReplacement, bool) and \
+                withReplacement is not None:
+            # sample(fraction[, seed]) form: shift arguments
+            withReplacement, fraction, seed = None, withReplacement, fraction
+        if withReplacement:
+            raise NotImplementedError(
+                "sample(withReplacement=True) is not supported")
+        if fraction is None:
+            raise TypeError("sample() needs a fraction")
+        return self.filter(F.rand(0 if seed is None else int(seed))
+                           < float(fraction))
+
+    def toDF(self, *names: str) -> "DataFrame":
+        cur = self.schema().names()
+        if len(names) != len(cur):
+            raise ValueError(f"toDF needs {len(cur)} names, got {len(names)}")
+        exprs = tuple(Alias(UnresolvedAttribute(o), n)
+                      for o, n in zip(cur, names))
+        return DataFrame(lp.Project(exprs, self._plan), self.session)
+
+    def withColumnsRenamed(self, mapping: Dict[str, str]) -> "DataFrame":
+        exprs = tuple(Alias(UnresolvedAttribute(f.name),
+                            mapping.get(f.name, f.name))
+                      for f in self.schema())
+        return DataFrame(lp.Project(exprs, self._plan), self.session)
+
+    def unionByName(self, other: "DataFrame",
+                    allowMissingColumns: bool = False) -> "DataFrame":
+        from spark_rapids_tpu.api import functions as F
+        mine = self.schema().names()
+        theirs = other.schema().names()
+        if allowMissingColumns:
+            all_names = mine + [n for n in theirs if n not in mine]
+
+            def null_as(schema, n):
+                # typed null (Spark casts the null literal to the peer type)
+                dt = schema[schema.index_of(n)].dtype
+                return F.lit(None).cast(dt.value).alias(n)
+
+            left = self.select(*[F.col(n) if n in mine
+                                 else null_as(other.schema(), n)
+                                 for n in all_names])
+            right = other.select(*[F.col(n) if n in theirs
+                                   else null_as(self.schema(), n)
+                                   for n in all_names])
+            return left.union(right)
+        if set(mine) != set(theirs):
+            raise ValueError(
+                f"unionByName column mismatch: {mine} vs {theirs}")
+        return self.union(other.select(*mine))
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in both (SQL INTERSECT: nulls compare
+        equal, Spark semantics)."""
+        return _null_safe_set_op(self, other, "intersect")
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of self absent from other (SQL EXCEPT)."""
+        return _null_safe_set_op(self, other, "subtract")
+
+    def exceptAll(self, other: "DataFrame") -> "DataFrame":
+        raise NotImplementedError(
+            "exceptAll (bag semantics) is not supported; use subtract() "
+            "for SQL EXCEPT (distinct) semantics")
+
+    def dropna(self, how: str = "any", thresh: Optional[int] = None,
+               subset: Optional[List[str]] = None) -> "DataFrame":
+        """pyspark na.drop: NaN counts as null for float columns
+        (AtLeastNNonNulls, the expression Spark plans for dropna)."""
+        from spark_rapids_tpu.exprs import AtLeastNNonNulls
+        names = subset or self.schema().names()
+        need = thresh if thresh is not None else (
+            len(names) if how == "any" else 1)
+        cond = AtLeastNNonNulls(
+            need, tuple(UnresolvedAttribute(n) for n in names))
+        return DataFrame(lp.Filter(cond, self._plan), self.session)
+
+    def fillna(self, value, subset: Optional[List[str]] = None
+               ) -> "DataFrame":
+        from spark_rapids_tpu.api import functions as F
+        schema = self.schema()
+        names = subset or [f.name for f in schema]
+        by_col = value if isinstance(value, dict) else {n: value
+                                                        for n in names}
+        exprs = []
+        for f in schema:
+            v = by_col.get(f.name)
+            compatible = v is not None and (
+                (f.dtype.is_numeric and isinstance(v, (int, float))
+                 and not isinstance(v, bool))
+                or (f.dtype is DType.STRING and isinstance(v, str))
+                or (f.dtype is DType.BOOLEAN and isinstance(v, bool)))
+            if compatible:
+                src: Any = UnresolvedAttribute(f.name)
+                if f.dtype.is_floating and isinstance(v, (int, float)):
+                    # pyspark na.fill also replaces NaN in float columns
+                    from spark_rapids_tpu.exprs import NaNvl
+                    src = NaNvl(src, F.lit(float(v)).expr)
+                exprs.append(Alias(Coalesce((src, F.lit(v).expr)), f.name))
+            else:
+                exprs.append(UnresolvedAttribute(f.name))
+        return DataFrame(lp.Project(tuple(exprs), self._plan), self.session)
 
     # ---- caching -------------------------------------------------------------
     def cache(self) -> "DataFrame":

@@ -80,7 +80,14 @@ class Coalesce(Expression):
                 continue
             take_new = xp.logical_and(xp.logical_not(out.validity), v.validity)
             if dt is DType.STRING:
-                from spark_rapids_tpu.ops.strings import align_widths
+                from spark_rapids_tpu.ops.strings import (_bcast_rows,
+                                                          align_widths)
+                # a string LITERAL evals as one row: broadcast to the column
+                vdat, vlen = _bcast_rows(xp, v.data, v.lengths, out.data)
+                odat, olen = _bcast_rows(xp, out.data, out.lengths, vdat)
+                v = ColV(dt, vdat, xp.broadcast_to(
+                    xp.asarray(v.validity), take_new.shape), vlen)
+                out = ColV(dt, odat, out.validity, olen)
                 vd, od = align_widths(xp, v.data, out.data)
                 tn = take_new[..., None] if hasattr(take_new, "ndim") and vd.ndim == 2 else take_new
                 data = xp.where(tn, vd, od)

@@ -1,0 +1,176 @@
+"""pyspark user-surface conveniences: show/head/take/first/printSchema/
+describe/sample/toDF/unionByName/intersect/subtract/dropna/fillna — the
+day-one APIs a user migrating from the reference's Spark sessions reaches
+for (exercised throughout the reference's pytest integration suite)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api.dataframe import Row, TpuSession
+from spark_rapids_tpu.api import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return TpuSession()
+
+
+@pytest.fixture()
+def df(sess):
+    return sess.create_dataframe(pa.table({
+        "k": [1, 2, None, 4, 5],
+        "v": [10.0, None, 30.0, 40.0, 50.0],
+        "s": ["aa", "bb", None, "dd", "a-very-long-string-value"],
+    }))
+
+
+def test_take_head_first(df):
+    rows = df.take(2)
+    assert len(rows) == 2 and isinstance(rows[0], Row)
+    assert rows[0].k == 1 and rows[0]["v"] == 10.0
+    assert df.first().s == "aa"
+    assert df.head() == rows[0]
+    assert df.head(3)[2].k is None
+    empty = df.filter(F.col("k") > 100)
+    assert empty.head() is None and empty.take(5) == []
+
+
+def test_show_and_print_schema(df, capsys):
+    df.show(3)
+    out = capsys.readouterr().out
+    assert "|  k|" in out.replace(" ", " ") or "k" in out
+    assert "null" in out
+    df.show(5, truncate=10)
+    out = capsys.readouterr().out
+    assert "a-very-..." in out
+    df.printSchema()
+    out = capsys.readouterr().out
+    assert out.startswith("root")
+    assert " |-- k: long (nullable = true)" in out
+
+
+def test_describe(df):
+    out = df.describe("k", "v").collect()
+    d = {r["summary"]: r for r in out.to_pylist()}
+    assert d["count"]["k"] == "4"          # nulls excluded
+    assert float(d["mean"]["v"]) == pytest.approx(32.5)
+    assert d["min"]["k"] == "1" and d["max"]["k"] == "5"
+    assert float(d["stddev"]["v"]) > 0
+
+
+def test_sample_is_deterministic_and_bounded(sess):
+    big = sess.create_dataframe(pa.table({"x": list(range(2000))}))
+    a = big.sample(0.25, seed=7).collect()
+    b = big.sample(0.25, seed=7).collect()
+    assert a.num_rows == b.num_rows
+    assert a.column("x").to_pylist() == b.column("x").to_pylist()
+    assert 0 < a.num_rows < 2000
+    assert abs(a.num_rows / 2000 - 0.25) < 0.1
+
+
+def test_todf_and_rename(df):
+    out = df.toDF("a", "b", "c")
+    assert out.columns == ["a", "b", "c"]
+    out = df.withColumnsRenamed({"k": "key", "s": "str"})
+    assert out.columns == ["key", "v", "str"]
+    with pytest.raises(ValueError):
+        df.toDF("only-two", "names")
+
+
+def test_union_by_name(sess):
+    a = sess.create_dataframe(pa.table({"x": [1], "y": [2]}))
+    b = sess.create_dataframe(pa.table({"y": [20], "x": [10]}))
+    out = a.unionByName(b).collect()
+    assert out.column("x").to_pylist() == [1, 10]
+    assert out.column("y").to_pylist() == [2, 20]
+    c = sess.create_dataframe(pa.table({"x": [99]}))
+    with pytest.raises(ValueError):
+        a.unionByName(c)
+    out = a.unionByName(c, allowMissingColumns=True).collect()
+    assert out.column("y").to_pylist() == [2, None]
+
+
+def test_intersect_and_subtract_null_semantics(sess):
+    a = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, None], "s": ["x", "x", "y", None]}))
+    b = sess.create_dataframe(pa.table({
+        "k": [1, None, 3], "s": ["x", None, "z"]}))
+    inter = a.intersect(b).collect().to_pylist()
+    # distinct + nulls compare equal (SQL INTERSECT)
+    assert sorted(inter, key=repr) == sorted(
+        [{"k": 1, "s": "x"}, {"k": None, "s": None}], key=repr)
+    sub = a.subtract(b).collect().to_pylist()
+    assert sub == [{"k": 2, "s": "y"}]
+
+
+def test_dropna_modes(df):
+    assert df.dropna().count() == 3               # rows with ANY null out
+    assert df.dropna(how="all").count() == 5      # no all-null rows
+    assert df.dropna(subset=["k"]).count() == 4
+    assert df.dropna(thresh=3).count() == 3       # all three non-null
+
+
+def test_fillna_scalar_and_dict(df):
+    out = df.fillna(0).collect()
+    assert out.column("k").to_pylist() == [1, 2, 0, 4, 5]
+    assert out.column("v").to_pylist() == [10.0, 0.0, 30.0, 40.0, 50.0]
+    assert out.column("s").to_pylist()[2] is None     # type-incompatible
+    out = df.fillna({"s": "??", "v": -1.0}).collect()
+    assert out.column("s").to_pylist()[2] == "??"
+    assert out.column("v").to_pylist()[1] == -1.0
+    assert out.column("k").to_pylist()[2] is None     # not in dict
+    out = df.fillna("zz").collect()
+    assert out.column("s").to_pylist()[2] == "zz"
+    assert out.column("k").to_pylist()[2] is None
+
+
+def test_conveniences_match_cpu_engine(sess):
+    """The new surface lowers to ordinary plans: TPU and CPU engines agree."""
+    t = pa.table({"k": [1, None, 3, 3], "v": [1.5, 2.5, None, 4.0]})
+    on = TpuSession()
+    off = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    for build in (lambda s: s.create_dataframe(t).dropna(),
+                  lambda s: s.create_dataframe(t).fillna(9),
+                  lambda s: s.create_dataframe(t).intersect(
+                      s.create_dataframe(t)),
+                  lambda s: s.create_dataframe(t).subtract(
+                      s.create_dataframe(
+                          pa.table({"k": [3], "v": [4.0]})))):
+        a = build(on).collect()
+        b = build(off).collect()
+        assert sorted(a.to_pylist(), key=repr) == \
+            sorted(b.to_pylist(), key=repr)
+
+
+def test_dropna_fillna_nan_semantics(sess):
+    """Code review: pyspark treats NaN as missing in float columns for
+    na.drop/na.fill."""
+    t = pa.table({"v": pa.array([1.0, float("nan"), None])})
+    df = sess.create_dataframe(t)
+    assert df.dropna().count() == 1
+    out = df.fillna(0).collect().column("v").to_pylist()
+    assert out == [1.0, 0.0, 0.0]
+
+
+def test_sample_pyspark_call_forms(sess):
+    big = sess.create_dataframe(pa.table({"x": list(range(500))}))
+    a = big.sample(0.3, 5).collect()
+    b = big.sample(False, 0.3, 5).collect()
+    assert a.column("x").to_pylist() == b.column("x").to_pylist()
+    with pytest.raises(NotImplementedError):
+        big.sample(True, 0.3)
+    with pytest.raises(TypeError):
+        big.sample()
+
+
+def test_except_all_raises(sess):
+    a = sess.create_dataframe(pa.table({"x": [1, 1, 2]}))
+    b = sess.create_dataframe(pa.table({"x": [1]}))
+    with pytest.raises(NotImplementedError):
+        a.exceptAll(b)
+
+
+def test_show_tiny_truncate(df, capsys):
+    df.show(truncate=2)
+    out = capsys.readouterr().out
+    assert "|a-|" in out            # plain cut, no ellipsis below width 4
+    assert "..." not in out
