@@ -458,6 +458,8 @@ class DataFrame:
         """pyspark na.drop: NaN counts as null for float columns
         (AtLeastNNonNulls, the expression Spark plans for dropna)."""
         from spark_rapids_tpu.exprs import AtLeastNNonNulls
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
         names = subset or self.schema().names()
         need = thresh if thresh is not None else (
             len(names) if how == "any" else 1)
@@ -486,7 +488,13 @@ class DataFrame:
                     # pyspark na.fill also replaces NaN in float columns
                     from spark_rapids_tpu.exprs import NaNvl
                     src = NaNvl(src, F.lit(float(v)).expr)
-                exprs.append(Alias(Coalesce((src, F.lit(v).expr)), f.name))
+                filled = Coalesce((src, F.lit(v).expr))
+                if f.dtype.is_numeric and isinstance(v, float):
+                    # Spark casts the result BACK to the column type, so a
+                    # double fill never widens an integer column
+                    from spark_rapids_tpu.exprs.cast import Cast
+                    filled = Cast(filled, f.dtype)
+                exprs.append(Alias(filled, f.name))
             else:
                 exprs.append(UnresolvedAttribute(f.name))
         return DataFrame(lp.Project(tuple(exprs), self._plan), self.session)
@@ -774,8 +782,75 @@ class GroupedData:
         self._df = df
         self._grouping = grouping
         self._mode = mode
+        self._pivot: Optional[tuple] = None
+
+    def pivot(self, col_name: str, values: Optional[List] = None
+              ) -> "GroupedData":
+        """Spark pivot: one output column per pivot value. With no values
+        list, the distinct pivot values are queried first (exactly what
+        Spark does, which is why it recommends passing them)."""
+        if self._mode != "groupby":
+            raise NotImplementedError("pivot with rollup/cube")
+        if values is None:
+            vals = (self._df.select(col_name).distinct().collect()
+                    .column(0).to_pylist())
+            values = sorted([v for v in vals if v is not None],
+                            key=lambda v: (str(type(v)), v))
+            if any(v is None for v in vals):
+                values.insert(0, None)      # Spark's 'null' pivot column
+        g = GroupedData(self._df, self._grouping)
+        g._pivot = (col_name, list(values))
+        return g
 
     def agg(self, *cols: Column) -> DataFrame:
+        if self._pivot is not None:
+            return self._pivot_agg(cols)
+        return self._agg_impl(cols)
+
+    def _pivot_agg(self, cols) -> DataFrame:
+        """Pivot lowering (Catalyst's single-aggregation pivot shape):
+        each aggregate becomes one conditional aggregate per pivot value —
+        agg(when(p == v, child)) AS <v>[_<aggname>]."""
+        from spark_rapids_tpu.api import functions as F
+        from spark_rapids_tpu.exprs.core import Expression
+        pcol, values = self._pivot
+        from spark_rapids_tpu.exprs.aggregates import (AggregateFunction,
+                                                       DistinctAgg)
+        aggs = []
+        for v in values:
+            for c in cols:
+                e = c.expr
+                name_suffix = None
+                if isinstance(e, Alias):
+                    name_suffix = e.name
+                    e = e.c
+                if not isinstance(e, AggregateFunction):
+                    raise NotImplementedError(
+                        "pivot aggregates must be plain aggregate "
+                        "functions (optionally aliased), e.g. sum(col)")
+
+                # rewrite the aggregate's input to when(p == v, input);
+                # a null pivot value matches with isNull (Spark's 'null'
+                # pivot column)
+                def gate(child: Expression, v=v) -> Expression:
+                    match = (F.col(pcol).isNull() if v is None
+                             else F.col(pcol) == F.lit(v))
+                    return (F.when(match, Column(child))
+                            .otherwise(F.lit(None))).expr
+
+                if isinstance(e, DistinctAgg):
+                    # gate INSIDE the distinct wrapper so the rewrite in
+                    # _distinct_agg still sees an aggregate at the top
+                    gated = DistinctAgg(e.inner.map_children(gate))
+                else:
+                    gated = e.map_children(gate)
+                base = "null" if v is None else str(v)
+                name = (base if len(cols) == 1 and name_suffix is None
+                        else f"{base}_{name_suffix or e.name_hint}")
+                aggs.append(Column(Alias(gated, name)))
+        return GroupedData(self._df, self._grouping).agg(*aggs)
+
+    def _agg_impl(self, cols) -> DataFrame:
         from spark_rapids_tpu.exprs import DistinctAgg
         aggs = []
         for i, c in enumerate(cols):

@@ -174,3 +174,63 @@ def test_show_tiny_truncate(df, capsys):
     out = capsys.readouterr().out
     assert "|a-|" in out            # plain cut, no ellipsis below width 4
     assert "..." not in out
+
+
+def test_pivot_basic(sess):
+    t = pa.table({"year": [2020, 2020, 2021, 2021, 2021],
+                  "cat": ["a", "b", "a", "a", None],
+                  "amt": [1.0, 2.0, 3.0, 4.0, 9.0]})
+    df = sess.create_dataframe(t)
+    out = (df.groupBy("year").pivot("cat").agg(F.sum("amt"))
+           .sort("year").collect())
+    # inferred values include the null pivot column (Spark semantics)
+    assert out.column_names == ["year", "null", "a", "b"]
+    assert out.column("a").to_pylist() == [1.0, 7.0]
+    assert out.column("b").to_pylist() == [2.0, None]
+    assert out.column("null").to_pylist() == [None, 9.0]
+    # explicit values pin column order and include absent values
+    out = (df.groupBy("year").pivot("cat", ["b", "a", "zzz"])
+           .agg(F.sum("amt")).sort("year").collect())
+    assert out.column_names == ["year", "b", "a", "zzz"]
+    assert out.column("zzz").to_pylist() == [None, None]
+
+
+def test_pivot_multiple_aggs_and_count(sess):
+    t = pa.table({"k": [1, 1, 2], "p": ["x", "y", "x"],
+                  "v": [10, 20, 30]})
+    df = sess.create_dataframe(t)
+    out = (df.groupBy("k").pivot("p")
+           .agg(F.sum("v").alias("s"), F.count("v").alias("c"))
+           .sort("k").collect())
+    assert out.column_names == ["k", "x_s", "x_c", "y_s", "y_c"]
+    assert out.column("x_s").to_pylist() == [10, 30]
+    assert out.column("x_c").to_pylist() == [1, 1]
+    assert out.column("y_c").to_pylist() == [1, 0]
+    with pytest.raises(NotImplementedError):
+        df.groupBy("k").pivot("p").agg(F.sum("v") + F.lit(1))
+
+
+def test_pivot_null_values_and_count_distinct(sess):
+    """Code review: null pivot values form a 'null' column; countDistinct
+    composes with pivot."""
+    t = pa.table({"k": [1, 1, 1, 2], "p": ["x", None, None, "x"],
+                  "v": [5, 7, 7, 9]})
+    df = sess.create_dataframe(t)
+    out = df.groupBy("k").pivot("p").agg(F.sum("v")).sort("k").collect()
+    assert out.column_names == ["k", "null", "x"]
+    assert out.column("null").to_pylist() == [14, None]
+    out = (df.groupBy("k").pivot("p", ["x", None])
+           .agg(F.countDistinct("v")).sort("k").collect())
+    assert out.column_names == ["k", "x", "null"]
+    assert out.column("x").to_pylist() == [1, 1]
+    assert out.column("null").to_pylist() == [1, 0]
+
+
+def test_dropna_validates_how_and_fillna_keeps_int_type(sess):
+    df = sess.create_dataframe(pa.table({"k": pa.array([1, None, 3],
+                                                       type=pa.int64())}))
+    with pytest.raises(ValueError):
+        df.dropna(how="bogus")
+    out = df.fillna(0.9).collect()
+    assert out.schema.field("k").type == pa.int64()   # not widened
+    assert out.column("k").to_pylist() == [1, 0, 3]   # cast like Spark
