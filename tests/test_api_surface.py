@@ -234,3 +234,22 @@ def test_dropna_validates_how_and_fillna_keeps_int_type(sess):
     out = df.fillna(0.9).collect()
     assert out.schema.field("k").type == pa.int64()   # not widened
     assert out.column("k").to_pylist() == [1, 0, 3]   # cast like Spark
+
+
+def test_new_surface_composes_with_mesh(eight_devices):
+    """pivot/set-ops/na functions lower to ordinary plans, so they must
+    distribute like any aggregate/union when the mesh is on."""
+    mesh_sess = TpuSession({"spark.rapids.tpu.mesh.enabled": "true"})
+    plain = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+
+    def build(s):
+        t = pa.table({"k": [i % 3 for i in range(300)],
+                      "p": [["x", "y", "z"][i % 3] for i in range(300)],
+                      "v": [float(i) if i % 7 else None
+                            for i in range(300)]})
+        df = s.create_dataframe(t).fillna(0.5).dropna()
+        return df.groupBy("k").pivot("p", ["x", "y"]).agg(F.sum("v"))
+
+    a = sorted(build(mesh_sess).collect().to_pylist(), key=repr)
+    b = sorted(build(plain).collect().to_pylist(), key=repr)
+    assert a == b
