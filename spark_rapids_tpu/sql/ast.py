@@ -187,9 +187,10 @@ class Select(Node):
 
 @dataclass(frozen=True)
 class SetOp(Node):
-    """UNION [ALL] chain (left-folded). Members are full SELECTs; ORDER
-    BY/LIMIT written inside a member bind to that member."""
-    op: str                                # union_all | union
+    """UNION [ALL] / INTERSECT / EXCEPT chain (left-folded). Members are
+    full SELECTs; ORDER BY/LIMIT written inside a member bind to that
+    member."""
+    op: str                    # union_all | union | intersect | except
     left: Node                             # Select | SetOp
     right: Node                            # Select
     ctes: Tuple[Tuple[str, "Select"], ...] = ()

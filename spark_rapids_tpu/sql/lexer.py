@@ -26,7 +26,7 @@ KEYWORDS = {
     "asc", "desc", "union", "all", "date", "interval", "extract", "cast",
     "substring", "true", "false", "for", "over", "partition", "rows",
     "unbounded", "preceding", "following", "current", "row", "rollup",
-    "cube", "range", "with",
+    "cube", "range", "with", "intersect", "except",
 }
 
 #: window/grouping words are NON-reserved (Spark keeps them usable as

@@ -72,14 +72,31 @@ class Parser:
 
     # ---- statement ---------------------------------------------------------
     def parse_query(self) -> A.Node:
-        """SELECT (UNION [ALL] SELECT)* — the set-op chain derived tables
-        and CTE bodies accept (TpcdsLikeSpark's multi-channel unions)."""
+        """SELECT ((UNION [ALL] | INTERSECT | EXCEPT) SELECT)* — the
+        set-op chain derived tables and CTE bodies accept
+        (TpcdsLikeSpark's multi-channel unions; q14/q38/q87-style
+        INTERSECT/EXCEPT). Chains fold LEFT uniformly (standard SQL gives
+        INTERSECT higher precedence than UNION/EXCEPT — parenthesize
+        mixed chains that rely on it)."""
         q: A.Node = self.parse_select()
-        while self.at_kw("union"):
-            self.next()
-            all_ = self.eat_kw("all")
+        ops_seen = set()
+        while self.at_kw("union", "intersect", "except"):
+            kw = self.next().value.lower()
+            if kw == "union":
+                all_ = self.eat_kw("all")
+                op = "union_all" if all_ else "union"
+            else:
+                op = kw
+            ops_seen.add("intersect" if op == "intersect" else "other")
+            if len(ops_seen) > 1:
+                # left-folding would silently violate INTERSECT's higher
+                # standard-SQL precedence: refuse rather than misparse
+                raise SqlError(
+                    "mixing INTERSECT with UNION/EXCEPT in one chain is "
+                    "ambiguous here (INTERSECT binds tighter in SQL); "
+                    "parenthesize via derived tables")
             r = self.parse_select()
-            q = A.SetOp("union_all" if all_ else "union", q, r)
+            q = A.SetOp(op, q, r)
         return q
 
     def parse_select(self) -> A.Select:

@@ -472,11 +472,16 @@ class SqlPlanner:
             rdf, rnames = self.plan(stmt.right, outer)
             if len(lnames) != len(rnames):
                 raise SqlError(
-                    f"UNION arms have {len(lnames)} vs {len(rnames)} columns")
-            # positional union (SQL semantics): right arm renamed to the
+                    f"{stmt.op.split('_')[0].upper()} arms have "
+                    f"{len(lnames)} vs {len(rnames)} columns")
+            # positional set op (SQL semantics): right arm renamed to the
             # left arm's output names
             rdf = rdf.select(*[col(rn).alias(ln)
                                for rn, ln in zip(rnames, lnames)])
+            if stmt.op == "intersect":
+                return ldf.intersect(rdf), lnames
+            if stmt.op == "except":
+                return ldf.subtract(rdf), lnames
             df = ldf.union(rdf)
             if stmt.op == "union":      # UNION (distinct)
                 df = df.distinct()

@@ -369,3 +369,38 @@ def test_select_distinct_order_by_hidden_column_rejected():
     sess.create_dataframe(t).createOrReplaceTempView("t2")
     with _pytest.raises(SqlError, match="DISTINCT"):
         sess.sql("select distinct k from t2 order by v")
+
+
+def test_sql_intersect_except():
+    """INTERSECT / EXCEPT set operations (the official TPC-DS q14/q38/q87
+    texts use them): distinct rows, nulls compare equal, positional
+    columns."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table(
+        {"a": [1, 1, 2, 3, None]})).createOrReplaceTempView("ta")
+    sess.create_dataframe(pa.table(
+        {"b": [1, 3, None, 9]})).createOrReplaceTempView("tb")
+    out = sess.sql("select a from ta intersect select b from tb").collect()
+    assert sorted(out.column("a").to_pylist(),
+                  key=lambda v: (v is None, v)) == [1, 3, None]
+    out = sess.sql("select a from ta except select b from tb").collect()
+    assert out.column("a").to_pylist() == [2]
+    # uniform chains fold left; MIXED chains are refused (INTERSECT binds
+    # tighter in standard SQL — left-folding would silently misparse)
+    import pytest as _pytest
+    from spark_rapids_tpu.sql.lexer import SqlError
+    with _pytest.raises(SqlError, match="INTERSECT"):
+        sess.sql("""select a from ta intersect select b from tb
+                    except select 1 as x""")
+    # the parenthesized (derived-table) form works
+    out = sess.sql("""
+        select a from (select a from ta intersect select b from tb) i
+        except select 1 as x""").collect()
+    assert sorted(out.column("a").to_pylist(),
+                  key=lambda v: (v is None, v)) == [3, None]
+    # uniform intersect chain still folds
+    out = sess.sql("""select a from ta intersect select b from tb
+                      intersect select 1 as x""").collect()
+    assert out.column("a").to_pylist() == [1]
