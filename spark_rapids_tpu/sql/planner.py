@@ -197,7 +197,42 @@ _FUNCS = {
     "least": lambda a: F.least(*a),
     "pow": lambda a: F.pow(a[0], a[1]),
     "power": lambda a: F.pow(a[0], a[1]),
+    "substr": lambda a: F.substring(a[0], _int(a[1]),
+                                    _int(a[2]) if len(a) > 2
+                                    else (1 << 30)),   # 2-arg: to end
+    "lpad": lambda a: F.lpad(a[0], _int(a[1]), _str(a[2])),
+    "rpad": lambda a: F.rpad(a[0], _int(a[1]), _str(a[2])),
+    "ltrim": lambda a: (F.ltrim(a[0]) if len(a) == 1
+                        else F.ltrim(a[1], _str(a[0]))),  # 2-arg: chars, s
+    "rtrim": lambda a: (F.rtrim(a[0]) if len(a) == 1
+                        else F.rtrim(a[1], _str(a[0]))),
+    "instr": lambda a: F.instr(a[0], _str(a[1])),
+    "locate": lambda a: F.locate(_str(a[0]), a[1], _int(a[2]) if len(a) > 2
+                                 else 1),
+    "replace": lambda a: F.replace(a[0], _str(a[1]),
+                                   _str(a[2]) if len(a) > 2 else ""),
+    "regexp_replace": lambda a: F.regexp_replace(a[0], _str(a[1]),
+                                                 _str(a[2])),
+    "nvl": lambda a: (F.coalesce(*a) if len(a) == 2
+                      else _arity_error("nvl", 2, len(a))),
+    "nanvl": lambda a: F.nanvl(a[0], a[1]),
+    "pmod": lambda a: F.pmod(a[0], a[1]),
+    "char_length": lambda a: F.length(a[0]),
+    "weekday": lambda a: F.weekday(a[0]),
+    "from_unixtime": lambda a: (F.from_unixtime(a[0]) if len(a) == 1
+                                else _arity_error("from_unixtime with a "
+                                                  "format", 1, len(a))),
+    "unix_timestamp": lambda a: (F.unix_timestamp(a[0]) if len(a) == 1
+                                 else _arity_error("unix_timestamp with a "
+                                                   "format", 1, len(a))),
+    "substring_index": lambda a: F.substring_index(a[0], _str(a[1]),
+                                                   _int(a[2])),
 }
+
+
+def _arity_error(name: str, want: int, got: int):
+    raise SqlError(f"{name} is not supported with {got} arguments "
+                   f"(expected {want})")
 
 
 def _int(c: Column) -> int:
@@ -205,6 +240,13 @@ def _int(c: Column) -> int:
     if isinstance(c.expr, Literal):
         return int(c.expr.value)
     raise SqlError("expected an integer literal argument")
+
+
+def _str(c: Column) -> str:
+    from spark_rapids_tpu.exprs import Literal
+    if isinstance(c.expr, Literal) and isinstance(c.expr.value, str):
+        return c.expr.value
+    raise SqlError("expected a string literal argument")
 
 
 # ---------------------------------------------------------------------------

@@ -404,3 +404,53 @@ def test_sql_intersect_except():
     out = sess.sql("""select a from ta intersect select b from tb
                       intersect select 1 as x""").collect()
     assert out.column("a").to_pylist() == [1]
+
+
+def test_sql_string_function_registry():
+    """String/misc functions newly exposed to SQL match their DataFrame
+    forms."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "s": ["hello world", "a,b,c", None, "  pad  "],
+        "n": [7, -7, 3, None]})).createOrReplaceTempView("t")
+    out = sess.sql("""
+        select substr(s, 1, 5) as sub, lpad(s, 14, '*') as lp,
+               rtrim(s) as rt, instr(s, 'o') as pos,
+               replace(s, ',', ';') as rep, nvl(s, '??') as nv,
+               char_length(s) as ln, pmod(n, 5) as pm,
+               substring_index(s, ',', 2) as si
+        from t""").collect()
+    r = out.to_pylist()
+    assert r[0]["sub"] == "hello" and r[0]["pos"] == 5
+    assert r[1]["rep"] == "a;b;c" and r[1]["si"] == "a,b"
+    assert r[2]["nv"] == "??"
+    assert r[3]["rt"] == "  pad"
+    assert r[0]["lp"] == "***hello world"
+    assert r[1]["pm"] == 3            # Spark pmod: positive result
+    assert r[2]["ln"] is None
+
+
+def test_sql_function_arity_forms():
+    """Code review: 2-arg substr/replace work (Spark semantics), trim chars
+    are honored, and unsupported format/arity forms raise SqlError rather
+    than silently returning wrong data."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({"s": ["000x0", "hello world"]})
+                          ).createOrReplaceTempView("tf")
+    out = sess.sql("""
+        select substr(s, 7) as tail, replace(s, '0') as gone,
+               ltrim('0', s) as lt, rtrim('0', s) as rt
+        from tf""").collect()
+    r = out.to_pylist()
+    assert r[1]["tail"] == "world"
+    assert r[0]["gone"] == "x"
+    assert r[0]["lt"] == "x0" and r[0]["rt"] == "000x"
+    for bad in ("select nvl(s, s, s) from tf",
+                "select from_unixtime(1, 'yyyy') from tf",
+                "select unix_timestamp(s, 'yyyy') from tf"):
+        with pytest.raises(SqlError):
+            sess.sql(bad)
