@@ -263,3 +263,19 @@ def test_cached_scan_falls_back_from_cluster():
     wantg = _sorted_pylist(plain.create_dataframe(_table())
                            .groupBy("k").agg(F.count().alias("n")).collect())
     assert got == wantg and want
+
+
+def test_sql_over_cached_view():
+    """sess.sql over a view whose DataFrame is cached must scan the cache
+    (the reference accelerates Spark-cached tables under SQL the same
+    way)."""
+    sess = _sess()
+    df = sess.create_dataframe(_table()).cache()
+    df.createOrReplaceTempView("cached_t")
+    first = sess.sql("select k, sum(v) as sv from cached_t group by k "
+                     "order by k").collect()
+    assert any(isinstance(n, TpuCachedScanExec)
+               for n in _iter_execs(sess.last_plan))
+    second = sess.sql("select count(*) as n from cached_t").collect()
+    assert second.column("n")[0].as_py() == 1000
+    assert first.num_rows == 7

@@ -62,3 +62,22 @@ def test_mesh_broadcast_join_ran(tables, eight_devices):
             {k: s.create_dataframe(v) for k, v in tables.items()}),
         conf=MESH_CONF, ignore_order=True, approx_float=1e-9,
         expect_tpu_execs=["MeshScatterExec", "MeshBroadcastHashJoinExec"])
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 7, 18, 21])
+def test_tpch_sql_on_mesh_matches_cpu(qnum, eight_devices):
+    """RAW SQL text distributed over the mesh for TPC-H too (the TPC-DS
+    composition lives in test_tpcds_sql_mesh.py)."""
+    from spark_rapids_tpu.benchmarks.tpch_data import gen_all as tpch_gen
+    from spark_rapids_tpu.benchmarks.tpch_sql import SQL_QUERIES
+    from spark_rapids_tpu.testing import run_with_cpu_and_tpu
+    from spark_rapids_tpu.testing import assert_tables_equal
+    tables = tpch_gen(0.002, seed=7)
+
+    def build(s):
+        for name, tab in tables.items():
+            s.create_dataframe(tab).createOrReplaceTempView(name)
+        return s.sql(SQL_QUERIES[qnum])
+
+    cpu, tpu, _sess = run_with_cpu_and_tpu(build, MESH_CONF)
+    assert_tables_equal(cpu, tpu, ignore_order=True, approx_float=1e-6)
