@@ -123,6 +123,18 @@ class TableRef(Node):
 
 
 @dataclass(frozen=True)
+class PivotRef(Node):
+    """FROM rel PIVOT (agg [AS a][, ...] FOR col IN (lit [AS a], ...))
+    (Spark SQL's PIVOT clause; lowers to GroupedData.pivot with the
+    implicit group-by over the untouched columns)."""
+    child: Node                   # TableRef | SubqueryRef
+    aggs: Tuple                   # ((expr, alias|None), ...)
+    pivot_col: "ColRef"
+    values: Tuple                 # ((literal value, alias|None), ...)
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class SubqueryRef(Node):
     query: "Select"
     alias: str

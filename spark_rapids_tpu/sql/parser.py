@@ -269,14 +269,61 @@ class Parser:
             self.expect_op(")")
             self.eat_kw("as")
             alias = self._ident()
-            return A.SubqueryRef(q, alias)
+            return self._maybe_pivot(A.SubqueryRef(q, alias))
         name = self._ident()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self._ident()
+        elif self.peek().kind == "IDENT" and \
+                self.peek().value.lower() != "pivot":
+            alias = self._ident()
+        ref: A.Node = A.TableRef(name, alias)
+        return self._maybe_pivot(ref)
+
+    def _maybe_pivot(self, ref: A.Node) -> A.Node:
+        """rel PIVOT (agg [AS a][, ...] FOR col IN (lit [AS a], ...))
+        [[AS] alias] — 'pivot' stays a soft keyword (usable as an
+        identifier everywhere else)."""
+        t = self.peek()
+        if not (t.kind == "IDENT" and t.value.lower() == "pivot"):
+            return ref
+        save = self.i
+        self.next()
+        if not self.at_op("("):
+            self.i = save
+            return ref
+        self.next()
+        aggs = []
+        while True:
+            e = self.expr()
+            al = self._ident() if self.eat_kw("as") else None
+            aggs.append((e, al))
+            if not self.eat_op(","):
+                break
+        self.expect_kw("for")
+        pcol = A.ColRef(self._ident())
+        self.expect_kw("in")
+        self.expect_op("(")
+        values = []
+        while True:
+            v = self.expr()
+            if isinstance(v, A.UnaryOp) and v.op == "neg" \
+                    and isinstance(v.child, A.Lit):
+                v = A.Lit(-v.child.value)
+            if not isinstance(v, A.Lit):
+                raise SqlError("PIVOT IN values must be literals")
+            val_alias = self._ident() if self.eat_kw("as") else None
+            values.append((v.value, val_alias))
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        self.expect_op(")")
         alias = None
         if self.eat_kw("as"):
             alias = self._ident()
         elif self.peek().kind == "IDENT":
             alias = self._ident()
-        return A.TableRef(name, alias)
+        return A.PivotRef(ref, tuple(aggs), pcol, tuple(values), alias)
 
     def _expr_list(self) -> list:
         out = [self.expr()]

@@ -491,6 +491,17 @@ class _Rel:
         self.raw_cols = raw_cols
 
 
+
+def _rel_alias(rel: A.Node) -> str:
+    """Display/scope alias of a FROM item (PIVOT inherits its child's
+    alias unless it has its own)."""
+    if isinstance(rel, A.SubqueryRef):
+        return rel.alias
+    if isinstance(rel, A.PivotRef):
+        return rel.alias or _rel_alias(rel.child)
+    return rel.alias or rel.name
+
+
 class SqlPlanner:
     def __init__(self, session):
         self.session = session
@@ -615,7 +626,62 @@ class SqlPlanner:
             pref = sub.select(*[col(c).alias(f"{rel.alias}.{c}")
                                 for c in out_names])
             return _Rel(rel.alias, pref, out_names)
+        if isinstance(rel, A.PivotRef):
+            return self._load_pivot(rel)
         raise SqlError(f"unsupported FROM item {type(rel).__name__}")
+
+    def _load_pivot(self, rel: "A.PivotRef") -> _Rel:
+        """Spark SQL PIVOT: implicit group-by over every column not
+        consumed by the pivot column or the aggregates, then
+        GroupedData.pivot."""
+        from spark_rapids_tpu.exprs import Alias as EAlias
+        base = self._load_relation(rel.child)
+        scope = Scope([(base.alias, base.raw_cols)])
+        pivot_pref = scope.resolve(rel.pivot_col)
+        consumed = {pivot_pref}
+        agg_cols = []
+        multi = len(rel.aggs) > 1
+        for e, al in rel.aggs:
+            for ref in _refs(e):
+                consumed.add(scope.resolve(ref))
+            c = to_column(e, scope)
+            if al is not None:
+                c = Column(EAlias(c.expr, al))
+            elif multi:
+                raise SqlError(
+                    "PIVOT with multiple aggregates needs an alias on "
+                    "each (agg AS name)")
+            agg_cols.append(c)
+        group_pref = [f"{base.alias}.{c}" for c in base.raw_cols
+                      if f"{base.alias}.{c}" not in consumed]
+        values = [v for v, _ in rel.values]
+        out = (base.df.groupBy(*[col(g) for g in group_pref])
+               .pivot(pivot_pref, values).agg(*agg_cols))
+        # value aliases rename the generated columns (IN (1 AS one)).
+        # GroupedData names plain '{value}' ONLY for a single unaliased
+        # aggregate; any alias (or multiple aggs) appends '_{aggAlias}'
+        suffixes = ([al for _, al in rel.aggs]
+                    if (multi or rel.aggs[0][1] is not None) else None)
+        renames = {}
+        for v, val_alias in rel.values:
+            if val_alias is None:
+                continue
+            vbase = "null" if v is None else str(v)
+            if suffixes is None:
+                renames[vbase] = val_alias
+            else:
+                for al in suffixes:
+                    renames[f"{vbase}_{al}"] = f"{val_alias}_{al}"
+        if renames:
+            out = out.withColumnsRenamed(renames)
+        alias = rel.alias or base.alias
+        raw = ([c.split(".", 1)[1] for c in group_pref]
+               + [c for c in out.columns if c not in group_pref])
+        pref = out.select(
+            *[col(c).alias(f"{alias}.{c.split('.', 1)[1]}")
+              if c in group_pref else col(c).alias(f"{alias}.{c}")
+              for c in out.columns])
+        return _Rel(alias, pref, raw)
 
     def _nullable_aliases(self, stmt: A.Select):
         """Aliases whose columns may be null-extended by an outer join (the
@@ -624,8 +690,7 @@ class SqlPlanner:
         seen = []
         for item in stmt.relations:
             rel = item.relation if isinstance(item, A.JoinItem) else item
-            alias = (rel.alias if isinstance(rel, A.SubqueryRef)
-                     else (rel.alias or rel.name))
+            alias = _rel_alias(rel)
             if isinstance(item, A.JoinItem):
                 if item.how == "left":
                     out.add(alias)
@@ -666,8 +731,7 @@ class SqlPlanner:
         for item in stmt.relations:
             if isinstance(item, A.JoinItem):
                 rel = item.relation
-                alias = (rel.alias if isinstance(rel, A.SubqueryRef)
-                         else (rel.alias or rel.name))
+                alias = _rel_alias(rel)
                 explicit[alias] = item
 
         done = [rels[0]]
@@ -899,7 +963,7 @@ class SqlPlanner:
     def _correlation(self, stmt: A.Select, outer_scope: Scope):
         """(eq_pairs, other) without planning — correlation probe."""
         rels_scope = Scope([
-            ((r.alias if isinstance(r, A.SubqueryRef) else (r.alias or r.name)),
+            (_rel_alias(r),
              self._relation_cols(r))
             for item in stmt.relations
             for r in [item.relation if isinstance(item, A.JoinItem) else item]])
@@ -916,6 +980,8 @@ class SqlPlanner:
             # output names of the derived table (plan-time only, no exec)
             _, names = self.plan(rel.query)
             return names
+        if isinstance(rel, A.PivotRef):
+            return self._load_pivot(rel).raw_cols
         raise SqlError(f"unsupported FROM item {type(rel).__name__}")
 
     def _plan_from_where(self, stmt: A.Select):

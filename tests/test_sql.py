@@ -454,3 +454,58 @@ def test_sql_function_arity_forms():
                 "select unix_timestamp(s, 'yyyy') from tf"):
         with pytest.raises(SqlError):
             sess.sql(bad)
+
+
+def test_sql_pivot_clause():
+    """Spark SQL PIVOT clause lowers to GroupedData.pivot with the
+    implicit group-by over untouched columns."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "year": [2020, 2020, 2021, 2021],
+        "q": ["q1", "q2", "q1", "q1"],
+        "amt": [10.0, 20.0, 30.0, 40.0]})).createOrReplaceTempView("sales")
+    out = sess.sql("""
+        select * from sales
+        pivot (sum(amt) for q in ('q1', 'q2'))
+        order by year""").collect()
+    assert out.column_names == ["year", "q1", "q2"]
+    assert out.column("q1").to_pylist() == [10.0, 70.0]
+    assert out.column("q2").to_pylist() == [20.0, None]
+    # value aliases + multiple aliased aggregates + projection
+    out = sess.sql("""
+        select year, first_s, first_n from sales
+        pivot (sum(amt) as s, count(amt) as n
+               for q in ('q1' as first, 'q2' as second))
+        order by year""").collect()
+    assert out.column("first_s").to_pylist() == [10.0, 70.0]
+    assert out.column("first_n").to_pylist() == [1, 2]
+    # multiple aggs without aliases are refused
+    with pytest.raises(SqlError, match="alias"):
+        sess.sql("""select * from sales
+                    pivot (sum(amt), count(amt) for q in ('q1'))""")
+    # 'pivot' stays usable as an identifier
+    sess.create_dataframe(pa.table({"pivot": [1, 2]})
+                          ).createOrReplaceTempView("p2")
+    assert sess.sql("select pivot from p2 order by pivot"
+                    ).collect().column("pivot").to_pylist() == [1, 2]
+
+
+def test_sql_pivot_aliased_single_agg_and_negative_values():
+    """Code review: a value alias must rename the '{value}_{aggAlias}'
+    column a single ALIASED aggregate generates, and negative literals
+    are valid PIVOT IN values."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "g": [1, 1, 2], "k": [-1, 1, -1], "v": [10.0, 20.0, 30.0]})
+    ).createOrReplaceTempView("tp")
+    out = sess.sql("""
+        select * from tp
+        pivot (sum(v) as s for k in (-1 as neg, 1 as pos))
+        order by g""").collect()
+    assert out.column_names == ["g", "neg_s", "pos_s"]
+    assert out.column("neg_s").to_pylist() == [10.0, 30.0]
+    assert out.column("pos_s").to_pylist() == [20.0, None]
