@@ -158,6 +158,8 @@ class SelectItem(Node):
 class OrderItem(Node):
     expr: Node
     ascending: bool = True
+    #: None = Spark default (nulls first when ascending, last when not)
+    nulls_first: Optional[bool] = None
 
 
 @dataclass(frozen=True)

@@ -148,16 +148,7 @@ class Parser:
         order_by: List[A.OrderItem] = []
         if self.eat_kw("order"):
             self.expect_kw("by")
-            while True:
-                e = self.expr()
-                asc = True
-                if self.eat_kw("desc"):
-                    asc = False
-                else:
-                    self.eat_kw("asc")
-                order_by.append(A.OrderItem(e, asc))
-                if not self.eat_op(","):
-                    break
+            order_by = self._order_items()
         limit = None
         if self.eat_kw("limit"):
             t = self.next()
@@ -180,16 +171,7 @@ class Parser:
         orders: List[A.OrderItem] = []
         if self.eat_kw("order"):
             self.expect_kw("by")
-            while True:
-                e = self.expr()
-                asc = True
-                if self.eat_kw("desc"):
-                    asc = False
-                else:
-                    self.eat_kw("asc")
-                orders.append(A.OrderItem(e, asc))
-                if not self.eat_op(","):
-                    break
+            orders = self._order_items()
         ftype = None
         lower = upper = None
         if self.at_kw("rows", "range"):
@@ -324,6 +306,31 @@ class Parser:
         elif self.peek().kind == "IDENT":
             alias = self._ident()
         return A.PivotRef(ref, tuple(aggs), pcol, tuple(values), alias)
+
+    def _order_items(self) -> List["A.OrderItem"]:
+        """expr [ASC|DESC] [NULLS FIRST|LAST] {, ...} — shared by the
+        statement-level ORDER BY and window specs."""
+        out: List[A.OrderItem] = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.eat_kw("desc"):
+                asc = False
+            else:
+                self.eat_kw("asc")
+            nulls_first = None
+            t = self.peek()
+            if t.kind == "IDENT" and t.value.lower() == "nulls":
+                self.next()
+                w = self._ident().lower()
+                if w not in ("first", "last"):
+                    raise SqlError(
+                        f"expected FIRST or LAST after NULLS, got {w!r}")
+                nulls_first = (w == "first")
+            out.append(A.OrderItem(e, asc, nulls_first))
+            if not self.eat_op(","):
+                break
+        return out
 
     def _expr_list(self) -> list:
         out = [self.expr()]

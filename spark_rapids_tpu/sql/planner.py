@@ -442,7 +442,8 @@ def _window_func(node: A.WindowFuncCall, scope: Scope) -> Column:
     sp = node.spec
     part = tuple(to_column(e, scope).expr for e in sp.partition_by)
     orders = tuple(
-        SortOrder(to_column(o.expr, scope).expr, o.ascending, o.ascending)
+        SortOrder(to_column(o.expr, scope).expr, o.ascending,
+                  o.ascending if o.nulls_first is None else o.nulls_first)
         for o in sp.order_by)
     frame = (WindowFrame(sp.frame_type, sp.frame_lower, sp.frame_upper)
              if sp.frame_type is not None else None)
@@ -1204,6 +1205,18 @@ class SqlPlanner:
         table: Dict[A.Node, A.Node] = {}
         key_cols = []
         for g in stmt.group_by:
+            if isinstance(g, A.Lit) and isinstance(g.value, int) \
+                    and not isinstance(g.value, bool):
+                # GROUP BY <ordinal> (Spark's groupByOrdinal, on by default)
+                v = g.value
+                if not (1 <= v <= len(items)):
+                    raise SqlError(
+                        f"GROUP BY position {v} is not in the select "
+                        f"list (1..{len(items)})")
+                if _has_agg(items[v - 1].expr):
+                    raise SqlError(
+                        f"GROUP BY position {v} is an aggregate function")
+                g = items[v - 1].expr
             if isinstance(g, A.ColRef):
                 name = scope.resolve(g)
                 key_cols.append(col(name))
@@ -1307,10 +1320,34 @@ class SqlPlanner:
         if not stmt.order_by:
             final = make_final(pre_df)
         else:
+            # ORDER BY <ordinal> names the select-list position (Spark's
+            # orderByOrdinal, on by default): the output-name form serves
+            # the post-projection sort, the underlying select expression
+            # serves the pre-projection branch (where output aliases do
+            # not exist yet)
+            order_out, order_pre = [], []
+            for o in stmt.order_by:
+                if isinstance(o.expr, A.Lit) \
+                        and isinstance(o.expr.value, int) \
+                        and not isinstance(o.expr.value, bool):
+                    v = o.expr.value
+                    if not (1 <= v <= len(names)):
+                        raise SqlError(
+                            f"ORDER BY position {v} is not in the select "
+                            f"list (1..{len(names)})")
+                    order_out.append(A.OrderItem(A.ColRef(names[v - 1]),
+                                                 o.ascending, o.nulls_first))
+                    pre_expr = (stmt.items[v - 1].expr
+                                if v - 1 < len(stmt.items) else o.expr)
+                    order_pre.append(A.OrderItem(pre_expr, o.ascending,
+                                                 o.nulls_first))
+                else:
+                    order_out.append(o)
+                    order_pre.append(o)
             out_scope = _NameScope(names)
             orders = []
             resolved_out = True
-            for o in stmt.order_by:
+            for o in order_out:
                 try:
                     orders.append(self._order_col(o, o.expr, out_scope))
                 except (KeyError, SqlError):
@@ -1326,7 +1363,7 @@ class SqlPlanner:
                         "ORDER BY with SELECT DISTINCT must reference "
                         "columns in the select list")
                 orders = []
-                for o in stmt.order_by:
+                for o in order_pre:
                     e = _substitute(o.expr, table) if table else o.expr
                     orders.append(self._order_col(o, e, pre_scope))
                 final = make_final(pre_df.sort(*orders))
@@ -1336,7 +1373,10 @@ class SqlPlanner:
 
     def _order_col(self, o: A.OrderItem, expr: A.Node, scope) -> Column:
         c = to_column(expr, scope)
-        return c.asc() if o.ascending else c.desc()
+        if o.nulls_first is None:
+            return c.asc() if o.ascending else c.desc()
+        from spark_rapids_tpu.exprs.misc import SortOrder as ESortOrder
+        return Column(ESortOrder(c.expr, o.ascending, o.nulls_first))
 
 
 class _NameScope(Scope):

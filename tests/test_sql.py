@@ -509,3 +509,52 @@ def test_sql_pivot_aliased_single_agg_and_negative_values():
     assert out.column_names == ["g", "neg_s", "pos_s"]
     assert out.column("neg_s").to_pylist() == [10.0, 30.0]
     assert out.column("pos_s").to_pylist() == [20.0, None]
+
+
+def test_sql_nulls_ordering_and_ordinals():
+    """ORDER BY ... NULLS FIRST/LAST (official TPC-DS texts use it) and
+    ordinal positions in ORDER BY / GROUP BY (Spark's
+    orderByOrdinal/groupByOrdinal defaults)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "g": ["a", "a", "b", "b"],
+        "v": pa.array([3, None, 1, None], type=pa.int64())})
+    ).createOrReplaceTempView("tn")
+    out = sess.sql("select v from tn order by v nulls last").collect()
+    assert out.column("v").to_pylist() == [1, 3, None, None]
+    out = sess.sql("select v from tn order by v desc nulls first").collect()
+    assert out.column("v").to_pylist() == [None, None, 3, 1]
+    out = sess.sql("select g, sum(v) as sv from tn group by 1 "
+                   "order by 2 desc").collect()
+    assert out.to_pydict() == {"g": ["a", "b"], "sv": [3, 1]}
+    with pytest.raises(SqlError, match="position"):
+        sess.sql("select g from tn order by 5")
+    with pytest.raises(SqlError, match="position"):
+        sess.sql("select g from tn group by 3")
+
+
+def test_sql_ordinals_in_pre_projection_branch_and_window_nulls():
+    """Code review: ordinals must work when another sort key forces the
+    pre-projection branch; NULLS ordering works inside window specs; a
+    GROUP BY ordinal naming an aggregate is rejected clearly."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "g": ["a", "b", "a"],
+        "v": pa.array([5, None, 1], type=pa.int64())})
+    ).createOrReplaceTempView("tw")
+    # ordinal + non-output key -> pre-projection sort still resolves
+    out = sess.sql("select v as w from tw order by v + 0, 1").collect()
+    assert out.column("w").to_pylist() == [None, 1, 5]
+    out = sess.sql("select g as h, sum(v) as sv from tw group by g "
+                   "order by g, 2").collect()
+    assert out.to_pydict() == {"h": ["a", "b"], "sv": [6, None]}
+    # window spec honors NULLS LAST
+    out = sess.sql("select v, row_number() over (order by v nulls last) "
+                   "as r from tw order by r").collect()
+    assert out.column("v").to_pylist() == [1, 5, None]
+    with pytest.raises(SqlError, match="aggregate"):
+        sess.sql("select g, sum(v) from tw group by 2")
