@@ -390,15 +390,21 @@ class DataFrame:
         from spark_rapids_tpu.api import functions as F
         if not isinstance(withReplacement, bool) and \
                 withReplacement is not None:
-            # sample(fraction[, seed]) form: shift arguments
-            withReplacement, fraction, seed = None, withReplacement, fraction
+            # sample(fraction[, seed]) form: shift arguments, but keep a
+            # keyword seed= that was passed alongside a positional fraction
+            withReplacement, fraction, seed = (
+                None, withReplacement,
+                fraction if fraction is not None else seed)
         if withReplacement:
             raise NotImplementedError(
                 "sample(withReplacement=True) is not supported")
         if fraction is None:
             raise TypeError("sample() needs a fraction")
-        return self.filter(F.rand(0 if seed is None else int(seed))
-                           < float(fraction))
+        if seed is None:
+            # pyspark draws a fresh random seed per unseeded call
+            import random
+            seed = random.randint(0, 2**31 - 1)
+        return self.filter(F.rand(int(seed)) < float(fraction))
 
     def toDF(self, *names: str) -> "DataFrame":
         cur = self.schema().names()

@@ -671,10 +671,18 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         low mantissa on this backend."""
         from spark_rapids_tpu.shuffle import partition_kernel as pk
         if isinstance(part, HashPartitioning):
-            try:
-                if any(k.dtype() is DType.DOUBLE for k in part.keys):
-                    return _NOT_FUSABLE
-            except TypeError:
+            # walk each key's FULL expression tree: a non-DOUBLE key over a
+            # DOUBLE subexpression (cast(dbl AS string), dbl > 0, ...) still
+            # evaluates f64 arithmetic inside the fused program, where the
+            # columns are bitcast u64 siblings rather than emulated f64
+            def _touches_double(e):
+                try:
+                    if e.dtype() is DType.DOUBLE:
+                        return True
+                except TypeError:
+                    return True
+                return any(_touches_double(c) for c in e.children)
+            if any(_touches_double(k) for k in part.keys):
                 return _NOT_FUSABLE
         spec = pk.PackSpec.for_batch(db)
         if spec is None or n < 2 or n > pk.MAX_PARTS:

@@ -100,6 +100,12 @@ class DeviceManager:
         return cls.initialize()
 
     @classmethod
+    def peek(cls) -> Optional["DeviceManager"]:
+        """Current instance WITHOUT creating one (safe from finalizers)."""
+        with cls._lock:
+            return cls._instance
+
+    @classmethod
     def shutdown(cls) -> None:
         with cls._lock:
             inst, cls._instance = cls._instance, None

@@ -62,13 +62,39 @@ class CachedData:
         return self.buffer_ids is not None
 
 
+def _release_entry(e: CachedData, dm) -> None:
+    """Drop an entry's materialized buffers from the catalog (device/host/
+    disk tiers, incl. spill files). dm may be None (manager already gone)."""
+    ids, e.buffer_ids = e.buffer_ids, None
+    if ids and dm is not None:
+        for bid in ids:
+            dm.catalog.remove(bid)
+
+
+def _finalize_entries(entries: List[CachedData]) -> None:
+    """Session finalizer: free any still-registered cached buffers when a
+    TpuSession is dropped without clearCache(). Runs via weakref.finalize,
+    so it must not reference the session or the manager — only the
+    (identity-stable) entries list."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    for e in list(entries):
+        _release_entry(e, DeviceManager.peek())
+    del entries[:]
+
+
 class CacheManager:
     """Per-session registry of cached plans (Spark CacheManager analog)."""
 
     def __init__(self, session):
+        import weakref
         self.session = session
         self._entries: List[CachedData] = []
         self._registry_lock = threading.Lock()
+        # keyed on the session: fires when the session↔manager cycle is
+        # collected, and holds no ref that keeps either alive (the entries
+        # list is identity-stable — clear() mutates it in place)
+        self._finalizer = weakref.finalize(session, _finalize_entries,
+                                           self._entries)
 
     # ---- registration ----------------------------------------------------------
     def add(self, logical: lp.LogicalPlan) -> CachedData:
@@ -99,17 +125,15 @@ class CacheManager:
 
     def clear(self) -> None:
         with self._registry_lock:
-            entries, self._entries = self._entries, []
+            entries = list(self._entries)
+            del self._entries[:]    # in place: the finalizer holds this list
         for e in entries:
             self._free(e)
 
     def _free(self, e: CachedData) -> None:
-        ids, e.buffer_ids = e.buffer_ids, None
-        if ids:
+        if e.buffer_ids:
             from spark_rapids_tpu.memory.device_manager import DeviceManager
-            catalog = DeviceManager.get().catalog
-            for bid in ids:
-                catalog.remove(bid)
+            _release_entry(e, DeviceManager.get())
 
     # ---- planning-time substitution --------------------------------------------
     def substitute(self, logical: lp.LogicalPlan,

@@ -249,8 +249,16 @@ class Parser:
             self.next()
             q = self.parse_query()
             self.expect_op(")")
-            self.eat_kw("as")
-            alias = self._ident()
+            alias = None
+            if self.eat_kw("as"):
+                alias = self._ident()
+            elif self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() != "pivot":
+                # 'pivot' is a soft keyword: FROM (subquery) PIVOT (...)
+                # carries no derived-table alias (Spark accepts this form)
+                alias = self._ident()
+            if alias is None:
+                alias = "__auto_generated_subquery_name"
             return self._maybe_pivot(A.SubqueryRef(q, alias))
         name = self._ident()
         alias = None

@@ -253,3 +253,12 @@ def test_new_surface_composes_with_mesh(eight_devices):
     a = sorted(build(mesh_sess).collect().to_pylist(), key=repr)
     b = sorted(build(plain).collect().to_pylist(), key=repr)
     assert a == b
+
+
+def test_sample_unseeded_draws_fresh_seed(sess):
+    """Advisor (round 4): unseeded sample() must not pin rand(0) — two
+    unseeded calls should (with overwhelming probability) pick different
+    seeds. Asserted on the plan's rand seed, not row luck."""
+    big = sess.create_dataframe(pa.table({"x": list(range(100))}))
+    seeds = {repr(big.sample(0.5)._plan) for _ in range(8)}
+    assert len(seeds) > 1

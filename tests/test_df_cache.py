@@ -279,3 +279,19 @@ def test_sql_over_cached_view():
     second = sess.sql("select count(*) as n from cached_t").collect()
     assert second.column("n")[0].as_py() == 1000
     assert first.num_rows == 7
+
+
+def test_dropped_session_finalizer_frees_cached_buffers():
+    """Advisor (round 4): dropping a TpuSession without clearCache() must not
+    leak cached buffers in the process-global DeviceManager catalog — a
+    weakref.finalize on the session frees them when the session is GC'd."""
+    import gc
+    sess = _sess()
+    df = sess.create_dataframe(_table()).cache()
+    df.collect()
+    ids = list(sess.cache_manager.lookup(df._plan).buffer_ids)
+    assert any(bid in set(DeviceManager.get().catalog.ids()) for bid in ids)
+    del df, sess
+    gc.collect()
+    live = set(DeviceManager.get().catalog.ids())
+    assert not any(bid in live for bid in ids)

@@ -137,3 +137,44 @@ def test_string_hash_distribution():
     pids = hash_partition_ids(np, keys, 256, 8)
     counts = np.bincount(pids, minlength=8)
     assert counts.min() > 10, counts
+
+
+def test_fused_guard_rejects_double_subexpression():
+    """Advisor (round 4): a hash key whose ROOT dtype is not DOUBLE but that
+    computes over a DOUBLE column (cast, comparison) must not fuse — the
+    fused program sees bitcast u64 bit-siblings where the per-batch paths
+    see emulated f64 data, and the two can hash differently."""
+    from spark_rapids_tpu.execs.exchange_execs import _NOT_FUSABLE
+    from spark_rapids_tpu.exprs.core import BoundReference
+    from spark_rapids_tpu.exprs.cast import Cast
+
+    dbl = BoundReference(0, DType.DOUBLE)
+    for key in (dbl,                              # root DOUBLE (old guard)
+                Cast(dbl, DType.STRING),          # non-DOUBLE root, DOUBLE child
+                Cast(Cast(dbl, DType.FLOAT), DType.INT)):  # nested
+        part = HashPartitioning(4, keys=(key,))
+        got = TpuShuffleExchangeExec._fused_pids_split(
+            None, None, part, None, 0, 4, False)
+        assert got is _NOT_FUSABLE, key
+
+
+def test_fused_exchange_cast_double_key_correct():
+    """End-to-end: repartition by a BOOLEAN comparison over a DOUBLE column
+    keeps equal keys co-located and preserves every row."""
+    tpu, cpu = _sessions()
+    data = _data()
+    outs = []
+    for sess in (tpu, cpu):
+        df = sess.create_dataframe(data)
+        t = (df.repartition(4, col("f") > 0)
+               .select(col("a"), col("f"), spark_partition_id().alias("p"))
+               .collect())
+        assert sorted(t.column("a").to_pylist()) == sorted(data["a"])
+        by_key = {}
+        for f, p in zip(t.column("f").to_pylist(), t.column("p").to_pylist()):
+            by_key.setdefault(f, set()).add(p)
+        assert all(len(ps) == 1 for ps in by_key.values())
+        outs.append({k: next(iter(ps)) for k, ps in by_key.items()})
+    # both engines must agree on the key -> partition assignment (the
+    # disagreement the fused-path DOUBLE guard exists to prevent)
+    assert outs[0] == outs[1]

@@ -558,3 +558,31 @@ def test_sql_ordinals_in_pre_projection_branch_and_window_nulls():
     assert out.column("v").to_pylist() == [1, 5, None]
     with pytest.raises(SqlError, match="aggregate"):
         sess.sql("select g, sum(v) from tw group by 2")
+
+
+def test_sql_pivot_on_unaliased_subquery():
+    """Advisor (round 4): FROM (subquery) PIVOT (...) without a derived-table
+    alias must parse — 'pivot' is a soft keyword, not the alias."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    sess = TpuSession()
+    sess.create_dataframe(pa.table({
+        "year": [2020, 2020, 2021],
+        "q": ["q1", "q2", "q1"],
+        "amt": [10.0, 20.0, 30.0]})).createOrReplaceTempView("sales")
+    out = sess.sql("""
+        select * from (select year, q, amt from sales)
+        pivot (sum(amt) for q in ('q1', 'q2'))
+        order by year""").collect()
+    assert out.column_names == ["year", "q1", "q2"]
+    assert out.column("q1").to_pylist() == [10.0, 30.0]
+    # an aliased subquery still pivots, and a bare unaliased derived table
+    # (no pivot) also parses
+    out = sess.sql("""
+        select * from (select year, q, amt from sales) t
+        pivot (sum(amt) for q in ('q1'))
+        order by year""").collect()
+    assert out.column("q1").to_pylist() == [10.0, 30.0]
+    out = sess.sql(
+        "select year from (select year from sales) order by year").collect()
+    assert out.column("year").to_pylist() == [2020, 2020, 2021]
