@@ -257,6 +257,11 @@ def _make_kernel(geom: KernelGeom):
     wn = geom.cap // W
     groups = geom.groups
     seg_rows = q_w + 32
+    # Mosaic requires dynamic-slice offsets in dim 0 provably 8-aligned:
+    # wg * n is only provable when n is a multiple of 8, so the per-window
+    # running-count matrix pads its partition rows (pids never reach the
+    # padding, so the extra rows stay zero and drop out of the rank sum)
+    n_pad = (n + 7) // 8 * 8
 
     def kernel(pid_ref, data_ref, out_ref, cnt_ref, run_ref, cs_ref):
         # 2D grid (group, window-in-group): index maps stay arithmetic-free
@@ -273,9 +278,9 @@ def _make_kernel(geom: KernelGeom):
             c_i = jax.lax.broadcasted_iota(jnp.int32, (W, W), 1)
             tri = (c_i <= r_i).astype(jnp.int8)
             pids = pid_ref[0]                       # (G, W)
-            jj = jax.lax.broadcasted_iota(jnp.int32, (G, n, W), 1)
+            jj = jax.lax.broadcasted_iota(jnp.int32, (G, n_pad, W), 1)
             m = (pids[:, None, :] == jj).astype(jnp.int8)
-            m2 = m.reshape(G * n, W)
+            m2 = m.reshape(G * n_pad, W)
             cs = jax.lax.dot_general(m2, tri, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.int32)
             cs_ref[:] = cs
@@ -285,10 +290,11 @@ def _make_kernel(geom: KernelGeom):
 
         p = pid_ref[0, wg, :]
         d8 = data_ref[0].astype(jnp.int8)
-        cs_w = cs_ref[pl.ds(wg * np.int32(n), n), :]    # (n, W) inclusive
+        # (n_pad, W) inclusive counts; offset wg*n_pad is 8-aligned
+        cs_w = cs_ref[pl.ds(wg * np.int32(n_pad), n_pad), :]
         rank = jnp.sum(jnp.where(p[None, :] ==
                                  jax.lax.broadcasted_iota(
-                                     jnp.int32, (n, W), 0),
+                                     jnp.int32, (n_pad, W), 0),
                                  cs_w, np.int32(0)),
                        axis=0, dtype=jnp.int32) - np.int32(1)
         base_max = np.int32((quota - seg_rows) // 32 * 32)
@@ -371,7 +377,7 @@ def _make_kernel(geom: KernelGeom):
             kernel, out_shape=out_shapes, grid=grid,
             in_specs=in_specs, out_specs=out_specs,
             scratch_shapes=[pltpu.SMEM((n,), jnp.int32),
-                            pltpu.VMEM((G * n, W), jnp.int32)],
+                            pltpu.VMEM((G * n_pad, W), jnp.int32)],
             interpret=interpret,
         )(pid2d.reshape(groups, G, W),
           data.reshape(groups, G * W, L))
