@@ -1,0 +1,101 @@
+"""Executor-side broadcast cache for the cluster path.
+
+Reference analog: GpuBroadcastExchangeExec builds the broadcast batch ONCE
+(driver side) and ships it through Spark's TorrentBroadcast; each executor
+deserializes it ONE time and every task on that executor shares the device
+copy (execution/GpuBroadcastExchangeExec.scala:47-66
+SerializeConcatHostBuffersDeserializeBatch — the `@transient lazy val batch`
+is the once-per-executor deserialize).
+
+Here the driver executes the broadcast subtree locally, serializes the
+result batch as arrow IPC, and pushes the bytes to every executor over the
+control plane exactly once per (broadcast, executor). This process-global
+registry holds the bytes; the first task that consumes the broadcast
+deserializes to a device (or host) batch under a lock, and later tasks in
+the same executor process reuse that batch.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional, Tuple
+
+import pyarrow as pa
+
+#: process-global broadcast-id namespace: schedulers from concurrent
+#: sessions share one BroadcastManager registry, so ids must never collide
+#: (distinct from the df.cache table_id namespace at 1 << 28)
+BROADCAST_IDS = itertools.count(1 << 29)
+
+
+class _Entry:
+    __slots__ = ("ipc", "lock", "batches", "deserialize_count")
+
+    def __init__(self, ipc: bytes):
+        self.ipc = ipc
+        self.lock = threading.Lock()
+        #: (device, string_max_bytes) -> built batch; in practice one key,
+        #: keyed defensively so a conf drift cannot serve a mis-sized batch
+        self.batches: Dict[Tuple[bool, int], object] = {}
+        #: observability for tests: how many times the IPC bytes were
+        #: actually deserialized in this process (must be 1 per consumer
+        #: shape, not once per task)
+        self.deserialize_count = 0
+
+
+class BroadcastManager:
+    """Per-process registry (one per executor process; in-process executors
+    share the driver's)."""
+
+    _lock = threading.Lock()
+    _entries: Dict[int, _Entry] = {}
+
+    @classmethod
+    def put(cls, broadcast_id: int, ipc: bytes) -> None:
+        with cls._lock:
+            cls._entries[broadcast_id] = _Entry(ipc)
+
+    @classmethod
+    def has(cls, broadcast_id: int) -> bool:
+        with cls._lock:
+            return broadcast_id in cls._entries
+
+    @classmethod
+    def get_batch(cls, broadcast_id: int, device: bool,
+                  string_max_bytes: int):
+        with cls._lock:
+            e = cls._entries.get(broadcast_id)
+        if e is None:
+            raise KeyError(f"broadcast {broadcast_id} not registered in "
+                           "this executor")
+        key = (device, string_max_bytes)
+        with e.lock:
+            batch = e.batches.get(key)
+            if batch is None:
+                with pa.ipc.open_stream(pa.BufferReader(e.ipc)) as r:
+                    table = r.read_all()
+                e.deserialize_count += 1
+                if device:
+                    from spark_rapids_tpu.columnar.batch import DeviceBatch
+                    batch = DeviceBatch.from_arrow(table, string_max_bytes)
+                else:
+                    from spark_rapids_tpu.columnar.host import HostBatch
+                    batch = HostBatch.from_arrow(table, string_max_bytes)
+                e.batches[key] = batch
+        return batch
+
+    @classmethod
+    def deserialize_count(cls, broadcast_id: int) -> int:
+        with cls._lock:
+            e = cls._entries.get(broadcast_id)
+        return e.deserialize_count if e is not None else 0
+
+    @classmethod
+    def remove(cls, broadcast_id: int) -> None:
+        with cls._lock:
+            cls._entries.pop(broadcast_id, None)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._entries.clear()

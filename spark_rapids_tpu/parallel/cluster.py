@@ -100,6 +100,9 @@ class _Stage:
     statuses: List[MapStatus] = field(default_factory=list)
     #: result stage only: collected tables in partition order
     result_tables: List = field(default_factory=list)
+    #: broadcast stages: driver-built, shipped once per executor
+    is_broadcast: bool = False
+    broadcast_id: Optional[int] = None
 
 
 def split_stages(final: PhysicalExec) -> Optional[List[_Stage]]:
@@ -107,16 +110,38 @@ def split_stages(final: PhysicalExec) -> Optional[List[_Stage]]:
     the plan has exchanges the cluster cannot stage (CPU exchanges), handing
     execution back to the single-process engine."""
     from spark_rapids_tpu.execs.exchange_execs import (
-        CpuShuffleExchangeExec, RangePartitioning, TpuShuffleExchangeExec)
+        BroadcastExchangeExecBase, CpuShuffleExchangeExec, RangePartitioning,
+        TpuShuffleExchangeExec)
     stages: List[_Stage] = []
 
     def walk(node: PhysicalExec, deps: List[int]) -> PhysicalExec:
         if isinstance(node, CpuShuffleExchangeExec):
             raise _Unstageable()
         if getattr(node, "cluster_unstageable", False):
-            # e.g. cached-table scans: their buffers live in the driver
-            # process's catalog and cannot ship to executors
+            # extension point: an exec whose state genuinely cannot ship to
+            # executor processes opts out of staging here (cached scans USED
+            # to — they now ship via _ship_cached_entries; no in-tree exec
+            # sets the flag today)
             raise _Unstageable()
+        if isinstance(node, BroadcastExchangeExecBase):
+            child_deps: List[int] = []
+            new_child = walk(node.children[0], child_deps)
+            if any(not stages[d].is_broadcast for d in child_deps):
+                # the build side reads dep shuffles (AQE dynamic broadcast
+                # after an exchange): the driver cannot serve executor
+                # catalogs, so the exchange stays inline in the parent
+                # stage (rebuilt per task — the pre-cut behavior)
+                deps.extend(child_deps)
+                return (node if new_child is node.children[0]
+                        else node.with_children([new_child]))
+            exchange = (node if new_child is node.children[0]
+                        else node.with_children([new_child]))
+            idx = len(stages)
+            stages.append(_Stage(idx, exchange, is_result=False,
+                                 is_broadcast=True, deps=child_deps))
+            deps.append(idx)
+            return ClusterBroadcastReadExec(idx, exchange.output,
+                                            exchange.is_device)
         if isinstance(node, TpuShuffleExchangeExec):
             child_deps: List[int] = []
             new_child = walk(node.children[0], child_deps)
@@ -151,6 +176,30 @@ def split_stages(final: PhysicalExec) -> Optional[List[_Stage]]:
 
 
 # ------------------------------------------------------------------ tasks
+class ClusterBroadcastReadExec(LeafExec):
+    """Stand-in for a broadcast exchange on the cluster path: yields the
+    driver-built broadcast batch from the executor's BroadcastManager cache
+    (GpuBroadcastExchangeExec's once-per-executor deserialized batch,
+    GpuBroadcastExchangeExec.scala:47-66). The driver assigns broadcast_id
+    pre-pickle and ships the IPC bytes to every executor before any
+    consuming task runs."""
+
+    num_partitions = 1
+
+    def __init__(self, stage_index: int, output: Schema, device: bool):
+        super().__init__(output)
+        self.stage_index = stage_index
+        self.is_device = device
+        self.broadcast_id: Optional[int] = None  # driver assigns pre-pickle
+
+    def execute(self, ctx: ExecContext):
+        from spark_rapids_tpu.parallel.broadcast import BroadcastManager
+        batch = BroadcastManager.get_batch(self.broadcast_id, self.is_device,
+                                           ctx.string_max_bytes)
+        self.count_output(batch.num_rows)
+        yield batch
+
+
 @dataclass
 class ClusterTaskContext:
     env: ShuffleEnv
@@ -240,6 +289,21 @@ class InProcessExecutor:
 
     def cleanup_shuffle(self, shuffle_id: int) -> None:
         self.env.shuffle_catalog.remove_shuffle(shuffle_id)
+
+    def send_broadcast(self, broadcast_id: int, ipc: bytes) -> None:
+        # in-process executors share the driver's BroadcastManager, which
+        # the scheduler already registered — nothing to ship
+        pass
+
+    def cleanup_broadcast(self, broadcast_id: int) -> None:
+        pass  # driver-local removal covers the shared registry
+
+    def put_cache(self, table_id: int, generation: int,
+                  parts: List[bytes]) -> None:
+        pass  # shares the driver's DeviceManager catalog — already there
+
+    def cleanup_cache(self, table_id: int) -> None:
+        pass  # CacheManager._free already dropped the shared buffers
 
     def close(self) -> None:
         self.env.close()
@@ -365,6 +429,27 @@ class ProcessExecutor:
     def cleanup_shuffle(self, shuffle_id: int) -> None:
         self._request({"type": "cleanup", "shuffle_id": shuffle_id})
 
+    def send_broadcast(self, broadcast_id: int, ipc: bytes) -> None:
+        resp = self._request({"type": "broadcast", "bid": broadcast_id,
+                              "blob": ipc})
+        if resp.get("type") == "error":
+            raise RuntimeError(f"broadcast push to {self.executor_id} "
+                               f"failed: {resp['message']}")
+
+    def cleanup_broadcast(self, broadcast_id: int) -> None:
+        self._request({"type": "cleanup_broadcast", "bid": broadcast_id})
+
+    def put_cache(self, table_id: int, generation: int,
+                  parts: List[bytes]) -> None:
+        resp = self._request({"type": "cache_put", "tid": table_id,
+                              "gen": generation, "parts": parts})
+        if resp.get("type") == "error":
+            raise RuntimeError(f"cache push to {self.executor_id} failed: "
+                               f"{resp['message']}")
+
+    def cleanup_cache(self, table_id: int) -> None:
+        self._request({"type": "cache_remove", "tid": table_id})
+
     def close(self) -> None:
         try:
             with self._send_lock:
@@ -403,6 +488,8 @@ class ClusterScheduler:
                                   os.path.join(self._tmp, f"exec-{i}"))
                 for i in range(self.n)]
         self._next_shuffle = 0
+        #: (executor identity, cache table_id) -> shipped generation
+        self._shipped_caches: Dict[Tuple[int, int], int] = {}
         atexit.register(self.close)
 
     def _prepare_conf(self, conf: TpuConf) -> TpuConf:
@@ -440,9 +527,16 @@ class ClusterScheduler:
         if stages is None:
             return None
         self.last_stages = stages  # introspection for tests/explain
+        self._ship_cached_entries(stages)
         shuffle_ids: List[int] = []
+        broadcast_ids: List[int] = []
         try:
             for stage in stages:
+                if stage.is_broadcast:
+                    # the id list tracks the bid the moment it registers so
+                    # a failed executor push still reaches cleanup
+                    self._run_broadcast_stage(stage, stages, broadcast_ids)
+                    continue
                 if not stage.is_result:
                     stage.shuffle_id = self._next_shuffle
                     self._next_shuffle += 1
@@ -455,10 +549,18 @@ class ClusterScheduler:
             # executors: fall back to the single-process engine
             return None
         finally:
+            from spark_rapids_tpu.parallel.broadcast import BroadcastManager
             for sid in shuffle_ids:
                 for ex in self.executors:
                     try:
                         ex.cleanup_shuffle(sid)
+                    except Exception:
+                        pass
+            for bid in broadcast_ids:
+                BroadcastManager.remove(bid)      # driver-local registry
+                for ex in self.executors:
+                    try:
+                        ex.cleanup_broadcast(bid)
                     except Exception:
                         pass
 
@@ -501,6 +603,114 @@ class ClusterScheduler:
             for lf in leaves:
                 lf.specs = None
 
+    def _ship_cached_entries(self, stages: List[_Stage]) -> None:
+        """df.cache() on the cluster (round-4 VERDICT item 6): every cached
+        entry scanned by this plan ships ONCE per executor process —
+        generation-tracked, so re-materialized entries re-ship and repeat
+        actions don't (the second-run-faster property). Executors register
+        the partitions in their own spillable catalogs under the same
+        BufferIds the scan execs resolve (HostColumnarToGpu.scala:222
+        executor-side cache serving, re-targeted at the tiered store)."""
+        from spark_rapids_tpu.execs.cache_execs import _CachedScanBase
+
+        def walk(n: PhysicalExec):
+            yield n
+            for c in n.children:
+                yield from walk(c)
+
+        entries = {}
+        for st in stages:
+            for n in walk(st.root):
+                if isinstance(n, _CachedScanBase):
+                    entries[n.entry.table_id] = n.entry
+        for e in entries.values():
+            if e.buffer_ids is None:
+                raise RuntimeError("cached plan reached the cluster "
+                                   "scheduler unmaterialized")
+            parts: Optional[List[bytes]] = None   # serialized lazily, once
+            for ex in self.executors:
+                key = (id(ex), e.table_id)
+                if self._shipped_caches.get(key) == e.generation:
+                    continue
+                if parts is None:
+                    parts = self._serialize_cached(e)
+                ex.put_cache(e.table_id, e.generation, parts)
+                self._shipped_caches[key] = e.generation
+
+    def _serialize_cached(self, e) -> List[bytes]:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        catalog = DeviceManager.get().catalog
+        parts: List[bytes] = []
+        for bid in e.buffer_ids:
+            buf = catalog.acquire(bid)
+            if buf is None:
+                raise RuntimeError(f"cached buffer {bid} vanished while "
+                                   "shipping to executors")
+            try:
+                table = buf.get_host_batch().to_arrow()
+            finally:
+                buf.close()
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as w:
+                w.write_table(table)
+            parts.append(sink.getvalue().to_pybytes())
+        return parts
+
+    def cleanup_cache(self, table_id: int) -> None:
+        """unpersist() propagation: drop shipped copies everywhere."""
+        for ex in self.executors:
+            self._shipped_caches.pop((id(ex), table_id), None)
+            try:
+                ex.cleanup_cache(table_id)
+            except Exception:
+                pass
+
+    def _run_broadcast_stage(self, stage: _Stage, stages: List[_Stage],
+                             broadcast_ids: List[int]) -> None:
+        """Build the broadcast batch ONCE on the driver and ship the
+        serialized bytes to every executor (GpuBroadcastExchangeExec's
+        driver-side build + TorrentBroadcast distribution,
+        GpuBroadcastExchangeExec.scala:140-165). Tasks consume it through
+        ClusterBroadcastReadExec -> BroadcastManager (one deserialize per
+        executor process, not one per task)."""
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        from spark_rapids_tpu.parallel.broadcast import BroadcastManager
+
+        # nested broadcasts in the build side read the driver-local registry
+        root = stage.root.transform_up(lambda n: self._resolve_broadcast(
+            n, stages))
+        dm = DeviceManager.initialize(self.conf)
+        cleanups: List = []
+        ctx = ExecContext(self.conf, partition_id=0, num_partitions=1,
+                          device_manager=dm, cleanups=cleanups)
+        try:
+            batch = next(iter(root.execute(ctx)))
+            schema = root.output.to_pa()
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, schema) as w:
+                w.write_table(batch.to_arrow().cast(schema))
+            ipc = sink.getvalue().to_pybytes()
+        finally:
+            for fn in cleanups:
+                fn()
+        from spark_rapids_tpu.parallel.broadcast import BROADCAST_IDS
+        stage.broadcast_id = next(BROADCAST_IDS)
+        # track for cleanup BEFORE any push: a failed executor push must
+        # not leak the driver entry or the blobs already pushed
+        broadcast_ids.append(stage.broadcast_id)
+        # driver-local registration first (serves in-process executors and
+        # nested driver-side builds), then one push per process executor
+        BroadcastManager.put(stage.broadcast_id, ipc)
+        for ex in self.executors:
+            ex.send_broadcast(stage.broadcast_id, ipc)
+
+    @staticmethod
+    def _resolve_broadcast(node: PhysicalExec,
+                           stages: List[_Stage]) -> PhysicalExec:
+        if isinstance(node, ClusterBroadcastReadExec):
+            node.broadcast_id = stages[node.stage_index].broadcast_id
+        return node
+
     def _run_stage(self, stage: _Stage, stages: List[_Stage]) -> None:
         from spark_rapids_tpu.execs.exchange_execs import RangePartitioning
         # resolve dep shuffle ids into the read leaves, then pickle
@@ -513,7 +723,7 @@ class ClusterScheduler:
                 node.shuffle_id = dep.shuffle_id
                 dep_statuses[dep.shuffle_id] = dep.statuses
                 leaves.append(node)
-            return node
+            return self._resolve_broadcast(node, stages)
 
         root = stage.root.transform_up(fix)
         self._coalesce_stage_reads(stage, stages, leaves, root)

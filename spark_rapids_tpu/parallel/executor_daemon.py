@@ -21,6 +21,50 @@ import threading
 import traceback
 
 
+def _cache_put(conf, cached_parts, tid: int, parts) -> None:
+    """Register a shipped df.cache() entry's partitions in THIS executor's
+    spillable catalog under the driver's BufferIds (the executor-side cache
+    serving of HostColumnarToGpu.scala:222, re-targeted at the tiered
+    store: the batches spill device->host->disk under pressure like any
+    cached buffer)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.memory.store import CACHE_BUFFER_PRIORITY
+
+    _cache_remove(cached_parts, tid)      # stale generation, if any
+    dm = DeviceManager.initialize(conf)
+    smax = conf.string_max_bytes
+    ids = []
+    try:
+        for i, ipc in enumerate(parts):
+            with pa.ipc.open_stream(pa.BufferReader(ipc)) as r:
+                table = r.read_all()
+            bid = BufferId(tid, i)
+            dm.device_store.add_batch(bid,
+                                      DeviceBatch.from_arrow(table, smax),
+                                      CACHE_BUFFER_PRIORITY)
+            ids.append(bid)
+    except Exception:
+        # mid-loop failure must not orphan the partitions already
+        # registered (mirrors CacheManager._materialize's rollback)
+        for bid in ids:
+            dm.catalog.remove(bid)
+        raise
+    cached_parts[tid] = ids
+
+
+def _cache_remove(cached_parts, tid: int) -> None:
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    ids = cached_parts.pop(tid, None)
+    if ids:
+        dm = DeviceManager.peek()
+        if dm is not None:
+            for bid in ids:
+                dm.catalog.remove(bid)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--executor-id", required=True)
@@ -43,6 +87,7 @@ def main() -> int:
     from spark_rapids_tpu.shuffle.manager import ShuffleEnv
 
     env = None
+    cached_parts: dict = {}      # df.cache() table_id -> [BufferId...]
     spill_dir = tempfile.mkdtemp(prefix=f"spill-{args.executor_id}-")
     try:
         msg = _recv_msg(sock)
@@ -67,6 +112,30 @@ def main() -> int:
                 return 0
             if kind == "cleanup":
                 env.shuffle_catalog.remove_shuffle(msg["shuffle_id"])
+                send({"type": "ok", "id": rid})
+                continue
+            if kind == "broadcast":
+                from spark_rapids_tpu.parallel.broadcast import \
+                    BroadcastManager
+                BroadcastManager.put(msg["bid"], msg["blob"])
+                send({"type": "ok", "id": rid})
+                continue
+            if kind == "cleanup_broadcast":
+                from spark_rapids_tpu.parallel.broadcast import \
+                    BroadcastManager
+                BroadcastManager.remove(msg["bid"])
+                send({"type": "ok", "id": rid})
+                continue
+            if kind == "cache_put":
+                try:
+                    _cache_put(conf, cached_parts, msg["tid"], msg["parts"])
+                    send({"type": "ok", "id": rid})
+                except Exception:
+                    send({"type": "error", "id": rid,
+                          "message": traceback.format_exc()})
+                continue
+            if kind == "cache_remove":
+                _cache_remove(cached_parts, msg["tid"])
                 send({"type": "ok", "id": rid})
                 continue
             if kind == "task":

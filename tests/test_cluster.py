@@ -292,3 +292,170 @@ def test_cluster_tpcds_queries(tmp_path):
     finally:
         if getattr(s, "_cluster_scheduler", None):
             s._cluster_scheduler.close()
+
+
+def test_cluster_broadcast_built_once_per_executor(monkeypatch):
+    """Round-4 VERDICT item 4: a broadcast exchange is cut into its own
+    driver-built stage — the build side executes ONCE (not once per map
+    task) and each executor process deserializes the shipped bytes once."""
+    from spark_rapids_tpu.parallel.broadcast import BroadcastManager
+    from spark_rapids_tpu.parallel.cluster import ClusterBroadcastReadExec
+
+    fact, dim = _tables(seed=3)
+    s = TpuSession({"spark.rapids.tpu.sql.cluster.numExecutors": "2"})
+    # default broadcast threshold (10 MB): the 400-row dim broadcasts
+    df = (s.create_dataframe(fact).repartition(4, "k")
+           .join(s.create_dataframe(dim), "k")
+           .groupBy("name").agg(F.sum("v").alias("sv")).sort("name"))
+
+    counts = {}
+    orig_remove = BroadcastManager.remove.__func__
+
+    def spy_remove(cls, bid):
+        counts[bid] = cls.deserialize_count(bid)
+        orig_remove(cls, bid)
+
+    monkeypatch.setattr(BroadcastManager, "remove", classmethod(spy_remove))
+    out = df.collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.create_dataframe(fact).repartition(4, "k")
+              .join(cpu.create_dataframe(dim), "k")
+              .groupBy("name").agg(F.sum("v").alias("sv")).sort("name")
+              .collect())
+    sched = s._cluster_scheduler
+    try:
+        assert_tables_equal(exp, out, ignore_order=True)
+        stages = sched.last_stages
+        bstages = [st for st in stages if st.is_broadcast]
+        assert len(bstages) == 1, "broadcast exchange must become a stage"
+        # the driver-side build executed exactly once: the exchange's own
+        # output metric saw the dim rows a single time
+        assert bstages[0].root.metrics["numOutputRows"].value == dim.num_rows
+        # consumers read through the once-per-executor cache, not a rebuild
+        consumer = [st for st in stages
+                    if any(isinstance(n, ClusterBroadcastReadExec)
+                           for n in _walk(st.root))]
+        assert consumer, "a stage must consume the broadcast read leaf"
+        # in-process executors share the driver registry: ONE deserialize
+        # total despite 4 map tasks
+        assert counts and all(v == 1 for v in counts.values()), counts
+    finally:
+        sched.close()
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+@pytest.mark.slow
+def test_cluster_two_processes_broadcast_join_tpch(tmp_path):
+    """Round-4 VERDICT item 4 done-bar: a broadcast-join TPC-H query (Q2
+    shape: tiny region/nation broadcast against part/partsupp/supplier)
+    green on the 2-OS-process cluster with the default broadcast
+    threshold."""
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+    from spark_rapids_tpu.benchmarks.tpch_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
+    tables = gen_all(0.002, seed=9)
+    conf = {
+        **BENCH_CONF,
+        "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.cluster.processExecutors": "true",
+    }
+    # repartition the fact-side tables only: a repartitioned dimension has
+    # no size estimate, which would defeat static broadcast selection
+    facts = {"part", "partsupp", "lineitem", "orders"}
+
+    def mk(sess):
+        return {k: (sess.create_dataframe(v).repartition(2) if k in facts
+                    else sess.create_dataframe(v))
+                for k, v in tables.items()}
+
+    s = TpuSession(conf)
+    out = QUERIES[2](mk(s)).collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = QUERIES[2](mk(cpu)).collect()
+    try:
+        assert_tables_equal(exp, out, ignore_order=True, approx_float=1e-9)
+        sched = s._cluster_scheduler
+        assert any(st.is_broadcast for st in sched.last_stages), (
+            "Q2's dimension joins must ride the broadcast-stage cut")
+    finally:
+        s._cluster_scheduler.close()
+
+
+def test_cluster_cached_scan_inprocess():
+    """Round-4 VERDICT item 6: df.cache() no longer hands cluster queries
+    back to the single-process engine — cached scans stage and serve from
+    the (shared, in-process) catalog."""
+    from spark_rapids_tpu.execs.cache_execs import TpuCachedScanExec
+    fact, dim = _tables(seed=21)
+    s = TpuSession({"spark.rapids.tpu.sql.cluster.numExecutors": "2"})
+    cached = s.create_dataframe(fact).cache()
+    df = (cached.repartition(4, "k").groupBy("k")
+                .agg(F.sum("v").alias("sv")).sort("k"))
+    out = df.collect()
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.create_dataframe(fact).repartition(4, "k").groupBy("k")
+              .agg(F.sum("v").alias("sv")).sort("k").collect())
+    sched = s._cluster_scheduler
+    try:
+        assert_tables_equal(exp, out, ignore_order=True)
+        stages = sched.last_stages
+        assert any(isinstance(n, TpuCachedScanExec)
+                   for st in stages for n in _walk(st.root)), (
+            "the cached scan must ride the cluster stages, not a fallback")
+    finally:
+        sched.close()
+
+
+@pytest.mark.slow
+def test_cluster_cached_scan_two_processes(monkeypatch):
+    """Cached buffers ship ONCE per executor process (generation-tracked),
+    serve from each executor's own spillable catalog, and a second action
+    re-uses the shipped copy without re-shipping; unpersist drops them."""
+    from spark_rapids_tpu.execs.cache_execs import TpuCachedScanExec
+    from spark_rapids_tpu.parallel.cluster import ProcessExecutor
+    fact, dim = _tables(seed=22)
+    s = TpuSession({
+        "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.cluster.processExecutors": "true",
+    })
+    pushes = []
+    orig = ProcessExecutor.put_cache
+
+    def spy(self, tid, gen, parts):
+        pushes.append((self.executor_id, tid, gen))
+        orig(self, tid, gen, parts)
+
+    monkeypatch.setattr(ProcessExecutor, "put_cache", spy)
+    cached = s.create_dataframe(fact).cache()
+
+    def q():
+        return (cached.repartition(4, "k").groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.count("s").alias("c"))
+                .sort("k"))
+
+    out1 = q().collect()
+    assert len(pushes) == 2, f"one push per executor, got {pushes}"
+    out2 = q().collect()          # second action: no re-ship
+    assert len(pushes) == 2, f"re-shipped on second action: {pushes}"
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = (cpu.create_dataframe(fact).repartition(4, "k").groupBy("k")
+              .agg(F.sum("v").alias("sv"), F.count("s").alias("c"))
+              .sort("k").collect())
+    sched = s._cluster_scheduler
+    try:
+        assert_tables_equal(exp, out1, ignore_order=True)
+        assert_tables_equal(exp, out2, ignore_order=True)
+        assert any(isinstance(n, TpuCachedScanExec)
+                   for st in sched.last_stages for n in _walk(st.root))
+        cached.unpersist()
+        assert not sched._shipped_caches, "unpersist must clear ship state"
+        # a post-unpersist action recomputes (fresh generation ships again)
+        out3 = q().collect()
+        assert_tables_equal(exp, out3, ignore_order=True)
+    finally:
+        sched.close()
