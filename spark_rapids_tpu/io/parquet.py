@@ -101,11 +101,50 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
     batch_rows = min(max_rows, rows_by_bytes)
     file_cols = set(md.schema.names)
     want = [f2.name for f2 in data_schema if f2.name in file_cols]
+    # legacy-calendar detection from the writer's file metadata
+    # (RebaseHelper.scala:82, GpuParquetScan.scala:216): Spark < 3 /
+    # LEGACY-mode files store hybrid-Julian day counts — rebase them
+    from spark_rapids_tpu.io.rebase import file_rebase_mode
+    needs_rebase = file_rebase_mode(md.metadata) == "legacy"
     for rb in pf.iter_batches(batch_size=batch_rows, row_groups=groups,
                               columns=want):
         t = evolve_schema(pa.Table.from_batches([rb]), data_schema)
+        if needs_rebase:
+            t = _rebase_legacy_datetimes(t)
         yield append_partition_columns(t, partition_schema,
                                        f.partition_values)
+
+
+def _rebase_legacy_datetimes(t: pa.Table) -> pa.Table:
+    """Julian->Gregorian correction for every date/timestamp column of a
+    legacy-calendar file's batch (host-side, before any upload)."""
+    import numpy as np
+
+    from spark_rapids_tpu.io.rebase import (julian_to_gregorian_days,
+                                            julian_to_gregorian_micros)
+    for i, field in enumerate(t.schema):
+        typ = field.type
+        if pa.types.is_date32(typ):
+            rebase, vt, width = julian_to_gregorian_days, pa.int32(), np.int32
+            col = t.column(i).combine_chunks()
+        elif pa.types.is_timestamp(typ):
+            rebase, vt, width = julian_to_gregorian_micros, pa.int64(), \
+                np.int64
+            # normalize to micros (Spark's storage unit) before the math
+            col = t.column(i).combine_chunks().cast(
+                pa.timestamp("us", typ.tz))
+        else:
+            continue
+        raw = col.cast(vt)
+        # fill nulls BEFORE to_numpy: a nullable int column converts to
+        # float64 otherwise, silently rounding |micros| > 2^53 (any
+        # pre-1582 timestamp) before the rebase ever runs
+        ints = raw.fill_null(0).to_numpy(zero_copy_only=False)
+        fixed = rebase(ints).astype(width)
+        new = pa.Array.from_pandas(fixed, mask=np.asarray(col.is_null()),
+                                   type=vt).cast(col.type).cast(typ)
+        t = t.set_column(i, field, new)
+    return t
 
 
 class _ParquetScanBase(LeafExec):
