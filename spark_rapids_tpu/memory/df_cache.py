@@ -56,10 +56,26 @@ class CachedData:
         self.table_id = next(_CACHE_IDS)
         self.buffer_ids: Optional[List[BufferId]] = None
         self.lock = threading.Lock()
+        #: bumped on every (re)materialization so cluster executors can tell
+        #: a stale shipped copy from the current buffers
+        self.generation = 0
 
     @property
     def is_materialized(self) -> bool:
         return self.buffer_ids is not None
+
+    def __getstate__(self):
+        # cached-scan execs ship to cluster executors by pickle: the lock is
+        # process-local and the logical plan is never needed executor-side
+        # (and may itself be unpicklable, e.g. lambda UDFs)
+        state = dict(self.__dict__)
+        state["lock"] = None
+        state["logical"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.lock = threading.Lock()
 
 
 def _release_entry(e: CachedData, dm) -> None:
@@ -134,6 +150,11 @@ class CacheManager:
         if e.buffer_ids:
             from spark_rapids_tpu.memory.device_manager import DeviceManager
             _release_entry(e, DeviceManager.get())
+        # executor processes holding a shipped copy drop it too (unpersist
+        # reaches the whole cluster, not just the driver catalog)
+        sched = getattr(self.session, "_cluster_scheduler", None)
+        if sched is not None:
+            sched.cleanup_cache(e.table_id)
 
     # ---- planning-time substitution --------------------------------------------
     def substitute(self, logical: lp.LogicalPlan,
@@ -216,3 +237,4 @@ class CacheManager:
                 dm.catalog.remove(bid)
             raise
         e.buffer_ids = ids
+        e.generation += 1
