@@ -1,0 +1,89 @@
+"""Validate + time the pipelined-DMA consolidate_all against the take()
+path on the real chip (round-4 perf-notes "next lever").
+
+Run: python experiments/consolidate_dma_all.py  (from the repo root)
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from spark_rapids_tpu import device as _device  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.benchmarks.tpch import gen_lineitem
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.shuffle import partition_kernel as pk
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    table = gen_lineitem(scale=1.0, seed=42)
+    batch = DeviceBatch.from_arrow(table, 16)
+    jax.block_until_ready(batch.columns[0].data)
+    n = 8
+    spec = pk.PackSpec.for_batch(batch)
+    geom = pk.KernelGeom.plan(batch.capacity, n, spec.lanes)
+    rng = np.random.default_rng(3)
+    pids = jnp.asarray(rng.integers(0, n, batch.capacity).astype(np.int32))
+    res = pk.split_batch_kernel(batch, pids, n, interpret=False)
+    assert res is not None
+    out, stats, spec, geom = res
+    jax.block_until_ready(out)
+    gb = sum(c.data.size * c.data.dtype.itemsize + c.validity.size
+             + (c.lengths.size * 4 if c.lengths is not None else 0)
+             for c in batch.columns) / 1e9
+    print(f"payload {gb:.2f} GB", flush=True)
+
+    def sync_batches(batches):
+        jax.block_until_ready([c.data for b in batches if b
+                               for c in b.columns])
+
+    # warm both paths
+    take = [pk.consolidate(out, stats, j, spec, batch.schema, geom)
+            for j in range(n)]
+    sync_batches(take)
+    dma = pk.consolidate_all(out, stats, spec, batch.schema, geom)
+    assert dma is not None, "DMA path refused on TPU backend"
+    sync_batches(dma)
+
+    # ---- correctness: EXACT per-partition equality (same block order) ----
+    for j in range(n):
+        a, b = take[j], dma[j]
+        assert (a is None) == (b is None), j
+        if a is None:
+            continue
+        assert a.num_rows == b.num_rows, (j, a.num_rows, b.num_rows)
+        for ca, cb in zip(a.columns, b.columns):
+            ax = np.asarray(ca.data)[:a.num_rows]
+            bx = np.asarray(cb.data)[:a.num_rows]
+            va = np.asarray(ca.validity)[:a.num_rows]
+            vb = np.asarray(cb.validity)[:a.num_rows]
+            assert np.array_equal(va, vb), j
+            live = va if ax.ndim == 1 else va[:, None]
+            assert np.array_equal(np.where(live, ax, 0),
+                                  np.where(live, bx, 0)), (j, ca.dtype)
+    print("correctness: EXACT match per partition", flush=True)
+
+    # ---- timing ----
+    for name, run in (("take", lambda: [pk.consolidate(out, stats, j, spec,
+                                                       batch.schema, geom)
+                                        for j in range(n)]),
+                      ("dma", lambda: pk.consolidate_all(out, stats, spec,
+                                                         batch.schema,
+                                                         geom))):
+        best = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            sync_batches(run())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        print(f"{name}-consolidate best: {best:.3f}s -> {gb/best:.2f} GB/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

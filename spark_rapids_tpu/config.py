@@ -249,6 +249,13 @@ SHUFFLE_KERNEL_MODE = _conf(
                        else f"shuffle.kernel.mode must be auto | interpret"
                             f" | off, got {v!r}"))
 
+SHUFFLE_DMA_CONSOLIDATE = _conf(
+    "shuffle.kernel.dmaConsolidate.enabled", bool, True,
+    "Consolidate the partition kernel's quota-padded pieces with ONE "
+    "pipelined-DMA compaction program (per-partition semaphores, n copies "
+    "in flight, barrier-free unpack) instead of per-partition gather "
+    "programs. TPU backends only; elsewhere the gather path runs.")
+
 SHUFFLE_FETCH_TIMEOUT = _conf(
     "shuffle.fetch.timeoutSeconds", int, 300,
     "How long a reduce-side reader waits for remote shuffle blocks before "

@@ -758,11 +758,16 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         if res is None:
             return None
         out, stats, spec, geom = res
-        # per-partition consolidation: one shape-stable program serves every
-        # partition (fusing all partitions into one dispatch was tried and
-        # backed out — dispatches between host syncs pipeline, so it bought
-        # nothing and duplicated this logic; docs/perf-notes.md round 4)
+        # pipelined-DMA consolidation first (round-5: per-partition
+        # semaphores, n copies in flight, barrier-free unpack on the
+        # materialized compact); falls back to the per-partition
+        # shape-stable gather program off-TPU / when disabled
         pieces = []
+        if ctx.conf.get(_cfg.SHUFFLE_DMA_CONSOLIDATE):
+            subs = pk.consolidate_all(out, stats, spec, schema, geom)
+            if subs is not None:
+                return [(j, sub) for j, sub in enumerate(subs)
+                        if sub is not None]
         for j in range(n):
             sub = pk.consolidate(out, stats, j, spec, schema, geom)
             if sub is not None:
@@ -864,6 +869,73 @@ class BroadcastExchangeExecBase(PhysicalExec):
                 # count build rows once, not once per consuming partition
                 self.count_output(self._cached.num_rows)
         yield self._cached
+
+
+class CpuReusedExchangeExec(PhysicalExec):
+    """Spark's ReusedExchangeExec shape: a pointer at an exchange elsewhere
+    in the plan whose output this node re-reads instead of recomputing.
+    Enters through imported Catalyst plans; the overrides engine must give
+    it the SAME on/off-device decision as its referent (the exchange-reuse
+    consistency check, RapidsMeta.scala:443).
+
+    The referent is modeled as a regular CHILD (the same exec object the
+    main branch holds) so every plan pass — transitions, fusion — rewrites
+    the reused subtree too; execution re-runs it (recompute-not-reuse, like
+    every exchange consumer in this engine outside the AQE path)."""
+
+    def __init__(self, referent: PhysicalExec):
+        super().__init__((referent,), referent.output)
+
+    @property
+    def referent(self) -> PhysicalExec:
+        return self.children[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.referent.num_partitions
+
+    def execute(self, ctx: ExecContext):
+        yield from self.referent.execute(ctx)
+
+
+class CpuQueryStageExec(PhysicalExec):
+    """AQE stage wrapper shape (ShuffleQueryStageExec /
+    BroadcastQueryStageExec): a materialized stage boundary around an
+    exchange. Imported Catalyst plans carry these; the overrides engine
+    tags THROUGH the wrapper and conversion unwraps it (the
+    optimizeAdaptiveTransitions role, GpuTransitionOverrides.scala:47)."""
+
+    def __init__(self, child: PhysicalExec, stage_id: int = 0):
+        super().__init__((child,), child.output)
+        self.stage_id = stage_id
+
+    def execute(self, ctx: ExecContext):
+        yield from self.children[0].execute(ctx)
+
+
+class TpuReusedExchangeExec(PhysicalExec):
+    """Device form of a reused exchange. Execution re-reads the (converted)
+    referent child; the AQE path (plan/adaptive.py) is where materialized
+    stage output is actually served without recompute — this node preserves
+    the plan SHAPE and the consistency contract for imported Catalyst
+    plans. The referent rides as a child so transition insertion fixes its
+    host/device boundaries like any other subtree."""
+
+    is_device = True
+
+    def __init__(self, referent: PhysicalExec):
+        super().__init__((referent,), referent.output)
+
+    @property
+    def referent(self) -> PhysicalExec:
+        return self.children[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.referent.num_partitions
+
+    def execute(self, ctx: ExecContext):
+        yield from self.referent.execute(ctx)
 
 
 class CpuBroadcastExchangeExec(BroadcastExchangeExecBase):

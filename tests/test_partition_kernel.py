@@ -193,3 +193,44 @@ def test_fused_program_shared_across_round_robin_offsets():
     all1 = sorted(sum((_rows_key(b.to_arrow()) for _, b in r1), []), key=repr)
     all2 = sorted(sum((_rows_key(b.to_arrow()) for _, b in r2), []), key=repr)
     assert all1 == all2
+
+
+def test_dma_index_plan_matches_take_order():
+    """Code review (round 5): the DMA consolidation's host-side index math
+    must place every row exactly where the take()-path puts it — simulated
+    here in numpy, so CI covers it without a TPU. The DMA path itself is
+    validated on-chip (experiments/consolidate_dma_all.py: EXACT match)."""
+    import numpy as np
+    from spark_rapids_tpu.shuffle.partition_kernel import (BLOCK,
+                                                           KernelGeom,
+                                                           dma_index_plan)
+
+    rng = np.random.default_rng(11)
+    geom = KernelGeom.plan(4096, 5, 76)
+    for trial in range(6):
+        counts = rng.integers(0, geom.quota - 64, (geom.groups, geom.n))
+        if trial == 0:
+            counts[:, 2] = 0            # an empty partition
+        prefix8, nb8, ridx, ri_cap, dst_rows = dma_index_plan(counts, geom)
+        # staging rows: flat index g*quota + r identifies each source row
+        for j in range(geom.n):
+            cj = counts[:, j]
+            nb = cj // BLOCK
+            # take-path layout: full blocks (g asc), then remainders (g asc)
+            want = []
+            for g in range(geom.groups):
+                want.extend(g * geom.quota + r for r in range(nb[g] * BLOCK))
+            for g in range(geom.groups):
+                want.extend(g * geom.quota + nb[g] * BLOCK + r
+                            for r in range(cj[g] - nb[g] * BLOCK))
+            # DMA simulation: quota-sized copies at prefix8 (later copies
+            # overwrite earlier tails), remainder block at nb8
+            dst = np.full(dst_rows, -1, np.int64)
+            for g in range(geom.groups):
+                off = prefix8[j, g]
+                dst[off:off + geom.quota] = g * geom.quota + np.arange(
+                    geom.quota)
+            rem_tot = int((cj - nb * BLOCK).sum())
+            dst[nb8[j]:nb8[j] + ri_cap] = ridx[j]
+            got = dst[:int(cj.sum())].tolist()
+            assert got == want, (trial, j)
