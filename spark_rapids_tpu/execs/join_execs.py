@@ -217,6 +217,17 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
 
 
 
+class CpuSortMergeJoinExec(CpuHashJoinExec):
+    """Spark's SortMergeJoinExec shape (sorted children required by
+    EnsureRequirements). Never produced by this repo's frontend — it enters
+    through imported Catalyst plans (plan/catalyst_import.py). Executes as
+    a hash join (identical equi-join results); the overrides engine
+    replaces it with the TPU shuffled-hash join and DROPS the join-key
+    sorts, the reference's GpuSortMergeJoinExec behavior
+    (shims/spark300/GpuSortMergeJoinExec.scala, conf
+    spark.rapids.tpu.sql.replaceSortMergeJoin.enabled)."""
+
+
 class CpuBroadcastHashJoinExec(CpuHashJoinExec):
     """Equi-join whose build child is a BroadcastExchange; the stream side
     keeps its partitioning, so the join runs once per stream partition against

@@ -537,14 +537,52 @@ def _join_exprs(e) -> tuple:
             + ((e.condition,) if e.condition is not None else ()))
 
 
+def _tag_smj(meta: ExecMeta) -> None:
+    """GpuSortMergeJoinExec tagging: the TPU replacement is a shuffled hash
+    join, so the SMJ only moves when the replacement conf allows it
+    (shims/spark300/GpuSortMergeJoinExec.scala, conf
+    spark.rapids.sql.replaceSortMergeJoin.enabled analog)."""
+    _tag_join(meta)
+    if not meta.conf.get(cfg.REPLACE_SORT_MERGE_JOIN):
+        meta.will_not_work(
+            "sort-merge join replacement is disabled "
+            "(spark.rapids.tpu.sql.replaceSortMergeJoin.enabled)")
+
+
+def _convert_smj(meta: ExecMeta, children) -> PhysicalExec:
+    """SMJ -> shuffled hash join, DROPPING each side's join-key sort (the
+    hash join does not need sorted input; the reference strips the sorts
+    the same way so the expensive device sorts disappear)."""
+    from spark_rapids_tpu.execs.join_execs import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.execs.tpu_execs import TpuSortExec
+    from spark_rapids_tpu.execs.cpu_execs import CpuSortExec
+    e = meta.exec
+
+    def strip(child: PhysicalExec, keys) -> PhysicalExec:
+        if isinstance(child, (TpuSortExec, CpuSortExec)):
+            key_set = {repr(k) for k in keys}
+            if all(repr(o.child) in key_set for o in child.orders):
+                return child.children[0]
+        return child
+
+    return TpuShuffledHashJoinExec(strip(children[0], e.left_keys),
+                                   strip(children[1], e.right_keys),
+                                   e.how, e.left_keys, e.right_keys,
+                                   e.output, e.condition)
+
+
 def _make_join_rules() -> List[ExecRule]:
     from spark_rapids_tpu.execs.join_execs import (CpuBroadcastHashJoinExec,
                                                    CpuCartesianProductExec,
                                                    CpuHashJoinExec,
-                                                   CpuNestedLoopJoinExec)
+                                                   CpuNestedLoopJoinExec,
+                                                   CpuSortMergeJoinExec)
     return [
         ExecRule(CpuHashJoinExec, "shuffled hash join", _convert_join,
                  exprs_of=_join_exprs, tag=_tag_join),
+        ExecRule(CpuSortMergeJoinExec, "sort-merge join (replaced by "
+                 "shuffled hash join, sorts removed)", _convert_smj,
+                 exprs_of=_join_exprs, tag=_tag_smj),
         ExecRule(CpuBroadcastHashJoinExec, "broadcast hash join",
                  _convert_broadcast_join, exprs_of=_join_exprs, tag=_tag_join),
         ExecRule(CpuNestedLoopJoinExec, "broadcast nested-loop join",
@@ -601,14 +639,33 @@ def _convert_broadcast_exchange(meta: ExecMeta, children) -> PhysicalExec:
     return TpuBroadcastExchangeExec(children[0])
 
 
+def _convert_reused_exchange(meta: ExecMeta, children) -> PhysicalExec:
+    # the consistency pass guarantees the referent converts too; the
+    # converted referent arrives as the child (the reuse models its
+    # referent as a regular child so all plan passes rewrite it)
+    from spark_rapids_tpu.execs.exchange_execs import TpuReusedExchangeExec
+    return TpuReusedExchangeExec(children[0])
+
+
+def _convert_query_stage(meta: ExecMeta, children) -> PhysicalExec:
+    # AQE stage wrappers dissolve into the converted plan
+    # (optimizeAdaptiveTransitions role, GpuTransitionOverrides.scala:47)
+    return children[0]
+
+
 def _make_exchange_rules() -> List[ExecRule]:
     from spark_rapids_tpu.execs.exchange_execs import (
-        CpuBroadcastExchangeExec, CpuShuffleExchangeExec)
+        CpuBroadcastExchangeExec, CpuQueryStageExec, CpuReusedExchangeExec,
+        CpuShuffleExchangeExec)
     return [ExecRule(CpuShuffleExchangeExec, "shuffle exchange",
                      _convert_exchange,
                      exprs_of=lambda e: e.partitioning.expressions),
             ExecRule(CpuBroadcastExchangeExec, "broadcast exchange",
-                     _convert_broadcast_exchange)]
+                     _convert_broadcast_exchange),
+            ExecRule(CpuReusedExchangeExec, "reused exchange",
+                     _convert_reused_exchange),
+            ExecRule(CpuQueryStageExec, "adaptive query stage",
+                     _convert_query_stage)]
 
 
 def _convert_cached_scan(meta: ExecMeta, children) -> PhysicalExec:
@@ -672,6 +729,7 @@ class TpuOverrides:
             return plan
         meta = wrap_exec(plan, self.conf)
         meta.tag_for_tpu()
+        _enforce_exchange_reuse(meta)
         lines: List[str] = []
         meta.explain(lines)
         self.last_explain = "\n".join(lines)
@@ -684,6 +742,40 @@ class TpuOverrides:
                     print(line)
         converted = meta.convert_if_needed()
         return insert_transitions(fuse_device_ops(converted))
+
+
+def _enforce_exchange_reuse(root: ExecMeta) -> None:
+    """Exchange-reuse consistency (RapidsMeta.scala:443 runAfterTagRules):
+    a ReusedExchange and its referent must make the SAME on/off-device
+    decision — a device original under a host reuse (or vice versa) would
+    change the exchanged data's placement semantics. The convertible one
+    of a disagreeing pair is forced to the CPU."""
+    from spark_rapids_tpu.execs.exchange_execs import CpuReusedExchangeExec
+    metas: dict = {}
+    reused: List[ExecMeta] = []
+
+    def walk(m: ExecMeta) -> None:
+        # the same exchange OBJECT appears under the main branch and under
+        # every reuse child, each with its own meta — reconcile all of them
+        metas.setdefault(id(m.exec), []).append(m)
+        if isinstance(m.exec, CpuReusedExchangeExec):
+            reused.append(m)
+        for c in m.child_metas:
+            walk(c)
+
+    walk(root)
+    for m in reused:
+        group = metas.get(id(m.exec.referent), []) + [m]
+        if len(group) < 2:
+            m.will_not_work("reused exchange's referent is not part of "
+                            "this plan")
+            continue
+        if len({mm.can_replace for mm in group}) > 1:
+            for mm in group:
+                if mm.can_replace:
+                    mm.will_not_work(
+                        "exchange reuse consistency: the reused copy and "
+                        "its original must make the same TPU decision")
 
 
 def _substitute_refs(e: Expression, repl) -> Expression:
