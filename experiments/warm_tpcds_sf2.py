@@ -1,0 +1,32 @@
+"""Warm the persistent compile cache for the TPC-DS bench subset at SF2.
+
+TPU-side only (no CPU comparator): each query runs once so every program
+compiles at SF2's capacity buckets; bench.py's recorded run then hits the
+cache. Prints per-query warm+run seconds."""
+import sys
+import time
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+
+SCALE = float(sys.argv[1]) if len(sys.argv) > 1 else 2.0
+NAMES = sys.argv[2].split(",") if len(sys.argv) > 2 else [
+    "q3", "q7", "q19", "q27", "q34", "q42", "q52", "q55", "q68", "q96",
+    "q4", "q14", "q23", "q67"]   # light first, heavy last
+
+t0 = time.time()
+tables = gen_all(scale=SCALE, seed=42)
+print(f"[warm] datagen SF{SCALE}: {time.time()-t0:.1f}s "
+      f"({sum(v.num_rows for v in tables.values())} rows)", flush=True)
+sess = TpuSession(BENCH_CONF)
+dfs = {k: sess.create_dataframe(v) for k, v in tables.items()}
+for q in NAMES:
+    t0 = time.time()
+    try:
+        n = QUERIES[q](dfs).collect().num_rows
+        print(f"[warm] {q}: {time.time()-t0:.1f}s rows={n}", flush=True)
+    except Exception as e:
+        print(f"[warm] {q}: FAILED {type(e).__name__}: {e}", flush=True)
+print("[warm] done", flush=True)
