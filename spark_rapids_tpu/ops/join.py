@@ -63,7 +63,8 @@ def join_size(xp, l_keys: Sequence[ColV], r_keys: Sequence[ColV],
               l_alive, r_alive, how: str):
     """Phase 1. Returns a dict of device arrays:
     emit_counts [S+B], emit_offsets [S+B], total (scalar), border [B],
-    start_b [S+B], sgid [S], matches_l [S].
+    start_b [S] (PER STREAM ROW: the row's group's first build-row index
+    within `border`), sgid [S], matches_l [S].
     """
     S = l_keys[0].validity.shape[0] if l_keys else l_alive.shape[0]
     B = r_keys[0].validity.shape[0] if r_keys else r_alive.shape[0]
@@ -80,7 +81,7 @@ def join_size(xp, l_keys: Sequence[ColV], r_keys: Sequence[ColV],
         border = bk._stable_argsort(xp, xp.logical_not(r_alive))
         return dict(emit_counts=emit_counts, emit_offsets=emit_offsets,
                     total=total, border=border.astype(np.int32),
-                    start_b=xp.zeros(G, dtype=np.int64),
+                    start_b=xp.zeros(S, dtype=np.int64),
                     sgid=xp.zeros(S, dtype=np.int32),
                     matches_l=xp.where(l_alive, B_count, 0).astype(np.int64))
 
@@ -101,14 +102,42 @@ def join_size(xp, l_keys: Sequence[ColV], r_keys: Sequence[ColV],
     sgid = gid_by_row[:S]
     bgid = gid_by_row[S:]
 
-    bgid_safe = xp.clip(bgid, 0, G - 1)
-    ones_b = xp.where(bgid >= 0, 1, 0).astype(np.int64)
-    counts_b = _segment_sum(xp, ones_b, bgid_safe, G)
-    ones_s = xp.where(sgid >= 0, 1, 0).astype(np.int64)
-    counts_s = _segment_sum(xp, ones_s, xp.clip(sgid, 0, G - 1), G)
+    # per-row group counts WITHOUT scatters (1.16 s per scatter-segment_sum
+    # at 8.4M rows on this chip vs ~30 ms per scan): compute in SORTED
+    # space — group-start/end positions from cummax/cummin over the start
+    # marks, member counts as inclusive-cumsum differences — then gather
+    # back to row order through the inverse permutation.
+    pos = xp.arange(G, dtype=np.int64)
+    alive_sorted = alive_all[order]
+    is_b_sorted = xp.logical_and(order >= S, alive_sorted)
+    is_s_sorted = xp.logical_and(order < S, alive_sorted)
+    csum_b = xp.cumsum(is_b_sorted.astype(np.int64))
+    csum_s = xp.cumsum(is_s_sorted.astype(np.int64))
+    if xp is np:
+        st = np.maximum.accumulate(xp.where(starts, pos, 0))
+        nxt = xp.where(starts, pos, G)
+        nxt_rev = np.minimum.accumulate(nxt[::-1])[::-1]
+    else:
+        import jax
+        st = jax.lax.cummax(xp.where(starts, pos, np.int64(0)))
+        nxt = xp.where(starts, pos, np.int64(G))
+        nxt_rev = jax.lax.cummin(nxt[::-1])[::-1]
+    # next group's start strictly after i = min start at/after i+1
+    en = xp.concatenate([nxt_rev[1:], xp.full((1,), G, np.int64)]) - 1
+    en = xp.clip(en, 0, G - 1)
+    b_at_st = is_b_sorted[st].astype(np.int64)
+    s_at_st = is_s_sorted[st].astype(np.int64)
+    cnt_b_sorted = csum_b[en] - csum_b[st] + b_at_st
+    cnt_s_sorted = csum_s[en] - csum_s[st] + s_at_st
+    startb_sorted = csum_b[st] - b_at_st       # build rows before my group
+    cnt_b_row = cnt_b_sorted[inv]
+    cnt_s_row = cnt_s_sorted[inv]
+    startb_row = startb_sorted[inv]
 
-    matches_l = xp.where(sgid >= 0, counts_b[xp.clip(sgid, 0, G - 1)], 0)
-    matched_b = xp.where(bgid >= 0, counts_s[bgid_safe] > 0, False)
+    matches_l = xp.where(sgid >= 0, cnt_b_row[:S], 0)
+    matched_b = xp.where(bgid >= 0, cnt_s_row[S:] > 0, False)
+    #: per-STREAM-row index of the group's first build row within `border`
+    start_b_stream = xp.where(sgid >= 0, startb_row[:S], 0).astype(np.int64)
 
     if how == "inner":
         emit_l = matches_l
@@ -138,16 +167,14 @@ def join_size(xp, l_keys: Sequence[ColV], r_keys: Sequence[ColV],
     emit_offsets = _exclusive_cumsum(xp, emit_counts)
     total = xp.sum(emit_counts)
 
-    # build rows sorted by gid (dead rows last); first border-index per gid
+    # build rows sorted by gid (dead rows last); start_b is PER STREAM ROW
+    # (the first border-index of the row's group), replacing the dense
+    # per-group segment_min with the sorted-space prefix computed above
     bkey = xp.where(bgid >= 0, bgid, G).astype(np.int64)
     border = bk._stable_argsort(xp, bkey).astype(np.int32)
-    pos = xp.arange(B, dtype=np.int64)
-    bgid_sorted = bgid[border]
-    start_b = _segment_min(xp, xp.where(bgid_sorted >= 0, pos, np.int64(B)),
-                           xp.clip(bgid_sorted, 0, G - 1), G)
 
     return dict(emit_counts=emit_counts, emit_offsets=emit_offsets, total=total,
-                border=border, start_b=start_b, sgid=sgid,
+                border=border, start_b=start_b_stream, sgid=sgid,
                 matches_l=matches_l.astype(np.int64))
 
 
@@ -168,7 +195,7 @@ def join_gather(xp, sized: dict, S: int, B: int, out_cap: int, how: str):
 
     p = xp.arange(out_cap, dtype=np.int64)
     in_range = p < total
-    g = xp.searchsorted(emit_offsets, p, side="right") - 1
+    g = _searchsorted_right(xp, emit_offsets, p) - 1
     g = xp.clip(g, 0, S + B - 1).astype(np.int64)
     k = p - emit_offsets[g]
 
@@ -185,8 +212,7 @@ def join_gather(xp, sized: dict, S: int, B: int, out_cap: int, how: str):
                 right_row.astype(np.int32), right_valid, total)
 
     has_match = matches_l[srow] > 0
-    sg = xp.clip(sgid[srow], 0, S + B - 1)
-    bpos = xp.clip(start_b[sg] + k, 0, max(B - 1, 0))
+    bpos = xp.clip(start_b[srow] + k, 0, max(B - 1, 0))
     right_from_match = border[bpos]
 
     if how in ("left_semi", "left_anti"):
@@ -222,19 +248,12 @@ def gather_join_output(xp, l_cols: Sequence[ColV], r_cols: Sequence[ColV],
     return out
 
 
-def _segment_sum(xp, data, seg_ids, num_segments: int):
+def _searchsorted_right(xp, a, v):
+    """searchsorted(side='right') that lowers well on TPU: the default
+    binary-search lowering measured 7.1 s for 8.4M queries on this chip;
+    method='sort' (one co-sort of a and v) is ~320 ms."""
     if xp is np:
-        out = np.zeros(num_segments, dtype=data.dtype)
-        np.add.at(out, seg_ids, data)
-        return out
-    import jax
-    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+        return np.searchsorted(a, v, side="right")
+    return xp.searchsorted(a, v, side="right", method="sort")
 
 
-def _segment_min(xp, data, seg_ids, num_segments: int):
-    if xp is np:
-        out = np.full(num_segments, np.iinfo(data.dtype).max, dtype=data.dtype)
-        np.minimum.at(out, seg_ids, data)
-        return out
-    import jax
-    return jax.ops.segment_min(data, seg_ids, num_segments=num_segments)
