@@ -73,6 +73,10 @@ class DType(enum.Enum):
             return DType.STRING
         if pa.types.is_timestamp(t):
             return DType.TIMESTAMP
+        if pa.types.is_dictionary(t):
+            # dictionary-encoded column: the logical type is the value type
+            # (the encoding is an upload/transport detail, decoded on device)
+            return DType.from_pa(t.value_type)
         raise TypeError(f"unsupported arrow type {t} (reference also gates types at "
                         f"GpuOverrides.isSupportedType)")
 

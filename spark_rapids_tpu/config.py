@@ -228,6 +228,14 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+PARQUET_DEVICE_DICT = _conf(
+    "io.parquet.deviceDictDecode.enabled", bool, True,
+    "TPU parquet scans keep fixed-width columns dictionary-encoded through "
+    "the read and decode them ON DEVICE with a gather (narrow indices + the "
+    "small dictionary cross the host link instead of the decoded column — "
+    "the GpuParquetScan.scala:576 device-decode role for the dictionary "
+    "encoding). Strings stay host-decoded.")
+
 SCAN_PREFETCH_BATCHES = _conf(
     "io.scan.prefetchBatches", int, 2,
     "Device parquet scans decode and upload this many chunks ahead of the "
@@ -250,11 +258,13 @@ SHUFFLE_KERNEL_MODE = _conf(
                             f" | off, got {v!r}"))
 
 SHUFFLE_DMA_CONSOLIDATE = _conf(
-    "shuffle.kernel.dmaConsolidate.enabled", bool, True,
+    "shuffle.kernel.dmaConsolidate.enabled", bool, False,
     "Consolidate the partition kernel's quota-padded pieces with ONE "
     "pipelined-DMA compaction program (per-partition semaphores, n copies "
     "in flight, barrier-free unpack) instead of per-partition gather "
-    "programs. TPU backends only; elsewhere the gather path runs.")
+    "programs. TPU backends only; elsewhere the gather path runs. Off by "
+    "default: it pays a 128-lane pad pass, measured ahead only on wide "
+    "schemas (see docs/perf-notes.md round 5).")
 
 SHUFFLE_FETCH_TIMEOUT = _conf(
     "shuffle.fetch.timeoutSeconds", int, 300,

@@ -148,15 +148,29 @@ def append_partition_columns(table: pa.Table, partition_schema: Schema,
 
 def evolve_schema(table: pa.Table, want: Schema) -> pa.Table:
     """Reorder/cast/null-fill the file's columns to the requested read schema
-    (evolveSchemaIfNeededAndClose analog, GpuParquetScan.scala:520)."""
+    (evolveSchemaIfNeededAndClose analog, GpuParquetScan.scala:520).
+    Dictionary-encoded columns whose VALUE type already matches stay
+    encoded — the device upload path decodes them with an on-device gather
+    (the point of shipping the encoded form)."""
     cols = []
+    fields = []
     for f in want:
         idx = table.schema.get_field_index(f.name)
+        wt = f.dtype.pa_type()
         if idx < 0:
-            cols.append(pa.nulls(table.num_rows, f.dtype.pa_type()))
-        else:
-            cols.append(table.column(idx))
-    return pa.table(cols, schema=want.to_pa()).cast(want.to_pa())
+            cols.append(pa.nulls(table.num_rows, wt))
+            fields.append(pa.field(f.name, wt, f.nullable))
+            continue
+        col = table.column(idx)
+        if pa.types.is_dictionary(col.type):
+            if col.type.value_type.equals(wt):
+                cols.append(col)
+                fields.append(pa.field(f.name, col.type, f.nullable))
+                continue
+            col = col.cast(col.type.value_type)   # value-type drift: decode
+        cols.append(col.cast(wt) if not col.type.equals(wt) else col)
+        fields.append(pa.field(f.name, wt, f.nullable))
+    return pa.table(cols, schema=pa.schema(fields))
 
 
 # ---------------------------------------------------------------- pushdown

@@ -20,7 +20,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch
-from spark_rapids_tpu.columnar.dtypes import Schema
+from spark_rapids_tpu.columnar.dtypes import DType, Schema
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec
 from spark_rapids_tpu.exprs.core import Expression
@@ -86,7 +86,8 @@ def clipped_groups(path: str, filters: Tuple[Expression, ...]):
 def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
                       partition_schema: Schema,
                       filters: Sequence[Expression],
-                      max_rows: int, max_bytes: int) -> Iterator[pa.Table]:
+                      max_rows: int, max_bytes: int,
+                      device_dict: bool = False) -> Iterator[pa.Table]:
     pf = pq.ParquetFile(f.path)
     groups = list(clipped_groups(f.path, tuple(filters))[0])
     if not groups:
@@ -106,6 +107,16 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
     # LEGACY-mode files store hybrid-Julian day counts — rebase them
     from spark_rapids_tpu.io.rebase import file_rebase_mode
     needs_rebase = file_rebase_mode(md.metadata) == "legacy"
+    if device_dict and not needs_rebase:
+        # fixed-width columns come straight off the PAGE BYTES as the
+        # file's own dictionary encoding (io/parquet_pages.py): narrow
+        # indices + the small dictionary cross the host link and decode
+        # with an on-device gather — the GpuParquetScan.scala:576 device-
+        # decode role. Strings (and any chunk with PLAIN-fallback pages)
+        # read through pyarrow as before.
+        yield from _iter_dict_tables(pf, f, groups, want, data_schema,
+                                     partition_schema, batch_rows)
+        return
     for rb in pf.iter_batches(batch_size=batch_rows, row_groups=groups,
                               columns=want):
         t = evolve_schema(pa.Table.from_batches([rb]), data_schema)
@@ -113,6 +124,54 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
             t = _rebase_legacy_datetimes(t)
         yield append_partition_columns(t, partition_schema,
                                        f.partition_values)
+
+
+def _iter_dict_tables(pf: pq.ParquetFile, f: PartitionedFile,
+                      groups, want, data_schema: Schema,
+                      partition_schema: Schema,
+                      batch_rows: int) -> Iterator[pa.Table]:
+    """Per-row-group read keeping fixed-width columns dictionary-encoded
+    from the raw page bytes; pyarrow reads the rest. Yields batch_rows-
+    bounded slices (dictionary arrays slice zero-copy)."""
+    from spark_rapids_tpu.io.parquet_pages import read_dict_column
+    md = pf.metadata
+    names = list(md.schema.names)
+    arrow_schema = pf.schema_arrow
+    # strings ride pyarrow's own still-encoded read (read_dictionary is
+    # BYTE_ARRAY-only); the upload gathers their byte-matrix rows on device
+    str_cols = [f2.name for f2 in data_schema
+                if f2.dtype is DType.STRING and f2.name in names]
+    pf_str = (pq.ParquetFile(f.path, read_dictionary=str_cols)
+              if str_cols else pf)
+    for rg in groups:
+        encoded = {}
+        for f2 in data_schema:
+            if f2.dtype is DType.STRING or f2.name not in names:
+                continue
+            ci = names.index(f2.name)
+            at = arrow_schema.field(f2.name).type
+            arr = read_dict_column(f.path, md, rg, ci, at)
+            if arr is not None:
+                encoded[f2.name] = arr
+        rest = [n for n in want if n not in encoded]
+        plain = (pf_str.read_row_group(rg, columns=rest) if rest else None)
+        cols, fields = [], []
+        nrows = md.row_group(rg).num_rows
+        for n in want:
+            if n in encoded:
+                a = encoded[n]
+                cols.append(a)
+                fields.append(pa.field(n, a.type))
+            else:
+                c = plain.column(n)
+                cols.append(c)
+                fields.append(pa.field(n, c.type))
+        table = pa.table(cols, schema=pa.schema(fields))
+        for start in range(0, nrows, batch_rows):
+            t = table.slice(start, min(batch_rows, nrows - start))
+            t = evolve_schema(t, data_schema)
+            yield append_partition_columns(t, partition_schema,
+                                           f.partition_values)
 
 
 def _rebase_legacy_datetimes(t: pa.Table) -> pa.Table:
@@ -194,12 +253,17 @@ class _ParquetScanBase(LeafExec):
         return [clipped_groups(f.path, tuple(self.filters))[1]
                 for f in self.files]
 
+    #: TPU scans flip this on (per conf) so fixed-width columns arrive
+    #: dictionary-encoded and decode on device
+    device_dict = False
+
     def iter_tables_for_files(self, files: Sequence[PartitionedFile]
                               ) -> Iterator[pa.Table]:
         for f in files:
             for t in _iter_file_tables(
                     f, self.data_schema, self.partition_schema, self.filters,
-                    self.max_batch_rows, self.max_batch_bytes):
+                    self.max_batch_rows, self.max_batch_bytes,
+                    device_dict=self.device_dict):
                 yield fill_file_meta(t, f, self.output)
 
     def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
@@ -232,6 +296,7 @@ class TpuParquetScanExec(_ParquetScanBase):
         import os as _os
 
         from spark_rapids_tpu import config as _cfg
+        self.device_dict = ctx.conf.get(_cfg.PARQUET_DEVICE_DICT)
         depth = ctx.conf.get(_cfg.SCAN_PREFETCH_BATCHES)
         if (_os.cpu_count() or 1) < 2:
             # decode-ahead needs a spare core: on a single-core host the

@@ -488,3 +488,89 @@ def test_parquet_legacy_calendar_rebase(tmp_path):
         raw = [None if v is None else (v - datetime.date(1970, 1, 1)).days
                for v in out2.column("d").to_pylist()]
         assert raw == ancient_julian + [None], (conf, raw)
+
+
+def test_parquet_device_dict_decode_bit_identical(tmp_path):
+    """Round-4 VERDICT item 3: fixed-width columns ride the host link
+    dictionary-encoded and decode on device via gather — results must be
+    BIT-identical to the host-decoded path and the CPU engine, including
+    nulls, doubles (bits sibling), dates, and a high-cardinality column
+    that parquet falls back to PLAIN for."""
+    import pyarrow.parquet as pq
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    table = pa.table({
+        "qty": pa.array([None if i % 97 == 0 else float(rng.integers(1, 51))
+                         for i in range(n)], pa.float64()),
+        "disc": pa.array((rng.integers(0, 11, n) / 100.0)),
+        "d": pa.array(rng.integers(8000, 8060, n), pa.int32()).cast(
+            pa.date32()),
+        "hi": pa.array(rng.random(n)),          # ~unique: PLAIN fallback
+        "tag": pa.array([f"t{int(x)}" for x in rng.integers(0, 5, n)]),
+        "k": pa.array(rng.integers(0, 1 << 40, n), pa.int64()),
+    })
+    path = str(tmp_path / "dict.parquet")
+    pq.write_table(table, path, row_group_size=7000)
+
+    from spark_rapids_tpu.testing import assert_tables_equal
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    exp = cpu.read.parquet(path).collect()
+    on = TpuSession({"spark.rapids.tpu.sql.enabled": "true"})
+    off = TpuSession({
+        "spark.rapids.tpu.sql.enabled": "true",
+        "spark.rapids.tpu.io.parquet.deviceDictDecode.enabled": "false"})
+    got_on = on.read.parquet(path).collect()
+    got_off = off.read.parquet(path).collect()
+    assert_tables_equal(exp, got_on)        # exact: no approx_float
+    assert_tables_equal(exp, got_off)
+    # an aggregation over the dict-decoded doubles matches exactly too
+    # (the f64 bits sibling must come from the gathered dictionary bits)
+    from spark_rapids_tpu.api import functions as F
+    q = lambda s: (s.read.parquet(path).groupBy("tag")
+                   .agg(F.min("qty").alias("mn"), F.max("disc").alias("mx"),
+                        F.count("d").alias("c")).sort("tag").collect())
+    assert_tables_equal(q(cpu), q(on))
+
+
+def test_parquet_page_decode_scan_path(tmp_path):
+    """The raw-page dict decode rides the TPU scan end-to-end: fixed-width
+    columns from io/parquet_pages.py, strings via pyarrow read_dictionary,
+    PLAIN-fallback + nulls mixed in — bit-identical to the CPU engine and
+    to the decoded path, across page versions."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    rng = np.random.default_rng(9)
+    n = 150000
+    table = pa.table({
+        "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+        "price": pa.array([None if i % 501 == 0 else float(rng.integers(1, 9000)) / 100
+                           for i in range(n)], pa.float64()),
+        "dense": pa.array(rng.random(n)),
+        "d": pa.array(rng.integers(8000, 8200, n), pa.int32()).cast(pa.date32()),
+        "tag": pa.array([None if i % 997 == 0 else f"tag{int(x)}"
+                         for i, x in enumerate(rng.integers(0, 23, n))]),
+    })
+    for ver in ("1.0", "2.0"):
+        path = str(tmp_path / f"pages_{ver}.parquet")
+        pq.write_table(table, path, row_group_size=40000,
+                       data_page_version=ver)
+        cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+        exp = cpu.read.parquet(path).collect()
+        tpu = TpuSession({"spark.rapids.tpu.sql.enabled": "true"})
+        off = TpuSession({
+            "spark.rapids.tpu.sql.enabled": "true",
+            "spark.rapids.tpu.io.parquet.deviceDictDecode.enabled": "false"})
+        assert_tables_equal(exp, tpu.read.parquet(path).collect())
+        assert_tables_equal(exp, off.read.parquet(path).collect())
+        # filtered + aggregated through the encoded scan
+        from spark_rapids_tpu.api import functions as F
+        q = lambda s: (s.read.parquet(path)
+                       .filter(F.col("price") > 10.0)
+                       .groupBy("tag").agg(F.sum("price").alias("sp"),
+                                           F.max("d").alias("md"))
+                       .sort("tag").collect())
+        assert_tables_equal(q(cpu), q(tpu), approx_float=1e-9)
