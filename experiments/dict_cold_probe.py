@@ -1,0 +1,35 @@
+import sys, tempfile, os, time
+sys.path.insert(0, ".")
+import pyarrow.parquet as pq
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
+from spark_rapids_tpu.testing import assert_tables_equal
+
+scale = float(sys.argv[1]) if len(sys.argv) > 1 else 0.5
+table = gen_lineitem(scale=scale, seed=42)
+tmp = tempfile.mkdtemp(); path = os.path.join(tmp, "li.parquet")
+pq.write_table(table, path, row_group_size=table.num_rows // 8)
+base = {**BENCH_CONF, "spark.rapids.tpu.sql.string.maxBytes": "16",
+        "spark.rapids.tpu.sql.scanCache.enabled": "false"}
+cpu = TpuSession({**base, "spark.rapids.tpu.sql.enabled": "false"})
+exp = q1(cpu.read.parquet(path)).collect()
+
+def run(onoff):
+    s = TpuSession({**base,
+        "spark.rapids.tpu.io.parquet.deviceDictDecode.enabled": onoff})
+    df = q1(s.read.parquet(path))
+    out = df.collect()
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = df.collect()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return out, best
+
+on, t_on = run("true")
+off, t_off = run("false")
+assert_tables_equal(exp, on, approx_float=1e-9)
+assert_tables_equal(exp, off, approx_float=1e-9)
+print(f"cold Q1 SF{scale} best-of-3: dict-on {t_on:.2f}s  "
+      f"dict-off {t_off:.2f}s  speedup {t_off/t_on:.2f}x", flush=True)

@@ -1,0 +1,67 @@
+"""Warm the persistent compile cache for a benchmark suite at scale.
+
+TPU-side only (no CPU comparator): each query runs once so every program
+compiles at the target scale's capacity buckets; bench.py's recorded run
+then hits the cache.
+
+Usage: python experiments/warm_suite.py <tpcds|tpcxbb|mortgage> <scale> [q,...]
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+
+SUITE = sys.argv[1] if len(sys.argv) > 1 else "tpcds"
+SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 2.0
+ONLY = sys.argv[3].split(",") if len(sys.argv) > 3 else None
+
+t0 = time.time()
+if SUITE == "mortgage":
+    from spark_rapids_tpu import ml
+    from spark_rapids_tpu.benchmarks.mortgage import (clean_acquisition_prime,
+                                                      gen_acquisition,
+                                                      gen_performance)
+    perf = gen_performance(scale=SCALE, seed=42)
+    acq = gen_acquisition(scale=SCALE, seed=42)
+    print(f"[warm] datagen SF{SCALE}: {time.time()-t0:.1f}s "
+          f"({perf.num_rows + acq.num_rows} rows)", flush=True)
+    sess = TpuSession(BENCH_CONF)
+    t0 = time.time()
+    df = clean_acquisition_prime(sess.create_dataframe(perf),
+                                 sess.create_dataframe(acq))
+    arrays = ml.device_arrays(df)
+    import jax
+    for arrs in arrays.values():
+        jax.block_until_ready(arrs[0])
+    print(f"[warm] mortgage ETL: {time.time()-t0:.1f}s "
+          f"cols={len(arrays)}", flush=True)
+    sys.exit(0)
+
+if SUITE == "tpcds":
+    from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+    import bench
+    names = [q for q in bench.TPCDS_BENCH_QUERIES if q in QUERIES]
+else:
+    from spark_rapids_tpu.benchmarks.tpcxbb_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpcxbb_queries import QUERIES
+    names = sorted(QUERIES, key=lambda q: int(q[1:]))
+if ONLY:
+    names = [q for q in names if q in ONLY]
+
+tables = gen_all(scale=SCALE, seed=42)
+print(f"[warm] datagen SF{SCALE}: {time.time()-t0:.1f}s "
+      f"({sum(v.num_rows for v in tables.values())} rows)", flush=True)
+sess = TpuSession(BENCH_CONF)
+dfs = {k: sess.create_dataframe(v) for k, v in tables.items()}
+for q in names:
+    t0 = time.time()
+    try:
+        n = QUERIES[q](dfs).collect().num_rows
+        print(f"[warm] {q}: {time.time()-t0:.1f}s rows={n}", flush=True)
+    except Exception as e:
+        print(f"[warm] {q}: FAILED {type(e).__name__}: {e}", flush=True)
+print("[warm] done", flush=True)

@@ -263,7 +263,6 @@ def _make_kernel(geom: KernelGeom):
     wn = geom.cap // W
     groups = geom.groups
     seg_rows = q_w + 32
-    Lp = padded_lanes(L)
     # Mosaic requires dynamic-slice offsets in dim 0 provably 8-aligned:
     # wg * n is only provable when n is a multiple of 8, so the per-window
     # running-count matrix pads its partition rows (pids never reach the
@@ -322,13 +321,6 @@ def _make_kernel(geom: KernelGeom):
         segs = jax.lax.dot_general(oh, d8, (((1,), (0,)), ((), ())),
                                    preferred_element_type=jnp.int32)
         segs = (segs & 255).astype(jnp.uint8)
-        if Lp != L:
-            # zero-pad lanes IN VMEM so the staging buffer is 128-lane
-            # tiled — the DMA consolidation then copies pieces whole with
-            # no separate pad pass over HBM
-            segs = jnp.concatenate(
-                [segs, jnp.zeros((n * seg_rows, Lp - L), jnp.uint8)],
-                axis=1)
 
         ovf = jnp.int32(0)
         for j in range(n):
@@ -366,7 +358,7 @@ def _make_kernel(geom: KernelGeom):
                 jnp.where(lane == np.int32(1), np.int32(1), np.int32(0)))
 
     out_shapes = (
-        jax.ShapeDtypeStruct((n, groups, quota, Lp), jnp.uint8),
+        jax.ShapeDtypeStruct((n, groups, quota, L), jnp.uint8),
         jax.ShapeDtypeStruct((groups, n, 128), jnp.int32),
     )
     # index-map literals pinned to int32: weak-typed 0s trace as int64
@@ -380,7 +372,7 @@ def _make_kernel(geom: KernelGeom):
                      memory_space=pltpu.VMEM),
     ]
     out_specs = (
-        pl.BlockSpec((n, 1, quota, Lp), lambda g, wg: (z, g, z, z),
+        pl.BlockSpec((n, 1, quota, L), lambda g, wg: (z, g, z, z),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, n, 128), lambda g, wg: (g, z, z),
                      memory_space=pltpu.VMEM),
@@ -617,19 +609,25 @@ def dma_index_plan(counts: np.ndarray, geom: KernelGeom):
 
 def _build_dma_compact(spec: PackSpec, geom: KernelGeom, ri_cap: int,
                        dst_rows: int):
-    """The jitted remainder-gather + pipelined-DMA program builder. The
-    staging buffer arrives 128-lane padded from the reorder kernel, so the
-    DMA reads it whole — no pad pass."""
-    n, groups, quota = geom.n, geom.groups, geom.quota
-    Lp = padded_lanes(geom.L)
+    """The jitted remainder-gather + pipelined-DMA program builder. Pays
+    ONE pad pass to 128 lanes before the DMA (Mosaic lane tiling): padding
+    the reorder kernel's staging output instead was tried and REGRESSED
+    suite exchanges up to 6x — narrow schemas (L ~ 20) amplified every
+    kernel write and consolidation read by Lp/L (round-5 perf-notes)."""
+    n, groups, quota, L = geom.n, geom.groups, geom.quota, geom.L
+    Lp = padded_lanes(L)
 
     def compact_fn(prefix8, nb8, ridx, out_arr):
         # pre-gather the (tiny) per-partition remainder rows into one
         # packed block the kernel can DMA whole
-        flat = out_arr.reshape(n, groups * quota, Lp)
+        flat = out_arr.reshape(n, groups * quota, L)
         rrows = jnp.take_along_axis(flat, ridx[:, :, None].astype(jnp.int32),
                                     axis=1)
-        src = out_arr
+        if Lp != L:
+            rrows = jnp.pad(rrows, ((0, 0), (0, 0), (0, Lp - L)))
+            src = jnp.pad(out_arr, ((0, 0), (0, 0), (0, 0), (0, Lp - L)))
+        else:
+            src = out_arr
 
         def kernel(prefix_ref, nb8_ref, src_ref, rem_ref, dst_ref, sems):
             g = pl.program_id(0)
@@ -720,7 +718,6 @@ def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
     ri = np.zeros(ri_cap, np.int32)
     ri[:rem_tot] = rem_idx
 
-    Lp = padded_lanes(geom.L)
     key = ("pconsol", spec, geom, bi_cap, ri_cap, bucket)
     fn = _PROGRAMS.get(key)
     if fn is None:
@@ -728,19 +725,19 @@ def consolidate(out, stats_host: np.ndarray, j: int, spec: PackSpec,
             def f(out_arr, jv, nb8, bidx, ridx):
                 x = jax.lax.dynamic_index_in_dim(
                     out_arr, jv, axis=0, keepdims=False)
-                x = x.reshape(geom.groups * geom.quota, Lp)
+                x = x.reshape(geom.groups * geom.quota, geom.L)
                 xb = x.reshape(geom.groups * geom.quota // BLOCK,
-                               BLOCK * Lp)
+                               BLOCK * geom.L)
                 full = jnp.take(xb, bidx, axis=0).reshape(
-                    bi_cap * BLOCK, Lp)
+                    bi_cap * BLOCK, geom.L)
                 rows = jnp.take(x, ridx, axis=0)
                 # contiguity under bucketed index shapes: write the padded
                 # full-block region first, then the remainder rows AT the
                 # live boundary (nb8 = true full-block rows) — remainder
                 # data overwrites the block padding, its own padding tail
                 # lands beyond the live prefix
-                work = jnp.zeros((bucket + bi_cap * BLOCK + ri_cap, Lp),
-                                 jnp.uint8)
+                work = jnp.zeros((bucket + bi_cap * BLOCK + ri_cap,
+                                  geom.L), jnp.uint8)
                 work = jax.lax.dynamic_update_slice(
                     work, full, (np.int32(0), np.int32(0)))
                 work = jax.lax.dynamic_update_slice(
