@@ -9,13 +9,10 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 echo "== config docs in sync =="
-python - << 'PY'
-from spark_rapids_tpu import config
-import pathlib
-assert pathlib.Path("docs/configs.md").read_text() == config.generate_docs(), \
-    "docs/configs.md stale: run python -m spark_rapids_tpu.config docs/configs.md"
-print("ok")
-PY
+python -m spark_rapids_tpu.analysis --check-configs
+
+echo "== tpu-lint (R001-R006 incl. config drift; fails on non-baselined findings) =="
+python -m spark_rapids_tpu.analysis spark_rapids_tpu/
 
 echo "== fast suite (slow markers excluded) =="
 python -m pytest tests/ -x -q -m "not slow"

@@ -1,0 +1,14 @@
+"""tpu-lint: AST static analysis for recompile hazards, hidden host-device
+syncs, dtype drift, config/registry drift, exec parity, and lock hygiene.
+
+CLI: ``python -m spark_rapids_tpu.analysis [paths]`` (see __main__.py).
+Library: ``analyze_files(files)`` over ``SourceFile`` objects; rules live in
+rules_*.py and self-register via the ``@register`` decorator.
+"""
+from spark_rapids_tpu.analysis.core import (AnalysisResult, Finding, Rule,
+                                            SourceFile, all_rules,
+                                            analyze_files, load_source,
+                                            register)
+
+__all__ = ["AnalysisResult", "Finding", "Rule", "SourceFile", "all_rules",
+           "analyze_files", "load_source", "register"]

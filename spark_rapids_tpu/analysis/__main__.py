@@ -1,0 +1,147 @@
+"""tpu-lint CLI.
+
+Usage:
+    python -m spark_rapids_tpu.analysis [paths...] [options]
+
+With no paths, lints the spark_rapids_tpu package itself. Exit status is 0
+when no non-baselined findings remain, 1 otherwise.
+
+Options:
+    --strict           ignore the baseline (nightly mode: grandfathered
+                       debt stays visible)
+    --baseline PATH    baseline file (default ci/tpu-lint-baseline.json)
+    --write-baseline   write current findings as a baseline skeleton
+                       (justifications left empty; the file will not load
+                       until they are filled in)
+    --rules IDS        comma-separated rule subset, e.g. R001,R004
+    --list-rules       print the rule catalog and exit
+    --check-configs    verify docs/configs.md matches the registry (the
+                       premerge docs-sync gate; R004 drift runs in the
+                       normal lint pass with baseline semantics)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from spark_rapids_tpu.analysis import baseline as bl
+from spark_rapids_tpu.analysis.core import (AnalysisResult, SourceFile,
+                                            all_rules, analyze_files,
+                                            load_source)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def collect_files(paths: List[str], root: str,
+                  errors: Optional[List[str]] = None) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        targets: List[str] = []
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                targets.extend(os.path.join(dirpath, f)
+                               for f in sorted(filenames)
+                               if f.endswith(".py"))
+        elif p.endswith(".py"):
+            targets.append(p)
+        for t in targets:
+            ap = os.path.abspath(t)
+            if ap in seen:
+                continue
+            seen.add(ap)
+            rel = os.path.relpath(ap, root)
+            display = rel if not rel.startswith("..") else ap
+            src = load_source(ap, display.replace(os.sep, "/"), errors)
+            if src is not None:
+                files.append(src)
+    return files
+
+
+def check_configs(root: str) -> int:
+    """The premerge docs-sync gate (replaces the old heredoc diff). The R004
+    drift scan runs in the full lint pass — NOT here — so its findings get
+    the same suppression/baseline treatment as every other rule."""
+    from spark_rapids_tpu import config
+    docs = os.path.join(root, "docs", "configs.md")
+    try:
+        with open(docs, encoding="utf-8") as f:
+            current = f.read()
+    except OSError:
+        current = None
+    if current != config.generate_docs():
+        print("docs/configs.md is stale: regenerate with "
+              "python -m spark_rapids_tpu.config docs/configs.md")
+        return 1
+    print("configs ok: docs/configs.md matches the registry")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m spark_rapids_tpu.analysis",
+                                 description="tpu-lint static analysis")
+    ap.add_argument("paths", nargs="*", help="files or directories "
+                    "(default: the spark_rapids_tpu package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="ignore the baseline")
+    ap.add_argument("--baseline", default=None, metavar="PATH")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--rules", default=None, metavar="IDS")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--check-configs", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = _repo_root()
+    if args.list_rules:
+        for rule in all_rules():
+            kind = "project" if rule.is_project_rule else "file"
+            print(f"{rule.rule_id}  [{kind}]  {rule.title}")
+        return 0
+    if args.check_configs:
+        return check_configs(root)
+
+    paths = args.paths or [os.path.join(root, "spark_rapids_tpu")]
+    rule_ids = (set(r.strip().upper() for r in args.rules.split(","))
+                if args.rules else None)
+    parse_errors: List[str] = []
+    files = collect_files(paths, root, parse_errors)
+    if not files and not parse_errors:
+        print("no python files found under", paths)
+        return 1
+    result: AnalysisResult = analyze_files(files, rule_ids=rule_ids)
+    result.errors.extend(parse_errors)
+
+    baseline_path = args.baseline or os.path.join(root, bl.DEFAULT_BASELINE)
+    if args.write_baseline:
+        bl.write_baseline(result.findings, baseline_path)
+        print(f"wrote {len(result.findings)} entries to {baseline_path}; "
+              f"fill in every justification before committing")
+        return 0
+
+    findings = result.findings
+    absorbed = 0
+    if not args.strict:
+        findings, absorbed = bl.apply_baseline(findings, baseline_path)
+    for f in findings:
+        print(f.render())
+    for err in result.errors:
+        print(f"PARSE ERROR: {err} (file NOT analyzed)")
+    note = f", {absorbed} baselined" if absorbed else ""
+    if findings or result.errors:
+        print(f"tpu-lint: {len(findings)} finding(s), "
+              f"{len(result.errors)} unparseable file(s) in "
+              f"{result.files_scanned} files{note}")
+        return 1
+    print(f"tpu-lint: clean ({result.files_scanned} files{note})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

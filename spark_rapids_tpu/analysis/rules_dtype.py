@@ -1,0 +1,109 @@
+"""R003: x64-dtype hazards.
+
+Spark LONG/DOUBLE semantics require true 64-bit arithmetic, which this
+engine guarantees by setting ``jax_enable_x64`` exactly once in
+``spark_rapids_tpu/device.py`` *before* any jax program traces. Two ways
+that guarantee silently erodes:
+
+- a module imports ``jax`` / ``jax.numpy`` at module level without first
+  importing ``spark_rapids_tpu.device``: imported standalone (a repl, a
+  script, a test importing the module directly), its programs trace in x32
+  and LONG columns truncate without an error. Every jax-importing module
+  carries the one-line guard import (the existing tpu_execs.py idiom).
+- an array is built from a bare numeric literal with no dtype
+  (``np.array([1, 2])``, ``jnp.zeros(n)``): the default dtype differs
+  between x32 and x64 modes, so the same code produces different column
+  types depending on import order. Device code pins every constructor's
+  dtype explicitly.
+
+Scalar sentinel constructors (``np.int64(-1)`` etc.) are deliberately NOT
+flagged: under the engine's pinned x64 mode they are exact, and ops/ uses
+them pervasively as typed sentinels.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from spark_rapids_tpu.analysis.core import (Finding, Rule, SourceFile,
+                                            call_name, has_kwarg,
+                                            is_numeric_literal, register)
+
+#: jnp constructors whose default dtype depends on the x64 flag; value is the
+#: positional index where dtype may appear (None = keyword only)
+_JNP_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                  "arange": None}
+
+#: modules exempt from the device-import guard: device.py itself applies the
+#: setting, analysis/ never traces programs
+_GUARD_EXEMPT = ("device.py", "analysis/")
+
+
+def _module_imports(tree: ast.Module):
+    """(imports_jax, imports_device, first_jax_node) from MODULE-LEVEL
+    imports only — lazy imports inside functions run after engine setup."""
+    imports_jax = False
+    imports_device = False
+    first = None
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    imports_jax = True
+                    first = first or node
+        elif isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            if m == "jax" or m.startswith("jax."):
+                imports_jax = True
+                first = first or node
+            if m == "spark_rapids_tpu.device":
+                imports_device = True
+            if m == "spark_rapids_tpu" and \
+                    any(a.name == "device" for a in node.names):
+                imports_device = True
+    return imports_jax, imports_device, first
+
+
+@register
+class X64DtypeHazards(Rule):
+    rule_id = "R003"
+    title = "x64-dtype hazards (unpinned dtypes, missing x64 guard)"
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        path = src.display_path.replace("\\", "/")
+        if not any(e in path for e in _GUARD_EXEMPT):
+            imports_jax, imports_device, first = _module_imports(src.tree)
+            if imports_jax and not imports_device:
+                findings.append(src.finding(
+                    self.rule_id, first,
+                    "module imports jax without importing "
+                    "spark_rapids_tpu.device first: imported standalone it "
+                    "traces in x32 and LONG/DOUBLE columns silently "
+                    "truncate; add `from spark_rapids_tpu import device as "
+                    "_device  # noqa: F401 - jax setup` above the jax "
+                    "import"))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if not cname:
+                continue
+            head, _, tail = cname.rpartition(".")
+            if head in ("np", "numpy", "jnp") and tail in ("array", "asarray"):
+                if node.args and is_numeric_literal(node.args[0]) and \
+                        len(node.args) < 2 and not has_kwarg(node, "dtype"):
+                    findings.append(src.finding(
+                        self.rule_id, node,
+                        f"{cname}(<numeric literal>) without dtype: the "
+                        f"default drifts between x32 and x64 modes; pin "
+                        f"dtype explicitly"))
+            elif head == "jnp" and tail in _JNP_DTYPE_POS:
+                pos = _JNP_DTYPE_POS[tail]
+                has_pos = pos is not None and len(node.args) > pos
+                if not has_pos and not has_kwarg(node, "dtype"):
+                    findings.append(src.finding(
+                        self.rule_id, node,
+                        f"jnp.{tail}(...) without dtype: default dtype "
+                        f"depends on the x64 flag; pin it explicitly"))
+        return findings

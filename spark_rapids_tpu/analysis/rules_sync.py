@@ -1,0 +1,189 @@
+"""R002: hidden host↔device syncs in hot paths.
+
+Theseus's thesis — accelerated query processing is won or lost on data
+movement — shows up in this engine as dispatch-bound queries (0.029x–0.063x)
+whose per-batch loops silently round-trip to the host. The checks, scoped to
+the hot-path packages (execs/, ops/, shuffle/):
+
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` anywhere: each is an
+  unconditional device→host sync; hot-path code must batch its downloads
+  through one ``np.asarray`` per program result.
+- ``jax.device_get(...)`` inside a loop: one blocking download per iteration.
+- ``int()`` / ``float()`` / ``bool()`` on the result of a jit-compiled
+  program inside a loop: forces a scalar download per iteration, stalling
+  dispatch pipelining. Tracked per function scope: names bound from
+  ``jax.jit`` / ``_cached_jit`` / ``_shard_jit`` / ``reorder_program``
+  constructions are jit programs; names bound from calling one hold device
+  values; ``np.asarray(x)`` re-binds to a host value and clears the taint.
+- ``np.asarray(col) for col in jitted_fn(...)`` comprehensions inside a
+  loop: downloads every output column of a program once per iteration —
+  the full-column-download-per-batch shape that stalled the exchange path.
+
+Designed sync points (the engine's one-scalar-row-count-per-batch contract)
+carry inline ``# tpu-lint: disable=R002`` suppressions with a justification
+comment; anything new must either batch its downloads or argue its case the
+same way.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from spark_rapids_tpu.analysis.core import (Finding, Rule, SourceFile,
+                                            call_name, register)
+from spark_rapids_tpu.analysis.rules_recompile import is_jit_call
+
+#: attribute calls that always synchronize with the device
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: factory callables whose result is a compiled program (callable)
+_PROGRAM_FACTORIES = {"_cached_jit", "_shard_jit", "reorder_program"}
+
+#: builtins that force a scalar host download when fed a device value
+_SCALAR_CASTS = {"int", "float", "bool"}
+
+
+def _assigned_names(node: ast.Assign) -> List[str]:
+    names: List[str] = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+    return names
+
+
+def _scope_nodes(fn_node: ast.AST):
+    """The nodes of one function scope: like ast.walk but does NOT descend
+    into nested def/lambda bodies — those are separate scopes whose
+    assignments must not taint (or clear taint in) the enclosing one."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _ScopeTaint:
+    """Per-function-scope name classification: which locals are jit programs,
+    which hold device results of calling one, and which were re-materialized
+    to host via np.asarray."""
+
+    def __init__(self, fn_node: ast.AST):
+        self.jit_fns: Set[str] = set()
+        self.device_vals: Set[str] = set()
+        assigns = sorted((n for n in _scope_nodes(fn_node)
+                          if isinstance(n, ast.Assign)),
+                         key=lambda n: n.lineno)
+        for node in assigns:
+            value = node.value
+            names = _assigned_names(node)
+            if not names or not isinstance(value, ast.Call):
+                continue
+            cname = call_name(value)
+            if is_jit_call(value) or cname in _PROGRAM_FACTORIES:
+                self.jit_fns.update(names)
+            elif cname.split(".")[-1] == "asarray":
+                self.device_vals.difference_update(names)
+            elif isinstance(value.func, ast.Name) and \
+                    value.func.id in self.jit_fns:
+                self.device_vals.update(names)
+
+    def is_device(self, node: ast.AST) -> bool:
+        """name or name[...] over a tracked device result."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in self.device_vals
+
+
+@register
+class HiddenHostSyncs(Rule):
+    rule_id = "R002"
+    title = "hidden host↔device syncs in hot paths"
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if not src.is_hot_path():
+            return []
+        findings: List[Finding] = []
+        scopes: Dict[ast.AST, _ScopeTaint] = {}
+
+        def scope_for(node: ast.AST) -> _ScopeTaint:
+            fn = src.tree
+            for anc in src.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = anc
+                    break
+            if fn not in scopes:
+                scopes[fn] = _ScopeTaint(fn)
+            return scopes[fn]
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # unconditional sync methods
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS and not node.args:
+                findings.append(src.finding(
+                    self.rule_id, node,
+                    f".{node.func.attr}() forces a blocking device->host "
+                    f"sync; download once via np.asarray on the batched "
+                    f"program result instead"))
+                continue
+            cname = call_name(node)
+            if cname == "jax.device_get" and src.inside_loop(node):
+                findings.append(src.finding(
+                    self.rule_id, node,
+                    "jax.device_get inside a loop: one blocking download "
+                    "per iteration; hoist the download out of the loop"))
+                continue
+            # scalar casts of jit-program results inside loops
+            if cname in _SCALAR_CASTS and len(node.args) == 1 and \
+                    src.inside_loop(node):
+                taint = scope_for(node)
+                if taint.is_device(node.args[0]):
+                    findings.append(src.finding(
+                        self.rule_id, node,
+                        f"{cname}() on a jit-program result inside a loop "
+                        f"syncs a scalar per iteration, stalling dispatch "
+                        f"pipelining; batch the downloads or justify the "
+                        f"sync point with a suppression"))
+                continue
+        findings.extend(self._download_comprehensions(src))
+        return findings
+
+    def _download_comprehensions(self, src: SourceFile) -> List[Finding]:
+        """[np.asarray(a) for a in fn(...)] where fn is a jit program and the
+        comprehension itself repeats per outer loop iteration."""
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+                continue
+            if not src.inside_loop(node):
+                continue
+            gen = node.generators[0]
+            if not isinstance(gen.iter, ast.Call):
+                continue
+            fn_expr = gen.iter.func
+            if not isinstance(fn_expr, ast.Name):
+                continue
+            # the scope that owns the comprehension classifies fn
+            taint = None
+            for anc in src.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    taint = _ScopeTaint(anc)
+                    break
+            if taint is None or fn_expr.id not in taint.jit_fns:
+                continue
+            elt = node.elt
+            if isinstance(elt, ast.Call) and \
+                    call_name(elt).split(".")[-1] == "asarray":
+                findings.append(src.finding(
+                    self.rule_id, node,
+                    f"downloads every output column of jit program "
+                    f"'{fn_expr.id}' once per loop iteration; move the "
+                    f"selection on device and download only what the host "
+                    f"needs, or justify with a suppression"))
+        return findings

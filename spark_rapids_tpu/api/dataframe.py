@@ -563,10 +563,15 @@ class DataFrame:
         dm = DeviceManager.initialize(self.session.conf)
         cleanups: List = []
         tables = []
+        # spark.rapids.tpu.trace.enabled: the whole action shows up as one
+        # named range in the XLA/TensorBoard profile (NVTX analog); when
+        # metrics are on, per-operator counters land in session.last_metrics
+        from spark_rapids_tpu.utils.metrics import NamedRange
+        trace = self.session.conf.get(_cfg.TRACE_ENABLED)
         try:
             # device-admission throttle for the whole task (GpuSemaphore analog)
-            with dm.semaphore.held():
-                from spark_rapids_tpu import config as _cfg
+            with dm.semaphore.held(), NamedRange("tpu-sql-action",
+                                                 trace=trace):
                 if self.session.conf.get(_cfg.ADAPTIVE_ENABLED) and \
                         not any(getattr(nd, "is_mesh", False)
                                 for nd in _iter_execs(final)):
@@ -600,6 +605,10 @@ class DataFrame:
         finally:
             for fn in cleanups:
                 fn()
+            if self.session.conf.get(_cfg.METRICS_ENABLED):
+                self.session.last_metrics = {
+                    f"{i}:{nd.name}": nd.metrics.snapshot()
+                    for i, nd in enumerate(_iter_execs(final))}
         return tables
 
     def collect(self) -> pa.Table:
@@ -1056,6 +1065,9 @@ class TpuSession:
         self.conf = TpuConf(conf or {})
         self.last_explain: str = ""
         self.last_plan: Optional[PhysicalExec] = None
+        #: per-operator metric snapshots of the last action, filled when
+        #: spark.rapids.tpu.metrics.enabled (SQLMetrics reporting analog)
+        self.last_metrics: Dict[str, Dict[str, int]] = {}
         self._views: Dict[str, DataFrame] = {}
         self.cache_manager = CacheManager(self)
 

@@ -5,7 +5,9 @@ tool diffing constructor signatures of Spark execs vs their Gpu replacements
 per shim, catching silent API drift. Here the pairing is CpuXExec vs TpuXExec:
 every conversion rule in plan/overrides.py builds the Tpu exec from the Cpu
 exec's fields, so a signature divergence is exactly the class of bug this
-catches. Run as ``python -m spark_rapids_tpu.api_validation``.
+catches. Run as ``python -m spark_rapids_tpu.api_validation``; tpu-lint
+surfaces the same check as rule R005 (analysis/rules_project.py), so
+premerge reports it through one tool with one suppression/baseline story.
 """
 from __future__ import annotations
 

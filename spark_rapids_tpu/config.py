@@ -103,10 +103,6 @@ ENABLE_FLOAT_AGG = _conf(
     "Allow float/double aggregations whose result can vary with evaluation order "
     "(parallel reductions). Analog of spark.rapids.sql.variableFloatAgg.enabled.")
 
-IMPROVED_FLOAT_OPS = _conf(
-    "sql.improvedFloatOps.enabled", bool, False,
-    "Enable float ops (e.g. string cast of floats) that do not match Spark bit-for-bit.")
-
 CACHED_SCAN_ENABLED = _conf(
     "sql.cachedScan.enabled", bool, True,
     "Scan df.cache()/persist() data on the TPU. Cached batches live in the tiered "
@@ -129,26 +125,10 @@ ENABLE_CAST_FLOAT_TO_STRING = _conf(
     "Cast float/double to string on the TPU; formatting may differ from Java in corner "
     "cases. Analog of spark.rapids.sql.castFloatToString.enabled.")
 
-ENABLE_CAST_STRING_TO_FLOAT = _conf(
-    "sql.castStringToFloat.enabled", bool, False,
-    "Cast string to float/double on the TPU; some edge-case literals differ from Java.")
-
-ENABLE_CAST_STRING_TO_TS = _conf(
-    "sql.castStringToTimestamp.enabled", bool, False,
-    "Cast string to timestamp on the TPU (UTC only).")
-
-ENABLE_CAST_FLOAT_TO_INT = _conf(
-    "sql.castFloatToIntegralTypes.enabled", bool, False,
-    "Cast float/double to integral types with Spark 3.1+ ANSI-overflow semantics.")
-
 TEST_CONF = _conf(
     "sql.test.enabled", bool, False,
     "Test-mode: assert every supported operator actually ran on the TPU "
     "(analog of spark.rapids.sql.test.enabled).", internal=True)
-
-TEST_ALLOWED_NONTPU = _conf(
-    "sql.test.allowedNonTpu", str, "",
-    "Comma-separated class names permitted to stay on CPU in test-mode.", internal=True)
 
 MAX_READER_BATCH_SIZE_ROWS = _conf(
     "sql.reader.batchSizeRows", int, 2147483647,
@@ -164,11 +144,6 @@ TPU_BATCH_SIZE_BYTES = _conf(
     "sql.batchSizeBytes", int, 1 << 31,
     "Target size for coalesced batches flowing between TPU operators (analog of "
     "spark.rapids.sql.batchSizeBytes; default 2 GiB).", checker=_positive("batchSizeBytes"))
-
-BATCH_CAPACITY_BUCKETS = _conf(
-    "sql.batch.capacityBuckets", bool, True,
-    "Pad device batches to power-of-two row-capacity buckets so XLA re-uses compiled "
-    "programs across batches (TPU-specific: static shapes avoid recompilation).")
 
 STRING_MAX_BYTES = _conf(
     "sql.string.maxBytes", int, 256,
@@ -199,11 +174,6 @@ REPLACE_SORT_MERGE_JOIN = _conf(
     "sql.replaceSortMergeJoin.enabled", bool, True,
     "Replace CPU sort-merge joins with TPU shuffled-hash joins, dropping the sorts "
     "(analog of spark.rapids.sql.replaceSortMergeJoin.enabled).")
-
-ENABLE_TOTAL_ORDER_SORT = _conf(
-    "sql.allowIncompatUTF8Strings", bool, False,
-    "Treat device string ordering (raw byte order) as compatible with Spark's UTF-8 "
-    "string ordering for sorts and comparisons.")
 
 UDF_COMPILER_ENABLED = _conf(
     "sql.udfCompiler.enabled", bool, False,
@@ -337,18 +307,6 @@ HOST_SPILL_STORAGE_SIZE = _conf(
     "(analog of spark.rapids.memory.host.spillStorageSize).",
     checker=_positive("spillStorageSize"))
 
-PAGEABLE_POOL_SIZE = _conf(
-    "memory.host.pageablePool.size", int, 1 << 30,
-    "Size of the host staging pool used for device<->host transfers.")
-
-MEMORY_DEBUG = _conf(
-    "memory.tpu.debug", bool, False,
-    "Log allocator activity for leak hunting (analog of spark.rapids.memory.gpu.debug).")
-
-UNSPILL_ENABLED = _conf(
-    "memory.tpu.unspill.enabled", bool, False,
-    "Promote spilled buffers back to HBM when re-referenced.")
-
 # --------------------------------------------------------------------------------------
 # Shuffle (analog of spark.rapids.shuffle.*)
 # --------------------------------------------------------------------------------------
@@ -392,10 +350,6 @@ SHUFFLE_COMPRESSION_CODEC = _conf(
     "zlib, zstd (fastest real codec; the right choice for network-bound DCN "
     "shuffles) — analog of spark.rapids.shuffle.compression.codec.")
 
-SHUFFLE_PARTITIONING_MAX_CPU_BATCH = _conf(
-    "shuffle.partitioning.maxCpuBatchSize", int, 1 << 31,
-    "Batches above this size are partitioned on device.", internal=True)
-
 # --------------------------------------------------------------------------------------
 # I/O formats (analog of spark.rapids.sql.format.*)
 # --------------------------------------------------------------------------------------
@@ -406,9 +360,6 @@ PARQUET_READ_ENABLED = _conf(
     "sql.format.parquet.read.enabled", bool, True, "Enable TPU parquet scans.")
 PARQUET_WRITE_ENABLED = _conf(
     "sql.format.parquet.write.enabled", bool, True, "Enable TPU parquet writes.")
-PARQUET_DEBUG_DUMP_PREFIX = _conf(
-    "sql.parquet.debug.dumpPrefix", str, "",
-    "If set, dump the host-staged parquet data for each scan to this path prefix.")
 ORC_ENABLED = _conf(
     "sql.format.orc.enabled", bool, True, "Enable TPU ORC scan/write as a whole.")
 ORC_READ_ENABLED = _conf(
@@ -421,18 +372,8 @@ CSV_READ_ENABLED = _conf(
     "sql.format.csv.read.enabled", bool, True, "Enable TPU CSV scans.")
 
 # --------------------------------------------------------------------------------------
-# Mesh / distributed execution (TPU-specific; no direct reference analog — replaces
-# the executor-per-GPU model with SPMD over a jax.sharding.Mesh)
+# Observability (SQLMetrics / NVTX analog)
 # --------------------------------------------------------------------------------------
-MESH_DATA_AXIS = _conf(
-    "mesh.dataAxis", str, "data",
-    "Name of the mesh axis batches are partitioned over for distributed execution.")
-
-MESH_SHAPE = _conf(
-    "mesh.shape", str, "",
-    "Comma-separated mesh shape, e.g. '8' or '4,2'. Empty means one axis over all "
-    "visible devices.")
-
 METRICS_ENABLED = _conf(
     "metrics.enabled", bool, True,
     "Collect per-operator metrics (rows, batches, op time) — analog of SQLMetrics.")

@@ -59,8 +59,10 @@ EXCHANGE_STATS: list = []
 def _shard_jit(mesh: Mesh, key: Tuple, builder, in_specs, out_specs):
     """Cached jit(shard_map(...)) keyed like the single-chip program cache."""
     def make():
-        return jax.shard_map(builder(), mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        from spark_rapids_tpu import shims
+        return shims.get().shard_map(builder(), mesh=mesh,
+                                     in_specs=in_specs,
+                                     out_specs=out_specs, check_vma=False)
     return _cached_jit(("mesh", mesh, key), make)
 
 
@@ -831,7 +833,10 @@ class MeshHashAggregateExec(MeshExec):
                 (P(DATA_AXIS),) + _specs(npartial) + flagged_specs)
             res = fn(mb.rows_dev(), *flatten_mesh(mb))
             if mode in ("hash", "onehot"):
-                if not bool(res[-1]):
+                # justified sync: the mesh-wide collision flag decides
+                # whether this grouping mode's result stands or the next
+                # mode runs — one scalar per attempted mode
+                if not bool(res[-1]):  # tpu-lint: disable=R002
                     res = res[:-1]
                     break
             else:

@@ -238,7 +238,10 @@ class TpuFilterExec(PhysicalExec):
 
             fn = _cached_jit(key, build)
             res = fn(np.int32(batch.num_rows), *_flatten(batch))
-            n = int(res[-1])
+            # justified sync: the engine's designed one-scalar-per-batch
+            # download — the logical row count must reach the host to pick
+            # the output capacity bucket (see module docstring)
+            n = int(res[-1])  # tpu-lint: disable=R002
             out = _to_batch(schema, res[:-1], n)
             self.count_output(n)
             yield out
@@ -304,8 +307,11 @@ class TpuHashAggregateExec(PhysicalExec):
         for mode in modes:
             fn = _cached_jit(key + (mode,), build(mode))
             res = fn(np.int32(batch.num_rows), *_flatten(batch))
+            # justified sync: the escalation flag must be read on host to
+            # decide whether the faster grouping's result is exact or the
+            # next mode runs — one scalar per attempted mode, not per batch
             flagged = (mode in ("hash", "onehot") and self.grouping
-                       and bool(res[-1]))
+                       and bool(res[-1]))  # tpu-lint: disable=R002
             if not flagged:
                 break
         if mode in ("hash", "onehot"):

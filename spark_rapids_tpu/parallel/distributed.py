@@ -35,14 +35,18 @@ from spark_rapids_tpu.ops.aggregate import group_aggregate, merge_aggregate
 from spark_rapids_tpu.exprs.core import (flatten_colvs as _flatten_colvs,
                                          unflatten_colvs as _unflatten_colvs)
 
-
 def build_distributed_aggregate(mesh: Mesh, schema: Schema,
                                 key_exprs: Tuple[Expression, ...],
                                 agg_fns: Tuple,
                                 local_capacity: int,
                                 string_max_bytes: int = 256,
                                 axis: str = "data"):
-    """Build the jitted SPMD aggregate step.
+    """Build (or fetch the cached) jitted SPMD aggregate step.
+
+    Cached through the engine's keyed program cache (_cached_jit): a fresh
+    jit(shard_map(closure)) per call would re-trace and recompile the whole
+    aggregate every time (R001 recompile hazard — the q4 compile-wall class
+    of bug).
 
     Returns fn(num_rows_local [n_dev] int32, *flat sharded arrays) ->
     (flat merged outputs..., num_groups) with outputs replicated.
@@ -76,9 +80,13 @@ def build_distributed_aggregate(mesh: Mesh, schema: Schema,
         P(axis) for _ in range(_flat_len(schema)))
     out_specs = _out_specs(key_exprs, agg_fns) + (P(),)
 
-    fn = jax.jit(jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False))
-    return fn
+    from spark_rapids_tpu import shims
+    from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+    key = ("dist-agg", mesh, schema, tuple(key_exprs), tuple(agg_fns),
+           local_capacity, string_max_bytes, axis)
+    return _cached_jit(key, lambda: shims.get().shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False))
 
 
 def _gather_colv(v: ColV, axis: str) -> ColV:

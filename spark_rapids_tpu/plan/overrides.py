@@ -379,6 +379,25 @@ def _convert_agg(meta: ExecMeta, children) -> PhysicalExec:
     return te.TpuHashAggregateExec(e.grouping, e.aggregates, children[0], e.output)
 
 
+def _tag_agg(meta: ExecMeta) -> None:
+    """Float/double GROUPING keys ride the device only when the user asserts
+    NaN-free data (the spark.rapids.sql.hasNans gate on GpuHashAggregateExec:
+    device NaN key equality differs from Spark's, which groups all NaNs
+    together)."""
+    e = meta.exec
+    for k in e.grouping:
+        try:
+            dt = k.dtype()
+        except TypeError:
+            continue
+        if dt.is_floating and meta.conf.get(cfg.HAS_NANS):
+            meta.will_not_work(
+                "floating point grouping keys may hold NaN, whose grouping "
+                "differs on TPU; set spark.rapids.tpu.sql.hasNans=false if "
+                "the data has none")
+            return
+
+
 def _convert_sort(meta: ExecMeta, children) -> PhysicalExec:
     return te.TpuSortExec(meta.exec.orders, children[0])
 
@@ -498,15 +517,24 @@ def _convert_join(meta: ExecMeta, children) -> PhysicalExec:
 
 
 def _tag_join(meta: ExecMeta) -> None:
-    """GpuHashJoin.tagJoin analog (shims/spark300/GpuHashJoin.scala:36-50)."""
+    """GpuHashJoin.tagJoin analog (shims/spark300/GpuHashJoin.scala:36-50):
+    unsupported key types, and float/double keys only when the user asserts
+    the data is NaN-free (spark.rapids.sql.hasNans analog — device NaN
+    grouping/equality differs from Spark's NaN-normalizing semantics)."""
     e = meta.exec
     for k in list(e.left_keys) + list(e.right_keys):
         try:
-            if k.dtype() not in (set(SUPPORTED_JOIN_KEY_TYPES)):
-                meta.will_not_work(f"join key type {k.dtype().value} is not "
-                                   f"supported on TPU")
+            dt = k.dtype()
         except TypeError:
-            pass
+            continue
+        if dt not in (set(SUPPORTED_JOIN_KEY_TYPES)):
+            meta.will_not_work(f"join key type {dt.value} is not "
+                               f"supported on TPU")
+        elif dt.is_floating and meta.conf.get(cfg.HAS_NANS):
+            meta.will_not_work(
+                "floating point join keys may hold NaN, whose join "
+                "equality differs on TPU; set "
+                "spark.rapids.tpu.sql.hasNans=false if the data has none")
 
 
 SUPPORTED_JOIN_KEY_TYPES = (DType.BOOLEAN, DType.BYTE, DType.SHORT, DType.INT,
@@ -695,7 +723,8 @@ _EXEC_RULE_LIST: List[ExecRule] = (_make_scan_rules() + _make_write_rules()
     ExecRule(ce.CpuFilterExec, "row filter", _convert_filter,
              exprs_of=lambda e: (e.condition,)),
     ExecRule(ce.CpuHashAggregateExec, "hash aggregate", _convert_agg,
-             exprs_of=lambda e: tuple(e.grouping) + tuple(e.aggregates)),
+             exprs_of=lambda e: tuple(e.grouping) + tuple(e.aggregates),
+             tag=_tag_agg),
     ExecRule(ce.CpuSortExec, "sort", _convert_sort,
              exprs_of=lambda e: e.orders),
     ExecRule(ce.CpuLimitExec, "row limit", _convert_limit),

@@ -45,6 +45,13 @@ class JaxShims:
         from jax.sharding import Mesh
         return Mesh(np.array(devices), axis_names)
 
+    def shard_map(self, f, mesh, in_specs, out_specs, check_vma=False):
+        """Top-level jax.shard_map (promoted from experimental in 0.5+);
+        ``check_vma`` is the 0.5+ name of the replication check flag."""
+        import jax
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
     # ---- dtype bit tricks -----------------------------------------------------
     def bitcast(self, arr, dtype):
         import jax
@@ -76,6 +83,13 @@ class Jax04Shims(JaxShims):
     def tree_map(self, fn, tree):
         import jax
         return jax.tree_util.tree_map(fn, tree)
+
+    def shard_map(self, f, mesh, in_specs, out_specs, check_vma=False):
+        """0.4 location (jax.experimental.shard_map) and flag name
+        (check_rep)."""
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
 
 
 #: registration order = match priority (ShimLoader's provider list)

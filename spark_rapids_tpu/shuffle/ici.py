@@ -123,8 +123,9 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
     # cached per (mesh, schema, capacities): same-shaped batch streams reuse
     # the compiled exchange instead of paying XLA compilation per call
     from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+    from spark_rapids_tpu import shims
     key = ("ici-repart", mesh, schema, local_capacity, chunk_cap, axis)
-    return _cached_jit(key, lambda: jax.shard_map(
+    return _cached_jit(key, lambda: shims.get().shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False))
 

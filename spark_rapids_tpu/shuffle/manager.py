@@ -106,15 +106,32 @@ class ShuffleEnv:
                                     self.conf.shuffle_codec)
         self._clients: Dict[str, ShuffleClient] = {}
         self._lock = threading.Lock()
+        self._connect_locks: Dict[str, threading.Lock] = {}
 
     def client_for(self, peer_executor_id: str) -> ShuffleClient:
+        # connect() blocks (TCP handshake + registry polling, up to 30 s):
+        # holding the client-table lock across it would serialize every
+        # fetch in the process behind the slowest peer. A per-peer connect
+        # lock serializes only callers of the SAME unconnected peer, so no
+        # duplicate connection is ever created (a dropped loser would leak
+        # its socket + reader thread and desync the transport peer table).
         with self._lock:
             c = self._clients.get(peer_executor_id)
-            if c is None:
-                c = ShuffleClient(self.transport,
-                                  self.transport.connect(peer_executor_id),
-                                  self.received_catalog,
-                                  self.conf.shuffle_codec)
+            if c is not None:
+                return c
+            plock = self._connect_locks.setdefault(peer_executor_id,
+                                                   threading.Lock())
+        with plock:
+            with self._lock:
+                c = self._clients.get(peer_executor_id)
+                if c is not None:
+                    return c
+            # justified block-under-lock: plock guards one peer's connect
+            # only; other peers never contend  # tpu-lint: disable=R006
+            conn = self.transport.connect(peer_executor_id)
+            c = ShuffleClient(self.transport, conn, self.received_catalog,
+                              self.conf.shuffle_codec)
+            with self._lock:
                 self._clients[peer_executor_id] = c
             return c
 

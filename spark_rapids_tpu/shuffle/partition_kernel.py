@@ -291,7 +291,9 @@ def _make_kernel(geom: KernelGeom):
                                      preferred_element_type=jnp.int32)
             cs_ref[:] = cs
             for j in range(n):
-                run_ref[j] = 0
+                # pinned: a weak 0 traces as int64 under jax_enable_x64 and
+                # the interpret-mode ref store rejects the dtype mismatch
+                run_ref[j] = jnp.int32(0)
             cnt_ref[...] = jnp.zeros((1, n, 128), jnp.int32)
 
         p = pid_ref[0, wg, :]

@@ -40,8 +40,12 @@ _HDR = struct.Struct(">cQI")
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: bytes,
                 tag: int, payload: bytes) -> None:
+    # justified per-socket writer lock: frames must hit the stream whole
+    # (interleaved sendall calls would corrupt the wire format), and the
+    # lock covers exactly one socket — contention is bounded to writers of
+    # that peer, never the transport's shared state.
     with lock:
-        sock.sendall(_HDR.pack(kind, tag, len(payload)) + payload)
+        sock.sendall(_HDR.pack(kind, tag, len(payload)) + payload)  # tpu-lint: disable=R006
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -1,0 +1,355 @@
+"""tpu-lint coverage: every rule gets at least one true-positive and one
+clean-negative fixture, plus suppression/baseline mechanics and a run over
+the real package asserting zero non-baselined findings (the premerge gate's
+contract)."""
+import json
+import os
+import textwrap
+
+import pytest
+
+from spark_rapids_tpu.analysis import SourceFile, analyze_files
+from spark_rapids_tpu.analysis import baseline as bl
+from spark_rapids_tpu.analysis.__main__ import collect_files, main
+
+
+def src(text: str, path: str = "mod.py") -> SourceFile:
+    # fixtures concatenate the unindented GUARD line with an indented
+    # triple-quoted body; dedent the body alone or dedent finds no common
+    # prefix and leaves the fixture unparseable
+    if text.startswith("from spark_rapids_tpu import device"):
+        head, _, body = text.partition("\n")
+        text = head + "\n" + textwrap.dedent(body)
+    else:
+        text = textwrap.dedent(text)
+    return SourceFile(path, text, path)
+
+
+def run(files, rules):
+    if not isinstance(files, list):
+        files = [files]
+    res = analyze_files(files, rule_ids=set(rules))
+    return res.findings
+
+
+#: the x64 guard import every jax-importing module carries (keeps R003
+#: quiet in fixtures that target other rules)
+GUARD = "from spark_rapids_tpu import device as _device\n"
+
+
+# ------------------------------------------------------------------ R001
+def test_r001_jit_in_loop_flagged():
+    fs = src(GUARD + """
+        import jax
+        def f(batches):
+            outs = []
+            for b in batches:
+                fn = jax.jit(lambda x: x + 1)
+                outs.append(fn(b))
+            return outs
+        """)
+    found = run(fs, {"R001"})
+    assert len(found) == 1 and "inside a loop" in found[0].message
+
+
+def test_r001_immediate_invoke_flagged():
+    fs = src(GUARD + """
+        import jax
+        def f(x):
+            return jax.jit(lambda v: v * 2)(x)
+        """)
+    found = run(fs, {"R001"})
+    assert len(found) == 1 and "invoked immediately" in found[0].message
+
+
+def test_r001_cache_guard_clean():
+    fs = src(GUARD + """
+        import jax
+        _PROGRAMS = {}
+        def get(keys):
+            fns = []
+            for key in keys:
+                fn = _PROGRAMS.get(key)
+                if fn is None:
+                    fn = jax.jit(lambda x: x)
+                    _PROGRAMS[key] = fn
+                fns.append(fn)
+            return fns
+        """)
+    assert run(fs, {"R001"}) == []
+
+
+def test_r001_module_level_jit_clean():
+    fs = src(GUARD + """
+        import jax
+        def _impl(x):
+            return x + 1
+        fast = jax.jit(_impl)
+        """)
+    assert run(fs, {"R001"}) == []
+
+
+# ------------------------------------------------------------------ R002
+def test_r002_item_flagged_in_hot_path():
+    fs = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()
+        """, path="execs/foo.py")
+    found = run(fs, {"R002"})
+    assert len(found) == 1 and ".item()" in found[0].message
+
+
+def test_r002_scalar_cast_of_program_result_in_loop():
+    fs = src(GUARD + """
+        import jax
+        def f(batches, build):
+            fn = jax.jit(build)
+            for b in batches:
+                res = fn(b)
+                n = int(res[-1])
+                yield n
+        """, path="ops/foo.py")
+    found = run(fs, {"R002"})
+    assert len(found) == 1 and "inside a loop" in found[0].message
+
+
+def test_r002_download_comprehension_in_loop():
+    fs = src(GUARD + """
+        import jax
+        import numpy as np
+        def f(batches, build):
+            fn = jax.jit(build)
+            for b in batches:
+                flat = [np.asarray(a) for a in fn(b)]
+                yield flat
+        """, path="shuffle/foo.py")
+    found = run(fs, {"R002"})
+    assert len(found) == 1 and "every output column" in found[0].message
+
+
+def test_r002_nested_def_does_not_taint_outer_scope():
+    """Regression: a nested helper's jit program must not make the OUTER
+    function's unrelated loop variables look like device results."""
+    fs = src(GUARD + """
+        import jax
+        def outer(host_counts, build):
+            def helper(b):
+                fn = jax.jit(build)
+                res = fn(b)
+                return res
+            total = 0
+            for res in host_counts:
+                total += int(res)
+            return total
+        """, path="execs/foo.py")
+    assert run(fs, {"R002"}) == []
+
+
+def test_r002_clean_outside_loop_and_outside_hot_path():
+    hot_clean = src(GUARD + """
+        import jax
+        import numpy as np
+        def f(b, build):
+            fn = jax.jit(build)
+            res = fn(b)
+            return int(res[-1])
+        """, path="execs/foo.py")
+    assert run(hot_clean, {"R002"}) == []
+    # identical sync code outside the hot-path dirs is out of scope
+    cold = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()
+        """, path="benchmarks/foo.py")
+    assert run(cold, {"R002"}) == []
+
+
+# ------------------------------------------------------------------ R003
+def test_r003_jax_import_without_device_guard():
+    fs = src("""
+        import jax
+        def f(x):
+            return jax.numpy.sum(x)
+        """)
+    found = run(fs, {"R003"})
+    assert len(found) == 1 and "x32" in found[0].message
+
+
+def test_r003_dtypeless_constructors():
+    fs = src(GUARD + """
+        import jax.numpy as jnp
+        import numpy as np
+        a = np.array([1, 2, 3])
+        b = jnp.zeros(16)
+        """)
+    found = run(fs, {"R003"})
+    assert len(found) == 2
+    assert any("np.array" in f.message for f in found)
+    assert any("jnp.zeros" in f.message for f in found)
+
+
+def test_r003_clean_with_guard_and_dtypes():
+    fs = src("""
+        from spark_rapids_tpu import device as _device  # noqa: F401
+        import jax.numpy as jnp
+        import numpy as np
+        a = np.array([1, 2, 3], dtype=np.int32)
+        b = jnp.zeros(16, jnp.int32)
+        c = jnp.arange(8, dtype=np.int32)
+        strings = np.array(["CA", "TX"])  # non-numeric: dtype is unambiguous
+        """)
+    assert run(fs, {"R003"}) == []
+
+
+# ------------------------------------------------------------------ R004
+def test_r004_dead_and_unregistered_keys():
+    config = src("""
+        def _conf(key, conf_type, default, doc):
+            pass
+        USED = _conf("sql.used", bool, True, "read by engine.py")
+        DEAD = _conf("sql.dead", bool, True, "never read")
+        """, path="spark_rapids_tpu/config.py")
+    engine = src("""
+        from spark_rapids_tpu import config as cfg
+        def f(conf):
+            if conf.get(cfg.USED):
+                return conf.get_raw("spark.rapids.tpu.sql.typoed.key")
+        """, path="spark_rapids_tpu/engine.py")
+    found = run([config, engine], {"R004"})
+    assert len(found) == 2
+    dead = [f for f in found if "never read" in f.message]
+    unreg = [f for f in found if "not registered" in f.message]
+    assert len(dead) == 1 and "sql.dead" in dead[0].message
+    assert len(unreg) == 1 and "typoed" in unreg[0].message
+
+
+def test_r004_needs_registry_in_scope():
+    lone = src("""
+        def f(conf):
+            return conf.get_raw("spark.rapids.tpu.sql.anything.here")
+        """, path="other/engine.py")
+    assert run(lone, {"R004"}) == []
+
+
+# ------------------------------------------------------------------ R005
+def test_r005_real_exec_pairs_line_up():
+    files = collect_files([os.path.join(_repo_root(), "spark_rapids_tpu")],
+                          _repo_root())
+    res = analyze_files(files, rule_ids={"R005"})
+    assert res.findings == []
+
+
+# ------------------------------------------------------------------ R006
+def test_r006_blocking_calls_under_lock():
+    fs = src(GUARD + """
+        import threading
+        class T:
+            def __init__(self, sock, fut):
+                self._lock = threading.Lock()
+                self.sock = sock
+                self.fut = fut
+            def bad_send(self, data):
+                with self._lock:
+                    self.sock.sendall(data)
+            def bad_wait(self):
+                with self._lock:
+                    return self.fut.result()
+        """)
+    found = run(fs, {"R006"})
+    assert len(found) == 2
+    assert any(".sendall()" in f.message for f in found)
+    assert any(".result()" in f.message for f in found)
+
+
+def test_r006_condition_wait_and_unlocked_io_clean():
+    fs = src(GUARD + """
+        import threading
+        class Pool:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._available = threading.Condition(self._lock)
+                self.sock = sock
+                self.free = []
+            def acquire(self):
+                with self._available:
+                    while not self.free:
+                        self._available.wait(1.0)
+                    return self.free.pop()
+            def send(self, data):
+                self.sock.sendall(data)
+        """)
+    assert run(fs, {"R006"}) == []
+
+
+# ---------------------------------------------------------- suppressions
+def test_suppression_same_line_and_line_above():
+    fs = src(GUARD + """
+        def f(arr, brr):
+            a = arr.sum().item()  # tpu-lint: disable=R002
+            # justified: tiny scalar  # tpu-lint: disable=R002
+            b = brr.sum().item()
+            return a + b
+        """, path="execs/foo.py")
+    assert run(fs, {"R002"}) == []
+
+
+def test_suppression_is_rule_specific():
+    fs = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()  # tpu-lint: disable=R001
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R002"})) == 1
+
+
+# -------------------------------------------------------------- baseline
+def test_baseline_absorbs_with_justification(tmp_path):
+    fs = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()
+        """, path="execs/foo.py")
+    found = run(fs, {"R002"})
+    assert len(found) == 1
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R002", "path": "execs/foo.py",
+        "code": found[0].code, "count": 1,
+        "justification": "grandfathered: fixed in the next PR"}]}))
+    new, absorbed = bl.apply_baseline(found, str(path))
+    assert new == [] and absorbed == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R002", "path": "execs/foo.py", "code": "x = y.item()",
+        "count": 1, "justification": ""}]}))
+    with pytest.raises(bl.BaselineError):
+        bl.load_baseline(str(path))
+
+
+# ------------------------------------------------------- whole-tree gates
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_is_lint_clean():
+    """The premerge contract: the analyzer exits 0 over spark_rapids_tpu/
+    with every rule active and only baselined/suppressed debt standing."""
+    assert main([os.path.join(_repo_root(), "spark_rapids_tpu")]) == 0
+
+
+def test_check_configs_gate():
+    """--check-configs replaces the old premerge heredoc: docs/configs.md
+    must match the registry (R004 drift runs in the normal lint pass)."""
+    assert main(["--check-configs"]) == 0
+
+
+def test_unparseable_file_fails_the_gate(tmp_path):
+    """A file the analyzer cannot parse must fail the run, not silently
+    vanish from coverage."""
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    errors = []
+    files = collect_files([str(tmp_path)], str(tmp_path), errors)
+    assert len(files) == 1 and len(errors) == 1
+    assert "broken.py" in errors[0]
+    assert main([str(tmp_path)]) == 1
