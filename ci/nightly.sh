@@ -14,6 +14,9 @@ python -m spark_rapids_tpu.analysis --strict spark_rapids_tpu/
 echo "== full suite (incl. slow) =="
 python -m pytest tests/ -q
 
+echo "== shuffle fault injection (deterministic chaos, fixed seed) =="
+python -m pytest tests/test_shuffle_faults.py -q
+
 if [ "${RUN_TPU_BENCH:-0}" = "1" ]; then
     echo "== device benchmarks (real chip) =="
     unset JAX_PLATFORMS

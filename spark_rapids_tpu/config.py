@@ -350,6 +350,66 @@ SHUFFLE_COMPRESSION_CODEC = _conf(
     "zlib, zstd (fastest real codec; the right choice for network-bound DCN "
     "shuffles) — analog of spark.rapids.shuffle.compression.codec.")
 
+
+def _non_negative(name: str) -> Callable[[Any], Optional[str]]:
+    def check(v: Any) -> Optional[str]:
+        return None if v >= 0 else f"{name} must be >= 0, got {v}"
+    return check
+
+
+SHUFFLE_MAX_RETRIES = _conf(
+    "shuffle.maxRetries", int, 3,
+    "How many times a transient shuffle failure is retried before it becomes "
+    "fatal, at every level of the stack: TCP connect attempts, metadata/"
+    "transfer RPCs, per-block transfers (including checksum mismatches), and "
+    "reduce-side per-peer re-fetches (which reconnect after a peer loss). "
+    "0 disables retries — the first failure surfaces immediately as "
+    "ShuffleFetchFailedError (the lineage-recompute signal).",
+    checker=_non_negative("maxRetries"))
+
+SHUFFLE_RETRY_BACKOFF_MS = _conf(
+    "shuffle.retryBackoffMs", int, 50,
+    "Base delay between shuffle retries. Attempt i sleeps roughly "
+    "base * 2^i with deterministic jitter (seeded by the retry key), so "
+    "retries from many reducers hitting one recovering peer spread out "
+    "instead of stampeding.", checker=_positive("retryBackoffMs"))
+
+SHUFFLE_CONNECT_TIMEOUT = _conf(
+    "shuffle.connectTimeout", float, 30.0,
+    "Seconds a single TCP shuffle connect attempt (registry resolution + "
+    "socket establishment) may take before it counts as a transient failure "
+    "and enters the retry/backoff schedule.",
+    checker=_positive("connectTimeout"))
+
+SHUFFLE_CHECKSUM_ENABLED = _conf(
+    "shuffle.checksum.enabled", bool, True,
+    "Verify a crc32 over every fetched shuffle buffer (computed by the "
+    "server over the on-wire bytes, carried in TransferResponse/TableMeta). "
+    "A mismatch marks the transfer as a retryable corruption instead of "
+    "silently producing wrong rows; disabling skips client-side "
+    "verification only.")
+
+SHUFFLE_FAULTS_PLAN = _conf(
+    "shuffle.faults.plan", str, "",
+    "Deterministic fault-injection plan for chaos testing the shuffle stack "
+    "(empty = no faults). Semicolon-separated specs, e.g. "
+    "'drop_conn:peer=exec-1,after=3;corrupt_frame:after=1,count=2'. Kinds: "
+    "drop_conn, corrupt_frame, delay_frame, dup_frame, fail_request. Only "
+    "honored by the FaultInjectingTransport (shuffle/faults.py).")
+
+SHUFFLE_FAULTS_SEED = _conf(
+    "shuffle.faults.seed", int, 0,
+    "Seed for the fault-injection plan's random choices (which byte a "
+    "corrupt_frame flips, backoff jitter inside the harness) — the same "
+    "seed replays the exact same chaos schedule.")
+
+SHUFFLE_FAULTS_TRANSPORT = _conf(
+    "shuffle.faults.transport.class", str,
+    "spark_rapids_tpu.shuffle.inprocess.InProcessTransport",
+    "Transport the FaultInjectingTransport wraps (in-process fabric or the "
+    "TCP transport); all traffic flows through the wrapped transport with "
+    "faults injected at the connection layer.")
+
 # --------------------------------------------------------------------------------------
 # I/O formats (analog of spark.rapids.sql.format.*)
 # --------------------------------------------------------------------------------------
@@ -464,6 +524,31 @@ class TpuConf:
 
     @property
     def shuffle_codec(self) -> str: return self.get(SHUFFLE_COMPRESSION_CODEC)
+
+    @property
+    def shuffle_max_retries(self) -> int: return self.get(SHUFFLE_MAX_RETRIES)
+
+    @property
+    def shuffle_retry_backoff_ms(self) -> int:
+        return self.get(SHUFFLE_RETRY_BACKOFF_MS)
+
+    @property
+    def shuffle_connect_timeout(self) -> float:
+        return self.get(SHUFFLE_CONNECT_TIMEOUT)
+
+    @property
+    def shuffle_checksum_enabled(self) -> bool:
+        return self.get(SHUFFLE_CHECKSUM_ENABLED)
+
+    @property
+    def shuffle_faults_plan(self) -> str: return self.get(SHUFFLE_FAULTS_PLAN)
+
+    @property
+    def shuffle_faults_seed(self) -> int: return self.get(SHUFFLE_FAULTS_SEED)
+
+    @property
+    def shuffle_faults_transport_class(self) -> str:
+        return self.get(SHUFFLE_FAULTS_TRANSPORT)
 
 
 def all_entries() -> List[ConfEntry]:

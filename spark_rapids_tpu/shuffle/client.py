@@ -6,33 +6,60 @@ bounce buffers, consumeBuffers:193 assembling the target buffer, then handing
 the received buffer id to the fetch handler. The inflight throttle
 (queuePending / maxReceiveInflightBytes) gates how many bytes of transfers are
 outstanding per client.
+
+Fault tolerance on top of the reference protocol:
+
+- the metadata RPC and each per-block transfer retry transient failures
+  under ``spark.rapids.tpu.shuffle.maxRetries`` / ``.retryBackoffMs``
+  (deterministic-jitter exponential backoff; retries re-issue on a timer
+  thread, never on the transport's progress thread);
+- every assembled buffer is verified against the server's crc32
+  (TransferResponse.checksum) before decompression — corruption is a
+  retryable error, not a wrong answer;
+- a fetch fails AT MOST ONCE per attempt, and the error names exactly the
+  blocks that were not delivered, so the reader (or the lineage recompute)
+  re-fetches only those.
 """
 from __future__ import annotations
 
 import itertools
 import threading
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from spark_rapids_tpu.shuffle import messages as msg
+from spark_rapids_tpu.shuffle import retry
 from spark_rapids_tpu.shuffle.catalog import (ReceivedBufferCatalog,
                                               ShuffleBlockId)
-from spark_rapids_tpu.shuffle.codec import decompress_batch
+from spark_rapids_tpu.shuffle.codec import (ChecksumError, decompress_batch,
+                                            verify_checksum)
 from spark_rapids_tpu.shuffle.table_meta import TableMeta
 from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ClientConnection,
                                                 ShuffleTransport, Transaction,
                                                 TransactionStatus)
+from spark_rapids_tpu.utils import metrics as mt
 
 
 class ShuffleFetchHandler:
     """Callbacks a task iterator registers for one fetch
     (RapidsShuffleFetchHandler analog)."""
 
-    def start(self, expected_tables: int) -> None: ...
+    def start(self, expected_tables: int,
+              tables: Sequence[Tuple[ShuffleBlockId, int]] = ()) -> None:
+        """``tables`` enumerates the (block, table_idx) pairs this fetch will
+        deliver — the reader's completion/dedup bookkeeping."""
 
-    def batch_received(self, received_id: int) -> None: ...
+    def batch_received(self, received_id: int,
+                       block: Optional[ShuffleBlockId] = None,
+                       table_idx: int = 0) -> None: ...
 
-    def transfer_error(self, message: str) -> None: ...
+    def transfer_error(self, message: str,
+                       failed_blocks: Sequence[ShuffleBlockId] = (),
+                       permanent: bool = False) -> None:
+        """``failed_blocks`` are the blocks with ≥1 undelivered table — the
+        scope of a retry/recompute; blocks already delivered are excluded.
+        ``permanent`` marks failures a re-fetch cannot fix (lost blocks):
+        the reader must skip its retries and surface the recompute signal."""
 
 
 class PendingTransferRequest:
@@ -42,6 +69,41 @@ class PendingTransferRequest:
         self.block = block
         self.table_idx = table_idx
         self.meta = meta
+
+
+class _FetchState:
+    """Bookkeeping for one fetch() call: which (block, table_idx) pairs are
+    still undelivered, and a fail-once latch so concurrent transfer failures
+    collapse into ONE transfer_error carrying the precise failure scope."""
+
+    def __init__(self, blocks: Sequence[ShuffleBlockId],
+                 handler: ShuffleFetchHandler):
+        self.blocks = tuple(blocks)
+        self.handler = handler
+        self._lock = threading.Lock()
+        self._pending: Set[Tuple[ShuffleBlockId, int]] = set()
+        self._failed = False
+
+    def register(self, tables: Sequence[Tuple[ShuffleBlockId, int]]) -> None:
+        with self._lock:
+            self._pending.update(tables)
+
+    def mark_delivered(self, block: ShuffleBlockId, table_idx: int) -> None:
+        with self._lock:
+            self._pending.discard((block, table_idx))
+
+    @property
+    def failed(self) -> bool:
+        with self._lock:
+            return self._failed
+
+    def fail(self, message: str, permanent: bool = False) -> None:
+        with self._lock:
+            if self._failed:
+                return
+            self._failed = True
+            failed_blocks = tuple(sorted({b for b, _ in self._pending}))
+        self.handler.transfer_error(message, failed_blocks, permanent)
 
 
 class BufferReceiveState:
@@ -117,6 +179,12 @@ class ShuffleClient:
         self.received = received_catalog
         self.codec_name = codec_name
         self.chunk_size = transport.send_bounce.buffer_size
+        conf = transport.conf
+        self.max_retries = conf.shuffle_max_retries
+        self.backoff_ms = conf.shuffle_retry_backoff_ms
+        self.retry_seed = conf.shuffle_faults_seed
+        self.verify_checksums = conf.shuffle_checksum_enabled
+        self.metrics = transport.metrics
 
     # ---- protocol --------------------------------------------------------------
     def fetch(self, blocks: List[ShuffleBlockId],
@@ -124,34 +192,60 @@ class ShuffleClient:
         """Fetch all tables of ``blocks`` from this peer; async — results land
         via handler callbacks."""
         if not blocks:
-            handler.start(0)
+            handler.start(0, ())
             return
+        state = _FetchState(blocks, handler)
+        self._request_metadata(state, attempt=0)
+
+    def _request_metadata(self, state: _FetchState, attempt: int) -> None:
+        blocks = state.blocks
         req = msg.MetadataRequest(blocks[0].shuffle_id,
-                                  blocks[0].partition_id, tuple(blocks))
+                                  blocks[0].partition_id, blocks)
 
         def on_meta(tx: Transaction):
             if tx.status is not TransactionStatus.SUCCESS:
-                handler.transfer_error(tx.error_message or "metadata failed")
+                self._retry_metadata(
+                    state, attempt, tx.error_message or "metadata failed")
                 return
             resp = msg.MetadataResponse.from_bytes(tx.response)
             pending = [PendingTransferRequest(b, i, m)
                        for b, i, m in resp.tables]
             # the tracker only lists non-empty blocks, so a requested block the
-            # server no longer has is a lost block, not an empty one
+            # server no longer has is a lost block, not an empty one — NOT
+            # transient (no retry): only a map recompute brings it back
             answered = {p.block for p in pending}
             missing = [b for b in blocks if b not in answered]
             if missing:
-                handler.transfer_error(
+                state.register([(b, 0) for b in missing])
+                state.fail(
                     f"peer {self.connection.peer_executor_id} lost blocks: "
-                    f"{missing[:3]}{'...' if len(missing) > 3 else ''}")
+                    f"{missing[:3]}{'...' if len(missing) > 3 else ''}",
+                    permanent=True)
                 return
-            handler.start(len(pending))
+            tables = [(p.block, p.table_idx) for p in pending]
+            state.register(tables)
+            state.handler.start(len(pending), tables)
             for p in pending:
-                self._issue_transfer(p, handler)
+                self._issue_transfer(state, p, attempt=0)
         self.connection.request(msg.REQ_METADATA, req.to_bytes(), on_meta)
 
-    def _issue_transfer(self, p: PendingTransferRequest,
-                        handler: ShuffleFetchHandler) -> None:
+    def _retry_metadata(self, state: _FetchState, attempt: int,
+                        error: str) -> None:
+        if attempt >= self.max_retries or state.failed:
+            state.register([(b, 0) for b in state.blocks])
+            state.fail(error)
+            return
+        self.metrics[mt.SHUFFLE_RPC_RETRIES].add(1)
+        delay = retry.backoff_ms(
+            attempt, self.backoff_ms, self.retry_seed,
+            key=f"meta:{self.connection.peer_executor_id}")
+        retry.call_later(delay,
+                         lambda: self._request_metadata(state, attempt + 1))
+
+    def _issue_transfer(self, state: _FetchState, p: PendingTransferRequest,
+                        attempt: int) -> None:
+        # a FRESH tag range per attempt: chunks of a failed attempt still in
+        # flight can never land in a retry's bounce buffers
         base_tag = (next(self._tag_seq) << 16)
         treq = msg.TransferRequest(p.block, p.table_idx, base_tag,
                                    self.chunk_size, self.codec_name)
@@ -164,25 +258,46 @@ class ShuffleClient:
                 released.set()
                 self.transport.throttle.release(p.meta.packed_size)
 
+        def fail_or_retry(error: str, corrupt: bool = False):
+            release_once()
+            if corrupt:
+                self.metrics[mt.SHUFFLE_CHECKSUM_FAILURES].add(1)
+            if attempt >= self.max_retries or state.failed:
+                state.fail(error)
+                return
+            self.metrics[mt.SHUFFLE_TRANSFER_RETRIES].add(1)
+            delay = retry.backoff_ms(
+                attempt, self.backoff_ms, self.retry_seed,
+                key=f"transfer:{p.block}:{p.table_idx}")
+            retry.call_later(
+                delay, lambda: self._issue_transfer(state, p, attempt + 1))
+
         def on_transfer_resp(tx: Transaction):
             if tx.status is not TransactionStatus.SUCCESS:
-                release_once()
-                handler.transfer_error(tx.error_message or "transfer failed")
+                fail_or_retry(tx.error_message or "transfer failed")
                 return
             resp = msg.TransferResponse.from_bytes(tx.response)
 
             def on_buffer(target: Optional[bytearray], error: Optional[str]):
-                release_once()
                 if error is not None:
-                    handler.transfer_error(error)
+                    fail_or_retry(error)
                     return
                 try:
-                    raw, meta = decompress_batch(bytes(target), resp.meta)
+                    wire = bytes(target)
+                    if self.verify_checksums:
+                        verify_checksum(wire, resp.checksum,
+                                        context=f"{p.block} table {p.table_idx}")
+                    raw, meta = decompress_batch(wire, resp.meta)
                     rid = self.received.add(raw, meta)
-                except Exception as e:  # noqa: BLE001
-                    handler.transfer_error(f"{type(e).__name__}: {e}")
+                except ChecksumError as e:
+                    fail_or_retry(str(e), corrupt=True)
                     return
-                handler.batch_received(rid)
+                except Exception as e:  # noqa: BLE001
+                    fail_or_retry(f"{type(e).__name__}: {e}")
+                    return
+                release_once()
+                state.mark_delivered(p.block, p.table_idx)
+                state.handler.batch_received(rid, p.block, p.table_idx)
             BufferReceiveState(self, base_tag, resp.wire_size,
                                self.chunk_size, on_buffer).start()
         self.connection.request(msg.REQ_TRANSFER, treq.to_bytes(),

@@ -13,7 +13,28 @@ from __future__ import annotations
 import zlib
 from typing import Dict, List, Sequence, Tuple
 
-from spark_rapids_tpu.shuffle.table_meta import TableMeta
+from spark_rapids_tpu.shuffle.table_meta import (  # noqa: F401 - re-export
+    ChecksumError, TableMeta)
+
+
+def checksum_of(buf: bytes) -> int:
+    """crc32 (unsigned) over a packed/on-wire buffer."""
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+def verify_checksum(buf: bytes, expected: int, context: str = "") -> None:
+    """Raise ChecksumError unless ``buf`` hashes to ``expected``.
+    ``expected == 0`` means "not computed" and is never checked (crc32 of
+    real payloads hitting exactly 0 is a 2^-32 event; senders always fill
+    the field, so 0 only appears for legacy/device-layout metas)."""
+    if expected == 0:
+        return
+    actual = checksum_of(buf)
+    if actual != expected:
+        raise ChecksumError(
+            f"shuffle payload checksum mismatch{': ' + context if context else ''}"
+            f" (expected {expected:#010x}, got {actual:#010x}, "
+            f"{len(buf)} bytes)")
 
 
 class TableCompressionCodec:

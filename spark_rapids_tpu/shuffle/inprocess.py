@@ -119,6 +119,7 @@ class _Fabric:
         self.endpoints: Dict[str, _Endpoint] = {}
         self.tags = _TagTable()
         self._lock = threading.Lock()
+        self._transports: list = []
 
     @classmethod
     def get(cls) -> "_Fabric":
@@ -150,6 +151,30 @@ class _Fabric:
         if ep is None:
             raise ConnectionError(f"no executor {executor_id!r} on the fabric")
         return ep
+
+    def attach_transport(self, transport: "InProcessTransport") -> None:
+        with self._lock:
+            self._transports.append(transport)
+
+    def detach_transport(self, transport: "InProcessTransport") -> None:
+        with self._lock:
+            if transport in self._transports:
+                self._transports.remove(transport)
+
+    def kill(self, executor_id: str) -> None:
+        """Simulate an executor dying: deregister its endpoint and fire every
+        transport's peer-lost listeners (the in-process analog of a TCP
+        reader thread hitting a closed socket) — chaos tests use this to
+        exercise the evict-and-reconnect path without real sockets."""
+        with self._lock:
+            ep = self.endpoints.pop(executor_id, None)
+            transports = list(self._transports)
+        if ep is not None:
+            ep.shutdown()
+        for t in transports:
+            if t.executor_id != executor_id:
+                t._drop_client(executor_id)
+                t.notify_peer_lost(executor_id)
 
 
 class InProcessClientConnection(ClientConnection):
@@ -216,7 +241,9 @@ class InProcessTransport(ShuffleTransport):
 
     def __init__(self, executor_id: str, conf=None):
         super().__init__(executor_id, conf)
-        self._endpoint = _Fabric.get().register(executor_id)
+        self._fabric = _Fabric.get()
+        self._endpoint = self._fabric.register(executor_id)
+        self._fabric.attach_transport(self)
         self._server = InProcessServerConnection(self._endpoint)
         self._clients: Dict[str, InProcessClientConnection] = {}
         self._lock = threading.Lock()
@@ -230,9 +257,18 @@ class InProcessTransport(ShuffleTransport):
                 self._clients[peer_executor_id] = conn
             return conn
 
+    def _drop_client(self, peer_executor_id: str) -> None:
+        with self._lock:
+            self._clients.pop(peer_executor_id, None)
+
     @property
     def server(self) -> InProcessServerConnection:
         return self._server
 
     def shutdown(self) -> None:
-        pass
+        # detach so kill() stops notifying this transport and the fabric
+        # singleton doesn't pin its bounce pools forever; the ENDPOINT stays
+        # registered (peers may still hold live connections to it — the
+        # multi-executor-per-host topology shares one fabric for the
+        # process lifetime)
+        self._fabric.detach_transport(self)

@@ -41,7 +41,18 @@ from spark_rapids_tpu.shuffle.transport import make_transport
 
 class ShuffleFetchFailedError(RuntimeError):
     """RapidsShuffleFetchFailedException analog — callers re-run the map stage
-    (Spark's lineage recompute is the recovery story, SURVEY.md §5)."""
+    (Spark's lineage recompute is the recovery story, SURVEY.md §5).
+
+    Raised only after the reader's own retries (reconnect + re-fetch under
+    spark.rapids.tpu.shuffle.maxRetries) are exhausted. ``executor_id`` and
+    ``blocks`` scope the failure so callers can recompute only the affected
+    map outputs instead of the whole stage."""
+
+    def __init__(self, message: str, executor_id: Optional[str] = None,
+                 blocks: Tuple[ShuffleBlockId, ...] = ()):
+        super().__init__(message)
+        self.executor_id = executor_id
+        self.blocks = tuple(blocks)
 
 
 @dataclass(frozen=True)
@@ -104,9 +115,14 @@ class ShuffleEnv:
         self.transport = make_transport(executor_id, self.conf)
         self.server = ShuffleServer(self.transport, self.shuffle_catalog,
                                     self.conf.shuffle_codec)
+        self.metrics = self.transport.metrics
         self._clients: Dict[str, ShuffleClient] = {}
         self._lock = threading.Lock()
         self._connect_locks: Dict[str, threading.Lock] = {}
+        # a dead peer's cached client holds a dead connection; evicting it
+        # here makes the next client_for() reconnect instead of failing
+        # every future fetch against a corpse socket
+        self.transport.add_peer_lost_listener(self.invalidate_client)
 
     def client_for(self, peer_executor_id: str) -> ShuffleClient:
         # connect() blocks (TCP handshake + registry polling, up to 30 s):
@@ -134,6 +150,20 @@ class ShuffleEnv:
             with self._lock:
                 self._clients[peer_executor_id] = c
             return c
+
+    def invalidate_client(self, peer_executor_id: str) -> None:
+        """Drop the cached client for a peer whose connection died
+        (peer-lost listener target), so the next client_for() reconnects.
+        The per-peer connect LOCK is kept: replacing it while an in-flight
+        connect holds the old one would let a second caller dial a
+        duplicate connection (leaked socket + reader thread, desynced peer
+        table); the lock is tiny and reusable across reconnects. Safe to
+        call for unknown peers."""
+        from spark_rapids_tpu.utils import metrics as mt
+        with self._lock:
+            evicted = self._clients.pop(peer_executor_id, None)
+        if evicted is not None:
+            self.metrics[mt.SHUFFLE_PEER_EVICTIONS].add(1)
 
     def close(self) -> None:
         self.transport.shutdown()
@@ -179,23 +209,50 @@ class _QueueHandler(ShuffleFetchHandler):
     def __init__(self, q: "queue.Queue", peer: str):
         self.q = q
         self.peer = peer
-        self.expected = None
 
-    def start(self, expected_tables: int) -> None:
-        self.expected = expected_tables
-        self.q.put(("start", self.peer, expected_tables))
+    def start(self, expected_tables: int, tables=()) -> None:
+        self.q.put(("start", self.peer, tuple(tables)))
 
-    def batch_received(self, received_id: int) -> None:
-        self.q.put(("batch", self.peer, received_id))
+    def batch_received(self, received_id: int, block=None,
+                       table_idx: int = 0) -> None:
+        self.q.put(("batch", self.peer, (received_id, block, table_idx)))
 
-    def transfer_error(self, message: str) -> None:
-        self.q.put(("error", self.peer, message))
+    def transfer_error(self, message: str, failed_blocks=(),
+                       permanent: bool = False) -> None:
+        self.q.put(("error", self.peer,
+                    (message, tuple(failed_blocks), permanent)))
+
+
+class _PeerFetch:
+    """One peer's fetch-in-progress: the blocks still owed, the tables the
+    current attempt will deliver (None until its metadata lands), and how
+    many attempts were spent."""
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+        self.needed = None    # set[(block, table_idx)] of the current attempt
+        self.attempts = 0
+
+    def done(self, delivered) -> bool:
+        return self.needed is not None and self.needed <= delivered
 
 
 class CachingShuffleReader:
     """Reduce-side reader (RapidsCachingReader + RapidsShuffleIterator analog):
     local blocks come straight off the catalog (device tier → zero-copy), remote
-    blocks are fetched via the transport client, uploaded on arrival."""
+    blocks are fetched via the transport client, uploaded on arrival.
+
+    Failure handling: when a peer's fetch errors (connection drop, repeated
+    corruption, handler failure beyond the client's own retries), the reader
+    retries THAT peer — reconnecting through client_for (the dead client was
+    evicted by the peer-lost listener) and re-fetching only the blocks the
+    error reported undelivered. Tables are deduplicated by (block, table_idx),
+    so a retry racing a late delivery (or a duplicated frame) never yields a
+    row twice. Only after maxRetries per peer (or immediately for permanent
+    failures — lost blocks that only a map recompute brings back) does
+    ShuffleFetchFailedError surface, scoped to the failing executor +
+    blocks. The fetch timeout is one overall WAIT budget across the whole
+    drain — a trickling-but-stuck fetch cannot reset it per event."""
 
     def __init__(self, env: ShuffleEnv, tracker: MapOutputTracker,
                  shuffle_id: int, partition_id: int, semaphore=None,
@@ -208,20 +265,26 @@ class CachingShuffleReader:
         self.semaphore = semaphore
         self.timeout = (timeout if timeout is not None
                         else float(env.conf.get(_cfg.SHUFFLE_FETCH_TIMEOUT)))
+        self.max_retries = env.conf.shuffle_max_retries
+        self.backoff_ms = env.conf.shuffle_retry_backoff_ms
+        self.retry_seed = env.conf.shuffle_faults_seed
 
     def read(self):
         """Yields DeviceBatch for this reduce partition."""
+        import time as _time
+
+        from spark_rapids_tpu.shuffle import retry as _retry
+        from spark_rapids_tpu.utils import metrics as mt
         by_exec = self.tracker.blocks_by_executor(self.shuffle_id,
                                                   self.partition_id)
         local_blocks = by_exec.pop(self.env.executor_id, [])
 
         # kick off remote fetches first (overlap with local materialization)
         q: "queue.Queue" = queue.Queue()
-        expected = 0
-        started = 0
+        peers: Dict[str, _PeerFetch] = {}
         for peer, blocks in by_exec.items():
-            self.env.client_for(peer).fetch(blocks, _QueueHandler(q, peer))
-            started += 1
+            peers[peer] = _PeerFetch(blocks)
+            self._start_fetch(q, peer, blocks)
 
         if self.semaphore is not None:
             self.semaphore.acquire_if_necessary()
@@ -233,27 +296,83 @@ class CachingShuffleReader:
                 finally:
                     buf.close()
 
-        # drain remote results
-        starts_seen = 0
-        received = 0
-        while starts_seen < started or received < expected:
+        # drain remote results under ONE overall WAIT budget: the timeout
+        # counts only time this reader spends blocked on the fetch (queue
+        # waits + retry backoffs), never the consumer's compute between
+        # yields — a slow join downstream must not fake a fetch failure,
+        # while a trickling-but-stuck fetch still exhausts the budget
+        wait_budget = self.timeout
+        delivered: set = set()     # (block, table_idx) pairs yielded already
+        while not all(st.done(delivered) for st in peers.values()):
+            if wait_budget <= 0:
+                self._raise_timeout(peers, delivered)
+            t0 = _time.monotonic()
             try:
-                kind, peer, value = q.get(timeout=self.timeout)
+                kind, peer, value = q.get(timeout=wait_budget)
             except queue.Empty:
-                raise ShuffleFetchFailedError(
-                    f"shuffle {self.shuffle_id} partition {self.partition_id}: "
-                    f"timed out waiting for remote blocks")
+                self._raise_timeout(peers, delivered)
+            finally:
+                wait_budget -= _time.monotonic() - t0
+            st = peers[peer]
             if kind == "start":
-                starts_seen += 1
-                expected += value
+                st.needed = set(value)
             elif kind == "error":
-                raise ShuffleFetchFailedError(
-                    f"fetch from {peer} failed: {value}")
+                message, failed_blocks, permanent = value
+                st.attempts += 1
+                if permanent or st.attempts > self.max_retries:
+                    raise ShuffleFetchFailedError(
+                        f"fetch from {peer} failed after {st.attempts} "
+                        f"attempts: {message}", executor_id=peer,
+                        blocks=tuple(failed_blocks) or tuple(st.blocks))
+                self.env.metrics[mt.SHUFFLE_FETCH_RETRIES].add(1)
+                # bounded pause, then re-fetch only the undelivered blocks on
+                # a fresh client (the dead one was evicted on peer loss)
+                pause = min(
+                    _retry.backoff_ms(st.attempts - 1, self.backoff_ms,
+                                      self.retry_seed, key=f"read:{peer}") / 1e3,
+                    max(wait_budget, 0))
+                _time.sleep(pause)
+                wait_budget -= pause
+                if failed_blocks:
+                    st.blocks = list(failed_blocks)
+                st.needed = None
+                self._start_fetch(q, peer, st.blocks)
             else:
-                received += 1
-                raw, meta = self.env.received_catalog.take(value)
+                rid, block, table_idx = value
+                raw, meta = self.env.received_catalog.take(rid)
+                if (block, table_idx) in delivered:
+                    continue          # duplicate from a retried/duped transfer
+                delivered.add((block, table_idx))
                 hb = unpack_host_batch(raw, meta)
                 yield host_to_device_batch(hb)
+
+    def _start_fetch(self, q: "queue.Queue", peer: str, blocks) -> None:
+        """Kick off (or re-kick after an error) one peer's fetch. A connect
+        failure — client_for dialing a dead peer past ITS retries — is not
+        an unscoped crash: it queues as an error event, so it consumes a
+        reader-level attempt like any other transient and surfaces as a
+        scoped ShuffleFetchFailedError once those run out."""
+        try:
+            client = self.env.client_for(peer)
+        except (ConnectionError, OSError) as e:
+            q.put(("error", peer,
+                   (f"connect failed: {e}", tuple(blocks), False)))
+            return
+        client.fetch(blocks, _QueueHandler(q, peer))
+
+    def _raise_timeout(self, peers: Dict[str, "_PeerFetch"],
+                       delivered: set) -> None:
+        stuck = {p: [b for b in st.blocks
+                     if st.needed is None
+                     or any(k not in delivered for k in st.needed
+                            if k[0] == b)]
+                 for p, st in peers.items() if not st.done(delivered)}
+        peer = next(iter(stuck), None)
+        raise ShuffleFetchFailedError(
+            f"shuffle {self.shuffle_id} partition {self.partition_id}: "
+            f"timed out after {self.timeout}s waiting for remote blocks "
+            f"from {sorted(stuck)}", executor_id=peer,
+            blocks=tuple(stuck.get(peer, ())))
 
 
 class ShuffleManager:

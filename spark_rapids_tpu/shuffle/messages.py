@@ -115,16 +115,23 @@ class TransferRequest:
 @dataclass(frozen=True)
 class TransferResponse:
     """Ack carrying the on-wire size (post-compression) + updated meta, so the
-    receiver sizes its target buffer and chunk walk before data arrives."""
+    receiver sizes its target buffer and chunk walk before data arrives.
+    ``checksum`` is the server's crc32 over the on-wire bytes — the client
+    verifies the assembled buffer against it before decompressing, turning
+    silent corruption into a retryable error."""
     wire_size: int
     meta: TableMeta
+    checksum: int = 0
 
     def to_bytes(self) -> bytes:
         mb = self.meta.to_bytes()
-        return _U64.pack(self.wire_size) + _U32.pack(len(mb)) + mb
+        return (_U64.pack(self.wire_size) + _U32.pack(self.checksum)
+                + _U32.pack(len(mb)) + mb)
 
     @staticmethod
     def from_bytes(buf: bytes) -> "TransferResponse":
         size, = _U64.unpack_from(buf, 0)
-        mlen, = _U32.unpack_from(buf, 8)
-        return TransferResponse(size, TableMeta.from_bytes(buf[12:12 + mlen]))
+        crc, = _U32.unpack_from(buf, 8)
+        mlen, = _U32.unpack_from(buf, 12)
+        return TransferResponse(size, TableMeta.from_bytes(buf[16:16 + mlen]),
+                                crc)

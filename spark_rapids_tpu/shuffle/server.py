@@ -19,7 +19,8 @@ import numpy as np
 
 from spark_rapids_tpu.shuffle import messages as msg
 from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
-from spark_rapids_tpu.shuffle.codec import compress_batch, get_codec
+from spark_rapids_tpu.shuffle.codec import (checksum_of, compress_batch,
+                                            get_codec)
 from spark_rapids_tpu.shuffle.table_meta import (DevicePackLayout, TableMeta,
                                                  batch_string_max, device_pack,
                                                  uniform_string_batch,
@@ -147,6 +148,11 @@ class ShuffleServer:
             buf.close()
         codec = get_codec(req.codec)
         wire, wire_meta = compress_batch(raw, meta, codec)
+        # crc over the exact bytes that ride the wire (post-compression):
+        # the client verifies the assembled buffer against this before
+        # decompressing, so corruption anywhere in flight is retryable
+        crc = checksum_of(wire)
         state = BufferSendState(self, peer, wire, req.base_tag, req.chunk_size)
         state.start()
-        return msg.TransferResponse(len(wire), wire_meta).to_bytes()
+        return msg.TransferResponse(len(wire), wire_meta.with_checksum(crc),
+                                    crc).to_bytes()

@@ -17,6 +17,7 @@ flatbuffer toolchain needed) and two symmetric pack paths:
 from __future__ import annotations
 
 import struct
+import zlib
 from dataclasses import dataclass, field, replace
 from typing import List, Optional, Sequence, Tuple
 
@@ -26,7 +27,7 @@ from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 
 MAGIC = b"TPUM"
-VERSION = 1
+VERSION = 2          # v2: header carries a crc32 of the packed buffer
 ALIGN = 64
 
 _DTYPE_CODES = {dt: i for i, dt in enumerate(DType)}
@@ -61,12 +62,17 @@ class ColumnMeta:
 class TableMeta:
     """TableMeta.fbs analog. ``codec`` names the compression codec applied to
     the packed buffer ("copy" = uncompressed, CodecType.fbs analog);
-    ``uncompressed_size`` is the unpacked buffer size either way."""
+    ``uncompressed_size`` is the unpacked buffer size either way.
+    ``checksum`` is a crc32 over the packed buffer the meta describes
+    (0 = not computed, e.g. device-pack layouts sized before the bytes
+    exist); end-to-end verification uses the TransferResponse checksum over
+    the on-wire bytes, this one survives spill/reload round trips."""
     num_rows: int
     columns: Tuple[ColumnMeta, ...]
     packed_size: int
     uncompressed_size: int
     codec: str = "copy"
+    checksum: int = 0
 
     @property
     def schema(self) -> Schema:
@@ -74,8 +80,8 @@ class TableMeta:
 
     # ---- wire format ------------------------------------------------------------
     # header: magic(4s) version(H) codec_len(B) pad(B) num_rows(Q) num_cols(H)
-    #         packed_size(Q) uncompressed_size(Q)
-    _HDR = struct.Struct("<4sHBxQHQQ")
+    #         packed_size(Q) uncompressed_size(Q) checksum(I)
+    _HDR = struct.Struct("<4sHBxQHQQI")
     # per column: name_len(H) dtype(B) nullable(B) smax(I) 3×(offset Q, length Q)
     _COL = struct.Struct("<HBBIQQQQQQ")
 
@@ -84,7 +90,7 @@ class TableMeta:
         codec_b = self.codec.encode()
         out += self._HDR.pack(MAGIC, VERSION, len(codec_b), self.num_rows,
                               len(self.columns), self.packed_size,
-                              self.uncompressed_size)
+                              self.uncompressed_size, self.checksum)
         out += codec_b
         for c in self.columns:
             nb = c.name.encode()
@@ -98,7 +104,7 @@ class TableMeta:
 
     @staticmethod
     def from_bytes(b: bytes) -> "TableMeta":
-        magic, ver, codec_len, num_rows, ncols, psize, usize = \
+        magic, ver, codec_len, num_rows, ncols, psize, usize, crc = \
             TableMeta._HDR.unpack_from(b, 0)
         if magic != MAGIC:
             raise ValueError(f"bad TableMeta magic {magic!r}")
@@ -118,10 +124,16 @@ class TableMeta:
                                    smax, SubBufferMeta(doff, dlen),
                                    SubBufferMeta(voff, vlen),
                                    SubBufferMeta(loff, llen)))
-        return TableMeta(num_rows, tuple(cols), psize, usize, codec)
+        return TableMeta(num_rows, tuple(cols), psize, usize, codec, crc)
 
     def with_codec(self, codec: str, packed_size: int) -> "TableMeta":
-        return replace(self, codec=codec, packed_size=packed_size)
+        # the described bytes change with the codec, so the old crc no
+        # longer applies — reset to "not computed" unless re-stamped
+        return replace(self, codec=codec, packed_size=packed_size,
+                       checksum=0)
+
+    def with_checksum(self, checksum: int) -> "TableMeta":
+        return replace(self, checksum=checksum)
 
 
 # ---------------------------------------------------------------------------------
@@ -151,15 +163,32 @@ def pack_host_batch(batch: HostBatch) -> Tuple[bytes, TableMeta]:
     buf = bytearray(pos)
     for off, raw in chunks:
         buf[off:off + len(raw)] = raw
-    meta = TableMeta(batch.num_rows, tuple(cols), len(buf), len(buf))
-    return bytes(buf), meta
+    data = bytes(buf)        # the one copy the caller gets; crc over it too
+    meta = TableMeta(batch.num_rows, tuple(cols), len(data), len(data),
+                     checksum=zlib.crc32(data) & 0xFFFFFFFF)
+    return data, meta
+
+
+class ChecksumError(ValueError):
+    """The buffer does not match the checksum its meta promises — corruption
+    between pack and unpack (wire, spill, or staging). Retryable on fetch
+    paths; re-exported by shuffle.codec for the transfer pipeline."""
 
 
 def unpack_host_batch(buf: bytes, meta: TableMeta) -> HostBatch:
-    """Rebuild a HostBatch from a contiguous buffer (getBatchFromMeta analog)."""
+    """Rebuild a HostBatch from a contiguous buffer (getBatchFromMeta analog).
+    When the meta carries a checksum (pack_host_batch stamps one; codec
+    transforms reset it), the buffer is verified first — the last line of
+    defense before corrupted bytes become rows."""
     if meta.codec != "copy":
         raise ValueError(f"buffer still compressed with {meta.codec!r}; "
                          f"decompress first (BatchedBufferDecompressor analog)")
+    if meta.checksum:
+        actual = zlib.crc32(buf) & 0xFFFFFFFF
+        if actual != meta.checksum:
+            raise ChecksumError(
+                f"packed buffer checksum mismatch (expected "
+                f"{meta.checksum:#010x}, got {actual:#010x}, {len(buf)} bytes)")
     mv = memoryview(buf)
     cols: List[HostColumn] = []
     for cm in meta.columns:

@@ -29,11 +29,13 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from spark_rapids_tpu.shuffle.retry import backoff_ms
 from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ClientConnection,
                                                 ServerConnection,
                                                 ShuffleTransport, Transaction,
                                                 TransactionStatus)
+from spark_rapids_tpu.utils import metrics as mt
 
 _HDR = struct.Struct(">cQI")
 
@@ -98,6 +100,14 @@ class _Peer:
         t._peer_lost(self, "connection closed")
 
     def close(self) -> None:
+        # SHUT_RDWR first: a bare close() is deferred by CPython while the
+        # reader thread is blocked in recv — no FIN goes out and neither
+        # side's reader ever wakes; shutdown() interrupts the recv and
+        # notifies the remote immediately
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -114,7 +124,7 @@ class TcpClientConnection(ClientConnection):
                 cb: Callable[[Transaction], None]) -> Transaction:
         tx = Transaction().start(cb)
         rid = self._t._next_request_id()
-        self._t._pending_rpcs[rid] = tx
+        self._t._pending_rpcs[rid] = (tx, self._peer)
         body = (struct.pack(">H", len(req_type)) + req_type.encode()
                 + payload)
         try:
@@ -129,7 +139,7 @@ class TcpClientConnection(ClientConnection):
 
     def receive(self, alt: AddressLengthTag, cb) -> Transaction:
         tx = Transaction(alt.tag).start(cb)
-        self._t._post_receive(alt, tx)
+        self._t._post_receive(alt, tx, self._peer)
         return tx
 
 
@@ -163,11 +173,14 @@ class TcpTransport(ShuffleTransport):
     def __init__(self, executor_id: str, conf=None):
         super().__init__(executor_id, conf)
         self._handlers: Dict[str, Callable[[str, bytes], bytes]] = {}
-        self._pending_rpcs: Dict[int, Transaction] = {}
+        # pending tables track the OWNING peer per transaction, so a lost
+        # peer fails only its own transactions (scoped failure domains)
+        self._pending_rpcs: Dict[int, Tuple[Transaction, "_Peer"]] = {}
         self._rpc_id = 0
         self._rpc_lock = threading.Lock()
         self._tag_lock = threading.Lock()
-        self._pending_recvs: Dict[int, Tuple[AddressLengthTag, Transaction]] = {}
+        self._pending_recvs: Dict[
+            int, Tuple[AddressLengthTag, Transaction, "_Peer"]] = {}
         self._early_data: Dict[int, bytes] = {}
         self._peers: Dict[str, _Peer] = {}
         self._clients: Dict[str, TcpClientConnection] = {}
@@ -256,22 +269,40 @@ class TcpTransport(ShuffleTransport):
         self._peers[peer_id] = peer
 
     def _peer_lost(self, peer: _Peer, reason: str) -> None:
-        """A reader exited: every pending transaction fails NOW (a silent
-        hang until the fetch timeout is strictly worse than an error — the
-        iterator's ShuffleFetchFailedError drives the stage retry)."""
+        """A reader exited: every pending transaction OWNED BY THAT PEER
+        fails NOW (a silent hang until the fetch timeout is strictly worse
+        than an error — the error drives the reader's reconnect-and-retry,
+        then ShuffleFetchFailedError and the stage retry). Transactions of
+        healthy peers are untouched: one lost executor must not fail
+        fetches that were never routed through it."""
         with self._tag_lock:
-            recvs = list(self._pending_recvs.values())
-            self._pending_recvs.clear()
-        rpcs = list(self._pending_rpcs.values())
-        self._pending_rpcs.clear()
+            dead_tags = [t for t, (_, _, owner) in self._pending_recvs.items()
+                         if owner is peer]
+            recvs = [self._pending_recvs.pop(t)[1] for t in dead_tags]
+        dead_rids = [r for r, (_, owner) in list(self._pending_rpcs.items())
+                     if owner is peer]
+        rpcs = [tx for rid in dead_rids
+                for tx in (self._pending_rpcs.pop(rid, (None,))[0],)
+                if tx is not None]
+        # drop the dead peer from the connection tables so the next
+        # connect() dials a fresh socket instead of reusing a corpse —
+        # guard against a STALE reader (a replaced connection's old socket)
+        # evicting the live one
+        was_current = self._peers.get(peer.peer_id) is peer
+        if was_current:
+            self._peers.pop(peer.peer_id, None)
+            with self._clients_lock:
+                self._clients.pop(peer.peer_id, None)
 
         def fail():
             msg = f"peer {peer.peer_id!r} lost: {reason}"
-            for _, tx in recvs:
+            for tx in recvs:
                 tx.complete(TransactionStatus.ERROR, msg)
             for tx in rpcs:
                 tx.complete(TransactionStatus.ERROR, msg)
         self._progress_put(fail)
+        if was_current and peer.peer_id != "?":
+            self.notify_peer_lost(peer.peer_id)
 
     def _peer_by_id(self, peer_id: str) -> Optional[_Peer]:
         return self._peers.get(peer_id)
@@ -281,11 +312,12 @@ class TcpTransport(ShuffleTransport):
             self._rpc_id += 1
             return self._rpc_id
 
-    def _post_receive(self, alt: AddressLengthTag, tx: Transaction) -> None:
+    def _post_receive(self, alt: AddressLengthTag, tx: Transaction,
+                      peer: _Peer) -> None:
         with self._tag_lock:
             data = self._early_data.pop(alt.tag, None)
             if data is None:
-                self._pending_recvs[alt.tag] = (alt, tx)
+                self._pending_recvs[alt.tag] = (alt, tx, peer)
                 return
         # complete on the progress thread, NEVER inline: the poster holds its
         # own state lock (inprocess._TagTable defers the same way)
@@ -297,7 +329,7 @@ class TcpTransport(ShuffleTransport):
             if pending is None:
                 self._early_data[tag] = payload   # send raced ahead of recv
                 return
-        alt, tx = pending
+        alt, tx, _owner = pending
         self._fill(alt, tx, payload)
 
     @staticmethod
@@ -308,9 +340,10 @@ class TcpTransport(ShuffleTransport):
         tx.complete(TransactionStatus.SUCCESS)
 
     def _on_response(self, rid: int, payload: bytes) -> None:
-        tx = self._pending_rpcs.pop(rid, None)
-        if tx is None:
+        entry = self._pending_rpcs.pop(rid, None)
+        if entry is None:
             return
+        tx, _owner = entry
         ok = payload[:1] == b"\x00"
         tx.response = payload[1:]
         tx.stats.received_bytes = len(tx.response)
@@ -341,12 +374,37 @@ class TcpTransport(ShuffleTransport):
 
     # ---- transport API -----------------------------------------------------
     def connect(self, peer_executor_id: str) -> TcpClientConnection:
+        """Dial a peer, retrying transient failures (slow registry, peer
+        restarting, connection refused) with exponential backoff + jitter
+        under shuffle.maxRetries / .retryBackoffMs; each attempt is bounded
+        by shuffle.connectTimeout. On peer loss the cached connection was
+        evicted by _peer_lost, so calling connect() again re-dials."""
         with self._clients_lock:
             conn = self._clients.get(peer_executor_id)
             if conn is not None:
                 return conn
-        host, port = self._resolve(peer_executor_id)
-        sock = socket.create_connection((host, port), timeout=30)
+        timeout = self.conf.shuffle_connect_timeout
+        max_retries = self.conf.shuffle_max_retries
+        attempt = 0
+        while True:
+            try:
+                host, port = self._resolve(peer_executor_id, timeout)
+                sock = socket.create_connection((host, port), timeout=timeout)
+                break
+            except (OSError, ConnectionError) as e:
+                if attempt >= max_retries:
+                    raise ConnectionError(
+                        f"connect to {peer_executor_id!r} failed after "
+                        f"{attempt + 1} attempts: {e}") from e
+                self.metrics[mt.SHUFFLE_CONNECT_RETRIES].add(1)
+                time.sleep(backoff_ms(
+                    attempt, self.conf.shuffle_retry_backoff_ms,
+                    self.conf.shuffle_faults_seed,
+                    key=f"connect:{peer_executor_id}") / 1e3)
+                attempt += 1
+        # connectTimeout applies to establishment only; a long-idle but
+        # healthy connection must not trip the reader's recv timeout
+        sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         peer = _Peer(self, sock, peer_executor_id)
         self._register_peer(peer_executor_id, peer)
@@ -356,8 +414,10 @@ class TcpTransport(ShuffleTransport):
             self._clients[peer_executor_id] = conn
         return conn
 
-    def _resolve(self, peer_executor_id: str, timeout: float = 30.0
+    def _resolve(self, peer_executor_id: str, timeout: Optional[float] = None
                  ) -> Tuple[str, int]:
+        if timeout is None:
+            timeout = self.conf.shuffle_connect_timeout
         if ":" in peer_executor_id:          # direct host:port addressing
             host, _, port = peer_executor_id.rpartition(":")
             return host, int(port)
@@ -384,11 +444,19 @@ class TcpTransport(ShuffleTransport):
         return self._server_conn
 
     def shutdown(self) -> None:
+        # retract the registry entry FIRST: a restarted executor re-binds an
+        # ephemeral port, and a stale file would hand peers a dead address
+        # (or worse, someone else's re-used port) to resolve forever
+        if self._registry:
+            try:
+                os.remove(os.path.join(self._registry, self.executor_id))
+            except OSError:
+                pass
         try:
             self._listener.close()
         except OSError:
             pass
-        for p in self._peers.values():
+        for p in list(self._peers.values()):
             p.close()
         self._work.put(None)
         self._work.put(None)

@@ -237,6 +237,8 @@ class ShuffleTransport:
 
     def __init__(self, executor_id: str, conf=None):
         from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.utils.metrics import (SHUFFLE_METRIC_NAMES,
+                                                    MetricSet)
         self.executor_id = executor_id
         self.conf = conf or TpuConf()
         bb_size = self.conf.shuffle_bounce_buffer_size
@@ -244,6 +246,27 @@ class ShuffleTransport:
         self.send_bounce = BounceBufferManager("send", bb_size, bb_count)
         self.recv_bounce = BounceBufferManager("recv", bb_size, bb_count)
         self.throttle = InflightThrottle(self.conf.shuffle_max_inflight_bytes)
+        #: fault-tolerance counters, shared with the env/client/reader layers
+        self.metrics = MetricSet(*SHUFFLE_METRIC_NAMES)
+        self._peer_lost_listeners: List[Callable[[str], None]] = []
+        self._listeners_lock = threading.Lock()
+
+    def add_peer_lost_listener(self, fn: Callable[[str], None]) -> None:
+        """``fn(peer_executor_id)`` runs when a peer's connection dies —
+        the hook ShuffleEnv uses to evict its cached client so the next
+        fetch reconnects instead of reusing a dead socket."""
+        with self._listeners_lock:
+            self._peer_lost_listeners.append(fn)
+
+    def notify_peer_lost(self, peer_executor_id: str) -> None:
+        with self._listeners_lock:
+            listeners = list(self._peer_lost_listeners)
+        for fn in listeners:
+            try:
+                fn(peer_executor_id)
+            except Exception:  # noqa: BLE001 — one listener must not mute the rest
+                import traceback
+                traceback.print_exc()
 
     def connect(self, peer_executor_id: str) -> ClientConnection:
         raise NotImplementedError

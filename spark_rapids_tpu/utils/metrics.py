@@ -22,6 +22,21 @@ PEAK_DEVICE_MEMORY = "peakDevMemory"
 BUFFER_TIME = "bufferTime"
 DECODE_TIME = "tpuDecodeTime"
 
+# Shuffle fault-tolerance counters (one MetricSet per transport, shared by
+# the env/client/reader layers — RapidsShuffleInternalManager's
+# rapidsShuffle* metrics role, extended with the retry/corruption story)
+SHUFFLE_FETCH_RETRIES = "shuffleFetchRetries"        # reader re-fetches a peer
+SHUFFLE_TRANSFER_RETRIES = "shuffleTransferRetries"  # per-block re-transfers
+SHUFFLE_RPC_RETRIES = "shuffleRpcRetries"            # metadata request retries
+SHUFFLE_CONNECT_RETRIES = "shuffleConnectRetries"    # TCP connect re-attempts
+SHUFFLE_CHECKSUM_FAILURES = "shuffleChecksumFailures"  # corrupt payloads caught
+SHUFFLE_PEER_EVICTIONS = "shufflePeerEvictions"      # dead clients evicted
+
+SHUFFLE_METRIC_NAMES = (
+    SHUFFLE_FETCH_RETRIES, SHUFFLE_TRANSFER_RETRIES, SHUFFLE_RPC_RETRIES,
+    SHUFFLE_CONNECT_RETRIES, SHUFFLE_CHECKSUM_FAILURES,
+    SHUFFLE_PEER_EVICTIONS)
+
 
 class Metric:
     __slots__ = ("name", "unit", "_value", "_lock")
