@@ -17,6 +17,22 @@ python -m pytest tests/ -q
 echo "== shuffle fault injection (deterministic chaos, fixed seed) =="
 python -m pytest tests/test_shuffle_faults.py -q
 
+echo "== bench smoke (transfer-pipeline breakdown keys, cpu backend) =="
+BENCH_ITERS=1 BENCH_SCALE=0.05 python bench.py | tail -n 1 > /tmp/bench_smoke.json
+python - /tmp/bench_smoke.json <<'PY'
+import json, sys
+out = json.load(open(sys.argv[1]))
+pipe = out["breakdown"]["pipeline"]
+for key in ("chunk_rows", "upload_chunked_s", "per_chunk_upload_s",
+            "upload_overlap_efficiency", "inflight_high_water",
+            "end_to_end_cold_collect_s"):
+    assert key in pipe, f"missing pipeline breakdown key {key}: {pipe}"
+assert pipe["upload_overlap_efficiency"] > 0, pipe
+print("bench smoke OK:", {k: pipe[k] for k in
+                          ("upload_chunked_s", "upload_overlap_efficiency",
+                           "inflight_high_water")})
+PY
+
 if [ "${RUN_TPU_BENCH:-0}" = "1" ]; then
     echo "== device benchmarks (real chip) =="
     unset JAX_PLATFORMS

@@ -566,8 +566,11 @@ class DataFrame:
         # spark.rapids.tpu.trace.enabled: the whole action shows up as one
         # named range in the XLA/TensorBoard profile (NVTX analog); when
         # metrics are on, per-operator counters land in session.last_metrics
-        from spark_rapids_tpu.utils.metrics import NamedRange
+        from spark_rapids_tpu.utils.metrics import (NamedRange,
+                                                    transfer_delta,
+                                                    transfer_snapshot)
         trace = self.session.conf.get(_cfg.TRACE_ENABLED)
+        transfer_before = transfer_snapshot()
         try:
             # device-admission throttle for the whole task (GpuSemaphore analog)
             with dm.semaphore.held(), NamedRange("tpu-sql-action",
@@ -597,11 +600,42 @@ class DataFrame:
                                           device_manager=dm, cleanups=cleanups)
                         tables.extend(final.execute(ctx))
                     return tables
-                for p in range(final.num_partitions):
-                    ctx = ExecContext(self.session.conf, partition_id=p,
-                                      num_partitions=final.num_partitions,
-                                      device_manager=dm, cleanups=cleanups)
-                    tables.extend(b.to_arrow() for b in final.execute(ctx))
+                stream = (
+                    isinstance(final, DeviceToHostExec)
+                    and self.session.conf.get(_cfg.TRANSFER_STREAMING_COLLECT)
+                    and not any(getattr(nd, "is_mesh", False)
+                                for nd in _iter_execs(final)))
+                if stream:
+                    # streaming collect: each result batch's D2H starts the
+                    # moment its program is dispatched (copy_to_host_async)
+                    # and overlaps the remaining compute; at most
+                    # transfer.maxInflight downloads are outstanding, and
+                    # batch order is preserved by resolving in FIFO order
+                    from spark_rapids_tpu.columnar.transfer import \
+                        start_download
+                    child = final.children[0]
+                    max_inflight = self.session.conf.get(
+                        _cfg.TRANSFER_MAX_INFLIGHT)
+                    pending: List = []
+                    for p in range(final.num_partitions):
+                        ctx = ExecContext(self.session.conf, partition_id=p,
+                                          num_partitions=final.num_partitions,
+                                          device_manager=dm,
+                                          cleanups=cleanups)
+                        for db in child.execute(ctx):
+                            final.count_output(db.num_rows)
+                            pending.append(start_download(db))
+                            while len(pending) > max_inflight:
+                                tables.append(pending.pop(0).result())
+                    tables.extend(pd.result() for pd in pending)
+                else:
+                    for p in range(final.num_partitions):
+                        ctx = ExecContext(self.session.conf, partition_id=p,
+                                          num_partitions=final.num_partitions,
+                                          device_manager=dm,
+                                          cleanups=cleanups)
+                        tables.extend(b.to_arrow()
+                                      for b in final.execute(ctx))
         finally:
             for fn in cleanups:
                 fn()
@@ -609,6 +643,9 @@ class DataFrame:
                 self.session.last_metrics = {
                     f"{i}:{nd.name}": nd.metrics.snapshot()
                     for i, nd in enumerate(_iter_execs(final))}
+                # host-link story for the whole action, incl. derived GB/s
+                self.session.last_metrics["transfer"] = transfer_delta(
+                    transfer_before)
         return tables
 
     def collect(self) -> pa.Table:

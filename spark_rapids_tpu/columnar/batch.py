@@ -171,11 +171,11 @@ class DeviceBatch:
             cols.append(DeviceColumn(f.dtype, d, validity, l, bits))
         return DeviceBatch(schema, tuple(cols), n)
 
-    def to_arrow(self) -> pa.Table:
-        """Download to a host arrow table (GpuColumnarToRow analog). All
-        column buffers are sliced to the live rows on device and fetched in a
-        single device_get so transfers overlap instead of paying one
-        host-link round trip per buffer."""
+    def sliced_buffers(self) -> List[Tuple]:
+        """Device-side (data, validity, lengths_or_None) slices of the live
+        rows, ready to download: slicing happens ON DEVICE so only live rows
+        cross the host link. The streaming-collect path uses this to start
+        asynchronous per-batch downloads (columnar/transfer.py)."""
         n = self.num_rows
         sliced = []
         for col in self.columns:
@@ -185,17 +185,15 @@ class DeviceBatch:
             data = col.bits if col.bits is not None else col.data
             sliced.append((data[:n], col.validity[:n],
                            col.lengths[:n] if col.lengths is not None else None))
-        fetched = jax.device_get(sliced)
-        arrays: List[pa.Array] = []
-        for f, (data, validity, lengths) in zip(self.schema, fetched):
-            data = np.asarray(data)
-            if f.dtype is DType.DOUBLE and data.dtype == np.uint64:
-                data = data.view(np.float64)
-            arrays.append(_numpy_to_arrow(f.dtype, data,
-                                          np.asarray(validity),
-                                          None if lengths is None
-                                          else np.asarray(lengths), n))
-        return pa.Table.from_arrays(arrays, schema=self.schema.to_pa())
+        return sliced
+
+    def to_arrow(self) -> pa.Table:
+        """Download to a host arrow table (GpuColumnarToRow analog). All
+        column buffers are sliced to the live rows on device and fetched in a
+        single device_get so transfers overlap instead of paying one
+        host-link round trip per buffer."""
+        fetched = jax.device_get(self.sliced_buffers())
+        return fetched_to_arrow(self.schema, fetched, self.num_rows)
 
     # ------------------------------------------------------------------ helpers
     @staticmethod
@@ -204,6 +202,21 @@ class DeviceBatch:
         cap = max(capacity, 1)
         cols = tuple(null_column(f.dtype, cap, string_max_bytes) for f in schema)
         return DeviceBatch(schema, cols, 0)
+
+
+def fetched_to_arrow(schema: Schema, fetched, num_rows: int) -> pa.Table:
+    """Host buffers (one (data, validity, lengths) triple per column, as laid
+    out by ``DeviceBatch.sliced_buffers``) -> arrow table."""
+    arrays: List[pa.Array] = []
+    for f, (data, validity, lengths) in zip(schema, fetched):
+        data = np.asarray(data)
+        if f.dtype is DType.DOUBLE and data.dtype == np.uint64:
+            data = data.view(np.float64)
+        arrays.append(_numpy_to_arrow(f.dtype, data,
+                                      np.asarray(validity),
+                                      None if lengths is None
+                                      else np.asarray(lengths), num_rows))
+    return pa.Table.from_arrays(arrays, schema=schema.to_pa())
 
 
 def _arrow_to_staged(dtype: DType, arr: pa.Array, string_max_bytes: int):

@@ -60,6 +60,10 @@ class HostBatch:
             if isinstance(arr, pa.ChunkedArray):
                 arr = (arr.chunk(0) if arr.num_chunks == 1
                        else pa.concat_arrays(arr.chunks))
+            if isinstance(arr, pa.DictionaryArray):
+                # host layout has no dictionary form; device-side dict
+                # decode is DeviceBatch.from_arrow's job
+                arr = arr.cast(arr.type.value_type)
             validity = _arrow_validity(arr)
             if f.dtype is DType.STRING:
                 mat, lengths = _strings_to_matrix(arr, string_max_bytes)

@@ -75,6 +75,12 @@ def _fraction(name: str) -> Callable[[Any], Optional[str]]:
     return check
 
 
+def _non_negative(name: str) -> Callable[[Any], Optional[str]]:
+    def check(v: Any) -> Optional[str]:
+        return None if v >= 0 else f"{name} must be >= 0, got {v}"
+    return check
+
+
 # --------------------------------------------------------------------------------------
 # General / plan-rewrite keys (analog of spark.rapids.sql.* in RapidsConf.scala)
 # --------------------------------------------------------------------------------------
@@ -283,6 +289,51 @@ MESH_AGG_REPARTITION_THRESHOLD = _conf(
     "collective, no repartition program.")
 
 # --------------------------------------------------------------------------------------
+# Transfer pipeline (host link overlap; the HostToGpuCoalesceIterator pinned-
+# memory async-H2D role, engineered per Theseus: the link, device compute and
+# host decode must run concurrently, with BOUNDED in-flight buffers)
+# --------------------------------------------------------------------------------------
+TRANSFER_CHUNK_ROWS = _conf(
+    "transfer.chunkRows", int, 1 << 20,
+    "Host->device uploads larger than this many rows split into row chunks "
+    "so chunk N+1 stages on host while chunk N's asynchronous device_put is "
+    "in flight, then reassemble on device (one concat program per schema/"
+    "capacity). 0 uploads every table in a single shot.",
+    checker=_non_negative("transfer.chunkRows"))
+
+TRANSFER_MAX_INFLIGHT = _conf(
+    "transfer.maxInflight", int, 2,
+    "Bound on in-flight transfers: at most this many upload chunks (and, "
+    "with streaming collect, per-batch downloads) may be outstanding before "
+    "the pipeline blocks on the oldest — bounded buffering instead of an "
+    "unbounded queue so HBM and host staging memory cannot be overrun.",
+    checker=_positive("transfer.maxInflight"))
+
+TRANSFER_PIPELINE_ENABLED = _conf(
+    "transfer.pipeline.enabled", bool, True,
+    "Planner-inserted bounded-async dispatch between scan and compute "
+    "stages: a PipelinedExec wrapper keeps up to transfer.pipeline.depth "
+    "batches in flight on a producer thread instead of the strict "
+    "pull-per-batch lockstep, sharing the consumer task's device-admission "
+    "semaphore hold for backpressure. Skipped on single-core hosts (the "
+    "producer thread would only contend with the consumer).")
+
+TRANSFER_PIPELINE_DEPTH = _conf(
+    "transfer.pipeline.depth", int, 2,
+    "How many batches a PipelinedExec stage boundary keeps in flight "
+    "between the producing scan and the consuming compute stage.",
+    checker=_positive("transfer.pipeline.depth"))
+
+TRANSFER_STREAMING_COLLECT = _conf(
+    "transfer.streamingCollect.enabled", bool, True,
+    "collect() enqueues each result batch's device->host download as soon "
+    "as its program is dispatched (copy_to_host_async) instead of syncing "
+    "then downloading the full result at the end, so D2H overlaps the "
+    "remaining compute; at most transfer.maxInflight downloads are "
+    "outstanding. Batch order, error propagation and per-operator metrics "
+    "are preserved.")
+
+# --------------------------------------------------------------------------------------
 # Memory / scheduling (analog of spark.rapids.memory.*)
 # --------------------------------------------------------------------------------------
 CONCURRENT_TPU_TASKS = _conf(
@@ -349,12 +400,6 @@ SHUFFLE_COMPRESSION_CODEC = _conf(
     "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), "
     "zlib, zstd (fastest real codec; the right choice for network-bound DCN "
     "shuffles) — analog of spark.rapids.shuffle.compression.codec.")
-
-
-def _non_negative(name: str) -> Callable[[Any], Optional[str]]:
-    def check(v: Any) -> Optional[str]:
-        return None if v >= 0 else f"{name} must be >= 0, got {v}"
-    return check
 
 
 SHUFFLE_MAX_RETRIES = _conf(

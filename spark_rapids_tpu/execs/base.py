@@ -8,6 +8,7 @@ GpuColumnarToRowExec analogs).
 """
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.columnar.dtypes import Schema
@@ -21,11 +22,22 @@ class ExecContext:
 
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
                  num_partitions: int = 1, device_manager=None,
-                 cleanups: Optional[list] = None, cluster_shuffle=None):
+                 cleanups: Optional[list] = None, cluster_shuffle=None,
+                 device=None):
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.device_manager = device_manager
+        #: target jax device for this task's uploads (multi-device placement);
+        #: None = the process default device
+        self.device = device
+        #: the owning task's id for the device-admission semaphore: captured
+        #: at construction (the thread that starts the task). Worker threads
+        #: an exec spawns (PipelinedExec / prefetch producers) join THIS
+        #: task's semaphore hold — using their own ident (or their direct
+        #: consumer's, which for nested pipelines is just another producer
+        #: thread) would take extra permits and can deadlock admission.
+        self.task_id = threading.get_ident()
         #: shared across the partitions of one action; run by the caller when
         #: the query finishes (shuffle unregistration etc.)
         self.cleanups = cleanups

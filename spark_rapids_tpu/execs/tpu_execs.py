@@ -89,24 +89,35 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
     cap = bucket_capacity(total)
     cols = []
     for ci, f in enumerate(schema):
-        datas, valids, lens = [], [], []
+        datas, valids, lens, bit_parts = [], [], [], []
+        # the f64 bit sibling survives only when EVERY contributor carries
+        # one (upload-time doubles); device-computed doubles have none and
+        # a partial sibling would desynchronize from the data
+        carry_bits = (f.dtype is DType.DOUBLE
+                      and all(b.columns[ci].bits is not None for b in batches))
         for b in batches:
             c = b.columns[ci]
             datas.append(c.data[:b.num_rows])
             valids.append(c.validity[:b.num_rows])
             if c.lengths is not None:
                 lens.append(c.lengths[:b.num_rows])
+            if carry_bits:
+                bit_parts.append(c.bits[:b.num_rows])
         if f.dtype is DType.STRING:
             from spark_rapids_tpu.ops.strings import pad_width
             W = max(d.shape[-1] for d in datas)
             datas = [pad_width(jnp, d, W) for d in datas]
         data = jnp.concatenate(datas, axis=0)
         validity = jnp.concatenate(valids, axis=0)
+        bits = jnp.concatenate(bit_parts, axis=0) if carry_bits else None
         pad = cap - total
         if pad:
             pad_shape = (pad,) + data.shape[1:]
             data = jnp.concatenate([data, jnp.zeros(pad_shape, data.dtype)], axis=0)
             validity = jnp.concatenate([validity, jnp.zeros(pad, bool)], axis=0)
+            if bits is not None:
+                bits = jnp.concatenate(
+                    [bits, jnp.zeros(pad, bits.dtype)], axis=0)
         if f.dtype is DType.STRING:
             lengths = jnp.concatenate(lens, axis=0)
             if pad:
@@ -114,7 +125,7 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
                     [lengths, jnp.zeros(pad, lengths.dtype)], axis=0)
             cols.append(DeviceColumn(f.dtype, data, validity, lengths))
         else:
-            cols.append(DeviceColumn(f.dtype, data, validity))
+            cols.append(DeviceColumn(f.dtype, data, validity, bits=bits))
     return DeviceBatch(schema, tuple(cols), total)
 
 
@@ -133,6 +144,7 @@ class HostToDeviceExec(PhysicalExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.columnar.transfer import upload_table_conf
         from spark_rapids_tpu.execs.cpu_execs import CpuLocalScanExec
         child = self.children[0]
         if (isinstance(child, CpuLocalScanExec)
@@ -144,7 +156,8 @@ class HostToDeviceExec(PhysicalExec):
             smax = ctx.string_max_bytes
             b = cache.get(child.table, smax)
             if b is None:
-                b = DeviceBatch.from_arrow(child.table, smax)
+                b = upload_table_conf(child.table, smax, ctx.conf,
+                                      device=ctx.device)
                 cache.put(child.table, smax, b)
             child.count_output(b.num_rows)
             self.count_output(b.num_rows)
@@ -152,7 +165,8 @@ class HostToDeviceExec(PhysicalExec):
             return
         for hb in child.execute(ctx):
             table = hb.to_arrow() if isinstance(hb, HostBatch) else hb
-            b = DeviceBatch.from_arrow(table, ctx.string_max_bytes)
+            b = upload_table_conf(table, ctx.string_max_bytes, ctx.conf,
+                                  device=ctx.device)
             self.count_output(b.num_rows)
             yield b
 

@@ -296,6 +296,7 @@ class TpuParquetScanExec(_ParquetScanBase):
         import os as _os
 
         from spark_rapids_tpu import config as _cfg
+        from spark_rapids_tpu.columnar.transfer import upload_table_conf
         self.device_dict = ctx.conf.get(_cfg.PARQUET_DEVICE_DICT)
         depth = ctx.conf.get(_cfg.SCAN_PREFETCH_BATCHES)
         if (_os.cpu_count() or 1) < 2:
@@ -305,13 +306,16 @@ class TpuParquetScanExec(_ParquetScanBase):
             depth = 0
         if depth <= 0:
             for t in self._iter_arrow(ctx):
-                b = DeviceBatch.from_arrow(t, ctx.string_max_bytes)
+                b = upload_table_conf(t, ctx.string_max_bytes, ctx.conf,
+                                      device=ctx.device)
                 self.count_output(b.num_rows)
                 yield b
             return
         import queue
         import threading
+        from spark_rapids_tpu.execs.pipeline import _put_abortable
         q: "queue.Queue" = queue.Queue(maxsize=depth)
+        stop = threading.Event()
         smax = ctx.string_max_bytes
 
         def produce() -> None:
@@ -319,24 +323,39 @@ class TpuParquetScanExec(_ParquetScanBase):
                 for t in self._iter_arrow(ctx):
                     # staging + device_put happen HERE, ahead of the
                     # consumer; the upload is already in flight when the
-                    # consumer dequeues the batch
-                    q.put(("b", DeviceBatch.from_arrow(t, smax)))
+                    # consumer dequeues the batch. ctx.device rides along so
+                    # multi-device placement doesn't silently default.
+                    b = upload_table_conf(t, smax, ctx.conf,
+                                          device=ctx.device)
+                    if not _put_abortable(q, ("b", b), stop):
+                        return      # consumer abandoned the scan early
             except BaseException as e:  # noqa: BLE001 - reraised below
-                q.put(("e", e))
+                _put_abortable(q, ("e", e), stop)
                 return
-            q.put(("end", None))
+            _put_abortable(q, ("end", None), stop)
 
         worker = threading.Thread(target=produce, daemon=True,
                                   name="parquet-scan-prefetch")
         worker.start()
-        while True:
-            kind, val = q.get()
-            if kind == "end":
-                break
-            if kind == "e":
-                raise val
-            self.count_output(val.num_rows)
-            yield val
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "end":
+                    break
+                if kind == "e":
+                    raise val
+                self.count_output(val.num_rows)
+                yield val
+        finally:
+            # early exit (LimitExec closing the generator), consumer error,
+            # or normal end: unblock a producer stuck on a full queue and
+            # reap the thread instead of leaking it with device batches
+            stop.set()
+            while worker.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    worker.join(0.05)
 
 
 def write_parquet(table: pa.Table, path: str, compression: str = "snappy") -> None:

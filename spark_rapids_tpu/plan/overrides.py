@@ -770,7 +770,8 @@ class TpuOverrides:
                 if "cannot run on TPU" in line or "because" in line:
                     print(line)
         converted = meta.convert_if_needed()
-        return insert_transitions(fuse_device_ops(converted))
+        return insert_pipeline(insert_transitions(fuse_device_ops(converted)),
+                               self.conf)
 
 
 def _enforce_exchange_reuse(root: ExecMeta) -> None:
@@ -868,6 +869,42 @@ def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
             return type(node)(grouping, aggs, child, node.output,
                               pre_filter=pre)
         return node
+
+    return plan.transform_up(fix)
+
+
+def insert_pipeline(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
+    """Wrap scan->compute stage boundaries in PipelinedExec so up to
+    transfer.pipeline.depth batches stay in flight between the producing
+    scan (device file scans, upload transitions) and the consuming device
+    stage, replacing the strict pull-per-batch lockstep (conf-gated;
+    spark.rapids.tpu.transfer.pipeline.*)."""
+    import os
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.execs.pipeline import PipelinedExec
+    depth = conf.get(cfg.TRANSFER_PIPELINE_DEPTH)
+    if not conf.get(cfg.TRANSFER_PIPELINE_ENABLED) or depth <= 0:
+        return plan
+    if conf.get(cfg.MESH_ENABLED):
+        return plan     # mesh_rewrite pattern-matches exec types below it
+    if (os.cpu_count() or 1) < 2:
+        # the producer thread needs a spare core — same measured tradeoff
+        # as the parquet decode-ahead guard (io/parquet.py)
+        return plan
+
+    def is_source(node: PhysicalExec) -> bool:
+        return node.is_device and (
+            isinstance(node, te.HostToDeviceExec)
+            or getattr(node, "is_file_scan", False))
+
+    def fix(node: PhysicalExec) -> PhysicalExec:
+        if not node.is_device or isinstance(node, PipelinedExec):
+            return node
+        new_children = [PipelinedExec(c, depth) if is_source(c) else c
+                        for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            return node
+        return node.with_children(new_children)
 
     return plan.transform_up(fix)
 

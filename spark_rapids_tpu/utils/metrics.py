@@ -37,6 +37,23 @@ SHUFFLE_METRIC_NAMES = (
     SHUFFLE_CONNECT_RETRIES, SHUFFLE_CHECKSUM_FAILURES,
     SHUFFLE_PEER_EVICTIONS)
 
+# Host-link transfer counters (bufferTime/gpuDecodeTime observability role,
+# process-global like the link itself: uploads happen inside
+# DeviceBatch.from_arrow / the chunked pipeline, far from any operator's
+# MetricSet). session.last_metrics exposes the per-action delta plus the
+# derived link GB/s.
+TRANSFER_UPLOAD_BYTES = "transfer.upload_bytes"
+TRANSFER_UPLOAD_SECONDS = "transfer.upload_seconds"
+TRANSFER_UPLOAD_CHUNKS = "transfer.upload_chunks"
+TRANSFER_DOWNLOAD_BYTES = "transfer.download_bytes"
+TRANSFER_DOWNLOAD_SECONDS = "transfer.download_seconds"
+TRANSFER_INFLIGHT_PEAK = "transfer.inflight_peak"
+
+TRANSFER_METRIC_NAMES = (
+    TRANSFER_UPLOAD_BYTES, TRANSFER_UPLOAD_SECONDS, TRANSFER_UPLOAD_CHUNKS,
+    TRANSFER_DOWNLOAD_BYTES, TRANSFER_DOWNLOAD_SECONDS,
+    TRANSFER_INFLIGHT_PEAK)
+
 
 class Metric:
     __slots__ = ("name", "unit", "_value", "_lock")
@@ -62,6 +79,10 @@ class Metric:
     def set_max(self, v: int) -> None:
         with self._lock:
             self._value = max(self._value, v)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
 
     @property
     def value(self) -> int:
@@ -90,6 +111,38 @@ class MetricSet:
 
     def snapshot(self) -> Dict[str, int]:
         return {n: m.value for n, m in self._metrics.items()}
+
+
+#: process-global transfer counters (see TRANSFER_METRIC_NAMES above)
+TRANSFER_METRICS = MetricSet(*TRANSFER_METRIC_NAMES)
+
+
+def transfer_snapshot() -> Dict[str, float]:
+    """Action-start marker for ``transfer_delta``. Re-arms the in-flight
+    high-water mark so the delta reports THIS action's peak, not the
+    process-lifetime maximum."""
+    snap = TRANSFER_METRICS.snapshot()
+    TRANSFER_METRICS[TRANSFER_INFLIGHT_PEAK].reset()
+    return snap
+
+
+def transfer_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-action transfer stats: counter deltas since ``before`` plus the
+    derived link rates (upload_gb_per_sec / download_gb_per_sec)."""
+    now = TRANSFER_METRICS.snapshot()
+    out: Dict[str, float] = {}
+    for name in TRANSFER_METRIC_NAMES:
+        if name == TRANSFER_INFLIGHT_PEAK:
+            # high-water mark since the matching transfer_snapshot call
+            out[name] = now[name]
+            continue
+        out[name] = now[name] - before.get(name, 0)
+    for direction in ("upload", "download"):
+        b = out[f"transfer.{direction}_bytes"]
+        s = out[f"transfer.{direction}_seconds"]
+        out[f"transfer.{direction}_gb_per_sec"] = (
+            round(b / s / 1e9, 3) if s > 0 else 0.0)
+    return out
 
 
 class NamedRange:
