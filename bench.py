@@ -146,6 +146,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
         "chunked upload changed the collect result\n"
         f"single: {res_single.to_pydict()}\nchunked: {res_chunk.to_pydict()}")
 
+    # ---- compressed columnar path: encoded vs decoded link bytes ------------
+    compression = _bench_compression(table, conf)
+
     # ---- columnar shuffle partition rate (GB/s/chip) ------------------------
     shuffle_gbps = _bench_shuffle(batch, iters)
     exchange_gbps = _bench_full_exchange(batch, conf, iters)
@@ -184,6 +187,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
                 "end_to_end_cold_collect_single_shot_s":
                     round(cold_single_s, 4),
             },
+            "compression": compression,
             "end_to_end_collect_s": round(e2e_s, 4),
             "end_to_end_rows_per_sec": round(n_rows / e2e_s),
             "cpu_engine_s": round(cpu_time, 3),
@@ -201,6 +205,69 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
             # by the executor core count for a like-for-like estimate.
             "baseline": "in-repo numpy engine, 1 host core",
         },
+    }
+
+
+def _bench_compression(table, conf: dict) -> dict:
+    """Compressed columnar data path on a COLD parquet Q1 (scan cache off,
+    every run pays its upload): H2D link bytes with the encoded path
+    (dictionary indices + RLE runs shipped, decode/expansion in HBM,
+    encoded-domain operators) vs the decoded path, with bit-identical
+    collected results. ``link_bytes_decoded / link_bytes_encoded`` is the
+    link-byte reduction the encoded path buys — it multiplies directly with
+    the transfer pipeline's overlap (docs/compressed-data-path.md)."""
+    import shutil
+    import tempfile
+    import os as _os
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import q1
+    from spark_rapids_tpu.utils import metrics as um
+
+    tmp = tempfile.mkdtemp(prefix="bench-comp-")
+    path = _os.path.join(tmp, "lineitem.parquet")
+    pq.write_table(table, path, row_group_size=max(1, table.num_rows // 4))
+    base = {**conf, "spark.rapids.tpu.sql.scanCache.enabled": "false"}
+
+    def run(extra: dict):
+        sess = TpuSession({**base, **extra})
+        df = q1(sess.read.parquet(path))
+        df.collect()                         # warm programs; timed run next
+        before = um.transfer_snapshot()
+        t0 = time.perf_counter()
+        out = df.collect()
+        wall = time.perf_counter() - t0
+        return out, um.transfer_delta(before), wall
+
+    out_enc, d_enc, wall_enc = run({})
+    out_dec, d_dec, wall_dec = run({
+        "spark.rapids.tpu.io.parquet.deviceDictDecode.enabled": "false",
+        "spark.rapids.tpu.sql.encodedDomain.enabled": "false"})
+    shutil.rmtree(tmp, ignore_errors=True)
+    # Q1 output is sorted by its grouping keys, so strict table equality is
+    # the bit-identity bar: the encoded path must change NOTHING
+    assert out_enc.equals(out_dec), (
+        "encoded path changed Q1 results\n"
+        f"encoded: {out_enc.to_pydict()}\ndecoded: {out_dec.to_pydict()}")
+    enc_b = d_enc["transfer.encoded_bytes"]
+    dec_b = d_dec["transfer.encoded_bytes"]    # decoded run ships plain
+    up_s = d_enc["transfer.upload_seconds"]
+    return {
+        "link_bytes_encoded": int(enc_b),
+        "link_bytes_decoded": int(dec_b),
+        # < 1.0 = the encoded path shipped fewer bytes; the acceptance bar
+        # on lineitem (dictionary + RLE columns) is <= 0.5 (>= 2x cut)
+        "link_bytes_ratio": round(enc_b / dec_b, 4) if dec_b else 1.0,
+        "link_reduction_x": round(dec_b / enc_b, 2) if enc_b else 0.0,
+        "compression_ratio": d_enc["transfer.compression_ratio"],
+        # decoded-equivalent bytes delivered per second of upload wall: the
+        # effective link bandwidth the encoding buys
+        "effective_gb_per_sec": (round(
+            d_enc["transfer.decoded_equivalent_bytes"] / up_s / 1e9, 3)
+            if up_s > 0 else 0.0),
+        "encoded_domain_ops": int(d_enc["transfer.encoded_domain_ops"]),
+        "cold_collect_encoded_s": round(wall_enc, 4),
+        "cold_collect_decoded_s": round(wall_dec, 4),
     }
 
 
@@ -345,12 +412,14 @@ def _bench_tpch_cold(scale: float, iters: int) -> dict:
     piped = cold_run(2)
     import shutil
     shutil.rmtree(tmp, ignore_errors=True)
+    compression = _bench_compression(table, base)
     return {"metric": "tpch_q1_cold_scan_seconds", "value": round(piped, 3),
             "unit": "s", "vs_baseline": round(serial / piped, 3),
             "breakdown": {"rows": table.num_rows,
                           "serial_s": round(serial, 3),
                           "pipelined_s": round(piped, 3),
-                          "speedup": round(serial / piped, 3)}}
+                          "speedup": round(serial / piped, 3),
+                          "compression": compression}}
 
 
 def _bench_tpcxbb(scale: float, qname: str, iters: int) -> dict:

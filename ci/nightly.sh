@@ -17,7 +17,13 @@ python -m pytest tests/ -q
 echo "== shuffle fault injection (deterministic chaos, fixed seed) =="
 python -m pytest tests/test_shuffle_faults.py -q
 
-echo "== bench smoke (transfer-pipeline breakdown keys, cpu backend) =="
+echo "== shuffle fault injection over lz4-compressed payloads =="
+# same chaos matrix with every payload lz4-compressed: corrupt-frame
+# recovery (checksum over the on-wire bytes -> retry) is exercised on
+# compressed frames, not just copy-codec ones
+SHUFFLE_FAULTS_CODEC=lz4 python -m pytest tests/test_shuffle_faults.py -q
+
+echo "== bench smoke (transfer-pipeline + compression breakdown, cpu backend) =="
 BENCH_ITERS=1 BENCH_SCALE=0.05 python bench.py | tail -n 1 > /tmp/bench_smoke.json
 python - /tmp/bench_smoke.json <<'PY'
 import json, sys
@@ -28,9 +34,16 @@ for key in ("chunk_rows", "upload_chunked_s", "per_chunk_upload_s",
             "end_to_end_cold_collect_s"):
     assert key in pipe, f"missing pipeline breakdown key {key}: {pipe}"
 assert pipe["upload_overlap_efficiency"] > 0, pipe
+comp = out["breakdown"]["compression"]
+for key in ("link_bytes_encoded", "link_bytes_decoded", "link_bytes_ratio",
+            "effective_gb_per_sec", "encoded_domain_ops"):
+    assert key in comp, f"missing compression breakdown key {key}: {comp}"
+assert comp["link_bytes_ratio"] < 1.0, comp
+assert comp["encoded_domain_ops"] >= 1, comp
 print("bench smoke OK:", {k: pipe[k] for k in
                           ("upload_chunked_s", "upload_overlap_efficiency",
-                           "inflight_high_water")})
+                           "inflight_high_water")},
+      {k: comp[k] for k in ("link_bytes_ratio", "encoded_domain_ops")})
 PY
 
 if [ "${RUN_TPU_BENCH:-0}" = "1" ]; then

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.column import DeviceColumn, null_column
 from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+from spark_rapids_tpu.utils import metrics as um
 
 DEFAULT_STRING_MAX_BYTES = 256
 
@@ -70,11 +71,20 @@ class DeviceBatch:
                    bucketed: bool = True, device: Any = None) -> "DeviceBatch":
         """Host arrow table -> device batch (single upload per buffer).
 
-        Columns arriving as pa.DictionaryArray (the parquet page reader
-        keeps the file's own dictionary encoding, io/parquet_pages.py) ship
-        as narrow indices + dictionary and decode ON DEVICE with a gather.
+        Encoded columns never decode on host:
+
+        - pa.DictionaryArray (the parquet page reader keeps the file's own
+          dictionary encoding, io/parquet_pages.py) ships as narrow indices
+          + the small dictionary and decodes ON DEVICE with a gather; the
+          encoded form is RETAINED on the column (DeviceColumn.encoding) so
+          downstream operators can work on the index domain.
+        - pa.RunEndEncodedArray (RLE-dominant parquet chunks) ships as
+          (run_ends, per-run values) and expands in HBM with a searchsorted
+          gather (columnar/encoding.expand_ree_device).
+
         (Host-side re-encoding of plain columns was tried and cut: on the
         1-core bench rig np.unique staging cost exceeds the link saving.)"""
+        from spark_rapids_tpu.columnar import encoding as ce
         table = table.combine_chunks()
         schema = Schema.from_pa(table.schema)
         n = table.num_rows
@@ -84,12 +94,40 @@ class DeviceBatch:
         # round trip). Capacity padding and the validity masks of null-free
         # columns are built on device — no reason to move zeros over the link.
         staged = []
-        encoded = {}     # column index -> staged dictionary values (+bits)
+        encoded = {}     # column index -> "string" | "fixed" | "ree"
+        enc_meta = {}    # column index -> (token, unique) for dict columns
+        enc_bytes = 0    # bytes actually staged for the link
+        dec_bytes = 0    # bytes the decoded forms would have staged
+
+        def _nb(*arrs) -> int:
+            return sum(a.nbytes for a in arrs if a is not None)
+
         for i, f in enumerate(schema):
             arr = table.column(i).combine_chunks()
             if isinstance(arr, pa.ChunkedArray):
                 arr = (arr.chunk(0) if arr.num_chunks == 1
                        else pa.concat_arrays(arr.chunks))
+            if (isinstance(arr, pa.Array)
+                    and pa.types.is_run_end_encoded(arr.type)):
+                ends, vals = ce.ree_staged(arr)
+                if len(ends) == 0 or f.dtype is DType.STRING:
+                    # empty slice / string REE (never produced by the scan):
+                    # host-decode and take the plain path below
+                    arr = ce.ree_to_plain(arr)
+                else:
+                    rvalid = (None if vals.null_count == 0
+                              else _arrow_validity(vals))
+                    vd, _, _ = _arrow_to_staged(f.dtype, vals,
+                                                string_max_bytes)
+                    vbits = (vd.view(np.uint64)
+                             if f.dtype is DType.DOUBLE else None)
+                    encoded[i] = "ree"
+                    staged.append((ends, rvalid, vd, vbits))
+                    enc_bytes += _nb(ends, rvalid, vd, vbits)
+                    dec_bytes += (n * vd.dtype.itemsize
+                                  + (n * 8 if vbits is not None else 0)
+                                  + _nb(rvalid))
+                    continue
             if (isinstance(arr, pa.DictionaryArray)
                     and len(arr.dictionary) > 0):
                 # device-side decode (GpuParquetScan.scala:576 analog for
@@ -109,6 +147,9 @@ class DeviceBatch:
                         arr.dictionary.cast(pa.string()), string_max_bytes)
                     encoded[i] = "string"
                     staged.append((np_idx, validity, dmat, dlen))
+                    enc_bytes += _nb(np_idx, validity, dmat, dlen)
+                    dec_bytes += (n * dmat.shape[1] + n * 4 + _nb(validity))
+                    unique = ce.dictionary_is_unique(dmat, dlen)
                 else:
                     dd, _, _ = _arrow_to_staged(f.dtype, arr.dictionary,
                                                 string_max_bytes)
@@ -116,6 +157,12 @@ class DeviceBatch:
                              else None)
                     encoded[i] = "fixed"
                     staged.append((np_idx, validity, dd, dbits))
+                    enc_bytes += _nb(np_idx, validity, dd, dbits)
+                    dec_bytes += (n * dd.dtype.itemsize
+                                  + (n * 8 if dbits is not None else 0)
+                                  + _nb(validity))
+                    unique = ce.dictionary_is_unique(dd)
+                enc_meta[i] = (ce.field_token(table.schema, i), unique)
                 continue
             if isinstance(arr, pa.DictionaryArray):
                 arr = arr.cast(arr.type.value_type)   # empty dict
@@ -126,6 +173,12 @@ class DeviceBatch:
             # the shuffle kernel's byte packing needs the host-made sibling
             bits = d.view(np.uint64) if f.dtype is DType.DOUBLE else None
             staged.append((d, v, l, bits))
+            plain = _nb(d, v, l, bits)
+            enc_bytes += plain
+            dec_bytes += plain
+        m = um.TRANSFER_METRICS
+        m[um.TRANSFER_ENCODED_BYTES].add(enc_bytes)
+        m[um.TRANSFER_DECODED_EQUIV_BYTES].add(dec_bytes)
         up = (jax.device_put(staged, device) if device is not None
               else jax.device_put(staged))
         # shared all-valid mask, on the same device as the data
@@ -135,7 +188,19 @@ class DeviceBatch:
         pad = cap - n
         cols = []
         for i, (f, slot) in enumerate(zip(schema, up)):
-            if i in encoded:
+            enc = None
+            if encoded.get(i) == "ree":
+                # HBM expansion of the RLE runs: searchsorted over the run
+                # ends picks each row's run, one gather per buffer. The
+                # decoded column exists ONLY on device.
+                ends, rv, vd, vbits = slot
+                d, ridx = ce.expand_ree_device(jnp, ends, vd, cap)
+                bits = (jnp.take(vbits, ridx, axis=0)
+                        if vbits is not None else None)
+                l = None
+                v = (jnp.logical_and(jnp.take(rv, ridx, axis=0), alive)
+                     if rv is not None else None)
+            elif i in encoded:
                 # padded gather: index padding rows point at dict slot 0;
                 # their garbage values land beyond the live prefix
                 idx, v, dd, extra = slot
@@ -147,10 +212,32 @@ class DeviceBatch:
                 if encoded[i] == "string":
                     l = jnp.take(extra, idx32, axis=0)
                     bits = None
+                    enc_lengths = extra
                 else:
                     bits = (jnp.take(extra, idx32, axis=0)
                             if extra is not None else None)
                     l = None
+                    enc_lengths = None
+                token, unique = enc_meta[i]
+                if unique:
+                    # the retained encoding pads its dictionary to a
+                    # power-of-two bucket ON DEVICE (zero link bytes): the
+                    # padded size is the jit-key shape, so per-row-group
+                    # dictionary growth doesn't recompile encoded-domain
+                    # programs
+                    k_real = int(dd.shape[0])
+                    dpad = ce.dict_bucket(k_real) - k_real
+                    dd_enc, len_enc = dd, enc_lengths
+                    if dpad:
+                        dd_enc = jnp.concatenate(
+                            [dd, jnp.zeros((dpad,) + dd.shape[1:],
+                                           dd.dtype)], axis=0)
+                        if enc_lengths is not None:
+                            len_enc = jnp.concatenate(
+                                [enc_lengths,
+                                 jnp.zeros(dpad, enc_lengths.dtype)], axis=0)
+                    enc = ce.DictEncoding(idx32, dd_enc, k_real, len_enc,
+                                          token)
             else:
                 d, v, l, bits = slot
                 if pad:
@@ -165,10 +252,11 @@ class DeviceBatch:
                             [bits, jnp.zeros(pad, bits.dtype)], axis=0)
             if v is not None:
                 validity = (jnp.concatenate([v, jnp.zeros(pad, jnp.bool_)])
-                            if pad else v)
+                            if pad and v.shape[0] != cap else v)
             else:
                 validity = alive
-            cols.append(DeviceColumn(f.dtype, d, validity, l, bits))
+            cols.append(DeviceColumn(f.dtype, d, validity, l, bits,
+                                     encoding=enc))
         return DeviceBatch(schema, tuple(cols), n)
 
     def sliced_buffers(self) -> List[Tuple]:

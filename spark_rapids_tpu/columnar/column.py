@@ -42,6 +42,13 @@ class DeviceColumn:
     #: carried alongside; device-computed doubles instead ride an exact
     #: three-float32 expansion (shuffle/partition_kernel.py).
     bits: Optional[jax.Array] = None
+    #: columns that arrived dictionary-encoded keep their narrow index
+    #: vector + small dictionary on device (columnar/encoding.DictEncoding)
+    #: so filters/group-by/join keys can run on the index domain instead of
+    #: the decoded values (exprs/encoded.py); invariant:
+    #: data == take(encoding.values, encoding.indices) row-wise. Kernels
+    #: that rebuild columns drop it (their output is no longer the gather).
+    encoding: Optional["DictEncoding"] = None  # noqa: F821
 
     @property
     def capacity(self) -> int:

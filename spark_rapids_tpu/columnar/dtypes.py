@@ -77,6 +77,10 @@ class DType(enum.Enum):
             # dictionary-encoded column: the logical type is the value type
             # (the encoding is an upload/transport detail, decoded on device)
             return DType.from_pa(t.value_type)
+        if pa.types.is_run_end_encoded(t):
+            # run-end-encoded column (RLE-dominant parquet chunks): ships as
+            # (run_ends, values) and expands in HBM (columnar/encoding.py)
+            return DType.from_pa(t.value_type)
         raise TypeError(f"unsupported arrow type {t} (reference also gates types at "
                         f"GpuOverrides.isSupportedType)")
 

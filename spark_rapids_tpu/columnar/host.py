@@ -64,6 +64,11 @@ class HostBatch:
                 # host layout has no dictionary form; device-side dict
                 # decode is DeviceBatch.from_arrow's job
                 arr = arr.cast(arr.type.value_type)
+            if pa.types.is_run_end_encoded(arr.type):
+                # host layout has no run-length form either; device-side
+                # expansion is DeviceBatch.from_arrow's job
+                from spark_rapids_tpu.columnar.encoding import ree_to_plain
+                arr = ree_to_plain(arr)
             validity = _arrow_validity(arr)
             if f.dtype is DType.STRING:
                 mat, lengths = _strings_to_matrix(arr, string_max_bytes)

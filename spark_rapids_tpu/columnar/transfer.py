@@ -47,6 +47,11 @@ def _batch_arrays(batch: DeviceBatch) -> List[Any]:
             arrs.append(c.lengths)
         if c.bits is not None:
             arrs.append(c.bits)
+        if c.encoding is not None:
+            arrs.append(c.encoding.indices)
+            arrs.append(c.encoding.values)
+            if c.encoding.lengths is not None:
+                arrs.append(c.encoding.lengths)
     return arrs
 
 

@@ -212,6 +212,25 @@ PARQUET_DEVICE_DICT = _conf(
     "the GpuParquetScan.scala:576 device-decode role for the dictionary "
     "encoding). Strings stay host-decoded.")
 
+PARQUET_DEVICE_RLE = _conf(
+    "io.parquet.deviceRleExpand.enabled", bool, True,
+    "TPU parquet scans keep RLE-dominant dictionary-encoded column chunks "
+    "as (run-ends, run-values) pairs across the host link and expand them "
+    "in HBM with a jitted searchsorted gather — often hundreds of bytes on "
+    "the wire for millions of rows. Chunks whose index stream is mostly "
+    "bit-packed ship as dictionary indices instead; requires "
+    "deviceDictDecode.")
+
+ENCODED_DOMAIN = _conf(
+    "sql.encodedDomain.enabled", bool, True,
+    "Run filters, group-by keys and equi-join keys directly on dictionary "
+    "INDICES when a column's encoded form survived upload "
+    "(DeviceColumn.encoding): predicates evaluate over the k dictionary "
+    "values and gather per row, grouping hashes narrow int32 keys instead "
+    "of wide string byte-matrices, and joins match on remapped indices — "
+    "key values materialize only for the surviving groups (late "
+    "materialization).")
+
 SCAN_PREFETCH_BATCHES = _conf(
     "io.scan.prefetchBatches", int, 2,
     "Device parquet scans decode and upload this many chunks ahead of the "
@@ -398,8 +417,18 @@ SHUFFLE_BOUNCE_BUFFER_COUNT = _conf(
 SHUFFLE_COMPRESSION_CODEC = _conf(
     "shuffle.compression.codec", str, "none",
     "Codec for shuffle batches: none, copy (memcpy pseudo-codec for testing), "
-    "zlib, zstd (fastest real codec; the right choice for network-bound DCN "
-    "shuffles) — analog of spark.rapids.shuffle.compression.codec.")
+    "lz4 (always available; the fast default for network-bound shuffles), "
+    "zlib, zstd (needs the zstandard package) — analog of "
+    "spark.rapids.shuffle.compression.codec. A peer that lacks the "
+    "requested codec negotiates the transfer down to copy (TableMeta.codec "
+    "carries the codec actually applied).")
+
+SHUFFLE_ZLIB_LEVEL = _conf(
+    "shuffle.compression.zlib.level", int, 1,
+    "zlib compression level (0-9) for shuffle batches when "
+    "shuffle.compression.codec=zlib; 1 favors speed, 9 ratio.",
+    checker=lambda v: (None if 0 <= v <= 9
+                       else f"zlib.level must be in [0, 9], got {v}"))
 
 
 SHUFFLE_MAX_RETRIES = _conf(

@@ -137,7 +137,50 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
 
     is_device = True
 
+    #: set by plan/encoded.mark_encoded_domain: equi-join key pairs whose
+    #: both sides kept their dictionary encoding match on int32 indices —
+    #: directly when the sides share a dictionary stream, via a k_l x k_r
+    #: device remap otherwise (exprs/encoded.dict_remap)
+    encoded_domain_ok = False
+
+    #: different-dictionary remaps above this k_l * k_r stay decoded (the
+    #: equality matrix would no longer be trivially small)
+    _REMAP_CELLS_CAP = 1 << 22
+
+    def _encoded_key_pairs(self, ctx: ExecContext, lb: DeviceBatch,
+                           rb: DeviceBatch):
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.columnar import encoding as cenc
+        from spark_rapids_tpu.exprs import encoded as ed
+        from spark_rapids_tpu.exprs.core import BoundReference
+        if not (self.encoded_domain_ok
+                and ctx.conf.get(cfg.ENCODED_DOMAIN)):
+            return ()
+        lspecs = {s.ordinal: s for s in cenc.enc_specs_of(lb)}
+        rspecs = {s.ordinal: s for s in cenc.enc_specs_of(rb)}
+        pairs = []
+        for pos, (lk, rk) in enumerate(zip(self.left_keys,
+                                           self.right_keys)):
+            if not (isinstance(lk, BoundReference)
+                    and isinstance(rk, BoundReference)):
+                continue
+            ls, rs = lspecs.get(lk.ordinal), rspecs.get(rk.ordinal)
+            if ls is None or rs is None or ls.dtype != rs.dtype:
+                continue
+            if ls.dtype.is_floating:
+                continue      # float equality semantics stay on decoded data
+            le = lb.columns[lk.ordinal].encoding
+            re_ = rb.columns[rk.ordinal].encoding
+            same = le.token is not None and le.token == re_.token
+            if not same and ls.k * rs.k > self._REMAP_CELLS_CAP:
+                continue
+            pairs.append(ed.EncJoinKey(pos, ls, rs, same))
+        return tuple(pairs)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.columnar import encoding as cenc
+        from spark_rapids_tpu.exprs import encoded as ed
+        from spark_rapids_tpu.utils import metrics as mt
         smax = ctx.string_max_bytes
         lschema = self.children[0].output
         rschema = self.children[1].output
@@ -147,20 +190,49 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
                                    rschema, smax)
         S, B = lb.capacity, rb.capacity
 
+        enc_pairs = self._encoded_key_pairs(ctx, lb, rb)
+        l_used = tuple(p.left for p in enc_pairs)
+        r_used = tuple(p.right for p in enc_pairs)
+
         key1 = ("join_size", self.how, self.left_keys, self.right_keys,
-                lschema, rschema, S, B, smax)
+                enc_pairs, lschema, rschema, S, B, smax)
 
         def build1(how=self.how, lkeys=self.left_keys, rkeys=self.right_keys,
-                   lschema=lschema, rschema=rschema, S=S, B=B, smax=smax):
+                   lschema=lschema, rschema=rschema, S=S, B=B, smax=smax,
+                   enc_pairs=enc_pairs, l_used=l_used, r_used=r_used):
             nl = _n_flat(lschema)
+            nr = _n_flat(rschema)
 
             def fn(l_rows, r_rows, *flat):
                 l_cols = _unflatten_colvs(lschema, flat[:nl])
-                r_cols = _unflatten_colvs(rschema, flat[nl:])
+                r_cols = _unflatten_colvs(rschema, flat[nl:nl + nr])
                 l_alive = jnp.arange(S, dtype=np.int32) < l_rows
                 r_alive = jnp.arange(B, dtype=np.int32) < r_rows
                 lk = _eval_keys(jnp, l_cols, S, smax, lkeys)
                 rk = _eval_keys(jnp, r_cols, B, smax, rkeys)
+                if enc_pairs:
+                    rest = list(flat[nl + nr:])
+                    nle = sum(4 if s.is_string else 3 for s in l_used)
+                    l_enc = cenc.unflatten_encodings(jnp, l_used,
+                                                     rest[:nle])
+                    r_enc = cenc.unflatten_encodings(jnp, r_used,
+                                                     rest[nle:])
+                    for p in enc_pairs:
+                        lv = l_enc[p.left.ordinal]
+                        rv = r_enc[p.right.ordinal]
+                        l_validity = lk[p.pos].validity
+                        r_validity = rk[p.pos].validity
+                        if p.same_token:
+                            r_idx = rv.indices
+                        else:
+                            remap = ed.dict_remap(jnp, lv.values, rv.values,
+                                                  p.left.k, lv.k_real,
+                                                  rv.k_real)
+                            r_idx = jnp.take(remap, rv.indices, axis=0)
+                        from spark_rapids_tpu.columnar.dtypes import DType
+                        from spark_rapids_tpu.exprs.core import ColV
+                        lk[p.pos] = ColV(DType.INT, lv.indices, l_validity)
+                        rk[p.pos] = ColV(DType.INT, r_idx, r_validity)
                 sized = jk.join_size(jnp, lk, rk, l_alive, r_alive, how)
                 return (sized["emit_counts"], sized["emit_offsets"],
                         sized["total"], sized["border"], sized["start_b"],
@@ -169,9 +241,13 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
 
         fn1 = _cached_jit(key1, build1)
         flat_in = _flatten(lb) + _flatten(rb)
+        enc_flat = (list(cenc.flatten_encodings(lb, l_used))
+                    + list(cenc.flatten_encodings(rb, r_used)))
+        if enc_pairs:
+            mt.TRANSFER_METRICS[mt.TRANSFER_ENCODED_DOMAIN_OPS].add(1)
         (emit_counts, emit_offsets, total, border, start_b, sgid,
          matches_l) = fn1(np.int32(lb.num_rows), np.int32(rb.num_rows),
-                          *flat_in)
+                          *flat_in, *enc_flat)
         n_out = int(total)
         out_cap = bucket_capacity(n_out)
 

@@ -95,6 +95,16 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
         # a partial sibling would desynchronize from the data
         carry_bits = (f.dtype is DType.DOUBLE
                       and all(b.columns[ci].bits is not None for b in batches))
+        # the dictionary encoding survives when every contributor carries
+        # one from the SAME dictionary stream (DictionaryUnifier token):
+        # dictionaries are then prefix-compatible, so the concatenated
+        # index vector stays valid against the largest contributor's
+        # dictionary — encoded-domain operators keep working after coalesce
+        encs = [b.columns[ci].encoding for b in batches]
+        carry_enc = (all(e is not None and e.token is not None
+                         for e in encs)
+                     and len({e.token for e in encs}) == 1)
+        idx_parts = []
         for b in batches:
             c = b.columns[ci]
             datas.append(c.data[:b.num_rows])
@@ -103,6 +113,8 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
                 lens.append(c.lengths[:b.num_rows])
             if carry_bits:
                 bit_parts.append(c.bits[:b.num_rows])
+            if carry_enc:
+                idx_parts.append(c.encoding.indices[:b.num_rows])
         if f.dtype is DType.STRING:
             from spark_rapids_tpu.ops.strings import pad_width
             W = max(d.shape[-1] for d in datas)
@@ -118,14 +130,26 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
             if bits is not None:
                 bits = jnp.concatenate(
                     [bits, jnp.zeros(pad, bits.dtype)], axis=0)
+        enc = None
+        if carry_enc:
+            from spark_rapids_tpu.columnar.encoding import DictEncoding
+            indices = jnp.concatenate(idx_parts, axis=0)
+            if pad:
+                indices = jnp.concatenate(
+                    [indices, jnp.zeros(pad, indices.dtype)], axis=0)
+            big = max(encs, key=lambda e: (e.k, e.k_real))
+            enc = DictEncoding(indices, big.values, big.k_real, big.lengths,
+                               big.token)
         if f.dtype is DType.STRING:
             lengths = jnp.concatenate(lens, axis=0)
             if pad:
                 lengths = jnp.concatenate(
                     [lengths, jnp.zeros(pad, lengths.dtype)], axis=0)
-            cols.append(DeviceColumn(f.dtype, data, validity, lengths))
+            cols.append(DeviceColumn(f.dtype, data, validity, lengths,
+                                     encoding=enc))
         else:
-            cols.append(DeviceColumn(f.dtype, data, validity, bits=bits))
+            cols.append(DeviceColumn(f.dtype, data, validity, bits=bits,
+                                     encoding=enc))
     return DeviceBatch(schema, tuple(cols), total)
 
 
@@ -224,21 +248,43 @@ class TpuProjectExec(PhysicalExec):
 class TpuFilterExec(PhysicalExec):
     is_device = True
 
+    #: set by plan/encoded.mark_encoded_domain: the child chain can deliver
+    #: batches whose columns still carry their dictionary encoding, so
+    #: single-column predicates may evaluate on the k dictionary slots and
+    #: gather (exprs/encoded.py) instead of scanning n decoded rows
+    encoded_domain_ok = False
+
     def __init__(self, condition: Expression, child: PhysicalExec):
         super().__init__((child,), child.output)
         self.condition = condition
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.columnar import encoding as cenc
+        from spark_rapids_tpu.exprs import encoded as ed
+        from spark_rapids_tpu.utils import metrics as um
         schema = self.output
+        use_enc = (self.encoded_domain_ok
+                   and ctx.conf.get(cfg.ENCODED_DOMAIN))
         for batch in self.children[0].execute(ctx):
             cap = batch.capacity
-            key = ("filter", self.condition, schema, cap, ctx.string_max_bytes)
+            cond, used = self.condition, ()
+            if use_enc:
+                specs = cenc.enc_specs_of(batch)
+                if specs:
+                    cond, used = ed.rewrite_predicate(self.condition, specs)
+            key = ("filter", cond, used, schema, cap, ctx.string_max_bytes)
 
-            def build(cond=self.condition, schema=schema, cap=cap,
+            def build(cond=cond, used=used, schema=schema, cap=cap,
                       smax=ctx.string_max_bytes):
+                nflat = flat_len(schema)
+
                 def fn(num_rows, *flat):
-                    colvs = _unflatten_colvs(schema, flat)
+                    colvs = _unflatten_colvs(schema, flat[:nflat])
                     ectx = EvalCtx(jnp, colvs, cap, smax)
+                    if used:
+                        ectx.encodings = cenc.unflatten_encodings(
+                            jnp, used, flat[nflat:])
                     pred = cond.eval(ectx)
                     alive = jnp.arange(cap, dtype=np.int32) < num_rows
                     keep = jnp.logical_and(
@@ -251,7 +297,10 @@ class TpuFilterExec(PhysicalExec):
                 return fn
 
             fn = _cached_jit(key, build)
-            res = fn(np.int32(batch.num_rows), *_flatten(batch))
+            res = fn(np.int32(batch.num_rows), *_flatten(batch),
+                     *cenc.flatten_encodings(batch, used))
+            if used:
+                um.TRANSFER_METRICS[um.TRANSFER_ENCODED_DOMAIN_OPS].add(1)
             # justified sync: the engine's designed one-scalar-per-batch
             # download — the logical row count must reach the host to pick
             # the output capacity bucket (see module docstring)
@@ -269,6 +318,12 @@ class TpuHashAggregateExec(PhysicalExec):
 
     is_device = True
 
+    #: set by plan/encoded.mark_encoded_domain: grouping keys that are
+    #: plain references to encoded columns group on the int32 dictionary
+    #: indices (unlocking the sort-free one-hot path even for string keys)
+    #: and materialize decoded key values only for the surviving groups
+    encoded_domain_ok = False
+
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...], child: PhysicalExec,
                  output: Schema, pre_filter: Optional[Expression] = None):
@@ -278,6 +333,10 @@ class TpuHashAggregateExec(PhysicalExec):
         self.pre_filter = pre_filter
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.columnar import encoding as cenc
+        from spark_rapids_tpu.exprs import encoded as ed
+        from spark_rapids_tpu.utils import metrics as um
         child_batches = list(self.children[0].execute(ctx))
         batch = concat_device_batches(child_batches, self.children[0].output,
                                       ctx.string_max_bytes)
@@ -285,13 +344,34 @@ class TpuHashAggregateExec(PhysicalExec):
         schema = self.children[0].output
         fns = tuple(a.c if isinstance(a, Alias) else a for a in self.aggregates)
 
+        grouping, pre_filter = self.grouping, self.pre_filter
+        subs: Dict[int, "cenc.EncSpec"] = {}
+        used: Tuple = ()
+        if self.encoded_domain_ok and ctx.conf.get(cfg.ENCODED_DOMAIN):
+            specs = cenc.enc_specs_of(batch)
+            if specs:
+                grouping, subs, used_g = ed.rewrite_grouping(self.grouping,
+                                                             specs)
+                used_p: Tuple = ()
+                if pre_filter is not None:
+                    pre_filter, used_p = ed.rewrite_predicate(pre_filter,
+                                                              specs)
+                merged = {s.ordinal: s for s in tuple(used_g) + tuple(used_p)}
+                used = tuple(sorted(merged.values(),
+                                    key=lambda s: s.ordinal))
+
         def build(mode):
-            def make(keys_=self.grouping, fns=fns, schema=schema, cap=cap,
+            def make(keys_=grouping, fns=fns, schema=schema, cap=cap,
                      smax=ctx.string_max_bytes, mode=mode,
-                     pre=self.pre_filter):
+                     pre=pre_filter, used=used, subs=tuple(subs.items())):
+                nflat = flat_len(schema)
+
                 def fn(num_rows, *flat):
-                    colvs = _unflatten_colvs(schema, flat)
+                    colvs = _unflatten_colvs(schema, flat[:nflat])
                     ectx = EvalCtx(jnp, colvs, cap, smax)
+                    if used:
+                        ectx.encodings = cenc.unflatten_encodings(
+                            jnp, used, flat[nflat:])
                     mask = None
                     if pre is not None:
                         p = pre.eval(ectx)
@@ -302,6 +382,12 @@ class TpuHashAggregateExec(PhysicalExec):
                                           cap, grouping=mode,
                                           extra_mask=mask)
                     key_cols, res_cols, num_groups = res[:3]
+                    key_cols = list(key_cols)
+                    for j, spec in subs:
+                        # late materialization: only the surviving groups'
+                        # key values decode (k-bounded gather)
+                        key_cols[j] = ed.materialize_key(ectx, spec,
+                                                         key_cols[j])
                     tail = ((num_groups, res[3]) if mode in ("hash", "onehot")
                             else (num_groups,))
                     return tuple(_flatten_colvs(
@@ -313,14 +399,17 @@ class TpuHashAggregateExec(PhysicalExec):
         # count, exact overflow/collision flag), then hash-ordered grouping
         # (one variadic sort), then the exact lexsort — each escalation only
         # on a flagged run
-        key = ("agg", self.grouping, fns, self.pre_filter, schema, cap,
+        key = ("agg", grouping, fns, pre_filter, used, schema, cap,
                ctx.string_max_bytes)
         from spark_rapids_tpu.ops.aggregate import grouping_modes
-        modes = grouping_modes(self.grouping, fns)
+        modes = grouping_modes(grouping, fns)
+        enc_flat = cenc.flatten_encodings(batch, used)
+        if used:
+            um.TRANSFER_METRICS[um.TRANSFER_ENCODED_DOMAIN_OPS].add(1)
         res = None
         for mode in modes:
             fn = _cached_jit(key + (mode,), build(mode))
-            res = fn(np.int32(batch.num_rows), *_flatten(batch))
+            res = fn(np.int32(batch.num_rows), *_flatten(batch), *enc_flat)
             # justified sync: the escalation flag must be read on host to
             # decide whether the faster grouping's result is exact or the
             # next mode runs — one scalar per attempted mode, not per batch

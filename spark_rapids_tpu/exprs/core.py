@@ -59,6 +59,12 @@ class EvalCtx:
         self.columns = list(columns)
         self.capacity = capacity
         self.string_max_bytes = string_max_bytes
+        #: ordinal -> columnar.encoding.EncView for input columns whose
+        #: dictionary encoding survived upload; encoded-domain expressions
+        #: (exprs/encoded.py) evaluate against these instead of the decoded
+        #: columns. Populated only by execs that flatten encodings through
+        #: their jit boundary.
+        self.encodings = {}
 
     @property
     def is_tracing(self) -> bool:

@@ -1,0 +1,269 @@
+"""Encoded-domain expressions: evaluate on dictionary INDICES, not values.
+
+The compressed columnar path (columnar/encoding.py) keeps each uploaded
+column's dictionary encoding on device. These expression nodes exploit it —
+the late-materialization piece of ROADMAP item 1, following "GPU
+Acceleration of SQL Analytics on Compressed Data" (PAPERS.md): operators
+that only need value EQUALITY or a per-distinct-value verdict run over the
+k dictionary slots (or the int32 index vector) instead of the n decoded
+rows, and the decoded values materialize only where an operator truly needs
+them.
+
+- ``DictDomainGather``: a row-wise boolean predicate over ONE encoded
+  column evaluates once per dictionary slot (k rows), then a single gather
+  broadcasts the verdict to all n rows. For string predicates this replaces
+  n x width byte comparisons with k x width plus an int gather.
+- ``EncodedKeyRef``: group-by / join keys read the index vector as an int32
+  column. Distinct indices <=> distinct values (dictionary uniqueness is
+  checked at upload), so grouping and equi-join semantics are preserved —
+  and int keys unlock the sort-free one-hot aggregation path that string
+  keys cannot take.
+- ``materialize_key``: after aggregation, the surviving group keys (one row
+  per GROUP, not per input row) gather their decoded values back — the
+  deferred materialization.
+
+Planner/exec wiring lives in plan/encoded.py and execs/tpu_execs.py /
+execs/join_execs.py; tpu-lint's R001/R002 apply to these code paths like
+any other (EncSpec is part of every jit cache key, and nothing here syncs).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.columnar.encoding import EncSpec, EncView
+from spark_rapids_tpu.exprs.core import (BoundReference, ColV, EvalCtx,
+                                         Expression)
+
+
+@dataclass(frozen=True)
+class DictDomainGather(Expression):
+    """Evaluate ``pred`` (bound to ordinal 0 of a one-column dictionary
+    schema) over the k dictionary values of input column ``ordinal``, then
+    gather the per-slot verdict through the index vector. ``k`` is static
+    (part of the jit cache key via this node's equality)."""
+
+    pred: Expression
+    ordinal: int
+    k: int
+
+    def dtype(self) -> DType:
+        return DType.BOOLEAN
+
+    def nullable(self) -> bool:
+        return True
+
+    def sql_name(self) -> str:
+        return f"DictDomain({self.pred.sql_name()})"
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        xp = ctx.xp
+        enc: EncView = ctx.encodings[self.ordinal]
+        sub = EvalCtx(xp, [enc.values], self.k, ctx.string_max_bytes)
+        # scalar context attrs (partition_id etc.) carry over
+        for a in ("partition_id",):
+            if hasattr(ctx, a):
+                setattr(sub, a, getattr(ctx, a))
+        pv = self.pred.eval(sub)
+        data = xp.broadcast_to(pv.data, (self.k,))
+        valid = xp.broadcast_to(pv.validity, (self.k,))
+        col_valid = ctx.columns[self.ordinal].validity
+        return ColV(DType.BOOLEAN, xp.take(data, enc.indices, axis=0),
+                    xp.logical_and(xp.take(valid, enc.indices, axis=0),
+                                   col_valid))
+
+
+@dataclass(frozen=True)
+class EncodedKeyRef(Expression):
+    """The dictionary-index vector of input column ``ordinal`` as an int32
+    key column. Validity is the column's own (null rows stay null keys)."""
+
+    ordinal: int
+    k: int
+    ref_dtype: DType                 # the ORIGINAL value dtype (for explain)
+    ref_name: str = ""
+
+    def dtype(self) -> DType:
+        return DType.INT
+
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name_hint(self) -> str:
+        return self.ref_name or f"c{self.ordinal}"
+
+    def eval(self, ctx: EvalCtx) -> ColV:
+        enc: EncView = ctx.encodings[self.ordinal]
+        return ColV(DType.INT, enc.indices,
+                    ctx.columns[self.ordinal].validity)
+
+
+def materialize_key(ctx: EvalCtx, spec: EncSpec, key: ColV) -> ColV:
+    """Late materialization: turn a reduced index-key column (one row per
+    group) back into its decoded values with a k-bounded gather."""
+    xp = ctx.xp
+    enc: EncView = ctx.encodings[spec.ordinal]
+    idx = xp.clip(key.data.astype(np.int32), 0, spec.k - 1)
+    data = xp.take(enc.values.data, idx, axis=0)
+    lengths = (xp.take(enc.values.lengths, idx, axis=0)
+               if enc.values.lengths is not None else None)
+    return ColV(spec.dtype, data, key.validity, lengths)
+
+
+@dataclass(frozen=True)
+class EncJoinKey:
+    """One equi-join key pair that matches on the index domain. With
+    ``same_token`` the two sides share a prefix-compatible dictionary and
+    indices compare directly; otherwise the right dictionary remaps into
+    the left one on device (k_l x k_r work — tiny next to n)."""
+    pos: int
+    left: EncSpec
+    right: EncSpec
+    same_token: bool
+
+
+def dict_remap(xp, lvals: ColV, rvals: ColV, k_left: int,
+               l_k_real, r_k_real):
+    """int32[k_right] mapping each right-dictionary slot to the left slot
+    holding the same value, or the sentinel ``k_left`` when the value does
+    not occur on the left (the sentinel equals no left index, so those rows
+    simply never match — exactly the decoded join's behavior).
+
+    One k_l x k_r equality matrix (tiny next to n; callers cap the cell
+    count). ``l_k_real``/``r_k_real`` are traced live counts masking the
+    PADDING slots of the bucketed dictionaries — a pad zero must never
+    claim a real value's match."""
+    kl, kr = lvals.data.shape[0], rvals.data.shape[0]
+    live = xp.logical_and(
+        (xp.arange(kl, dtype=np.int32) < l_k_real)[:, None],
+        (xp.arange(kr, dtype=np.int32) < r_k_real)[None, :])
+    if lvals.lengths is None:
+        eq = lvals.data[:, None] == rvals.data[None, :]
+    else:
+        from spark_rapids_tpu.ops.strings import pad_width
+        L, R = lvals.data, rvals.data
+        W = max(L.shape[1], R.shape[1])
+        L, R = pad_width(xp, L, W), pad_width(xp, R, W)
+        eq = xp.logical_and(
+            (L[:, None, :] == R[None, :, :]).all(axis=-1),
+            lvals.lengths[:, None] == rvals.lengths[None, :])
+    eq = xp.logical_and(eq, live)
+    found = eq.any(axis=0)
+    return xp.where(found, xp.argmax(eq, axis=0),
+                    k_left).astype(np.int32)
+
+
+# ---------------------------------------------------------------- rewriting
+def _refs(e: Expression, out: Set[int]) -> None:
+    if isinstance(e, BoundReference):
+        out.add(e.ordinal)
+    for c in e.children:
+        _refs(c, out)
+
+
+def _domain_safe(e: Expression) -> bool:
+    """True when evaluating ``e`` once per DISTINCT dictionary value and
+    gathering the verdict is equivalent to per-row evaluation.
+
+    The gather sees only VALID dictionary values and then forces null rows
+    to a null verdict (validity AND), so the rewrite is sound exactly for
+    expressions with ``f(NULL) is NULL`` null propagation and no positional
+    state. That is enforced by WHITELIST, not blacklist:
+
+    - Literal / BoundReference leaves;
+    - nodes that inherit the Unary/BinaryExpression base ``eval`` (those
+      bases ARE the null-intolerant convention — a subclass overriding
+      eval, like EqualNullSafe's null-safe equality or NaNvl, is excluded
+      automatically);
+    - And / Or / Not / In / InSet, whose explicit three-valued logic still
+      yields a null verdict for a null input within a single-column
+      subtree (verified case by case — e.g. Kleene AND of two verdicts of
+      the SAME null row is null on both paths).
+
+    Everything else (IsNull/Coalesce/If/CaseWhen produce non-null results
+    from null inputs; Rand and ids have positional state; aggregates and
+    windows are not row-wise) stays on the decoded path."""
+    from spark_rapids_tpu.exprs import predicates as pr
+    from spark_rapids_tpu.exprs.core import (BinaryExpression,
+                                             UnaryExpression)
+    from spark_rapids_tpu.exprs.literals import Literal
+    if isinstance(e, (Literal, BoundReference)):
+        return True
+    ok = False
+    if isinstance(e, (pr.And, pr.Or, pr.Not, pr.In, pr.InSet)):
+        ok = True
+    elif isinstance(e, (UnaryExpression, BinaryExpression)):
+        ok = type(e).eval in (UnaryExpression.eval, BinaryExpression.eval)
+    return ok and all(_domain_safe(c) for c in e.children)
+
+
+def _rebind_to_slot0(e: Expression, ordinal: int) -> Expression:
+    if isinstance(e, BoundReference):
+        assert e.ordinal == ordinal
+        return BoundReference(0, e.ref_dtype, e.ref_nullable, e.ref_name)
+    return e.map_children(lambda c: _rebind_to_slot0(c, ordinal))
+
+
+def rewrite_predicate(cond: Expression, specs: Sequence[EncSpec]
+                      ) -> Tuple[Expression, Tuple[EncSpec, ...]]:
+    """Rewrite every maximal boolean subtree of ``cond`` that references
+    exactly one encoded column into a DictDomainGather over that column's
+    dictionary. Returns (rewritten condition, the EncSpecs actually used).
+    A condition with no eligible subtree comes back unchanged."""
+    by_ord: Dict[int, EncSpec] = {s.ordinal: s for s in specs}
+    used: Dict[int, EncSpec] = {}
+
+    def rec(e: Expression) -> Expression:
+        refs: Set[int] = set()
+        _refs(e, refs)
+        if (len(refs) == 1 and not isinstance(e, BoundReference)
+                and e.children):
+            (o,) = tuple(refs)
+            spec = by_ord.get(o)
+            if spec is not None and _domain_safe(e):
+                try:
+                    is_bool = e.dtype() is DType.BOOLEAN
+                except TypeError:
+                    is_bool = False
+                if is_bool:
+                    used[o] = spec
+                    return DictDomainGather(_rebind_to_slot0(e, o), o,
+                                            spec.k)
+        return e.map_children(rec)
+
+    out = rec(cond)
+    return out, tuple(sorted(used.values(), key=lambda s: s.ordinal))
+
+
+def rewrite_grouping(grouping: Sequence[Expression],
+                     specs: Sequence[EncSpec]
+                     ) -> Tuple[Tuple[Expression, ...],
+                                Dict[int, EncSpec],
+                                Tuple[EncSpec, ...]]:
+    """Substitute grouping keys that are plain references to encoded columns
+    with their index vectors. Returns (new grouping, {key position ->
+    EncSpec} for later materialization, EncSpecs used)."""
+    by_ord: Dict[int, EncSpec] = {s.ordinal: s for s in specs}
+    out = []
+    subs: Dict[int, EncSpec] = {}
+    used: Dict[int, EncSpec] = {}
+    for j, g in enumerate(grouping):
+        spec = (by_ord.get(g.ordinal)
+                if isinstance(g, BoundReference) else None)
+        if spec is not None and spec.dtype.is_floating:
+            # index identity is FINER than float equality (-0.0 vs 0.0 are
+            # distinct dictionary slots but equal keys): floats stay decoded
+            spec = None
+        if spec is not None:
+            out.append(EncodedKeyRef(g.ordinal, spec.k, g.ref_dtype,
+                                     g.ref_name))
+            subs[j] = spec
+            used[spec.ordinal] = spec
+        else:
+            out.append(g)
+    return (tuple(out), subs,
+            tuple(sorted(used.values(), key=lambda s: s.ordinal)))

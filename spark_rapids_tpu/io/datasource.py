@@ -149,9 +149,11 @@ def append_partition_columns(table: pa.Table, partition_schema: Schema,
 def evolve_schema(table: pa.Table, want: Schema) -> pa.Table:
     """Reorder/cast/null-fill the file's columns to the requested read schema
     (evolveSchemaIfNeededAndClose analog, GpuParquetScan.scala:520).
-    Dictionary-encoded columns whose VALUE type already matches stay
-    encoded — the device upload path decodes them with an on-device gather
-    (the point of shipping the encoded form)."""
+    Dictionary- and run-end-encoded columns whose VALUE type already matches
+    stay encoded — the device upload path decodes them with an on-device
+    gather/expansion (the point of shipping the encoded form). Field
+    metadata (the dictionary token, columnar/encoding.DICT_TOKEN_META)
+    survives for kept-encoded columns."""
     cols = []
     fields = []
     for f in want:
@@ -165,12 +167,30 @@ def evolve_schema(table: pa.Table, want: Schema) -> pa.Table:
         if pa.types.is_dictionary(col.type):
             if col.type.value_type.equals(wt):
                 cols.append(col)
-                fields.append(pa.field(f.name, col.type, f.nullable))
+                fields.append(pa.field(f.name, col.type, f.nullable,
+                                       table.schema.field(idx).metadata))
                 continue
             col = col.cast(col.type.value_type)   # value-type drift: decode
+        elif pa.types.is_run_end_encoded(col.type):
+            if col.type.value_type.equals(wt):
+                cols.append(col)
+                fields.append(pa.field(f.name, col.type, f.nullable))
+                continue
+            col = _decode_ree(col)                # value-type drift: decode
         cols.append(col.cast(wt) if not col.type.equals(wt) else col)
         fields.append(pa.field(f.name, wt, f.nullable))
     return pa.table(cols, schema=pa.schema(fields))
+
+
+def _decode_ree(col):
+    """Host-expand a run-end-encoded column (type-drift fallback only; the
+    normal path keeps REE through to the device expansion)."""
+    from spark_rapids_tpu.columnar.encoding import ree_to_plain
+    if isinstance(col, pa.ChunkedArray):
+        if col.num_chunks == 0:
+            return pa.chunked_array([], type=col.type.value_type)
+        return pa.chunked_array([ree_to_plain(c) for c in col.chunks])
+    return ree_to_plain(col)
 
 
 # ---------------------------------------------------------------- pushdown

@@ -87,7 +87,8 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
                       partition_schema: Schema,
                       filters: Sequence[Expression],
                       max_rows: int, max_bytes: int,
-                      device_dict: bool = False) -> Iterator[pa.Table]:
+                      device_dict: bool = False, device_rle: bool = False,
+                      unifier=None) -> Iterator[pa.Table]:
     pf = pq.ParquetFile(f.path)
     groups = list(clipped_groups(f.path, tuple(filters))[0])
     if not groups:
@@ -109,13 +110,16 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
     needs_rebase = file_rebase_mode(md.metadata) == "legacy"
     if device_dict and not needs_rebase:
         # fixed-width columns come straight off the PAGE BYTES as the
-        # file's own dictionary encoding (io/parquet_pages.py): narrow
-        # indices + the small dictionary cross the host link and decode
-        # with an on-device gather — the GpuParquetScan.scala:576 device-
-        # decode role. Strings (and any chunk with PLAIN-fallback pages)
-        # read through pyarrow as before.
+        # file's own encoding (io/parquet_pages.py): narrow indices + the
+        # small dictionary — or, for RLE-dominant chunks, the run form
+        # itself — cross the host link and decode with an on-device
+        # gather/expansion, the GpuParquetScan.scala:576 device-decode
+        # role. Mixed-encoding chunks keep their dictionary prefix encoded
+        # and host-decode only the PLAIN tail; strings read through
+        # pyarrow's still-encoded dictionary read.
         yield from _iter_dict_tables(pf, f, groups, want, data_schema,
-                                     partition_schema, batch_rows)
+                                     partition_schema, batch_rows,
+                                     device_rle, unifier)
         return
     for rb in pf.iter_batches(batch_size=batch_rows, row_groups=groups,
                               columns=want):
@@ -128,12 +132,27 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
 
 def _iter_dict_tables(pf: pq.ParquetFile, f: PartitionedFile,
                       groups, want, data_schema: Schema,
-                      partition_schema: Schema,
-                      batch_rows: int) -> Iterator[pa.Table]:
-    """Per-row-group read keeping fixed-width columns dictionary-encoded
-    from the raw page bytes; pyarrow reads the rest. Yields batch_rows-
-    bounded slices (dictionary arrays slice zero-copy)."""
+                      partition_schema: Schema, batch_rows: int,
+                      device_rle: bool = False,
+                      unifier=None) -> Iterator[pa.Table]:
+    """Per-row-group read keeping fixed-width columns encoded from the raw
+    page bytes (dictionary indices, or the run form for RLE-dominant
+    chunks); pyarrow reads the rest. Yields batch_rows-bounded slices
+    (dictionary and run-end-encoded arrays slice zero-copy).
+
+    Every dictionary column is remapped through the scan's
+    DictionaryUnifier so all batches of one scan share a prefix-compatible
+    dictionary identified by a token in the field metadata — that is what
+    lets concat_device_batches carry the encoding across batches and the
+    encoded-domain operators run on stable indices. Mixed-encoding chunks
+    split the row group at the dictionary-prefix/PLAIN-tail boundary:
+    prefix segments stay encoded, tail segments carry the host-decoded
+    values."""
+    from spark_rapids_tpu.columnar.encoding import (DictionaryUnifier,
+                                                    with_dict_tokens)
     from spark_rapids_tpu.io.parquet_pages import read_dict_column
+    if unifier is None:
+        unifier = DictionaryUnifier()
     md = pf.metadata
     names = list(md.schema.names)
     arrow_schema = pf.schema_arrow
@@ -150,28 +169,51 @@ def _iter_dict_tables(pf: pq.ParquetFile, f: PartitionedFile,
                 continue
             ci = names.index(f2.name)
             at = arrow_schema.field(f2.name).type
-            arr = read_dict_column(f.path, md, rg, ci, at)
-            if arr is not None:
-                encoded[f2.name] = arr
+            r = read_dict_column(f.path, md, rg, ci, at,
+                                 want_runs=device_rle)
+            if r is not None:
+                encoded[f2.name] = r
         rest = [n for n in want if n not in encoded]
         plain = (pf_str.read_row_group(rg, columns=rest) if rest else None)
-        cols, fields = [], []
         nrows = md.row_group(rg).num_rows
+        cols = {}       # name -> (prefix_or_whole, tail_or_None, split_row)
+        tokens = {}
         for n in want:
             if n in encoded:
-                a = encoded[n]
-                cols.append(a)
-                fields.append(pa.field(n, a.type))
+                r = encoded[n]
+                prefix = r.prefix
+                if isinstance(prefix, pa.DictionaryArray):
+                    prefix, tokens[n] = unifier.unify(n, prefix)
+                cols[n] = (prefix, r.tail, len(prefix))
             else:
                 c = plain.column(n)
-                cols.append(c)
-                fields.append(pa.field(n, c.type))
-        table = pa.table(cols, schema=pa.schema(fields))
-        for start in range(0, nrows, batch_rows):
-            t = table.slice(start, min(batch_rows, nrows - start))
-            t = evolve_schema(t, data_schema)
-            yield append_partition_columns(t, partition_schema,
-                                           f.partition_values)
+                if isinstance(c, pa.ChunkedArray):
+                    # combine_chunks on a ChunkedArray yields an Array
+                    # (also for the 0-chunk empty-file case)
+                    c = (c.chunk(0) if c.num_chunks == 1
+                         else c.combine_chunks())
+                if isinstance(c, pa.DictionaryArray) and len(c.dictionary):
+                    c, tokens[n] = unifier.unify(n, c)
+                cols[n] = (c, None, nrows)
+        # segment boundaries: a mixed-encoding column splits the row group
+        # where its dictionary prefix ends (only the tail is decoded)
+        bounds = sorted({0, nrows} | {sr for _, tail, sr in cols.values()
+                                      if tail is not None})
+        for s, e in zip(bounds, bounds[1:]):
+            seg_cols, fields = [], []
+            for n in want:
+                prefix, tail, split = cols[n]
+                a = (prefix.slice(s, e - s) if e <= split
+                     else tail.slice(s - split, e - s))
+                seg_cols.append(a)
+                fields.append(pa.field(n, a.type))
+            table = pa.table(seg_cols, schema=pa.schema(fields))
+            table = with_dict_tokens(table, tokens)
+            for start in range(0, e - s, batch_rows):
+                t = table.slice(start, min(batch_rows, e - s - start))
+                t = evolve_schema(t, data_schema)
+                yield append_partition_columns(t, partition_schema,
+                                               f.partition_values)
 
 
 def _rebase_legacy_datetimes(t: pa.Table) -> pa.Table:
@@ -256,14 +298,25 @@ class _ParquetScanBase(LeafExec):
     #: TPU scans flip this on (per conf) so fixed-width columns arrive
     #: dictionary-encoded and decode on device
     device_dict = False
+    #: with device_dict: keep RLE-dominant chunks as run pairs and expand
+    #: in HBM instead of shipping per-row indices
+    device_rle = False
 
     def iter_tables_for_files(self, files: Sequence[PartitionedFile]
                               ) -> Iterator[pa.Table]:
+        # ONE dictionary unifier per scan pass: every file/row group's
+        # dictionaries remap into a shared prefix-compatible dictionary per
+        # column, so batch concatenation keeps the encoded form
+        unifier = None
+        if self.device_dict:
+            from spark_rapids_tpu.columnar.encoding import DictionaryUnifier
+            unifier = DictionaryUnifier()
         for f in files:
             for t in _iter_file_tables(
                     f, self.data_schema, self.partition_schema, self.filters,
                     self.max_batch_rows, self.max_batch_bytes,
-                    device_dict=self.device_dict):
+                    device_dict=self.device_dict,
+                    device_rle=self.device_rle, unifier=unifier):
                 yield fill_file_meta(t, f, self.output)
 
     def _iter_arrow(self, ctx: ExecContext) -> Iterator[pa.Table]:
@@ -298,6 +351,8 @@ class TpuParquetScanExec(_ParquetScanBase):
         from spark_rapids_tpu import config as _cfg
         from spark_rapids_tpu.columnar.transfer import upload_table_conf
         self.device_dict = ctx.conf.get(_cfg.PARQUET_DEVICE_DICT)
+        self.device_rle = (self.device_dict
+                           and ctx.conf.get(_cfg.PARQUET_DEVICE_RLE))
         depth = ctx.conf.get(_cfg.SCAN_PREFETCH_BATCHES)
         if (_os.cpu_count() or 1) < 2:
             # decode-ahead needs a spare core: on a single-core host the

@@ -135,15 +135,90 @@ def rle_bp_decode(buf: memoryview, bit_width: int, count: int) -> np.ndarray:
     return out
 
 
+def rle_bp_runs(buf: memoryview, bit_width: int,
+                count: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Parquet RLE/bit-packed hybrid -> (run values int32, run lengths
+    int64) WITHOUT host expansion: an RLE run contributes one (value,
+    length) pair whatever its length, bit-packed groups contribute their
+    literal values with length 1. RLE-dominant streams stay tiny; callers
+    compare the run count against the row count to decide whether the runs
+    (not the expanded indices) should cross the host link
+    (columnar/encoding.expand_ree_device does the expansion in HBM)."""
+    if count == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int64)
+    if bit_width == 0:
+        return np.zeros(1, np.int32), np.array([count], np.int64)
+    vals_parts: List[np.ndarray] = []
+    len_parts: List[np.ndarray] = []
+    th = _Thrift(buf)
+    got = 0
+    byte_w = (bit_width + 7) // 8
+    while got < count:
+        header = th.varint()
+        if header & 1:                      # bit-packed groups of 8
+            n = (header >> 1) * 8
+            nbytes = n * bit_width // 8
+            raw = np.frombuffer(th.buf[th.pos:th.pos + nbytes], np.uint8)
+            th.pos += nbytes
+            vals = _unpack_bits(raw, bit_width, n)
+            take = min(n, count - got)
+            vals_parts.append(vals[:take])
+            len_parts.append(np.ones(take, np.int64))
+            got += take
+        else:                               # RLE run
+            run = header >> 1
+            raw = bytes(th.buf[th.pos:th.pos + byte_w]) + b"\0" * (4 - byte_w)
+            th.pos += byte_w
+            value = int(np.frombuffer(raw, "<u4")[0])
+            take = min(run, count - got)
+            vals_parts.append(np.array([value], np.int32))
+            len_parts.append(np.array([take], np.int64))
+            got += take
+    return (np.concatenate(vals_parts).astype(np.int32),
+            np.concatenate(len_parts))
+
+
+def merge_runs(values: np.ndarray,
+               lengths: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Coalesce adjacent equal-valued runs (page boundaries split runs;
+    bit-packed groups emit length-1 runs that often repeat). Vectorized."""
+    if len(values) < 2:
+        return values, lengths
+    starts = np.flatnonzero(
+        np.concatenate([[True], values[1:] != values[:-1]]))
+    csum = np.concatenate([[0], np.cumsum(lengths)])
+    ends = np.concatenate([starts[1:], [len(values)]])
+    return values[starts], csum[ends] - csum[starts]
+
+
 # ------------------------------------------------------------- chunk decode
 class _ChunkPages:
-    """One column chunk parsed into (validity, dictionary, indices)."""
+    """One column chunk parsed into a dictionary-encoded prefix (kept as
+    RUNS — no host expansion) plus an optional PLAIN tail (the writer's
+    mid-chunk dictionary fallback; only the tail decodes on host)."""
 
-    def __init__(self, dictionary: np.ndarray, indices: np.ndarray,
-                 validity: Optional[np.ndarray]):
+    def __init__(self, dictionary: np.ndarray,
+                 runs: Tuple[np.ndarray, np.ndarray],
+                 prefix_defs: Optional[np.ndarray], prefix_rows: int,
+                 tail_values: Optional[np.ndarray],
+                 tail_defs: Optional[np.ndarray]):
         self.dictionary = dictionary
-        self.indices = indices
-        self.validity = validity
+        self.runs = runs                  # (values, lengths) over DEFINED rows
+        self.prefix_defs = prefix_defs    # bool[prefix_rows] or None (no nulls)
+        self.prefix_rows = prefix_rows
+        self.tail_values = tail_values    # defined PLAIN values or None
+        self.tail_defs = tail_defs        # bool[tail_rows] or None
+
+    def prefix_indices(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Expand the run form to per-row indices (+validity) — the
+        dictionary-index representation when runs are not worth keeping."""
+        vals, lens = self.runs
+        idx = np.repeat(vals, lens).astype(np.int32)
+        if self.prefix_defs is None:
+            return idx, None
+        full = np.zeros(self.prefix_rows, np.int32)
+        full[self.prefix_defs] = idx
+        return full, self.prefix_defs
 
 
 def _decompress(codec: str, raw: memoryview, usize: int) -> memoryview:
@@ -156,17 +231,25 @@ def _decompress(codec: str, raw: memoryview, usize: int) -> memoryview:
 
 def decode_dict_chunk(data: memoryview, codec: str, phys: str,
                       num_values: int, max_def: int) -> Optional[_ChunkPages]:
-    """Parse one column chunk's pages. Returns None when any data page is
-    not dictionary-encoded (PLAIN fallback mid-chunk) — caller reads the
-    column through pyarrow instead."""
+    """Parse one column chunk's pages. Handles the mixed-encoding chunk
+    (dictionary-encoded prefix, PLAIN fallback tail once the dictionary
+    overflowed): the prefix stays encoded as runs, only the PLAIN tail is
+    decoded. Returns None for layouts out of scope (no dictionary page at
+    all, dictionary pages after the PLAIN fallback, nested columns) —
+    caller reads the column through pyarrow instead."""
     np_t = _PHYS_NP.get(phys)
     if np_t is None:
         return None
     pos = 0
     dictionary: Optional[np.ndarray] = None
-    idx_parts: List[np.ndarray] = []
+    run_val_parts: List[np.ndarray] = []
+    run_len_parts: List[np.ndarray] = []
     def_parts: List[np.ndarray] = []
+    tail_val_parts: List[np.ndarray] = []
+    tail_def_parts: List[np.ndarray] = []
+    prefix_rows = 0
     seen = 0
+    in_tail = False
     while seen < num_values and pos < len(data):
         th = _Thrift(data, pos)
         hdr = th.read_struct()
@@ -184,7 +267,8 @@ def decode_dict_chunk(data: memoryview, codec: str, phys: str,
         if ptype == _DATA_PAGE:
             dh = hdr.get(5, {})
             nv = dh.get(1, 0)
-            if dh.get(2) not in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+            enc = dh.get(2)
+            if enc not in (_ENC_PLAIN_DICT, _ENC_RLE_DICT, _ENC_PLAIN):
                 return None
             page = _decompress(codec, data[body:body + csize], usize)
             p = 0
@@ -195,18 +279,29 @@ def decode_dict_chunk(data: memoryview, codec: str, phys: str,
                 p += dlen
             else:
                 defs = np.ones(nv, np.int32)
-            bw = page[p]
-            p += 1
             n_def = int(defs.sum())
-            idx = rle_bp_decode(page[p:], int(bw), n_def)
-            def_parts.append(defs)
-            idx_parts.append(idx)
+            if enc == _ENC_PLAIN:
+                in_tail = True
+                tail_val_parts.append(
+                    np.frombuffer(page, np_t, count=n_def, offset=p))
+                tail_def_parts.append(defs)
+            else:
+                if in_tail:           # dict page after the PLAIN fallback:
+                    return None       # not the writer layout we model
+                bw = page[p]
+                p += 1
+                rv, rl = rle_bp_runs(page[p:], int(bw), n_def)
+                run_val_parts.append(rv)
+                run_len_parts.append(rl)
+                def_parts.append(defs)
+                prefix_rows += nv
             seen += nv
             continue
         if ptype == _DATA_PAGE_V2:
             dh = hdr.get(8, {})
             nv, n_nulls = dh.get(1, 0), dh.get(2, 0)
-            if dh.get(4) not in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+            enc = dh.get(4)
+            if enc not in (_ENC_PLAIN_DICT, _ENC_RLE_DICT, _ENC_PLAIN):
                 return None
             dlen, rlen = dh.get(5, 0), dh.get(6, 0)
             if rlen:
@@ -220,31 +315,69 @@ def decode_dict_chunk(data: memoryview, codec: str, phys: str,
                 defs = rle_bp_decode(levels, 1, nv)
             else:
                 defs = np.ones(nv, np.int32)
-            bw = vals[0]
-            idx = rle_bp_decode(vals[1:], int(bw), nv - n_nulls)
-            def_parts.append(defs)
-            idx_parts.append(idx)
+            if enc == _ENC_PLAIN:
+                in_tail = True
+                tail_val_parts.append(
+                    np.frombuffer(vals, np_t, count=nv - n_nulls))
+                tail_def_parts.append(defs)
+            else:
+                if in_tail:
+                    return None
+                bw = vals[0]
+                rv, rl = rle_bp_runs(vals[1:], int(bw), nv - n_nulls)
+                run_val_parts.append(rv)
+                run_len_parts.append(rl)
+                def_parts.append(defs)
+                prefix_rows += nv
             seen += nv
             continue
         # index pages etc.: skip
-    if dictionary is None or seen < num_values:
+    if dictionary is None or seen < num_values or prefix_rows == 0:
         return None
+    rvals, rlens = merge_runs(
+        np.concatenate(run_val_parts) if run_val_parts
+        else np.zeros(0, np.int32),
+        np.concatenate(run_len_parts) if run_len_parts
+        else np.zeros(0, np.int64))
     defs = np.concatenate(def_parts) if def_parts else np.ones(0, np.int32)
-    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int32)
-    if max_def > 0:
-        validity = defs.astype(bool)
-        full = np.zeros(num_values, np.int32)
-        full[validity] = idx
-        return _ChunkPages(dictionary, full,
-                           None if validity.all() else validity)
-    return _ChunkPages(dictionary, idx, None)
+    prefix_defs = None
+    if max_def > 0 and not bool(defs.all()):
+        prefix_defs = defs.astype(bool)
+    tail_values = tail_defs = None
+    if tail_val_parts:
+        tail_values = np.concatenate(tail_val_parts)
+        tdefs = np.concatenate(tail_def_parts)
+        tail_defs = tdefs.astype(bool) if not bool(tdefs.all()) else None
+        if tail_defs is None and len(tail_values) != num_values - prefix_rows:
+            return None                   # inconsistent counts: bail
+    return _ChunkPages(dictionary, (rvals, rlens), prefix_defs, prefix_rows,
+                       tail_values, tail_defs)
 
 
 # ------------------------------------------------------------- file surface
+class ColumnRead:
+    """One row group's column read straight from the page bytes: an encoded
+    prefix (DictionaryArray, or RunEndEncodedArray when the index stream was
+    RLE-dominant) plus an optional host-decoded PLAIN tail. ``tail`` is None
+    for the common fully-dictionary-encoded chunk."""
+
+    def __init__(self, prefix: pa.Array, tail: Optional[pa.Array] = None):
+        self.prefix = prefix
+        self.tail = tail
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.prefix) + (len(self.tail) if self.tail is not None
+                                   else 0)
+
+
 def read_dict_column(path: str, pf_metadata, rg: int, col_idx: int,
-                     arrow_type: pa.DataType) -> Optional[pa.DictionaryArray]:
-    """Read one row group's column as a DictionaryArray straight from the
-    page bytes; None when ineligible (caller falls back to pyarrow)."""
+                     arrow_type: pa.DataType,
+                     want_runs: bool = False) -> Optional[ColumnRead]:
+    """Read one row group's column from the raw page bytes, keeping the
+    file's own encoding; None when ineligible OR when no encoded form is
+    smaller than the decoded column (per-column fallback — shipping an
+    encoding that does not shrink the link is pure overhead)."""
     col = pf_metadata.row_group(rg).column(col_idx)
     sc = pf_metadata.schema.column(col_idx)
     if sc.max_repetition_level != 0 or sc.max_definition_level > 1:
@@ -257,8 +390,6 @@ def read_dict_column(path: str, pf_metadata, rg: int, col_idx: int,
         if col.compression != "UNCOMPRESSED":
             return None
     start = col.dictionary_page_offset
-    end = col.data_page_offset + col.total_compressed_size - (
-        col.data_page_offset - start)
     with open(path, "rb") as f:
         f.seek(start)
         data = memoryview(f.read(col.total_compressed_size))
@@ -270,14 +401,43 @@ def read_dict_column(path: str, pf_metadata, rg: int, col_idx: int,
     if chunk is None:
         return None
     k = len(chunk.dictionary)
-    idx_t = (pa.int8() if k <= 127 else
-             pa.int16() if k <= 0x7FFF else pa.int32())
-    mask = None if chunk.validity is None else ~chunk.validity
-    indices = pa.array(chunk.indices, type=idx_t, safe=False)
-    if mask is not None:
-        indices = pa.array(chunk.indices.astype(
-            idx_t.to_pandas_dtype()), mask=mask)
+    elem = chunk.dictionary.dtype.itemsize
+    n_prefix = chunk.prefix_rows
+    idx_w = 1 if k <= 127 else 2 if k <= 0x7FFF else 4
+    dict_bytes = n_prefix * idx_w + k * elem
+    rvals, rlens = chunk.runs
+    ree_bytes = len(rvals) * (4 + elem)
+    decoded_bytes = n_prefix * elem
+    if min(dict_bytes, ree_bytes) >= decoded_bytes:
+        return None         # no encoding survives: decoded upload is smaller
     dict_vals = pa.array(chunk.dictionary)
     if not dict_vals.type.equals(arrow_type):
         dict_vals = dict_vals.cast(arrow_type)
-    return pa.DictionaryArray.from_arrays(indices, dict_vals)
+    if want_runs and ree_bytes < dict_bytes and chunk.prefix_defs is None:
+        # RLE-dominant, null-free: ship the runs themselves. Values are the
+        # per-run DECODED value (one dictionary lookup per run — k-sized
+        # host work); run ends are the int32 cumulative lengths.
+        ends = pa.array(np.cumsum(rlens).astype(np.int32), type=pa.int32())
+        run_values = dict_vals.take(pa.array(rvals.astype(np.int64)))
+        prefix: pa.Array = pa.RunEndEncodedArray.from_arrays(ends, run_values)
+    else:
+        indices, validity = chunk.prefix_indices()
+        idx_t = (pa.int8() if k <= 127 else
+                 pa.int16() if k <= 0x7FFF else pa.int32())
+        if validity is not None:
+            idx = pa.array(indices.astype(idx_t.to_pandas_dtype()),
+                           mask=~validity)
+        else:
+            idx = pa.array(indices, type=idx_t, safe=False)
+        prefix = pa.DictionaryArray.from_arrays(idx, dict_vals)
+    tail = None
+    if chunk.tail_values is not None:
+        if chunk.tail_defs is None:
+            tail = pa.array(chunk.tail_values)
+        else:
+            full = np.zeros(len(chunk.tail_defs), chunk.tail_values.dtype)
+            full[chunk.tail_defs] = chunk.tail_values
+            tail = pa.array(full, mask=~chunk.tail_defs)
+        if not tail.type.equals(arrow_type):
+            tail = tail.cast(arrow_type)
+    return ColumnRead(prefix, tail)

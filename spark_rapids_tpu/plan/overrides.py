@@ -770,8 +770,11 @@ class TpuOverrides:
                 if "cannot run on TPU" in line or "because" in line:
                     print(line)
         converted = meta.convert_if_needed()
-        return insert_pipeline(insert_transitions(fuse_device_ops(converted)),
-                               self.conf)
+        from spark_rapids_tpu.plan.encoded import mark_encoded_domain
+        return mark_encoded_domain(
+            insert_pipeline(insert_transitions(fuse_device_ops(converted)),
+                            self.conf),
+            self.conf)
 
 
 def _enforce_exchange_reuse(root: ExecMeta) -> None:

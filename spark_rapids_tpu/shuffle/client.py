@@ -178,6 +178,12 @@ class ShuffleClient:
         self.connection = connection
         self.received = received_catalog
         self.codec_name = codec_name
+        if codec_name not in ("none", "copy"):
+            # fail fast with the registry's ONE well-formed error on a
+            # mistyped/unavailable codec conf, instead of erroring deep in
+            # decompress after bytes already crossed the wire
+            from spark_rapids_tpu.shuffle.codec import get_codec
+            get_codec(codec_name, transport.conf)
         self.chunk_size = transport.send_bounce.buffer_size
         conf = transport.conf
         self.max_retries = conf.shuffle_max_retries

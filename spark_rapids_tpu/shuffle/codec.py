@@ -11,7 +11,7 @@ pack_host_batch and the transport send.
 from __future__ import annotations
 
 import zlib
-from typing import Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.shuffle.table_meta import (  # noqa: F401 - re-export
     ChecksumError, TableMeta)
@@ -109,24 +109,87 @@ class ZstdCodec(TableCompressionCodec):
         return out
 
 
-_REGISTRY: Dict[str, TableCompressionCodec] = {
-    "copy": CopyCodec(),
-    "zlib": ZlibCodec(),
-    "none": CopyCodec(),
-}
-try:
-    _REGISTRY["zstd"] = ZstdCodec()
-except ImportError:  # zstandard not installed: registry omits it
-    pass
+class Lz4Codec(TableCompressionCodec):
+    """LZ4 block format — always available: shuffle/lz4.py carries a pure-
+    Python implementation and upgrades to the C ``lz4.block`` package when
+    installed (both speak the standard block format, so mixed peers
+    interoperate). The right default for network-bound shuffles that cannot
+    assume zstandard on every executor."""
+
+    name = "lz4"
+
+    def compress(self, buf: bytes) -> bytes:
+        from spark_rapids_tpu.shuffle import lz4
+        return lz4.compress(buf)
+
+    def decompress(self, buf: bytes, uncompressed_size: int) -> bytes:
+        from spark_rapids_tpu.shuffle import lz4
+        out = lz4.decompress(buf, uncompressed_size)
+        if len(out) != uncompressed_size:
+            raise ValueError(f"lz4 decompressed to {len(out)}, expected "
+                             f"{uncompressed_size}")
+        return out
 
 
-def get_codec(name: str) -> TableCompressionCodec:
-    """Registry lookup (TableCompressionCodec.getCodec analog)."""
-    codec = _REGISTRY.get(name.lower())
-    if codec is None:
+def _zlib_factory(conf) -> TableCompressionCodec:
+    from spark_rapids_tpu import config as cfg
+    level = conf.get(cfg.SHUFFLE_ZLIB_LEVEL) if conf is not None else 1
+    return ZlibCodec(level)
+
+
+#: THE codec registry: one name->factory table shared by the client (which
+#: validates its configured codec at construction) and the server (which
+#: resolves each TransferRequest's codec) — TableCompressionCodec.getCodec
+#: analog. A factory may raise ImportError for an uninstalled backend.
+_REGISTRY: Dict[str, Callable[[Optional[object]],
+                              TableCompressionCodec]] = {}
+
+
+def register_codec(name: str,
+                   factory: Callable[[Optional[object]],
+                                     TableCompressionCodec]) -> None:
+    _REGISTRY[name.lower()] = factory
+
+
+register_codec("copy", lambda conf: CopyCodec())
+register_codec("none", lambda conf: CopyCodec())
+register_codec("zlib", _zlib_factory)
+register_codec("zstd", lambda conf: ZstdCodec())
+register_codec("lz4", lambda conf: Lz4Codec())
+
+
+def codec_available(name: str) -> bool:
+    """Can this executor actually construct the named codec? (The server's
+    negotiation check: a requested codec that fails here degrades the
+    transfer to 'copy' instead of failing it.)"""
+    factory = _REGISTRY.get(name.lower())
+    if factory is None:
+        return False
+    try:
+        factory(None)
+        return True
+    except ImportError:
+        return False
+
+
+def available_codecs() -> List[str]:
+    return sorted(n for n in _REGISTRY if codec_available(n))
+
+
+def get_codec(name: str, conf=None) -> TableCompressionCodec:
+    """Registry lookup (TableCompressionCodec.getCodec analog): ONE
+    well-formed error for an unknown or unavailable codec name, raised at
+    configuration/validation time instead of deep inside a decompress."""
+    factory = _REGISTRY.get(name.lower())
+    if factory is None:
         raise ValueError(f"unknown shuffle codec {name!r}; known: "
                          f"{sorted(_REGISTRY)}")
-    return codec
+    try:
+        return factory(conf)
+    except ImportError as e:
+        raise ValueError(f"shuffle codec {name!r} is not available on this "
+                         f"executor ({e}); install its backend or pick one "
+                         f"of {available_codecs()}") from None
 
 
 def compress_batch(buf: bytes, meta: TableMeta,

@@ -109,18 +109,44 @@ class BufferSendState:
 class ShuffleServer:
     """Registers the request handlers and owns send-state lifecycles
     (RapidsShuffleServer analog; the copy executor is the transport's
-    progress/rpc threads)."""
+    progress/rpc threads).
+
+    ``supported_codecs`` restricts which compression codecs this server
+    will apply (None = everything the local registry can construct). A
+    TransferRequest naming a codec outside that set NEGOTIATES DOWN to the
+    copy pseudo-codec instead of failing: the response's TableMeta.codec
+    records what was actually applied, so a new client fetching from an
+    old/codec-less peer still gets its data — uncompressed — rather than an
+    error (the reference's CodecType negotiation role)."""
 
     def __init__(self, transport: ShuffleTransport,
-                 catalog: ShuffleBufferCatalog, codec_name: str = "none"):
+                 catalog: ShuffleBufferCatalog, codec_name: str = "none",
+                 supported_codecs=None):
         self.transport = transport
         self.server_conn: ServerConnection = transport.server
         self.catalog = catalog
         self.codec_name = codec_name
+        self.supported_codecs = (None if supported_codecs is None
+                                 else {c.lower() for c in supported_codecs})
         self.server_conn.register_request_handler(msg.REQ_METADATA,
                                                   self.handle_metadata_request)
         self.server_conn.register_request_handler(msg.REQ_TRANSFER,
                                                   self.handle_transfer_request)
+
+    def _negotiate_codec(self, requested: str):
+        """The codec actually applied for a request: the requested one when
+        this server supports it, else copy (graceful degradation — never
+        fail a fetch over a codec mismatch)."""
+        from spark_rapids_tpu.shuffle.codec import codec_available
+        from spark_rapids_tpu.utils import metrics as mt
+        name = (requested or "copy").lower()
+        if ((self.supported_codecs is not None
+             and name not in self.supported_codecs)
+                or not codec_available(name)):
+            if name not in ("copy", "none"):
+                self.transport.metrics[mt.SHUFFLE_CODEC_FALLBACKS].add(1)
+            name = "copy"
+        return get_codec(name, getattr(self.transport, "conf", None))
 
     # ---- handlers (run on transport rpc threads) --------------------------------
     def handle_metadata_request(self, peer: str, payload: bytes) -> bytes:
@@ -146,7 +172,7 @@ class ShuffleServer:
             raw = _pack_spillable(buf)
         finally:
             buf.close()
-        codec = get_codec(req.codec)
+        codec = self._negotiate_codec(req.codec)
         wire, wire_meta = compress_batch(raw, meta, codec)
         # crc over the exact bytes that ride the wire (post-compression):
         # the client verifies the assembled buffer against this before

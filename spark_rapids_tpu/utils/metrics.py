@@ -31,11 +31,12 @@ SHUFFLE_RPC_RETRIES = "shuffleRpcRetries"            # metadata request retries
 SHUFFLE_CONNECT_RETRIES = "shuffleConnectRetries"    # TCP connect re-attempts
 SHUFFLE_CHECKSUM_FAILURES = "shuffleChecksumFailures"  # corrupt payloads caught
 SHUFFLE_PEER_EVICTIONS = "shufflePeerEvictions"      # dead clients evicted
+SHUFFLE_CODEC_FALLBACKS = "shuffleCodecFallbacks"    # negotiated down to copy
 
 SHUFFLE_METRIC_NAMES = (
     SHUFFLE_FETCH_RETRIES, SHUFFLE_TRANSFER_RETRIES, SHUFFLE_RPC_RETRIES,
     SHUFFLE_CONNECT_RETRIES, SHUFFLE_CHECKSUM_FAILURES,
-    SHUFFLE_PEER_EVICTIONS)
+    SHUFFLE_PEER_EVICTIONS, SHUFFLE_CODEC_FALLBACKS)
 
 # Host-link transfer counters (bufferTime/gpuDecodeTime observability role,
 # process-global like the link itself: uploads happen inside
@@ -48,11 +49,22 @@ TRANSFER_UPLOAD_CHUNKS = "transfer.upload_chunks"
 TRANSFER_DOWNLOAD_BYTES = "transfer.download_bytes"
 TRANSFER_DOWNLOAD_SECONDS = "transfer.download_seconds"
 TRANSFER_INFLIGHT_PEAK = "transfer.inflight_peak"
+# compressed columnar path: bytes actually staged for the link (encoded
+# forms: dict indices + dictionary, RLE run ends + run values) vs the bytes
+# the decoded columns would have staged — the per-action ratio is the link
+# compression the encoded path bought (transfer.compression_ratio in
+# session.last_metrics["transfer"]).
+TRANSFER_ENCODED_BYTES = "transfer.encoded_bytes"
+TRANSFER_DECODED_EQUIV_BYTES = "transfer.decoded_equivalent_bytes"
+#: batch programs that ran a filter/group-by/join on the encoded domain
+#: (dictionary indices) instead of decoded values (exprs/encoded.py)
+TRANSFER_ENCODED_DOMAIN_OPS = "transfer.encoded_domain_ops"
 
 TRANSFER_METRIC_NAMES = (
     TRANSFER_UPLOAD_BYTES, TRANSFER_UPLOAD_SECONDS, TRANSFER_UPLOAD_CHUNKS,
     TRANSFER_DOWNLOAD_BYTES, TRANSFER_DOWNLOAD_SECONDS,
-    TRANSFER_INFLIGHT_PEAK)
+    TRANSFER_INFLIGHT_PEAK, TRANSFER_ENCODED_BYTES,
+    TRANSFER_DECODED_EQUIV_BYTES, TRANSFER_ENCODED_DOMAIN_OPS)
 
 
 class Metric:
@@ -142,6 +154,11 @@ def transfer_delta(before: Dict[str, float]) -> Dict[str, float]:
         s = out[f"transfer.{direction}_seconds"]
         out[f"transfer.{direction}_gb_per_sec"] = (
             round(b / s / 1e9, 3) if s > 0 else 0.0)
+    # encoded-path link compression for this action: < 1.0 means the upload
+    # shipped fewer bytes than the decoded columns would have
+    dec = out[TRANSFER_DECODED_EQUIV_BYTES]
+    out["transfer.compression_ratio"] = (
+        round(out[TRANSFER_ENCODED_BYTES] / dec, 4) if dec > 0 else 1.0)
     return out
 
 

@@ -46,7 +46,10 @@ def fresh_fabric():
 def fault_cluster(tmp_path, plan="", seed=7, n=2, extra=None):
     """n ShuffleEnvs riding the fault wrapper around the in-process fabric.
     Small bounce buffers force multi-chunk transfers (faults need frames to
-    hit); small backoff keeps chaos tests fast."""
+    hit); small backoff keeps chaos tests fast. SHUFFLE_FAULTS_CODEC runs
+    the whole chaos matrix over compressed payloads (ci/nightly.sh sets
+    lz4, so corrupt-frame recovery is exercised on compressed frames)."""
+    import os
     conf = TpuConf({
         "spark.rapids.tpu.shuffle.transport.class": FAULT_TRANSPORT,
         "spark.rapids.tpu.shuffle.faults.plan": plan,
@@ -54,6 +57,8 @@ def fault_cluster(tmp_path, plan="", seed=7, n=2, extra=None):
         "spark.rapids.tpu.shuffle.bounceBuffers.size": 1024,
         "spark.rapids.tpu.shuffle.bounceBuffers.count": 16,
         "spark.rapids.tpu.shuffle.retryBackoffMs": 5,
+        "spark.rapids.tpu.shuffle.compression.codec":
+            os.environ.get("SHUFFLE_FAULTS_CODEC", "none"),
         **(extra or {})})
     envs = [ShuffleEnv(f"exec-{i}", conf, disk_dir=str(tmp_path / f"e{i}"))
             for i in range(n)]
@@ -162,7 +167,11 @@ def test_corruption_without_checksum_would_pass_silently(tmp_path):
     that the checksum is what stands between corruption and wrong answers."""
     mgr, e0, e1 = fault_cluster(
         tmp_path, plan="corrupt_frame:after=2",
-        extra={"spark.rapids.tpu.shuffle.checksum.enabled": "false"})
+        extra={"spark.rapids.tpu.shuffle.checksum.enabled": "false",
+               # pinned to the copy codec: a real codec's decompressor can
+               # catch the flip incidentally and retry, defeating this
+               # negative control (the lz4 matrix run sets the codec env)
+               "spark.rapids.tpu.shuffle.compression.codec": "none"})
     sid, _ = mgr.register_shuffle(1)
     t = sample_table(700, seed=3)
     write_partitioned(mgr, e1, sid, 0, t, 1)
