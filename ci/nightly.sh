@@ -40,10 +40,28 @@ for key in ("link_bytes_encoded", "link_bytes_decoded", "link_bytes_ratio",
     assert key in comp, f"missing compression breakdown key {key}: {comp}"
 assert comp["link_bytes_ratio"] < 1.0, comp
 assert comp["encoded_domain_ops"] >= 1, comp
+mesh = out["breakdown"]["mesh"]
+for key in ("devices", "in_mesh_exchange_gb_per_sec",
+            "single_device_exchange_gb_per_sec",
+            "host_hop_exchange_gb_per_sec", "in_mesh_vs_host_hop_x",
+            "host_hop_bytes", "per_device_rows_per_sec",
+            "collect_bit_identical", "q1_exact_cols_bit_identical",
+            "q1_float_max_rel_err"):
+    assert key in mesh, f"missing mesh breakdown key {key}: {mesh}"
+# the all_to_all exchange path must move NOTHING through the host
+assert mesh["host_hop_bytes"] == 0, mesh
+# acceptance bar: in-mesh exchange >= 2x the host-hop exchange path
+assert mesh["in_mesh_vs_host_hop_x"] >= 2.0, mesh
+# exchange bit-identity: the permute-only sharded collect is bitwise equal
+assert mesh["collect_bit_identical"] is True, mesh
+assert mesh["q1_exact_cols_bit_identical"] is True, mesh
+assert any(v for v in mesh["in_mesh_exchange_gb_per_sec"].values()), mesh
 print("bench smoke OK:", {k: pipe[k] for k in
                           ("upload_chunked_s", "upload_overlap_efficiency",
                            "inflight_high_water")},
-      {k: comp[k] for k in ("link_bytes_ratio", "encoded_domain_ops")})
+      {k: comp[k] for k in ("link_bytes_ratio", "encoded_domain_ops")},
+      {k: mesh[k] for k in ("in_mesh_exchange_gb_per_sec",
+                            "in_mesh_vs_host_hop_x", "host_hop_bytes")})
 PY
 
 if [ "${RUN_TPU_BENCH:-0}" = "1" ]; then

@@ -68,7 +68,8 @@ class DeviceBatch:
     # ------------------------------------------------------------------ arrow I/O
     @staticmethod
     def from_arrow(table: pa.Table, string_max_bytes: int = DEFAULT_STRING_MAX_BYTES,
-                   bucketed: bool = True, device: Any = None) -> "DeviceBatch":
+                   bucketed: bool = True, device: Any = None,
+                   with_bits: bool = True) -> "DeviceBatch":
         """Host arrow table -> device batch (single upload per buffer).
 
         Encoded columns never decode on host:
@@ -120,7 +121,8 @@ class DeviceBatch:
                     vd, _, _ = _arrow_to_staged(f.dtype, vals,
                                                 string_max_bytes)
                     vbits = (vd.view(np.uint64)
-                             if f.dtype is DType.DOUBLE else None)
+                             if f.dtype is DType.DOUBLE and with_bits
+                             else None)
                     encoded[i] = "ree"
                     staged.append((ends, rvalid, vd, vbits))
                     enc_bytes += _nb(ends, rvalid, vd, vbits)
@@ -153,7 +155,8 @@ class DeviceBatch:
                 else:
                     dd, _, _ = _arrow_to_staged(f.dtype, arr.dictionary,
                                                 string_max_bytes)
-                    dbits = (dd.view(np.uint64) if f.dtype is DType.DOUBLE
+                    dbits = (dd.view(np.uint64)
+                             if f.dtype is DType.DOUBLE and with_bits
                              else None)
                     encoded[i] = "fixed"
                     staged.append((np_idx, validity, dd, dbits))
@@ -170,8 +173,11 @@ class DeviceBatch:
             # DOUBLE columns also ship their IEEE bit pattern: device f64
             # STORAGE is true 64-bit but no device op can extract its bits
             # (f64->u64 bitcast does not lower; arithmetic is ~49-bit), so
-            # the shuffle kernel's byte packing needs the host-made sibling
-            bits = d.view(np.uint64) if f.dtype is DType.DOUBLE else None
+            # the shuffle kernel's byte packing needs the host-made sibling.
+            # with_bits=False skips it for consumers that never reach that
+            # kernel (mesh-sharded scans: exchange is an all_to_all)
+            bits = (d.view(np.uint64)
+                    if f.dtype is DType.DOUBLE and with_bits else None)
             staged.append((d, v, l, bits))
             plain = _nb(d, v, l, bits)
             enc_bytes += plain

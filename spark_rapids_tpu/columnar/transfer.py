@@ -89,7 +89,8 @@ def upload_table(table: pa.Table,
                  string_max_bytes: int = DEFAULT_STRING_MAX_BYTES,
                  chunk_rows: int = 0, max_inflight: int = 2,
                  device: Any = None,
-                 stats: Optional[Dict[str, Any]] = None) -> DeviceBatch:
+                 stats: Optional[Dict[str, Any]] = None,
+                 with_bits: bool = True) -> DeviceBatch:
     """Host arrow table -> DeviceBatch via the chunked overlapped pipeline.
 
     chunk_rows <= 0 (or a table at most one chunk big) takes the single-shot
@@ -101,7 +102,8 @@ def upload_table(table: pa.Table,
     t_start = time.perf_counter()
     bounds = chunk_bounds(table, chunk_rows)
     if len(bounds) < 2:
-        batch = DeviceBatch.from_arrow(table, string_max_bytes, device=device)
+        batch = DeviceBatch.from_arrow(table, string_max_bytes, device=device,
+                                       with_bits=with_bits)
         if stats is not None:
             # bench instrumentation wants the honest transfer wall; the
             # engine path must NOT sync — the async device_put overlapping
@@ -135,7 +137,8 @@ def upload_table(table: pa.Table,
         # XLA's compile cache across tables instead of compiling per exact
         # chunk-size tuple (padding is built ON DEVICE — no link bytes)
         b = DeviceBatch.from_arrow(table.slice(start, end - start),
-                                   string_max_bytes, device=device)
+                                   string_max_bytes, device=device,
+                                   with_bits=with_bits)
         t1 = time.perf_counter()
         stage_total += t1 - t0
         per_chunk.append(round(t1 - t0, 4))
@@ -170,13 +173,14 @@ def upload_table(table: pa.Table,
 
 
 def upload_table_conf(table: pa.Table, string_max_bytes: int, conf,
-                      device: Any = None) -> DeviceBatch:
+                      device: Any = None,
+                      with_bits: bool = True) -> DeviceBatch:
     """upload_table with chunking parameters read from a TpuConf."""
     from spark_rapids_tpu import config as cfg
     return upload_table(table, string_max_bytes,
                         chunk_rows=conf.get(cfg.TRANSFER_CHUNK_ROWS),
                         max_inflight=conf.get(cfg.TRANSFER_MAX_INFLIGHT),
-                        device=device)
+                        device=device, with_bits=with_bits)
 
 
 # ------------------------------------------------------------------ downloads

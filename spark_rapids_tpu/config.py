@@ -204,6 +204,37 @@ MESH_NUM_DEVICES = _conf(
     "sql.mesh.numDevices", int, 0,
     "Devices in the execution mesh; 0 uses every visible device.")
 
+MESH_SCAN_ASSIGNMENT = _conf(
+    "sql.mesh.scan.shardAssignment", str, "rowgroup",
+    "How mesh file scans split work across shards: 'rowgroup' balances "
+    "statistics-clipped parquet ROW GROUPS over shards AT PLAN TIME (exact "
+    "footer row counts, greedy LPT — one huge file still spreads over the "
+    "mesh) and each shard's read uploads straight onto its owning device "
+    "through the chunked transfer pipeline; 'file' keeps the execute-time "
+    "whole-file assignment (formats without row-group metadata always use "
+    "it).",
+    checker=lambda v: (None if v in ("rowgroup", "file")
+                       else f"sql.mesh.scan.shardAssignment must be "
+                            f"rowgroup | file, got {v!r}"))
+
+MESH_REQUIRE_ICI = _conf(
+    "sql.mesh.requireIci", bool, True,
+    "Clip the collective-exchange mesh to ONE ICI domain (the largest "
+    "single-slice, single-process device group): in-mesh all_to_all / "
+    "all-gather exchanges then never ride DCN, whose loss/latency profile "
+    "belongs to the fault-tolerant TCP shuffle stack (shuffle/tcp.py + "
+    "retry/checksum layers) instead. Disable only to let XLA schedule "
+    "collectives across slices itself.")
+
+EXCHANGE_KEEP_ENCODINGS = _conf(
+    "sql.exchange.keepEncodings", bool, True,
+    "Shuffle exchanges carry dictionary-encoded columns as int32 INDICES "
+    "plus the shared dictionary through the partition/repack kernels "
+    "instead of materializing decoded values first — shuffled bytes shrink "
+    "by the same ratio the encoded scan bought, and encoded-domain "
+    "operators keep working downstream of an exchange (the dictionary "
+    "token survives).")
+
 PARQUET_DEVICE_DICT = _conf(
     "io.parquet.deviceDictDecode.enabled", bool, True,
     "TPU parquet scans keep fixed-width columns dictionary-encoded through "

@@ -23,14 +23,20 @@ class ExecContext:
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
                  num_partitions: int = 1, device_manager=None,
                  cleanups: Optional[list] = None, cluster_shuffle=None,
-                 device=None):
+                 device=None, placement=None):
+        from spark_rapids_tpu.parallel.placement import as_placement
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
         self.num_partitions = num_partitions
         self.device_manager = device_manager
-        #: target jax device for this task's uploads (multi-device placement);
-        #: None = the process default device
-        self.device = device
+        #: where this task's batches land: a jax.sharding.Sharding (single
+        #: device, mesh-sharded, or replicated) or None for the process
+        #: default device. The PLANNER decides this; operators are
+        #: placement-agnostic and just hand it to the upload path. The
+        #: legacy ``device=`` argument (a raw jax.Device) normalizes to a
+        #: SingleDeviceSharding.
+        self.placement = as_placement(placement if placement is not None
+                                      else device)
         #: the owning task's id for the device-admission semaphore: captured
         #: at construction (the thread that starts the task). Worker threads
         #: an exec spawns (PipelinedExec / prefetch producers) join THIS
@@ -46,6 +52,13 @@ class ExecContext:
         self.cluster_shuffle = cluster_shuffle
 
     @property
+    def device(self):
+        """The task's placement in ``jax.device_put``-compatible form (a
+        Sharding IS a valid device_put target). Kept so every upload call
+        site reads naturally; ``placement`` is the first-class property."""
+        return self.placement
+
+    @property
     def string_max_bytes(self) -> int:
         return self.conf.string_max_bytes
 
@@ -56,6 +69,14 @@ class PhysicalExec:
 
     #: True when this exec produces DeviceBatch (TPU side)
     is_device: bool = False
+
+    #: plan-time placement annotation (a jax.sharding.Sharding): where this
+    #: operator's output batches live. Mesh operators set it when the mesh
+    #: rewrite constructs them (plan/mesh_rewrite.py); None = process
+    #: default. Operators do not read it to execute — it is the declared
+    #: contract the execution must satisfy, surfaced in plan display and
+    #: asserted by tests.
+    placement = None
 
     def __init__(self, children: Sequence["PhysicalExec"], output: Schema):
         self.children: Tuple[PhysicalExec, ...] = tuple(children)
@@ -84,7 +105,11 @@ class PhysicalExec:
 
     # ---- plan display ---------------------------------------------------------
     def tree_string(self, indent: int = 0) -> str:
-        lines = ["  " * indent + f"{self.name} [{self.output}]"]
+        tag = ""
+        if self.placement is not None:
+            from spark_rapids_tpu.parallel.placement import placement_label
+            tag = f" @{placement_label(self.placement)}"
+        lines = ["  " * indent + f"{self.name} [{self.output}]{tag}"]
         for c in self.children:
             lines.append(c.tree_string(indent + 1))
         return "\n".join(lines)

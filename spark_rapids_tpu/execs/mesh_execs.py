@@ -23,6 +23,7 @@ the whole mesh, never per batch per device.
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -90,6 +91,11 @@ class MeshExec(PhysicalExec):
     def __init__(self, children, output: Schema, mesh: Mesh):
         super().__init__(children, output)
         self.mesh = mesh
+        #: declared output placement: rows partitioned over the mesh data
+        #: axis. Set at CONSTRUCTION (i.e. at plan time, by mesh_rewrite) so
+        #: the plan carries where every batch lives; boundary execs
+        #: (gather, writes) override.
+        self.placement = NamedSharding(mesh, P(DATA_AXIS))
 
     @property
     def num_partitions(self) -> int:
@@ -133,33 +139,93 @@ class MeshScatterExec(MeshExec):
         yield mb
 
 
+@dataclass(frozen=True)
+class ScanShardAssignment:
+    """Plan-time scan split: which (file_index, row_group) units each mesh
+    shard reads, with exact per-shard row totals from footer metadata. The
+    FilePartition split-packing role at row-group granularity — computed by
+    the PLANNER (plan/mesh_rewrite.plan_scan_shards), not at execute time,
+    so the plan itself says where every row lands."""
+
+    #: per shard: ordered (file_index, row_group) units
+    units: Tuple[Tuple[Tuple[int, int], ...], ...]
+    #: per shard: exact row totals (statistics-clipped footer counts)
+    rows: Tuple[int, ...]
+
+    @property
+    def num_rows(self) -> int:
+        return sum(self.rows)
+
+
+def plan_scan_shards(scan, mesh: Mesh, conf) -> Optional[ScanShardAssignment]:
+    """Balance the scan's row-group units over the mesh shards at PLAN time
+    (greedy LPT on exact metadata row counts). None when the format has no
+    row-group granularity or the conf keeps the whole-file path."""
+    from spark_rapids_tpu import config as cfg
+    if conf is None or conf.get(cfg.MESH_SCAN_ASSIGNMENT) != "rowgroup":
+        return None
+    units_fn = getattr(scan, "row_group_units", None)
+    if units_fn is None or not getattr(scan, "files", None):
+        return None
+    try:
+        units = units_fn()
+    except OSError:
+        return None       # unreadable footer: the execute-time path decides
+    n_dev = int(mesh.devices.size)
+    order = sorted(range(len(units)), key=lambda i: -units[i][2])
+    loads = [0] * n_dev
+    assign: List[List[int]] = [[] for _ in range(n_dev)]
+    for i in order:
+        d = int(np.argmin(loads))
+        assign[d].append(i)
+        loads[d] += units[i][2]
+    shard_units, shard_rows = [], []
+    for lst in assign:
+        lst.sort()    # preserve (file, group) plan order within a shard
+        shard_units.append(tuple((units[i][0], units[i][1]) for i in lst))
+        shard_rows.append(sum(units[i][2] for i in lst))
+    return ScanShardAssignment(tuple(shard_units), tuple(shard_rows))
+
+
 class MeshFileScatterExec(MeshExec):
-    """Shard-local distributed scan: the scan's file splits are assigned to
-    shards (balanced by exact metadata row counts), each shard's files are
-    read and uploaded straight to that shard's device, and the sharded global
-    arrays are assembled without EVER materializing the whole table on one
-    host buffer — the per-task partition readers of GpuParquetScan.scala
-    (:151,291), with a mesh shard as the task.
+    """Shard-local distributed scan: the scan's splits are assigned to
+    shards, each shard's rows are read and uploaded straight to that shard's
+    device, and the sharded global arrays are assembled without EVER
+    materializing the whole table on one host buffer — the per-task
+    partition readers of GpuParquetScan.scala (:151,291), with a mesh shard
+    as the task.
 
-    Host working set = one shard's rows. Formats without exact row-count
-    metadata (CSV) fall back to read-everything-then-scatter."""
+    With a plan-time ``ScanShardAssignment`` (parquet; row-group
+    granularity, sql.mesh.scan.shardAssignment=rowgroup) each shard's upload
+    rides the chunked overlapped transfer pipeline (columnar/transfer.py)
+    directly onto its owning device. Otherwise files are split at execute
+    time by exact metadata row counts; formats without row-count metadata
+    (CSV) fall back to read-everything-then-scatter.
 
-    def __init__(self, scan: PhysicalExec, mesh: Mesh):
+    Host working set = one shard's rows."""
+
+    def __init__(self, scan: PhysicalExec, mesh: Mesh,
+                 assignment: Optional[ScanShardAssignment] = None):
         super().__init__((scan,), scan.output, mesh)
+        self.assignment = assignment
 
     def execute(self, ctx: ExecContext) -> Iterator[MeshBatch]:
         import pyarrow as pa
         scan = self.children[0]
-        counts = scan.file_row_counts() if scan.files else None
-        if counts is None:
-            # no metadata counts: read all, scatter (the generic path)
-            tables = list(scan.iter_tables_for_files(scan.files))
-            table = (pa.concat_tables(tables) if tables
-                     else self.output.to_pa().empty_table())
-            mb = scatter_arrow(table, self.mesh, ctx.string_max_bytes)
+        if self.assignment is not None:
+            mb = _scatter_assigned_shards(scan, self.assignment, self.mesh,
+                                          ctx)
         else:
-            mb = _scatter_file_shards(scan, counts, self.mesh,
-                                      ctx.string_max_bytes)
+            counts = scan.file_row_counts() if scan.files else None
+            if counts is None:
+                # no metadata counts: read all, scatter (the generic path)
+                tables = list(scan.iter_tables_for_files(scan.files))
+                table = (pa.concat_tables(tables) if tables
+                         else self.output.to_pa().empty_table())
+                mb = scatter_arrow(table, self.mesh, ctx.string_max_bytes)
+            else:
+                mb = _scatter_file_shards(scan, counts, self.mesh,
+                                          ctx.string_max_bytes)
         scan.count_output(mb.num_rows)
         self.count_output(mb.num_rows)
         yield mb
@@ -178,6 +244,53 @@ def _assign_files_to_shards(counts: Sequence[int], n_dev: int) -> List[List[int]
     for lst in assign:
         lst.sort()  # preserve file order within a shard
     return assign
+
+
+def _assemble_mesh_batch(schema: Schema, shard_cols: List[List], rows,
+                         mesh: Mesh, local_cap: int) -> MeshBatch:
+    """Per-shard (data, validity, lengths) device arrays -> one MeshBatch:
+    pad each shard to the common local capacity ON ITS DEVICE, equalize
+    adaptive string widths, then assemble the global data-axis arrays with
+    ``make_array_from_single_device_arrays`` — zero extra data movement.
+    ``shard_cols[ci][d]`` is shard d's triple for column ci; arrays already
+    at ``local_cap`` pass through untouched. The single assembly tail shared
+    by every mesh scan path."""
+    n_dev = int(mesh.devices.size)
+
+    def pad_rows(a):
+        n = a.shape[0]
+        if n == local_cap:
+            return a
+        if n > local_cap:
+            return a[:local_cap]
+        return jnp.concatenate(
+            [a, jnp.zeros((local_cap - n,) + a.shape[1:], a.dtype)])
+
+    from spark_rapids_tpu.columnar.column import DeviceColumn as _DC
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    cols: List[_DC] = []
+    for ci, f in enumerate(schema):
+        parts = shard_cols[ci]
+        datas = [p[0] for p in parts]
+        if datas[0].ndim == 2:
+            w = max(d.shape[1] for d in datas)
+            datas = [jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+                     if d.shape[1] < w else d for d in datas]
+        datas = [pad_rows(a) for a in datas]
+        valids = [pad_rows(p[1]) for p in parts]
+        lens = ([pad_rows(p[2]) for p in parts]
+                if parts[0][2] is not None else None)
+        gshape = (n_dev * local_cap,) + datas[0].shape[1:]
+        data = jax.make_array_from_single_device_arrays(
+            gshape, sharding, datas)
+        validity = jax.make_array_from_single_device_arrays(
+            (n_dev * local_cap,), sharding, valids)
+        lengths = None
+        if lens is not None:
+            lengths = jax.make_array_from_single_device_arrays(
+                (n_dev * local_cap,), sharding, lens)
+        cols.append(_DC(f.dtype, data, validity, lengths))
+    return MeshBatch(schema, tuple(cols), rows, mesh)
 
 
 def _scatter_file_shards(scan, counts: Sequence[int], mesh: Mesh,
@@ -202,9 +315,12 @@ def _scatter_file_shards(scan, counts: Sequence[int], mesh: Mesh,
         else:
             table = schema.to_pa().empty_table()
         n = table.num_rows
-        assert n == shard_rows[d], (
-            f"shard {d} read {n} rows but metadata said {shard_rows[d]} "
-            f"(stale file metadata?)")
+        if n != shard_rows[d]:
+            # loud even under python -O: the local-capacity pad would
+            # otherwise silently truncate or zero-pad live rows
+            raise RuntimeError(
+                f"shard {d} read {n} rows but metadata said "
+                f"{shard_rows[d]} (stale file metadata?)")
         rows[d] = n
         for ci, f in enumerate(schema):
             data, validity, lengths = staged_column_arrays(
@@ -223,29 +339,67 @@ def _scatter_file_shards(scan, counts: Sequence[int], mesh: Mesh,
             shard_cols[ci].append(
                 (up[0], up[1], up[2] if plen is not None else None))
         del table, tables  # free this shard's host copy before the next
+    return _assemble_mesh_batch(schema, shard_cols, rows, mesh, local_cap)
 
-    # equalize string widths device-side (per-shard adaptive widths differ)
-    cols: List[DeviceColumn] = []
-    from spark_rapids_tpu.columnar.column import DeviceColumn as _DC
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    for ci, f in enumerate(schema):
-        parts = shard_cols[ci]
-        datas = [p[0] for p in parts]
-        if datas[0].ndim == 2:
-            w = max(d.shape[1] for d in datas)
-            datas = [jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
-                     if d.shape[1] < w else d for d in datas]
-        gshape = (n_dev * local_cap,) + datas[0].shape[1:]
-        data = jax.make_array_from_single_device_arrays(
-            gshape, sharding, datas)
-        validity = jax.make_array_from_single_device_arrays(
-            (n_dev * local_cap,), sharding, [p[1] for p in parts])
-        lengths = None
-        if parts[0][2] is not None:
-            lengths = jax.make_array_from_single_device_arrays(
-                (n_dev * local_cap,), sharding, [p[2] for p in parts])
-        cols.append(_DC(f.dtype, data, validity, lengths))
-    return MeshBatch(schema, tuple(cols), rows, mesh)
+
+def _scatter_assigned_shards(scan, assign: ScanShardAssignment, mesh: Mesh,
+                             ctx: ExecContext) -> MeshBatch:
+    """Execute a plan-time shard assignment: per shard, read its row groups,
+    upload through the chunked overlapped pipeline (PR 3) LANDING DIRECTLY
+    on the owning device (SingleDeviceSharding placement), then assemble the
+    global data-axis arrays from the per-device buffers with
+    ``make_array_from_single_device_arrays`` — zero extra data movement, no
+    whole-table host buffer."""
+    from jax.sharding import SingleDeviceSharding
+    from spark_rapids_tpu import config as _cfg
+    from spark_rapids_tpu.columnar.transfer import upload_table_conf
+    if hasattr(scan, "device_dict"):
+        # the assigned path uploads through DeviceBatch.from_arrow, which
+        # handles encoded forms — mesh scans get the compressed link too
+        scan.device_dict = ctx.conf.get(_cfg.PARQUET_DEVICE_DICT)
+        scan.device_rle = (scan.device_dict
+                           and ctx.conf.get(_cfg.PARQUET_DEVICE_RLE))
+    schema = scan.output
+    n_dev = int(mesh.devices.size)
+    devices = list(mesh.devices.flat)
+    local_cap = max(bucket_capacity(max(assign.rows, default=0)), 1)
+    rows = np.zeros(n_dev, dtype=np.int32)
+    shard_batches: List[DeviceBatch] = []
+    from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
+    for d in range(n_dev):
+        place = SingleDeviceSharding(devices[d])
+        # upload each unit table SEPARATELY (a shard's row groups may carry
+        # different encodings — dictionary vs REE vs plain — which cannot
+        # concatenate as host arrow tables), then combine ON THE DEVICE via
+        # the shared concat program. PR 3 pipeline per table, landing
+        # straight on the owning device; no u64 bits siblings — the mesh
+        # exchange is an all_to_all, never the Pallas byte-packing kernel
+        # those siblings exist for, so shipping them would waste
+        # 8 B/row/DOUBLE-column of link bandwidth.
+        parts = [upload_table_conf(t, ctx.string_max_bytes, ctx.conf,
+                                   device=place, with_bits=False)
+                 for t in (scan.iter_tables_for_units(assign.units[d])
+                           if assign.units[d] else ())]
+        if parts:
+            db = concat_device_batches(parts, schema, ctx.string_max_bytes)
+        else:
+            db = upload_table_conf(schema.to_pa().empty_table(),
+                                   ctx.string_max_bytes, ctx.conf,
+                                   device=place, with_bits=False)
+        if db.num_rows != assign.rows[d]:
+            # must fail loudly even under python -O: a mismatch means the
+            # file changed since plan time, and the capacity pad below
+            # would otherwise silently truncate or zero-pad live rows
+            raise RuntimeError(
+                f"shard {d} read {db.num_rows} rows but the plan-time "
+                f"assignment said {assign.rows[d]} (stale file metadata?)")
+        rows[d] = db.num_rows
+        shard_batches.append(db)
+        del parts    # free this shard's intermediate batches
+    shard_cols = [[(b.columns[ci].data, b.columns[ci].validity,
+                    b.columns[ci].lengths) for b in shard_batches]
+                  for ci in range(len(schema))]
+    return _assemble_mesh_batch(schema, shard_cols, rows, mesh, local_cap)
 
 
 class MeshFromDeviceExec(MeshExec):
@@ -273,6 +427,7 @@ class MeshGatherExec(MeshExec):
 
     def __init__(self, child: PhysicalExec, mesh: Mesh):
         super().__init__((child,), child.output, mesh)
+        self.placement = None    # gathered output: process default device
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for mb in self.children[0].execute(ctx):
@@ -673,6 +828,7 @@ class MeshWriteFilesExec(MeshExec):
     def __init__(self, spec, child: PhysicalExec, mesh: Mesh):
         super().__init__((child,), Schema([]), mesh)
         self.spec = spec
+        self.placement = None    # produces no batches
         from spark_rapids_tpu.io.writer import WriteStats
         self.stats = WriteStats()
 

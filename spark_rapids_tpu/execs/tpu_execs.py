@@ -83,6 +83,11 @@ def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
     batches = [b for b in batches if b.num_rows > 0]
     if not batches:
         return DeviceBatch.empty(schema, string_max_bytes)
+    # a mesh-sharded input would silently collapse onto one device through
+    # XLA's implicit resharding — refuse; the explicit boundaries are
+    # MeshGatherExec (collective gather) / scatter_device_batch (reshard)
+    from spark_rapids_tpu.parallel.placement import assert_unsharded
+    assert_unsharded(batches, "concat_device_batches")
     if len(batches) == 1:
         return batches[0]
     total = sum(b.num_rows for b in batches)

@@ -68,13 +68,14 @@ def clip_row_groups(pf: pq.ParquetFile,
 @lru_cache(maxsize=512)
 def _clipped_groups_cached(path: str, mtime_ns: int, size: int,
                            filters: Tuple[Expression, ...]):
-    """One footer parse per (file state, filters): the pruned row-group list
-    and its exact row count, shared by the sizing pass (file_row_counts) and
+    """One footer parse per (file state, filters): the pruned row-group list,
+    its exact row count, and per-group row counts — shared by the sizing pass
+    (file_row_counts), the plan-time shard assignment (row_group_units) and
     the read pass so metadata is never re-parsed per pass."""
     pf = pq.ParquetFile(path)
     groups = clip_row_groups(pf, filters)
-    rows = sum(pf.metadata.row_group(i).num_rows for i in groups)
-    return tuple(groups), rows
+    group_rows = tuple(pf.metadata.row_group(i).num_rows for i in groups)
+    return tuple(groups), sum(group_rows), group_rows
 
 
 def clipped_groups(path: str, filters: Tuple[Expression, ...]):
@@ -88,9 +89,16 @@ def _iter_file_tables(f: PartitionedFile, data_schema: Schema,
                       filters: Sequence[Expression],
                       max_rows: int, max_bytes: int,
                       device_dict: bool = False, device_rle: bool = False,
-                      unifier=None) -> Iterator[pa.Table]:
+                      unifier=None,
+                      groups: Optional[Sequence[int]] = None
+                      ) -> Iterator[pa.Table]:
     pf = pq.ParquetFile(f.path)
-    groups = list(clipped_groups(f.path, tuple(filters))[0])
+    if groups is None:
+        groups = list(clipped_groups(f.path, tuple(filters))[0])
+    else:
+        # caller-restricted read (a mesh shard's plan-time assignment):
+        # the units are already statistics-clipped at plan time
+        groups = list(groups)
     if not groups:
         return
     md = pf.metadata
@@ -294,6 +302,44 @@ class _ParquetScanBase(LeafExec):
         metadata only (no data read) — sizes shard-local mesh reads."""
         return [clipped_groups(f.path, tuple(self.filters))[1]
                 for f in self.files]
+
+    def row_group_units(self) -> List[Tuple[int, int, int]]:
+        """The scan's splittable work units at ROW-GROUP granularity:
+        (file_index, row_group, exact_rows) per statistics-clipped group,
+        from footer metadata only. This is what the mesh planner balances
+        across shards AT PLAN TIME (the FilePartition split-packing role,
+        one level finer than whole files), so a single huge file still
+        spreads over the mesh."""
+        units: List[Tuple[int, int, int]] = []
+        for fi, f in enumerate(self.files):
+            groups, _, group_rows = clipped_groups(f.path,
+                                                   tuple(self.filters))
+            units.extend((fi, rg, rows)
+                         for rg, rows in zip(groups, group_rows))
+        return units
+
+    def iter_tables_for_units(self, units: Sequence[Tuple[int, int]]
+                              ) -> Iterator[pa.Table]:
+        """Read only the given (file_index, row_group) units — one shard's
+        slice of the plan-time assignment. File order (and group order
+        within a file) is preserved so shard-major row order is
+        deterministic."""
+        unifier = None
+        if self.device_dict:
+            from spark_rapids_tpu.columnar.encoding import DictionaryUnifier
+            unifier = DictionaryUnifier()
+        by_file: dict = {}
+        for fi, rg in units:
+            by_file.setdefault(fi, []).append(rg)
+        for fi in sorted(by_file):
+            f = self.files[fi]
+            for t in _iter_file_tables(
+                    f, self.data_schema, self.partition_schema, self.filters,
+                    self.max_batch_rows, self.max_batch_bytes,
+                    device_dict=self.device_dict,
+                    device_rle=self.device_rle, unifier=unifier,
+                    groups=sorted(by_file[fi])):
+                yield fill_file_meta(t, f, self.output)
 
     #: TPU scans flip this on (per conf) so fixed-width columns arrive
     #: dictionary-encoded and decode on device

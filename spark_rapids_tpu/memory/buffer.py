@@ -63,17 +63,20 @@ def _flatten_device(batch: DeviceBatch) -> Tuple[List, Tuple[bool, ...]]:
 
 
 def _rebuild(schema: Schema, arrays: List, num_rows: int,
-             bits_mask: Tuple[bool, ...] = ()) -> DeviceBatch:
+             bits_mask: Tuple[bool, ...] = (),
+             encodings: Tuple = ()) -> DeviceBatch:
     cols, i = [], 0
     for j, f in enumerate(schema):
         has_bits = bool(bits_mask) and bits_mask[j]
+        enc = encodings[j] if encodings else None
         if f.dtype is DType.STRING:
             cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1],
-                                     arrays[i + 2]))
+                                     arrays[i + 2], encoding=enc))
             i += 3
         else:
             cols.append(DeviceColumn(f.dtype, arrays[i], arrays[i + 1],
-                                     bits=arrays[i + 2] if has_bits else None))
+                                     bits=arrays[i + 2] if has_bits else None,
+                                     encoding=enc))
             i += 2 + has_bits
     return DeviceBatch(schema, tuple(cols), num_rows)
 
@@ -85,13 +88,19 @@ class SpillableBuffer(Retainable):
 
     def __init__(self, buffer_id: BufferId, schema: Schema, num_rows: int,
                  tier: StorageTier, payload, size_bytes: int,
-                 spill_priority: float, bits_mask: Tuple[bool, ...] = ()):
+                 spill_priority: float, bits_mask: Tuple[bool, ...] = (),
+                 encodings: Tuple = ()):
         super().__init__()
         self.id = buffer_id
         self.schema = schema
         self.num_rows = num_rows
         self.tier = tier
         self.payload = payload          # device arrays | numpy arrays | file path
+        #: per-column DictEncoding (or None) carried ONLY on the device
+        #: tier: a resident shuffle-cached batch keeps its encoded form so
+        #: encoded-domain operators survive the exchange; spilling drops it
+        #: (the decoded arrays are the lossless representation)
+        self.encodings = encodings
         self.size_bytes = size_bytes
         self.spill_priority = spill_priority
         self.bits_mask = bits_mask      # per-column f64 bits-sibling presence
@@ -107,7 +116,7 @@ class SpillableBuffer(Retainable):
         import jax.numpy as jnp
         if self.tier == StorageTier.DEVICE:
             return _rebuild(self.schema, self.payload, self.num_rows,
-                            self.bits_mask)
+                            self.bits_mask, self.encodings)
         arrays = self._host_arrays()
         cols, i = [], 0
         for j, f in enumerate(self.schema):
@@ -218,6 +227,7 @@ class SpillableBuffer(Retainable):
             except OSError:
                 pass
         self.payload = None
+        self.encodings = ()
 
     @staticmethod
     def from_batch(buffer_id: BufferId, batch: DeviceBatch,
@@ -226,4 +236,6 @@ class SpillableBuffer(Retainable):
         return SpillableBuffer(buffer_id, batch.schema, batch.num_rows,
                                StorageTier.DEVICE, arrays,
                                batch.device_size_bytes, spill_priority,
-                               bits_mask)
+                               bits_mask,
+                               encodings=tuple(c.encoding
+                                               for c in batch.columns))

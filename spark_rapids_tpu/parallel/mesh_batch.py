@@ -146,8 +146,13 @@ def scatter_arrow(table: pa.Table, mesh: Mesh, string_max_bytes: int
 
 
 def scatter_device_batch(db: DeviceBatch, mesh: Mesh) -> MeshBatch:
-    """Single-device batch -> mesh batch (host staging; the entry path for
-    small single-device intermediates joining a mesh pipeline)."""
+    """Single-device batch -> mesh batch: the EXPLICIT reshard (host
+    staging; the entry path for small single-device intermediates joining a
+    mesh pipeline). This is a deliberate host hop and counts as one —
+    in-mesh exchanges must never route through here (host_hop_bytes == 0 on
+    the all_to_all path is a CI assert)."""
+    from spark_rapids_tpu.utils import metrics as um
+    um.TRANSFER_METRICS[um.TRANSFER_HOST_HOP_BYTES].add(db.device_size_bytes)
     return scatter_arrow(db.to_arrow(), mesh, _string_width(db))
 
 

@@ -1,0 +1,145 @@
+"""Placement: where a batch's buffers live, as a first-class plan property.
+
+The reference binds an operator to a device implicitly (one GPU per executor
+process, GpuDeviceManager.scala); this engine makes placement explicit and
+carries it through planning as a ``jax.sharding.Sharding``:
+
+- ``None``                      — the process default device (legacy behavior);
+- ``SingleDeviceSharding(d)``   — a pinned single device (multi-device task
+  scheduling, the PR 3 ``ExecContext.device`` role);
+- ``NamedSharding(mesh, P('data'))`` — rows partitioned over the mesh data
+  axis (mesh execution; exchanges are in-mesh collectives);
+- ``NamedSharding(mesh, P())``  — replicated across the mesh (broadcast
+  builds, range bounds).
+
+``jax.device_put`` accepts any of these as its placement argument, so one
+upload path (columnar/transfer.py, the PR 3 pipeline) serves every operator:
+operators are placement-agnostic and the PLANNER (plan/mesh_rewrite.py)
+decides where batches land.
+
+The ICI-vs-DCN boundary also lives here: collective exchange (all_to_all,
+all-gather) must ride the interconnect, so the planner clips its mesh to one
+ICI domain (``ici_groups``); the PR 2 fault-tolerant TCP stack is reserved
+for cross-slice (DCN) shuffle.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import SingleDeviceSharding
+
+
+def as_placement(device_or_sharding: Any) -> Optional[jax.sharding.Sharding]:
+    """Normalize the legacy ``device=`` argument into a placement: a raw
+    ``jax.Device`` becomes a SingleDeviceSharding, a Sharding passes through,
+    None (process default) stays None."""
+    x = device_or_sharding
+    if x is None or isinstance(x, jax.sharding.Sharding):
+        return x
+    return SingleDeviceSharding(x)
+
+
+def placement_devices(p: Optional[jax.sharding.Sharding]) -> Tuple:
+    """The devices a placement covers (empty tuple for the default)."""
+    if p is None:
+        return ()
+    return tuple(p.device_set)
+
+
+def placement_device(p: Optional[jax.sharding.Sharding]):
+    """The single device of a one-device placement, else None (callers that
+    genuinely need ONE device — e.g. a host-staged writer — must gather or
+    reshard first; a multi-device placement has no canonical device)."""
+    devs = placement_devices(p)
+    return devs[0] if len(devs) == 1 else None
+
+
+def is_sharded(p: Optional[jax.sharding.Sharding]) -> bool:
+    """True when the placement partitions data over more than one device
+    (a replicated multi-device sharding counts: its buffers live on every
+    device and single-device code must not consume it blindly)."""
+    return p is not None and len(p.device_set) > 1
+
+
+def array_placement(arr: Any) -> Optional[jax.sharding.Sharding]:
+    """The committed sharding of a jax array (None for host/numpy arrays)."""
+    return getattr(arr, "sharding", None)
+
+
+def batch_devices(batch) -> frozenset:
+    """Every device holding any buffer of a DeviceBatch."""
+    devs: set = set()
+    for c in batch.columns:
+        for arr in (c.data, c.validity, c.lengths):
+            s = array_placement(arr)
+            if s is not None:
+                devs |= set(s.device_set)
+    return frozenset(devs)
+
+
+def assert_unsharded(batches: Sequence, op: str) -> None:
+    """Refuse to silently gather mesh-sharded buffers onto one device.
+
+    Single-device repack paths (``concat_device_batches`` and friends) would
+    otherwise pull every shard of a NamedSharding array through XLA's implicit
+    resharding — a hidden host-scale data movement. The explicit boundaries
+    are ``MeshGatherExec`` / ``parallel.mesh_batch.gather_mesh`` (collective
+    gather) and ``scatter_device_batch`` (reshard onto the mesh)."""
+    for b in batches:
+        devs = batch_devices(b)
+        if len(devs) > 1:
+            raise ValueError(
+                f"{op} received a batch sharded over {len(devs)} devices; "
+                "gather it explicitly (MeshGatherExec / gather_mesh) or "
+                "reshard (scatter_device_batch) instead of silently "
+                "collapsing the mesh onto one device")
+
+
+def placement_label(p: Optional[jax.sharding.Sharding]) -> str:
+    """Compact human label for plan display (tree_string)."""
+    if p is None:
+        return "default"
+    devs = placement_devices(p)
+    if len(devs) == 1:
+        return f"device:{devs[0]}"
+    if isinstance(p, NamedSharding):
+        spec = tuple(p.spec)
+        kind = "replicated" if not any(spec) else f"P{spec}"
+        return f"mesh[{len(devs)}]:{kind}"
+    return f"sharded[{len(devs)}]"
+
+
+# ------------------------------------------------------------------ ICI / DCN
+def _ici_key(d) -> Tuple:
+    """Devices sharing this key are connected by ICI (one pod slice on one
+    process group); differing keys can only reach each other over DCN."""
+    return (getattr(d, "slice_index", None), d.process_index)
+
+
+def ici_groups(devices: Sequence) -> List[List]:
+    """Partition devices into ICI domains, preserving order within each.
+
+    TPU runtimes expose ``slice_index`` per device (one pod slice = one ICI
+    domain); backends without it fall back to process_index — devices owned
+    by different hosts without a shared slice can only exchange over DCN."""
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(_ici_key(d), []).append(d)
+    return list(groups.values())
+
+
+def largest_ici_group(devices: Sequence) -> List:
+    """The biggest single-ICI-domain subset — the widest mesh whose
+    collectives never touch DCN."""
+    groups = ici_groups(devices)
+    return max(groups, key=len) if groups else []
+
+
+def spans_dcn(devices: Sequence) -> bool:
+    """True when the device set crosses an ICI boundary: a collective over
+    it would ride DCN, which belongs to the fault-tolerant TCP shuffle
+    (shuffle/tcp.py), not to an in-mesh all_to_all."""
+    return len(ici_groups(devices)) > 1

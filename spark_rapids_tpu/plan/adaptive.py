@@ -48,7 +48,8 @@ class CustomShuffleReaderExecBase(PhysicalExec):
             sub = ExecContext(ctx.conf, partition_id=pid,
                               num_partitions=exchange.num_partitions,
                               device_manager=ctx.device_manager,
-                              cleanups=ctx.cleanups)
+                              cleanups=ctx.cleanups,
+                              placement=ctx.placement)
             for batch in exchange.execute(sub):
                 self.count_output(batch.num_rows)
                 yield batch

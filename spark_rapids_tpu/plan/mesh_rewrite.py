@@ -43,17 +43,27 @@ def _is_mesh(node: PhysicalExec) -> bool:
 
 def mesh_rewrite(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
     """Lower device subtrees onto the session mesh (no-op when disabled or
-    fewer than 2 devices)."""
+    fewer than 2 devices).
+
+    The collective mesh is clipped to ONE ICI domain (sql.mesh.requireIci):
+    in-mesh all_to_all / all-gather exchanges ride the interconnect only;
+    crossing a slice/process boundary (DCN) is the job of the
+    fault-tolerant TCP shuffle stack (shuffle/tcp.py + retry/checksums),
+    not of an XLA collective."""
     if not conf.get(cfg.MESH_ENABLED):
         return plan
     import jax
+    from spark_rapids_tpu.parallel import placement as pl
     from spark_rapids_tpu.parallel.mesh import make_mesh
-    n = conf.get(cfg.MESH_NUM_DEVICES) or len(jax.devices())
-    n = min(n, len(jax.devices()))
+    devs = list(jax.devices())
+    if conf.get(cfg.MESH_REQUIRE_ICI):
+        devs = pl.largest_ici_group(devs)
+    n = conf.get(cfg.MESH_NUM_DEVICES) or len(devs)
+    n = min(n, len(devs))
     if n < 2:
         return plan
-    mesh = make_mesh(n)
-    return _rewrite(plan, mesh)
+    mesh = make_mesh(n, devices=devs)
+    return _rewrite(plan, mesh, conf)
 
 
 def _gathered(node: PhysicalExec, mesh) -> PhysicalExec:
@@ -84,7 +94,7 @@ def _meshed(node: PhysicalExec, mesh) -> Optional[PhysicalExec]:
     return None
 
 
-def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
+def _rewrite(node: PhysicalExec, mesh, conf=None) -> PhysicalExec:
     from spark_rapids_tpu.execs.exchange_execs import (HashPartitioning,
                                                        RoundRobinPartitioning,
                                                        TpuBroadcastExchangeExec,
@@ -93,18 +103,21 @@ def _rewrite(node: PhysicalExec, mesh) -> PhysicalExec:
                                                    TpuBroadcastHashJoinExec,
                                                    TpuShuffledHashJoinExec)
 
-    kids = [_rewrite(c, mesh) for c in node.children]
+    kids = [_rewrite(c, mesh, conf) for c in node.children]
 
     # ---- scans --------------------------------------------------------------
     if getattr(node, "is_file_scan", False) and getattr(node, "is_device",
                                                         False):
-        # device file scan: shard-local reads straight onto the mesh
-        return me.MeshFileScatterExec(node, mesh)
+        # device file scan: shard-local reads straight onto the mesh, with
+        # the row-group -> shard split decided HERE at plan time
+        return me.MeshFileScatterExec(node, mesh,
+                                      me.plan_scan_shards(node, mesh, conf))
 
     # ---- transitions --------------------------------------------------------
     if isinstance(node, te.HostToDeviceExec):
         if getattr(kids[0], "is_file_scan", False):
-            return me.MeshFileScatterExec(kids[0], mesh)
+            return me.MeshFileScatterExec(
+                kids[0], mesh, me.plan_scan_shards(kids[0], mesh, conf))
         return me.MeshScatterExec(kids[0], mesh)
     if isinstance(node, te.DeviceToHostExec):
         return te.DeviceToHostExec(_gathered(kids[0], mesh))

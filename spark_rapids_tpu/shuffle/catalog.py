@@ -47,8 +47,15 @@ class ShuffleBufferCatalog:
         self._device_store.add_batch(buffer_id, batch,
                                      spill_priority=SHUFFLE_BUFFER_PRIORITY)
         with self._lock:
-            self._blocks.setdefault(block, []).append((buffer_id, meta))
-            self._by_shuffle.setdefault(block.shuffle_id, []).append(block)
+            entries = self._blocks.setdefault(block, [])
+            if not entries:
+                # one index entry per block id: a map task emitting SEVERAL
+                # batches for the same (map, partition) block appends extra
+                # buffers to the block, not duplicate index entries —
+                # blocks_for_partition would otherwise hand consumers the
+                # block once per batch and every buffer re-reads N times
+                self._by_shuffle.setdefault(block.shuffle_id, []).append(block)
+            entries.append((buffer_id, meta))
         return buffer_id
 
     def blocks_for_partition(self, shuffle_id: int,

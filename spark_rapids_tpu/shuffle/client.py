@@ -294,6 +294,10 @@ class ShuffleClient:
                         verify_checksum(wire, resp.checksum,
                                         context=f"{p.block} table {p.table_idx}")
                     raw, meta = decompress_batch(wire, resp.meta)
+                    # shuffle payload crossed the host (DCN/TCP path): the
+                    # in-mesh all_to_all exchange never reaches here
+                    mt.TRANSFER_METRICS[mt.TRANSFER_HOST_HOP_BYTES].add(
+                        len(raw))
                     rid = self.received.add(raw, meta)
                 except ChecksumError as e:
                     fail_or_retry(str(e), corrupt=True)

@@ -59,12 +59,23 @@ TRANSFER_DECODED_EQUIV_BYTES = "transfer.decoded_equivalent_bytes"
 #: batch programs that ran a filter/group-by/join on the encoded domain
 #: (dictionary indices) instead of decoded values (exprs/encoded.py)
 TRANSFER_ENCODED_DOMAIN_OPS = "transfer.encoded_domain_ops"
+#: bytes of EXCHANGE data that bounced through the host (device -> host ->
+#: device) instead of riding an in-mesh collective: the scatter of a
+#: single-device intermediate onto the mesh, and TCP shuffle payloads (the
+#: DCN path). The in-mesh all_to_all exchange keeps this at EXACTLY 0 —
+#: only per-shard row COUNTS sync to the host, never row data (the bench
+#: `mesh` section and CI assert the zero).
+TRANSFER_HOST_HOP_BYTES = "transfer.host_hop_bytes"
+#: shuffle exchanges that carried a column through partition/repack as
+#: dictionary indices + shared dictionary instead of decoded values
+TRANSFER_EXCHANGE_ENCODED_OPS = "transfer.exchange_encoded_ops"
 
 TRANSFER_METRIC_NAMES = (
     TRANSFER_UPLOAD_BYTES, TRANSFER_UPLOAD_SECONDS, TRANSFER_UPLOAD_CHUNKS,
     TRANSFER_DOWNLOAD_BYTES, TRANSFER_DOWNLOAD_SECONDS,
     TRANSFER_INFLIGHT_PEAK, TRANSFER_ENCODED_BYTES,
-    TRANSFER_DECODED_EQUIV_BYTES, TRANSFER_ENCODED_DOMAIN_OPS)
+    TRANSFER_DECODED_EQUIV_BYTES, TRANSFER_ENCODED_DOMAIN_OPS,
+    TRANSFER_HOST_HOP_BYTES, TRANSFER_EXCHANGE_ENCODED_OPS)
 
 
 class Metric:

@@ -1,0 +1,474 @@
+"""NamedSharding-first execution on the forced 8-device CPU mesh.
+
+The PR 7 acceptance suite: placement as a first-class ExecContext/plan
+property, plan-time row-group -> shard assignment with uploads landing
+directly on owning devices, the in-mesh all_to_all exchange with
+``host_hop_bytes == 0``, sharded-vs-single-device bit identity, dictionary
+encodings carried through exchange repack, the sharded-concat guard, and
+the ICI/DCN boundary rule."""
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import SingleDeviceSharding
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.parallel import placement as pl
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.utils import metrics as um
+
+MESH_CONF = {
+    "spark.rapids.tpu.sql.mesh.enabled": "true",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    "spark.rapids.tpu.sql.scanCache.enabled": "false",
+}
+SINGLE_CONF = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    "spark.rapids.tpu.sql.scanCache.enabled": "false",
+}
+
+
+def _rand_table(n=4000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 37, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        "x": rng.random(n),
+        "s": pa.array([f"cat{int(i)}" for i in rng.integers(0, 9, n)]),
+    })
+
+
+def _write_parquet(table, tmpdir, row_groups=16, **kw):
+    path = os.path.join(tmpdir, "t.parquet")
+    pq.write_table(table, path,
+                   row_group_size=max(1, table.num_rows // row_groups), **kw)
+    return path
+
+
+# ------------------------------------------------------------------ placement
+def test_as_placement_normalizes(eight_devices):
+    dev = eight_devices[3]
+    p = pl.as_placement(dev)
+    assert isinstance(p, SingleDeviceSharding)
+    assert pl.placement_device(p) is dev
+    assert pl.as_placement(None) is None
+    s = NamedSharding(jax.sharding.Mesh(np.array(eight_devices), ("data",)),
+                      P("data"))
+    assert pl.as_placement(s) is s
+    assert pl.is_sharded(s) and not pl.is_sharded(p)
+    assert pl.placement_device(s) is None
+
+
+def test_exec_context_device_is_placement(eight_devices):
+    from spark_rapids_tpu.execs.base import ExecContext
+    dev = eight_devices[5]
+    # legacy device= argument normalizes; ctx.device stays device_put-usable
+    ctx = ExecContext(device=dev)
+    assert isinstance(ctx.placement, SingleDeviceSharding)
+    arr = jax.device_put(np.arange(8), ctx.device)
+    assert set(arr.sharding.device_set) == {dev}
+    mesh = jax.sharding.Mesh(np.array(eight_devices), ("data",))
+    ctx2 = ExecContext(placement=NamedSharding(mesh, P("data")))
+    assert pl.is_sharded(ctx2.placement)
+
+
+def test_upload_lands_on_placement(eight_devices):
+    from spark_rapids_tpu.columnar.transfer import upload_table
+    dev = eight_devices[6]
+    b = upload_table(_rand_table(256), 16,
+                     device=SingleDeviceSharding(dev))
+    for c in b.columns:
+        assert set(c.data.sharding.device_set) == {dev}
+
+
+def test_placement_label(eight_devices):
+    mesh = jax.sharding.Mesh(np.array(eight_devices), ("data",))
+    assert pl.placement_label(None) == "default"
+    assert pl.placement_label(
+        NamedSharding(mesh, P("data"))).startswith("mesh[8]:P")
+    assert pl.placement_label(NamedSharding(mesh, P())) == \
+        "mesh[8]:replicated"
+    assert pl.placement_label(
+        SingleDeviceSharding(eight_devices[0])).startswith("device:")
+
+
+# ------------------------------------------------------------------ ICI / DCN
+class _FakeDev:
+    def __init__(self, process_index, slice_index=None):
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+
+def test_ici_groups_by_slice_and_process():
+    devs = [_FakeDev(0, 0), _FakeDev(0, 0), _FakeDev(0, 1), _FakeDev(1, 1)]
+    groups = pl.ici_groups(devs)
+    assert sorted(len(g) for g in groups) == [1, 1, 2]
+    assert pl.spans_dcn(devs)
+    assert len(pl.largest_ici_group(devs)) == 2
+    # one host, no slice attr (CPU backend): a single ICI domain
+    cpu = [_FakeDev(0) for _ in range(8)]
+    assert not pl.spans_dcn(cpu)
+    assert pl.largest_ici_group(cpu) == cpu
+
+
+def test_mesh_rewrite_respects_require_ici(eight_devices):
+    """All 8 virtual CPU devices share process 0 / no slice: one ICI domain,
+    so requireIci keeps the full mesh (clipping only bites on multi-slice
+    topologies, where the TCP stack owns the DCN hop)."""
+    s = TpuSession(MESH_CONF)
+    out = s.create_dataframe(_rand_table(512)).groupBy("k").agg(
+        F.sum("v").alias("sv")).collect()
+    plan = s.last_plan.tree_string()
+    assert "MeshHashAggregateExec" in plan, plan
+    assert "mesh[8]" in plan, plan    # placement annotation, full domain
+    assert out.num_rows == 37
+
+
+# ------------------------------------------------------- plan-time assignment
+def test_row_group_units_from_footer(eight_devices):
+    t = _rand_table(3200)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write_parquet(t, tmp, row_groups=16)
+        s = TpuSession(SINGLE_CONF)
+        df = s.read.parquet(path)
+        scan = df._executed_plan()
+        while not getattr(scan, "is_file_scan", False):
+            scan = scan.children[0]
+        units = scan.row_group_units()
+        assert len(units) == 16
+        assert sum(rows for _, _, rows in units) == t.num_rows
+        assert all(fi == 0 for fi, _, _ in units)
+
+
+def test_plan_time_shard_assignment_balances(eight_devices):
+    from spark_rapids_tpu.execs.mesh_execs import MeshFileScatterExec
+    t = _rand_table(3200)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write_parquet(t, tmp, row_groups=16)
+        s = TpuSession(MESH_CONF)
+        out = s.read.parquet(path).groupBy("k").agg(
+            F.count("v").alias("c")).collect()
+        node = s.last_plan
+        stack = [node]
+        scatter = None
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, MeshFileScatterExec):
+                scatter = nd
+            stack.extend(nd.children)
+        assert scatter is not None, s.last_plan.tree_string()
+        a = scatter.assignment
+        assert a is not None, "plan-time assignment missing"
+        assert sum(a.rows) == t.num_rows
+        # LPT over 16 equal groups on 8 shards: 2 groups per shard
+        assert all(len(u) == 2 for u in a.units)
+        assert max(a.rows) - min(a.rows) <= max(a.rows) // 4
+        cpu = TpuSession({**SINGLE_CONF,
+                          "spark.rapids.tpu.sql.enabled": "false"})
+        ref = cpu.read.parquet(path).groupBy("k").agg(
+            F.count("v").alias("c")).collect()
+        assert_tables_equal(ref, out, ignore_order=True)
+
+
+def test_assigned_scan_lands_sharded(eight_devices):
+    """Executing the planned scatter yields a MeshBatch whose buffers are
+    committed NamedSharding arrays over all 8 devices — the scan uploaded
+    each shard straight to its owner, no host-side whole-table staging."""
+    from spark_rapids_tpu.execs.base import ExecContext
+    from spark_rapids_tpu.execs.mesh_execs import MeshFileScatterExec
+    t = _rand_table(3200)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write_parquet(t, tmp, row_groups=16)
+        s = TpuSession(MESH_CONF)
+        s.read.parquet(path).groupBy("k").agg(
+            F.count("v").alias("c")).collect()
+        stack, scatter = [s.last_plan], None
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, MeshFileScatterExec):
+                scatter = nd
+            stack.extend(nd.children)
+        (mb,) = list(scatter.execute(ExecContext(s.conf)))
+        assert mb.num_rows == t.num_rows
+        for c in mb.columns:
+            assert len(c.data.sharding.device_set) == 8, c.data.sharding
+            assert c.data.sharding.spec == P("data")
+        # declared placement matches what landed
+        assert pl.is_sharded(scatter.placement)
+
+
+def test_file_granularity_conf_disables_plan_assignment(eight_devices):
+    from spark_rapids_tpu.execs.mesh_execs import MeshFileScatterExec
+    t = _rand_table(800)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write_parquet(t, tmp, row_groups=4)
+        s = TpuSession({**MESH_CONF,
+                        "spark.rapids.tpu.sql.mesh.scan.shardAssignment":
+                            "file"})
+        out = s.read.parquet(path).groupBy("k").agg(
+            F.count("v").alias("c")).collect()
+        stack, scatter = [s.last_plan], None
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, MeshFileScatterExec):
+                scatter = nd
+            stack.extend(nd.children)
+        assert scatter is not None and scatter.assignment is None
+        assert out.num_rows == 37
+
+
+def test_assigned_scan_mixed_encodings_per_shard(eight_devices):
+    """Regression: one shard's row groups can yield DIFFERENT arrow
+    encodings (dictionary vs plain vs REE) — they cannot concatenate as
+    host tables, so the assigned path must upload per unit and combine on
+    device. NaN/null/unicode ride along."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    t = pa.table({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "x": pa.array([float("nan") if i % 211 == 0 else v
+                       for i, v in enumerate(rng.random(n))]),
+        "s": pa.array([None if i % 89 == 0 else f"véç{int(i % 7)}"
+                       for i in range(n)])})
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "t.parquet")
+        pq.write_table(t, path, row_group_size=n // 9, use_dictionary=True)
+        s = TpuSession(MESH_CONF)
+        out = (s.read.parquet(path).filter(F.col("s").isNotNull())
+               .groupBy("s").agg(F.count("x").alias("c")).collect())
+        cpu = TpuSession({**SINGLE_CONF,
+                          "spark.rapids.tpu.sql.enabled": "false"})
+        ref = (cpu.read.parquet(path).filter(F.col("s").isNotNull())
+               .groupBy("s").agg(F.count("x").alias("c")).collect())
+        assert_tables_equal(ref, out, ignore_order=True)
+        assert "MeshFileScatterExec" in s.last_plan.tree_string()
+
+
+# ------------------------------------------------------------- host_hop_bytes
+def test_in_mesh_exchange_zero_host_hop(eight_devices):
+    from spark_rapids_tpu.execs import mesh_execs as me
+    from spark_rapids_tpu.exprs.core import BoundReference
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.mesh_batch import scatter_arrow
+    mesh = make_mesh(8)
+    mb = scatter_arrow(_rand_table(2048), mesh, 16)
+    key = BoundReference(0, mb.schema.fields[0].dtype, False)
+    hop = um.TRANSFER_METRICS[um.TRANSFER_HOST_HOP_BYTES]
+    before = hop.value
+    out = me._mesh_repartition(
+        mb, ("t_zero_hop", mb.schema, mb.local_capacity),
+        me._hash_pid_builder((key,), 8), smax=16)
+    assert out.num_rows == mb.num_rows
+    assert hop.value - before == 0, "all_to_all exchange touched the host"
+
+
+def test_scatter_device_batch_counts_host_hop(eight_devices):
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.mesh_batch import scatter_device_batch
+    db = DeviceBatch.from_arrow(_rand_table(512), 16)
+    hop = um.TRANSFER_METRICS[um.TRANSFER_HOST_HOP_BYTES]
+    before = hop.value
+    mb = scatter_device_batch(db, make_mesh(8))
+    assert mb.num_rows == 512
+    assert hop.value - before >= db.device_size_bytes
+
+
+def test_mesh_query_zero_host_hop(eight_devices):
+    """A whole sharded query (scan -> filter -> hash exchange -> aggregate)
+    moves NO exchange data through the host: scatter is an upload, the
+    exchange is an all_to_all, only row counts sync."""
+    t = _rand_table(4000)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write_parquet(t, tmp, row_groups=8)
+        s = TpuSession({**MESH_CONF,
+                        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes":
+                            "1"})
+        hop = um.TRANSFER_METRICS[um.TRANSFER_HOST_HOP_BYTES]
+        before = hop.value
+        out = (s.read.parquet(path).filter(F.col("v") > F.lit(0))
+               .groupBy("s").agg(F.sum("v").alias("sv")).collect())
+        assert out.num_rows > 0
+        plan = s.last_plan.tree_string()
+        assert "MeshHashAggregateExec" in plan, plan
+        assert hop.value - before == 0
+
+
+# ------------------------------------------------------------- bit identity
+def test_sharded_projection_collect_bit_identical(eight_devices):
+    t = _rand_table(4000)
+    def q(sess):
+        return (sess.create_dataframe(t)
+                .filter(F.col("v") > F.lit(100))
+                .select("k", "x", "s"))
+    mesh = q(TpuSession(MESH_CONF)).collect()
+    single = q(TpuSession(SINGLE_CONF)).collect()
+    assert mesh.equals(single), "permute-only pipeline must be bitwise equal"
+
+
+def test_sharded_q1_vs_single_device(eight_devices):
+    """Sharded TPC-H Q1: every non-float column bitwise identical; float
+    aggregates (per-shard partials merged in shard order) agree to 1e-9 —
+    the distributed-float-sum contract documented in
+    docs/mesh-execution.md."""
+    from spark_rapids_tpu.benchmarks.tpch import gen_lineitem, q1
+    t = gen_lineitem(scale=0.002, seed=42)
+    conf_extra = {"spark.rapids.tpu.sql.string.maxBytes": "16"}
+    mesh = q1(TpuSession({**MESH_CONF, **conf_extra})
+              .create_dataframe(t)).collect()
+    single = q1(TpuSession({**SINGLE_CONF, **conf_extra})
+                .create_dataframe(t)).collect()
+    assert mesh.num_rows == single.num_rows
+    max_rel = 0.0
+    for name in single.column_names:
+        cs, cm = single[name], mesh[name]
+        if pa.types.is_floating(cs.type):
+            a = cs.to_numpy(zero_copy_only=False)
+            b = cm.to_numpy(zero_copy_only=False)
+            max_rel = max(max_rel, float(np.max(
+                np.abs(a - b) / np.maximum(np.abs(a), 1e-300))))
+        else:
+            assert cs.equals(cm), f"non-float column {name} differs"
+    assert max_rel < 1e-9, max_rel
+
+
+# ------------------------------------------------------------ encoding carry
+def _dict_parquet(tmp, n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "k": rng.integers(0, 12, n).astype(np.int64),
+        "s": pa.array([f"cat{int(i)}" for i in rng.integers(0, 9, n)]),
+        "v": rng.integers(-100, 100, n).astype(np.int64)})
+    path = os.path.join(tmp, "t.parquet")
+    pq.write_table(t, path, row_group_size=n // 4, use_dictionary=True)
+    return t, path
+
+
+def test_exchange_carries_encoding(eight_devices):
+    """Repartition over a dictionary-encoded scan: the exchange moves int32
+    indices (transfer.exchange_encoded_ops fires), the multiset of rows is
+    exactly preserved, and results downstream of the exchange match CPU."""
+    import collections
+    with tempfile.TemporaryDirectory() as tmp:
+        t, path = _dict_parquet(tmp)
+        s = TpuSession(SINGLE_CONF)
+        enc_ops = um.TRANSFER_METRICS[um.TRANSFER_EXCHANGE_ENCODED_OPS]
+        before = enc_ops.value
+        out = s.read.parquet(path).repartition(4, "s").collect()
+        assert enc_ops.value - before >= 1, "encoded exchange never fired"
+        co = collections.Counter(zip(out["k"].to_pylist(),
+                                     out["s"].to_pylist(),
+                                     out["v"].to_pylist()))
+        ct = collections.Counter(zip(t["k"].to_pylist(),
+                                     t["s"].to_pylist(),
+                                     t["v"].to_pylist()))
+        assert co == ct, "exchange changed the row multiset"
+        cpu = TpuSession({**SINGLE_CONF,
+                          "spark.rapids.tpu.sql.enabled": "false"})
+        ref = (cpu.read.parquet(path).repartition(4, "s").groupBy("s")
+               .agg(F.sum("v").alias("sv")).collect())
+        got = (s.read.parquet(path).repartition(4, "s").groupBy("s")
+               .agg(F.sum("v").alias("sv")).collect())
+        assert_tables_equal(ref, got, ignore_order=True)
+
+
+def test_exchange_pieces_keep_token_and_invariant(eight_devices):
+    """Exec-level check: output pieces of an encoded exchange carry the SAME
+    dictionary token and satisfy data == take(values, indices) row-wise."""
+    from spark_rapids_tpu.execs.base import ExecContext, LeafExec
+    from spark_rapids_tpu.execs.exchange_execs import (HashPartitioning,
+                                                       TpuShuffleExchangeExec)
+    from spark_rapids_tpu.exprs.core import BoundReference
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    with tempfile.TemporaryDirectory() as tmp:
+        t, path = _dict_parquet(tmp, n=1000)
+        s = TpuSession(SINGLE_CONF)
+        df = s.read.parquet(path)
+        scan = df._executed_plan()
+        while not getattr(scan, "is_device", False):
+            scan = scan.children[0]
+        ctx = ExecContext(s.conf,
+                          device_manager=DeviceManager.initialize(s.conf))
+        batches = list(scan.execute(ctx))
+        enc_cols = [ci for ci, c in enumerate(batches[0].columns)
+                    if c.encoding is not None and c.encoding.token]
+        assert enc_cols, "scan produced no token-carrying encodings"
+        src_tokens = {ci: batches[0].columns[ci].encoding.token
+                      for ci in enc_cols}
+
+        class _Resident(LeafExec):
+            is_device = True
+            num_partitions = 1
+
+            def execute(self, _ctx):
+                yield from iter(batches)
+
+        key = BoundReference(1, batches[0].schema.fields[1].dtype, True)
+        exchange = TpuShuffleExchangeExec(HashPartitioning(4, (key,)),
+                                          _Resident(batches[0].schema))
+        cleanups = []
+        total = 0
+        for p in range(4):
+            cctx = ExecContext(s.conf, partition_id=p, num_partitions=4,
+                               device_manager=ctx.device_manager,
+                               cleanups=cleanups)
+            for piece in exchange.execute(cctx):
+                total += piece.num_rows
+                for ci in enc_cols:
+                    e = piece.columns[ci].encoding
+                    assert e is not None, "piece dropped the encoding"
+                    assert e.token == src_tokens[ci]
+                    n = piece.num_rows
+                    data = np.asarray(piece.columns[ci].data)[:n]
+                    vals = np.asarray(e.values)
+                    idx = np.asarray(e.indices)[:n]
+                    np.testing.assert_array_equal(
+                        data, vals[idx], err_msg="piece invariant broken")
+        assert total == sum(b.num_rows for b in batches)
+        for fn in cleanups:
+            fn()
+
+
+def test_catalog_multi_batch_block_no_duplication(eight_devices):
+    """Regression: a map task emitting several batches for one (map,
+    partition) block must not index the block once per batch — consumers
+    were re-reading every buffer N times (rows multiplied N-fold on any
+    multi-row-group repartition)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        t, path = _dict_parquet(tmp)
+        s = TpuSession({**SINGLE_CONF,
+                        "spark.rapids.tpu.sql.exchange.keepEncodings":
+                            "false"})
+        out = s.read.parquet(path).repartition(4, "s").collect()
+        assert out.num_rows == t.num_rows
+
+
+# -------------------------------------------------------------- concat guard
+def test_concat_refuses_sharded_batch(eight_devices):
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.execs.tpu_execs import concat_device_batches
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.mesh_batch import scatter_arrow
+    mesh = make_mesh(8)
+    mb = scatter_arrow(_rand_table(1024), mesh, 16)
+    sharded = DeviceBatch(mb.schema, mb.columns, mb.num_rows)
+    plain = DeviceBatch.from_arrow(_rand_table(64, seed=3), 16)
+    with pytest.raises(ValueError, match="gather it explicitly"):
+        concat_device_batches([sharded, plain], sharded.schema, 16)
+    with pytest.raises(ValueError, match="gather it explicitly"):
+        concat_device_batches([sharded], sharded.schema, 16)
+    # the EXPLICIT paths still work
+    from spark_rapids_tpu.parallel.mesh_batch import gather_mesh
+    db = gather_mesh(mb)
+    assert db.num_rows == mb.num_rows
+    out = concat_device_batches([db, plain], db.schema, 16)
+    assert out.num_rows == db.num_rows + plain.num_rows
