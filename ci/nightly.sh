@@ -40,6 +40,19 @@ for key in ("link_bytes_encoded", "link_bytes_decoded", "link_bytes_ratio",
     assert key in comp, f"missing compression breakdown key {key}: {comp}"
 assert comp["link_bytes_ratio"] < 1.0, comp
 assert comp["encoded_domain_ops"] >= 1, comp
+conc = out["breakdown"]["concurrent"]
+for key in ("queries", "sequential_rows_per_sec", "aggregate_rows_per_sec",
+            "aggregate_vs_sequential_x", "p50_latency_s", "p99_latency_s",
+            "program_cache_hit_rate", "warm_start"):
+    assert key in conc, f"missing concurrent breakdown key {key}: {conc}"
+assert conc["queries"] >= 16, conc
+# serving acceptance: 16 interleaved queries hold >= 0.9x sequential
+# aggregate throughput, the repeat mix hits the program cache >= 50%, and
+# a second server process warm-starts from the on-disk index
+assert conc["aggregate_vs_sequential_x"] >= 0.9, conc
+assert conc["program_cache_hit_rate"] >= 0.5, conc
+assert conc["warm_start"]["disk_hits"] >= 1, conc
+assert conc["p99_latency_s"] >= conc["p50_latency_s"] > 0, conc
 mesh = out["breakdown"]["mesh"]
 for key in ("devices", "in_mesh_exchange_gb_per_sec",
             "single_device_exchange_gb_per_sec",
@@ -60,6 +73,10 @@ print("bench smoke OK:", {k: pipe[k] for k in
                           ("upload_chunked_s", "upload_overlap_efficiency",
                            "inflight_high_water")},
       {k: comp[k] for k in ("link_bytes_ratio", "encoded_domain_ops")},
+      {k: conc[k] for k in ("aggregate_vs_sequential_x",
+                            "program_cache_hit_rate", "p50_latency_s",
+                            "p99_latency_s")},
+      {"warm_start_disk_hits": conc["warm_start"]["disk_hits"]},
       {k: mesh[k] for k in ("in_mesh_exchange_gb_per_sec",
                             "in_mesh_vs_host_hop_x", "host_hop_bytes")})
 PY

@@ -20,6 +20,32 @@ python -m pytest tests/ -x -q -m "not slow"
 echo "== API surface validation =="
 python -m spark_rapids_tpu.api_validation
 
+echo "== serving smoke (4 concurrent queries through the scheduler) =="
+python - << 'PY'
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.serving import QueryState
+
+rng = np.random.default_rng(7)
+table = pa.table({"k": rng.integers(0, 8, 4096).astype("int64"),
+                  "v": rng.random(4096)})
+sess = TpuSession({
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    "spark.rapids.tpu.serving.maxConcurrentQueries": "4"})
+df = (sess.create_dataframe(table).filter(F.col("v") > 0.25)
+      .groupBy("k").agg(F.sum("v").alias("s"), F.count(F.lit(1)).alias("c")))
+expected = df.collect()
+handles = [sess.submit(df, tenant=f"t{i % 2}") for i in range(4)]
+for h in handles:
+    assert h.result(timeout=300).equals(expected), h
+    assert h.state is QueryState.DONE, h
+stats = sess.scheduler.stats()
+assert stats["states"]["DONE"] == 4, stats
+assert stats["program_cache"]["hits"] > 0, stats
+print("serving smoke ok:", stats["program_cache"])
+PY
+
 echo "== multichip dry-run (8 virtual devices) =="
 python - << 'PY'
 import importlib.util

@@ -98,6 +98,7 @@ def _load_builtin_rules() -> None:
                                            rules_locks,      # noqa: F401
                                            rules_project,    # noqa: F401
                                            rules_recompile,  # noqa: F401
+                                           rules_serving,    # noqa: F401
                                            rules_sync)       # noqa: F401
 
 

@@ -1,0 +1,94 @@
+"""R007: program-cache bypass in exec execute paths.
+
+The serving layer's compile-once/serve-many contract (serving/
+program_cache.py) only holds when every program an operator builds at
+execute time routes through the cross-query cache — a direct ``jax.jit``
+at call time compiles privately: invisible to hit/miss accounting, never
+warmed from the on-disk index, and re-traced per exec instance. R001
+already catches per-iteration construction; R007 catches the serving
+regression: ANY jit construction reachable from an ``execute`` method in
+the exec layer that neither goes through the sanctioned cache entry
+points (``_cached_jit`` / ``_shard_jit`` / ``cached_program`` /
+``get_or_build``) nor sits in the keyed-cache guard idiom.
+
+Designed exceptions (a program that is genuinely per-query, e.g. keyed on
+runtime-only state) carry an inline ``# tpu-lint: disable=R007`` or a
+baseline entry with a written justification.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from spark_rapids_tpu.analysis.core import (Finding, Rule, SourceFile,
+                                            call_name, register)
+from spark_rapids_tpu.analysis.rules_recompile import (_in_cache_guard,
+                                                       is_jit_call)
+
+#: sanctioned cache entry points: a jit construction that is an argument
+#: (or lambda-argument body) of one of these is cached, not bypassing
+_CACHE_ROUTES = ("_cached_jit", "_shard_jit", "cached_program",
+                 "get_or_build")
+
+#: directories whose execute paths are in scope (the exec layer; ops/ and
+#: shuffle/ kernels are built through their own keyed caches and stay
+#: covered by R001's loop/immediate-invoke forms)
+_SCOPE_DIRS = ("execs",)
+
+
+def _in_scope(src: SourceFile) -> bool:
+    p = src.display_path.replace("\\", "/")
+    return any(f"/{d}/" in p or p.startswith(f"{d}/") for d in _SCOPE_DIRS)
+
+
+def _routed_through_cache(src: SourceFile, node: ast.Call) -> bool:
+    """True when the jit construction flows into a sanctioned cache entry
+    point: ``cache.get_or_build(key, lambda: jax.jit(...))`` or
+    ``_cached_jit(key, builder)``-style wrappers."""
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.Call):
+            name = call_name(anc)
+            if name.rsplit(".", 1)[-1] in _CACHE_ROUTES:
+                return True
+    return False
+
+
+def _enclosing_execute(src: SourceFile, node: ast.AST):
+    """The nearest enclosing ``execute`` FunctionDef (directly or through
+    nested defs/lambdas), or None when the node is not on an execute
+    path's lexical body."""
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                anc.name == "execute":
+            return anc
+    return None
+
+
+@register
+class ProgramCacheBypass(Rule):
+    rule_id = "R007"
+    title = "jit bypassing the cross-query program cache in execute paths"
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if not _in_scope(src):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not is_jit_call(node):
+                continue
+            if _enclosing_execute(src, node) is None:
+                continue
+            if _routed_through_cache(src, node):
+                continue
+            if _in_cache_guard(src, node):
+                continue    # the keyed-cache idiom compiles once per key
+            name = call_name(node) or "jit"
+            findings.append(src.finding(
+                self.rule_id, node,
+                f"{name}(...) constructed on an execute path without a "
+                f"cache key: the program bypasses the cross-query serving "
+                f"cache (no hit/miss accounting, no on-disk warm start, "
+                f"re-traced per exec instance); route it through "
+                f"_cached_jit / cached_program / get_or_build, or justify "
+                f"it in the baseline"))
+        return findings

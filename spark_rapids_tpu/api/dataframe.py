@@ -7,6 +7,7 @@ the will-run/fallback report like spark.rapids.sql.explain.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import pyarrow as pa
@@ -542,7 +543,7 @@ class DataFrame:
         return final
 
     def _run_partitions(self, final: PhysicalExec,
-                        capture_device: bool = False) -> List:
+                        capture_device: bool = False, query=None) -> List:
         """Execute and collect per-partition results as arrow tables. With
         ``capture_device`` (cache materialization), a single-process plan
         whose root is the download transition instead returns the raw
@@ -571,10 +572,18 @@ class DataFrame:
                                                     transfer_snapshot)
         trace = self.session.conf.get(_cfg.TRACE_ENABLED)
         transfer_before = transfer_snapshot()
+        import time as _time
+        tenant = query.tenant if query is not None else "default"
+        cancel = query.check_cancelled if query is not None else None
+        t_admit = _time.perf_counter()
         try:
-            # device-admission throttle for the whole task (GpuSemaphore analog)
-            with dm.semaphore.held(), NamedRange("tpu-sql-action",
-                                                 trace=trace):
+            # device-admission throttle for the whole task (GpuSemaphore
+            # analog), fair-shared by tenant; a cancelled query blocked on
+            # admission unwinds here instead of waiting for a permit
+            with dm.semaphore.held(tenant=tenant, cancel_check=cancel), \
+                    NamedRange("tpu-sql-action", trace=trace):
+                if query is not None:
+                    query.note_admission_wait(_time.perf_counter() - t_admit)
                 if self.session.conf.get(_cfg.ADAPTIVE_ENABLED) and \
                         not any(getattr(nd, "is_mesh", False)
                                 for nd in _iter_execs(final)):
@@ -586,7 +595,7 @@ class DataFrame:
                     stage_ctx = ExecContext(self.session.conf, partition_id=0,
                                             num_partitions=1,
                                             device_manager=dm,
-                                            cleanups=cleanups)
+                                            cleanups=cleanups, query=query)
                     final = adaptive_rewrite(final, stage_ctx)
                     self.session.last_plan = final
                 from spark_rapids_tpu.execs.tpu_execs import DeviceToHostExec
@@ -597,8 +606,11 @@ class DataFrame:
                     for p in range(final.num_partitions):
                         ctx = ExecContext(self.session.conf, partition_id=p,
                                           num_partitions=final.num_partitions,
-                                          device_manager=dm, cleanups=cleanups)
-                        tables.extend(final.execute(ctx))
+                                          device_manager=dm, cleanups=cleanups,
+                                          query=query)
+                        for b in final.execute(ctx):
+                            ctx.check_cancelled()
+                            tables.append(b)
                     return tables
                 stream = (
                     isinstance(final, DeviceToHostExec)
@@ -621,8 +633,9 @@ class DataFrame:
                         ctx = ExecContext(self.session.conf, partition_id=p,
                                           num_partitions=final.num_partitions,
                                           device_manager=dm,
-                                          cleanups=cleanups)
+                                          cleanups=cleanups, query=query)
                         for db in child.execute(ctx):
+                            ctx.check_cancelled()
                             final.count_output(db.num_rows)
                             pending.append(start_download(db))
                             while len(pending) > max_inflight:
@@ -633,23 +646,43 @@ class DataFrame:
                         ctx = ExecContext(self.session.conf, partition_id=p,
                                           num_partitions=final.num_partitions,
                                           device_manager=dm,
-                                          cleanups=cleanups)
-                        tables.extend(b.to_arrow()
-                                      for b in final.execute(ctx))
+                                          cleanups=cleanups, query=query)
+                        for b in final.execute(ctx):
+                            ctx.check_cancelled()
+                            tables.append(b.to_arrow())
         finally:
             for fn in cleanups:
                 fn()
             if self.session.conf.get(_cfg.METRICS_ENABLED):
-                self.session.last_metrics = {
-                    f"{i}:{nd.name}": nd.metrics.snapshot()
-                    for i, nd in enumerate(_iter_execs(final))}
+                # build the whole snapshot FIRST, then publish with ONE
+                # attribute store: two interleaved actions used to mutate
+                # the shared dict after assignment, so a reader could see
+                # the other query's half-written metrics. The per-query
+                # handle is the first-class record; the session global
+                # stays as a last-action alias for compatibility.
+                snap = {f"{i}:{nd.name}": nd.metrics.snapshot()
+                        for i, nd in enumerate(_iter_execs(final))}
                 # host-link story for the whole action, incl. derived GB/s
-                self.session.last_metrics["transfer"] = transfer_delta(
-                    transfer_before)
+                # (process-global counters: under concurrent queries the
+                # per-action delta includes overlapping queries' traffic)
+                snap["transfer"] = transfer_delta(transfer_before)
+                if query is not None:
+                    query.record_exec_metrics(snap)
+                self.session.last_metrics = snap
         return tables
 
     def collect(self) -> pa.Table:
-        tables = self._run_partitions(self._executed_plan())
+        return self._collect()
+
+    def _collect(self, query=None, final: Optional[PhysicalExec] = None
+                 ) -> pa.Table:
+        """collect() with serving context: ``query`` is the QueryHandle a
+        scheduler worker is driving (cancellation checkpoints, fair-share
+        tenant, per-query metric snapshot); ``final`` reuses an already-
+        planned physical tree."""
+        if final is None:
+            final = self._executed_plan()
+        tables = self._run_partitions(final, query=query)
         schema = self._plan.schema().to_pa()
         if not tables:
             return schema.empty_table()
@@ -1102,17 +1135,43 @@ class TpuSession:
         self.conf = TpuConf(conf or {})
         self.last_explain: str = ""
         self.last_plan: Optional[PhysicalExec] = None
-        #: per-operator metric snapshots of the last action, filled when
-        #: spark.rapids.tpu.metrics.enabled (SQLMetrics reporting analog)
+        #: per-operator metric snapshots of the LAST action, filled when
+        #: spark.rapids.tpu.metrics.enabled (SQLMetrics reporting analog).
+        #: Under concurrent serving this is a last-writer-wins alias —
+        #: read QueryHandle.exec_metrics for a specific query's snapshot.
         self.last_metrics: Dict[str, Dict[str, int]] = {}
         self._views: Dict[str, DataFrame] = {}
         self.cache_manager = CacheManager(self)
+        self._scheduler = None
+        self._scheduler_lock = threading.Lock()
 
     def clear_cache(self) -> None:
         """Drop every cached DataFrame (spark.catalog.clearCache analog)."""
         self.cache_manager.clear()
 
     clearCache = clear_cache
+
+    # ---- concurrent serving -----------------------------------------------
+    @property
+    def scheduler(self):
+        """The session's query scheduler (serving/scheduler.py), created on
+        first use with the session's serving.* conf."""
+        with self._scheduler_lock:
+            if self._scheduler is None:
+                from spark_rapids_tpu.serving.scheduler import \
+                    SessionScheduler
+                self._scheduler = SessionScheduler(self)
+            return self._scheduler
+
+    def submit(self, query, tenant: str = "default",
+               timeout: Optional[float] = None, label: Optional[str] = None):
+        """Submit a DataFrame or SQL string for concurrent execution;
+        returns a QueryHandle immediately (state QUEUED). ``handle.
+        result()`` blocks for the collected table; ``handle.cancel()``
+        requests cooperative cancellation; per-query metrics live in
+        ``handle.snapshot()`` / ``handle.exec_metrics``."""
+        return self.scheduler.submit(query, tenant=tenant, timeout=timeout,
+                                     label=label)
 
     # ---- SQL frontend -----------------------------------------------------
     def table(self, name: str) -> "DataFrame":

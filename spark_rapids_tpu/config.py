@@ -537,6 +537,57 @@ CSV_READ_ENABLED = _conf(
     "sql.format.csv.read.enabled", bool, True, "Enable TPU CSV scans.")
 
 # --------------------------------------------------------------------------------------
+# Serving (concurrent query scheduler + cross-query program cache)
+# --------------------------------------------------------------------------------------
+SERVING_MAX_CONCURRENT = _conf(
+    "serving.maxConcurrentQueries", int, 4,
+    "How many submitted queries the session scheduler runs concurrently "
+    "(the shared worker-pool size). Queries past the bound wait in their "
+    "tenant's FIFO queue under fair-share admission; device admission "
+    "within a running query is still gated by sql.concurrentTpuTasks.",
+    checker=_positive("serving.maxConcurrentQueries"))
+
+SERVING_TENANT_WEIGHTS = _conf(
+    "serving.tenantWeights", str, "",
+    "Per-tenant fair-share weights as 'tenant:weight,...' (e.g. "
+    "'etl:3,adhoc:1'). Admission picks the queued tenant with the lowest "
+    "served/weight deficit (FIFO within a tenant); unlisted tenants weigh "
+    "1. The same weights drive the device-admission semaphore so a heavy "
+    "tenant cannot starve the rest at either layer.")
+
+SERVING_SHAPE_BUCKETS = _conf(
+    "serving.shapeBuckets", bool, True,
+    "Bucket row counts to powers of two in cross-query program-cache keys "
+    "(the tpu-lint R001 discipline): row-count drift between batches of "
+    "the same plan reuses one compiled program instead of recompiling per "
+    "exact shape. Disabling keys programs on exact capacities — only for "
+    "debugging recompile behavior.")
+
+SERVING_QUERY_TIMEOUT = _conf(
+    "serving.queryTimeoutSeconds", float, 0.0,
+    "Default per-query deadline for submitted queries, enforced "
+    "cooperatively at exec boundaries and in the pipeline producer; a "
+    "query past its deadline fails with QueryTimeoutError and releases "
+    "its device-semaphore hold and catalog buffers. 0 disables; "
+    "session.submit(timeout=...) overrides per query.",
+    checker=_non_negative("serving.queryTimeoutSeconds"))
+
+SERVING_CACHE_DIR = _conf(
+    "serving.cache.dir", str, "",
+    "Directory of the serving program-cache's on-disk plan-key index "
+    "(plus the jax persistent compilation cache it rides on): a restarted "
+    "server warms compiled programs from disk instead of re-tracing them "
+    "cold. Empty uses the process compilation-cache directory configured "
+    "at startup (device.py); 'off' disables the index.")
+
+SERVING_CACHE_MAX_PROGRAMS = _conf(
+    "serving.cache.maxPrograms", int, 4096,
+    "Upper bound on compiled programs the in-memory cross-query cache "
+    "retains; least-recently-used programs are dropped past it (their "
+    "on-disk compilation-cache entries survive, so a re-miss recompiles "
+    "warm).", checker=_positive("serving.cache.maxPrograms"))
+
+# --------------------------------------------------------------------------------------
 # Observability (SQLMetrics / NVTX analog)
 # --------------------------------------------------------------------------------------
 METRICS_ENABLED = _conf(

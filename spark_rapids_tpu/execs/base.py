@@ -23,7 +23,7 @@ class ExecContext:
     def __init__(self, conf: Optional[TpuConf] = None, partition_id: int = 0,
                  num_partitions: int = 1, device_manager=None,
                  cleanups: Optional[list] = None, cluster_shuffle=None,
-                 device=None, placement=None):
+                 device=None, placement=None, query=None):
         from spark_rapids_tpu.parallel.placement import as_placement
         self.conf = conf or TpuConf()
         self.partition_id = partition_id
@@ -50,6 +50,19 @@ class ExecContext:
         #: cluster-task wiring (executor shuffle env + dep map statuses) for
         #: ClusterShuffleReadExec leaves; None outside cluster execution
         self.cluster_shuffle = cluster_shuffle
+        #: the serving QueryHandle driving this execution (None for direct
+        #: actions): carries cooperative cancellation/deadline, the tenant
+        #: for fair-share device admission, and per-query metric snapshots
+        self.query = query
+
+    def check_cancelled(self) -> None:
+        """Cooperative cancellation/deadline checkpoint: raises
+        QueryCancelledError / QueryTimeoutError when the owning query was
+        cancelled or ran past its deadline; a no-op for direct actions.
+        Execs call this at batch boundaries so a cancelled query unwinds
+        through the normal finally chain (semaphore + catalog cleanup)."""
+        if self.query is not None:
+            self.query.check_cancelled()
 
     @property
     def device(self):
@@ -130,6 +143,19 @@ class PhysicalExec:
     def count_output(self, num_rows: int) -> None:
         self.metrics[NUM_OUTPUT_ROWS].add(num_rows)
         self.metrics[NUM_OUTPUT_BATCHES].add(1)
+
+    def cached_program(self, key, builder):
+        """Program-cache hook for exec-built jit programs: routes through
+        the cross-query serving cache (serving/program_cache.py), keyed on
+        (operator name,) + key — operator config, dtype signature and
+        capacity bucket by convention. One compiled program serves every
+        query that hits the same key; hits/misses/compile time attribute
+        to the current query's handle. ``builder`` returns the callable
+        to cache (typically ``jax.jit`` over the traced pipeline)."""
+        from spark_rapids_tpu.serving.program_cache import \
+            global_program_cache
+        return global_program_cache().get_or_build((self.name,) + tuple(key),
+                                                   builder)
 
 
 class LeafExec(PhysicalExec):

@@ -79,7 +79,13 @@ def eval_exprs_host(exprs: Sequence[Expression], batch: HostBatch,
 
 
 # ------------------------------------------------------------------ TPU (jitted)
-_JIT_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+from spark_rapids_tpu.serving.program_cache import global_program_cache
+
+_PROGRAM_CACHE = global_program_cache()
+#: legacy alias for the serving cache's program table (cleared by conftest
+#: between modules; expression keys are tuples of frozen expressions, so
+#: they can't collide with the execs' string-prefixed keys)
+_JIT_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = _PROGRAM_CACHE._programs
 
 
 def _flatten_batch(batch: DeviceBatch) -> List:
@@ -127,11 +133,9 @@ def eval_exprs_device(exprs: Sequence[Expression], batch: DeviceBatch,
     exprs = tuple(exprs)
     attrs = tuple(sorted((ctx_attrs or {}).items()))
     key = (exprs, batch.schema, batch.capacity, string_max_bytes, attrs)
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(_trace_fn(exprs, batch.schema, batch.capacity,
-                               string_max_bytes, attrs))
-        _JIT_CACHE[key] = fn
+    fn = _PROGRAM_CACHE.get_or_build(
+        key, lambda: jax.jit(_trace_fn(exprs, batch.schema, batch.capacity,
+                                       string_max_bytes, attrs)))
     flat_out = fn(*_flatten_batch(batch))
     out_schema = output_schema(exprs)
     cols = []

@@ -77,12 +77,23 @@ class PipelinedExec(PhysicalExec):
             # id, so this nests instead of taking a second permit — nested
             # pipelines all fold into one hold — and admission still blocks
             # the producer when other tasks saturate the device
-            hold = (dm.semaphore.held(task_id=ctx.task_id) if dm is not None
-                    else nullcontext())
+            from spark_rapids_tpu.serving.lifecycle import bind_query
+            query = ctx.query
+            tenant = query.tenant if query is not None else "default"
+            cancel = (query.check_cancelled if query is not None else None)
+            hold = (dm.semaphore.held(task_id=ctx.task_id, tenant=tenant,
+                                      cancel_check=cancel)
+                    if dm is not None else nullcontext())
             src = self.children[0].execute(ctx)
             try:
-                with hold:
+                # rebind the consumer's query on THIS thread so program-
+                # cache and compile-time attribution follow the producer's
+                # uploads/compiles, and cancellation stops the producer at
+                # its next batch instead of filling the queue for a dead
+                # consumer
+                with bind_query(query), hold:
                     for b in src:
+                        ctx.check_cancelled()
                         peak.set_max(q.qsize() + 1)
                         if not _put_abortable(q, ("b", b), stop):
                             return

@@ -30,7 +30,12 @@ from spark_rapids_tpu.exprs.misc import Alias, SortOrder
 from spark_rapids_tpu.ops import batch_kernels as bk
 from spark_rapids_tpu.ops.aggregate import group_aggregate
 
-_JIT_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = {}
+from spark_rapids_tpu.serving.program_cache import global_program_cache
+
+_PROGRAM_CACHE = global_program_cache()
+#: legacy alias for the serving cache's program table: tests introspect its
+#: keys (recompile guards) and clear it between modules for heap pressure
+_JIT_CACHE: Dict[Tuple, "jax.stages.Wrapped"] = _PROGRAM_CACHE._programs
 
 
 def _flatten(batch: DeviceBatch) -> List:
@@ -68,11 +73,12 @@ def _to_batch(schema: Schema, flat, num_rows: int) -> DeviceBatch:
 
 
 def _cached_jit(key, builder):
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(builder())
-        _JIT_CACHE[key] = fn
-    return fn
+    """One compiled program per key, shared ACROSS QUERIES: keys carry the
+    operator config + schema (dtype signature) + capacity bucket, so any
+    query hitting the same plan shape reuses the program (serving/
+    program_cache.py: hit/miss/disk-warm accounting, in-flight build
+    latch, LRU bound, per-query attribution)."""
+    return _PROGRAM_CACHE.get_or_build(key, lambda: jax.jit(builder()))
 
 
 def concat_device_batches(batches: List[DeviceBatch], schema: Schema,
@@ -183,16 +189,19 @@ class HostToDeviceExec(PhysicalExec):
             from spark_rapids_tpu.memory.scan_cache import get_cache
             cache = get_cache(ctx.conf.get(cfg.SCAN_CACHE_BYTES))
             smax = ctx.string_max_bytes
-            b = cache.get(child.table, smax)
-            if b is None:
-                b = upload_table_conf(child.table, smax, ctx.conf,
-                                      device=ctx.device)
-                cache.put(child.table, smax, b)
+            # per-key latch: concurrent queries missing on the same table
+            # share ONE upload instead of each paying the host link
+            b = cache.get_or_put(
+                child.table, smax,
+                lambda: upload_table_conf(child.table, smax, ctx.conf,
+                                          device=ctx.device),
+                cancel_check=ctx.check_cancelled)
             child.count_output(b.num_rows)
             self.count_output(b.num_rows)
             yield b
             return
         for hb in child.execute(ctx):
+            ctx.check_cancelled()   # before each upload: the costliest step
             table = hb.to_arrow() if isinstance(hb, HostBatch) else hb
             b = upload_table_conf(table, ctx.string_max_bytes, ctx.conf,
                                   device=ctx.device)
@@ -210,6 +219,7 @@ class DeviceToHostExec(PhysicalExec):
 
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         for db in self.children[0].execute(ctx):
+            ctx.check_cancelled()   # before each download
             hb = HostBatch.from_arrow(db.to_arrow(), ctx.string_max_bytes)
             self.count_output(hb.num_rows)
             yield hb

@@ -59,6 +59,10 @@ class CachedData:
         #: bumped on every (re)materialization so cluster executors can tell
         #: a stale shipped copy from the current buffers
         self.generation = 0
+        #: set (under ``lock``) when the entry was unpersisted; a later
+        #: materialization attempt must not register fresh buffers nobody
+        #: would ever free
+        self.dropped = False
 
     @property
     def is_materialized(self) -> bool:
@@ -147,9 +151,16 @@ class CacheManager:
             self._free(e)
 
     def _free(self, e: CachedData) -> None:
-        if e.buffer_ids:
-            from spark_rapids_tpu.memory.device_manager import DeviceManager
-            _release_entry(e, DeviceManager.get())
+        # serialize with an in-flight materialization on the entry lock:
+        # without it, unpersist() racing _materialize could run before the
+        # fresh buffer_ids landed, leaking just-registered buffers that no
+        # later free would ever see (the concurrent-miss audit fix)
+        with e.lock:
+            e.dropped = True
+            if e.buffer_ids:
+                from spark_rapids_tpu.memory.device_manager import \
+                    DeviceManager
+                _release_entry(e, DeviceManager.get())
         # executor processes holding a shipped copy drop it too (unpersist
         # reaches the whole cluster, not just the driver catalog)
         sched = getattr(self.session, "_cluster_scheduler", None)
@@ -195,7 +206,14 @@ class CacheManager:
     # ---- materialization -------------------------------------------------------
     def _ensure_materialized(self, e: CachedData) -> None:
         from spark_rapids_tpu.memory.device_manager import DeviceManager
+        # e.lock doubles as the per-entry in-flight latch: concurrent
+        # queries whose plans share one cached subtree serialize here, so
+        # exactly one materializes and the rest see its buffer_ids
         with e.lock:
+            if e.dropped:
+                raise RuntimeError(
+                    "cached DataFrame was unpersisted while a query using "
+                    "it was being planned; re-run the action")
             if e.buffer_ids is not None:
                 catalog = DeviceManager.get().catalog
                 live = set(catalog.ids())

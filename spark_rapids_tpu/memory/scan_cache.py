@@ -34,19 +34,62 @@ class DeviceScanCache:
         self._lock = threading.Lock()
         # key -> (weakref to table, DeviceBatch, nbytes)
         self._entries: "OrderedDict[Tuple[int, int], tuple]" = OrderedDict()
+        #: per-key in-flight upload latch: two queries missing on the same
+        #: table concurrently must share ONE upload, not pay the host link
+        #: twice (the concurrent-miss double-insert fix)
+        self._inflight: dict = {}
+
+    def get_or_put(self, table, smax: int, builder, cancel_check=None):
+        """Hit -> cached batch. Miss -> exactly one caller runs ``builder``
+        (the upload) while concurrent missers wait on the key's latch and
+        then read the inserted entry. If the builder fails, its exception
+        propagates to the builder caller and a waiter takes over the build
+        on its next loop — no key is ever latched forever.
+
+        ``cancel_check`` (typically QueryHandle.check_cancelled) runs
+        periodically while blocked on another query's upload, so a
+        cancelled query unwinds instead of waiting out a transfer it will
+        never use — the same contract as semaphore admission."""
+        key = (id(table), smax)
+        while True:
+            mine = False
+            with self._lock:
+                got = self._get_locked(table, smax)
+                if got is not None:
+                    return got
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[key] = ev
+                    mine = True
+            if mine:
+                try:
+                    batch = builder()
+                    self.put(table, smax, batch)
+                    return batch
+                finally:
+                    with self._lock:
+                        self._inflight.pop(key, None)
+                    ev.set()
+            while not ev.wait(0.05):
+                if cancel_check is not None:
+                    cancel_check()
+
+    def _get_locked(self, table, smax: int):
+        key = (id(table), smax)
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        ref, batch, _ = entry
+        if ref() is not table:  # address reused by a different table
+            del self._entries[key]
+            return None
+        self._entries.move_to_end(key)
+        return batch
 
     def get(self, table, smax: int):
-        key = (id(table), smax)
         with self._lock:
-            entry = self._entries.get(key)
-            if entry is None:
-                return None
-            ref, batch, _ = entry
-            if ref() is not table:  # address reused by a different table
-                del self._entries[key]
-                return None
-            self._entries.move_to_end(key)
-            return batch
+            return self._get_locked(table, smax)
 
     def put(self, table, smax: int, batch) -> None:
         try:

@@ -5,12 +5,31 @@ per-task acquire and completion-listener release).
 Here tasks are host threads driving device work; holding the semaphore bounds
 concurrent HBM working sets. Re-entrant per task: a task that already holds it
 does not double-acquire (acquireIfNecessary semantics).
+
+Fair-share admission (serving layer): waiters queue per TENANT and a freed
+permit goes to the tenant with the lowest served/weight deficit, FIFO within
+that tenant — so one heavy tenant's task storm cannot starve the rest of the
+device (weights mirror the scheduler's ``serving.tenantWeights``). Callers
+that pass no tenant all share the default tenant, which degrades to plain
+FIFO admission — strictly fairer than the pre-serving herd wakeup.
+
+Cooperative cancellation: a waiter may pass ``cancel_check`` (typically
+``QueryHandle.check_cancelled``); it runs periodically while blocked, so a
+cancelled query stuck behind admission unwinds instead of waiting for a
+permit it will never use.
 """
 from __future__ import annotations
 
 import threading
+from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Optional, Set
+from typing import Callable, Dict, Optional, Set
+
+from spark_rapids_tpu.utils.fair_share import (activation_reset, pick_tenant,
+                                               weight_of)
+
+_DEFAULT_TENANT = "default"
+_POLL_S = 0.05
 
 
 class TpuSemaphore:
@@ -21,26 +40,122 @@ class TpuSemaphore:
         self._cond = threading.Condition()
         self._holders: Set[int] = set()
         self._nesting: Dict[int, int] = {}
+        self._seq = 0
+        #: tenant -> FIFO of waiting ticket ids
+        self._waiters: Dict[str, deque] = {}
+        #: weighted admission counters / weights (fair-share state)
+        self._served: Dict[str, float] = {}
+        self._weights: Dict[str, float] = {}
 
     def _task_id(self, task_id: Optional[int]) -> int:
         return task_id if task_id is not None else threading.get_ident()
 
+    # ---- fair-share policy -----------------------------------------------
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        with self._cond:
+            self._weights[tenant] = float(weight)
+            self._cond.notify_all()
+
+    def _weight(self, tenant: str) -> float:
+        return weight_of(self._weights, tenant)
+
+    def _next_tenant_locked(self) -> Optional[str]:
+        return pick_tenant((t for t, q in self._waiters.items() if q),
+                           self._served, self._weights)
+
+    def _may_admit_locked(self, ticket: int, tenant: str) -> bool:
+        if len(self._holders) >= self.max_concurrent:
+            return False
+        q = self._waiters.get(tenant)
+        if not q or q[0] != ticket:
+            return False
+        return self._next_tenant_locked() == tenant
+
+    def _enqueue_locked(self, tenant: str) -> int:
+        q = self._waiters.get(tenant)
+        if not q:
+            # deficit-round-robin activation reset (utils/fair_share.py):
+            # a newcomer cannot jump ahead of standing backlogs, and a
+            # returning tenant is not starved by its own history
+            activation_reset(tenant,
+                             (t for t, w in self._waiters.items() if w),
+                             self._served, self._weights)
+        ticket = self._seq
+        self._seq += 1
+        self._waiters.setdefault(tenant, deque()).append(ticket)
+        return ticket
+
+    def _dequeue_locked(self, ticket: int, tenant: str) -> None:
+        q = self._waiters.get(tenant)
+        if q is not None:
+            try:
+                q.remove(ticket)
+            except ValueError:
+                pass
+            if not q:
+                del self._waiters[tenant]
+
+    def _wait_turn_locked(self, tid: int, ticket: int, tenant: str,
+                          timeout: Optional[float],
+                          cancel_check: Optional[Callable[[], None]]) -> bool:
+        """Block until this ticket is the fair-share pick (or the task
+        already holds a permit via another thread). Runs under self._cond.
+        Returns False on timeout; re-raises whatever cancel_check raises."""
+        import time
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+
+        def ready() -> bool:
+            return tid in self._holders or \
+                self._may_admit_locked(ticket, tenant)
+
+        while not ready():
+            if cancel_check is not None:
+                cancel_check()
+            wait = _POLL_S if cancel_check is not None else timeout
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                wait = left if wait is None else min(wait, left)
+            self._cond.wait(wait)
+        return True
+
+    def _admit_locked(self, tid: int, ticket: int, tenant: str) -> None:
+        self._dequeue_locked(ticket, tenant)
+        if tid not in self._holders:
+            self._holders.add(tid)
+            self._served[tenant] = self._served.get(tenant, 0.0) + 1.0
+        # our departure may unblock a different tenant's head-of-line
+        self._cond.notify_all()
+
+    # ---- acquire/release --------------------------------------------------
     def acquire_if_necessary(self, task_id: Optional[int] = None,
-                             timeout: Optional[float] = None) -> bool:
-        """Idempotent per task; holder check and permit take are one atomic step
-        under the condition (no check-then-act race between threads sharing a
-        task id). timeout=0 is a non-blocking try."""
+                             timeout: Optional[float] = None,
+                             tenant: str = _DEFAULT_TENANT,
+                             cancel_check: Optional[Callable[[], None]] = None
+                             ) -> bool:
+        """Idempotent per task; holder check and permit take are one atomic
+        step under the condition (no check-then-act race between threads
+        sharing a task id). timeout=0 is a non-blocking try."""
         tid = self._task_id(task_id)
         with self._cond:
             if tid in self._holders:
                 return True
-            ok = self._cond.wait_for(
-                lambda: tid in self._holders
-                or len(self._holders) < self.max_concurrent,
-                timeout=timeout)
+            ticket = self._enqueue_locked(tenant)
+            try:
+                ok = self._wait_turn_locked(tid, ticket, tenant, timeout,
+                                            cancel_check)
+            except BaseException:
+                self._dequeue_locked(ticket, tenant)
+                self._cond.notify_all()
+                raise
             if not ok:
+                self._dequeue_locked(ticket, tenant)
+                self._cond.notify_all()
                 return False
-            self._holders.add(tid)  # re-adding after a racer added is harmless
+            self._admit_locked(tid, ticket, tenant)
             return True
 
     def release_if_necessary(self, task_id: Optional[int] = None) -> None:
@@ -52,7 +167,9 @@ class TpuSemaphore:
                 self._cond.notify_all()
 
     @contextmanager
-    def held(self, task_id: Optional[int] = None):
+    def held(self, task_id: Optional[int] = None,
+             tenant: str = _DEFAULT_TENANT,
+             cancel_check: Optional[Callable[[], None]] = None):
         """Scoped hold with per-task nesting: threads sharing a task id each
         enter/exit; the permit releases only when the LAST one exits (the
         check-then-act race of a naive snapshot would release mid-work)."""
@@ -61,14 +178,26 @@ class TpuSemaphore:
             if tid in self._holders:
                 self._nesting[tid] = self._nesting.get(tid, 1) + 1
             else:
-                self._cond.wait_for(
-                    lambda: tid in self._holders
-                    or len(self._holders) < self.max_concurrent)
+                ticket = self._enqueue_locked(tenant)
+                try:
+                    self._wait_turn_locked(tid, ticket, tenant, None,
+                                           cancel_check)
+                except BaseException:
+                    self._dequeue_locked(ticket, tenant)
+                    self._cond.notify_all()
+                    raise
+                self._dequeue_locked(ticket, tenant)
                 if tid in self._holders:
+                    # a sibling thread of this task was admitted while we
+                    # queued: nest (default 1 covers a sibling that entered
+                    # via acquire_if_necessary, which records no nesting)
                     self._nesting[tid] = self._nesting.get(tid, 1) + 1
                 else:
                     self._holders.add(tid)
+                    self._served[tenant] = self._served.get(tenant, 0.0) + 1.0
                     self._nesting[tid] = 1
+                # our dequeue may unblock a different tenant's head-of-line
+                self._cond.notify_all()
         try:
             yield
         finally:
@@ -86,3 +215,8 @@ class TpuSemaphore:
     def active_holders(self) -> int:
         with self._cond:
             return len(self._holders)
+
+    @property
+    def waiting(self) -> int:
+        with self._cond:
+            return sum(len(q) for q in self._waiters.values())

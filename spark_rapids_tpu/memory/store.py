@@ -70,15 +70,32 @@ class BufferCatalog:
 
     def remove(self, buffer_id: BufferId) -> None:
         """Delete a buffer everywhere: store-owned tiers go through their owning
-        store (keeping store bookkeeping consistent); orphans close directly."""
-        with self._lock:
-            tiers = dict(self._buffers.get(buffer_id, {}))
-        for buf in tiers.values():
-            if buf.owner_store is not None:
-                buf.owner_store.remove(buffer_id)
-            else:
-                self.unregister(buf)
-                buf.close()
+        store (keeping store bookkeeping consistent); orphans close directly.
+
+        Loops until the id is fully unregistered: a concurrent spill
+        migrating this buffer down a tier holds it PRIVATELY between the
+        source store's pop and the target store's add, so a single-pass
+        remove landing in that window misses the copy that re-registers at
+        the lower tier moments later — the spilled copy would leak (caught
+        by the 8-thread store-concurrency test). The catalog entry for the
+        old tier stays registered until the spill completes, so re-checking
+        the catalog converges in every interleaving."""
+        import time as _time
+        while True:
+            with self._lock:
+                tiers = dict(self._buffers.get(buffer_id, {}))
+            if not tiers:
+                return
+            for buf in tiers.values():
+                if buf.owner_store is not None:
+                    buf.owner_store.remove(buffer_id)
+                else:
+                    self.unregister(buf)
+                    buf.close()
+            with self._lock:
+                if buffer_id not in self._buffers:
+                    return
+            _time.sleep(0.001)      # a spill is migrating this id; wait
 
 
 class BufferStore:

@@ -1,0 +1,31 @@
+"""Concurrent query serving: scheduler, program cache, query lifecycle.
+
+The layer that turns the one-query-at-a-time engine into a multi-tenant
+server (ROADMAP item 4; Theseus's admission-controlled many-queries-in-
+flight platform + Flare's compile-once/serve-many result):
+
+- ``lifecycle``: QueryHandle state machine (QUEUED -> ADMITTED -> RUNNING
+  -> {DONE, FAILED, CANCELLED}) with cooperative cancellation, per-query
+  deadlines, and per-query metric snapshots;
+- ``program_cache``: the cross-query compiled-program cache keyed on
+  canonical plan structure + dtype signature + shape bucket, with an
+  on-disk plan-key index over the jax persistent compilation cache so a
+  restarted server warms from disk;
+- ``scheduler``: the session scheduler running N concurrent queries over
+  a shared worker pool with fair-share tenant admission layered on the
+  device-admission semaphore.
+"""
+from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
+                                                QueryHandle, QueryState,
+                                                QueryTimeoutError,
+                                                current_query)
+from spark_rapids_tpu.serving.program_cache import (ProgramCache,
+                                                    global_program_cache,
+                                                    plan_key)
+from spark_rapids_tpu.serving.scheduler import SessionScheduler
+
+__all__ = [
+    "ProgramCache", "QueryCancelledError", "QueryHandle", "QueryState",
+    "QueryTimeoutError", "SessionScheduler", "current_query",
+    "global_program_cache", "plan_key",
+]

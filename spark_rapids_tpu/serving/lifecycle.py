@@ -1,0 +1,257 @@
+"""Per-query lifecycle: handle, states, cancellation, deadlines, metrics.
+
+A ``QueryHandle`` is the server-side identity of one submitted query — the
+role Spark's jobGroup/SQLExecution id plays for a statement, extended with
+the pieces an inference-serving stack needs:
+
+- a state machine QUEUED -> ADMITTED -> RUNNING -> {DONE, FAILED,
+  CANCELLED} with monotonic transition timestamps;
+- COOPERATIVE cancellation and deadlines: ``cancel()`` only sets a flag;
+  the running query observes it at exec boundaries (ExecContext.
+  check_cancelled), in the pipeline producer, and while blocked on
+  device-semaphore admission, then unwinds through the normal finally
+  chain — so a cancelled query releases its semaphore hold and catalog
+  buffers exactly like a failed one;
+- per-query metric snapshots (queue wait, admission wait, compile time,
+  program-cache hits/misses, transfer deltas, rows) replacing the racy
+  process-global ``session.last_metrics`` as the source of truth; the
+  global survives as a last-action alias.
+
+``current_query()`` is the thread-scoped attribution point: the scheduler
+worker (and any producer thread an exec spawns on the query's behalf)
+binds the handle so the program cache can attribute hits/misses/compile
+time without threading the handle through every call signature.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import itertools
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class QueryState(enum.Enum):
+    QUEUED = "QUEUED"
+    ADMITTED = "ADMITTED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (QueryState.DONE, QueryState.FAILED,
+                        QueryState.CANCELLED)
+
+
+class QueryCancelledError(RuntimeError):
+    """Raised inside a running query at the next cooperative checkpoint
+    after ``cancel()``; surfaces from ``result()`` as the terminal error."""
+
+
+class QueryTimeoutError(RuntimeError):
+    """Raised at a cooperative checkpoint once the query's deadline passed
+    (conf ``serving.queryTimeoutSeconds`` or ``submit(timeout=...)``)."""
+
+
+_QUERY_IDS = itertools.count(1)
+
+#: thread-scoped current query for metric attribution (a thread-local, not
+#: a contextvar: exec producer threads rebind explicitly from ctx.query —
+#: implicit contextvar inheritance does not cross threading.Thread anyway)
+_TLS = threading.local()
+
+
+def current_query() -> Optional["QueryHandle"]:
+    return getattr(_TLS, "query", None)
+
+
+@contextlib.contextmanager
+def bind_query(handle: Optional["QueryHandle"]):
+    """Bind ``handle`` as the thread's current query for the scope."""
+    prev = getattr(_TLS, "query", None)
+    _TLS.query = handle
+    try:
+        yield handle
+    finally:
+        _TLS.query = prev
+
+
+class QueryHandle:
+    """One submitted query: state, cancellation, deadline, metrics, result."""
+
+    def __init__(self, query: Any, tenant: str = "default",
+                 timeout: Optional[float] = None,
+                 label: Optional[str] = None):
+        self.query_id = next(_QUERY_IDS)
+        self.tenant = tenant
+        self.label = label or f"query-{self.query_id}"
+        #: the submitted work: a DataFrame or a SQL string (planned lazily
+        #: in the worker so a malformed query FAILS its handle instead of
+        #: raising in submit())
+        self._work = query
+        self._lock = threading.Lock()
+        self._done_evt = threading.Event()
+        self._cancel_evt = threading.Event()
+        self.state = QueryState.QUEUED
+        self.submitted_at = time.perf_counter()
+        self.deadline = (self.submitted_at + timeout
+                         if timeout and timeout > 0 else None)
+        self._result = None
+        self._error: Optional[BaseException] = None
+        #: per-query metric snapshot; keys documented in docs/serving.md
+        self.metrics: Dict[str, Any] = {
+            "tenant": tenant,
+            "queue_wait_s": None,
+            "admission_wait_s": 0.0,
+            "compile_s": 0.0,
+            "program_cache": {"hits": 0, "misses": 0, "disk_hits": 0},
+            "rows": None,
+            "wall_s": None,
+        }
+        #: per-operator + transfer snapshot of the query's action(s); the
+        #: per-handle replacement for session.last_metrics
+        self.exec_metrics: Dict[str, Dict] = {}
+
+    # ---- cooperative cancellation / deadline -------------------------------
+    def cancel(self) -> bool:
+        """Request cancellation. Returns True when the request could still
+        take effect (query not already terminal). A QUEUED query is
+        finished immediately by the scheduler at dequeue; a RUNNING one
+        unwinds at its next checkpoint."""
+        with self._lock:
+            if self.state.is_terminal:
+                return False
+        self._cancel_evt.set()
+        return True
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel_evt.is_set()
+
+    def check_cancelled(self) -> None:
+        """The cooperative checkpoint: raises when cancellation was
+        requested or the deadline passed. Called at exec boundaries
+        (ExecContext.check_cancelled), in the pipeline producer, and while
+        waiting on device-semaphore admission."""
+        if self._cancel_evt.is_set():
+            raise QueryCancelledError(
+                f"{self.label} (id {self.query_id}) cancelled")
+        if self.deadline is not None and time.perf_counter() > self.deadline:
+            raise QueryTimeoutError(
+                f"{self.label} (id {self.query_id}) exceeded its deadline")
+
+    # ---- state transitions (scheduler-driven) ------------------------------
+    def _transition(self, state: QueryState) -> None:
+        with self._lock:
+            self.state = state
+            self.metrics[f"t_{state.value.lower()}"] = (
+                time.perf_counter() - self.submitted_at)
+
+    def mark_admitted(self) -> None:
+        self._transition(QueryState.ADMITTED)
+        self.metrics["queue_wait_s"] = round(
+            time.perf_counter() - self.submitted_at, 6)
+
+    def mark_running(self) -> None:
+        self._transition(QueryState.RUNNING)
+
+    def _finish(self, state: QueryState,
+                error: Optional[BaseException] = None,
+                result=None) -> None:
+        with self._lock:
+            if self.state.is_terminal:
+                return
+            self.state = state
+            self._error = error
+            self._result = result
+            self._work = None       # free the plan; the result is kept
+            self.metrics["wall_s"] = round(
+                time.perf_counter() - self.submitted_at, 6)
+            if result is not None and hasattr(result, "num_rows"):
+                self.metrics["rows"] = result.num_rows
+        self._done_evt.set()
+
+    def finish_ok(self, result) -> None:
+        self._finish(QueryState.DONE, result=result)
+
+    def finish_failed(self, error: BaseException) -> None:
+        self._finish(QueryState.FAILED, error=error)
+
+    def finish_cancelled(self, error: Optional[BaseException] = None) -> None:
+        self._finish(QueryState.CANCELLED,
+                     error=error or QueryCancelledError(
+                         f"{self.label} (id {self.query_id}) cancelled"))
+
+    # ---- metric attribution ------------------------------------------------
+    def note_admission_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.metrics["admission_wait_s"] = round(
+                self.metrics["admission_wait_s"] + seconds, 6)
+
+    def count_program(self, *, hit: bool, from_disk: bool = False) -> None:
+        pc = self.metrics["program_cache"]
+        with self._lock:
+            if hit:
+                pc["hits"] += 1
+            else:
+                pc["misses"] += 1
+                if from_disk:
+                    pc["disk_hits"] += 1
+
+    def note_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.metrics["compile_s"] = round(
+                self.metrics["compile_s"] + seconds, 6)
+
+    def record_exec_metrics(self, snapshot: Dict[str, Dict]) -> None:
+        """Attach one action's per-operator + transfer snapshot. Multi-action
+        queries (distinct-agg rewrites, pivots) accumulate keyed by action
+        ordinal so nothing is overwritten."""
+        with self._lock:
+            ordinal = self.metrics.get("actions", 0)
+            self.metrics["actions"] = ordinal + 1
+            if ordinal == 0:
+                self.exec_metrics.update(snapshot)
+            else:
+                self.exec_metrics.update(
+                    {f"a{ordinal}:{k}": v for k, v in snapshot.items()})
+
+    # ---- results -----------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done_evt.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done_evt.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the collected arrow table; re-raises the query's error
+        for FAILED/CANCELLED handles."""
+        if not self._done_evt.wait(timeout):
+            raise TimeoutError(
+                f"{self.label} (id {self.query_id}) still "
+                f"{self.state.value} after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time view of the handle: state + metrics (the per-query
+        replacement for reading session.last_metrics)."""
+        with self._lock:
+            out = {"query_id": self.query_id, "label": self.label,
+                   "tenant": self.tenant, "state": self.state.value}
+            out.update({k: v for k, v in self.metrics.items()})
+            out["program_cache"] = dict(self.metrics["program_cache"])
+            return out
+
+    def __repr__(self) -> str:
+        return (f"QueryHandle(id={self.query_id}, tenant={self.tenant!r}, "
+                f"state={self.state.value})")

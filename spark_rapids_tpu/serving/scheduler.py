@@ -1,0 +1,275 @@
+"""Session scheduler: N concurrent queries over a shared worker pool.
+
+The admission story has two layers, both fair-share by tenant:
+
+1. SCHEDULER admission — submitted queries wait in per-tenant FIFO queues;
+   a shared pool of ``serving.maxConcurrentQueries`` workers picks the
+   next query from the tenant with the lowest served/weight deficit
+   (weighted deficit round-robin: a tenant with weight 3 is served three
+   times as often as a tenant with weight 1, FIFO within each tenant).
+   This bounds in-flight queries by conf, so one heavy tenant cannot
+   occupy every worker.
+2. DEVICE admission — each running query still takes the device-admission
+   semaphore (memory/semaphore.py) for its action, with the SAME tenant
+   weights, so HBM working sets are fair-shared too (the GpuSemaphore
+   role, extended per Theseus's admission-controlled concurrency).
+
+Per-query lifecycle, cancellation, deadlines and metric snapshots live on
+the QueryHandle (lifecycle.py); the worker binds the handle thread-locally
+so the program cache attributes hits/misses/compile time to it.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
+                                                QueryHandle,
+                                                QueryTimeoutError,
+                                                bind_query)
+from spark_rapids_tpu.serving.program_cache import (configure_from_conf,
+                                                    plan_key)
+from spark_rapids_tpu.utils.fair_share import (activation_reset, pick_tenant,
+                                               weight_of)
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """'etl:3,adhoc:1' -> {'etl': 3.0, 'adhoc': 1.0}; malformed entries
+    raise (a silently dropped weight would silently unbalance serving)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w = part.rpartition(":")
+        if not sep or not name.strip():
+            raise ValueError(
+                f"serving.tenantWeights entry {part!r} is not tenant:weight")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ValueError(
+                f"serving.tenantWeights entry {part!r}: weight {w!r} is "
+                f"not a number") from None
+        if weight <= 0:
+            raise ValueError(
+                f"serving.tenantWeights: weight for {name!r} must be > 0")
+        out[name.strip()] = weight
+    return out
+
+
+#: terminal handles kept for introspection; older ones are pruned at
+#: submit so a long-running server's handle list (each holding its result
+#: table) cannot grow without bound — callers keep their own references
+_HANDLE_HISTORY = 4096
+
+
+class SessionScheduler:
+    """Fair-share scheduler over one TpuSession (created lazily by
+    ``session.scheduler`` / ``session.submit``)."""
+
+    def __init__(self, session):
+        self.session = session
+        conf = session.conf
+        self.max_concurrent = conf.get(cfg.SERVING_MAX_CONCURRENT)
+        self.default_timeout = conf.get(cfg.SERVING_QUERY_TIMEOUT) or None
+        self._weights = parse_tenant_weights(
+            conf.get(cfg.SERVING_TENANT_WEIGHTS))
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {}
+        self._served: Dict[str, float] = {}
+        self._handles: List[QueryHandle] = []
+        #: terminal states of handles pruned from the history, so stats()
+        #: stays truthful after pruning
+        self._pruned_states: Dict[str, int] = {}
+        self._active = 0
+        self._shutdown = False
+        self._workers: List[threading.Thread] = []
+        self.program_cache = configure_from_conf(conf)
+        self._push_weights_to_semaphore()
+
+    # ---- configuration -----------------------------------------------------
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        with self._cv:
+            self._weights[tenant] = float(weight)
+            self._cv.notify_all()
+        self._push_weights_to_semaphore()
+
+    def _push_weights_to_semaphore(self) -> None:
+        """Mirror the scheduler's weights into the device-admission
+        semaphore so both layers share one fairness policy."""
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        dm = DeviceManager.peek()
+        if dm is not None:
+            for tenant, w in dict(self._weights).items():
+                dm.semaphore.set_tenant_weight(tenant, w)
+
+    def _weight(self, tenant: str) -> float:
+        return weight_of(self._weights, tenant)
+
+    # ---- submission --------------------------------------------------------
+    def submit(self, query: Any, tenant: str = "default",
+               timeout: Optional[float] = None,
+               label: Optional[str] = None) -> QueryHandle:
+        """Enqueue a DataFrame or SQL string; returns immediately with the
+        query's handle. Planning and execution happen on a worker, so a
+        malformed query FAILS its handle instead of raising here."""
+        handle = QueryHandle(query, tenant=tenant,
+                             timeout=(timeout if timeout is not None
+                                      else self.default_timeout),
+                             label=label)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("scheduler is shut down")
+            q = self._queues.get(tenant)
+            if not q:
+                # deficit-round-robin activation reset (utils/fair_share
+                # .py): a late joiner cannot monopolize the workers, and a
+                # returning tenant is not starved by its own history
+                activation_reset(tenant,
+                                 (t for t, w in self._queues.items() if w),
+                                 self._served, self._weights)
+            self._queues.setdefault(tenant, deque()).append(handle)
+            self._handles.append(handle)
+            if len(self._handles) > _HANDLE_HISTORY:
+                keep = []
+                excess = len(self._handles) - _HANDLE_HISTORY
+                for h in self._handles:
+                    if excess > 0 and h.state.is_terminal:
+                        self._pruned_states[h.state.value] = \
+                            self._pruned_states.get(h.state.value, 0) + 1
+                        excess -= 1
+                    else:
+                        keep.append(h)
+                self._handles = keep
+            self._ensure_workers_locked()
+            self._cv.notify_all()
+        return handle
+
+    def _ensure_workers_locked(self) -> None:
+        while len(self._workers) < self.max_concurrent:
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"serving-worker-{len(self._workers)}")
+            self._workers.append(t)
+            t.start()
+
+    # ---- fair-share pick ---------------------------------------------------
+    def _next_locked(self) -> Optional[QueryHandle]:
+        tenant = pick_tenant((t for t, q in self._queues.items() if q),
+                             self._served, self._weights)
+        if tenant is None:
+            return None
+        self._served[tenant] = self._served.get(tenant, 0.0) + 1.0
+        return self._queues[tenant].popleft()
+
+    # ---- the worker pool ---------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                handle = self._next_locked()
+                while handle is None and not self._shutdown:
+                    self._cv.wait(timeout=0.2)
+                    handle = self._next_locked()
+                if handle is None:      # shutdown with an empty queue
+                    return
+                self._active += 1
+            try:
+                self._run_handle(handle)
+            finally:
+                with self._cv:
+                    self._active -= 1
+                    self._cv.notify_all()
+
+    def _run_handle(self, handle: QueryHandle) -> None:
+        if handle.cancel_requested:     # cancelled while QUEUED
+            handle.mark_admitted()
+            handle.finish_cancelled()
+            return
+        handle.mark_admitted()
+        if self._weights:
+            # the DeviceManager is created lazily by the first action, so
+            # weights pushed at scheduler construction may have found no
+            # semaphore yet — re-mirror them on the running path (cheap,
+            # idempotent) so device admission is weighted from query one
+            from spark_rapids_tpu.memory.device_manager import DeviceManager
+            DeviceManager.initialize(self.session.conf)
+            self._push_weights_to_semaphore()
+        try:
+            with bind_query(handle):
+                handle.check_cancelled()
+                df = self._as_dataframe(handle._work)
+                final = df._executed_plan()
+                handle.metrics["plan_key"] = plan_key(final,
+                                                      self.session.conf)
+                handle.mark_running()
+                result = df._collect(query=handle, final=final)
+            handle.finish_ok(result)
+        except QueryCancelledError as e:
+            handle.finish_cancelled(e)
+        except QueryTimeoutError as e:
+            handle.finish_failed(e)
+        except BaseException as e:      # noqa: BLE001 - surfaces in result()
+            handle.finish_failed(e)
+
+    def _as_dataframe(self, work):
+        if isinstance(work, str):
+            return self.session.sql(work)
+        if hasattr(work, "_collect"):
+            return work
+        raise TypeError(
+            f"submit() takes a DataFrame or a SQL string, got {type(work)}")
+
+    # ---- introspection / lifecycle ----------------------------------------
+    def handles(self) -> List[QueryHandle]:
+        with self._cv:
+            return list(self._handles)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted query reaches a terminal state.
+        ``timeout=0`` is a non-blocking poll."""
+        import time as _time
+        deadline = (_time.perf_counter() + timeout
+                    if timeout is not None else None)
+        for h in self.handles():
+            left = (None if deadline is None
+                    else max(0.0, deadline - _time.perf_counter()))
+            if not h.wait(left):
+                return False
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            states: Dict[str, int] = dict(self._pruned_states)
+            for h in self._handles:
+                states[h.state.value] = states.get(h.state.value, 0) + 1
+            queued = sum(len(q) for q in self._queues.values())
+            out = {"submitted": (len(self._handles)
+                                 + sum(self._pruned_states.values())),
+                   "queued": queued,
+                   "active": self._active, "states": states,
+                   "served_by_tenant": dict(self._served),
+                   "weights": dict(self._weights)}
+        out["program_cache"] = self.program_cache.stats()
+        return out
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop accepting work; cancel queued queries; optionally wait for
+        running ones (cancellation stays cooperative — running queries
+        finish or observe their cancel flag at the next checkpoint)."""
+        with self._cv:
+            self._shutdown = True
+            queued = [h for q in self._queues.values() for h in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cv.notify_all()
+        for h in queued:
+            h.cancel()
+            h.finish_cancelled()
+        if wait:
+            for t in self._workers:
+                t.join(timeout)

@@ -77,6 +77,31 @@ TRANSFER_METRIC_NAMES = (
     TRANSFER_DECODED_EQUIV_BYTES, TRANSFER_ENCODED_DOMAIN_OPS,
     TRANSFER_HOST_HOP_BYTES, TRANSFER_EXCHANGE_ENCODED_OPS)
 
+# Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
+# unlike the per-operator MetricSets — which live on per-action plan nodes —
+# and the process-global transfer counters, these are scoped to ONE query
+# handle, so concurrent queries never interleave in them.
+QUERY_QUEUE_WAIT_S = "queue_wait_s"            # submit -> scheduler pickup
+QUERY_ADMISSION_WAIT_S = "admission_wait_s"    # device-semaphore wait
+QUERY_COMPILE_S = "compile_s"                  # first-call program builds
+QUERY_WALL_S = "wall_s"                        # submit -> terminal state
+QUERY_ROWS = "rows"                            # collected result rows
+
+QUERY_METRIC_NAMES = (QUERY_QUEUE_WAIT_S, QUERY_ADMISSION_WAIT_S,
+                      QUERY_COMPILE_S, QUERY_WALL_S, QUERY_ROWS)
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ascending list (p50/p99 latency
+    reporting for the serving bench and scheduler stats)."""
+    if not sorted_vals:
+        return 0.0
+    if q <= 0:
+        return float(sorted_vals[0])
+    import math
+    rank = math.ceil(q / 100.0 * len(sorted_vals))
+    return float(sorted_vals[min(len(sorted_vals), max(1, rank)) - 1])
+
 
 class Metric:
     __slots__ = ("name", "unit", "_value", "_lock")

@@ -280,6 +280,78 @@ def test_r006_condition_wait_and_unlocked_io_clean():
     assert run(fs, {"R006"}) == []
 
 
+# ------------------------------------------------------------------ R007
+def test_r007_direct_jit_in_execute_flagged():
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                fn = jax.jit(lambda x: x + 1)
+                yield fn(ctx)
+        """, path="execs/foo.py")
+    found = run(fs, {"R007"})
+    assert len(found) == 1 and "cross-query" in found[0].message
+
+
+def test_r007_nested_helper_inside_execute_flagged():
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def build():
+                    return jax.jit(lambda x: x * 2)
+                yield build()(ctx)
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R007"})) == 1
+
+
+def test_r007_cache_routes_clean():
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                fn = _cached_jit(("k", ctx.cap),
+                                 lambda: (lambda x: x + 1))
+                g = cache.get_or_build(("k2",), lambda: jax.jit(f))
+                yield fn(ctx), g(ctx)
+        """, path="execs/foo.py")
+    assert run(fs, {"R007"}) == []
+
+
+def test_r007_keyed_cache_guard_clean():
+    fs = src(GUARD + """
+        import jax
+        _PROGRAMS = {}
+        class FooExec:
+            def execute(self, ctx):
+                fn = _PROGRAMS.get(ctx.key)
+                if fn is None:
+                    fn = jax.jit(lambda x: x + 1)
+                    _PROGRAMS[ctx.key] = fn
+                yield fn(ctx)
+        """, path="execs/foo.py")
+    assert run(fs, {"R007"}) == []
+
+
+def test_r007_scoped_to_exec_layer():
+    fs = src(GUARD + """
+        import jax
+        class Foo:
+            def execute(self, ctx):
+                return jax.jit(lambda x: x + 1)(ctx)
+        """, path="ops/foo.py")
+    assert run(fs, {"R007"}) == []
+
+
+def test_r007_non_execute_function_clean():
+    fs = src(GUARD + """
+        import jax
+        def helper():
+            return jax.jit(lambda x: x + 1)
+        """, path="execs/foo.py")
+    assert run(fs, {"R007"}) == []
+
+
 # ---------------------------------------------------------- suppressions
 def test_suppression_same_line_and_line_above():
     fs = src(GUARD + """

@@ -1,0 +1,770 @@
+"""Concurrent query serving: scheduler, program cache, lifecycle, fairness.
+
+Covers the serving subsystem's contracts (docs/serving.md):
+- scheduler: N concurrent queries complete, fair-share tenant admission
+  (weighted deficit round-robin, FIFO within tenant), SQL submission;
+- lifecycle: cooperative cancellation (QUEUED, RUNNING, and
+  blocked-on-admission), deadlines, per-query metric snapshots, and the
+  cancelled-query-releases-semaphore/catalog regression tests;
+- program cache: cross-query reuse, shape-bucket keying, the concurrent-
+  build latch, and the on-disk index warm start;
+- the last_metrics data-race fix (atomic per-action snapshots);
+- scan-cache in-flight upload latch;
+- store concurrency: BufferCatalog acquire/remove + spill hammered from 8
+  threads while a query runs.
+"""
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.serving import (ProgramCache, QueryCancelledError,
+                                      QueryState, QueryTimeoutError)
+from spark_rapids_tpu.serving.scheduler import parse_tenant_weights
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.string.maxBytes": "16",
+    "spark.rapids.tpu.serving.maxConcurrentQueries": "3",
+    # double aggregations stay on the TPU engine (parallel-reduction float
+    # ordering), so the tests exercise real device programs
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+}
+
+
+def make_session(extra=None):
+    return TpuSession({**BASE_CONF, **(extra or {})})
+
+
+def small_table(n=64, seed=0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 8, n).astype("int64"),
+        "v": rng.random(n),
+    })
+
+
+def blocking_udf_df(sess, started, release, n_rows=2):
+    """A DataFrame whose execution signals ``started`` and then blocks on
+    ``release`` (row UDF on the fallback path) — the controllable slow
+    query the cancellation/fairness tests drive."""
+    def slow(x):
+        started.set()
+        release.wait(20)
+        return x
+
+    df = sess.create_dataframe(pa.table({"a": list(range(n_rows))}))
+    return df.select(F.udf(slow, DType.LONG)(F.col("a")).alias("b"))
+
+
+# ------------------------------------------------------------- scheduler
+def test_concurrent_queries_all_complete():
+    sess = make_session()
+    t = small_table(256)
+    df = (sess.create_dataframe(t).groupBy("k")
+          .agg(F.sum("v").alias("s"), F.count(F.lit(1)).alias("c")))
+    expected = df.collect()
+    handles = [sess.submit(df, tenant=f"t{i % 3}") for i in range(9)]
+    for h in handles:
+        out = h.result(timeout=120)
+        assert out.num_rows == expected.num_rows
+        assert h.state is QueryState.DONE
+        snap = h.snapshot()
+        assert snap["queue_wait_s"] is not None
+        assert snap["rows"] == expected.num_rows
+    stats = sess.scheduler.stats()
+    assert stats["states"]["DONE"] == 9
+    assert stats["program_cache"]["hits"] > 0
+
+
+def test_submit_sql_string():
+    sess = make_session()
+    sess.create_dataframe(small_table(32)).createOrReplaceTempView("t")
+    h = sess.submit("SELECT k, COUNT(*) AS c FROM t GROUP BY k",
+                    label="sql-smoke")
+    out = h.result(timeout=120)
+    assert out.num_rows > 0
+    assert h.metrics.get("plan_key")
+
+
+def test_submit_malformed_query_fails_handle():
+    sess = make_session()
+    h = sess.submit("SELECT definitely_not_a_column FROM nowhere")
+    h.wait(120)
+    assert h.state is QueryState.FAILED
+    with pytest.raises(Exception):
+        h.result(timeout=1)
+
+
+def test_fair_share_interleaves_tenants():
+    """With one worker, queued tenants are served by weighted deficit:
+    [a, a, b] admits as a, b, a — not global FIFO."""
+    sess = make_session({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release),
+                          tenant="z")
+    assert started.wait(60)
+    order = []
+
+    def tag_df(name):
+        def tag(x):
+            order.append(name)
+            return x
+        df = sess.create_dataframe(pa.table({"a": [1]}))
+        return df.select(F.udf(tag, DType.LONG)(F.col("a")).alias("b"))
+
+    ha1 = sess.submit(tag_df("a1"), tenant="a")
+    ha2 = sess.submit(tag_df("a2"), tenant="a")
+    hb1 = sess.submit(tag_df("b1"), tenant="b")
+    release.set()
+    assert blocker.result(timeout=120) is not None
+    for h in (ha1, ha2, hb1):
+        h.result(timeout=120)
+    assert order == ["a1", "b1", "a2"]
+
+
+def test_tenant_weights_conf_parse():
+    assert parse_tenant_weights("etl:3,adhoc:1") == {"etl": 3.0,
+                                                     "adhoc": 1.0}
+    assert parse_tenant_weights("") == {}
+    with pytest.raises(ValueError):
+        parse_tenant_weights("noweight")
+    with pytest.raises(ValueError):
+        parse_tenant_weights("t:0")
+    # the error must NAME the conf key, not just echo float()'s message
+    with pytest.raises(ValueError, match="tenantWeights"):
+        parse_tenant_weights("etl:abc")
+
+
+def test_drain_timeout_zero_polls():
+    sess = make_session({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    started, release = threading.Event(), threading.Event()
+    h = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    t0 = time.time()
+    assert sess.scheduler.drain(timeout=0) is False
+    assert time.time() - t0 < 5          # returned immediately, no block
+    release.set()
+    assert h.result(timeout=120) is not None
+    assert sess.scheduler.drain(timeout=30) is True
+
+
+def test_terminal_handles_pruned_beyond_history(monkeypatch):
+    from spark_rapids_tpu.serving import scheduler as sched_mod
+    monkeypatch.setattr(sched_mod, "_HANDLE_HISTORY", 4)
+    sess = make_session()
+    df = sess.create_dataframe(small_table(16)).groupBy("k").count()
+    handles = [sess.submit(df) for _ in range(10)]
+    for h in handles:
+        h.result(timeout=120)
+    sess.submit(df).result(timeout=120)   # triggers a post-completion prune
+    stats = sess.scheduler.stats()
+    assert len(sess.scheduler.handles()) <= 5
+    assert stats["submitted"] == 11       # pruned handles still counted
+    assert stats["states"]["DONE"] == 11
+
+
+# ---------------------------------------------------------- cancellation
+def test_cancel_queued_query_never_runs():
+    sess = make_session({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    ran = []
+
+    def tag(x):
+        ran.append(x)
+        return x
+
+    df = (sess.create_dataframe(pa.table({"a": [1]}))
+          .select(F.udf(tag, DType.LONG)(F.col("a")).alias("b")))
+    victim = sess.submit(df)
+    assert victim.cancel()
+    release.set()
+    blocker.result(timeout=120)
+    victim.wait(120)
+    assert victim.state is QueryState.CANCELLED
+    assert ran == []
+    with pytest.raises(QueryCancelledError):
+        victim.result(timeout=1)
+
+
+def test_cancelled_running_query_releases_semaphore_and_catalog():
+    """The acceptance-bar regression test: a query cancelled MID-RUN must
+    free its device-semaphore hold and leave no exec buffers behind in
+    the catalog (the finally chain runs on the cooperative unwind)."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    sess = make_session()
+    dm = DeviceManager.initialize(sess.conf)
+    ids_before = set(dm.catalog.ids())
+    started, release = threading.Event(), threading.Event()
+
+    def slow(x):
+        started.set()
+        release.wait(20)
+        return x
+
+    # repartition forces a shuffle exchange, whose blocks register in the
+    # catalog during the run and must be unregistered by the cleanups
+    df = (sess.create_dataframe(pa.table({"a": list(range(8))}))
+          .select(F.udf(slow, DType.LONG)(F.col("a")).alias("b"))
+          .repartition(4, F.col("b"))
+          .groupBy("b").count())
+    h = sess.submit(df, label="victim")
+    assert started.wait(60)
+    assert h.cancel()
+    release.set()
+    h.wait(120)
+    assert h.state is QueryState.CANCELLED
+    assert dm.semaphore.active_holders == 0
+    assert set(dm.catalog.ids()) == ids_before
+    # the device stays usable: a follow-up query completes normally
+    out = sess.submit(sess.create_dataframe(small_table(16))
+                      .groupBy("k").count()).result(timeout=120)
+    assert out.num_rows > 0
+
+
+def test_cancel_while_blocked_on_device_admission():
+    """A query stuck BEHIND the device semaphore observes its cancel flag
+    via the semaphore's cancel_check and unwinds without a permit."""
+    sess = make_session({
+        "spark.rapids.tpu.sql.concurrentTpuTasks": "1",
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "2"})
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    DeviceManager.shutdown()            # apply the 1-permit conf
+    dm = DeviceManager.initialize(sess.conf)
+    assert dm.semaphore.max_concurrent == 1
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    waiter = sess.submit(sess.create_dataframe(small_table(16))
+                         .groupBy("k").count())
+    # the waiter reaches ADMITTED (a worker picked it) then blocks on the
+    # device semaphore held by the blocker
+    deadline = time.time() + 30
+    while waiter.state is QueryState.QUEUED and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)
+    assert waiter.cancel()
+    waiter.wait(120)
+    assert waiter.state is QueryState.CANCELLED
+    release.set()
+    assert blocker.result(timeout=120) is not None
+    assert dm.semaphore.active_holders == 0
+    assert dm.semaphore.waiting == 0
+
+
+def test_query_deadline_fails_with_timeout_error():
+    sess = make_session()
+    started, release = threading.Event(), threading.Event()
+    df = blocking_udf_df(sess, started, release)
+    h = sess.submit(df, timeout=0.3)
+    assert started.wait(60)
+    time.sleep(0.4)                     # run past the deadline
+    release.set()
+    h.wait(120)
+    assert h.state is QueryState.FAILED
+    with pytest.raises(QueryTimeoutError):
+        h.result(timeout=1)
+
+
+# ---------------------------------------------------------- program cache
+def test_program_cache_cross_query_reuse_and_shape_buckets():
+    """Two submissions of the same plan shape share programs, and tables
+    whose row counts land in the same power-of-two capacity bucket share
+    them too (the serving.shapeBuckets discipline)."""
+    sess = make_session()
+
+    def agg_over(table):
+        return (sess.create_dataframe(table).filter(F.col("v") > 0.25)
+                .groupBy("k").agg(F.sum("v").alias("s")))
+
+    first = sess.submit(agg_over(small_table(100, seed=1)))
+    first.result(timeout=120)
+    # 100 and 120 rows both bucket to capacity 128 -> identical keys
+    second = sess.submit(agg_over(small_table(120, seed=2)))
+    second.result(timeout=120)
+    pc2 = second.snapshot()["program_cache"]
+    assert pc2["misses"] == 0, pc2
+    assert pc2["hits"] > 0, pc2
+
+
+def test_program_cache_build_latch_single_build():
+    cache = ProgramCache(index_path="off")
+    builds = []
+
+    def builder():
+        builds.append(1)
+        time.sleep(0.05)
+        return lambda x: x + 1
+
+    outs = []
+
+    def worker():
+        outs.append(cache.get_or_build(("k",), builder))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(builds) == 1
+    assert len({id(o) for o in outs}) == 1
+    st = cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 7
+
+
+def test_program_cache_disk_index_warm_start(tmp_path):
+    """A second cache instance (a restarted server) pointed at the same
+    index directory counts its first compile of a known key as a
+    disk hit."""
+    d = str(tmp_path)
+    c1 = ProgramCache(index_path=d)
+    c1.get_or_build(("plan", "sig", 128), lambda: (lambda x: x))
+    assert c1.stats()["disk_hits"] == 0
+    c2 = ProgramCache(index_path=d)
+    c2.get_or_build(("plan", "sig", 128), lambda: (lambda x: x))
+    st = c2.stats()
+    assert st["misses"] == 1 and st["disk_hits"] == 1
+    # an unknown key is a cold miss, not a disk hit
+    c2.get_or_build(("other", 1), lambda: (lambda x: x))
+    assert c2.stats()["disk_hits"] == 1
+
+
+def test_program_cache_latch_wait_cancellable_and_clear_safe():
+    """A query waiting on another query's in-flight build observes its
+    cancel flag, and clear() during a build does not orphan the latch."""
+    from spark_rapids_tpu.serving.lifecycle import QueryHandle, bind_query
+    cache = ProgramCache(index_path="off")
+    release = threading.Event()
+
+    def slow_builder():
+        release.wait(20)
+        return lambda x: x
+
+    builder_thread = threading.Thread(
+        target=lambda: cache.get_or_build(("slow",), slow_builder))
+    builder_thread.start()
+    deadline = time.time() + 10
+    while not cache._building and time.time() < deadline:
+        time.sleep(0.005)
+    cache.clear()       # must NOT drop the in-flight latch
+    victim = QueryHandle(None, label="latch-victim")
+    victim.cancel()
+    errs = []
+
+    def waiter():
+        with bind_query(victim):
+            try:
+                cache.get_or_build(("slow",), slow_builder)
+            except QueryCancelledError as e:
+                errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(30)
+    assert len(errs) == 1               # cancelled waiter unwound
+    release.set()
+    builder_thread.join(30)             # builder completes normally
+    assert cache.get_or_build(("slow",), slow_builder) is not None
+
+
+def test_program_cache_lru_bound():
+    cache = ProgramCache(max_programs=4, index_path="off")
+    for i in range(10):
+        cache.get_or_build(("k", i), lambda: (lambda x: x))
+    st = cache.stats()
+    assert st["programs"] == 4 and st["evictions"] == 6
+
+
+def test_plan_key_stable_across_row_counts():
+    from spark_rapids_tpu.serving.program_cache import plan_key
+    sess = make_session()
+    k1 = plan_key(sess.create_dataframe(small_table(100))
+                  .groupBy("k").count()._executed_plan(), sess.conf)
+    k2 = plan_key(sess.create_dataframe(small_table(120))
+                  .groupBy("k").count()._executed_plan(), sess.conf)
+    k3 = plan_key(sess.create_dataframe(small_table(100))
+                  .groupBy("k").agg(F.sum("v").alias("s"))
+                  ._executed_plan(), sess.conf)
+    assert k1 == k2
+    assert k1 != k3
+
+
+# ---------------------------------------------------- per-query metrics
+def test_interleaved_collects_keep_metrics_separate():
+    """The session.last_metrics data-race fix: concurrent queries get
+    their own exec-metric snapshots, and the global alias is exactly one
+    query's complete snapshot (never a mix)."""
+    sess = make_session()
+    df_a = (sess.create_dataframe(small_table(128, seed=3))
+            .groupBy("k").agg(F.sum("v").alias("s")))
+    df_b = (sess.create_dataframe(small_table(64, seed=4))
+            .filter(F.col("v") > 0.5).select("k"))
+    ha = sess.submit(df_a, label="a")
+    hb = sess.submit(df_b, label="b")
+    ha.result(timeout=120)
+    hb.result(timeout=120)
+    assert ha.exec_metrics and hb.exec_metrics
+    assert "transfer" in ha.exec_metrics and "transfer" in hb.exec_metrics
+    assert ha.exec_metrics is not hb.exec_metrics
+    # the compatibility alias is one query's snapshot object, unmutated
+    assert sess.last_metrics in (ha.exec_metrics, hb.exec_metrics) or \
+        sess.last_metrics == ha.exec_metrics or \
+        sess.last_metrics == hb.exec_metrics
+
+
+# ------------------------------------------------------- scan-cache latch
+def test_scan_cache_concurrent_miss_single_upload():
+    from spark_rapids_tpu.memory.scan_cache import DeviceScanCache
+
+    class FakeBatch:
+        device_size_bytes = 128
+
+    cache = DeviceScanCache(max_bytes=1 << 20)
+    table = small_table(8)
+    uploads = []
+
+    def builder():
+        uploads.append(1)
+        time.sleep(0.05)
+        return FakeBatch()
+
+    outs = []
+    threads = [threading.Thread(
+        target=lambda: outs.append(cache.get_or_put(table, 16, builder)))
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(uploads) == 1
+    assert len({id(o) for o in outs}) == 1
+
+
+def test_scan_cache_builder_failure_releases_latch():
+    from spark_rapids_tpu.memory.scan_cache import DeviceScanCache
+
+    class FakeBatch:
+        device_size_bytes = 128
+
+    cache = DeviceScanCache(max_bytes=1 << 20)
+    table = small_table(8)
+
+    def failing():
+        raise RuntimeError("upload died")
+
+    with pytest.raises(RuntimeError):
+        cache.get_or_put(table, 16, failing)
+    # the key is not latched forever: a later builder succeeds
+    out = cache.get_or_put(table, 16, FakeBatch)
+    assert isinstance(out, FakeBatch)
+
+
+# --------------------------------------------------- semaphore fairness
+def test_semaphore_weighted_fairness_and_fifo():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    assert sem.acquire_if_necessary(task_id=999)
+    order = []
+    threads = []
+
+    def waiter(name, tenant, tid):
+        with sem.held(task_id=tid, tenant=tenant):
+            order.append(name)
+
+    for i, (name, tenant) in enumerate(
+            [("a1", "a"), ("a2", "a"), ("b1", "b")]):
+        t = threading.Thread(target=waiter, args=(name, tenant, 1000 + i))
+        t.start()
+        deadline = time.time() + 10
+        while sem.waiting < i + 1 and time.time() < deadline:
+            time.sleep(0.005)
+        threads.append(t)
+    sem.release_if_necessary(task_id=999)
+    for t in threads:
+        t.join(30)
+    # deficit round-robin: a then b then a — FIFO within tenant a
+    assert order == ["a1", "b1", "a2"]
+
+
+def test_semaphore_weight_prefers_heavy_tenant():
+    """Weighted deficit round-robin: with heavy:3, heavy admits 3 of the
+    first 4 permits. From zero deficits: heavy wins the tie (name), then
+    light's 0 deficit beats heavy's 1/3, then heavy (1/3, 2/3) beats
+    light's 1 twice, then light drains."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    sem.set_tenant_weight("heavy", 3.0)
+    assert sem.acquire_if_necessary(task_id=999)
+    order = []
+    threads = []
+    for i, (name, tenant) in enumerate(
+            [("l1", "light"), ("h1", "heavy"), ("l2", "light"),
+             ("h2", "heavy"), ("h3", "heavy")]):
+        def waiter(name=name, tenant=tenant, tid=2000 + i):
+            with sem.held(task_id=tid, tenant=tenant):
+                order.append(name)
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.time() + 10
+        while sem.waiting < i + 1 and time.time() < deadline:
+            time.sleep(0.005)
+        threads.append(t)
+    sem.release_if_necessary(task_id=999)
+    for t in threads:
+        t.join(30)
+    assert order == ["h1", "l1", "h2", "h3", "l2"]
+
+
+def test_semaphore_late_joiner_does_not_monopolize():
+    """Deficit counters are clamped on tenant (re)activation: a tenant
+    joining after another has been served for a while must share from
+    NOW on, not drain its whole historical 'debt' first."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    for i in range(5):      # tenant a has history
+        assert sem.acquire_if_necessary(task_id=100 + i, tenant="a")
+        sem.release_if_necessary(task_id=100 + i)
+    assert sem.acquire_if_necessary(task_id=999)
+    order = []
+    threads = []
+    for i, (name, tenant) in enumerate(
+            [("a1", "a"), ("b1", "b"), ("b2", "b")]):
+        def waiter(name=name, tenant=tenant, tid=3000 + i):
+            with sem.held(task_id=tid, tenant=tenant):
+                order.append(name)
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.time() + 10
+        while sem.waiting < i + 1 and time.time() < deadline:
+            time.sleep(0.005)
+        threads.append(t)
+    sem.release_if_necessary(task_id=999)
+    for t in threads:
+        t.join(30)
+    # without the activation clamp, b's zero deficit would admit b1 AND
+    # b2 before a1 despite a1 queueing first
+    assert order == ["a1", "b1", "b2"]
+
+
+def test_semaphore_returning_tenant_not_starved():
+    """The inverse of the late-joiner case: a tenant with long served
+    history re-activating against a newcomer's backlog joins at the
+    CURRENT floor instead of waiting for the newcomer to 'catch up' its
+    entire history."""
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    for i in range(5):      # tenant a has long history
+        assert sem.acquire_if_necessary(task_id=100 + i, tenant="a")
+        sem.release_if_necessary(task_id=100 + i)
+    assert sem.acquire_if_necessary(task_id=999)
+    order = []
+    threads = []
+    for i, (name, tenant) in enumerate(
+            [("b1", "b"), ("b2", "b"), ("b3", "b"), ("a1", "a")]):
+        def waiter(name=name, tenant=tenant, tid=4000 + i):
+            with sem.held(task_id=tid, tenant=tenant):
+                order.append(name)
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.time() + 10
+        while sem.waiting < i + 1 and time.time() < deadline:
+            time.sleep(0.005)
+        threads.append(t)
+    sem.release_if_necessary(task_id=999)
+    for t in threads:
+        t.join(30)
+    # pre-fix, a1 would wait behind ALL of b's backlog (a's deficit 5 vs
+    # b's 0); with the activation reset a re-enters at the floor
+    assert order.index("a1") <= 1, order
+
+
+def test_tenant_weights_conf_reaches_device_semaphore():
+    """serving.tenantWeights must drive device admission even though the
+    DeviceManager is created lazily AFTER the scheduler."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    sess = make_session({
+        "spark.rapids.tpu.serving.tenantWeights": "etl:3,adhoc:1"})
+    h = sess.submit(sess.create_dataframe(small_table(16))
+                    .groupBy("k").count(), tenant="etl")
+    h.result(timeout=120)
+    sem = DeviceManager.get().semaphore
+    assert sem._weights.get("etl") == 3.0
+    assert sem._weights.get("adhoc") == 1.0
+
+
+def test_program_cache_no_disk_hits_with_persistence_off():
+    cache = ProgramCache(index_path="off")
+    cache.get_or_build(("k", 1), lambda: (lambda x: x))
+    cache.clear()                       # forces a rebuild of a known key
+    cache.get_or_build(("k", 1), lambda: (lambda x: x))
+    assert cache.stats()["disk_hits"] == 0
+
+
+def test_scan_cache_latch_wait_is_cancellable():
+    from spark_rapids_tpu.memory.scan_cache import DeviceScanCache
+
+    class FakeBatch:
+        device_size_bytes = 128
+
+    cache = DeviceScanCache(max_bytes=1 << 20)
+    table = small_table(8)
+    release = threading.Event()
+
+    def slow_builder():
+        release.wait(20)
+        return FakeBatch()
+
+    builder_thread = threading.Thread(
+        target=lambda: cache.get_or_put(table, 16, slow_builder))
+    builder_thread.start()
+    deadline = time.time() + 10
+    while not cache._inflight and time.time() < deadline:
+        time.sleep(0.005)
+    cancelled = threading.Event()
+    cancelled.set()
+
+    def check():
+        if cancelled.is_set():
+            raise QueryCancelledError("stop")
+
+    with pytest.raises(QueryCancelledError):
+        cache.get_or_put(table, 16, lambda: FakeBatch(),
+                         cancel_check=check)
+    release.set()
+    builder_thread.join(30)
+    assert cache.get(table, 16) is not None
+
+
+def test_semaphore_nesting_preserved():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    with sem.held(task_id=7):
+        with sem.held(task_id=7):       # same task nests, no second permit
+            assert sem.active_holders == 1
+        assert sem.active_holders == 1
+    assert sem.active_holders == 0
+
+
+def test_semaphore_cancel_check_unblocks_waiter():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    assert sem.acquire_if_necessary(task_id=1)
+    cancelled = threading.Event()
+
+    def check():
+        if cancelled.is_set():
+            raise QueryCancelledError("stop")
+
+    errs = []
+
+    def waiter():
+        try:
+            with sem.held(task_id=2, cancel_check=check):
+                pass
+        except QueryCancelledError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.time() + 10
+    while sem.waiting < 1 and time.time() < deadline:
+        time.sleep(0.005)
+    cancelled.set()
+    t.join(30)
+    assert len(errs) == 1
+    assert sem.waiting == 0
+    sem.release_if_necessary(task_id=1)
+    # the permit is untouched and reusable
+    assert sem.acquire_if_necessary(task_id=3, timeout=1)
+    sem.release_if_necessary(task_id=3)
+
+
+# --------------------------------------------------- store concurrency
+def test_store_concurrency_under_running_query():
+    """Hammer BufferCatalog acquire/remove and the spill path from 8
+    threads while a query runs through the same DeviceManager: no
+    exceptions, catalog consistent, every hammered buffer cleaned up."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.memory.store import INPUT_BATCH_PRIORITY
+
+    DeviceManager.shutdown()
+    sess = make_session({
+        # small device budget so adds force spills down the chain
+        "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(256 << 10),
+        "spark.rapids.tpu.memory.host.spillStorageSize": str(256 << 10)})
+    dm = DeviceManager.initialize(sess.conf)
+    ids_before = set(dm.catalog.ids())
+    tab = pa.table({"x": np.arange(512, dtype="int64")})
+    errors = []
+    table_ids = [(1 << 27) + i for i in range(8)]
+
+    def hammer(tid):
+        try:
+            rng = np.random.default_rng(tid)
+            mine = []
+            for i in range(12):
+                bid = BufferId(tid, i)
+                batch = DeviceBatch.from_arrow(tab, 16)
+                dm.device_store.add_batch(bid, batch,
+                                          INPUT_BATCH_PRIORITY)
+                mine.append(bid)
+                # interleave acquire/release/remove with other threads'
+                # adds so spill + catalog paths race for real
+                probe = mine[int(rng.integers(0, len(mine)))]
+                buf = dm.catalog.acquire(probe)
+                if buf is not None:
+                    buf.close()
+                if rng.random() < 0.3 and len(mine) > 1:
+                    dm.catalog.remove(mine.pop(0))
+            for bid in mine:
+                dm.catalog.remove(bid)
+        except Exception as e:          # noqa: BLE001 - asserted below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(tid,))
+               for tid in table_ids]
+    for t in threads:
+        t.start()
+    # a real query runs through the same manager meanwhile
+    df = (sess.create_dataframe(small_table(256))
+          .repartition(4, F.col("k")).groupBy("k")
+          .agg(F.sum("v").alias("s")))
+    h = sess.submit(df)
+    out = h.result(timeout=180)
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert out.num_rows > 0
+    assert set(dm.catalog.ids()) == ids_before
+    assert dm.semaphore.active_holders == 0
+    DeviceManager.shutdown()
+
+
+def test_scheduler_shutdown_cancels_queued():
+    sess = make_session({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    queued = [sess.submit(sess.create_dataframe(small_table(16))
+                          .groupBy("k").count()) for _ in range(3)]
+    sess.scheduler.shutdown(wait=False)
+    release.set()
+    blocker.wait(120)
+    for h in queued:
+        h.wait(120)
+        assert h.state is QueryState.CANCELLED
+    with pytest.raises(RuntimeError):
+        sess.scheduler.submit(sess.create_dataframe(small_table(8)))
