@@ -11,8 +11,17 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 echo "== config docs in sync =="
 python -m spark_rapids_tpu.analysis --check-configs
 
-echo "== tpu-lint (R001-R006 incl. config drift; fails on non-baselined findings) =="
+echo "== tpu-lint (full rule set R001-R010 incl. interprocedural R008-R010; fails on non-baselined findings) =="
+lint_start=$(date +%s)
 python -m spark_rapids_tpu.analysis spark_rapids_tpu/
+lint_elapsed=$(( $(date +%s) - lint_start ))
+# runtime guard: the interprocedural pass (call graph + CFG dataflow) must
+# not quietly blow up premerge latency
+if [ "${lint_elapsed}" -gt 30 ]; then
+  echo "tpu-lint runtime guard FAILED: ${lint_elapsed}s > 30s budget"
+  exit 1
+fi
+echo "tpu-lint runtime: ${lint_elapsed}s (budget 30s)"
 
 echo "== fast suite (slow markers excluded) =="
 python -m pytest tests/ -x -q -m "not slow"

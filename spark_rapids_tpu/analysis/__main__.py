@@ -7,29 +7,37 @@ With no paths, lints the spark_rapids_tpu package itself. Exit status is 0
 when no non-baselined findings remain, 1 otherwise.
 
 Options:
-    --strict           ignore the baseline (nightly mode: grandfathered
-                       debt stays visible)
-    --baseline PATH    baseline file (default ci/tpu-lint-baseline.json)
-    --write-baseline   write current findings as a baseline skeleton
-                       (justifications left empty; the file will not load
-                       until they are filled in)
-    --rules IDS        comma-separated rule subset, e.g. R001,R004
-    --list-rules       print the rule catalog and exit
-    --check-configs    verify docs/configs.md matches the registry (the
-                       premerge docs-sync gate; R004 drift runs in the
-                       normal lint pass with baseline semantics)
+    --strict            ignore the baseline (nightly mode: grandfathered
+                        debt stays visible) AND fail on stale baseline
+                        entries — an entry whose (rule, path, code) no
+                        longer matches any source line must be removed
+    --baseline PATH     baseline file (default ci/tpu-lint-baseline.json)
+    --write-baseline    write current findings as a baseline skeleton
+                        (justifications left empty; the file will not load
+                        until they are filled in)
+    --rules IDS         comma-separated rule subset, e.g. R008,R009,R010
+    --list-rules        print the rule catalog and exit
+    --list-suppressions inventory every inline ``# tpu-lint: disable=``
+                        with file:line and its justification text
+    --format MODE       output format: text (default) or json — json emits
+                        one machine-readable object for CI annotation
+    --check-configs     verify docs/configs.md matches the registry (the
+                        premerge docs-sync gate; R004 drift runs in the
+                        normal lint pass with baseline semantics)
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import re
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from spark_rapids_tpu.analysis import baseline as bl
-from spark_rapids_tpu.analysis.core import (AnalysisResult, SourceFile,
-                                            all_rules, analyze_files,
-                                            load_source)
+from spark_rapids_tpu.analysis.core import (_SUPPRESS_RE, AnalysisResult,
+                                            SourceFile, all_rules,
+                                            analyze_files, load_source)
 
 
 def _repo_root() -> str:
@@ -84,17 +92,109 @@ def check_configs(root: str) -> int:
     return 0
 
 
+def _suppression_justification(src: SourceFile, lineno: int) -> str:
+    """The human text around a ``# tpu-lint: disable=`` directive: the
+    comment on the same line with the directive stripped, else the pure
+    comment line directly above."""
+    def comment_text(line: str) -> str:
+        idx = line.find("#")
+        if idx < 0:
+            return ""
+        text = line[idx:]
+        text = _SUPPRESS_RE.sub("", text)
+        text = re.sub(r"#\s*noqa[^#]*", "", text)
+        return text.replace("#", " ").strip(" -—:\t")
+
+    own = comment_text(src.lines[lineno - 1]) \
+        if lineno - 1 < len(src.lines) else ""
+    # justification blocks conventionally sit in the comment run just above
+    # the suppressed statement (possibly a couple of code lines up when the
+    # statement wraps): collect the nearest contiguous pure-comment block
+    block: List[str] = []
+    i = lineno - 2
+    skipped = 0
+    while i >= 0 and skipped <= 2 and not block:
+        line = src.lines[i].strip()
+        if line.startswith("#"):
+            while i >= 0 and src.lines[i].strip().startswith("#"):
+                text = comment_text(src.lines[i])
+                if text:
+                    block.insert(0, text)
+                i -= 1
+            break
+        if not line:
+            break
+        skipped += 1
+        i -= 1
+    pieces = [p for p in (" ".join(block), own) if p]
+    return " — ".join(pieces) if len(pieces) > 1 else \
+        (pieces[0] if pieces else "")
+
+
+def list_suppressions(files: List[SourceFile], fmt: str) -> int:
+    entries: List[Dict[str, object]] = []
+    for src in files:
+        for lineno in sorted(src.suppressions):
+            entries.append({
+                "path": src.display_path,
+                "line": lineno,
+                "rules": sorted(src.suppressions[lineno]),
+                "justification": _suppression_justification(src, lineno),
+                "code": src.line_text(lineno),
+            })
+    if fmt == "json":
+        print(json.dumps({"suppressions": entries}, indent=2))
+        return 0
+    for e in entries:
+        just = e["justification"] or "(no justification text)"
+        print(f"{e['path']}:{e['line']}: {','.join(e['rules'])} — {just}")
+    print(f"{len(entries)} inline suppression(s) in {len(files)} files")
+    return 0
+
+
+def _emit(findings, errors, stale, files_scanned: int, absorbed: int,
+          fmt: str) -> None:
+    if fmt == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "errors": list(errors),
+            "stale_baseline": list(stale),
+            "files_scanned": files_scanned,
+            "baselined": absorbed,
+        }, indent=2))
+        return
+    for f in findings:
+        print(f.render())
+    for err in errors:
+        print(f"PARSE ERROR: {err} (file NOT analyzed)")
+    for msg in stale:
+        print(msg)
+    note = f", {absorbed} baselined" if absorbed else ""
+    if findings or errors or stale:
+        bits = [f"{len(findings)} finding(s)",
+                f"{len(errors)} unparseable file(s)"]
+        if stale:
+            bits.append(f"{len(stale)} stale baseline entr"
+                        f"{'ies' if len(stale) > 1 else 'y'}")
+        print(f"tpu-lint: {', '.join(bits)} in {files_scanned} "
+              f"files{note}")
+    else:
+        print(f"tpu-lint: clean ({files_scanned} files{note})")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m spark_rapids_tpu.analysis",
                                  description="tpu-lint static analysis")
     ap.add_argument("paths", nargs="*", help="files or directories "
                     "(default: the spark_rapids_tpu package)")
     ap.add_argument("--strict", action="store_true",
-                    help="ignore the baseline")
+                    help="ignore the baseline; fail on stale entries")
     ap.add_argument("--baseline", default=None, metavar="PATH")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--rules", default=None, metavar="IDS")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-suppressions", action="store_true")
+    ap.add_argument("--format", default="text", choices=("text", "json"))
     ap.add_argument("--check-configs", action="store_true")
     args = ap.parse_args(argv)
 
@@ -112,6 +212,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if args.rules else None)
     parse_errors: List[str] = []
     files = collect_files(paths, root, parse_errors)
+    if args.list_suppressions:
+        return list_suppressions(files, args.format)
     if not files and not parse_errors:
         print("no python files found under", paths)
         return 1
@@ -127,20 +229,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     findings = result.findings
     absorbed = 0
+    stale: List[str] = []
     if not args.strict:
         findings, absorbed = bl.apply_baseline(findings, baseline_path)
-    for f in findings:
-        print(f.render())
-    for err in result.errors:
-        print(f"PARSE ERROR: {err} (file NOT analyzed)")
-    note = f", {absorbed} baselined" if absorbed else ""
-    if findings or result.errors:
-        print(f"tpu-lint: {len(findings)} finding(s), "
-              f"{len(result.errors)} unparseable file(s) in "
-              f"{result.files_scanned} files{note}")
-        return 1
-    print(f"tpu-lint: clean ({result.files_scanned} files{note})")
-    return 0
+    else:
+        # nightly hygiene: a baseline entry no source line matches anymore
+        # is debt pretending to still exist — fail with a remove-me
+        stale = bl.stale_entries(baseline_path, files, root)
+    _emit(findings, result.errors, stale, result.files_scanned, absorbed,
+          args.format)
+    return 1 if (findings or result.errors or stale) else 0
 
 
 if __name__ == "__main__":

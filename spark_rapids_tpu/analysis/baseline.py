@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.analysis.core import Finding
 
@@ -61,6 +61,35 @@ def apply_baseline(findings: List[Finding], path: str
         else:
             new.append(f)
     return new, absorbed
+
+
+def stale_entries(path: str, files, root: Optional[str] = None) -> List[str]:
+    """Baseline entries whose (rule, path, code) no longer matches ANY
+    source line — dead weight that would silently absorb a future
+    unrelated finding with the same shape. ``--strict`` (nightly) fails on
+    these with a remove-me message, so grandfathered debt disappears from
+    the ledger the same PR it disappears from the code.
+
+    An entry for a file OUTSIDE the analyzed set is only stale when the
+    file is gone from disk too — ``--strict path/to/one_file.py`` subset
+    runs must not condemn live entries for files they never looked at."""
+    table = load_baseline(path)
+    by_path = {f.display_path: f for f in files}
+    out: List[str] = []
+    for (rule, fpath, code) in sorted(table):
+        src = by_path.get(fpath)
+        if src is None:
+            on_disk = os.path.join(root, fpath) if root else fpath
+            if not os.path.exists(on_disk):
+                out.append(
+                    f"stale baseline entry: ({rule}, {fpath}) — the file "
+                    f"no longer exists; remove me")
+            continue
+        if not any(line.strip() == code for line in src.lines):
+            out.append(
+                f"stale baseline entry: ({rule}, {fpath}, {code!r}) no "
+                f"longer matches any source line; remove me")
+    return out
 
 
 def write_baseline(findings: List[Finding], path: str) -> None:

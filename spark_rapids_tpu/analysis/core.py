@@ -56,6 +56,11 @@ class Finding:
     def baseline_key(self) -> Tuple[str, str, str]:
         return (self.rule, self.path, self.code)
 
+    def to_dict(self) -> Dict[str, object]:
+        """Machine-readable form for ``--format json`` (CI annotations)."""
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "code": self.code}
+
 
 class Rule:
     """Base lint rule. Subclasses set ``rule_id``/``title`` and implement
@@ -94,10 +99,13 @@ def all_rules() -> List[Rule]:
 
 def _load_builtin_rules() -> None:
     # import for side effect: each module registers its rules
-    from spark_rapids_tpu.analysis import (rules_dtype,      # noqa: F401
+    from spark_rapids_tpu.analysis import (rules_cancel,     # noqa: F401
+                                           rules_dtype,      # noqa: F401
+                                           rules_lockorder,  # noqa: F401
                                            rules_locks,      # noqa: F401
                                            rules_project,    # noqa: F401
                                            rules_recompile,  # noqa: F401
+                                           rules_resource,   # noqa: F401
                                            rules_serving,    # noqa: F401
                                            rules_sync)       # noqa: F401
 

@@ -111,7 +111,14 @@ class PipelinedExec(PhysicalExec):
         worker.start()
         try:
             while True:
-                kind, val = q.get()
+                # bounded poll (R010): the producer normally wakes us, but
+                # if it wedges mid-upload a cancelled consumer must still
+                # observe its flag instead of blocking here forever
+                try:
+                    kind, val = q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    ctx.check_cancelled()
+                    continue
                 if kind == "end":
                     return
                 if kind == "e":

@@ -75,11 +75,23 @@ class ShuffleBufferCatalog:
         with self._lock:
             entries = list(self._blocks.get(block, []))
         out = []
-        for buffer_id, meta in entries:
-            buf = self._catalog.acquire(buffer_id)
-            if buf is None:
-                raise KeyError(f"shuffle buffer {buffer_id} vanished for {block}")
-            out.append((buf, meta))
+        buf = None
+        try:
+            for buffer_id, meta in entries:
+                buf = self._catalog.acquire(buffer_id)
+                if buf is None:
+                    raise KeyError(
+                        f"shuffle buffer {buffer_id} vanished for {block}")
+                out.append((buf, meta))
+                buf = None      # handed off to `out`; the except owns it not
+        except BaseException:
+            # a later acquire failing must not strand the refcounts the
+            # earlier ones already took (found during the R008 audit)
+            if buf is not None:
+                buf.close()
+            for b, _m in out:
+                b.close()
+            raise
         return out
 
     def remove_shuffle(self, shuffle_id: int) -> int:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -286,65 +287,82 @@ class CachingShuffleReader:
             peers[peer] = _PeerFetch(blocks)
             self._start_fetch(q, peer, blocks)
 
-        if self.semaphore is not None:
-            self.semaphore.acquire_if_necessary()
-
-        for block in local_blocks:
-            for buf, _meta in self.env.shuffle_catalog.acquire_buffers(block):
+        # SCOPED hold (R008 fix): the old bare acquire_if_necessary never
+        # released, so a reader driven outside a task's held() scope pinned
+        # a device permit for the thread's lifetime. held() nests when the
+        # owning task already holds (the normal exec path) and releases at
+        # generator close when this reader was the first acquirer.
+        hold = (self.semaphore.held() if self.semaphore is not None
+                else nullcontext())
+        with hold:
+            for block in local_blocks:
+                # acquire_buffers retains EVERY buffer of the block upfront;
+                # an early generator close (LIMIT downstream) must release
+                # the not-yet-yielded tail, not just the buffer in hand
+                acquired = self.env.shuffle_catalog.acquire_buffers(block)
                 try:
-                    yield buf.get_batch()
+                    while acquired:
+                        buf, _meta = acquired.pop(0)
+                        try:
+                            yield buf.get_batch()
+                        finally:
+                            buf.close()
                 finally:
-                    buf.close()
+                    for buf, _meta in acquired:
+                        buf.close()
 
-        # drain remote results under ONE overall WAIT budget: the timeout
-        # counts only time this reader spends blocked on the fetch (queue
-        # waits + retry backoffs), never the consumer's compute between
-        # yields — a slow join downstream must not fake a fetch failure,
-        # while a trickling-but-stuck fetch still exhausts the budget
-        wait_budget = self.timeout
-        delivered: set = set()     # (block, table_idx) pairs yielded already
-        while not all(st.done(delivered) for st in peers.values()):
-            if wait_budget <= 0:
-                self._raise_timeout(peers, delivered)
-            t0 = _time.monotonic()
-            try:
-                kind, peer, value = q.get(timeout=wait_budget)
-            except queue.Empty:
-                self._raise_timeout(peers, delivered)
-            finally:
-                wait_budget -= _time.monotonic() - t0
-            st = peers[peer]
-            if kind == "start":
-                st.needed = set(value)
-            elif kind == "error":
-                message, failed_blocks, permanent = value
-                st.attempts += 1
-                if permanent or st.attempts > self.max_retries:
-                    raise ShuffleFetchFailedError(
-                        f"fetch from {peer} failed after {st.attempts} "
-                        f"attempts: {message}", executor_id=peer,
-                        blocks=tuple(failed_blocks) or tuple(st.blocks))
-                self.env.metrics[mt.SHUFFLE_FETCH_RETRIES].add(1)
-                # bounded pause, then re-fetch only the undelivered blocks on
-                # a fresh client (the dead one was evicted on peer loss)
-                pause = min(
-                    _retry.backoff_ms(st.attempts - 1, self.backoff_ms,
-                                      self.retry_seed, key=f"read:{peer}") / 1e3,
-                    max(wait_budget, 0))
-                _time.sleep(pause)
-                wait_budget -= pause
-                if failed_blocks:
-                    st.blocks = list(failed_blocks)
-                st.needed = None
-                self._start_fetch(q, peer, st.blocks)
-            else:
-                rid, block, table_idx = value
-                raw, meta = self.env.received_catalog.take(rid)
-                if (block, table_idx) in delivered:
-                    continue          # duplicate from a retried/duped transfer
-                delivered.add((block, table_idx))
-                hb = unpack_host_batch(raw, meta)
-                yield host_to_device_batch(hb)
+            # drain remote results under ONE overall WAIT budget: the
+            # timeout counts only time this reader spends blocked on the
+            # fetch (queue waits + retry backoffs), never the consumer's
+            # compute between yields — a slow join downstream must not fake
+            # a fetch failure, while a trickling-but-stuck fetch still
+            # exhausts the budget
+            wait_budget = self.timeout
+            delivered: set = set()  # (block, table_idx) pairs yielded already
+            while not all(st.done(delivered) for st in peers.values()):
+                if wait_budget <= 0:
+                    self._raise_timeout(peers, delivered)
+                t0 = _time.monotonic()
+                try:
+                    kind, peer, value = q.get(timeout=wait_budget)
+                except queue.Empty:
+                    self._raise_timeout(peers, delivered)
+                finally:
+                    wait_budget -= _time.monotonic() - t0
+                st = peers[peer]
+                if kind == "start":
+                    st.needed = set(value)
+                elif kind == "error":
+                    message, failed_blocks, permanent = value
+                    st.attempts += 1
+                    if permanent or st.attempts > self.max_retries:
+                        raise ShuffleFetchFailedError(
+                            f"fetch from {peer} failed after {st.attempts} "
+                            f"attempts: {message}", executor_id=peer,
+                            blocks=tuple(failed_blocks) or tuple(st.blocks))
+                    self.env.metrics[mt.SHUFFLE_FETCH_RETRIES].add(1)
+                    # bounded pause, then re-fetch only the undelivered
+                    # blocks on a fresh client (the dead one was evicted on
+                    # peer loss)
+                    pause = min(
+                        _retry.backoff_ms(st.attempts - 1, self.backoff_ms,
+                                          self.retry_seed,
+                                          key=f"read:{peer}") / 1e3,
+                        max(wait_budget, 0))
+                    _time.sleep(pause)
+                    wait_budget -= pause
+                    if failed_blocks:
+                        st.blocks = list(failed_blocks)
+                    st.needed = None
+                    self._start_fetch(q, peer, st.blocks)
+                else:
+                    rid, block, table_idx = value
+                    raw, meta = self.env.received_catalog.take(rid)
+                    if (block, table_idx) in delivered:
+                        continue    # duplicate from a retried/duped transfer
+                    delivered.add((block, table_idx))
+                    hb = unpack_host_batch(raw, meta)
+                    yield host_to_device_batch(hb)
 
     def _start_fetch(self, q: "queue.Queue", peer: str, blocks) -> None:
         """Kick off (or re-kick after an error) one peer's fetch. A connect

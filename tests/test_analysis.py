@@ -425,3 +425,585 @@ def test_unparseable_file_fails_the_gate(tmp_path):
     assert len(files) == 1 and len(errors) == 1
     assert "broken.py" in errors[0]
     assert main([str(tmp_path)]) == 1
+
+
+# ------------------------------------------------------------------ R008
+def test_r008_catalog_remove_vs_spill_leak_shape():
+    """The pre-fix PR 8 bug: ``remove`` acquires (refcount retain), then the
+    unregister-failed branch — a concurrent spill re-registered the copy at
+    a lower tier — returns WITHOUT closing. Found originally by an 8-thread
+    hammer test; R008 must catch the shape statically."""
+    fs = src("""
+        class ShuffleBufferCatalog:
+            def remove(self, buffer_id):
+                buf = self.catalog.acquire(buffer_id)
+                if buf is None:
+                    return False
+                if self.catalog.unregister(buffer_id):
+                    buf.close()
+                    return True
+                return False
+        """, path="shuffle/catalog.py")
+    found = run(fs, {"R008"})
+    assert len(found) == 1
+    assert "retained buffer never close()d" in found[0].message
+    assert "'buf'" in found[0].message
+
+
+def test_r008_semaphore_hold_escape():
+    fs = src("""
+        class Reader:
+            def read(self):
+                self.semaphore.acquire_if_necessary()
+                if not self.blocks:
+                    return []
+                out = self.do_work()
+                self.semaphore.release_if_necessary()
+                return out
+        """, path="shuffle/reader.py")
+    found = run(fs, {"R008"})
+    assert len(found) == 1
+    assert "semaphore hold never release_if_necessary()d" in found[0].message
+
+
+def test_r008_finally_release_clean():
+    fs = src("""
+        class Reader:
+            def read(self):
+                self.semaphore.acquire_if_necessary()
+                try:
+                    return self.do_work()
+                finally:
+                    self.semaphore.release_if_necessary()
+        """, path="shuffle/reader.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_none_guard_clean():
+    """Branch sensitivity: the branch that proved the buffer None holds
+    nothing — the acquire-then-guard idiom stays clean."""
+    fs = src("""
+        class C:
+            def get(self, key):
+                buf = self.catalog.acquire(key)
+                if buf is None:
+                    return None
+                try:
+                    return buf.get_batch()
+                finally:
+                    buf.close()
+        """, path="memory/c.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_handoff_ends_tracking():
+    """Returning / storing / appending the buffer transfers ownership."""
+    fs = src("""
+        class C:
+            def take(self, key):
+                buf = self.catalog.acquire(key)
+                return buf
+            def stash(self, key):
+                buf = self.catalog.acquire(key)
+                self._held[key] = buf
+            def collect(self, keys, out):
+                for key in keys:
+                    buf = self.catalog.acquire(key)
+                    out.append(buf)
+        """, path="memory/c.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_with_held_scope_clean():
+    """``with sem.held():`` is scoped — never tracked as a bare hold."""
+    fs = src("""
+        class Reader:
+            def read(self):
+                with self.semaphore.held():
+                    if not self.blocks:
+                        return []
+                    return self.do_work()
+        """, path="shuffle/reader.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_build_latch_leak_and_clean():
+    leak = src("""
+        import threading
+        class Cache:
+            def get_or_put(self, key, builder):
+                ev = threading.Event()
+                self._inflight[key] = ev
+                return builder()
+        """, path="memory/cache.py")
+    found = run(leak, {"R008"})
+    assert len(found) == 1 and "build latch" in found[0].message
+
+    clean = src("""
+        import threading
+        class Cache:
+            def get_or_put(self, key, builder):
+                ev = threading.Event()
+                self._inflight[key] = ev
+                try:
+                    return builder()
+                finally:
+                    self._inflight.pop(key, None)
+                    ev.set()
+        """, path="memory/cache.py")
+    assert run(clean, {"R008"}) == []
+
+
+def test_r008_permit_released_by_nested_def_clean():
+    """The shuffle client's release_once-closure idiom: a nested def
+    releasing the receiver is a designed deferred handoff."""
+    fs = src("""
+        class Client:
+            def fetch(self, blocks):
+                self._throttle.acquire()
+                def release_once():
+                    self._throttle.release()
+                self.start(blocks, on_done=release_once)
+        """, path="shuffle/client.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_raise_path_is_a_path():
+    """An explicit raise escaping with a live hold is flagged; the same
+    function releasing in a finally is clean."""
+    fs = src("""
+        class C:
+            def f(self):
+                self.sem.acquire_if_necessary()
+                if self.bad:
+                    raise RuntimeError("boom")
+                self.sem.release_if_necessary()
+        """, path="memory/c.py")
+    found = run(fs, {"R008"})
+    assert len(found) == 1 and "semaphore" in found[0].message
+
+
+def test_r008_outer_except_release_clean():
+    """Review regression: a raise inside a nested finally-only try lands in
+    the OUTER except that releases — chaining handler levels instead of
+    replacing them keeps this shape clean."""
+    fs = src("""
+        class C:
+            def f(self):
+                self.sem.acquire_if_necessary()
+                try:
+                    try:
+                        raise ValueError("x")
+                    finally:
+                        self.log()
+                except ValueError:
+                    self.sem.release_if_necessary()
+        """, path="memory/c.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r008_break_skips_else_release():
+    """Review regression: break exits past the loop's else clause, so a
+    release living ONLY there leaks on every break path; releasing on both
+    exits is clean."""
+    leaky = src("""
+        class C:
+            def f(self, items):
+                self.sem.acquire_if_necessary()
+                for x in items:
+                    if x:
+                        break
+                else:
+                    self.sem.release_if_necessary()
+        """, path="memory/c.py")
+    found = run(leaky, {"R008"})
+    assert len(found) == 1 and "semaphore" in found[0].message
+    balanced = src("""
+        class C:
+            def f(self, items):
+                self.sem.acquire_if_necessary()
+                for x in items:
+                    if x:
+                        self.sem.release_if_necessary()
+                        break
+                else:
+                    self.sem.release_if_necessary()
+        """, path="memory/c.py")
+    assert run(balanced, {"R008"}) == []
+
+
+def test_r008_suppression_applies():
+    fs = src("""
+        class C:
+            def f(self):
+                # designed handoff: the daemon thread releases at shutdown
+                self.sem.acquire_if_necessary()  # tpu-lint: disable=R008
+                self.spawn_daemon()
+        """, path="memory/c.py")
+    assert run(fs, {"R008"}) == []
+
+
+# ------------------------------------------------------------------ R009
+def test_r009_seeded_two_lock_cycle():
+    fs = src("""
+        class Store:
+            def spill(self):
+                with self._lock:
+                    with self._free_cond:
+                        pass
+            def reclaim(self):
+                with self._free_cond:
+                    with self._lock:
+                        pass
+        """, path="memory/store.py")
+    found = run(fs, {"R009"})
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+    assert "_lock" in found[0].message and "_free_cond" in found[0].message
+
+
+def test_r009_consistent_order_clean():
+    fs = src("""
+        class Store:
+            def spill(self):
+                with self._lock:
+                    with self._free_cond:
+                        pass
+            def reclaim(self):
+                with self._lock:
+                    with self._free_cond:
+                        pass
+        """, path="memory/store.py")
+    assert run(fs, {"R009"}) == []
+
+
+def test_r009_interprocedural_cycle_through_call_graph():
+    """A -> B in one module, B -> A established through a method CALL in
+    another: only the call graph sees the inversion."""
+    a = src("""
+        class Catalog:
+            def register(self):
+                with self._lock:
+                    self.store.note()
+            def peek(self):
+                with self._lock:
+                    pass
+        """, path="memory/catalog2.py")
+    b = src("""
+        class Store:
+            def note(self):
+                with self._tier_lock:
+                    pass
+            def drain(self, catalog: Catalog):
+                with self._tier_lock:
+                    catalog.peek()
+        """, path="memory/store2.py")
+    found = run([a, b], {"R009"})
+    assert len(found) == 1
+    assert "_lock" in found[0].message and "_tier_lock" in found[0].message
+
+
+def test_r009_reentrant_same_lock_not_a_cycle():
+    """A -> A through a subclass hierarchy is re-entrancy, not inversion."""
+    fs = src("""
+        class Base:
+            def outer(self):
+                with self._lock:
+                    self.inner()
+        class Child(Base):
+            def inner(self):
+                with self._lock:
+                    pass
+        """, path="memory/tiers.py")
+    assert run(fs, {"R009"}) == []
+
+
+def test_r009_suppression_on_inner_acquisition():
+    fs = src("""
+        class Store:
+            def spill(self):
+                with self._lock:
+                    # lock handoff protocol documented in module docstring
+                    with self._free_cond:  # tpu-lint: disable=R009
+                        pass
+            def reclaim(self):
+                with self._free_cond:
+                    # reverse half of the documented handoff
+                    with self._lock:  # tpu-lint: disable=R009
+                        pass
+        """, path="memory/store.py")
+    assert run(fs, {"R009"}) == []
+
+
+def test_r009_package_lock_graph_is_acyclic():
+    """The real engine's lock graph must stay cycle-free: R009 over the
+    whole package reports nothing (no baseline entries, no suppressions
+    beyond inline-justified ones)."""
+    root = _repo_root()
+    files = collect_files([os.path.join(root, "spark_rapids_tpu")], root)
+    from spark_rapids_tpu.analysis import analyze_files as _af
+    res = _af(files, rule_ids={"R009"})
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+# ------------------------------------------------------------------ R010
+def test_r010_queue_get_on_execute_path_flagged():
+    fs = src("""
+        import queue
+        class FooExec:
+            def execute(self, ctx):
+                q = queue.Queue()
+                self.start(q)
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    yield item
+        """, path="execs/foo.py")
+    found = run(fs, {"R010"})
+    assert len(found) == 1
+    assert "q.get()" in found[0].message
+    assert "cancel" in found[0].message
+
+
+def test_r010_timeout_poll_idiom_clean():
+    fs = src("""
+        import queue
+        class FooExec:
+            def execute(self, ctx):
+                q = queue.Queue()
+                while True:
+                    try:
+                        item = q.get(timeout=0.05)
+                    except queue.Empty:
+                        ctx.check_cancelled()
+                        continue
+                    yield item
+        """, path="execs/foo.py")
+    assert run(fs, {"R010"}) == []
+
+
+def test_r010_interprocedural_wait_below_execute():
+    fs = src("""
+        class BarExec:
+            def execute(self, ctx):
+                return self._drain(ctx)
+            def _drain(self, ctx):
+                self._done_event.wait()
+                return []
+        """, path="execs/bar.py")
+    found = run(fs, {"R010"})
+    assert len(found) == 1 and "_done_event.wait()" in found[0].message
+
+
+def test_r010_unreachable_daemon_clean():
+    """A wait not reachable from any execute/serving root is outside the
+    per-query cancellation contract."""
+    fs = src("""
+        class Daemon:
+            def pump(self):
+                self._ready_event.wait()
+        """, path="execs/daemon.py")
+    assert run(fs, {"R010"}) == []
+
+
+def test_r010_non_exec_module_execute_clean():
+    """`execute` outside execs/ (and non-worker serving functions) is not
+    a root."""
+    fs = src("""
+        class Runner:
+            def execute(self, ctx):
+                self._done_event.wait()
+        """, path="io/runner.py")
+    assert run(fs, {"R010"}) == []
+
+
+def test_r010_wait_with_timeout_clean():
+    fs = src("""
+        class FooExec:
+            def execute(self, ctx):
+                while not self._done_event.wait(0.05):
+                    ctx.check_cancelled()
+        """, path="execs/foo.py")
+    assert run(fs, {"R010"}) == []
+
+
+# ------------------------------------------ interprocedural runtime budget
+def test_interprocedural_rules_stay_inside_runtime_budget():
+    """ISSUE 9's latency contract: the call-graph + CFG pass over the whole
+    package must not blow up premerge (ci/premerge.sh guards the full run
+    at 30 s; the interprocedural subset alone gets 20 s here)."""
+    import time
+    root = _repo_root()
+    files = collect_files([os.path.join(root, "spark_rapids_tpu")], root)
+    from spark_rapids_tpu.analysis import analyze_files as _af
+    t0 = time.monotonic()
+    _af(files, rule_ids={"R008", "R009", "R010"})
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0, f"interprocedural pass took {elapsed:.1f}s"
+
+
+# ------------------------------------------------------ CLI surfaces (v2)
+def test_format_json_findings(tmp_path, capsys):
+    hot = tmp_path / "execs"
+    hot.mkdir()
+    (hot / "foo.py").write_text(
+        "def f(arr):\n    return arr.sum().item()\n")
+    rc = main([str(tmp_path), "--rules", "R002", "--format", "json",
+               "--baseline", str(tmp_path / "nonexistent.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files_scanned"] == 1 and out["baselined"] == 0
+    (finding,) = out["findings"]
+    assert finding["rule"] == "R002"
+    assert finding["path"].endswith("execs/foo.py")
+    assert finding["line"] == 2
+    assert ".item()" in finding["message"]
+    assert finding["code"] == "return arr.sum().item()"
+
+
+def test_list_suppressions_inventory(tmp_path, capsys):
+    (tmp_path / "a.py").write_text(
+        "def f(arr):\n"
+        "    # justified: one designed scalar sync per batch\n"
+        "    return arr.sum().item()  # tpu-lint: disable=R002\n")
+    rc = main(["--list-suppressions", str(tmp_path)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "a.py:3" in text and "R002" in text
+    assert "justified: one designed scalar sync per batch" in text
+
+    rc = main(["--list-suppressions", "--format", "json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    (entry,) = out["suppressions"]
+    assert entry["line"] == 3 and entry["rules"] == ["R002"]
+    assert "designed scalar sync" in entry["justification"]
+
+
+def test_list_suppressions_package_all_justified():
+    """Every inline suppression in the tree carries justification text —
+    the satellite contract: suppressions document themselves."""
+    root = _repo_root()
+    files = collect_files([os.path.join(root, "spark_rapids_tpu")], root)
+    from spark_rapids_tpu.analysis.__main__ import \
+        _suppression_justification
+    for fs in files:
+        for lineno in fs.suppressions:
+            just = _suppression_justification(fs, lineno)
+            assert just, (f"{fs.display_path}:{lineno}: suppression "
+                          f"without justification text")
+
+
+def test_stale_baseline_entry_fails_strict(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R002", "path": "ok.py", "code": "y = z.item()",
+        "count": 1, "justification": "fixed long ago"}]}))
+    # non-strict: the unused entry lingers silently (premerge tolerance)
+    assert main([str(tmp_path), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # strict (nightly): the stale entry fails with a remove-me message
+    rc = main(["--strict", str(tmp_path), "--baseline", str(base)])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "stale baseline entry" in text and "remove me" in text
+
+
+def test_live_baseline_entry_passes_strict_stale_check(tmp_path, capsys):
+    """strict ignores the baseline for ABSORPTION but a still-matching
+    entry is not stale — the finding itself is what strict reports."""
+    hot = tmp_path / "execs"
+    hot.mkdir()
+    (hot / "foo.py").write_text(
+        "def f(arr):\n    return arr.sum().item()\n")
+    # out-of-repo files report their absolute path (collect_files falls
+    # back to it when the repo-relative form would start with "..")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R002", "path": str(hot / "foo.py"),
+        "code": "return arr.sum().item()", "count": 1,
+        "justification": "grandfathered"}]}))
+    rc = main(["--strict", str(tmp_path), "--baseline", str(base),
+               "--rules", "R002"])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "stale baseline entry" not in text
+    assert ".item()" in text
+
+
+def test_r008_nested_finally_outer_release_clean():
+    """Review regression: an abrupt exit through NESTED try/finally must
+    route through every enclosing finally — releasing in the outer one is
+    clean."""
+    fs = src("""
+        class C:
+            def f(self):
+                self.sem.acquire_if_necessary()
+                try:
+                    try:
+                        return self.work()
+                    finally:
+                        self.log()
+                finally:
+                    self.sem.release_if_necessary()
+        """, path="memory/c.py")
+    assert run(fs, {"R008"}) == []
+
+
+def test_r009_closure_under_lock_creates_no_edge():
+    """Review regression: a closure DEFINED under a lock does not RUN
+    under it — its acquisitions must not create lock-order edges."""
+    fs = src("""
+        class Pool:
+            def schedule(self):
+                with self._lock:
+                    def cb():
+                        with self._free_cond:
+                            pass
+                    self.executor.submit(cb)
+            def reclaim(self):
+                with self._free_cond:
+                    with self._lock:
+                        pass
+        """, path="memory/pool.py")
+    assert run(fs, {"R009"}) == []
+
+
+def test_r010_spelled_out_unbounded_get_still_flagged():
+    """Review regression: q.get(True) / q.get(block=True) are the
+    unbounded default restated, not a bound; non-blocking and timed forms
+    stay clean."""
+    fs = src("""
+        import queue
+        class FooExec:
+            def execute(self, ctx):
+                q = queue.Queue()
+                a = q.get(True)
+                b = q.get(block=True)
+                c = q.get(False)
+                d = q.get(block=False)
+                e = q.get(timeout=0.05)
+                g = q.get(True, 0.05)
+        """, path="execs/foo.py")
+    found = run(fs, {"R010"})
+    # lines of q.get(True) and q.get(block=True) in the dedented fixture
+    assert sorted(f.line for f in found) == [6, 7]
+
+
+def test_stale_check_tolerates_subset_invocation(tmp_path, capsys):
+    """Review regression: ``--strict one_file.py`` must not condemn a LIVE
+    baseline entry for a file outside the analyzed set; only entries whose
+    file is gone from disk are stale."""
+    (tmp_path / "a.py").write_text("x = 1\n")
+    (tmp_path / "b.py").write_text(
+        "def f(arr):\n    return arr.sum().item()\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R002", "path": str(tmp_path / "b.py"),
+        "code": "return arr.sum().item()", "count": 1,
+        "justification": "live entry for an unanalyzed file"}]}))
+    assert main(["--strict", "--baseline", str(base),
+                 str(tmp_path / "a.py")]) == 0
+    capsys.readouterr()

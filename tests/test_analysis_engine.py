@@ -1,0 +1,574 @@
+"""Unit tests for the tpu-lint v2 interprocedural engine itself — CFG
+construction (cfg.py), the forward dataflow (dataflow.py), and call-graph
+name resolution (callgraph.py) — separate from the per-rule fixture tests
+in test_analysis.py. The rules are only as sound as these invariants."""
+import ast
+import textwrap
+
+from spark_rapids_tpu.analysis import SourceFile
+from spark_rapids_tpu.analysis.callgraph import (CallGraph, module_name)
+from spark_rapids_tpu.analysis.cfg import (Cond, LoopIter, WithEnter,
+                                           WithExit, build_cfg,
+                                           iter_functions, walk_local)
+from spark_rapids_tpu.analysis import dataflow
+
+
+def parse(text: str, path: str = "pkg/mod.py") -> SourceFile:
+    return SourceFile(path, textwrap.dedent(text), path)
+
+
+def cfg_of(text: str, name: str = "f"):
+    src = parse(text)
+    for qualname, node in iter_functions(src.tree):
+        if qualname.split(".")[-1] == name:
+            return build_cfg(node)
+    raise AssertionError(f"no function {name}")
+
+
+def blocks_calling(cfg, attr: str):
+    """Blocks containing a call whose attribute name is ``attr``."""
+    out = []
+    for b in cfg.blocks.values():
+        for item in b.items:
+            if isinstance(item, ast.AST):
+                for n in ast.walk(item):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute) and \
+                            n.func.attr == attr:
+                        out.append(b)
+    return out
+
+
+def reaches(cfg, src_id: int, dst_id: int) -> bool:
+    seen = set()
+    stack = [src_id]
+    while stack:
+        bid = stack.pop()
+        if bid == dst_id:
+            return True
+        if bid in seen:
+            continue
+        seen.add(bid)
+        stack.extend(t for (t, _l) in cfg.blocks[bid].succs)
+    return False
+
+
+# ------------------------------------------------------------------- CFG
+def test_if_else_creates_labeled_branches_and_join():
+    cfg = cfg_of("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+        """)
+    conds = [b for b in cfg.blocks.values()
+             if b.items and isinstance(b.items[-1], Cond)]
+    assert len(conds) == 1
+    labels = sorted(lbl for (_t, lbl) in conds[0].succs)
+    assert labels == ["false", "true"]
+
+
+def test_early_return_gives_exit_two_predecessors():
+    cfg = cfg_of("""
+        def f(x):
+            if x:
+                return 1
+            return 2
+        """)
+    preds = {bid for (bid, _l) in cfg.predecessors(cfg.exit)}
+    assert len(preds) == 2
+
+
+def test_try_finally_routes_return_through_finally():
+    """A return inside try must reach exit ONLY via the finally block —
+    the property R008's release-in-finally discipline rests on."""
+    cfg = cfg_of("""
+        def f(self):
+            self.acq()
+            try:
+                return self.work()
+            finally:
+                self.rel()
+        """)
+    (ret_block,) = [b for b in cfg.blocks.values()
+                    if any(isinstance(i, ast.Return) for i in b.items)]
+    (fin_block,) = blocks_calling(cfg, "rel")
+    # the return does not edge straight to exit…
+    assert (cfg.exit, None) not in ret_block.succs
+    # …it enters the finally, whose end reaches exit
+    assert any(t == fin_block.id for (t, _l) in ret_block.succs)
+    assert reaches(cfg, fin_block.id, cfg.exit)
+
+
+def test_try_except_edges_body_to_handler():
+    cfg = cfg_of("""
+        def f(self):
+            try:
+                self.work()
+            except ValueError:
+                self.recover()
+            self.after()
+        """)
+    (body,) = blocks_calling(cfg, "work")
+    (handler,) = blocks_calling(cfg, "recover")
+    (after,) = blocks_calling(cfg, "after")
+    assert any(t == handler.id for (t, _l) in body.succs)
+    assert reaches(cfg, handler.id, after.id)
+    assert reaches(cfg, body.id, after.id)
+
+
+def test_with_emits_enter_and_exit_markers():
+    cfg = cfg_of("""
+        def f(self):
+            with self.lock:
+                self.work()
+        """)
+    items = [i for b in cfg.blocks.values() for i in b.items]
+    assert any(isinstance(i, WithEnter) for i in items)
+    assert any(isinstance(i, WithExit) for i in items)
+
+
+def test_with_early_return_skips_exit_marker_block():
+    """A return inside with terminates the block stream — the WithExit
+    marker only sits on the fall-through path."""
+    cfg = cfg_of("""
+        def f(self):
+            with self.lock:
+                return self.work()
+        """)
+    items = [i for b in cfg.blocks.values() for i in b.items]
+    assert any(isinstance(i, WithEnter) for i in items)
+    assert not any(isinstance(i, WithExit) for i in items)
+
+
+def test_while_loop_has_back_edge():
+    cfg = cfg_of("""
+        def f(n):
+            i = 0
+            while i < n:
+                i += 1
+            return i
+        """)
+    assert len(cfg.back_edges()) == 1
+
+
+def test_for_loop_back_edge_and_loopiter_marker():
+    cfg = cfg_of("""
+        def f(xs):
+            total = 0
+            for x in xs:
+                total += x
+            return total
+        """)
+    assert len(cfg.back_edges()) == 1
+    items = [i for b in cfg.blocks.values() for i in b.items]
+    assert any(isinstance(i, LoopIter) for i in items)
+
+
+def test_break_exits_loop_without_back_edge_traversal():
+    cfg = cfg_of("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    break
+            return 1
+        """)
+    (ret_block,) = [b for b in cfg.blocks.values()
+                    if any(isinstance(i, ast.Return) for i in b.items)]
+    # the break path reaches the return without re-entering the loop head
+    assert reaches(cfg, cfg.entry, ret_block.id)
+    assert len(cfg.back_edges()) == 1
+
+
+def test_continue_targets_loop_head():
+    cfg = cfg_of("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    continue
+                use(x)
+            return 1
+        """)
+    # continue closes a second path to the loop head: entry-block edge plus
+    # back-edges; the graph must still reach exit
+    assert reaches(cfg, cfg.entry, cfg.exit)
+    assert len(cfg.back_edges()) >= 1
+
+
+def test_raise_with_no_handler_is_an_exit_path():
+    cfg = cfg_of("""
+        def f(self):
+            if self.bad:
+                raise RuntimeError("boom")
+            return 1
+        """)
+    preds = {bid for (bid, _l) in cfg.predecessors(cfg.exit)}
+    assert len(preds) == 2
+
+
+def test_iter_functions_qualnames():
+    src = parse("""
+        def top():
+            def inner():
+                pass
+        class C:
+            def m(self):
+                pass
+            class D:
+                def n(self):
+                    pass
+        """)
+    names = {qn for qn, _n in iter_functions(src.tree)}
+    assert names == {"top", "top.inner", "C.m", "C.D.n"}
+
+
+def test_walk_local_does_not_descend_into_nested_defs():
+    src = parse("""
+        def outer():
+            x = 1
+            def inner():
+                y = 2
+            return x
+        """)
+    (outer,) = [n for qn, n in iter_functions(src.tree) if qn == "outer"]
+    assigned = {t.id for n in walk_local(outer)
+                if isinstance(n, ast.Assign)
+                for t in n.targets if isinstance(t, ast.Name)}
+    assert assigned == {"x"}
+
+
+# -------------------------------------------------------------- dataflow
+def _acquire_release_transfer(state, item, block):
+    """Toy R008: gen 'held' on .acq(), kill on .rel()."""
+    if not isinstance(item, ast.AST):
+        return state
+    for n in ast.walk(item):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr == "acq":
+                state = state | {"held"}
+            elif n.func.attr == "rel":
+                state = state - {"held"}
+    return state
+
+
+def _exit_state(text):
+    cfg = cfg_of(text)
+    states = dataflow.run_forward(cfg, _acquire_release_transfer)
+    return states.get(cfg.exit, frozenset())
+
+
+def test_dataflow_finally_release_clears_exit_state():
+    assert _exit_state("""
+        def f(self):
+            self.acq()
+            try:
+                return self.work()
+            finally:
+                self.rel()
+        """) == frozenset()
+
+
+def test_dataflow_early_return_unions_paths_at_exit():
+    """May-analysis: one escaping path is enough for the fact to stand at
+    exit, even when another path releases."""
+    assert "held" in _exit_state("""
+        def f(self):
+            self.acq()
+            if self.fast:
+                return 1
+            self.rel()
+            return 2
+        """)
+
+
+def test_dataflow_loop_reaches_fixpoint():
+    """A gen inside a loop converges (facts are a finite set); the held
+    fact survives the back edge and escapes at fall-off."""
+    assert "held" in _exit_state("""
+        def f(self, xs):
+            for x in xs:
+                self.acq(x)
+            return 1
+        """)
+
+
+def test_dataflow_branch_balanced_release_is_clean():
+    assert _exit_state("""
+        def f(self):
+            self.acq()
+            if self.a:
+                self.rel()
+            else:
+                self.rel()
+            return 1
+        """) == frozenset()
+
+
+# ------------------------------------------------------------- callgraph
+def graph(*files) -> CallGraph:
+    return CallGraph([parse(t, p) for (t, p) in files])
+
+
+def test_module_name_normalization():
+    assert module_name("spark_rapids_tpu/memory/store.py") == \
+        "spark_rapids_tpu.memory.store"
+    assert module_name("spark_rapids_tpu/analysis/__init__.py") == \
+        "spark_rapids_tpu.analysis"
+
+
+def test_self_method_resolution():
+    g = graph(("""
+        class C:
+            def a(self):
+                self.b()
+            def b(self):
+                pass
+        """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::C.a") == {"pkg/m.py::C.b"}
+
+
+def test_self_method_resolves_through_base_class():
+    g = graph(("""
+        class Base:
+            def helper(self):
+                pass
+        class Child(Base):
+            def run(self):
+                self.helper()
+        """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::Child.run") == {"pkg/m.py::Base.helper"}
+
+
+def test_module_function_and_nested_sibling_resolution():
+    g = graph(("""
+        def util():
+            pass
+        def top():
+            util()
+            def inner():
+                pass
+            inner()
+        """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::top") == {"pkg/m.py::util",
+                                          "pkg/m.py::top.inner"}
+
+
+def test_from_import_resolution_across_modules():
+    g = graph(
+        ("""
+            def shared():
+                pass
+         """, "pkg/util.py"),
+        ("""
+            from pkg.util import shared
+            def caller():
+                shared()
+         """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::caller") == {"pkg/util.py::shared"}
+
+
+def test_module_alias_resolution():
+    g = graph(
+        ("""
+            def helper():
+                pass
+         """, "pkg/util.py"),
+        ("""
+            import pkg.util as u
+            def caller():
+                u.helper()
+         """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::caller") == {"pkg/util.py::helper"}
+
+
+def test_attr_name_typing_resolution():
+    """self.catalog = BufferCatalog() teaches the graph that any
+    ``*.catalog.remove()`` goes to BufferCatalog.remove."""
+    g = graph(
+        ("""
+            class BufferCatalog:
+                def remove(self, bid):
+                    pass
+         """, "pkg/catalog.py"),
+        ("""
+            from pkg.catalog import BufferCatalog
+            class DeviceManager:
+                def __init__(self):
+                    self.catalog = BufferCatalog()
+                def drop(self, bid):
+                    self.catalog.remove(bid)
+         """, "pkg/dm.py"))
+    assert "pkg/catalog.py::BufferCatalog.remove" in \
+        g.callees("pkg/dm.py::DeviceManager.drop")
+
+
+def test_unique_method_fallback_and_common_name_refusal():
+    g = graph(("""
+        class Only:
+            def frobnicate(self):
+                pass
+            def get(self):
+                pass
+        def caller(x):
+            x.frobnicate()
+            x.get()
+        """, "pkg/m.py"))
+    # unique uncommon method name resolves; 'get' is builtin-collection
+    # vocabulary and must NOT resolve through the fallback
+    assert g.callees("pkg/m.py::caller") == {"pkg/m.py::Only.frobnicate"}
+
+
+def test_instantiation_edges_to_init():
+    g = graph(("""
+        class Widget:
+            def __init__(self):
+                pass
+        def make():
+            return Widget()
+        """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::make") == {"pkg/m.py::Widget.__init__"}
+
+
+def test_reachable_is_depth_bounded():
+    chain = "\n".join(
+        f"def f{i}():\n    f{i + 1}()" for i in range(10)
+    ) + "\ndef f10():\n    pass\n"
+    g = graph((chain, "pkg/chain.py"))
+    root = "pkg/chain.py::f0"
+    shallow = g.reachable([root], max_depth=3)
+    assert f"pkg/chain.py::f3" in shallow
+    assert f"pkg/chain.py::f4" not in shallow
+    deep = g.reachable([root], max_depth=64)
+    assert f"pkg/chain.py::f10" in deep
+
+
+def test_reachable_terminates_on_mutual_recursion():
+    g = graph(("""
+        def ping():
+            pong()
+        def pong():
+            ping()
+        """, "pkg/m.py"))
+    got = g.reachable(["pkg/m.py::ping"], max_depth=1000)
+    assert got == {"pkg/m.py::ping", "pkg/m.py::pong"}
+
+
+def test_calls_inside_nested_defs_belong_to_the_nested_function():
+    g = graph(("""
+        def helper():
+            pass
+        def outer():
+            def inner():
+                helper()
+            return inner
+        """, "pkg/m.py"))
+    assert "pkg/m.py::helper" not in g.callees("pkg/m.py::outer")
+    assert g.callees("pkg/m.py::outer.inner") == {"pkg/m.py::helper"}
+
+
+def test_nested_finally_abrupt_exit_routes_through_outer_finally():
+    """Review regression: a return escaping two try/finally levels passes
+    through BOTH finally bodies before reaching exit."""
+    cfg = cfg_of("""
+        def f(self):
+            try:
+                try:
+                    return self.work()
+                finally:
+                    self.inner_cleanup()
+            finally:
+                self.outer_cleanup()
+        """)
+    (ret_block,) = [b for b in cfg.blocks.values()
+                    if any(isinstance(i, ast.Return) for i in b.items)]
+    (inner,) = blocks_calling(cfg, "inner_cleanup")
+    (outer,) = blocks_calling(cfg, "outer_cleanup")
+    assert (cfg.exit, None) not in ret_block.succs
+    assert (cfg.exit, None) not in inner.succs
+    assert reaches(cfg, ret_block.id, inner.id)
+    assert reaches(cfg, inner.id, outer.id)
+    assert reaches(cfg, outer.id, cfg.exit)
+
+
+def test_break_does_not_execute_loop_else():
+    """Review regression: ``break`` jumps past the for/while ``else``
+    clause — routing it INTO the else body made R008 miss leaks released
+    only on normal exhaustion."""
+    cfg = cfg_of("""
+        def f(self, items):
+            for x in items:
+                if x:
+                    break
+            else:
+                self.on_exhausted()
+            return 1
+        """)
+    (orelse_blk,) = blocks_calling(cfg, "on_exhausted")
+    (head,) = [b for b in cfg.blocks.values()
+               if b.items and isinstance(b.items[-1], LoopIter)]
+    (cond,) = [b for b in cfg.blocks.values()
+               if b.items and isinstance(b.items[-1], Cond)]
+    (then_id,) = [t for (t, lbl) in cond.succs if lbl == "true"]
+    # normal exhaustion (head FALSE) runs the else clause…
+    (false_id,) = [t for (t, lbl) in head.succs if lbl == "false"]
+    assert false_id == orelse_blk.id or reaches(cfg, false_id, orelse_blk.id)
+    # …but the break path must NOT pass through it
+    assert not reaches(cfg, then_id, orelse_blk.id)
+    # both paths still reach the statement after the loop
+    (ret_block,) = [b for b in cfg.blocks.values()
+                    if any(isinstance(i, ast.Return) for i in b.items)]
+    assert reaches(cfg, then_id, ret_block.id)
+    assert reaches(cfg, orelse_blk.id, ret_block.id)
+
+
+def test_nested_try_raise_reaches_outer_except():
+    """Review regression: a raise inside a finally-only try must land in
+    the ENCLOSING except — replacing the handler set per try level severed
+    the outer release path and falsely flagged R008."""
+    cfg = cfg_of("""
+        def f(self):
+            self.acq()
+            try:
+                try:
+                    raise ValueError("x")
+                finally:
+                    self.log()
+            except ValueError:
+                self.rel()
+        """)
+    (raise_blk,) = [b for b in cfg.blocks.values()
+                    if any(isinstance(i, ast.Raise) for i in b.items)]
+    (handler_blk,) = blocks_calling(cfg, "rel")
+    assert reaches(cfg, raise_blk.id, handler_blk.id)
+    # no escape to exit that bypasses the handler: every raise successor
+    # chain hits the handler before exit
+    def reaches_avoiding(src_id, dst_id, avoid_id):
+        seen, stack = set(), [src_id]
+        while stack:
+            bid = stack.pop()
+            if bid == avoid_id:
+                continue
+            if bid == dst_id:
+                return True
+            if bid in seen:
+                continue
+            seen.add(bid)
+            stack.extend(t for (t, _l) in cfg.blocks[bid].succs)
+        return False
+    assert not reaches_avoiding(raise_blk.id, cfg.exit, handler_blk.id)
+
+
+def test_bare_call_does_not_capture_method_leaf_name():
+    """Review regression: a bare call to a parameter/local named like some
+    class's method must not resolve to that method through the module
+    bare-name table."""
+    g = graph(("""
+        class Worker:
+            def drain(self):
+                pass
+        def run_cb(drain):
+            return drain()
+        """, "pkg/m.py"))
+    assert g.callees("pkg/m.py::run_cb") == set()

@@ -227,6 +227,32 @@ def test_shuffle_serves_spilled_buffers(tmp_path):
     assert sorted(got["f"].to_pylist()) == sorted(t["f"].to_pylist())
 
 
+def test_reader_early_close_releases_unyielded_buffers(tmp_path):
+    """Regression: read() retains EVERY buffer of a local block upfront
+    (acquire_buffers); closing the generator mid-block — a LIMIT consumer
+    stopping after the first batch — must release the not-yet-yielded
+    tail's refcounts too, not just the buffer in hand."""
+    mgr, e0, _e1 = two_env_cluster(tmp_path)
+    sid, _ = mgr.register_shuffle(1)
+    t = sample_table(30, seed=5)
+    # one map task emitting THREE batches for the same (map, partition)
+    # block — the multi-row-group repartition shape
+    writer = mgr.get_writer(e0, sid, 0, 1)
+    writer.write([(0, DeviceBatch.from_arrow(t.slice(i * 10, 10)))
+                  for i in range(3)])
+    block = mgr.tracker.blocks_by_executor(sid, 0)[e0.executor_id][0]
+    probe = e0.shuffle_catalog.acquire_buffers(block)
+    assert len(probe) == 3
+    bufs = [b for b, _m in probe]
+    for b in bufs:
+        b.close()
+    base = [b.refcount for b in bufs]            # owner-store refs only
+    it = mgr.get_reader(e0, sid, 0).read()
+    next(it)
+    it.close()
+    assert [b.refcount for b in bufs] == base
+
+
 def test_empty_partitions_are_skipped(tmp_path):
     mgr, e0, e1 = two_env_cluster(tmp_path)
     sid, _ = mgr.register_shuffle(4)
