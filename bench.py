@@ -149,6 +149,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
     # ---- compressed columnar path: encoded vs decoded link bytes ------------
     compression = _bench_compression(table, conf)
 
+    # ---- whole-stage fusion: fused vs unfused + 129-query coverage ----------
+    fusion = _bench_fusion(table, conf, iters)
+
     # ---- concurrent query serving (scheduler + cross-query program cache) ---
     concurrent = _bench_concurrent(table, conf, scale)
 
@@ -194,6 +197,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
                     round(cold_single_s, 4),
             },
             "compression": compression,
+            "fusion": fusion,
             "concurrent": concurrent,
             "mesh": mesh_section,
             "end_to_end_collect_s": round(e2e_s, 4),
@@ -276,6 +280,106 @@ def _bench_compression(table, conf: dict) -> dict:
         "encoded_domain_ops": int(d_enc["transfer.encoded_domain_ops"]),
         "cold_collect_encoded_s": round(wall_enc, 4),
         "cold_collect_decoded_s": round(wall_dec, 4),
+    }
+
+
+def _bench_fusion(table, conf: dict, iters: int) -> dict:
+    """Whole-stage fusion (ROADMAP item 5 acceptance): Q1 fused vs unfused
+    — bit-identical collect, >= 1 fused stage, warm device-compute delta,
+    batches-not-materialized from the executed plan's metrics, a repeat-
+    submission program-cache hit-rate — plus fusion COVERAGE measured by
+    planning the full TPC-DS (99) + TPCx-BB (30) query sets (plan-only:
+    coverage is a property of the plans, and 129 executions don't belong in
+    a bench smoke)."""
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import q1
+    from spark_rapids_tpu.plan.fusion import (fused_batches_not_materialized,
+                                              fusion_stats)
+    from spark_rapids_tpu.serving.program_cache import global_program_cache
+
+    fused_sess = TpuSession(conf)
+    unfused_sess = TpuSession({**conf,
+                               "spark.rapids.tpu.sql.fusion.enabled":
+                                   "false"})
+    fdf = q1(fused_sess.create_dataframe(table))
+    udf = q1(unfused_sess.create_dataframe(table))
+    fused_out = fdf.collect()            # warm: compiles fused programs
+    unfused_out = udf.collect()
+    assert fused_out.equals(unfused_out), (
+        "fusion changed Q1 results\n"
+        f"fused: {fused_out.to_pydict()}\nunfused: {unfused_out.to_pydict()}")
+    q1_stats = fusion_stats(fused_sess.last_plan)
+    assert q1_stats["fused_stages"] >= 1, fused_sess.last_plan.tree_string()
+    saved = fused_batches_not_materialized(fused_sess.last_plan)
+
+    def best_of(df):
+        best = None
+        for _ in range(max(2, iters)):
+            t0 = time.perf_counter()
+            df.collect()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    fused_s = best_of(fdf)
+    unfused_s = best_of(udf)
+
+    # repeat submission through the scheduler: the fused plan's programs
+    # must come out of the cross-query ProgramCache, not recompile
+    cache = global_program_cache()
+    fused_sess.submit(fdf).result(timeout=600)
+    before = cache.snapshot_counters()
+    h = fused_sess.submit(fdf)
+    assert h.result(timeout=600).equals(fused_out)
+    after = cache.snapshot_counters()
+    hits = after["hits"] - before["hits"]
+    misses = after["misses"] - before["misses"]
+    repeat_hit_rate = hits / (hits + misses) if (hits + misses) else 1.0
+
+    # coverage sweep: plan every TPC-DS + TPCx-BB query fused
+    from spark_rapids_tpu.benchmarks.tpcds_data import gen_all as gen_tpcds
+    from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES as TPCDS
+    from spark_rapids_tpu.benchmarks.tpcxbb_data import gen_all as gen_tpcxbb
+    from spark_rapids_tpu.benchmarks.tpcxbb_queries import QUERIES as TPCXBB
+    sweep_sess = TpuSession({**conf,
+                             "spark.rapids.tpu.sql.hasNans": "false",
+                             "spark.rapids.tpu.sql.exec.NestedLoopJoin":
+                                 "true",
+                             "spark.rapids.tpu.sql.exec.CartesianProduct":
+                                 "true"})
+    sweep_scale = 0.002                  # plan shapes, not data volume
+    ds = {k: sweep_sess.create_dataframe(v)
+          for k, v in gen_tpcds(sweep_scale, seed=0).items()}
+    bb = {k: sweep_sess.create_dataframe(v)
+          for k, v in gen_tpcxbb(scale=sweep_scale, seed=0).items()}
+    queries = fused_queries = total_stages = total_ops = 0
+    for registry, dfs in ((TPCDS, ds), (TPCXBB, bb)):
+        for fn in registry.values():
+            queries += 1
+            st = fusion_stats(fn(dfs)._executed_plan())
+            total_stages += st["fused_stages"]
+            total_ops += st["fused_ops"]
+            if st["fused_stages"] >= 1:
+                fused_queries += 1
+
+    return {
+        "q1_fused_stage_count": q1_stats["fused_stages"],
+        "q1_ops_per_fused_stage": q1_stats["ops_per_fused_stage"],
+        "batches_not_materialized": int(saved),
+        "q1_warm_collect_fused_s": round(fused_s, 4),
+        "q1_warm_collect_unfused_s": round(unfused_s, 4),
+        # the fused-vs-unfused device-compute delta (>1 = fusion faster)
+        "q1_fused_vs_unfused_x": round(unfused_s / fused_s, 3),
+        "bit_identical": True,
+        "repeat_hit_rate": round(repeat_hit_rate, 4),
+        "coverage": {
+            "queries": queries,
+            "fused_queries": fused_queries,
+            "fraction": round(fused_queries / queries, 4),
+            "fused_stages": total_stages,
+            "ops_per_fused_stage": (round(total_ops / total_stages, 3)
+                                    if total_stages else 0.0),
+        },
     }
 
 

@@ -40,6 +40,22 @@ for key in ("link_bytes_encoded", "link_bytes_decoded", "link_bytes_ratio",
     assert key in comp, f"missing compression breakdown key {key}: {comp}"
 assert comp["link_bytes_ratio"] < 1.0, comp
 assert comp["encoded_domain_ops"] >= 1, comp
+fusion = out["breakdown"]["fusion"]
+for key in ("q1_fused_stage_count", "q1_ops_per_fused_stage",
+            "batches_not_materialized", "q1_fused_vs_unfused_x",
+            "bit_identical", "repeat_hit_rate", "coverage"):
+    assert key in fusion, f"missing fusion breakdown key {key}: {fusion}"
+# whole-stage fusion acceptance: Q1 gets >= 1 fused stage whose interior
+# batches never materialized, fused collect is bit-identical, repeat
+# submission serves fused programs from the cross-query cache, and the
+# 129-query plan sweep keeps coverage a number (93/129 at introduction)
+assert fusion["q1_fused_stage_count"] >= 1, fusion
+assert fusion["batches_not_materialized"] > 0, fusion
+assert fusion["bit_identical"] is True, fusion
+assert fusion["repeat_hit_rate"] >= 0.99, fusion
+cov = fusion["coverage"]
+assert cov["queries"] >= 129, cov
+assert cov["fused_queries"] >= 60 and cov["fraction"] >= 0.5, cov
 conc = out["breakdown"]["concurrent"]
 for key in ("queries", "sequential_rows_per_sec", "aggregate_rows_per_sec",
             "aggregate_vs_sequential_x", "p50_latency_s", "p99_latency_s",
@@ -73,6 +89,10 @@ print("bench smoke OK:", {k: pipe[k] for k in
                           ("upload_chunked_s", "upload_overlap_efficiency",
                            "inflight_high_water")},
       {k: comp[k] for k in ("link_bytes_ratio", "encoded_domain_ops")},
+      {k: fusion[k] for k in ("q1_fused_stage_count",
+                              "batches_not_materialized",
+                              "q1_fused_vs_unfused_x", "repeat_hit_rate")},
+      {"fusion_coverage": fusion["coverage"]["fraction"]},
       {k: conc[k] for k in ("aggregate_vs_sequential_x",
                             "program_cache_hit_rate", "p50_latency_s",
                             "p99_latency_s")},

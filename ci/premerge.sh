@@ -55,6 +55,43 @@ assert stats["program_cache"]["hits"] > 0, stats
 print("serving smoke ok:", stats["program_cache"])
 PY
 
+echo "== fusion smoke (4 queries fused vs unfused, bit-identical) =="
+python - << 'PY'
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import gen_lineitem, q1, q6
+from spark_rapids_tpu.benchmarks.tpcds_data import gen_all
+from spark_rapids_tpu.benchmarks.tpcds_queries import QUERIES
+from spark_rapids_tpu.plan.fusion import fusion_stats
+from spark_rapids_tpu.testing import assert_tables_equal
+
+conf = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+        "spark.rapids.tpu.sql.hasNans": "false"}
+fused = TpuSession(conf)
+unfused = TpuSession({**conf,
+                      "spark.rapids.tpu.sql.fusion.enabled": "false"})
+lineitem = gen_lineitem(scale=0.01, seed=42)
+ds = gen_all(0.01, seed=0)
+f_ds = {k: fused.create_dataframe(v) for k, v in ds.items()}
+u_ds = {k: unfused.create_dataframe(v) for k, v in ds.items()}
+runs = [("tpch-q1", q1(fused.create_dataframe(lineitem)),
+         q1(unfused.create_dataframe(lineitem))),
+        ("tpch-q6", q6(fused.create_dataframe(lineitem)),
+         q6(unfused.create_dataframe(lineitem))),
+        ("tpcds-q9", QUERIES["q9"](f_ds), QUERIES["q9"](u_ds)),
+        ("tpcds-q28", QUERIES["q28"](f_ds), QUERIES["q28"](u_ds))]
+stages = 0
+for name, fdf, udf in runs:
+    got, ref = fdf.collect(), udf.collect()
+    assert_tables_equal(ref, got, approx_float=1e-9)
+    st = fusion_stats(fused.last_plan)
+    print(f"fusion smoke {name}: fused_stages={st['fused_stages']} "
+          f"ops={st['fused_ops']}")
+    stages += st["fused_stages"]
+assert stages >= 4, "fusion smoke saw fewer than 4 fused stages"
+assert fusion_stats(unfused.last_plan)["fused_stages"] == 0
+print("fusion smoke ok")
+PY
+
 echo "== multichip dry-run (8 virtual devices) =="
 python - << 'PY'
 import importlib.util

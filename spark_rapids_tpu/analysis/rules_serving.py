@@ -53,6 +53,69 @@ def _routed_through_cache(src: SourceFile, node: ast.Call) -> bool:
     return False
 
 
+def _builder_names(src: SourceFile, execute_def) -> set:
+    """Names of local builder functions handed to a sanctioned cache route
+    inside ``execute`` — the FusedStageExec.cached_program idiom:
+
+        def make(variants, used, cap):
+            ...
+            return jax.jit(fn)
+        fn = self.cached_program(key, lambda: make(variants, used, cap))
+
+    The jit lives in ``make``, lexically on the execute path but invoked
+    only through the cache's builder latch — one compile per fused
+    plan-signature key. Collected names: bare-name builder arguments
+    (``cached_program(key, build)``) and functions called UNDER A LAMBDA
+    in a builder-argument expression (the wrapper form above). Only the
+    BUILDER argument positions count (everything past the key, i.e.
+    args[1:] plus non-``key`` keywords), and two shapes stay flagged:
+    a name that execute ALSO calls directly outside a deferred builder,
+    and a call evaluated eagerly in the argument expression itself
+    (``cached_program(key, make(cap))`` runs ``make`` every batch before
+    the cache is even consulted) — both are exactly the per-call compile
+    the rule exists to catch."""
+    routed, direct, deferred = set(), set(), set()
+    for node in ast.walk(execute_def):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node) or ""
+        if name.rsplit(".", 1)[-1] not in _CACHE_ROUTES:
+            continue
+        for arg in (list(node.args)[1:]
+                    + [kw.value for kw in node.keywords if kw.arg != "key"]):
+            if isinstance(arg, ast.Name):
+                routed.add(arg.id)
+                continue
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Lambda):
+                    continue
+                for s2 in ast.walk(sub.body):
+                    deferred.add(id(s2))
+                    if isinstance(s2, ast.Call) and \
+                            isinstance(s2.func, ast.Name):
+                        routed.add(s2.func.id)
+    for node in ast.walk(execute_def):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and id(node) not in deferred:
+            direct.add(node.func.id)
+    return routed - direct
+
+
+def _in_routed_builder(src: SourceFile, node: ast.Call, execute_def) -> bool:
+    """True when the jit construction sits inside a function that execute
+    passes to a sanctioned cache route (see ``_builder_names``)."""
+    builders = _builder_names(src, execute_def)
+    if not builders:
+        return False
+    for anc in src.ancestors(node):
+        if anc is execute_def:
+            break
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                anc.name in builders:
+            return True
+    return False
+
+
 def _enclosing_execute(src: SourceFile, node: ast.AST):
     """The nearest enclosing ``execute`` FunctionDef (directly or through
     nested defs/lambdas), or None when the node is not on an execute
@@ -76,12 +139,15 @@ class ProgramCacheBypass(Rule):
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call) or not is_jit_call(node):
                 continue
-            if _enclosing_execute(src, node) is None:
+            exec_def = _enclosing_execute(src, node)
+            if exec_def is None:
                 continue
             if _routed_through_cache(src, node):
                 continue
             if _in_cache_guard(src, node):
                 continue    # the keyed-cache idiom compiles once per key
+            if _in_routed_builder(src, node, exec_def):
+                continue    # named builder handed to a cache route
             name = call_name(node) or "jit"
             findings.append(src.finding(
                 self.rule_id, node,

@@ -262,6 +262,26 @@ ENCODED_DOMAIN = _conf(
     "key values materialize only for the surviving groups (late "
     "materialization).")
 
+FUSION_ENABLED = _conf(
+    "sql.fusion.enabled", bool, True,
+    "Whole-stage fusion: collapse maximal chains of fusable device execs "
+    "(Project / Filter / Expand / CoalesceBatches, plus the partial-"
+    "aggregate fold) between pipeline breakers into one FusedStageExec "
+    "whose whole chain traces into a SINGLE jitted XLA program — a filter "
+    "becomes a mask threaded through the downstream expressions and no "
+    "intermediate batch materializes in HBM between the fused operators "
+    "(the WholeStageCodegenExec role; Flare's whole-pipeline compilation "
+    "argument). Breakers (exchange, sort, join, limit, union, cache and "
+    "mesh boundaries) end a stage; fused stages render with a '*(id)' "
+    "prefix in the plan tree.")
+
+FUSION_MAX_OPS = _conf(
+    "sql.fusion.maxOps", int, 16,
+    "Upper bound on operators collapsed into one fused stage; chains "
+    "longer than this split so a pathological plan cannot trace one "
+    "enormous XLA program (the spark.sql.codegen.maxFields spirit).",
+    checker=_positive("fusion.maxOps"))
+
 SCAN_PREFETCH_BATCHES = _conf(
     "io.scan.prefetchBatches", int, 2,
     "Device parquet scans decode and upload this many chunks ahead of the "

@@ -537,19 +537,28 @@ class TpuCoalesceBatchesExec(PhysicalExec):
         self.require_single = require_single
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        pending: List[DeviceBatch] = []
-        pending_bytes = 0
-        for batch in self.children[0].execute(ctx):
-            pending.append(batch)
-            pending_bytes += batch.device_size_bytes
-            if not self.require_single and pending_bytes >= self.target_bytes:
-                out = concat_device_batches(pending, self.output,
-                                            ctx.string_max_bytes)
-                self.count_output(out.num_rows)
-                yield out
-                pending, pending_bytes = [], 0
-        if pending or self.require_single:
-            out = concat_device_batches(pending, self.output,
-                                        ctx.string_max_bytes)
+        for out in coalesce_batches(self.children[0].execute(ctx),
+                                    self.output, self.target_bytes,
+                                    self.require_single,
+                                    ctx.string_max_bytes):
             self.count_output(out.num_rows)
             yield out
+
+
+def coalesce_batches(source: Iterator[DeviceBatch], schema: Schema,
+                     target_bytes: int, require_single: bool,
+                     string_max_bytes: int) -> Iterator[DeviceBatch]:
+    """The accumulate-until-target concat loop, shared by
+    TpuCoalesceBatchesExec and the fused-stage coalesce absorption
+    (execs/fused_execs.py) so the flush/require_single semantics cannot
+    drift between the two."""
+    pending: List[DeviceBatch] = []
+    pending_bytes = 0
+    for batch in source:
+        pending.append(batch)
+        pending_bytes += batch.device_size_bytes
+        if not require_single and pending_bytes >= target_bytes:
+            yield concat_device_batches(pending, schema, string_max_bytes)
+            pending, pending_bytes = [], 0
+    if pending or require_single:
+        yield concat_device_batches(pending, schema, string_max_bytes)

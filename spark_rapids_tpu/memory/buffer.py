@@ -28,6 +28,34 @@ class StorageTier(enum.IntEnum):
     DISK = 2
 
 
+@dataclass
+class HostDictEncoding:
+    """A column's DictEncoding in host (numpy) form: what a spilled batch
+    carries so an unspilled batch re-enters the encoded domain instead of
+    decoding (the PR 4 late-materialization contract surviving the PR 5
+    spill tiers)."""
+    indices: np.ndarray
+    values: np.ndarray
+    lengths: Optional[np.ndarray]
+    k_real: int
+    token: Optional[str]
+
+    @property
+    def nbytes(self) -> int:
+        return (self.indices.nbytes + self.values.nbytes
+                + (self.lengths.nbytes if self.lengths is not None else 0))
+
+
+@dataclass(frozen=True)
+class DiskDictEncoding:
+    """Disk-tier encoding descriptor: the arrays live in the buffer's npz
+    (keys ``e{col}i`` / ``e{col}v`` / ``e{col}l``), only the static shape
+    metadata stays in memory."""
+    has_lengths: bool
+    k_real: int
+    token: Optional[str]
+
+
 @dataclass(frozen=True, order=True)
 class BufferId:
     """Unique buffer identity; table_id groups shuffle partitions."""
@@ -96,10 +124,11 @@ class SpillableBuffer(Retainable):
         self.num_rows = num_rows
         self.tier = tier
         self.payload = payload          # device arrays | numpy arrays | file path
-        #: per-column DictEncoding (or None) carried ONLY on the device
-        #: tier: a resident shuffle-cached batch keeps its encoded form so
-        #: encoded-domain operators survive the exchange; spilling drops it
-        #: (the decoded arrays are the lossless representation)
+        #: per-column encoding (or None), carried through EVERY tier: device
+        #: DictEncoding on the device tier, HostDictEncoding numpy mirrors on
+        #: the host tier, DiskDictEncoding descriptors (arrays inside the
+        #: npz) on disk — an unspilled batch re-enters the encoded domain
+        #: instead of decoding
         self.encodings = encodings
         self.size_bytes = size_bytes
         self.spill_priority = spill_priority
@@ -117,24 +146,34 @@ class SpillableBuffer(Retainable):
         if self.tier == StorageTier.DEVICE:
             return _rebuild(self.schema, self.payload, self.num_rows,
                             self.bits_mask, self.encodings)
-        arrays = self._host_arrays()
+        if self.tier == StorageTier.DISK:
+            # one npz read serves both the column arrays and the encodings
+            with np.load(self.payload) as z:
+                arrays = self._disk_arrays(z)
+                host_encs = self._disk_encodings(z)
+            encs = self._device_put_encodings(host_encs)
+        else:
+            arrays = self._host_arrays()
+            encs = self._device_encodings()
         cols, i = [], 0
         for j, f in enumerate(self.schema):
             has_bits = bool(self.bits_mask) and self.bits_mask[j]
+            enc = encs[j] if encs else None
             if f.dtype is DType.STRING:
                 cols.append(DeviceColumn(
                     f.dtype, jax.device_put(arrays[i]),
                     jax.device_put(arrays[i + 1]),
-                    jax.device_put(arrays[i + 2])))
+                    jax.device_put(arrays[i + 2]), encoding=enc))
             elif has_bits:
                 bits = jax.device_put(arrays[i])
                 data = jax.lax.bitcast_convert_type(bits, jnp.float64)
                 cols.append(DeviceColumn(f.dtype, data,
                                          jax.device_put(arrays[i + 1]),
-                                         bits=bits))
+                                         bits=bits, encoding=enc))
             else:
                 cols.append(DeviceColumn(f.dtype, jax.device_put(arrays[i]),
-                                         jax.device_put(arrays[i + 1])))
+                                         jax.device_put(arrays[i + 1]),
+                                         encoding=enc))
             i += 3 if f.dtype is DType.STRING else 2
         return DeviceBatch(self.schema, tuple(cols), self.num_rows)
 
@@ -178,8 +217,70 @@ class SpillableBuffer(Retainable):
             return self.payload
         if self.tier == StorageTier.DISK:
             with np.load(self.payload) as z:
-                return [z[f"a{i}"] for i in range(len(z.files))]
+                return self._disk_arrays(z)
         return [np.asarray(a) for a in self.payload]
+
+    @staticmethod
+    def _disk_arrays(z) -> List[np.ndarray]:
+        # the npz also holds e{j}* encoding arrays — count a-keys
+        n = sum(1 for name in z.files if name.startswith("a"))
+        return [z[f"a{i}"] for i in range(n)]
+
+    # ---- encoding carry --------------------------------------------------------
+    def _disk_encodings(self, z) -> Tuple[Optional[HostDictEncoding], ...]:
+        """Host-form encodings read from an already-open npz archive."""
+        if not self.encodings or not any(e is not None
+                                         for e in self.encodings):
+            return ()
+        out: List[Optional[HostDictEncoding]] = []
+        for j, e in enumerate(self.encodings):
+            if e is None:
+                out.append(None)
+                continue
+            out.append(HostDictEncoding(
+                z[f"e{j}i"], z[f"e{j}v"],
+                z[f"e{j}l"] if e.has_lengths else None,
+                e.k_real, e.token))
+        return tuple(out)
+
+    def _host_encodings(self) -> Tuple[Optional[HostDictEncoding], ...]:
+        """Per-column encodings in host (numpy) form, whatever this tier."""
+        if not self.encodings or not any(e is not None
+                                         for e in self.encodings):
+            return ()
+        if self.tier == StorageTier.DISK:
+            with np.load(self.payload) as z:
+                return self._disk_encodings(z)
+        out: List[Optional[HostDictEncoding]] = []
+        for e in self.encodings:
+            if e is None:
+                out.append(None)
+            elif isinstance(e, HostDictEncoding):
+                out.append(e)
+            else:                       # device DictEncoding
+                out.append(HostDictEncoding(
+                    np.asarray(e.indices), np.asarray(e.values),
+                    None if e.lengths is None else np.asarray(e.lengths),
+                    e.k_real, e.token))
+        return tuple(out)
+
+    def _device_encodings(self) -> Tuple:
+        """Per-column DictEncoding rebuilt on device from a host/disk tier."""
+        return self._device_put_encodings(self._host_encodings())
+
+    @staticmethod
+    def _device_put_encodings(host: Tuple) -> Tuple:
+        import jax
+        from spark_rapids_tpu.columnar.encoding import DictEncoding
+        if not host:
+            return ()
+        return tuple(
+            None if e is None else DictEncoding(
+                jax.device_put(e.indices), jax.device_put(e.values),
+                e.k_real,
+                None if e.lengths is None else jax.device_put(e.lengths),
+                e.token)
+            for e in host)
 
     def _compact_host_arrays(self) -> List[np.ndarray]:
         """Host-layout arrays for spilling. DOUBLE columns with a u64 bits
@@ -203,22 +304,45 @@ class SpillableBuffer(Retainable):
         return out
 
     # ---- tier movement ---------------------------------------------------------
+    def _spill_form(self):
+        """(compact arrays, host encodings) — one npz read on the DISK tier
+        (disk layouts are already compact; see _compact_host_arrays)."""
+        if self.tier == StorageTier.DISK:
+            with np.load(self.payload) as z:
+                return self._disk_arrays(z), self._disk_encodings(z)
+        return self._compact_host_arrays(), self._host_encodings()
+
     def to_host(self) -> "SpillableBuffer":
-        arrays = self._compact_host_arrays()
-        size = sum(a.nbytes for a in arrays)
+        arrays, encs = self._spill_form()
+        size = (sum(a.nbytes for a in arrays)
+                + sum(e.nbytes for e in encs if e is not None))
         return SpillableBuffer(self.id, self.schema, self.num_rows,
                                StorageTier.HOST, arrays, size,
-                               self.spill_priority, self.bits_mask)
+                               self.spill_priority, self.bits_mask,
+                               encodings=encs)
 
     def to_disk(self, directory: str) -> "SpillableBuffer":
-        arrays = self._compact_host_arrays()
+        arrays, encs = self._spill_form()
         path = os.path.join(directory,
                             f"buf_{self.id.table_id}_{self.id.part_id}.npz")
-        np.savez(path, **{f"a{i}": a for i, a in enumerate(arrays)})
+        payload = {f"a{i}": a for i, a in enumerate(arrays)}
+        markers: List[Optional[DiskDictEncoding]] = []
+        for j, e in enumerate(encs):
+            if e is None:
+                markers.append(None)
+                continue
+            payload[f"e{j}i"] = e.indices
+            payload[f"e{j}v"] = e.values
+            if e.lengths is not None:
+                payload[f"e{j}l"] = e.lengths
+            markers.append(DiskDictEncoding(e.lengths is not None,
+                                            e.k_real, e.token))
+        np.savez(path, **payload)
         size = os.path.getsize(path)
         return SpillableBuffer(self.id, self.schema, self.num_rows,
                                StorageTier.DISK, path, size,
-                               self.spill_priority, self.bits_mask)
+                               self.spill_priority, self.bits_mask,
+                               encodings=(tuple(markers) if encs else ()))
 
     def _on_release(self) -> None:
         if self.tier == StorageTier.DISK and isinstance(self.payload, str):

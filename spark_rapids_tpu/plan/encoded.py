@@ -51,12 +51,21 @@ def mark_encoded_domain(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
     runs under a mesh (mesh execs have their own sharded programs)."""
     if not conf.get(cfg.ENCODED_DOMAIN) or conf.get(cfg.MESH_ENABLED):
         return plan
+    from spark_rapids_tpu.execs.fused_execs import FusedStageExec
     from spark_rapids_tpu.execs.join_execs import TpuShuffledHashJoinExec
 
     def walk(node: PhysicalExec) -> None:
         for c in node.children:
             walk(c)
         if isinstance(node, (te.TpuFilterExec, te.TpuHashAggregateExec)):
+            # incl. FusedAggregateStageExec: the fused partial aggregate
+            # keeps the inherited encoded-domain grouping/pre-filter rewrite
+            if _preserves_encoding(node.children[0]):
+                node.encoded_domain_ok = True
+        elif isinstance(node, FusedStageExec) and node.has_predicate:
+            # a fused chain's composed predicate is over the stage INPUT
+            # schema, so it rewrites onto dictionary indices exactly like a
+            # standalone filter's would
             if _preserves_encoding(node.children[0]):
                 node.encoded_domain_ok = True
         elif isinstance(node, TpuShuffledHashJoinExec):

@@ -771,10 +771,13 @@ class TpuOverrides:
                     print(line)
         converted = meta.convert_if_needed()
         from spark_rapids_tpu.plan.encoded import mark_encoded_domain
+        from spark_rapids_tpu.plan.fusion import fuse_stages
+        # whole-stage fusion first (it claims maximal device chains incl.
+        # the aggregate fold); fuse_device_ops then covers what remains —
+        # the CPU engine's fold, and device aggregates when fusion is off
+        plan = fuse_device_ops(fuse_stages(converted, self.conf))
         return mark_encoded_domain(
-            insert_pipeline(insert_transitions(fuse_device_ops(converted)),
-                            self.conf),
-            self.conf)
+            insert_pipeline(insert_transitions(plan), self.conf), self.conf)
 
 
 def _enforce_exchange_reuse(root: ExecMeta) -> None:
@@ -825,16 +828,54 @@ def _has_nondeterministic(e: Expression) -> bool:
     return any(_has_nondeterministic(c) for c in e.children)
 
 
+def fold_aggregate_chain(node, filter_cls, project_cls, coalesce_cls=None,
+                         max_ops=None):
+    """The partial-aggregate fold, shared by ``fuse_device_ops`` and the
+    whole-stage fusion pass (plan/fusion.py) so BOTH build identical
+    aggregate expression trees — and therefore identical program-cache
+    keys. Walks the chain below ``node``: filter conditions AND into the
+    pre-filter mask, projection expressions substitute into the grouping/
+    aggregate expressions, and (when ``coalesce_cls`` is given) coalesces
+    are absorbed — the aggregate concatenates its input anyway. Returns
+    (grouping, aggregates, pre_filter, chain child, folded nodes
+    top-down)."""
+    from spark_rapids_tpu.exprs.misc import Alias
+    from spark_rapids_tpu.exprs.predicates import And
+
+    grouping, aggs, pre = node.grouping, node.aggregates, node.pre_filter
+    child = node.children[0]
+    folded = []
+    while max_ops is None or len(folded) < max_ops:
+        if isinstance(child, filter_cls):
+            cond = child.condition
+            pre = cond if pre is None else And(cond, pre)
+        elif isinstance(child, project_cls):
+            repl = [a.c if isinstance(a, Alias) else a for a in child.exprs]
+            if any(_has_nondeterministic(r) for r in repl):
+                break
+            grouping = tuple(_substitute_refs(g, repl) for g in grouping)
+            aggs = tuple(_substitute_refs(a, repl) for a in aggs)
+            if pre is not None:
+                pre = _substitute_refs(pre, repl)
+        elif coalesce_cls is not None and isinstance(child, coalesce_cls):
+            pass
+        else:
+            break
+        folded.append(child)
+        child = child.children[0]
+    return grouping, aggs, pre, child, tuple(folded)
+
+
 def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
     """Collapse Filter/Project chains into the device aggregation above them
     (the whole-stage-fusion analog of Spark codegen collapsing these into one
     stage): the filter predicate folds into the aggregation's alive-mask and
     project expressions inline into the aggregate/grouping expressions, so
     the filtered/projected intermediate never materializes (on TPU that
-    removes a full compact — mask argsort + gathers of every column)."""
-    from spark_rapids_tpu.exprs.misc import Alias
-    from spark_rapids_tpu.exprs.predicates import And
-
+    removes a full compact — mask argsort + gathers of every column). The
+    full whole-stage pass (plan/fusion.py) runs first and claims device
+    chains when ``sql.fusion.enabled``; this pass covers the CPU engine and
+    device aggregates when fusion is off."""
     shapes = {
         te.TpuHashAggregateExec: (te.TpuFilterExec, te.TpuProjectExec),
         ce.CpuHashAggregateExec: (ce.CpuFilterExec, ce.CpuProjectExec),
@@ -845,30 +886,9 @@ def fuse_device_ops(plan: PhysicalExec) -> PhysicalExec:
         if pair is None:
             return node
         filter_cls, project_cls = pair
-        grouping, aggs, pre = node.grouping, node.aggregates, node.pre_filter
-        child = node.children[0]
-        changed = False
-        while True:
-            if isinstance(child, filter_cls):
-                cond = child.condition
-                pre = cond if pre is None else And(cond, pre)
-                child = child.children[0]
-                changed = True
-                continue
-            if isinstance(child, project_cls):
-                repl = [a.c if isinstance(a, Alias) else a
-                        for a in child.exprs]
-                if any(_has_nondeterministic(r) for r in repl):
-                    break
-                grouping = tuple(_substitute_refs(g, repl) for g in grouping)
-                aggs = tuple(_substitute_refs(a, repl) for a in aggs)
-                if pre is not None:
-                    pre = _substitute_refs(pre, repl)
-                child = child.children[0]
-                changed = True
-                continue
-            break
-        if changed:
+        grouping, aggs, pre, child, folded = fold_aggregate_chain(
+            node, filter_cls, project_cls)
+        if folded:
             return type(node)(grouping, aggs, child, node.output,
                               pre_filter=pre)
         return node

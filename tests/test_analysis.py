@@ -352,6 +352,108 @@ def test_r007_non_execute_function_clean():
     assert run(fs, {"R007"}) == []
 
 
+def test_r007_named_builder_routed_through_cached_program_clean():
+    """The FusedStageExec.cached_program idiom: the jit lives in a named
+    builder function that execute hands to a sanctioned cache route (via a
+    lambda wrapper binding the per-batch key values) — one compile per
+    fused plan-signature key, not a bypass."""
+    fs = src(GUARD + """
+        import jax
+        class FusedStageExec:
+            def execute(self, ctx):
+                def make(variants, cap):
+                    def fn(num_rows, *flat):
+                        return flat
+                    return jax.jit(fn)
+                for batch in ctx.batches:
+                    key = ("stage", batch.capacity)
+                    fn = self.cached_program(
+                        key, lambda: make(ctx.variants, batch.capacity))
+                    yield fn(batch)
+        """, path="execs/fused_execs.py")
+    assert run(fs, {"R007"}) == []
+
+
+def test_r007_named_builder_passed_by_bare_name_clean():
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def build():
+                    return jax.jit(lambda x: x + 1)
+                fn = self.cached_program(("k",), build)
+                yield fn(ctx)
+        """, path="execs/foo.py")
+    assert run(fs, {"R007"}) == []
+
+
+def test_r007_named_builder_also_called_directly_still_flagged():
+    """A builder that execute ALSO invokes directly per batch keeps its
+    finding — the direct call is a genuine per-call compile, and the one
+    routed use must not whitewash it."""
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def make(cap):
+                    return jax.jit(lambda x: x + 1)
+                fn = self.cached_program(("k",), lambda: make(8))
+                for batch in ctx.batches:
+                    yield make(batch.capacity)(batch)
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R007"})) == 1
+
+
+def test_r007_named_builder_called_eagerly_in_route_arg_flagged():
+    """``cached_program(key, make(cap))`` (no lambda) runs the builder —
+    and its jit — EVERY batch before the cache is even consulted: the
+    eager call in the argument expression is a direct call, not a routed
+    builder, and must keep its finding."""
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def make(cap):
+                    return jax.jit(lambda x: x + 1)
+                for batch in ctx.batches:
+                    fn = self.cached_program(("k",), make(batch.capacity))
+                    yield fn(batch)
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R007"})) == 1
+
+
+def test_r007_key_keyword_call_is_not_a_builder_position():
+    """A function called inside ``key=...`` computes the key, eagerly and
+    per batch — it is not a builder handed to the cache and must not
+    exempt a jit it contains."""
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def keyed(b):
+                    return ("k", jax.jit(lambda x: x)(b.capacity))
+                for batch in ctx.batches:
+                    fn = self.cached_program(key=keyed(batch),
+                                             builder=ctx.build)
+                    yield fn(batch)
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R007"})) == 1
+
+
+def test_r007_named_builder_not_routed_still_flagged():
+    """A builder with the same shape that is NEVER handed to a cache route
+    stays a finding — the recognition is route-scoped, not name-scoped."""
+    fs = src(GUARD + """
+        import jax
+        class FooExec:
+            def execute(self, ctx):
+                def make(cap):
+                    return jax.jit(lambda x: x + 1)
+                yield make(ctx.cap)(ctx)
+        """, path="execs/foo.py")
+    assert len(run(fs, {"R007"})) == 1
+
+
 # ---------------------------------------------------------- suppressions
 def test_suppression_same_line_and_line_above():
     fs = src(GUARD + """

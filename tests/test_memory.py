@@ -249,3 +249,79 @@ def test_double_spill_is_compact_and_bit_exact(tmp_path):
         got_host = bits_of(tier_buf.get_host_batch().to_arrow())
         assert got_dev == want, tier_buf.tier
         assert got_host == want, tier_buf.tier
+
+
+def test_spill_carries_dictionary_encoding_host_and_disk(tmp_path):
+    """Regression (PR 5 leftover): SpillableBuffer used to DROP column
+    encodings on spill, so an unspilled batch decoded instead of re-entering
+    the encoded domain. The descriptor must survive device -> host -> disk
+    and rebuild as a live DictEncoding on unspill."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+    from spark_rapids_tpu.columnar.encoding import DictEncoding, enc_specs_of
+    from spark_rapids_tpu.memory.buffer import SpillableBuffer, StorageTier
+
+    cap, n, k = 32, 20, 3
+    rng = np.random.default_rng(3)
+    idx = jnp.asarray(rng.integers(0, k, cap).astype(np.int32))
+    vals = jnp.asarray(np.array([11, 22, 33, 0, 0, 0, 0, 0], np.int64))
+    enc = DictEncoding(idx, vals, k, None, "tok-spill")
+    data = jnp.take(vals, idx)
+    valid = jnp.asarray(np.arange(cap) < n)
+    schema = Schema([Field("e", DType.LONG), Field("p", DType.LONG)])
+    b = DeviceBatch(schema, (DeviceColumn(DType.LONG, data, valid,
+                                          encoding=enc),
+                             DeviceColumn(DType.LONG, data, valid)), n)
+    buf = SpillableBuffer.from_batch(BufferId(992), b)
+
+    host = buf.to_host()
+    assert host.tier is StorageTier.HOST
+    disk = host.to_disk(str(tmp_path))
+    assert disk.tier is StorageTier.DISK
+    for tier_buf in (host, disk):
+        back = tier_buf.get_batch()
+        e2 = back.columns[0].encoding
+        assert e2 is not None, tier_buf.tier
+        assert e2.token == "tok-spill" and e2.k_real == k
+        assert np.array_equal(np.asarray(e2.indices), np.asarray(idx))
+        assert np.array_equal(np.asarray(e2.values), np.asarray(vals))
+        assert back.columns[1].encoding is None
+        # the unspilled batch is eligible for encoded-domain execution again
+        assert [s.ordinal for s in enc_specs_of(back)] == [0]
+        # and the decoded payload itself is intact
+        assert np.array_equal(np.asarray(back.columns[0].data)[:n],
+                              np.asarray(data)[:n])
+
+
+def test_spill_encoding_string_dictionary_roundtrip(tmp_path):
+    """String dictionaries carry the [k, width] byte matrix + per-entry
+    lengths through the host and disk tiers."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+    from spark_rapids_tpu.columnar.encoding import DictEncoding
+    from spark_rapids_tpu.memory.buffer import SpillableBuffer
+
+    cap, n, k, width = 16, 12, 2, 8
+    idx = jnp.asarray((np.arange(cap) % k).astype(np.int32))
+    mat = np.zeros((4, width), np.uint8)
+    mat[0, :3] = list(b"foo")
+    mat[1, :4] = list(b"barx")
+    lens = jnp.asarray(np.array([3, 4, 0, 0], np.int32))
+    vals = jnp.asarray(mat)
+    enc = DictEncoding(idx, vals, k, lens, "tok-str")
+    data = jnp.take(vals, idx, axis=0)
+    row_lens = jnp.take(lens, idx)
+    valid = jnp.asarray(np.arange(cap) < n)
+    schema = Schema([Field("s", DType.STRING)])
+    b = DeviceBatch(schema, (DeviceColumn(DType.STRING, data, valid, row_lens,
+                                          encoding=enc),), n)
+    disk = SpillableBuffer.from_batch(BufferId(993), b).to_host().to_disk(
+        str(tmp_path))
+    back = disk.get_batch()
+    e2 = back.columns[0].encoding
+    assert e2 is not None and e2.token == "tok-str"
+    assert np.array_equal(np.asarray(e2.values), mat)
+    assert np.array_equal(np.asarray(e2.lengths), np.asarray(lens))
+    assert back.to_arrow().equals(b.to_arrow())
