@@ -155,6 +155,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
     # ---- concurrent query serving (scheduler + cross-query program cache) ---
     concurrent = _bench_concurrent(table, conf, scale)
 
+    # ---- out-of-core degradation (ample vs 1/4 budget) ----------------------
+    out_of_core = _bench_out_of_core(table, conf, scale)
+
     # ---- columnar shuffle partition rate (GB/s/chip) ------------------------
     shuffle_gbps = _bench_shuffle(batch, iters)
     exchange_gbps = _bench_full_exchange(batch, conf, iters)
@@ -199,6 +202,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
             "compression": compression,
             "fusion": fusion,
             "concurrent": concurrent,
+            "out_of_core": out_of_core,
             "mesh": mesh_section,
             "end_to_end_collect_s": round(e2e_s, 4),
             "end_to_end_rows_per_sec": round(n_rows / e2e_s),
@@ -528,6 +532,92 @@ def _logical_bytes(batch) -> int:
         if c.lengths is not None:
             total += c.lengths.size * 4
     return total
+
+
+def _bench_out_of_core(table, conf: dict, scale: float) -> dict:
+    """Out-of-core degradation: Q1-shaped (filter+groupby) and Q3-shaped
+    (join+groupby) runs at AMPLE budget vs the device budget clamped to
+    ~1/4 of the measured working set. Reports rows/s both ways, grace
+    partitions, recursion depth and bytes spilled per tier; asserts the
+    clamped run completes with results matching ample (exact columns
+    bitwise, variableFloatAgg sums to 1e-9 — the distributed float-sum
+    contract, docs/out-of-core.md)."""
+    import numpy as np
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    from spark_rapids_tpu.benchmarks.tpch import q1
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    n_rows = table.num_rows
+    rng = np.random.default_rng(11)
+    n_ord = max(n_rows // 4, 2)
+    import pyarrow as pa
+    orders = pa.table({
+        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
+        "o_pri": rng.integers(0, 5, n_ord).astype(np.int64)})
+
+    def q3_shaped(sess, li, od):
+        # Q3 shape: selective filter -> equi-join -> aggregate
+        return (li.filter(F.col("l_quantity") < 30)
+                .join(od, [("l_orderkey", "o_orderkey")])
+                .groupBy("o_pri")
+                .agg(F.sum("l_extendedprice").alias("rev"),
+                     F.count(F.lit(1)).alias("n")))
+
+    base = {**conf, "spark.rapids.tpu.sql.scanCache.enabled": "false"}
+    out = {}
+    working_set = 0
+    for name, build in (("q1", lambda s: q1(s.create_dataframe(table))),
+                        ("q3_shaped", lambda s: q3_shaped(
+                            s, s.create_dataframe(table),
+                            s.create_dataframe(orders)))):
+        DeviceManager.shutdown()
+        sess = TpuSession(base)
+        df = build(sess)
+        df.collect()                      # warm programs
+        t0 = time.perf_counter()
+        ref = df.collect()
+        ample_s = time.perf_counter() - t0
+        mm = sess.last_metrics.get("memory", {})
+        assert mm.get("memory.spill_partitions", 0) == 0, (
+            "ample-budget run unexpectedly partitioned", mm)
+        # measured working set: what the operators' inputs occupy on device
+        working_set = max(
+            working_set,
+            sess.last_metrics.get("transfer", {}).get(
+                "transfer.upload_bytes", 0) or table.nbytes)
+        budget = max(int(working_set // 4), 64 << 10)
+        DeviceManager.shutdown()
+        tiny = TpuSession({
+            **base,
+            "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(budget),
+            "spark.rapids.tpu.memory.host.spillStorageSize": str(budget)})
+        tdf = build(tiny)
+        tdf.collect()                     # warm programs at tiny budget
+        t0 = time.perf_counter()
+        got = tdf.collect()
+        tiny_s = time.perf_counter() - t0
+        mm = tiny.last_metrics.get("memory", {})
+        # completion + correctness at 1/4 budget is the acceptance bar
+        assert_tables_equal(ref, got, ignore_order=True, approx_float=1e-9)
+        out[name] = {
+            "rows": n_rows,
+            "budget_bytes": budget,
+            "ample_rows_per_sec": round(n_rows / max(ample_s, 1e-9)),
+            "quarter_budget_rows_per_sec": round(n_rows / max(tiny_s, 1e-9)),
+            "quarter_vs_ample_x": round(ample_s / max(tiny_s, 1e-9), 3),
+            "spill_partitions": mm.get("memory.spill_partitions", 0),
+            "recursion_depth_peak": mm.get("memory.recursion_depth_peak", 0),
+            "bytes_spilled_to_host": mm.get("memory.bytes_spilled_to_host",
+                                            0),
+            "bytes_spilled_to_disk": mm.get("memory.bytes_spilled_to_disk",
+                                            0),
+            "pressure_events": mm.get("memory.pressure_events", 0),
+            "results_match": True,
+        }
+        assert out[name]["spill_partitions"] >= 2, out[name]
+    DeviceManager.shutdown()
+    return out
 
 
 def _bench_shuffle(batch, iters: int) -> float:

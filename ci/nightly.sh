@@ -23,6 +23,75 @@ echo "== shuffle fault injection over lz4-compressed payloads =="
 # compressed frames, not just copy-codec ones
 SHUFFLE_FAULTS_CODEC=lz4 python -m pytest tests/test_shuffle_faults.py -q
 
+echo "== out-of-core tight-budget chaos (1/4 working set + seeded alloc-failure injection) =="
+python - << 'PY'
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1, q6
+from spark_rapids_tpu.memory import faults as mfaults
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.testing import assert_tables_equal
+
+conf = {**BENCH_CONF, "spark.rapids.tpu.sql.string.maxBytes": "16",
+        "spark.rapids.tpu.sql.scanCache.enabled": "false"}
+lineitem = gen_lineitem(scale=0.05, seed=42)
+refs = {}
+for name, build in (("q1", q1), ("q6", q6)):
+    DeviceManager.shutdown()
+    sess = TpuSession(conf)
+    refs[name] = build(sess.create_dataframe(lineitem)).collect()
+    upload = sess.last_metrics["transfer"]["transfer.upload_bytes"]
+# device budget clamped to ~1/4 of the measured working set, PLUS seeded
+# allocation-failure injection so the reactive path fires even where the
+# footprint estimate would have predicted cleanly
+budget = max(int(upload // 4), 64 << 10)
+chaos = {**conf,
+         "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(budget),
+         "spark.rapids.tpu.memory.host.spillStorageSize": str(budget),
+         "spark.rapids.tpu.memory.faults.plan":
+             "alloc_fail:op=*,after=1,count=2;budget_clamp:fraction=0.5",
+         "spark.rapids.tpu.memory.faults.seed": "7"}
+spilled = 0
+for name, build in (("q1", q1), ("q6", q6)):
+    DeviceManager.shutdown()
+    mfaults.reset_plans()
+    sess = TpuSession(chaos)
+    got = build(sess.create_dataframe(lineitem)).collect()
+    # completion + bit-identity under chaos is the acceptance bar (exact
+    # columns bitwise, variableFloatAgg sums to 1e-9)
+    assert_tables_equal(refs[name], got, approx_float=1e-9)
+    mm = sess.last_metrics["memory"]
+    spilled += mm["memory.bytes_spilled_to_host"]
+    print(f"out-of-core chaos {name}: budget={budget} "
+          f"partitions={mm['memory.spill_partitions']} "
+          f"depth={mm['memory.recursion_depth_peak']} "
+          f"spilled_host={mm['memory.bytes_spilled_to_host']} "
+          f"spilled_disk={mm['memory.bytes_spilled_to_disk']} "
+          f"pressure={mm['memory.pressure_events']}")
+    if name == "q1":
+        assert mm["memory.spill_partitions"] >= 2, mm
+assert spilled > 0, "tight-budget chaos never spilled a byte"
+# third phase: AMPLE budget + seeded allocation-failure injection — the
+# plan-time footprint hint cannot predict this one, so the REACTIVE
+# machinery (admission probes -> mid-stream partition switch) is what
+# completes the query
+DeviceManager.shutdown()
+mfaults.reset_plans()
+sess = TpuSession({**conf,
+                   "spark.rapids.tpu.memory.faults.plan":
+                       "alloc_fail:op=agg,after=1",
+                   "spark.rapids.tpu.memory.faults.seed": "7"})
+got = q1(sess.create_dataframe(lineitem)).collect()
+assert_tables_equal(refs["q1"], got, approx_float=1e-9)
+mm = sess.last_metrics["memory"]
+assert mm["memory.pressure_events"] >= 1, mm
+assert mm["memory.spill_partitions"] >= 2, mm
+print(f"out-of-core chaos alloc_fail: partitions="
+      f"{mm['memory.spill_partitions']} "
+      f"pressure={mm['memory.pressure_events']}")
+DeviceManager.shutdown()
+print("out-of-core chaos ok")
+PY
+
 echo "== bench smoke (transfer-pipeline + compression breakdown, cpu backend) =="
 BENCH_ITERS=1 BENCH_SCALE=0.05 python bench.py | tail -n 1 > /tmp/bench_smoke.json
 python - /tmp/bench_smoke.json <<'PY'
@@ -56,6 +125,21 @@ assert fusion["repeat_hit_rate"] >= 0.99, fusion
 cov = fusion["coverage"]
 assert cov["queries"] >= 129, cov
 assert cov["fused_queries"] >= 60 and cov["fraction"] >= 0.5, cov
+ooc = out["breakdown"]["out_of_core"]
+for qname in ("q1", "q3_shaped"):
+    sec = ooc[qname]
+    for key in ("ample_rows_per_sec", "quarter_budget_rows_per_sec",
+                "spill_partitions", "recursion_depth_peak",
+                "bytes_spilled_to_host", "bytes_spilled_to_disk",
+                "results_match"):
+        assert key in sec, f"missing out_of_core {qname} key {key}: {sec}"
+    # out-of-core acceptance: the quarter-budget run grace-partitions,
+    # actually spills, completes, and matches the ample-budget results
+    assert sec["results_match"] is True, sec
+    assert sec["spill_partitions"] >= 2, sec
+    assert sec["quarter_budget_rows_per_sec"] > 0, sec
+assert (ooc["q1"]["bytes_spilled_to_host"]
+        + ooc["q3_shaped"]["bytes_spilled_to_host"]) > 0, ooc
 conc = out["breakdown"]["concurrent"]
 for key in ("queries", "sequential_rows_per_sec", "aggregate_rows_per_sec",
             "aggregate_vs_sequential_x", "p50_latency_s", "p99_latency_s",
@@ -96,6 +180,9 @@ print("bench smoke OK:", {k: pipe[k] for k in
       {k: conc[k] for k in ("aggregate_vs_sequential_x",
                             "program_cache_hit_rate", "p50_latency_s",
                             "p99_latency_s")},
+      {"out_of_core_q1": {k: ooc["q1"][k] for k in
+                          ("spill_partitions", "recursion_depth_peak",
+                           "quarter_vs_ample_x")}},
       {"warm_start_disk_hits": conc["warm_start"]["disk_hits"]},
       {k: mesh[k] for k in ("in_mesh_exchange_gb_per_sec",
                             "in_mesh_vs_host_hop_x", "host_hop_bytes")})

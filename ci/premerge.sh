@@ -92,6 +92,36 @@ assert fusion_stats(unfused.last_plan)["fused_stages"] == 0
 print("fusion smoke ok")
 PY
 
+echo "== out-of-core smoke (tiny-budget Q1, grace partitions + bit-identity) =="
+python - << 'PY'
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.testing import assert_tables_equal
+
+conf = {**BENCH_CONF, "spark.rapids.tpu.sql.string.maxBytes": "16",
+        "spark.rapids.tpu.sql.scanCache.enabled": "false"}
+lineitem = gen_lineitem(scale=0.01, seed=42)
+ref = q1(TpuSession(conf).create_dataframe(lineitem)).collect()
+DeviceManager.shutdown()
+tiny = TpuSession({**conf,
+                   "spark.rapids.tpu.memory.tpu.poolSizeBytes":
+                       str(256 << 10),
+                   "spark.rapids.tpu.memory.host.spillStorageSize":
+                       str(256 << 10)})
+got = q1(tiny.create_dataframe(lineitem)).collect()
+mm = tiny.last_metrics["memory"]
+# exact columns bitwise; variableFloatAgg sums to 1e-9 (the distributed
+# float-sum contract, docs/out-of-core.md)
+assert_tables_equal(ref, got, approx_float=1e-9)
+assert mm["memory.spill_partitions"] >= 2, mm
+assert mm["memory.bytes_spilled_to_host"] > 0, mm
+DeviceManager.shutdown()
+print("out-of-core smoke ok:", {k: mm[k] for k in
+      ("memory.spill_partitions", "memory.recursion_depth_peak",
+       "memory.bytes_spilled_to_host", "memory.bytes_spilled_to_disk")})
+PY
+
 echo "== multichip dry-run (8 virtual devices) =="
 python - << 'PY'
 import importlib.util

@@ -568,10 +568,13 @@ class DataFrame:
         # named range in the XLA/TensorBoard profile (NVTX analog); when
         # metrics are on, per-operator counters land in session.last_metrics
         from spark_rapids_tpu.utils.metrics import (NamedRange,
+                                                    memory_delta,
+                                                    memory_snapshot,
                                                     transfer_delta,
                                                     transfer_snapshot)
         trace = self.session.conf.get(_cfg.TRACE_ENABLED)
         transfer_before = transfer_snapshot()
+        memory_before = memory_snapshot()
         import time as _time
         tenant = query.tenant if query is not None else "default"
         cancel = query.check_cancelled if query is not None else None
@@ -666,6 +669,10 @@ class DataFrame:
                 # (process-global counters: under concurrent queries the
                 # per-action delta includes overlapping queries' traffic)
                 snap["transfer"] = transfer_delta(transfer_before)
+                # out-of-core story for the action: pressure events, grace
+                # partitions, recursion peak, bytes spilled per tier
+                # (process-global like the tiered store they observe)
+                snap["memory"] = memory_delta(memory_before)
                 if query is not None:
                     query.record_exec_metrics(snap)
                 self.session.last_metrics = snap

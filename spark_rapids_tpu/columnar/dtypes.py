@@ -215,3 +215,47 @@ def bucket_capacity(num_rows: int, bucketed: bool = True, minimum: int = 128) ->
     while cap < num_rows:
         cap <<= 1
     return cap
+
+
+#: nominal bytes per row per dtype, for size-estimate scaling (the Spark
+#: sizeInBytes convention; STRING uses a flat 20 B — the estimate feeds
+#: broadcast selection and out-of-core footprints, not allocation)
+_DTYPE_WIDTH = {DType.BOOLEAN: 1, DType.BYTE: 1, DType.SHORT: 2,
+                DType.INT: 4, DType.FLOAT: 4, DType.DATE: 4, DType.LONG: 8,
+                DType.DOUBLE: 8, DType.TIMESTAMP: 8, DType.STRING: 20,
+                DType.NULL: 1}
+
+
+def row_width(schema: "Schema") -> int:
+    """Nominal bytes per row for size-estimate scaling."""
+    return sum(_DTYPE_WIDTH.get(f.dtype, 8) for f in schema)
+
+
+def width_scaled_estimate(child, out_schema: "Schema"):
+    """Child exec's size estimate scaled by the output/input row-width
+    ratio (width-changing operators: projections, windows,
+    aggregates-as-upper-bound); None propagates."""
+    child_sz = child.size_estimate()
+    if child_sz is None:
+        return None
+    in_w = row_width(child.output)
+    return int(child_sz * row_width(out_schema) / max(in_w, 1))
+
+
+def limit_size_estimate(child, out_schema: "Schema", n: int):
+    """min(n rows at nominal width, child upper bound); None-tolerant."""
+    cap = n * row_width(out_schema)
+    child_sz = child.size_estimate()
+    return cap if child_sz is None else min(cap, child_sz)
+
+
+def union_size_estimate(children):
+    """Sum of the children's estimates; None if any child is unknown."""
+    sizes = [c.size_estimate() for c in children]
+    return None if any(s is None for s in sizes) else sum(sizes)
+
+
+def expand_size_estimate(child, num_projections: int):
+    """Every input row emits one row per projection list; None propagates."""
+    child_sz = child.size_estimate()
+    return None if child_sz is None else child_sz * num_projections

@@ -428,6 +428,72 @@ HOST_SPILL_STORAGE_SIZE = _conf(
     "(analog of spark.rapids.memory.host.spillStorageSize).",
     checker=_positive("spillStorageSize"))
 
+OOC_ENABLED = _conf(
+    "memory.outOfCore.enabled", bool, True,
+    "Out-of-core execution for hash aggregate, shuffled/broadcast hash join "
+    "and sort: when an operator's working set will not fit the device "
+    "budget (planner footprint estimate up front, or runtime pressure "
+    "reactively), the input is hash/range-partitioned by key into "
+    "spillable partitions across the device->host->disk tiers and the "
+    "operator recurses per partition — grace-style degradation instead of "
+    "an HBM allocation failure (the RapidsBufferCatalog spill design). "
+    "With ample budget the single-pass hot path runs unchanged.")
+
+OOC_HEADROOM = _conf(
+    "memory.outOfCore.headroomFraction", float, 0.8,
+    "Fraction of the free device budget an operator's estimated working "
+    "set may occupy before the out-of-core path engages; the rest is "
+    "headroom for the operator's own intermediates (sort passes, join "
+    "output) and concurrent queries.", checker=_fraction("headroomFraction"))
+
+OOC_FANOUT = _conf(
+    "memory.outOfCore.fanout", int, 8,
+    "Grace partitions created per recursion level when runtime pressure "
+    "triggers partitioning without a plan-time footprint estimate; each "
+    "level re-partitions with a depth-salted hash, so colliding key groups "
+    "separate on the next level.", checker=_positive("outOfCore.fanout"))
+
+OOC_MAX_PARTITIONS = _conf(
+    "memory.outOfCore.maxPartitions", int, 256,
+    "Upper bound on grace partitions one operator creates per recursion "
+    "level (clamps the planner's footprint-derived choice).",
+    checker=_positive("outOfCore.maxPartitions"))
+
+OOC_MAX_DEPTH = _conf(
+    "memory.outOfCore.maxRecursionDepth", int, 4,
+    "Bound on grace recursion depth. A partition that still exceeds the "
+    "budget at the deepest level (e.g. one giant key group, which no hash "
+    "can split) runs single-pass there — completion is preferred over "
+    "enforcing the budget exactly.",
+    checker=_positive("outOfCore.maxRecursionDepth"))
+
+OOC_FORCE_PARTITIONS = _conf(
+    "memory.outOfCore.forcePartitions", int, 0,
+    "Force every out-of-core-capable operator to grace-partition its input "
+    "into this many partitions regardless of budget (0 disables). "
+    "Deterministic degradation-path testing knob — the partitioned plan "
+    "runs even when everything would fit.",
+    checker=_non_negative("outOfCore.forcePartitions"))
+
+MEMORY_FAULTS_PLAN = _conf(
+    "memory.faults.plan", str, "",
+    "Deterministic HBM-pressure fault-injection plan (empty = no faults), "
+    "mirroring shuffle.faults.plan. Semicolon-separated specs, e.g. "
+    "'alloc_fail:op=agg,after=1;budget_clamp:fraction=0.25'. Kinds: "
+    "alloc_fail (the Nth working-set admission check of a matching "
+    "operator fails, forcing the reactive out-of-core path), budget_clamp "
+    "(the effective device budget shrinks to fraction of its real value; "
+    "sustained — count defaults to 0 = every read). "
+    "Keys: op (agg | join | sort | *), after, count (0 = every event), "
+    "fraction. Honored by memory/faults.py probes in the grace layer.")
+
+MEMORY_FAULTS_SEED = _conf(
+    "memory.faults.seed", int, 0,
+    "Identity of the memory fault schedule: the schedule itself is fully "
+    "deterministic from the plan text, and (plan, seed) keys one "
+    "process-wide event-counter instance — a new seed starts a fresh "
+    "chaos run, the same pair replays the same one.")
+
 # --------------------------------------------------------------------------------------
 # Shuffle (analog of spark.rapids.shuffle.*)
 # --------------------------------------------------------------------------------------

@@ -91,6 +91,21 @@ class PhysicalExec:
     #: asserted by tests.
     placement = None
 
+    #: size_estimate contract (audited by tests/test_out_of_core.py): every
+    #: exec class either defines size_estimate somewhere below PhysicalExec
+    #: in its MRO, or documents WHY None is the only honest answer here.
+    #: A non-empty reason string is the documented-None escape hatch
+    #: (FusedStageExec-with-Expand precedent: output multiplies per
+    #: variant, so child bytes stop being an upper bound).
+    size_estimate_none_reason: Optional[str] = None
+
+    #: plan-time out-of-core hint (plan/footprint.py): when > 0, the
+    #: planner's footprint estimate predicted this operator's working set
+    #: exceeds the device budget, and execution grace-partitions its input
+    #: into this many spillable partitions up front instead of waiting for
+    #: runtime pressure (memory/grace.py).
+    grace_partitions: int = 0
+
     def __init__(self, children: Sequence["PhysicalExec"], output: Schema):
         self.children: Tuple[PhysicalExec, ...] = tuple(children)
         self.output = output
@@ -110,10 +125,22 @@ class PhysicalExec:
         raise NotImplementedError(self.name)
 
     def size_estimate(self) -> Optional[int]:
-        """Estimated output bytes (Spark statistics sizeInBytes role), used by
-        the planner's broadcast-join selection. None = unknown (never
-        broadcast). Narrowing ops pass their child's estimate through as an
-        upper bound; everything else is unknown."""
+        """Estimated output bytes (Spark statistics sizeInBytes role), used
+        by the planner's broadcast-join selection AND the out-of-core
+        footprint contract (plan/footprint.py). None = unknown (never
+        broadcast, never predicted over budget) and must be justified via
+        ``size_estimate_none_reason``. Narrowing ops pass their child's
+        estimate through as an upper bound."""
+        return None
+
+    def working_set_estimate(self) -> Optional[int]:
+        """Estimated PEAK device bytes while this operator runs — the
+        planner-visible footprint contract (plan/footprint.py compares it
+        against the device budget to choose grace partition counts up
+        front). Streaming operators have no materialized working set
+        beyond one batch (None); the working-set operators (hash
+        aggregate, hash join, sort) override with
+        ``working_set_factor × Σ child size estimates``."""
         return None
 
     # ---- plan display ---------------------------------------------------------

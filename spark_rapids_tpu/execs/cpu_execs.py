@@ -45,14 +45,10 @@ def concat_host_batches(batches: List[HostBatch], schema: Schema) -> HostBatch:
     return HostBatch.from_arrow(pa.concat_tables(tables))
 
 
-_DTYPE_WIDTH = {DType.BOOLEAN: 1, DType.BYTE: 1, DType.SHORT: 2, DType.INT: 4,
-                DType.FLOAT: 4, DType.DATE: 4, DType.LONG: 8, DType.DOUBLE: 8,
-                DType.TIMESTAMP: 8, DType.STRING: 20, DType.NULL: 1}
-
-
-def _row_width(schema: Schema) -> int:
-    """Nominal bytes per row for size-estimate scaling."""
-    return sum(_DTYPE_WIDTH.get(f.dtype, 8) for f in schema)
+# canonical width/size-estimate helpers live with the dtype table
+# (columnar/dtypes.py); aliased here for the engine's historical import path
+from spark_rapids_tpu.columnar.dtypes import (row_width as _row_width,
+                                              width_scaled_estimate)
 
 
 class CpuLocalScanExec(LeafExec):
@@ -78,6 +74,9 @@ class CpuRangeExec(LeafExec):
         super().__init__(Schema([Field("id", DType.LONG, nullable=False)]))
         self.start, self.end, self.step = start, end, step
 
+    def size_estimate(self):
+        return max(0, -(-(self.end - self.start) // self.step)) * 9
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         if ctx.partition_id != 0:
             return
@@ -89,15 +88,8 @@ class CpuRangeExec(LeafExec):
 
 class CpuProjectExec(PhysicalExec):
     def size_estimate(self):
-        # scale by the output/input row-width ratio (Spark scales Project
-        # sizeInBytes the same way) so widening projections don't slip under
-        # the broadcast threshold
-        child_sz = self.children[0].size_estimate()
-        if child_sz is None:
-            return None
-        in_w = _row_width(self.children[0].output)
-        out_w = _row_width(self.output)
-        return int(child_sz * out_w / max(in_w, 1))
+        # widening projections must not slip under the broadcast threshold
+        return width_scaled_estimate(self.children[0], self.output)
 
     def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec):
         super().__init__((child,), output_schema(exprs))
@@ -150,6 +142,10 @@ class CpuHashAggregateExec(PhysicalExec):
         self.aggregates = aggregates
         self.pre_filter = pre_filter
 
+    def size_estimate(self):
+        # groups never exceed input rows: width-scaled child upper bound
+        return width_scaled_estimate(self.children[0], self.output)
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         from spark_rapids_tpu.exprs.misc import Alias
         child_batches = list(self.children[0].execute(ctx))
@@ -196,6 +192,9 @@ class CpuSortExec(PhysicalExec):
         super().__init__((child,), child.output)
         self.orders = orders
 
+    def size_estimate(self):
+        return self.children[0].size_estimate()   # a sort is a permutation
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         batches = list(self.children[0].execute(ctx))
         batch = concat_host_batches(batches, self.output)
@@ -220,6 +219,10 @@ class CpuLimitExec(PhysicalExec):
         super().__init__((child,), child.output)
         self.n = n
 
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import limit_size_estimate
+        return limit_size_estimate(self.children[0], self.output, self.n)
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         remaining = self.n
         for batch in self.children[0].execute(ctx):
@@ -238,6 +241,10 @@ class CpuUnionExec(PhysicalExec):
     def __init__(self, left: PhysicalExec, right: PhysicalExec):
         super().__init__((left, right), left.output)
 
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import union_size_estimate
+        return union_size_estimate(self.children)
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         for child in self.children:
             yield from child.execute(ctx)
@@ -248,6 +255,9 @@ class CpuCollectExec(PhysicalExec):
 
     def __init__(self, child: PhysicalExec):
         super().__init__((child,), child.output)
+
+    def size_estimate(self):
+        return self.children[0].size_estimate()   # drain: same rows
 
     def collect(self, ctx: ExecContext) -> pa.Table:
         tables = [b.to_arrow() for b in self.children[0].execute(ctx)]

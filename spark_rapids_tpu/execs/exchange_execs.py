@@ -146,9 +146,14 @@ def _column_hash(xp, v: ColV) -> "np.ndarray":
     return _fmix32(xp, xp.bitwise_xor(_fmix32(xp, lo), hi))
 
 
-def hash_partition_ids(xp, keys: Sequence[ColV], cap: int, n: int):
-    """Target partition id per row from the key columns."""
-    h = xp.full((cap,), _H_SEED, dtype=np.uint32)
+def hash_partition_ids(xp, keys: Sequence[ColV], cap: int, n: int,
+                       seed=None):
+    """Target partition id per row from the key columns. ``seed`` (default
+    the exchange seed) lets the out-of-core grace partitioner re-partition
+    with a DIFFERENT hash per recursion depth, so key groups that collided
+    mod n at one level separate at the next (memory/grace.py)."""
+    h = xp.full((cap,), _H_SEED if seed is None else np.uint32(seed),
+                dtype=np.uint32)
     for v in keys:
         ch = _column_hash(xp, v)
         if ch.ndim == 0:  # scalar key (literal)
@@ -413,6 +418,10 @@ def _sample_rows(colvs: List[ColV], num_rows: int, k: int) -> List[ColV]:
 
 # ------------------------------------------------------------------ exec base
 class ShuffleExchangeExecBase(PhysicalExec):
+    def size_estimate(self):
+        # a repartition moves rows, it does not create or drop them
+        return self.children[0].size_estimate()
+
     def __init__(self, partitioning: Partitioning, child: PhysicalExec):
         super().__init__((child,), child.output)
         self.partitioning = partitioning
@@ -1029,6 +1038,10 @@ class BroadcastExchangeExecBase(PhysicalExec):
     (SerializeConcatHostBuffersDeserializeBatch:47-66); here the single cached
     batch plays that per-executor role, released when the action finishes."""
 
+    def size_estimate(self):
+        # a broadcast replicates the child batch, it does not grow it
+        return self.children[0].size_estimate()
+
     def __init__(self, child: PhysicalExec):
         super().__init__((child,), child.output)
         self._lock = threading.Lock()
@@ -1093,6 +1106,9 @@ class CpuReusedExchangeExec(PhysicalExec):
     def __init__(self, referent: PhysicalExec):
         super().__init__((referent,), referent.output)
 
+    def size_estimate(self):
+        return self.referent.size_estimate()   # same rows, zero recompute
+
     @property
     def referent(self) -> PhysicalExec:
         return self.children[0]
@@ -1116,6 +1132,9 @@ class CpuQueryStageExec(PhysicalExec):
         super().__init__((child,), child.output)
         self.stage_id = stage_id
 
+    def size_estimate(self):
+        return self.children[0].size_estimate()   # wrapper: same rows
+
     def execute(self, ctx: ExecContext):
         yield from self.children[0].execute(ctx)
 
@@ -1132,6 +1151,9 @@ class TpuReusedExchangeExec(PhysicalExec):
 
     def __init__(self, referent: PhysicalExec):
         super().__init__((referent,), referent.output)
+
+    def size_estimate(self):
+        return self.referent.size_estimate()   # same rows, zero recompute
 
     @property
     def referent(self) -> PhysicalExec:

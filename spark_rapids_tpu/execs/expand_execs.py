@@ -40,6 +40,10 @@ class CpuExpandExec(PhysicalExec):
         super().__init__((child,), output)
         self.projections = _aligned(projections, output)
 
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import expand_size_estimate
+        return expand_size_estimate(self.children[0], len(self.projections))
+
     def execute(self, ctx: ExecContext) -> Iterator:
         for batch in self.children[0].execute(ctx):
             for plist in self.projections:
@@ -56,6 +60,10 @@ class TpuExpandExec(PhysicalExec):
                  child: PhysicalExec, output: Schema):
         super().__init__((child,), output)
         self.projections = _aligned(projections, output)
+
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import expand_size_estimate
+        return expand_size_estimate(self.children[0], len(self.projections))
 
     def execute(self, ctx: ExecContext) -> Iterator:
         for batch in self.children[0].execute(ctx):

@@ -56,6 +56,12 @@ def _eval_keys(xp, colvs, capacity, smax, key_exprs) -> List[ColV]:
 
 
 class _HashJoinBase(PhysicalExec):
+    #: join output size depends on key multiplicity, which no static
+    #: estimate captures — None keeps downstream consumers honest
+    #: (size_estimate contract, tests/test_out_of_core.py audit)
+    size_estimate_none_reason = ("join output multiplicity is unknown "
+                                 "without key statistics")
+
     def __init__(self, left: PhysicalExec, right: PhysicalExec, how: str,
                  left_keys: Tuple[Expression, ...],
                  right_keys: Tuple[Expression, ...], output: Schema,
@@ -137,6 +143,17 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
 
     is_device = True
 
+    #: both sides resident + the gather output while the join runs. On the
+    #: DEVICE class only: the footprint contract measures HBM, and a CPU
+    #: fallback join never reads a grace hint (plan/footprint.py)
+    working_set_factor = 3.0
+
+    def working_set_estimate(self):
+        sizes = [c.size_estimate() for c in self.children]
+        if any(s is None for s in sizes):
+            return None
+        return int(sum(sizes) * self.working_set_factor)
+
     #: set by plan/encoded.mark_encoded_domain: equi-join key pairs whose
     #: both sides kept their dictionary encoding match on int32 indices —
     #: directly when the sides share a dictionary stream, via a k_l x k_r
@@ -178,16 +195,67 @@ class TpuShuffledHashJoinExec(_HashJoinBase):
         return tuple(pairs)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.memory import grace
+        left = self.children[0].execute(ctx)
+        right = self.children[1].execute(ctx)
+        ooc = (grace.controller_for(self, ctx, "join",
+                                    self.left_keys + self.right_keys)
+               if self.left_keys else None)
+        if ooc is None:
+            yield from self._single_pass(ctx, list(left), list(right))
+            return
+        mode, payload = ooc.stage_two(left, right, self.left_keys,
+                                      self.right_keys)
+        if mode == "inline":
+            yield from self._single_pass(ctx, payload[0], payload[1])
+            return
+        yield from self._grace_execute(ctx, ooc, payload[0], payload[1])
+
+    def _grace_execute(self, ctx: ExecContext, ooc, lparts,
+                       rparts) -> Iterator[DeviceBatch]:
+        """Grace hash join: both sides partitioned by the SAME depth-salted
+        hash of their join keys, so every key's rows (and null-key outer
+        rows — nulls hash to one constant) meet inside exactly one
+        partition pair; per-pair single-pass joins union to the global
+        result. A pair still over budget re-partitions both sides with a
+        deeper salt, unless the split proved degenerate (one indivisible
+        key group on both sides — deeper salts cannot separate it)."""
+        try:
+            degenerate = lparts.degenerate and rparts.degenerate
+            for pid in range(lparts.n):
+                ctx.check_cancelled()
+                nbytes = lparts.bytes_of(pid) + rparts.bytes_of(pid)
+                if nbytes == 0:
+                    continue
+                if not degenerate and ooc.should_recurse(nbytes,
+                                                         lparts.depth):
+                    # drain() feeds each side's re-split one piece at a
+                    # time — the over-budget pair is never whole on device
+                    lsub = ooc.partition(lparts.drain(pid), self.left_keys,
+                                         depth=lparts.depth + 1)
+                    rsub = ooc.partition(rparts.drain(pid), self.right_keys,
+                                         depth=rparts.depth + 1)
+                    yield from self._grace_execute(ctx, ooc, lsub, rsub)
+                else:
+                    lbatches = lparts.take(pid)
+                    rbatches = rparts.take(pid)
+                    if lbatches or rbatches:
+                        yield from self._single_pass(ctx, lbatches,
+                                                     rbatches)
+        finally:
+            lparts.close()
+            rparts.close()
+
+    def _single_pass(self, ctx: ExecContext, lbatches,
+                     rbatches) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu.columnar import encoding as cenc
         from spark_rapids_tpu.exprs import encoded as ed
         from spark_rapids_tpu.utils import metrics as mt
         smax = ctx.string_max_bytes
         lschema = self.children[0].output
         rschema = self.children[1].output
-        lb = concat_device_batches(list(self.children[0].execute(ctx)),
-                                   lschema, smax)
-        rb = concat_device_batches(list(self.children[1].execute(ctx)),
-                                   rschema, smax)
+        lb = concat_device_batches(lbatches, lschema, smax)
+        rb = concat_device_batches(rbatches, rschema, smax)
         S, B = lb.capacity, rb.capacity
 
         enc_pairs = self._encoded_key_pairs(ctx, lb, rb)

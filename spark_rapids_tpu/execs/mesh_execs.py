@@ -88,6 +88,14 @@ class MeshExec(PhysicalExec):
     is_device = True
     is_mesh = True
 
+    #: mesh plans never consume static size estimates: every mesh exchange
+    #: and join/aggregate strategy switch counts OBSERVED per-shard sizes
+    #: before its program compiles (sql.mesh.aggRepartitionThreshold,
+    #: adaptive broadcast), and the out-of-core layer is single-process
+    #: scope (per-shard grace is a ROADMAP follow-up)
+    size_estimate_none_reason = ("mesh operators decide from observed "
+                                 "per-shard sizes at run time")
+
     def __init__(self, children, output: Schema, mesh: Mesh):
         super().__init__(children, output)
         self.mesh = mesh

@@ -20,7 +20,9 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
-from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema, bucket_capacity
+from spark_rapids_tpu.columnar.dtypes import (DType, Field, Schema,
+                                              bucket_capacity,
+                                              width_scaled_estimate as _width_scaled)
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
 from spark_rapids_tpu.execs.evaluator import (eval_exprs_device, output_schema)
@@ -177,6 +179,9 @@ class HostToDeviceExec(PhysicalExec):
     def __init__(self, child: PhysicalExec):
         super().__init__((child,), child.output)
 
+    def size_estimate(self) -> Optional[int]:
+        return self.children[0].size_estimate()   # transition: same rows
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.columnar.transfer import upload_table_conf
@@ -217,6 +222,9 @@ class DeviceToHostExec(PhysicalExec):
     def __init__(self, child: PhysicalExec):
         super().__init__((child,), child.output)
 
+    def size_estimate(self) -> Optional[int]:
+        return self.children[0].size_estimate()   # transition: same rows
+
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         for db in self.children[0].execute(ctx):
             ctx.check_cancelled()   # before each download
@@ -232,6 +240,10 @@ class TpuRangeExec(LeafExec):
     def __init__(self, start: int, end: int, step: int):
         super().__init__(Schema([Field("id", DType.LONG, nullable=False)]))
         self.start, self.end, self.step = start, end, step
+
+    def size_estimate(self) -> Optional[int]:
+        rows = max(0, -(-(self.end - self.start) // self.step))
+        return rows * 9      # 8B id + validity byte
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         if ctx.partition_id != 0:
@@ -251,6 +263,9 @@ class TpuProjectExec(PhysicalExec):
     def __init__(self, exprs: Tuple[Expression, ...], child: PhysicalExec):
         super().__init__((child,), output_schema(exprs))
         self.exprs = exprs
+
+    def size_estimate(self) -> Optional[int]:
+        return _width_scaled(self.children[0], self.output)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for batch in self.children[0].execute(ctx):
@@ -272,6 +287,9 @@ class TpuFilterExec(PhysicalExec):
     def __init__(self, condition: Expression, child: PhysicalExec):
         super().__init__((child,), child.output)
         self.condition = condition
+
+    def size_estimate(self) -> Optional[int]:
+        return self.children[0].size_estimate()   # upper bound (no stats)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu import config as cfg
@@ -339,6 +357,11 @@ class TpuHashAggregateExec(PhysicalExec):
     #: and materialize decoded key values only for the surviving groups
     encoded_domain_ok = False
 
+    #: peak device bytes per input byte while the aggregation runs (input
+    #: batch + the grouping sort passes + compacted output), the planner's
+    #: footprint contract and the runtime pressure check (memory/grace.py)
+    working_set_factor = 3.0
+
     def __init__(self, grouping: Tuple[Expression, ...],
                  aggregates: Tuple[Expression, ...], child: PhysicalExec,
                  output: Schema, pre_filter: Optional[Expression] = None):
@@ -347,12 +370,60 @@ class TpuHashAggregateExec(PhysicalExec):
         self.aggregates = aggregates
         self.pre_filter = pre_filter
 
+    def size_estimate(self) -> Optional[int]:
+        # output groups never exceed input rows: the child's estimate is an
+        # upper bound, scaled by the output/input row-width ratio
+        return _width_scaled(self.children[0], self.output)
+
+    def working_set_estimate(self) -> Optional[int]:
+        sz = self.children[0].size_estimate()
+        return None if sz is None else int(sz * self.working_set_factor)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.memory import grace
+        source = self.children[0].execute(ctx)
+        ooc = (grace.controller_for(self, ctx, "agg", self.grouping)
+               if self.grouping else None)
+        if ooc is None:
+            yield from self._single_pass(ctx, list(source))
+            return
+        mode, payload = ooc.stage(source, self.grouping)
+        if mode == "inline":
+            yield from self._single_pass(ctx, payload)
+            return
+        yield from self._grace_execute(ctx, ooc, payload)
+
+    def _grace_execute(self, ctx: ExecContext, ooc,
+                       parts) -> Iterator[DeviceBatch]:
+        """Grace recursion: every partition holds complete key groups
+        (hash-routed), so the per-partition single-pass results union to
+        the global aggregation; oversized partitions re-partition with a
+        deeper hash salt until they fit, the depth bound stops them, or a
+        split proves degenerate (one indivisible key group)."""
+        try:
+            degenerate = parts.degenerate
+            for pid in parts.nonempty():
+                ctx.check_cancelled()
+                if not degenerate and ooc.should_recurse(
+                        parts.bytes_of(pid), parts.depth):
+                    # drain() feeds the re-split one piece at a time, so
+                    # the over-budget partition is never whole on device
+                    sub = ooc.partition(parts.drain(pid), self.grouping,
+                                        depth=parts.depth + 1)
+                    yield from self._grace_execute(ctx, ooc, sub)
+                else:
+                    batches = parts.take(pid)
+                    if batches:
+                        yield from self._single_pass(ctx, batches)
+        finally:
+            parts.close()
+
+    def _single_pass(self, ctx: ExecContext,
+                     child_batches) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.columnar import encoding as cenc
         from spark_rapids_tpu.exprs import encoded as ed
         from spark_rapids_tpu.utils import metrics as um
-        child_batches = list(self.children[0].execute(ctx))
         batch = concat_device_batches(child_batches, self.children[0].output,
                                       ctx.string_max_bytes)
         cap = batch.capacity
@@ -445,12 +516,66 @@ class TpuHashAggregateExec(PhysicalExec):
 class TpuSortExec(PhysicalExec):
     is_device = True
 
+    #: input + the variadic sort's key passes + sorted output
+    working_set_factor = 3.0
+
     def __init__(self, orders: Tuple[SortOrder, ...], child: PhysicalExec):
         super().__init__((child,), child.output)
         self.orders = orders
 
+    def size_estimate(self) -> Optional[int]:
+        return self.children[0].size_estimate()   # a sort is a permutation
+
+    def working_set_estimate(self) -> Optional[int]:
+        sz = self.children[0].size_estimate()
+        return None if sz is None else int(sz * self.working_set_factor)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        batches = list(self.children[0].execute(ctx))
+        from spark_rapids_tpu.memory import grace
+        source = self.children[0].execute(ctx)
+        ooc = grace.controller_for(self, ctx, "sort", (),
+                                   orders=self.orders)
+        if ooc is None:
+            yield from self._single_pass(ctx, list(source))
+            return
+        mode, payload = ooc.stage(source, (), orders=self.orders)
+        if mode == "inline":
+            yield from self._single_pass(ctx, payload)
+            return
+        yield from self._grace_execute(ctx, ooc, payload)
+
+    def _grace_execute(self, ctx: ExecContext, ooc,
+                       parts) -> Iterator[DeviceBatch]:
+        """External sort by order-preserving range partitioning (the
+        device-friendly external merge: sampled bounds split the key space,
+        ties share a partition, and the bound-ordered emission of
+        per-partition stable sorts IS the merged output — bit-identical to
+        the single-pass stable sort). Skewed partitions re-partition on
+        their OWN resampled bounds until they fit, the depth bound stops
+        them, or a split proves degenerate (one indivisible key run)."""
+        try:
+            degenerate = parts.degenerate
+            for pid in parts.nonempty():
+                ctx.check_cancelled()
+                sub = None
+                if not degenerate and ooc.should_recurse(
+                        parts.bytes_of(pid), parts.depth):
+                    # drain() feeds the re-split piece-wise; bounds resample
+                    # from the drained prefix (a nonempty pid has live rows,
+                    # so the sample cannot come back empty)
+                    sub = ooc.partition(parts.drain(pid), (),
+                                        depth=parts.depth + 1,
+                                        orders=self.orders)
+                if sub is not None:
+                    yield from self._grace_execute(ctx, ooc, sub)
+                else:
+                    batches = parts.take(pid)
+                    if batches:
+                        yield from self._single_pass(ctx, batches)
+        finally:
+            parts.close()
+
+    def _single_pass(self, ctx: ExecContext, batches) -> Iterator[DeviceBatch]:
         batch = concat_device_batches(batches, self.output, ctx.string_max_bytes)
         if batch.num_rows == 0:
             yield batch
@@ -492,6 +617,10 @@ class TpuLimitExec(PhysicalExec):
         super().__init__((child,), child.output)
         self.n = n
 
+    def size_estimate(self) -> Optional[int]:
+        from spark_rapids_tpu.columnar.dtypes import limit_size_estimate
+        return limit_size_estimate(self.children[0], self.output, self.n)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         remaining = self.n
         for batch in self.children[0].execute(ctx):
@@ -519,6 +648,10 @@ class TpuUnionExec(PhysicalExec):
     def __init__(self, left: PhysicalExec, right: PhysicalExec):
         super().__init__((left, right), left.output)
 
+    def size_estimate(self) -> Optional[int]:
+        from spark_rapids_tpu.columnar.dtypes import union_size_estimate
+        return union_size_estimate(self.children)
+
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for child in self.children:
             yield from child.execute(ctx)
@@ -535,6 +668,9 @@ class TpuCoalesceBatchesExec(PhysicalExec):
         super().__init__((child,), child.output)
         self.target_bytes = target_bytes
         self.require_single = require_single
+
+    def size_estimate(self) -> Optional[int]:
+        return self.children[0].size_estimate()   # concat: same rows
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for out in coalesce_batches(self.children[0].execute(ctx),

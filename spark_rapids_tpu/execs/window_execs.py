@@ -98,6 +98,10 @@ class CpuWindowExec(PhysicalExec):
         super().__init__((child,), window_output_schema(child.output, wexprs))
         self.wexprs = wexprs
 
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import width_scaled_estimate
+        return width_scaled_estimate(self.children[0], self.output)
+
     def execute(self, ctx: ExecContext) -> Iterator:
         from spark_rapids_tpu.execs.cpu_execs import (_colvs_to_host,
                                                       _host_colvs,
@@ -127,6 +131,10 @@ class TpuWindowExec(PhysicalExec):
     def __init__(self, wexprs: Tuple[Expression, ...], child: PhysicalExec):
         super().__init__((child,), window_output_schema(child.output, wexprs))
         self.wexprs = wexprs
+
+    def size_estimate(self):
+        from spark_rapids_tpu.columnar.dtypes import width_scaled_estimate
+        return width_scaled_estimate(self.children[0], self.output)
 
     def execute(self, ctx: ExecContext) -> Iterator:
         from spark_rapids_tpu.execs.tpu_execs import (_cached_jit, _flatten,

@@ -59,6 +59,10 @@ class _CsvScanBase(LeafExec):
         self.partition_schema = partition_schema
         self.data_schema = scan_data_schema(schema, partition_schema)
 
+    def size_estimate(self):
+        from spark_rapids_tpu.io.datasource import file_scan_size_estimate
+        return file_scan_size_estimate(self.files)
+
     @property
     def paths(self) -> Tuple[str, ...]:
         return tuple(f.path for f in self.files)

@@ -327,3 +327,14 @@ def fill_file_meta(table: pa.Table, pf: "PartitionedFile",
             pa.field(col, pa.int64(), nullable=False),
             pa.array(np.full(n, val, dtype=np.int64)))
     return table
+
+
+def file_scan_size_estimate(files) -> "int | None":
+    """Sum of on-disk file sizes — the size_estimate every file-scan leaf
+    reports (parquet/orc/csv share it); None when any file is unstat-able
+    (remote path, raced delete)."""
+    import os
+    try:
+        return sum(os.path.getsize(f.path) for f in files)
+    except OSError:
+        return None

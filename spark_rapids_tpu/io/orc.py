@@ -74,6 +74,10 @@ class _OrcScanBase(LeafExec):
         self.max_batch_rows = max_batch_rows
         self.max_batch_bytes = max_batch_bytes
 
+    def size_estimate(self):
+        from spark_rapids_tpu.io.datasource import file_scan_size_estimate
+        return file_scan_size_estimate(self.files)
+
     @property
     def paths(self) -> Tuple[str, ...]:
         return tuple(f.path for f in self.files)

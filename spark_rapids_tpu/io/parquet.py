@@ -275,11 +275,8 @@ class _ParquetScanBase(LeafExec):
         self.max_batch_bytes = max_batch_bytes
 
     def size_estimate(self):
-        import os
-        try:
-            return sum(os.path.getsize(f.path) for f in self.files)
-        except OSError:
-            return None
+        from spark_rapids_tpu.io.datasource import file_scan_size_estimate
+        return file_scan_size_estimate(self.files)
 
     @property
     def paths(self) -> Tuple[str, ...]:

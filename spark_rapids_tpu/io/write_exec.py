@@ -59,6 +59,9 @@ class CpuWriteFilesExec(PhysicalExec):
     """Write command exec: produces no rows; ``stats`` carries the write
     result (GpuDataWritingCommandExec analog)."""
 
+    def size_estimate(self):
+        return 0          # a write command produces no rows
+
     def __init__(self, spec: WriteSpec, child: PhysicalExec):
         super().__init__((child,), Schema([]))
         self.spec = spec

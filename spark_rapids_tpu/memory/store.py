@@ -31,6 +31,11 @@ OUTPUT_BATCH_PRIORITY = 50.0
 #: user-cached DataFrame batches (df.cache()): colder than active working
 #: batches, warmer than shuffle buffers — recomputable, but the user asked
 CACHE_BUFFER_PRIORITY = 25.0
+#: out-of-core grace partitions (memory/grace.py): colder than the cache —
+#: they exist BECAUSE the working set is over budget, so pushing them down
+#: the tiers is the intended behavior — but warmer than shuffle buffers,
+#: which have a catalog lifetime beyond the current operator
+GRACE_PARTITION_PRIORITY = 10.0
 SHUFFLE_BUFFER_PRIORITY = 0.0
 
 
@@ -167,6 +172,16 @@ class BufferStore:
             # here or its backing storage (host arena block) leaks
             self._readmit(buf)
             raise
+        # stamp the tier the buffer ACTUALLY landed on: a host-arena
+        # overflow (HostMemoryStore.add_buffer) closes `moved` and admits
+        # a disk copy instead — it stamps bytes_spilled_to_disk itself, so
+        # counting host bytes here would double-count a buffer that never
+        # resided on host
+        if moved.owner_store is not None:
+            from spark_rapids_tpu.utils import metrics as um
+            um.MEMORY_METRICS[um.MEM_SPILLED_TO_HOST
+                              if moved.tier == StorageTier.HOST
+                              else um.MEM_SPILLED_TO_DISK].add(buf.size_bytes)
         self.catalog.unregister(buf)
         buf.close()
 
@@ -215,6 +230,39 @@ class DeviceMemoryStore(BufferStore):
     jax owns the physical allocator."""
 
     tier = StorageTier.DEVICE
+
+    def __init__(self, catalog: BufferCatalog,
+                 budget_bytes: Optional[int] = None):
+        super().__init__(catalog, budget_bytes)
+        #: budget-pressure callbacks, fn(spilled_bytes): fired whenever this
+        #: tier actually had to push buffers down the chain to make room
+        #: (admission overflow or reactive OOM). Out-of-core operators
+        #: subscribe while staging input (memory/grace.py) so pressure
+        #: caused by ANY query — not just their own working set — flips
+        #: them into the partitioned path. Listener errors are the
+        #: listener's problem; the spill itself already happened.
+        self._pressure_listeners: List = []
+
+    def add_pressure_listener(self, fn) -> None:
+        with self._lock:
+            self._pressure_listeners.append(fn)
+
+    def remove_pressure_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._pressure_listeners:
+                self._pressure_listeners.remove(fn)
+
+    def _notify_pressure(self, spilled_bytes: int) -> None:
+        with self._lock:
+            listeners = list(self._pressure_listeners)
+        for fn in listeners:
+            fn(spilled_bytes)
+
+    def spill_to_size(self, target_bytes: int) -> int:
+        spilled = super().spill_to_size(target_bytes)
+        if spilled > 0:
+            self._notify_pressure(spilled)
+        return spilled
 
     def add_batch(self, buffer_id: BufferId, batch, spill_priority: float = 0.0
                   ) -> SpillableBuffer:
@@ -284,6 +332,20 @@ class HostMemoryStore(BufferStore):
                 over = self._used
             freed = self.spill_to_size(max(over - need, 0)) if over else 0
             if freed == 0:
+                # nothing left to evict and still no contiguous block (the
+                # buffer is bigger than the arena, or concurrent admissions
+                # re-fragmented it between spill and retry): OVERFLOW the
+                # incoming buffer straight to the next tier instead of
+                # failing the cascade — out-of-core completion beats host
+                # staging (docs/out-of-core.md "fits or spills")
+                if self.spill_store is not None:
+                    moved = self._move_down(buf)
+                    self.spill_store.add_buffer(moved)
+                    from spark_rapids_tpu.utils import metrics as um
+                    um.MEMORY_METRICS[um.MEM_SPILLED_TO_DISK].add(
+                        buf.size_bytes)
+                    buf.close()
+                    return
                 raise MemoryError(
                     f"host spill arena exhausted ({need} bytes needed, "
                     f"largest free block {self.arena.largest_free_block})")

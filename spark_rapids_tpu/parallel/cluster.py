@@ -55,6 +55,11 @@ class ClusterShuffleReadExec(LeafExec):
 
     is_device = True
 
+    #: map-output sizes exist only at run time (MapStatus); the stage
+    #: scheduler's AQE coalescing consumes them there, not at plan time
+    size_estimate_none_reason = ("remote map-output sizes are known only "
+                                 "at run time (MapStatus)")
+
     def __init__(self, stage_index: int, output: Schema, num_parts: int):
         super().__init__(output)
         self.stage_index = stage_index
@@ -185,6 +190,11 @@ class ClusterBroadcastReadExec(LeafExec):
     consuming task runs."""
 
     num_partitions = 1
+
+    #: the broadcast batch is built by the driver mid-run; its size is a
+    #: runtime property of another stage's output
+    size_estimate_none_reason = ("broadcast stage output is materialized "
+                                 "at run time by the driver")
 
     def __init__(self, stage_index: int, output: Schema, device: bool):
         super().__init__(output)

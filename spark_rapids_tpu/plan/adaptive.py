@@ -38,6 +38,11 @@ class CustomShuffleReaderExecBase(PhysicalExec):
         super().__init__((exchange,), exchange.output)
         self.specs = specs
 
+    def size_estimate(self):
+        # the exchange's estimate covers ALL partitions; a reader over a
+        # subset is bounded by it (coalesced groups read each id once)
+        return self.children[0].size_estimate()
+
     @property
     def num_partitions(self) -> int:
         return len(self.specs)

@@ -1,0 +1,88 @@
+"""Plan-time footprint contract: predict working sets, choose grace
+partition counts up front.
+
+The planner half of the out-of-core design (memory/grace.py is the runtime
+half): after the overrides/fusion passes built the final physical tree,
+walk it and compare every operator's ``working_set_estimate()`` — the
+declared peak device footprint, ``working_set_factor × Σ child
+size_estimate()`` for the working-set operators — against the device
+budget. An operator predicted over budget gets ``grace_partitions``
+annotated: execution partitions its input immediately instead of
+discovering the pressure reactively mid-stream (the reference's
+GpuOverrides cost-model role applied to memory instead of placement;
+Sparkle's analysis that partition counts chosen from estimates beat
+reactive re-partitioning when stats exist).
+
+Runtime pressure triggers the SAME machinery when the estimate was absent
+(None) or wrong — the annotation is an optimization, never a correctness
+requirement.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.execs.base import PhysicalExec
+
+
+def device_budget_estimate(conf: TpuConf) -> Optional[int]:
+    """The device budget the store chain will enforce, WITHOUT creating a
+    DeviceManager: a live manager's configured budget when one exists,
+    else the same derivation the manager would apply (explicit
+    poolSizeBytes, or allocFraction × detected HBM)."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    dm = DeviceManager.peek()
+    if dm is not None:
+        return dm.device_budget
+    explicit = conf.get(cfg.DEVICE_POOL_BYTES)
+    if explicit:
+        return explicit
+    return int(DeviceManager._detect_hbm_bytes()
+               * conf.get(cfg.DEVICE_POOL_FRACTION))
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 2
+    while p < n:
+        p <<= 1
+    return p
+
+
+def choose_partitions(working_set: int, budget: int, conf: TpuConf) -> int:
+    """Partition count for a predicted-over-budget operator: enough
+    partitions that each one's share of the working set fits the headroom
+    budget with 2x slack for estimate error and skew, power-of-two (the
+    shape-bucket discipline: recursing levels then reuse split programs),
+    clamped to ``memory.outOfCore.maxPartitions``."""
+    headroom = max(int(budget * conf.get(cfg.OOC_HEADROOM)), 1)
+    need = -(-2 * working_set // headroom)          # ceil
+    n = _pow2_at_least(max(need, 2))
+    return max(2, min(n, conf.get(cfg.OOC_MAX_PARTITIONS)))
+
+
+def annotate_out_of_core(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
+    """Annotate ``grace_partitions`` on working-set operators whose
+    footprint estimate exceeds the device budget's headroom fraction.
+    A no-op (and zero plan mutations — program-cache keys stay stable)
+    when everything fits or out-of-core is disabled."""
+    if not conf.get(cfg.OOC_ENABLED):
+        return plan
+    # forcePartitions is a RUNTIME knob (GraceController honors it without
+    # any annotation); with no budget there is nothing to predict against
+    budget = device_budget_estimate(conf)
+    if budget is None:
+        return plan
+    threshold = int(budget * conf.get(cfg.OOC_HEADROOM))
+
+    def visit(node: PhysicalExec) -> PhysicalExec:
+        if not node.is_device:
+            # the contract measures HBM: a CPU-engine operator's working
+            # set lives in host memory and its execute never reads a hint
+            return node
+        ws = node.working_set_estimate()
+        if ws is not None and ws > threshold:
+            node.grace_partitions = choose_partitions(ws, budget, conf)
+        return node
+
+    return plan.transform_up(visit)

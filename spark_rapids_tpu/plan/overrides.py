@@ -776,8 +776,13 @@ class TpuOverrides:
         # the aggregate fold); fuse_device_ops then covers what remains —
         # the CPU engine's fold, and device aggregates when fusion is off
         plan = fuse_device_ops(fuse_stages(converted, self.conf))
-        return mark_encoded_domain(
+        plan = mark_encoded_domain(
             insert_pipeline(insert_transitions(plan), self.conf), self.conf)
+        # footprint contract last: working-set estimates over the FINAL
+        # operator tree (incl. fused aggregates) choose grace partition
+        # counts up front when the plan predicts HBM pressure
+        from spark_rapids_tpu.plan.footprint import annotate_out_of_core
+        return annotate_out_of_core(plan, self.conf)
 
 
 def _enforce_exchange_reuse(root: ExecMeta) -> None:

@@ -77,6 +77,29 @@ TRANSFER_METRIC_NAMES = (
     TRANSFER_DECODED_EQUIV_BYTES, TRANSFER_ENCODED_DOMAIN_OPS,
     TRANSFER_HOST_HOP_BYTES, TRANSFER_EXCHANGE_ENCODED_OPS)
 
+# Out-of-core / memory-pressure counters (process-global like the tiered
+# store they observe; session.last_metrics["memory"] exposes the per-action
+# delta, and per-query handle snapshots carry the same section). The
+# degradation story in one glance: how often operators hit pressure, how
+# many grace partitions they fanned out, how deep the recursion went, and
+# how many bytes each spill tier absorbed.
+#: runtime pressure events that forced an operator into the out-of-core
+#: path (reactive working-set trigger, store pressure callback, injected
+#: allocation failure) — plan-time predicted partitioning does NOT count
+MEM_PRESSURE_EVENTS = "memory.pressure_events"
+#: spillable grace partitions created by out-of-core operators
+MEM_SPILL_PARTITIONS = "memory.spill_partitions"
+#: deepest grace recursion level reached (set_max; re-armed per action)
+MEM_RECURSION_DEPTH = "memory.recursion_depth_peak"
+#: bytes the device tier pushed down to the host tier
+MEM_SPILLED_TO_HOST = "memory.bytes_spilled_to_host"
+#: bytes the host tier pushed down to the disk tier
+MEM_SPILLED_TO_DISK = "memory.bytes_spilled_to_disk"
+
+MEMORY_METRIC_NAMES = (
+    MEM_PRESSURE_EVENTS, MEM_SPILL_PARTITIONS, MEM_RECURSION_DEPTH,
+    MEM_SPILLED_TO_HOST, MEM_SPILLED_TO_DISK)
+
 # Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
 # unlike the per-operator MetricSets — which live on per-action plan nodes —
 # and the process-global transfer counters, these are scoped to ONE query
@@ -163,6 +186,35 @@ class MetricSet:
 
 #: process-global transfer counters (see TRANSFER_METRIC_NAMES above)
 TRANSFER_METRICS = MetricSet(*TRANSFER_METRIC_NAMES)
+
+#: process-global memory-pressure counters (see MEMORY_METRIC_NAMES above)
+MEMORY_METRICS = MetricSet(*MEMORY_METRIC_NAMES)
+
+
+def memory_snapshot() -> Dict[str, float]:
+    """Action-start marker for ``memory_delta``. Re-arms the recursion-depth
+    high-water mark so the delta reports THIS action's peak. Process-global
+    like the transfer inflight peak: under CONCURRENT out-of-core queries a
+    later action's re-arm can absorb part of an overlapping action's peak —
+    the same documented overlap caveat as the transfer section
+    (api/dataframe.py); additive counters are unaffected."""
+    snap = MEMORY_METRICS.snapshot()
+    MEMORY_METRICS[MEM_RECURSION_DEPTH].reset()
+    return snap
+
+
+def memory_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-action out-of-core stats: counter deltas since ``before`` (the
+    recursion-depth peak is the high-water mark since the matching
+    memory_snapshot call)."""
+    now = MEMORY_METRICS.snapshot()
+    out: Dict[str, float] = {}
+    for name in MEMORY_METRIC_NAMES:
+        if name == MEM_RECURSION_DEPTH:
+            out[name] = now[name]
+            continue
+        out[name] = now[name] - before.get(name, 0)
+    return out
 
 
 def transfer_snapshot() -> Dict[str, float]:

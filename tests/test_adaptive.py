@@ -58,11 +58,24 @@ def test_reader_respects_advisory_size():
 def test_dynamic_broadcast_join_switch():
     t = table()
 
+    rt_t = pa.table({"b": pa.array(np.arange(100) % 7, type=pa.int64()),
+                     "n": pa.array(np.arange(100), type=pa.int64())})
+
+    # The build side's STATIC estimate cannot see the filter's
+    # selectivity (PR 11 size_estimate audit: a filter passes its child
+    # through as an upper bound, ~1.6 KB here), so with this threshold
+    # static planning keeps the shuffled join; the OBSERVED materialized
+    # exchange (30 filtered rows, ~480 B) sits below it, so only AQE's
+    # runtime statistics can legally broadcast — the exact
+    # estimate-vs-observation gap the switch exists for.
+    threshold = {"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes":
+                     "1000"}
+
     def run(conf):
-        s = TpuSession(conf)
+        s = TpuSession({**threshold, **conf})
         lt = s.create_dataframe(t).repartition(4, "b")
-        rt = (s.create_dataframe(t).repartition(3, "b")
-              .groupBy("b").agg(F.count().alias("n")))
+        rt = (s.create_dataframe(rt_t).filter(F.col("n") < 30)
+              .repartition(3, "b"))
         return lt.join(rt, "b").sort("b", "a").collect(), s
 
     aqe_res, s_aqe = run(AQE)
@@ -74,6 +87,22 @@ def test_dynamic_broadcast_join_switch():
     ref, s_ref = run({})
     assert "TpuShuffledHashJoinExec" in s_ref.last_plan.tree_string()
     assert_tables_equal(ref, aqe_res)
+
+
+def test_static_broadcast_from_audited_estimates():
+    """PR 11: the size_estimate audit gave aggregates/exchanges real
+    upper bounds, so a build side KNOWN small at plan time broadcasts
+    statically — no AQE needed (the Spark statistics-driven
+    autoBroadcastJoinThreshold behavior)."""
+    t = table()
+    s = TpuSession()
+    lt = s.create_dataframe(t).repartition(4, "b")
+    rt = (s.create_dataframe(t).repartition(3, "b")
+          .groupBy("b").agg(F.count().alias("n")))
+    out = lt.join(rt, "b").sort("b", "a").collect()
+    plan = s.last_plan.tree_string()
+    assert "TpuBroadcastHashJoinExec" in plan, plan
+    assert out.num_rows == 100
 
 
 def test_broadcast_switch_respects_threshold():

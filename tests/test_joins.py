@@ -103,20 +103,23 @@ def test_nested_loop_join_enabled():
 
 
 def test_cartesian_product_enabled():
-    """Sides with unknown size estimates go through CartesianProductExec."""
+    """Keyless joins whose sides cannot broadcast go through
+    CartesianProductExec. Since the PR 11 size_estimate audit, aggregates
+    report a real upper bound (so they CAN broadcast by default); pinning
+    the threshold to 0 recreates the no-broadcastable-side case."""
     from spark_rapids_tpu.api import TpuSession, functions as F
     lt = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
     rt = pa.table({"b": pa.array([10, 20], type=pa.int64())})
 
     def build(s):
-        # aggregates have unknown output size -> no broadcast -> cartesian
         left = s.create_dataframe(lt).groupBy("a").agg(F.count().alias("n"))
         right = s.create_dataframe(rt).groupBy("b").agg(F.count().alias("m"))
         return left.crossJoin(right)
 
     cpu = assert_tpu_and_cpu_equal(
         build, ignore_order=True,
-        conf={"spark.rapids.tpu.sql.exec.CartesianProduct": "true"},
+        conf={"spark.rapids.tpu.sql.exec.CartesianProduct": "true",
+              "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "0"},
         expect_tpu_execs=["TpuCartesianProductExec"])
     assert cpu.num_rows == 6
 

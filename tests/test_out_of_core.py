@@ -1,0 +1,604 @@
+"""Out-of-core operators: grace partitioning, footprint contract, faults.
+
+Covers the degradation model (docs/out-of-core.md):
+- size_estimate audit: every PhysicalExec subclass returns a real estimate
+  or documents WHY None (the contract the footprint planner consumes);
+- forced / predicted / reactive / fault-injected partitioning for hash
+  aggregate, hash join and sort, each bit-identical to the single-pass run;
+- recursion under a tiny budget stays bounded and completes;
+- dictionary encodings and f64 bits siblings survive the partition split;
+- the store's pressure callbacks and spilled-bytes-per-tier counters;
+- observability: session.last_metrics["memory"] + per-query snapshots;
+- the hot path stays untouched when everything fits.
+"""
+import importlib
+import pkgutil
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.memory import faults as mfaults
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.testing import assert_tables_equal
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.hasNans": "false",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+}
+
+TINY_BUDGET = {
+    "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(256 << 10),
+    "spark.rapids.tpu.memory.host.spillStorageSize": str(256 << 10),
+    "spark.rapids.tpu.sql.scanCache.enabled": "false",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory_state():
+    """Each test gets a fresh DeviceManager (budget confs differ wildly)
+    and a fresh fault-plan schedule."""
+    DeviceManager.shutdown()
+    mfaults.reset_plans()
+    yield
+    DeviceManager.shutdown()
+    mfaults.reset_plans()
+
+
+def make_table(n=40000, seed=0, groups=64):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, groups, n).astype("int64"),
+        "v": rng.integers(0, 1000, n).astype("int64"),
+        "d": np.round(rng.random(n), 6),
+    })
+
+
+def agg_df(sess, table):
+    return (sess.create_dataframe(table).groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count(F.lit(1)).alias("c"),
+                 F.sum("d").alias("sd")))
+
+
+def assert_agg_equal(ref, got):
+    """Integer keys/sums/counts bitwise; the variableFloatAgg double sum to
+    1e-9 relative — the partitioned reduction runs at a different capacity
+    bucket, so its XLA reduction tree (and last-ulp rounding) legitimately
+    differs, exactly the mesh distributed-float-sum contract
+    (docs/mesh-execution.md, docs/out-of-core.md)."""
+    assert_tables_equal(ref.select(["k", "sv", "c"]),
+                        got.select(["k", "sv", "c"]), ignore_order=True)
+    assert_tables_equal(ref, got, ignore_order=True, approx_float=1e-9)
+
+
+def mem_metrics(sess):
+    return sess.last_metrics.get("memory", {})
+
+
+# --------------------------------------------------------- size_estimate audit
+def _all_exec_classes():
+    import spark_rapids_tpu
+    from spark_rapids_tpu.execs.base import PhysicalExec
+    for pkg in ("execs", "io", "plan", "parallel", "memory"):
+        mod = importlib.import_module(f"spark_rapids_tpu.{pkg}")
+        for info in pkgutil.iter_modules(mod.__path__):
+            importlib.import_module(f"spark_rapids_tpu.{pkg}.{info.name}")
+
+    def subs(cls):
+        out = set()
+        for sc in cls.__subclasses__():
+            out.add(sc)
+            out |= subs(sc)
+        return out
+    # the contract binds the ENGINE's classes; test modules define throwaway
+    # exec subclasses (fixtures) that are out of scope
+    return {c for c in subs(PhysicalExec)
+            if c.__module__.startswith("spark_rapids_tpu.")}
+
+
+def test_size_estimate_contract_every_exec_class():
+    """Every exec class defines size_estimate below PhysicalExec in its MRO
+    or carries a non-empty size_estimate_none_reason — the footprint
+    contract the out-of-core planner consumes. LeafExec is the one
+    exempted pure-abstract base: concrete leaves must declare their own
+    (scan file sizes, range row counts), and a new leaf that forgets
+    fails here."""
+    from spark_rapids_tpu.execs.base import LeafExec, PhysicalExec
+    violations = []
+    for cls in _all_exec_classes():
+        if cls is LeafExec:
+            continue
+        defined = any("size_estimate" in k.__dict__
+                      for k in cls.__mro__ if k is not PhysicalExec)
+        reason = getattr(cls, "size_estimate_none_reason", None)
+        if not defined and not (isinstance(reason, str) and reason.strip()):
+            violations.append(f"{cls.__module__}.{cls.__name__}")
+    assert not violations, (
+        "exec classes missing a size_estimate or a documented None reason: "
+        f"{sorted(violations)}")
+
+
+def test_size_estimates_sane_on_simple_plan():
+    sess = TpuSession(BASE_CONF)
+    table = make_table(2000)
+    df = agg_df(sess, table)
+    plan = df._executed_plan()
+    est = plan.size_estimate()
+    assert est is not None and 0 < est < 10 * table.nbytes
+
+    def walk(node):
+        yield node
+        for c in node.children:
+            yield from walk(c)
+    ws = [n.working_set_estimate() for n in walk(plan)]
+    assert any(w is not None and w > 0 for w in ws), \
+        "no working-set operator declared a footprint"
+
+
+# --------------------------------------------------------- forced partitioning
+def test_forced_partitions_aggregate_bit_identical():
+    table = make_table()
+    ref = agg_df(TpuSession(BASE_CONF), table).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    got = agg_df(sess, table).collect()
+    assert_agg_equal(ref, got)
+    mm = mem_metrics(sess)
+    assert mm["memory.spill_partitions"] == 4, mm
+    assert mm["memory.recursion_depth_peak"] >= 1, mm
+
+
+def test_forced_partitions_join_bit_identical():
+    rng = np.random.default_rng(3)
+    left = make_table(20000, seed=1)
+    right = pa.table({"k": rng.integers(0, 64, 4000).astype("int64"),
+                      "w": rng.integers(0, 9, 4000).astype("int64")})
+    def q(sess):
+        return (sess.create_dataframe(left)
+                .join(sess.create_dataframe(right), on="k")
+                .groupBy("k").agg(F.count(F.lit(1)).alias("c"),
+                                  F.sum("w").alias("sw")))
+    ref = q(TpuSession(BASE_CONF)).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    got = q(sess).collect()
+    assert_tables_equal(ref, got, ignore_order=True)
+    assert mem_metrics(sess)["memory.spill_partitions"] >= 8
+
+
+@pytest.mark.parametrize("how", ["left", "right", "left_semi", "left_anti"])
+def test_forced_partitions_join_types(how):
+    """Outer/semi/anti joins: unmatched-ness is decided inside a partition
+    because BOTH sides of a key hash to the same one (nulls included)."""
+    left = pa.table({"k": pa.array([1, 2, 2, None, 5, 6] * 50,
+                                   type=pa.int64()),
+                     "v": pa.array(list(range(300)), type=pa.int64())})
+    right = pa.table({"k": pa.array([2, 3, None, 6] * 30, type=pa.int64()),
+                      "w": pa.array(list(range(120)), type=pa.int64())})
+    def q(sess):
+        return (sess.create_dataframe(left)
+                .join(sess.create_dataframe(right), on="k", how=how))
+    ref = q(TpuSession(BASE_CONF)).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    got = q(sess).collect()
+    assert_tables_equal(ref, got, ignore_order=True)
+
+
+def test_forced_partitions_sort_exact_order():
+    table = make_table(30000, seed=2)
+    def q(sess):
+        return (sess.create_dataframe(table)
+                .sort("k", F.col("v").desc(), "d"))
+    ref = q(TpuSession(BASE_CONF)).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    got = q(sess).collect()
+    # STRICT order: the external sort's bound-ordered emission must equal
+    # the single-pass stable sort bit-for-bit
+    assert ref.equals(got)
+    assert mem_metrics(sess)["memory.spill_partitions"] >= 4
+
+
+def test_forced_partitions_sort_with_nulls():
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 50, 5000).astype("float64")
+    mask = rng.random(5000) < 0.1
+    table = pa.table({"k": pa.array([v if not m else None
+                                     for v, m in zip(vals, mask)],
+                                    type=pa.float64()),
+                      "r": pa.array(list(range(5000)), type=pa.int64())})
+    def q(sess):
+        return sess.create_dataframe(table).sort("k", "r")
+    ref = q(TpuSession(BASE_CONF)).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    got = q(sess).collect()
+    assert ref.equals(got)
+
+
+def test_forced_partitions_empty_input():
+    empty = make_table(0)
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "4"})
+    assert agg_df(sess, empty).collect().num_rows == 0
+    assert sess.create_dataframe(empty).sort("k").collect().num_rows == 0
+
+
+# ----------------------------------------------------- predicted (plan hints)
+def test_tiny_budget_predicts_partitioning_and_spills():
+    table = make_table(60000)
+    ref = agg_df(TpuSession(BASE_CONF), table).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF, **TINY_BUDGET})
+    got = agg_df(sess, table).collect()
+    assert_agg_equal(ref, got)
+    mm = mem_metrics(sess)
+    assert mm["memory.spill_partitions"] >= 2, mm
+    assert mm["memory.bytes_spilled_to_host"] > 0, mm
+
+    def walk(node):
+        yield node
+        for c in node.children:
+            yield from walk(c)
+    assert any(getattr(n, "grace_partitions", 0) > 0
+               for n in walk(sess.last_plan)), \
+        "planner did not annotate grace_partitions under a tiny budget"
+
+
+def test_tiny_budget_sort_exact_and_recursion_bounded():
+    table = make_table(60000, seed=7)
+    ref = TpuSession(BASE_CONF).create_dataframe(table) \
+        .sort("k", "v", "d").collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF, **TINY_BUDGET,
+                       "spark.rapids.tpu.memory.outOfCore."
+                       "maxRecursionDepth": "3"})
+    got = sess.create_dataframe(table).sort("k", "v", "d").collect()
+    assert ref.equals(got)
+    mm = mem_metrics(sess)
+    assert 1 <= mm["memory.recursion_depth_peak"] <= 3, mm
+
+
+def test_footprint_pass_no_hints_with_ample_budget():
+    sess = TpuSession(BASE_CONF)
+    df = agg_df(sess, make_table(2000))
+    plan = df._executed_plan()
+
+    def walk(node):
+        yield node
+        for c in node.children:
+            yield from walk(c)
+    assert all(getattr(n, "grace_partitions", 0) == 0 for n in walk(plan))
+
+
+def test_choose_partitions_scales_and_clamps():
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.plan.footprint import choose_partitions
+    conf = TpuConf()
+    assert choose_partitions(1 << 20, 1 << 30, conf) == 2
+    n = choose_partitions(1 << 30, 1 << 24, conf)
+    assert n >= 64 and n & (n - 1) == 0          # pow2
+    assert choose_partitions(1 << 40, 1 << 20, conf) == 256  # clamped
+
+
+def test_degenerate_split_stops_recursion():
+    """ONE key group exceeds the budget: no hash depth can split it, so
+    after one degenerate probe the partition runs single-pass instead of
+    burning the whole depth budget on re-splits."""
+    n = 60000
+    table = pa.table({"k": np.ones(n, dtype="int64"),
+                      "v": np.arange(n, dtype="int64")})
+    ref = TpuSession(BASE_CONF).create_dataframe(table) \
+        .groupBy("k").agg(F.sum("v").alias("sv")).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF, **TINY_BUDGET})
+    got = sess.create_dataframe(table).groupBy("k") \
+        .agg(F.sum("v").alias("sv")).collect()
+    assert_tables_equal(ref, got)
+    mm = mem_metrics(sess)
+    # initial split + at most one degenerate probe level
+    assert mm["memory.recursion_depth_peak"] <= 2, mm
+
+
+# ------------------------------------------------------------ fault injection
+def test_alloc_fail_forces_reactive_path():
+    table = make_table()
+    ref = agg_df(TpuSession(BASE_CONF), table).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.faults.plan":
+                           "alloc_fail:op=agg,after=1"})
+    got = agg_df(sess, table).collect()
+    assert_agg_equal(ref, got)
+    mm = mem_metrics(sess)
+    assert mm["memory.pressure_events"] >= 1, mm
+    assert mm["memory.spill_partitions"] >= 2, mm
+    plan = mfaults.plan_for_conf(sess.conf)
+    assert ("alloc_fail", "agg", 1) in plan.fired
+
+
+@pytest.mark.parametrize("op,build", [
+    ("join", lambda s, t: (s.create_dataframe(t)
+                           .join(s.create_dataframe(t.slice(0, 2000)
+                                                    .select(["k"])),
+                                 on="k")
+                           .groupBy("k").count())),
+    ("sort", lambda s, t: s.create_dataframe(t).sort("k", "v")),
+])
+def test_alloc_fail_other_operators(op, build):
+    table = make_table(12000, seed=11)
+    ref = build(TpuSession(BASE_CONF), table).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.faults.plan":
+                           f"alloc_fail:op={op},after=1"})
+    got = build(sess, table).collect()
+    if op == "sort":
+        assert ref.equals(got)
+    else:
+        assert_tables_equal(ref, got, ignore_order=True)
+    assert any(f[0] == "alloc_fail" and f[1] == op
+               for f in mfaults.plan_for_conf(sess.conf).fired)
+
+
+def test_budget_clamp_shrinks_effective_budget():
+    table = make_table(60000)
+    ref = agg_df(TpuSession(BASE_CONF), table).collect()
+    DeviceManager.shutdown()
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.sql.scanCache.enabled": "false",
+                       "spark.rapids.tpu.memory.faults.plan":
+                           "budget_clamp:fraction=0.0001,count=0"})
+    got = agg_df(sess, table).collect()
+    assert_agg_equal(ref, got)
+    assert mem_metrics(sess)["memory.pressure_events"] >= 1
+
+
+def test_fault_plan_deterministic_replay():
+    spec_text = "alloc_fail:op=agg,after=2,count=2"
+    a = mfaults.MemoryFaultPlan.parse(spec_text, seed=9)
+    b = mfaults.MemoryFaultPlan.parse(spec_text, seed=9)
+    for plan in (a, b):
+        for _ in range(5):
+            plan.on_admission("agg")
+        plan.on_admission("sort")       # separate per-op counter
+    assert a.fired == b.fired
+    assert a.fired == [("alloc_fail", "agg", 2), ("alloc_fail", "agg", 3)]
+
+
+def test_fault_plan_parse_errors():
+    with pytest.raises(ValueError, match="unknown memory fault kind"):
+        mfaults.MemoryFaultSpec.parse("explode:op=agg")
+    with pytest.raises(ValueError, match="unknown op"):
+        mfaults.MemoryFaultSpec.parse("alloc_fail:op=window")
+    with pytest.raises(ValueError, match="unknown memory fault key"):
+        mfaults.MemoryFaultSpec.parse("alloc_fail:nope=1")
+    with pytest.raises(ValueError, match="fraction"):
+        mfaults.MemoryFaultSpec.parse("budget_clamp:fraction=1.5")
+
+
+def test_budget_clamp_probe_math():
+    # a bare clamp is SUSTAINED (count defaults to 0 = every read)
+    plan = mfaults.MemoryFaultPlan.parse("budget_clamp:fraction=0.25")
+    assert plan.clamp_budget("agg", 1 << 20) == 1 << 18
+    assert plan.clamp_budget("agg", 1 << 20) == 1 << 18
+    plan2 = mfaults.MemoryFaultPlan.parse(
+        "budget_clamp:fraction=0.5,after=2,count=1")
+    assert plan2.clamp_budget("agg", 100) == 100      # window not open yet
+    assert plan2.clamp_budget("agg", 100) == 50
+    assert plan2.clamp_budget("agg", 100) == 100      # window closed
+
+
+# ------------------------------------------------------- carriers + internals
+def _encoded_batch():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+    from spark_rapids_tpu.columnar.encoding import DictEncoding
+    n, cap = 100, 128
+    idx = np.arange(n, dtype=np.int32) % 4
+    values = jnp.asarray(np.array([10, 20, 30, 40], dtype=np.int64))
+    data = jnp.asarray(np.array([10, 20, 30, 40], dtype=np.int64)[idx])
+    pad = np.zeros(cap - n, dtype=np.int64)
+    data = jnp.concatenate([data, jnp.asarray(pad)])
+    validity = jnp.asarray(np.arange(cap) < n)
+    indices = jnp.concatenate([jnp.asarray(idx),
+                               jnp.zeros(cap - n, jnp.int32)])
+    enc = DictEncoding(indices, values, 4, None, token="t-test")
+    col = DeviceColumn(DType.LONG, data, validity, encoding=enc)
+    key = DeviceColumn(
+        DType.LONG,
+        jnp.concatenate([jnp.asarray(np.arange(n, dtype=np.int64) % 8),
+                         jnp.asarray(pad)]), validity)
+    schema = Schema([Field("g", DType.LONG, False),
+                     Field("e", DType.LONG, False)])
+    return DeviceBatch(schema, (key, col), n)
+
+
+def test_split_carries_dictionary_encoding():
+    from spark_rapids_tpu.execs.base import ExecContext
+    from spark_rapids_tpu.exprs.core import BoundReference
+    from spark_rapids_tpu.columnar.dtypes import DType
+    from spark_rapids_tpu.memory import grace
+    batch = _encoded_batch()
+    ctx = ExecContext()
+    keys = (BoundReference(0, DType.LONG, False),)
+    pieces = list(grace.split_batch(ctx, batch, keys, 4, depth=0))
+    assert len(pieces) >= 2
+    total = 0
+    for _pid, piece in pieces:
+        enc = piece.columns[1].encoding
+        assert enc is not None and enc.token == "t-test"
+        # invariant: data == values[indices] for live rows
+        d = np.asarray(piece.columns[1].data)[:piece.num_rows]
+        i = np.asarray(enc.indices)[:piece.num_rows]
+        assert (d == np.asarray(enc.values)[i]).all()
+        total += piece.num_rows
+    assert total == batch.num_rows
+
+
+def test_split_carries_double_bits():
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.execs.base import ExecContext
+    from spark_rapids_tpu.exprs.core import BoundReference
+    from spark_rapids_tpu.columnar.dtypes import DType
+    from spark_rapids_tpu.memory import grace
+    rng = np.random.default_rng(0)
+    table = pa.table({"k": rng.integers(0, 8, 200).astype("int64"),
+                      "x": rng.random(200)})
+    batch = DeviceBatch.from_arrow(table, 16)
+    assert batch.columns[1].bits is not None
+    ctx = ExecContext()
+    keys = (BoundReference(0, DType.LONG, False),)
+    out_rows = 0
+    for _pid, piece in grace.split_batch(ctx, batch, keys, 4, depth=0):
+        c = piece.columns[1]
+        assert c.bits is not None
+        live = np.asarray(c.bits)[:piece.num_rows]
+        assert (live.view(np.float64)
+                == np.asarray(c.data)[:piece.num_rows]).all()
+        out_rows += piece.num_rows
+    assert out_rows == batch.num_rows
+
+
+def test_depth_salt_redistributes():
+    """Keys that collide mod n at depth 0 spread at depth 1 — the property
+    that makes fan-out recursion converge."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.execs.exchange_execs import hash_partition_ids
+    from spark_rapids_tpu.exprs.core import ColV
+    from spark_rapids_tpu.columnar.dtypes import DType
+    from spark_rapids_tpu.memory.grace import _depth_seed
+    keys = [ColV(DType.LONG, jnp.arange(4096, dtype=jnp.int64),
+                 jnp.ones(4096, bool))]
+    p0 = np.asarray(hash_partition_ids(jnp, keys, 4096, 8,
+                                       seed=_depth_seed(0)))
+    p1 = np.asarray(hash_partition_ids(jnp, keys, 4096, 8,
+                                       seed=_depth_seed(1)))
+    sub = p1[p0 == 0]
+    assert len(np.unique(sub)) >= 4, "deeper hash did not redistribute"
+
+
+def test_store_pressure_listener_fires():
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId
+    from spark_rapids_tpu.memory.store import (BufferCatalog,
+                                               build_store_chain)
+    catalog = BufferCatalog()
+    device, host, disk = build_store_chain(catalog, 64 << 10, 1 << 20)
+    events = []
+    device.add_pressure_listener(events.append)
+    tab = pa.table({"x": np.arange(4096, dtype="int64")})
+    for i in range(4):
+        device.add_batch(BufferId(1 << 28, i),
+                         DeviceBatch.from_arrow(tab, 16), float(i))
+    assert events and sum(events) > 0
+    device.remove_pressure_listener(events.append)
+    n = len(events)
+    device.add_batch(BufferId(1 << 28, 99), DeviceBatch.from_arrow(tab, 16),
+                     99.0)
+    assert len(events) == n          # unsubscribed
+    for s in (device, host, disk):
+        s.close()
+
+
+def test_spilled_bytes_by_tier_counters():
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId
+    from spark_rapids_tpu.memory.store import (BufferCatalog,
+                                               build_store_chain)
+    from spark_rapids_tpu.utils import metrics as um
+    before_h = um.MEMORY_METRICS[um.MEM_SPILLED_TO_HOST].value
+    before_d = um.MEMORY_METRICS[um.MEM_SPILLED_TO_DISK].value
+    catalog = BufferCatalog()
+    device, host, disk = build_store_chain(catalog, 48 << 10, 48 << 10)
+    tab = pa.table({"x": np.arange(4096, dtype="int64")})
+    for i in range(6):
+        device.add_batch(BufferId(1 << 28, i),
+                         DeviceBatch.from_arrow(tab, 16), float(i))
+    assert um.MEMORY_METRICS[um.MEM_SPILLED_TO_HOST].value > before_h
+    assert um.MEMORY_METRICS[um.MEM_SPILLED_TO_DISK].value > before_d
+    for s in (device, host, disk):
+        s.close()
+
+
+def test_host_arena_overflow_lands_on_disk():
+    """A buffer the host arena cannot hold (bigger than the whole arena, or
+    the arena re-fragmented under concurrency) overflows straight to the
+    disk tier instead of failing the spill cascade — out-of-core
+    completion beats host staging."""
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId, StorageTier
+    from spark_rapids_tpu.memory.store import (BufferCatalog,
+                                               build_store_chain)
+    catalog = BufferCatalog()
+    # host arena (8 KB) is smaller than ONE spilled batch (~36 KB)
+    device, host, disk = build_store_chain(catalog, 16 << 10, 8 << 10)
+    tab = pa.table({"x": np.arange(4096, dtype="int64")})
+    ids = [BufferId(1 << 28, i) for i in range(3)]
+    for i, bid in enumerate(ids):
+        device.add_batch(bid, DeviceBatch.from_arrow(tab, 16), float(i))
+    assert len(disk) >= 1, "overflow never reached the disk tier"
+    for bid in ids:          # every buffer still acquirable and intact
+        buf = catalog.acquire(bid)
+        assert buf is not None
+        try:
+            assert buf.get_batch().num_rows == 4096
+        finally:
+            buf.close()
+    for s in (device, host, disk):
+        s.close()
+
+
+# ------------------------------------------------------------- observability
+def test_memory_section_in_last_metrics_and_handle():
+    from spark_rapids_tpu.utils.metrics import MEMORY_METRIC_NAMES
+    table = make_table(8000)
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore.forcePartitions":
+                           "2"})
+    h = sess.submit(agg_df(sess, table))
+    h.result(timeout=300)
+    mm = sess.last_metrics["memory"]
+    for name in MEMORY_METRIC_NAMES:
+        assert name in mm, mm
+    assert mm["memory.spill_partitions"] >= 2
+    snap = h.snapshot()
+    exec_mm = h.exec_metrics.get("memory")
+    assert exec_mm and exec_mm["memory.spill_partitions"] >= 2, snap
+
+
+def test_hot_path_untouched_with_ample_budget():
+    table = make_table(8000)
+    sess = TpuSession(BASE_CONF)
+    agg_df(sess, table).collect()
+    mm = mem_metrics(sess)
+    assert mm["memory.pressure_events"] == 0, mm
+    assert mm["memory.spill_partitions"] == 0, mm
+    assert mm["memory.recursion_depth_peak"] == 0, mm
+
+
+def test_no_buffer_leaks_after_out_of_core_query():
+    table = make_table(60000)
+    sess = TpuSession({**BASE_CONF, **TINY_BUDGET})
+    dm = DeviceManager.initialize(sess.conf)
+    ids_before = set(dm.catalog.ids())
+    agg_df(sess, table).collect()
+    sess.create_dataframe(table).sort("k", "v").collect()
+    assert set(dm.catalog.ids()) == ids_before, \
+        "grace partition buffers leaked past the query"

@@ -752,6 +752,82 @@ def test_store_concurrency_under_running_query():
     DeviceManager.shutdown()
 
 
+def test_out_of_core_spill_under_concurrency():
+    """PR 11 extension of the 8-thread hammer: grace-PARTITIONED operators
+    spill through the tiered store while other queries run and hammer
+    threads churn the catalog — no exceptions, no buffer leaks (every
+    grace partition/spill copy released), and results identical to the
+    ample-budget single-pass run."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.memory.store import INPUT_BATCH_PRIORITY
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    rng = np.random.default_rng(0)
+    big = pa.table({"k": rng.integers(0, 32, 40000).astype("int64"),
+                    "v": rng.integers(0, 1000, 40000).astype("int64")})
+
+    def q(s):
+        return (s.create_dataframe(big).groupBy("k")
+                .agg(F.sum("v").alias("s"), F.count(F.lit(1)).alias("c")))
+
+    DeviceManager.shutdown()
+    expected = q(make_session()).collect()
+    DeviceManager.shutdown()
+    sess = make_session({
+        # tiny budget: the aggregate grace-partitions and its partitions
+        # spill device -> host -> disk while everything else runs
+        "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(256 << 10),
+        "spark.rapids.tpu.memory.host.spillStorageSize": str(256 << 10),
+        "spark.rapids.tpu.sql.scanCache.enabled": "false"})
+    dm = DeviceManager.initialize(sess.conf)
+    ids_before = set(dm.catalog.ids())
+    tab = pa.table({"x": np.arange(512, dtype="int64")})
+    errors = []
+
+    def hammer(tid):
+        try:
+            prng = np.random.default_rng(tid)
+            mine = []
+            for i in range(10):
+                bid = BufferId(tid, i)
+                dm.device_store.add_batch(bid, DeviceBatch.from_arrow(tab, 16),
+                                          INPUT_BATCH_PRIORITY)
+                mine.append(bid)
+                probe = mine[int(prng.integers(0, len(mine)))]
+                buf = dm.catalog.acquire(probe)
+                if buf is not None:
+                    buf.close()
+                if prng.random() < 0.3 and len(mine) > 1:
+                    dm.catalog.remove(mine.pop(0))
+            for bid in mine:
+                dm.catalog.remove(bid)
+        except Exception as e:          # noqa: BLE001 - asserted below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=((1 << 27) + t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    handles = [sess.submit(q(sess)) for _ in range(3)]
+    outs = [h.result(timeout=300) for h in handles]
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    for h, out in zip(handles, outs):
+        assert h.state is QueryState.DONE
+        assert_tables_equal(expected, out, ignore_order=True,
+                            approx_float=1e-9)
+        mm = h.exec_metrics.get("memory", {})
+        assert mm.get("memory.spill_partitions", 0) >= 2, mm
+    assert set(dm.catalog.ids()) == ids_before, \
+        "out-of-core partitions leaked under concurrency"
+    assert dm.semaphore.active_holders == 0
+    DeviceManager.shutdown()
+
+
 def test_scheduler_shutdown_cancels_queued():
     sess = make_session({
         "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
