@@ -155,6 +155,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
     # ---- concurrent query serving (scheduler + cross-query program cache) ---
     concurrent = _bench_concurrent(table, conf, scale)
 
+    # ---- network serving (wire streaming + preemption p99) ------------------
+    serving_net = _bench_serving_net(table, conf, scale)
+
     # ---- out-of-core degradation (ample vs 1/4 budget) ----------------------
     out_of_core = _bench_out_of_core(table, conf, scale)
 
@@ -202,6 +205,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
             "compression": compression,
             "fusion": fusion,
             "concurrent": concurrent,
+            "serving_net": serving_net,
             "out_of_core": out_of_core,
             "mesh": mesh_section,
             "end_to_end_collect_s": round(e2e_s, 4),
@@ -532,6 +536,103 @@ def _logical_bytes(batch) -> int:
         if c.lengths is not None:
             total += c.lengths.size * 4
     return total
+
+
+def _bench_serving_net(table, conf: dict, scale: float) -> dict:
+    """Network-native serving: wire streaming over TCP localhost (Arrow
+    IPC frames through the shuffle transport, >= 1 partial batch before
+    DONE, bit-identical assembly) and the preemption lever — one whale +
+    interactive tenants on a single device permit, interactive
+    submit-to-done p99 with batch-granularity preemption ON vs OFF, the
+    whale completing with identical results both ways."""
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.serving.client import QueryServiceClient
+    from spark_rapids_tpu.serving.server import QueryServer
+    from spark_rapids_tpu.utils import metrics as um
+    from spark_rapids_tpu.utils.metrics import percentile
+
+    # ---- wire streaming over localhost -------------------------------------
+    sess = TpuSession(conf)
+    (sess.create_dataframe(table).repartition(4)
+     .createOrReplaceTempView("lineitem"))
+    server = QueryServer(sess)
+    host, port = server.address
+    client = QueryServiceClient([f"{host}:{port}"], TpuConf(conf))
+    sql = ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+           "WHERE l_discount > 0.05")
+    ref = sess.sql(sql).collect()
+    bytes_before = um.SERVING_METRICS[um.SERVING_WIRE_BYTES_OUT].value
+    t0 = time.perf_counter()
+    handle = client.submit(sql)
+    got = handle.result()
+    wire_wall = time.perf_counter() - t0
+    wire_bytes = (um.SERVING_METRICS[um.SERVING_WIRE_BYTES_OUT].value
+                  - bytes_before)
+    stream_ok = got.equals(ref)
+    first_before_done = (handle.metrics["first_batch_s"]
+                         < handle.metrics["wall_s"])
+    stream_batches = handle.batches_delivered
+    client.close()
+    server.shutdown()
+    sess.scheduler.shutdown(wait=False)
+
+    # ---- preemption: whale + interactive p99 --------------------------------
+    whale_rows = min(table.num_rows, 400_000)
+    whale_table = table.slice(0, whale_rows)
+    inter_table = table.slice(0, min(table.num_rows, 2_000))
+
+    def run_mode(preempt: bool):
+        DeviceManager.shutdown()
+        s = TpuSession({
+            **conf,
+            "spark.rapids.tpu.sql.concurrentTpuTasks": "1",
+            "spark.rapids.tpu.serving.maxConcurrentQueries": "4",
+            "spark.rapids.tpu.serving.preemption.enabled":
+                str(preempt).lower(),
+            "spark.rapids.tpu.serving.preemption.starvationMs": "30"})
+        whale_df = (s.create_dataframe(whale_table).repartition(16)
+                    .groupBy("l_returnflag")
+                    .agg(F.sum("l_extendedprice").alias("rev"))
+                    .sort("l_returnflag"))
+        inter_df = (s.create_dataframe(inter_table)
+                    .groupBy("l_linestatus")
+                    .agg(F.sum("l_quantity").alias("q"))
+                    .sort("l_linestatus"))
+        ref_whale = whale_df.collect()          # warm compiles
+        inter_df.collect()
+        wh = s.submit(whale_df, tenant="whale", label="whale")
+        time.sleep(0.2)                         # whale takes the permit
+        walls = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            ih = s.submit(inter_df, tenant="interactive", label=f"i{i}")
+            ih.result(timeout=600)
+            walls.append(time.perf_counter() - t0)
+        whale_ok = wh.result(timeout=600).equals(ref_whale)
+        preempts = wh.metrics["preemptions"]
+        s.scheduler.shutdown(wait=False)
+        return sorted(walls), preempts, whale_ok
+
+    off_walls, _off_p, off_ok = run_mode(False)
+    on_walls, preemptions, on_ok = run_mode(True)
+    DeviceManager.shutdown()
+    off_p99 = percentile(off_walls, 99)
+    on_p99 = percentile(on_walls, 99)
+    return {
+        "wire_wall_s": round(wire_wall, 4),
+        "wire_bytes_out": int(wire_bytes),
+        "stream_batches": int(stream_batches),
+        "first_batch_before_done": bool(first_before_done),
+        "stream_bit_identical": bool(stream_ok),
+        "interactive_p99_preempt_off_s": round(off_p99, 4),
+        "interactive_p99_preempt_on_s": round(on_p99, 4),
+        "preempt_speedup_x": round(off_p99 / on_p99, 3) if on_p99 else 0.0,
+        "preemptions": int(preemptions),
+        "whale_results_match": bool(off_ok and on_ok),
+    }
 
 
 def _bench_out_of_core(table, conf: dict, scale: float) -> dict:

@@ -23,6 +23,126 @@ echo "== shuffle fault injection over lz4-compressed payloads =="
 # compressed frames, not just copy-codec ones
 SHUFFLE_FAULTS_CODEC=lz4 python -m pytest tests/test_shuffle_faults.py -q
 
+echo "== serving wire fault matrix (seeded chaos against query submission + result streams) =="
+python - << 'PY'
+import time
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient, WireQueryError
+from spark_rapids_tpu.serving.server import QueryServer
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+rng = np.random.default_rng(7)
+table = pa.table({"k": rng.integers(0, 8, 20000).astype("int64"),
+                  "v": rng.random(20000)})
+SQL = "SELECT k, v FROM t WHERE v > 0.5"
+
+def serve(server_faults=""):
+    sess = TpuSession({**CONF, **({"spark.rapids.tpu.serving.net.faults.plan":
+                                   server_faults,
+                                   "spark.rapids.tpu.serving.net.faults.seed":
+                                   "7"} if server_faults else {})})
+    sess.create_dataframe(table).repartition(4).createOrReplaceTempView("t")
+    ref = sess.sql(SQL).collect()
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}", ref
+
+# server-side send faults: every kind must still deliver a correct result
+for kind in ("corrupt_frame:after=1", "delay_frame:after=1,delay_ms=80",
+             "dup_frame:after=2", "corrupt_frame:after=1,count=2"):
+    sess, server, addr, ref = serve(kind)
+    client = QueryServiceClient([addr], TpuConf())
+    got = client.submit(SQL).result()
+    assert got.equals(ref), f"{kind}: wrong result"
+    fired = server.transport.plan.fired
+    assert fired, f"{kind}: fault never fired"
+    client.close(); server.shutdown()
+    print(f"wire fault ok: {kind} fired={len(fired)}")
+
+# client-side drop mid-stream: prompt failure with batches-delivered count
+sess, server, addr, ref = serve()
+client = QueryServiceClient([addr], TpuConf({
+    "spark.rapids.tpu.serving.net.faults.plan": "drop_conn:after=2",
+    "spark.rapids.tpu.serving.net.faults.seed": "7",
+    "spark.rapids.tpu.shuffle.maxRetries": "1"}))
+t0 = time.perf_counter()
+try:
+    client.submit(SQL).result()
+    raise AssertionError("drop_conn stream unexpectedly succeeded")
+except WireQueryError as e:
+    assert e.batches_delivered == 1, e.batches_delivered
+    assert time.perf_counter() - t0 < 60, "drop must fail promptly"
+    print(f"wire fault ok: drop_conn delivered={e.batches_delivered}")
+client.close(); server.shutdown()
+
+# submit-path request failure surfaces cleanly
+sess, server, addr, ref = serve()
+client = QueryServiceClient([addr], TpuConf({
+    "spark.rapids.tpu.serving.net.faults.plan":
+        "fail_request:req_type=serve.submit,after=1",
+    "spark.rapids.tpu.serving.net.faults.seed": "3"}))
+try:
+    client.submit(SQL)
+    raise AssertionError("injected submit failure did not surface")
+except WireQueryError:
+    pass
+assert client.submit(SQL).result().equals(ref)
+client.close(); server.shutdown()
+print("wire fault matrix ok")
+PY
+
+echo "== two-replica warm start (shared program-cache index behind the routing client) =="
+python - << 'PY'
+import os, subprocess, sys, tempfile
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient
+
+cache_dir = tempfile.mkdtemp(prefix="nightly-serving-")
+ARGS = [sys.executable, "-m", "spark_rapids_tpu.serving.server",
+        "--tpch-lineitem", "0.002",
+        "--conf", "spark.rapids.tpu.sql.variableFloatAgg.enabled=true",
+        "--conf", f"spark.rapids.tpu.serving.cache.dir={cache_dir}"]
+SQL = ("SELECT l_returnflag, sum(l_extendedprice) AS rev FROM lineitem "
+       "GROUP BY l_returnflag ORDER BY l_returnflag")
+procs, client = [], None
+
+def spawn():
+    # stderr to a FILE: a chatty server would fill an undrained pipe
+    errf = tempfile.NamedTemporaryFile(prefix="replica-err-",
+                                       delete=False, mode="w+")
+    proc = subprocess.Popen(ARGS, stdout=subprocess.PIPE, stderr=errf,
+                            text=True,
+                            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    procs.append(proc)
+    line = proc.stdout.readline()
+    if not line.startswith("SERVING "):
+        errf.seek(0)
+        raise AssertionError((line, errf.read()[-2000:]))
+    _t, host, port = line.split()
+    return f"{host}:{port}"
+
+try:
+    addr_a = spawn()
+    client = QueryServiceClient([addr_a], TpuConf())
+    ref = client.submit(SQL).result()       # replica A compiles cold
+    client.close()
+    addr_b = spawn()
+    client = QueryServiceClient([addr_a, addr_b], TpuConf())
+    got = client.submit(SQL, replica=1).result()
+    assert got.equals(ref), "replica B result diverged"
+    pc = client.stats(replica=1)["scheduler"]["program_cache"]
+    assert pc["disk_hits"] >= 1, pc
+    print("two-replica warm start ok:", pc)
+finally:
+    if client is not None:
+        client.close()
+    for p in procs:
+        p.terminate()
+        p.wait(timeout=30)
+PY
+
 echo "== out-of-core tight-budget chaos (1/4 working set + seeded alloc-failure injection) =="
 python - << 'PY'
 from spark_rapids_tpu.api import TpuSession
@@ -140,6 +260,24 @@ for qname in ("q1", "q3_shaped"):
     assert sec["quarter_budget_rows_per_sec"] > 0, sec
 assert (ooc["q1"]["bytes_spilled_to_host"]
         + ooc["q3_shaped"]["bytes_spilled_to_host"]) > 0, ooc
+sn = out["breakdown"]["serving_net"]
+for key in ("wire_wall_s", "wire_bytes_out", "stream_batches",
+            "first_batch_before_done", "stream_bit_identical",
+            "interactive_p99_preempt_off_s", "interactive_p99_preempt_on_s",
+            "preempt_speedup_x", "preemptions", "whale_results_match"):
+    assert key in sn, f"missing serving_net breakdown key {key}: {sn}"
+# network serving acceptance: >= 1 partial batch streams before DONE and
+# assembles bit-identically; with one whale + interactive tenants on a
+# single device permit, preemption yields >= 1 time, the whale completes
+# with identical results, and interactive p99 improves
+assert sn["stream_batches"] >= 2, sn
+assert sn["first_batch_before_done"] is True, sn
+assert sn["stream_bit_identical"] is True, sn
+assert sn["wire_bytes_out"] > 0, sn
+assert sn["preemptions"] >= 1, sn
+assert sn["whale_results_match"] is True, sn
+assert sn["interactive_p99_preempt_on_s"] < \
+    sn["interactive_p99_preempt_off_s"], sn
 conc = out["breakdown"]["concurrent"]
 for key in ("queries", "sequential_rows_per_sec", "aggregate_rows_per_sec",
             "aggregate_vs_sequential_x", "p50_latency_s", "p99_latency_s",
@@ -180,6 +318,8 @@ print("bench smoke OK:", {k: pipe[k] for k in
       {k: conc[k] for k in ("aggregate_vs_sequential_x",
                             "program_cache_hit_rate", "p50_latency_s",
                             "p99_latency_s")},
+      {k: sn[k] for k in ("stream_batches", "preempt_speedup_x",
+                          "preemptions")},
       {"out_of_core_q1": {k: ooc["q1"][k] for k in
                           ("spill_partitions", "recursion_depth_peak",
                            "quarter_vs_ample_x")}},

@@ -55,6 +55,65 @@ assert stats["program_cache"]["hits"] > 0, stats
 print("serving smoke ok:", stats["program_cache"])
 PY
 
+echo "== network serving smoke (server subprocess, TPC-H Q1 over TCP, streamed partials, bit-identity) =="
+python - << 'PY'
+import subprocess, sys, os, tempfile
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import gen_lineitem
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient
+from spark_rapids_tpu.testing import assert_tables_equal
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+# stderr to a FILE: a chatty server would fill an undrained pipe
+errf = tempfile.NamedTemporaryFile(prefix="serving-err-", delete=False,
+                                   mode="w+")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_rapids_tpu.serving.server",
+     "--tpch-lineitem", "0.002", "--partitions", "4",
+     "--conf", "spark.rapids.tpu.sql.variableFloatAgg.enabled=true"],
+    stdout=subprocess.PIPE, stderr=errf, text=True,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+line = proc.stdout.readline()
+if not line.startswith("SERVING "):
+    errf.seek(0)
+    raise AssertionError((line, errf.read()[-2000:]))
+_tag, host, port = line.split()
+client = QueryServiceClient([f"{host}:{port}"], TpuConf(CONF))
+try:
+    q1_sql = (
+        "SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty, "
+        "sum(l_extendedprice) AS sum_base_price, "
+        "sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price, "
+        "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge, "
+        "avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price, "
+        "avg(l_discount) AS avg_disc, count(*) AS count_order FROM lineitem "
+        "WHERE l_shipdate <= date '1998-09-02' "
+        "GROUP BY l_returnflag, l_linestatus "
+        "ORDER BY l_returnflag, l_linestatus")
+    scan_sql = ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+                "WHERE l_discount > 0.05")
+    sess = TpuSession(CONF)
+    (sess.create_dataframe(gen_lineitem(scale=0.002, seed=42))
+     .repartition(4).createOrReplaceTempView("lineitem"))
+    # Q1 over the wire vs in-process collect of the same SQL (float-agg
+    # carve-out per the documented contract)
+    got = client.submit(q1_sql).result()
+    assert_tables_equal(sess.sql(q1_sql).collect(), got, approx_float=1e-9)
+    # >= 1 streamed partial batch BEFORE completion, assembly bit-identical
+    h = client.submit(scan_sql)
+    got2 = h.result()
+    assert h.batches_delivered >= 2, h.batches_delivered
+    assert h.metrics["first_batch_s"] < h.metrics["wall_s"], h.metrics
+    assert got2.equals(sess.sql(scan_sql).collect())
+    print("network serving smoke ok: batches =", h.batches_delivered,
+          "first_batch_s =", h.metrics["first_batch_s"])
+finally:
+    client.close()
+    proc.terminate()
+    proc.wait(timeout=30)
+PY
+
 echo "== fusion smoke (4 queries fused vs unfused, bit-identical) =="
 python - << 'PY'
 from spark_rapids_tpu.api.dataframe import TpuSession

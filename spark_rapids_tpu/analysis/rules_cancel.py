@@ -12,8 +12,11 @@ The check: a blocking primitive reachable (callgraph.py, bounded hops)
 from a serving/exec execute root —
 
 - roots: every ``execute`` method in ``execs/``, plus the serving
-  scheduler's worker path (``_worker_loop`` / ``_run_handle``) and the
-  DataFrame collect entry (``_collect``);
+  scheduler's worker path (``_worker_loop`` / ``_run_handle``), the
+  serving wire surface (``serve_forever`` — the server's accept/run
+  loop must poll bounded so shutdown and signals land — plus the
+  client's ``submit`` / ``batches`` / ``result`` stream drivers) and
+  the DataFrame collect entry (``_collect``);
 - blocking primitives: ``<queue>.get()`` where the receiver is a
   ``queue.Queue`` (created in the function, assigned to an attr in the
   same module, or named ``*queue*``/``q``), and ``<event-or-cond>.wait()``
@@ -119,7 +122,8 @@ class CancellationUnsafeWait(Rule):
                 roots.append(key)
             elif ("/serving/" in mod or mod.startswith("serving/")) and \
                     name in ("_worker_loop", "_run_handle", "submit",
-                             "drain"):
+                             "drain", "serve_forever", "batches",
+                             "result"):
                 roots.append(key)
             elif name == "_collect" and mod.endswith("api/dataframe.py"):
                 roots.append(key)

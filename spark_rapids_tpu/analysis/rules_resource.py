@@ -24,6 +24,14 @@ CFG (cfg.py), tracking four acquire kinds:
 - **build latch** — ``container[key] = ev`` where ``ev`` was created by
   ``threading.Event()``; released by ``ev.set()`` or by popping/deleting
   from the container (the scan-cache / program-cache latch idiom).
+- **connection handle** — ``x = <...transport...>.connect(...)`` dials a
+  peer (a socket + reader thread in the TCP transport); released by
+  ``x.close()`` or handed off — stored into a cache (the
+  manager/client connection-cache idiom), returned, or passed into a
+  wrapping constructor (``c = ShuffleClient(transport, conn, ...)``).
+  A connect that escapes on an early-exit path leaks the socket AND
+  desyncs the peer's hello handshake — the serving wire layer's new
+  resource kind.
 
 Branch sensitivity: the edge transfer kills a buffer token on the branch
 that proved it None (``if buf is None: return`` leaks nothing), so the
@@ -155,6 +163,12 @@ class _FuncAnalysis:
                     h in recv.lower() for h in ("throttle", "sem")):
                 if recv not in self.deferred_releases:
                     gens.append(("gen", ("permit", recv, "", line)))
+            elif attr == "connect" and "transport" in recv.lower():
+                if isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                        and isinstance(item.targets[0], ast.Name) \
+                        and item.value is call:
+                    gens.append(("gen", ("connection", item.targets[0].id,
+                                         "", line)))
 
         # `x = None` drops the binding: whatever x held was released or
         # handed off out-of-band (the explicit-discard idiom)
@@ -195,6 +209,18 @@ class _FuncAnalysis:
         if handed:
             kills.append(("kill_buffer_names", (frozenset(handed),)))
 
+        # connection handoff-by-wrapping: a connection passed into a call
+        # whose result is BOUND (``c = ShuffleClient(transport, conn, ..)``)
+        # transfers ownership to the wrapper — the cached-client idiom.
+        # Scoped to the connection kind: buffers used via method calls must
+        # still close, only wrapping constructors adopt connections.
+        if isinstance(item, ast.Assign) and isinstance(item.value, ast.Call):
+            wrapped: Set[str] = set()
+            for a in item.value.args:
+                wrapped |= _names_in(a)
+            if wrapped:
+                kills.append(("kill_conn_names", (frozenset(wrapped),)))
+
         # latch publish: container[key] = ev
         if isinstance(item, ast.Assign) and len(item.targets) == 1 and \
                 isinstance(item.targets[0], ast.Subscript) and \
@@ -219,9 +245,15 @@ class _FuncAnalysis:
         out = set(state)
         for (op, args) in actions:
             if op == "kill_buffer_names":
+                # name-keyed kinds share the close/handoff discipline
                 names = args[0]
                 out = {t for t in out
-                       if not (t[0] == "buffer" and t[1] in names)}
+                       if not (t[0] in ("buffer", "connection")
+                               and t[1] in names)}
+            elif op == "kill_conn_names":
+                names = args[0]
+                out = {t for t in out
+                       if not (t[0] == "connection" and t[1] in names)}
             elif op == "kill_sem":
                 out = {t for t in out
                        if not (t[0] == "semaphore" and t[1] == args[0])}
@@ -257,7 +289,8 @@ class _FuncAnalysis:
         if (none_on == TRUE and label == TRUE) or \
                 (none_on == FALSE and label == FALSE):
             return frozenset(t for t in state
-                             if not (t[0] == "buffer" and t[1] in names))
+                             if not (t[0] in ("buffer", "connection")
+                                     and t[1] in names))
         return state
 
 
@@ -286,6 +319,8 @@ _KIND_HINT = {
     "semaphore": "semaphore hold never release_if_necessary()d",
     "permit": "admission permit never release()d",
     "latch": "build latch never set/popped — waiters block forever",
+    "connection": "connection handle never close()d or handed off — "
+                  "the socket and its reader thread leak",
 }
 
 
@@ -296,7 +331,7 @@ class ResourceLeak(Rule):
 
     #: attr names whose presence makes a function worth the CFG pass
     _TRIGGERS = frozenset({"acquire", "retain", "acquire_if_necessary",
-                           "Event"})
+                           "Event", "connect"})
 
     def check(self, src: SourceFile) -> List[Finding]:
         # one cheap pre-pass: the dataflow only ever generates tokens from

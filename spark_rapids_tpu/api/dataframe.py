@@ -559,6 +559,9 @@ class DataFrame:
             from spark_rapids_tpu.parallel.cluster import cluster_scheduler_for
             tables = cluster_scheduler_for(self.session).run(final)
             if tables is not None:
+                if query is not None:
+                    for t in tables:
+                        query.emit_batch(t)
                 return tables
             # plan not stageable (CPU exchanges): single-process fallback
         dm = DeviceManager.initialize(self.session.conf)
@@ -570,11 +573,14 @@ class DataFrame:
         from spark_rapids_tpu.utils.metrics import (NamedRange,
                                                     memory_delta,
                                                     memory_snapshot,
+                                                    serving_delta,
+                                                    serving_snapshot,
                                                     transfer_delta,
                                                     transfer_snapshot)
         trace = self.session.conf.get(_cfg.TRACE_ENABLED)
         transfer_before = transfer_snapshot()
         memory_before = memory_snapshot()
+        serving_before = serving_snapshot()
         import time as _time
         tenant = query.tenant if query is not None else "default"
         cancel = query.check_cancelled if query is not None else None
@@ -642,8 +648,19 @@ class DataFrame:
                             final.count_output(db.num_rows)
                             pending.append(start_download(db))
                             while len(pending) > max_inflight:
-                                tables.append(pending.pop(0).result())
-                    tables.extend(pd.result() for pd in pending)
+                                t = pending.pop(0).result()
+                                tables.append(t)
+                                # streaming partial results: each batch
+                                # reaches the serving stream the moment
+                                # its async D2H resolves — before the
+                                # final batch exists
+                                if query is not None:
+                                    query.emit_batch(t)
+                    for pd_ in pending:
+                        t = pd_.result()
+                        tables.append(t)
+                        if query is not None:
+                            query.emit_batch(t)
                 else:
                     for p in range(final.num_partitions):
                         ctx = ExecContext(self.session.conf, partition_id=p,
@@ -652,7 +669,10 @@ class DataFrame:
                                           cleanups=cleanups, query=query)
                         for b in final.execute(ctx):
                             ctx.check_cancelled()
-                            tables.append(b.to_arrow())
+                            t = b.to_arrow()
+                            tables.append(t)
+                            if query is not None:
+                                query.emit_batch(t)
         finally:
             for fn in cleanups:
                 fn()
@@ -673,6 +693,9 @@ class DataFrame:
                 # partitions, recursion peak, bytes spilled per tier
                 # (process-global like the tiered store they observe)
                 snap["memory"] = memory_delta(memory_before)
+                # serving story: wire bytes/batches streamed, preemptions,
+                # footprint-admission rejections over the action's window
+                snap["serving"] = serving_delta(serving_before)
                 if query is not None:
                     query.record_exec_metrics(snap)
                 self.session.last_metrics = snap

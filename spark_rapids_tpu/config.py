@@ -518,6 +518,14 @@ SHUFFLE_TCP_REGISTRY = _conf(
     "discovery (the management-handshake rendezvous; shared storage or the "
     "control plane's executor registry on a real cluster).")
 
+SHUFFLE_TCP_WORKER_THREADS = _conf(
+    "shuffle.tcp.workerThreads", int, 2,
+    "Request-handler worker threads per TCP transport (the server "
+    "copy-executor pool). The shuffle data plane needs few; the serving "
+    "wire protocol (serving/server.py) raises this so bounded-poll "
+    "serve.next handlers from many clients do not head-of-line-block each "
+    "other.", checker=_positive("shuffle.tcp.workerThreads"))
+
 SHUFFLE_MAX_INFLIGHT_BYTES = _conf(
     "shuffle.maxReceiveInflightBytes", int, 1 << 30,
     "Per-client cap on bytes of shuffle data in flight "
@@ -672,6 +680,107 @@ SERVING_CACHE_MAX_PROGRAMS = _conf(
     "retains; least-recently-used programs are dropped past it (their "
     "on-disk compilation-cache entries survive, so a re-miss recompiles "
     "warm).", checker=_positive("serving.cache.maxPrograms"))
+
+# --------------------------------------------------------------------------------------
+# Serving: network wire protocol, footprint admission, preemption
+# --------------------------------------------------------------------------------------
+SERVING_NET_PORT = _conf(
+    "serving.net.listenPort", int, 0,
+    "Listen port of the query service's wire transport (Arrow IPC over the "
+    "TCP shuffle framing); 0 picks an ephemeral port, printed by the server "
+    "process at startup.")
+
+SERVING_NET_TRANSPORT = _conf(
+    "serving.net.transportClass", str,
+    "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+    "Transport class the query service speaks over — the PR 2 "
+    "framing/checksum/retry stack, NOT new plumbing. Any ShuffleTransport "
+    "implementation works; tests swap in the in-process fabric.")
+
+SERVING_NET_FAULTS_PLAN = _conf(
+    "serving.net.faults.plan", str, "",
+    "Deterministic wire-chaos plan for the query service (empty = none): "
+    "the shuffle FaultPlan grammar (drop_conn / corrupt_frame / "
+    "delay_frame / dup_frame / fail_request) injected by wrapping the "
+    "serving transport in the FaultInjectingTransport — corrupted result "
+    "frames must surface as retryable checksum failures, dropped "
+    "connections as failed handles with a batches-delivered count.")
+
+SERVING_NET_FAULTS_SEED = _conf(
+    "serving.net.faults.seed", int, 0,
+    "Seed for the serving wire-chaos plan's random choices; a fixed seed "
+    "replays the same schedule (mirrors shuffle.faults.seed).")
+
+SERVING_NET_POLL_MS = _conf(
+    "serving.net.nextPollMs", int, 20,
+    "How long a serve.next handler waits (bounded — the R010 discipline) "
+    "for the query's next streamed batch before answering WAIT and "
+    "releasing its transport worker thread; the client re-polls "
+    "immediately, so this bounds handler occupancy, not stream latency.",
+    checker=_positive("serving.net.nextPollMs"))
+
+SERVING_NET_STREAM_DEPTH = _conf(
+    "serving.net.streamQueueDepth", int, 4,
+    "Bound on result batches buffered server-side per streaming query "
+    "between the scheduler worker (producer) and the wire layer "
+    "(consumer); a full queue backpressures the producer at its next "
+    "batch boundary — bounded buffering, never an unbounded queue.",
+    checker=_positive("serving.net.streamQueueDepth"))
+
+SERVING_NET_MAX_STREAM_ROWS = _conf(
+    "serving.net.maxStreamBatchRows", int, 1 << 20,
+    "Result batches larger than this many rows are sliced into multiple "
+    "wire frames before streaming, bounding per-frame memory on both ends "
+    "(slices concatenate client-side to the bit-identical table). "
+    "0 streams every exec batch whole.",
+    checker=_non_negative("serving.net.maxStreamBatchRows"))
+
+SERVING_NET_RPC_TIMEOUT = _conf(
+    "serving.net.rpcTimeoutSeconds", float, 60.0,
+    "Client-side bound on any single wire RPC (submit / next / fetch / "
+    "cancel) and on each posted batch receive; an expired wait surfaces "
+    "as a failed handle with its batches-delivered count, never a hang.",
+    checker=_positive("serving.net.rpcTimeoutSeconds"))
+
+SERVING_ADMIT_FOOTPRINT = _conf(
+    "serving.admission.byFootprint.enabled", bool, True,
+    "Admit RUNNING queries against the device budget using the plan's "
+    "working_set_estimate (the PR 11 footprint contract) instead of a "
+    "bare query count: a query whose estimate does not fit the free "
+    "budget waits (cancellable, visible in "
+    "serving.admission_rejections_footprint) until running queries "
+    "release their share. A query larger than the whole budget is "
+    "admitted under a grace hint, charged the out-of-core HEADROOM "
+    "share of the budget — the grace/spill layer completes it within "
+    "that share, and the remaining fraction stays free so interactive "
+    "queries still reach the device semaphore (where preemption can "
+    "see them) alongside a whale.")
+
+SERVING_PREEMPT_ENABLED = _conf(
+    "serving.preemption.enabled", bool, False,
+    "Batch-granularity preemption of RUNNING queries: when another "
+    "tenant's query has starved on device admission past "
+    "preemption.starvationMs, a preemptible running query yields its "
+    "device-semaphore permit at its next exec-boundary checkpoint "
+    "(check_cancelled sites), optionally parks spillable device state "
+    "down the grace/spill tiers, and re-acquires under fair share — so a "
+    "whale cannot starve interactive tenants between its batches.")
+
+SERVING_PREEMPT_STARVATION_MS = _conf(
+    "serving.preemption.starvationMs", int, 50,
+    "How long another tenant's head-of-line device-admission waiter must "
+    "have been blocked before a running preemptible query yields at its "
+    "next batch boundary.",
+    checker=_positive("serving.preemption.starvationMs"))
+
+SERVING_PREEMPT_PARK = _conf(
+    "serving.preemption.parkSpillable", bool, True,
+    "On yield, shed the device store down to the out-of-core headroom "
+    "watermark (memory.outOfCore.headroomFraction) — coldest-first, so "
+    "the overage parked down the host/disk tiers is in practice the "
+    "yielding whale's grace partitions, and the admitted tenant gets "
+    "immediate HBM headroom; parked state re-admits on next access. "
+    "Disabling leaves parking to the store's reactive pressure path.")
 
 # --------------------------------------------------------------------------------------
 # Observability (SQLMetrics / NVTX analog)

@@ -60,9 +60,15 @@ class ExecContext:
         QueryCancelledError / QueryTimeoutError when the owning query was
         cancelled or ran past its deadline; a no-op for direct actions.
         Execs call this at batch boundaries so a cancelled query unwinds
-        through the normal finally chain (semaphore + catalog cleanup)."""
+        through the normal finally chain (semaphore + catalog cleanup).
+
+        The same sites double as batch-granularity PREEMPTION points: a
+        preemptible serving query yields its device-semaphore permit here
+        when another tenant has starved on admission (QueryHandle.
+        check_preempt — a no-op unless serving.preemption.enabled)."""
         if self.query is not None:
             self.query.check_cancelled()
+            self.query.check_preempt(self)
 
     @property
     def device(self):

@@ -40,9 +40,16 @@ class TpuSemaphore:
         self._cond = threading.Condition()
         self._holders: Set[int] = set()
         self._nesting: Dict[int, int] = {}
+        #: tasks mid-yield (yield_to_waiters): not holding a permit, but
+        #: their nesting ledger stays LIVE so sibling threads entering or
+        #: exiting scoped holds during the yield keep it balanced
+        self._yielding: Set[int] = set()
         self._seq = 0
         #: tenant -> FIFO of waiting ticket ids
         self._waiters: Dict[str, deque] = {}
+        #: ticket -> monotonic enqueue time (starvation detection for the
+        #: serving preemption governor; removed with the ticket)
+        self._wait_since: Dict[int, float] = {}
         #: weighted admission counters / weights (fair-share state)
         self._served: Dict[str, float] = {}
         self._weights: Dict[str, float] = {}
@@ -85,10 +92,13 @@ class TpuSemaphore:
         ticket = self._seq
         self._seq += 1
         self._waiters.setdefault(tenant, deque()).append(ticket)
+        import time
+        self._wait_since[ticket] = time.monotonic()
         return ticket
 
     def _dequeue_locked(self, ticket: int, tenant: str) -> None:
         q = self._waiters.get(tenant)
+        self._wait_since.pop(ticket, None)
         if q is not None:
             try:
                 q.remove(ticket)
@@ -177,6 +187,12 @@ class TpuSemaphore:
         with self._cond:
             if tid in self._holders:
                 self._nesting[tid] = self._nesting.get(tid, 1) + 1
+            elif tid in self._yielding:
+                # the task is mid-preemption-yield: join its LIVE nesting
+                # ledger instead of queueing for a permit the task will
+                # re-take anyway (the same softness as producers that
+                # entered before the yield — they keep running)
+                self._nesting[tid] = self._nesting.get(tid, 1) + 1
             else:
                 ticket = self._enqueue_locked(tenant)
                 try:
@@ -210,6 +226,72 @@ class TpuSemaphore:
                         self._cond.notify_all()
                 else:
                     self._nesting[tid] = n
+
+    # ---- batch-granularity preemption (serving layer) ----------------------
+    def holds_permit(self, task_id: Optional[int] = None) -> bool:
+        """Whether the task currently holds a permit — the preemption
+        checkpoint's precondition: a non-holder has nothing to yield (and
+        must not park the device store on other holders' behalf)."""
+        tid = self._task_id(task_id)
+        with self._cond:
+            return tid in self._holders
+
+    def has_starved_waiter(self, exclude_tenant: str = _DEFAULT_TENANT,
+                           min_wait_s: float = 0.05) -> bool:
+        """True when some OTHER tenant's head-of-line waiter has been
+        blocked on admission at least ``min_wait_s`` — the signal a running
+        preemptible query polls at its exec-boundary checkpoints to decide
+        whether to yield its permit between batches."""
+        import time
+        now = time.monotonic()
+        with self._cond:
+            for tenant, q in self._waiters.items():
+                if tenant == exclude_tenant or not q:
+                    continue
+                since = self._wait_since.get(q[0])
+                if since is not None and now - since >= min_wait_s:
+                    return True
+        return False
+
+    def yield_to_waiters(self, task_id: Optional[int] = None,
+                         tenant: str = _DEFAULT_TENANT,
+                         cancel_check: Optional[Callable[[], None]] = None
+                         ) -> bool:
+        """Release this task's permit, let fair-share admission hand it to
+        the starved head-of-line, then re-acquire and continue — the
+        batch-granularity preemption point. The nesting ledger stays LIVE
+        through the yield (``_yielding`` marks the task): sibling threads
+        sharing the task's hold (pipeline producers) may enter or exit
+        their scoped holds mid-yield and the counts keep balancing, so
+        the final scope exit still releases exactly once. Returns False
+        when the task held no permit. On cancellation mid-yield the
+        permit is NOT re-taken; the unwinding scope exits drain the
+        ledger and find no hold to release."""
+        tid = self._task_id(task_id)
+        with self._cond:
+            if tid not in self._holders:
+                return False
+            self._holders.remove(tid)
+            self._yielding.add(tid)
+            ticket = self._enqueue_locked(tenant)
+            self._cond.notify_all()
+            try:
+                self._wait_turn_locked(tid, ticket, tenant, None,
+                                       cancel_check)
+            except BaseException:
+                self._yielding.discard(tid)
+                self._dequeue_locked(ticket, tenant)
+                self._cond.notify_all()
+                raise
+            self._yielding.discard(tid)
+            self._dequeue_locked(ticket, tenant)
+            if tid not in self._holders:
+                # an acquire_if_necessary sibling may have re-taken the
+                # hold while we queued; otherwise the permit is ours again
+                self._holders.add(tid)
+                self._served[tenant] = self._served.get(tenant, 0.0) + 1.0
+            self._cond.notify_all()
+            return True
 
     @property
     def active_holders(self) -> int:

@@ -61,6 +61,32 @@ def choose_partitions(working_set: int, budget: int, conf: TpuConf) -> int:
     return max(2, min(n, conf.get(cfg.OOC_MAX_PARTITIONS)))
 
 
+def plan_working_set_estimate(plan: PhysicalExec) -> Optional[int]:
+    """Peak device working set one action of ``plan`` is predicted to
+    need: the max over device operators' declared ``working_set_estimate``
+    (pipelined execution materializes one working-set operator's input at
+    a time, so the max — not the sum — is the honest peak; concurrent
+    subtree overlap is absorbed by the admission headroom). None when no
+    device operator declares an estimate — admission then has nothing to
+    hold the query against and admits it like the pre-footprint path.
+
+    This is the serving layer's admission contract (serving/admission.py):
+    a query is admitted against the device budget for this many bytes, and
+    the PR 11 out-of-core machinery honors the budget it was admitted
+    under by grace-partitioning and spilling past it."""
+    best: Optional[int] = None
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children)
+        if not node.is_device:
+            continue
+        ws = node.working_set_estimate()
+        if ws is not None and (best is None or ws > best):
+            best = ws
+    return best
+
+
 def annotate_out_of_core(plan: PhysicalExec, conf: TpuConf) -> PhysicalExec:
     """Annotate ``grace_partitions`` on working-set operators whose
     footprint estimate exceeds the device budget's headroom fraction.

@@ -1,31 +1,39 @@
-"""Concurrent query serving: scheduler, program cache, query lifecycle.
+"""Concurrent query serving: scheduler, program cache, lifecycle, wire.
 
 The layer that turns the one-query-at-a-time engine into a multi-tenant
-server (ROADMAP item 4; Theseus's admission-controlled many-queries-in-
-flight platform + Flare's compile-once/serve-many result):
+NETWORK service (ROADMAP items 2 and 4; Theseus's admission-controlled
+many-queries-in-flight platform + Flare's compile-once/serve-many
+result):
 
 - ``lifecycle``: QueryHandle state machine (QUEUED -> ADMITTED -> RUNNING
   -> {DONE, FAILED, CANCELLED}) with cooperative cancellation, per-query
-  deadlines, and per-query metric snapshots;
+  deadlines, per-query metric snapshots, streaming result sinks
+  (ResultStream) and batch-granularity preemption checkpoints;
 - ``program_cache``: the cross-query compiled-program cache keyed on
   canonical plan structure + dtype signature + shape bucket, with an
   on-disk plan-key index over the jax persistent compilation cache so a
-  restarted server warms from disk;
+  restarted server (or a SECOND replica) warms from disk;
 - ``scheduler``: the session scheduler running N concurrent queries over
   a shared worker pool with fair-share tenant admission layered on the
-  device-admission semaphore.
+  device-admission semaphore;
+- ``admission``: footprint admission — RUNNING queries charged their
+  working_set_estimate against the device budget, not a bare count;
+- ``wire`` / ``server`` / ``client``: the Arrow-IPC wire protocol over
+  the PR 2 TCP shuffle machinery — streaming partial results, retryable
+  checksum failures, disconnect-as-cancel, N routed replicas.
 """
+from spark_rapids_tpu.serving.admission import FootprintAdmission
 from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
                                                 QueryHandle, QueryState,
                                                 QueryTimeoutError,
-                                                current_query)
+                                                ResultStream, current_query)
 from spark_rapids_tpu.serving.program_cache import (ProgramCache,
                                                     global_program_cache,
                                                     plan_key)
 from spark_rapids_tpu.serving.scheduler import SessionScheduler
 
 __all__ = [
-    "ProgramCache", "QueryCancelledError", "QueryHandle", "QueryState",
-    "QueryTimeoutError", "SessionScheduler", "current_query",
-    "global_program_cache", "plan_key",
+    "FootprintAdmission", "ProgramCache", "QueryCancelledError",
+    "QueryHandle", "QueryState", "QueryTimeoutError", "ResultStream",
+    "SessionScheduler", "current_query", "global_program_cache", "plan_key",
 ]

@@ -1,0 +1,282 @@
+"""Query service client: connection-routing front end + streaming handles.
+
+One ``QueryServiceClient`` speaks to N server replicas (server.py) through
+ONE shuffle-transport instance — each replica is just a dialed peer of the
+PR 2 TCP stack, addressed ``host:port`` (no registry). Submissions route
+round-robin across replicas (the connection-routing front end: replicas
+share the on-disk program-cache index, so any of them serves any shape
+warm); ``register_table`` broadcasts to every replica so the catalog is
+identical behind the router.
+
+``RemoteQueryHandle.batches()`` streams partial results as the server
+produces them — batch 1 arrives while the query is still RUNNING. Fault
+handling mirrors the shuffle client: a checksum mismatch on a result
+frame is a RETRYABLE fetch (deterministic backoff, the parked server copy
+retransmits); a dropped connection or exhausted retries fails the handle
+with ``WireQueryError`` carrying ``batches_delivered`` — never a hang
+(every wait is bounded by ``serving.net.rpcTimeoutSeconds``).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.serving import wire
+from spark_rapids_tpu.shuffle import retry
+from spark_rapids_tpu.shuffle.codec import ChecksumError, verify_checksum
+from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
+                                                TransactionStatus)
+from spark_rapids_tpu.utils import metrics as um
+
+
+class WireQueryError(RuntimeError):
+    """A wire query failed (server error, lost connection, exhausted
+    retries). ``batches_delivered`` counts result batches that arrived
+    intact before the failure — the partial-progress contract."""
+
+    def __init__(self, message: str, batches_delivered: int = 0):
+        super().__init__(message)
+        self.batches_delivered = batches_delivered
+
+
+class RemoteQueryHandle:
+    """Client-side identity of one wire-submitted query."""
+
+    def __init__(self, client: "QueryServiceClient", replica: str, conn,
+                 query_id: int, label: str):
+        self._client = client
+        self._conn = conn
+        self.replica = replica
+        self.query_id = query_id
+        self.label = label
+        self.batches_delivered = 0
+        #: terminal per-query snapshot from the server's DONE frame
+        #: (queue/admission waits, program-cache hits incl. disk_hits,
+        #: stream/preemption counts — the QueryHandle.snapshot() keys)
+        self.metrics: Dict = {}
+        self._tables: List[pa.Table] = []
+        self._schema_ipc: bytes = b""
+        self._done = False
+        self._consumed = False
+
+    # ---- streaming ---------------------------------------------------------
+    def batches(self):
+        """Yield result batches as the server streams them (partial
+        results: the first batch arrives before the final one exists).
+        Batches are NOT retained client-side — streaming consumption is
+        memory-bounded; use ``result()`` instead for the assembled table.
+        Abandoning the iterator early cancels the server-side query so
+        its producer, permits and buffers release promptly."""
+        yield from self._drive(retain=False)
+
+    def _drive(self, retain: bool):
+        if self._consumed:
+            raise RuntimeError("batches() already consumed")
+        self._consumed = True
+        ack = -1
+        try:
+            while True:
+                resp = self._client._rpc(
+                    self._conn, wire.REQ_NEXT,
+                    wire.NextRequest(self.query_id, ack).to_bytes(),
+                    delivered=self.batches_delivered)
+                ack = -1
+                nr = wire.NextResponse.from_bytes(resp)
+                if nr.kind == wire.NEXT_WAIT:
+                    continue
+                if nr.kind == wire.NEXT_DONE:
+                    self.metrics = json.loads(nr.metrics_json or b"{}")
+                    self._schema_ipc = nr.schema_ipc
+                    self._done = True
+                    return
+                if nr.kind == wire.NEXT_ERROR:
+                    raise WireQueryError(nr.error, self.batches_delivered)
+                table = self._fetch(nr)
+                self.batches_delivered += 1
+                ack = nr.seq
+                if retain:
+                    self._tables.append(table)
+                yield table
+        finally:
+            # abandoned mid-stream (early break / GeneratorExit / error):
+            # cancel server-side so the producer, its device permit and
+            # the parked frames release now, not at client disconnect
+            if not self._done:
+                try:
+                    self.cancel()
+                except WireQueryError:
+                    pass
+
+    def _fetch(self, nr: wire.NextResponse) -> pa.Table:
+        """Pull one parked frame: post a receive on a fresh tag, ask the
+        server to push, verify the crc32. Corruption retries with the
+        shuffle stack's deterministic backoff — the server retransmits
+        its parked copy."""
+        c = self._client
+        last_err = "fetch failed"
+        for attempt in range(c.max_retries + 1):
+            tag = next(c._tags)
+            buf = bytearray(nr.nbytes)
+            rtx = self._conn.receive(
+                AddressLengthTag(buf, nr.nbytes, tag), lambda tx: None)
+            try:
+                c._rpc(self._conn, wire.REQ_FETCH,
+                       wire.FetchRequest(self.query_id, nr.seq,
+                                         tag).to_bytes(),
+                       delivered=self.batches_delivered)
+                rtx.wait(c.rpc_timeout)
+            except TimeoutError:
+                # abandon the posted receive so the stale tag neither pins
+                # its frame-sized buffer nor swallows a late retransmit
+                self._cancel_receive(tag)
+                last_err = (f"result frame seq {nr.seq} timed out after "
+                            f"{c.rpc_timeout}s")
+                self._backoff(attempt, nr.seq)
+                continue
+            except WireQueryError:
+                self._cancel_receive(tag)
+                raise
+            if rtx.status is not TransactionStatus.SUCCESS:
+                raise WireQueryError(
+                    f"result stream lost at seq {nr.seq}: "
+                    f"{rtx.error_message}", self.batches_delivered)
+            data = bytes(buf[:nr.nbytes])
+            try:
+                verify_checksum(data, nr.checksum,
+                                context=f"query {self.query_id} "
+                                        f"seq {nr.seq}")
+            except ChecksumError as e:
+                last_err = str(e)
+                um.SERVING_METRICS[um.SERVING_WIRE_RETRIES].add(1)
+                self._cancel_receive(tag)       # drop a straggling dup too
+                self._backoff(attempt, nr.seq)
+                continue
+            # purge any duplicate frame (dup_frame chaos) that already
+            # landed for this tag — it would otherwise park in the
+            # transport's early-data table until the cap evicts it
+            self._cancel_receive(tag)
+            return wire.ipc_to_table(data)
+        raise WireQueryError(
+            f"{last_err} ({c.max_retries + 1} attempts)",
+            self.batches_delivered)
+
+    def _cancel_receive(self, tag: int) -> None:
+        cancel = getattr(self._conn, "cancel_receive", None)
+        if cancel is not None:
+            cancel(tag)
+
+    def _backoff(self, attempt: int, seq: int) -> None:
+        time.sleep(retry.backoff_ms(
+            attempt, self._client.backoff_ms, self._client.retry_seed,
+            key=f"serve-fetch:{self.query_id}:{seq}") / 1e3)
+
+    # ---- terminal results --------------------------------------------------
+    def result(self) -> pa.Table:
+        """Drain the stream and assemble the full table — bit-identical
+        to the in-process ``collect()`` (float-agg carve-out per the
+        documented contract). A stream consumed via ``batches()`` was
+        deliberately not retained; assemble it caller-side instead."""
+        if not self._done:
+            if self._consumed:
+                raise RuntimeError(
+                    "stream partially consumed; drain batches() first")
+            for _ in self._drive(retain=True):
+                pass
+        if self._tables:
+            return pa.concat_tables(self._tables)
+        if self.batches_delivered:
+            raise RuntimeError(
+                "stream was consumed via batches() (not retained); "
+                "assemble the batches caller-side or re-submit")
+        return wire.ipc_to_table(self._schema_ipc)
+
+    def cancel(self) -> None:
+        self._client._rpc(self._conn, wire.REQ_CANCEL,
+                          wire.CancelRequest(self.query_id).to_bytes(),
+                          delivered=self.batches_delivered)
+
+
+class QueryServiceClient:
+    """Front end over N replica addresses (``["host:port", ...]``)."""
+
+    def __init__(self, addresses, conf=None):
+        from spark_rapids_tpu.config import TpuConf
+        self.conf = conf or TpuConf()
+        if isinstance(addresses, str):
+            addresses = [a.strip() for a in addresses.split(",") if a.strip()]
+        if not addresses:
+            raise ValueError("QueryServiceClient needs >= 1 server address")
+        self.addresses = list(addresses)
+        self.rpc_timeout = self.conf.get(cfg.SERVING_NET_RPC_TIMEOUT)
+        self.max_retries = self.conf.shuffle_max_retries
+        self.backoff_ms = self.conf.shuffle_retry_backoff_ms
+        self.retry_seed = self.conf.get(cfg.SERVING_NET_FAULTS_SEED)
+        self._transport = wire.make_serving_transport(
+            f"serve-client-{uuid.uuid4().hex[:8]}", self.conf, listen_port=0)
+        self._rr = itertools.count()
+        #: client-chosen receive tags, unique across queries and retries
+        self._tags = itertools.count(1 << 32)
+
+    # ---- plumbing ----------------------------------------------------------
+    def _connection(self, addr: str):
+        # the transport caches live connections and EVICTS dead ones
+        # (peer-lost handling in tcp.py / the fault wrapper), so asking it
+        # each time re-dials a dropped replica; a second cache here would
+        # pin a dead socket past its eviction
+        return self._transport.connect(addr)
+
+    def _rpc(self, conn, req_type: str, payload: bytes,
+             delivered: int = 0) -> bytes:
+        tx = conn.request(req_type, payload, lambda t: None)
+        try:
+            tx.wait(self.rpc_timeout)
+        except TimeoutError:
+            raise WireQueryError(
+                f"{req_type} timed out after {self.rpc_timeout}s",
+                delivered) from None
+        if tx.status is not TransactionStatus.SUCCESS:
+            raise WireQueryError(
+                f"{req_type} failed: {tx.error_message}", delivered)
+        return tx.response
+
+    def _route(self, replica: Optional[int]) -> str:
+        if replica is not None:
+            return self.addresses[replica % len(self.addresses)]
+        return self.addresses[next(self._rr) % len(self.addresses)]
+
+    # ---- API ---------------------------------------------------------------
+    def submit(self, sql: str, tenant: str = "default",
+               timeout: float = 0.0, label: str = "",
+               replica: Optional[int] = None) -> RemoteQueryHandle:
+        """Submit SQL to one replica (round-robin unless pinned); returns
+        a streaming handle immediately."""
+        addr = self._route(replica)
+        conn = self._connection(addr)
+        resp = wire.SubmitResponse.from_bytes(self._rpc(
+            conn, wire.REQ_SUBMIT,
+            wire.SubmitRequest(sql, tenant, timeout, label).to_bytes()))
+        return RemoteQueryHandle(self, addr, conn, resp.query_id, label)
+
+    def register_table(self, name: str, table: pa.Table) -> None:
+        """Register ``table`` as a temp view on EVERY replica, so routed
+        submissions see one catalog."""
+        data = wire.table_to_ipc(table)
+        req = wire.RegisterRequest(name, data).to_bytes()
+        for addr in self.addresses:
+            self._rpc(self._connection(addr), wire.REQ_REGISTER, req)
+
+    def stats(self, replica: int = 0) -> Dict:
+        """One replica's scheduler/program-cache/serving counters (the
+        warm-start probe reads disk_hits here)."""
+        addr = self._route(replica)
+        return json.loads(self._rpc(self._connection(addr),
+                                    wire.REQ_STATS, b""))
+
+    def close(self) -> None:
+        self._transport.shutdown()

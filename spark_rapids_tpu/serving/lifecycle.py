@@ -58,6 +58,83 @@ class QueryTimeoutError(RuntimeError):
 
 _QUERY_IDS = itertools.count(1)
 
+
+class ResultStream:
+    """Bounded FIFO of streamed result batches between the scheduler
+    worker (producer: ``QueryHandle.emit_batch``) and a consumer — the
+    wire layer's serve.next handler, or any in-process subscriber.
+
+    Bounded buffering, never an unbounded queue: a full stream
+    backpressures the producer at its next batch boundary (bounded poll +
+    the producer query's own cancel check, the R010 idiom). A consumer
+    that goes away calls ``abandon()``; the producer then drops batches
+    instead of blocking on a reader that will never come back."""
+
+    def __init__(self, depth: int = 4):
+        self.depth = max(1, depth)
+        self._cv = threading.Condition()
+        self._q: list = []
+        self._state = "open"            # open | finished | failed
+        self._error: Optional[BaseException] = None
+        self._abandoned = False
+
+    def put(self, table, cancel_check=None) -> bool:
+        """Producer side: enqueue one result batch; blocks (bounded poll)
+        while the stream is full. Returns False when the consumer
+        abandoned the stream (the batch is dropped)."""
+        with self._cv:
+            while len(self._q) >= self.depth and not self._abandoned:
+                self._cv.wait(0.05)
+                if cancel_check is not None:
+                    cancel_check()
+            if self._abandoned:
+                return False
+            self._q.append(table)
+            self._cv.notify_all()
+            return True
+
+    def finish(self) -> None:
+        with self._cv:
+            if self._state == "open":
+                self._state = "finished"
+            self._cv.notify_all()
+
+    def fail(self, error: BaseException) -> None:
+        with self._cv:
+            if self._state == "open":
+                self._state = "failed"
+                self._error = error
+            self._cv.notify_all()
+
+    def abandon(self) -> None:
+        """Consumer side: stop consuming; pending batches drop and the
+        producer never blocks on this stream again."""
+        with self._cv:
+            self._abandoned = True
+            self._q.clear()
+            self._cv.notify_all()
+
+    def next(self, timeout: float):
+        """Consumer side: ``("batch", table)`` when one is ready within
+        ``timeout`` seconds, ``("done", None)`` / ``("error", exc)`` once
+        drained and terminal, else ``("wait", None)`` — the caller
+        re-polls (a wire handler answers WAIT and frees its thread)."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cv:
+            while True:
+                if self._q:
+                    batch = self._q.pop(0)
+                    self._cv.notify_all()
+                    return ("batch", batch)
+                if self._state == "finished":
+                    return ("done", None)
+                if self._state == "failed":
+                    return ("error", self._error)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return ("wait", None)
+                self._cv.wait(left)
+
 #: thread-scoped current query for metric attribution (a thread-local, not
 #: a contextvar: exec producer threads rebind explicitly from ctx.query —
 #: implicit contextvar inheritance does not cross threading.Thread anyway)
@@ -84,10 +161,30 @@ class QueryHandle:
 
     def __init__(self, query: Any, tenant: str = "default",
                  timeout: Optional[float] = None,
-                 label: Optional[str] = None):
+                 label: Optional[str] = None,
+                 stream: Optional[ResultStream] = None):
         self.query_id = next(_QUERY_IDS)
         self.tenant = tenant
         self.label = label or f"query-{self.query_id}"
+        #: optional streaming sink: each result batch is pushed here as its
+        #: async D2H download resolves — BEFORE the final batch exists
+        #: (the wire layer's partial-results path); collect() semantics are
+        #: unchanged, the handle still carries the assembled result
+        self.stream = stream
+        #: batch-granularity preemption (scheduler-set from serving.
+        #: preemption.* conf): when True, check_preempt yields the device
+        #: permit to starved tenants at exec-boundary checkpoints
+        self.preemptible = False
+        self.preempt_starvation_s = 0.05
+        self.preempt_park_spillable = True
+        self._next_preempt_check = 0.0
+        #: footprint-admission state (serving/admission.py + scheduler):
+        #: the planned (df, final, estimate) cached across an admission
+        #: requeue, the earliest re-pick time, and the first-rejection
+        #: timestamp the admission wait metric is measured from
+        self._planned = None
+        self._admit_not_before = 0.0
+        self._admission_rejected_at: Optional[float] = None
         #: the submitted work: a DataFrame or a SQL string (planned lazily
         #: in the worker so a malformed query FAILS its handle instead of
         #: raising in submit())
@@ -110,10 +207,24 @@ class QueryHandle:
             "program_cache": {"hits": 0, "misses": 0, "disk_hits": 0},
             "rows": None,
             "wall_s": None,
+            #: streaming / preemption / admission story of THIS query
+            "stream_batches": 0,
+            "first_batch_s": None,
+            "preemptions": 0,
+            "preempt_wait_s": 0.0,
+            "footprint_est_bytes": None,
+            "admission_footprint_wait_s": 0.0,
+            "admission_grace_hint": False,
         }
         #: per-operator + transfer snapshot of the query's action(s); the
         #: per-handle replacement for session.last_metrics
         self.exec_metrics: Dict[str, Dict] = {}
+
+    def admit_ready(self, now: float) -> bool:
+        """Eligible for worker pickup: past any admission-requeue
+        deferral (monotonic clock), or cancelled — a cancelled handle
+        must be picked promptly so its terminal transition runs."""
+        return self._cancel_evt.is_set() or now >= self._admit_not_before
 
     # ---- cooperative cancellation / deadline -------------------------------
     def cancel(self) -> bool:
@@ -142,6 +253,75 @@ class QueryHandle:
         if self.deadline is not None and time.perf_counter() > self.deadline:
             raise QueryTimeoutError(
                 f"{self.label} (id {self.query_id}) exceeded its deadline")
+
+    # ---- streaming partial results -----------------------------------------
+    def emit_batch(self, table) -> None:
+        """One result batch materialized (its async D2H resolved): record
+        the streaming metrics and, when a ResultStream is attached, push it
+        to the consumer — before the remaining batches exist. Called by the
+        action driver (api/dataframe._run_partitions) per result batch."""
+        with self._lock:
+            self.metrics["stream_batches"] += 1
+            if self.metrics["first_batch_s"] is None:
+                self.metrics["first_batch_s"] = round(
+                    time.perf_counter() - self.submitted_at, 6)
+        if self.stream is not None:
+            self.stream.put(table, cancel_check=self.check_cancelled)
+
+    # ---- batch-granularity preemption --------------------------------------
+    def check_preempt(self, ctx) -> None:
+        """Preemption point, called from ExecContext.check_cancelled at
+        exec boundaries: when another tenant's admission waiter has starved
+        past the threshold, yield the device permit — optionally parking
+        spillable device state down the grace/spill tiers first — and
+        re-acquire under fair share. Only the thread OWNING the task's
+        semaphore hold may yield it (producer threads share the hold and
+        must not pull it out from under the consumer)."""
+        if not self.preemptible or ctx is None:
+            return
+        if threading.get_ident() != ctx.task_id:
+            return
+        dm = ctx.device_manager
+        if dm is None:
+            return
+        now = time.monotonic()
+        if now < self._next_preempt_check:   # cheap rate limit per batch
+            return
+        self._next_preempt_check = now + 0.01
+        sem = dm.semaphore
+        if not sem.has_starved_waiter(exclude_tenant=self.tenant,
+                                      min_wait_s=self.preempt_starvation_s):
+            return
+        # only an actual permit HOLDER parks and yields: a query passing
+        # this checkpoint without a hold (CPU-fallback section, between
+        # scoped holds) has nothing to give the starved tenant and must
+        # not thrash the holder's device state on its behalf
+        if not sem.holds_permit(ctx.task_id):
+            return
+        from spark_rapids_tpu.utils import metrics as um
+        if self.preempt_park_spillable:
+            store = dm.device_store
+            if store is not None and store.budget_bytes:
+                # shed the device tier down to the out-of-core HEADROOM
+                # watermark so the admitted tenant has HBM room: the
+                # overage is, by the store's spill priorities, this
+                # query's grace partitions — the store is shared and
+                # ownership-blind, but eviction is coldest-first, so
+                # another tenant's hot buffers stay put; anything parked
+                # re-admits on its next access
+                from spark_rapids_tpu import config as _cfg
+                headroom = ctx.conf.get(_cfg.OOC_HEADROOM)
+                store.spill_to_size(int(store.budget_bytes * headroom))
+        t0 = time.perf_counter()
+        if not sem.yield_to_waiters(task_id=ctx.task_id, tenant=self.tenant,
+                                    cancel_check=self.check_cancelled):
+            return
+        waited = time.perf_counter() - t0
+        um.SERVING_METRICS[um.SERVING_PREEMPTIONS].add(1)
+        with self._lock:
+            self.metrics["preemptions"] += 1
+            self.metrics["preempt_wait_s"] = round(
+                self.metrics["preempt_wait_s"] + waited, 6)
 
     # ---- state transitions (scheduler-driven) ------------------------------
     def _transition(self, state: QueryState) -> None:
@@ -173,6 +353,14 @@ class QueryHandle:
             if result is not None and hasattr(result, "num_rows"):
                 self.metrics["rows"] = result.num_rows
         self._done_evt.set()
+        # terminal state drains to the streaming consumer on EVERY path —
+        # worker completion, queued-cancel, scheduler shutdown — so a wire
+        # client always observes DONE or the error, never a silent stall
+        if self.stream is not None:
+            if state is QueryState.DONE:
+                self.stream.finish()
+            else:
+                self.stream.fail(self._error)
 
     def finish_ok(self, result) -> None:
         self._finish(QueryState.DONE, result=result)

@@ -25,10 +25,11 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.serving.admission import FootprintAdmission
 from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
                                                 QueryHandle,
                                                 QueryTimeoutError,
-                                                bind_query)
+                                                ResultStream, bind_query)
 from spark_rapids_tpu.serving.program_cache import (configure_from_conf,
                                                     plan_key)
 from spark_rapids_tpu.utils.fair_share import (activation_reset, pick_tenant,
@@ -88,6 +89,14 @@ class SessionScheduler:
         self._shutdown = False
         self._workers: List[threading.Thread] = []
         self.program_cache = configure_from_conf(conf)
+        #: footprint admission ledger (serving/admission.py): RUNNING
+        #: queries are charged their working_set_estimate against the
+        #: device budget instead of being bounded by count alone
+        self.admission = FootprintAdmission(conf)
+        self._preempt_enabled = conf.get(cfg.SERVING_PREEMPT_ENABLED)
+        self._preempt_starve_s = (
+            conf.get(cfg.SERVING_PREEMPT_STARVATION_MS) / 1e3)
+        self._preempt_park = conf.get(cfg.SERVING_PREEMPT_PARK)
         self._push_weights_to_semaphore()
 
     # ---- configuration -----------------------------------------------------
@@ -114,14 +123,21 @@ class SessionScheduler:
     # ---- submission --------------------------------------------------------
     def submit(self, query: Any, tenant: str = "default",
                timeout: Optional[float] = None,
-               label: Optional[str] = None) -> QueryHandle:
+               label: Optional[str] = None,
+               stream: Optional[ResultStream] = None) -> QueryHandle:
         """Enqueue a DataFrame or SQL string; returns immediately with the
         query's handle. Planning and execution happen on a worker, so a
-        malformed query FAILS its handle instead of raising here."""
+        malformed query FAILS its handle instead of raising here.
+        ``stream``, when given, receives each result batch as its download
+        resolves — before the final batch exists (the wire layer's
+        streaming-partial-results path)."""
         handle = QueryHandle(query, tenant=tenant,
                              timeout=(timeout if timeout is not None
                                       else self.default_timeout),
-                             label=label)
+                             label=label, stream=stream)
+        handle.preemptible = self._preempt_enabled
+        handle.preempt_starvation_s = self._preempt_starve_s
+        handle.preempt_park_spillable = self._preempt_park
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
@@ -159,7 +175,13 @@ class SessionScheduler:
 
     # ---- fair-share pick ---------------------------------------------------
     def _next_locked(self) -> Optional[QueryHandle]:
-        tenant = pick_tenant((t for t, q in self._queues.items() if q),
+        import time as _time
+        now = _time.monotonic()
+        # admission-requeued heads sit out their deferral (the worker
+        # pool's 0.2 s cv poll re-checks), so a budget-blocked whale
+        # cannot head-of-line-block tenants whose queries would fit
+        tenant = pick_tenant((t for t, q in self._queues.items()
+                              if q and q[0].admit_ready(now)),
                              self._served, self._weights)
         if tenant is None:
             return None
@@ -201,12 +223,34 @@ class SessionScheduler:
         try:
             with bind_query(handle):
                 handle.check_cancelled()
-                df = self._as_dataframe(handle._work)
-                final = df._executed_plan()
-                handle.metrics["plan_key"] = plan_key(final,
-                                                      self.session.conf)
-                handle.mark_running()
-                result = df._collect(query=handle, final=final)
+                if handle._planned is None:
+                    df = self._as_dataframe(handle._work)
+                    final = df._executed_plan()
+                    handle.metrics["plan_key"] = plan_key(final,
+                                                          self.session.conf)
+                    from spark_rapids_tpu.plan.footprint import \
+                        plan_working_set_estimate
+                    handle._planned = (df, final,
+                                       plan_working_set_estimate(final))
+                df, final, estimate = handle._planned
+                # footprint admission: charge the plan's predicted peak
+                # device working set against the budget BEFORE running —
+                # a query that does not fit is REQUEUED (plan cached on
+                # the handle) so this worker stays free for queries that
+                # do fit, instead of OOMing running queries or pinning
+                # the slot while it waits
+                if not self.admission.try_admit(handle, estimate):
+                    if self._requeue_for_admission(handle):
+                        return
+                    raise QueryCancelledError(
+                        f"{handle.label} (id {handle.query_id}) "
+                        f"cancelled at shutdown")
+                try:
+                    handle._planned = None
+                    handle.mark_running()
+                    result = df._collect(query=handle, final=final)
+                finally:
+                    self.admission.release(handle)
             handle.finish_ok(result)
         except QueryCancelledError as e:
             handle.finish_cancelled(e)
@@ -214,6 +258,20 @@ class SessionScheduler:
             handle.finish_failed(e)
         except BaseException as e:      # noqa: BLE001 - surfaces in result()
             handle.finish_failed(e)
+
+    def _requeue_for_admission(self, handle: QueryHandle) -> bool:
+        """Put a budget-rejected handle back at its tenant's HEAD (FIFO
+        preserved) with a short deferral before the next pick. False when
+        the scheduler is shutting down — the caller cancels instead."""
+        import time as _time
+        with self._cv:
+            if self._shutdown:
+                return False
+            handle._admit_not_before = _time.monotonic() + 0.05
+            self._queues.setdefault(handle.tenant,
+                                    deque()).appendleft(handle)
+            self._cv.notify_all()
+            return True
 
     def _as_dataframe(self, work):
         if isinstance(work, str):

@@ -248,6 +248,16 @@ class _FaultyClientConnection(ClientConnection):
         self._inner.receive(ialt, icb)
         return tx
 
+    def cancel_receive(self, tag: int) -> None:
+        """Pass-through of the transport's receive abandonment (tcp.py):
+        drop the matching staged transaction too, so a late completion of
+        a cancelled tag is a no-op instead of a surprise."""
+        with self._lock:
+            self._inflight = [t for t in self._inflight if t.tag != tag]
+        inner = getattr(self._inner, "cancel_receive", None)
+        if inner is not None:
+            inner(tag)
+
     def _drop(self) -> None:
         with self._lock:
             if self._dead:

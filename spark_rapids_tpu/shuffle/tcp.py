@@ -142,6 +142,13 @@ class TcpClientConnection(ClientConnection):
         self._t._post_receive(alt, tx, self._peer)
         return tx
 
+    def cancel_receive(self, tag: int) -> None:
+        """Abandon a posted receive: a timed-out fetch that retries with a
+        fresh tag must not pin its frame-sized buffer in the pending table
+        (or let a late retransmit scribble an abandoned buffer) for the
+        connection's lifetime."""
+        self._t._cancel_receive(tag)
+
 
 class TcpServerConnection(ServerConnection):
     def __init__(self, transport: "TcpTransport"):
@@ -186,10 +193,15 @@ class TcpTransport(ShuffleTransport):
         self._clients: Dict[str, TcpClientConnection] = {}
         self._clients_lock = threading.Lock()
         self._server_conn = TcpServerConnection(self)
-        # worker pool for request handlers (the server copy-executor role)
+        # worker pool for request handlers (the server copy-executor role);
+        # sized by conf: the shuffle data plane needs few, the serving wire
+        # protocol raises it so bounded-poll serve.next handlers from many
+        # clients do not head-of-line-block each other
         import queue as _q
+        from spark_rapids_tpu import config as _cfg
+        self._num_workers = self.conf.get(_cfg.SHUFFLE_TCP_WORKER_THREADS)
         self._work: "_q.Queue[Optional[Callable[[], None]]]" = _q.Queue()
-        for i in range(2):
+        for i in range(self._num_workers):
             threading.Thread(target=self._work_loop, daemon=True,
                              name=f"tcp-shuffle-server-{executor_id}-{i}"
                              ).start()
@@ -323,11 +335,26 @@ class TcpTransport(ShuffleTransport):
         # own state lock (inprocess._TagTable defers the same way)
         self._progress_put(lambda: self._fill(alt, tx, data))
 
+    def _cancel_receive(self, tag: int) -> None:
+        with self._tag_lock:
+            self._pending_recvs.pop(tag, None)
+            self._early_data.pop(tag, None)
+
+    #: bound on frames parked for not-yet-posted receives: legit early
+    #: data (a send racing its recv post) is transient and small in
+    #: count; an UNBOUNDED table would let orphaned tags (duplicate
+    #: frames, retransmits landing after a cancel_receive) accumulate
+    #: frame-sized buffers for the connection's lifetime. Evicting the
+    #: oldest degrades to a receive timeout + retry, never corruption.
+    _EARLY_DATA_CAP = 512
+
     def _on_data(self, tag: int, payload: bytes) -> None:
         with self._tag_lock:
             pending = self._pending_recvs.pop(tag, None)
             if pending is None:
                 self._early_data[tag] = payload   # send raced ahead of recv
+                while len(self._early_data) > self._EARLY_DATA_CAP:
+                    self._early_data.pop(next(iter(self._early_data)))
                 return
         alt, tx, _owner = pending
         self._fill(alt, tx, payload)
@@ -458,6 +485,6 @@ class TcpTransport(ShuffleTransport):
             pass
         for p in list(self._peers.values()):
             p.close()
-        self._work.put(None)
-        self._work.put(None)
+        for _ in range(self._num_workers):
+            self._work.put(None)
         self._progress.put(None)

@@ -100,6 +100,27 @@ MEMORY_METRIC_NAMES = (
     MEM_PRESSURE_EVENTS, MEM_SPILL_PARTITIONS, MEM_RECURSION_DEPTH,
     MEM_SPILLED_TO_HOST, MEM_SPILLED_TO_DISK)
 
+# Network-serving counters (process-global like the wire they observe; the
+# per-action delta lands in session.last_metrics["serving"], and per-query
+# stream/preemption counts additionally ride QueryHandle.metrics).
+#: bytes of Arrow-IPC result frames the query server pushed to clients
+#: (retransmits of a corrupted frame count again — this is wire traffic)
+SERVING_WIRE_BYTES_OUT = "serving.wire_bytes_out"
+#: result batches streamed to clients (each counted once, at first send)
+SERVING_STREAM_BATCHES = "serving.stream_batches"
+#: batch-granularity preemptions: a running query yielded its device
+#: permit to a starved tenant at an exec-boundary checkpoint
+SERVING_PREEMPTIONS = "serving.preemptions"
+#: queries made to WAIT by footprint admission because their
+#: working_set_estimate did not fit the free device budget
+SERVING_ADMISSION_REJECTIONS = "serving.admission_rejections_footprint"
+#: corrupted result frames a client caught by checksum and re-fetched
+SERVING_WIRE_RETRIES = "serving.wire_retries"
+
+SERVING_METRIC_NAMES = (
+    SERVING_WIRE_BYTES_OUT, SERVING_STREAM_BATCHES, SERVING_PREEMPTIONS,
+    SERVING_ADMISSION_REJECTIONS, SERVING_WIRE_RETRIES)
+
 # Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
 # unlike the per-operator MetricSets — which live on per-action plan nodes —
 # and the process-global transfer counters, these are scoped to ONE query
@@ -189,6 +210,24 @@ TRANSFER_METRICS = MetricSet(*TRANSFER_METRIC_NAMES)
 
 #: process-global memory-pressure counters (see MEMORY_METRIC_NAMES above)
 MEMORY_METRICS = MetricSet(*MEMORY_METRIC_NAMES)
+
+#: process-global network-serving counters (see SERVING_METRIC_NAMES above)
+SERVING_METRICS = MetricSet(*SERVING_METRIC_NAMES)
+
+
+def serving_snapshot() -> Dict[str, float]:
+    """Action-start marker for ``serving_delta`` (all counters additive)."""
+    return SERVING_METRICS.snapshot()
+
+
+def serving_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-action serving stats: counter deltas since ``before``. Like the
+    transfer section, counters are process-global — under concurrent
+    queries an action's delta can include overlapping queries' wire
+    traffic and preemptions; per-query exact counts live on the handle."""
+    now = SERVING_METRICS.snapshot()
+    return {name: now[name] - before.get(name, 0)
+            for name in SERVING_METRIC_NAMES}
 
 
 def memory_snapshot() -> Dict[str, float]:

@@ -745,6 +745,50 @@ def test_r008_suppression_applies():
     assert run(fs, {"R008"}) == []
 
 
+def test_r008_connection_handle_leak():
+    """The serving wire layer's resource kind: a transport.connect()
+    (socket + reader thread) that escapes on an early-exit path without
+    close() or a handoff leaks the connection — the shape a routing
+    client's dial-then-bail bug takes."""
+    fs = src("""
+        class Router:
+            def dial(self, peer):
+                conn = self.transport.connect(peer)
+                if not self.accepting:
+                    return None
+                self._conns[peer] = conn
+                return conn
+        """, path="serving/client.py")
+    found = run(fs, {"R008"})
+    assert len(found) == 1
+    assert "connection handle never close()d" in found[0].message
+    assert "'conn'" in found[0].message
+
+
+def test_r008_connection_handoffs_clean():
+    """All three sanctioned connection handoffs end tracking: caching into
+    a container, returning, and passing into a wrapping constructor whose
+    result is bound (the shuffle manager's ShuffleClient idiom)."""
+    fs = src("""
+        class Router:
+            def cache(self, peer):
+                conn = self.transport.connect(peer)
+                self._conns[peer] = conn
+                return conn
+            def wrap(self, peer):
+                conn = self.transport.connect(peer)
+                client = WireClient(self.transport, conn)
+                self._clients[peer] = client
+            def scoped(self, peer):
+                conn = self.transport.connect(peer)
+                try:
+                    return self.handshake(conn)
+                finally:
+                    conn.close()
+        """, path="serving/client.py")
+    assert run(fs, {"R008"}) == []
+
+
 # ------------------------------------------------------------------ R009
 def test_r009_seeded_two_lock_cycle():
     fs = src("""
@@ -927,6 +971,31 @@ def test_r010_wait_with_timeout_clean():
                 while not self._done_event.wait(0.05):
                     ctx.check_cancelled()
         """, path="execs/foo.py")
+    assert run(fs, {"R010"}) == []
+
+
+def test_r010_server_accept_loop_unbounded_flagged():
+    """The serving server's run loop is a root: an UNBOUNDED wait there
+    pins the process through signals and shutdown — serve_forever must
+    poll bounded."""
+    fs = src("""
+        class QueryServer:
+            def serve_forever(self):
+                self._stop_event.wait()
+        """, path="serving/server.py")
+    found = run(fs, {"R010"})
+    assert len(found) == 1 and "_stop_event.wait()" in found[0].message
+
+
+def test_r010_server_accept_loop_bounded_poll_clean():
+    """The sanctioned shape the real server uses: a bounded poll on the
+    stop latch."""
+    fs = src("""
+        class QueryServer:
+            def serve_forever(self):
+                while not self._stop_event.wait(0.5):
+                    pass
+        """, path="serving/server.py")
     assert run(fs, {"R010"}) == []
 
 
