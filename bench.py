@@ -161,6 +161,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
     # ---- out-of-core degradation (ample vs 1/4 budget) ----------------------
     out_of_core = _bench_out_of_core(table, conf, scale)
 
+    # ---- structured tracing: disabled cost + span coverage ------------------
+    observability = _bench_observability(table, conf, iters)
+
     # ---- columnar shuffle partition rate (GB/s/chip) ------------------------
     shuffle_gbps = _bench_shuffle(batch, iters)
     exchange_gbps = _bench_full_exchange(batch, conf, iters)
@@ -207,6 +210,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
             "concurrent": concurrent,
             "serving_net": serving_net,
             "out_of_core": out_of_core,
+            "observability": observability,
             "mesh": mesh_section,
             "end_to_end_collect_s": round(e2e_s, 4),
             "end_to_end_rows_per_sec": round(n_rows / e2e_s),
@@ -719,6 +723,76 @@ def _bench_out_of_core(table, conf: dict, scale: float) -> dict:
         assert out[name]["spill_partitions"] >= 2, out[name]
     DeviceManager.shutdown()
     return out
+
+
+def _bench_observability(table, conf: dict, iters: int) -> dict:
+    """Structured tracing (utils/tracing.py): Q1 warm with tracing OFF vs
+    ON — span counts per layer, export validity, EXPLAIN ANALYZE — plus
+    the deterministic disabled-cost bound: the disabled hook is one bool
+    read + a shared no-op context manager, so (per-hook ns x observed
+    hook sites) / warm wall bounds the tracing-off overhead without
+    depending on run-to-run timer noise. The <2% acceptance gate rides
+    that bound (ci/nightly.sh bench-smoke)."""
+    import json as _json
+    import tempfile
+    from spark_rapids_tpu.api import TpuSession
+    from spark_rapids_tpu.benchmarks.tpch import q1
+    from spark_rapids_tpu.utils import tracing
+
+    reps = max(3, min(5, iters))
+
+    def warm_best(sess):
+        df = q1(sess.create_dataframe(table))
+        df.collect()                # warm: programs + scan cache
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            df.collect()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    off_s = warm_best(TpuSession(conf))
+    # NO export path on the timed session: the per-action JSON write is
+    # O(spans) file serialization and would inflate tracing_on_overhead_x
+    on_sess = TpuSession({**conf,
+                          "spark.rapids.tpu.trace.enabled": "true"})
+    on_s = warm_best(on_sess)
+    export = tempfile.mktemp(prefix="bench-trace-", suffix=".json")
+    tracing.export_chrome(on_sess.last_trace, export)   # untimed
+    doc = _json.load(open(export))
+    events = doc.get("traceEvents", [])
+    counts = tracing.layer_counts(on_sess.last_trace)
+    analyze = on_sess.explain_analyze()
+
+    # disabled-hook microbench: per-call cost of a span site with tracing
+    # off. The guarded call-site shape is representative: hot sites check
+    # TRACER.on BEFORE building their args dict, so the disabled path is
+    # the bool read + the shared no-op context manager.
+    n_calls = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        cm = (tracing.span("bench", "exec", {"rows": n_calls, "b": 1})
+              if tracing.TRACER.on else tracing._NULL_SPAN)
+        with cm:
+            pass
+    disabled_hook_ns = (time.perf_counter() - t0) / n_calls * 1e9
+    hook_sites = max(sum(counts.values()), 1)
+    off_overhead_pct = disabled_hook_ns * hook_sites / (off_s * 1e9) * 100
+
+    return {
+        "q1_warm_off_s": round(off_s, 4),
+        "q1_warm_on_s": round(on_s, 4),
+        "tracing_on_overhead_x": round(on_s / off_s, 3),
+        "disabled_hook_ns": round(disabled_hook_ns, 1),
+        "hook_sites_per_action": hook_sites,
+        #: deterministic bound on the tracing-OFF cost of the hooks
+        "tracing_off_overhead_pct": round(off_overhead_pct, 4),
+        "spans_total": len(events),
+        "spans_by_layer": counts,
+        "export_valid": bool(events)
+        and all(e.get("ph") in ("X", "i") for e in events),
+        "explain_analyze_ok": ("rows=" in analyze and "wall=" in analyze),
+    }
 
 
 def _bench_shuffle(batch, iters: int) -> float:

@@ -260,6 +260,22 @@ for qname in ("q1", "q3_shaped"):
     assert sec["quarter_budget_rows_per_sec"] > 0, sec
 assert (ooc["q1"]["bytes_spilled_to_host"]
         + ooc["q3_shaped"]["bytes_spilled_to_host"]) > 0, ooc
+obs = out["breakdown"]["observability"]
+for key in ("q1_warm_off_s", "q1_warm_on_s", "tracing_on_overhead_x",
+            "disabled_hook_ns", "tracing_off_overhead_pct", "spans_total",
+            "spans_by_layer", "export_valid", "explain_analyze_ok"):
+    assert key in obs, f"missing observability breakdown key {key}: {obs}"
+# observability acceptance: the traced Q1 exports valid Chrome trace JSON
+# with spans from the exec/transfer/serving layers (memory spans need the
+# grace path — premerge's forced-partition smoke covers that layer), the
+# EXPLAIN ANALYZE render carries observed rows+wall, and tracing DISABLED
+# costs < 2% of the warm wall by the deterministic per-hook bound
+assert obs["export_valid"] is True, obs
+assert obs["explain_analyze_ok"] is True, obs
+assert obs["spans_total"] >= 3, obs
+for layer in ("exec", "transfer", "serving"):
+    assert obs["spans_by_layer"].get(layer, 0) >= 1, obs
+assert obs["tracing_off_overhead_pct"] < 2.0, obs
 sn = out["breakdown"]["serving_net"]
 for key in ("wire_wall_s", "wire_bytes_out", "stream_batches",
             "first_batch_before_done", "stream_bit_identical",
@@ -323,6 +339,9 @@ print("bench smoke OK:", {k: pipe[k] for k in
       {"out_of_core_q1": {k: ooc["q1"][k] for k in
                           ("spill_partitions", "recursion_depth_peak",
                            "quarter_vs_ample_x")}},
+      {"observability": {k: obs[k] for k in
+                         ("tracing_on_overhead_x",
+                          "tracing_off_overhead_pct", "spans_total")}},
       {"warm_start_disk_hits": conc["warm_start"]["disk_hits"]},
       {k: mesh[k] for k in ("in_mesh_exchange_gb_per_sec",
                             "in_mesh_vs_host_hop_x", "host_hop_bytes")})

@@ -11,7 +11,7 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 echo "== config docs in sync =="
 python -m spark_rapids_tpu.analysis --check-configs
 
-echo "== tpu-lint (full rule set R001-R010 incl. interprocedural R008-R010; fails on non-baselined findings) =="
+echo "== tpu-lint (full rule set R001-R011 incl. interprocedural R008-R010; fails on non-baselined findings) =="
 lint_start=$(date +%s)
 python -m spark_rapids_tpu.analysis spark_rapids_tpu/
 lint_elapsed=$(( $(date +%s) - lint_start ))
@@ -179,6 +179,40 @@ DeviceManager.shutdown()
 print("out-of-core smoke ok:", {k: mm[k] for k in
       ("memory.spill_partitions", "memory.recursion_depth_peak",
        "memory.bytes_spilled_to_host", "memory.bytes_spilled_to_disk")})
+PY
+
+echo "== tracing smoke (Q1 traced action: EXPLAIN ANALYZE + Perfetto export, >= 1 span per layer) =="
+python - << 'PY'
+import json, tempfile
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF, gen_lineitem, q1
+from spark_rapids_tpu.utils import tracing
+
+export = tempfile.mktemp(prefix="premerge-trace-", suffix=".json")
+# forced grace partitions: the memory layer (grace split + spill events)
+# must appear alongside exec/transfer/serving in the exported trace
+sess = TpuSession({**BENCH_CONF,
+                   "spark.rapids.tpu.sql.string.maxBytes": "16",
+                   "spark.rapids.tpu.trace.enabled": "true",
+                   "spark.rapids.tpu.trace.export.path": export,
+                   "spark.rapids.tpu.memory.outOfCore.forcePartitions": "2"})
+lineitem = gen_lineitem(scale=0.005, seed=42)
+handle = sess.submit(q1(sess.create_dataframe(lineitem)))
+result = handle.result(timeout=300)
+assert result.num_rows > 0
+doc = json.load(open(export))
+events = doc["traceEvents"]
+assert events and all(e["ph"] in ("X", "i") for e in events), "bad export"
+layers = {}
+for e in events:
+    layers[e["cat"]] = layers.get(e["cat"], 0) + 1
+for layer in ("exec", "transfer", "memory", "serving"):
+    assert layers.get(layer, 0) >= 1, f"no {layer} spans: {layers}"
+analyzed = handle.explain_analyze()
+assert "rows=" in analyzed and "wall=" in analyzed, analyzed
+assert "spill=" in analyzed, analyzed          # forced grace is visible
+assert handle.metrics["recursion_depth_peak"] >= 1, handle.metrics
+print("tracing smoke ok:", layers)
 PY
 
 echo "== multichip dry-run (8 virtual devices) =="

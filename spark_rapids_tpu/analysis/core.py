@@ -103,6 +103,7 @@ def _load_builtin_rules() -> None:
                                            rules_dtype,      # noqa: F401
                                            rules_lockorder,  # noqa: F401
                                            rules_locks,      # noqa: F401
+                                           rules_metrics,    # noqa: F401
                                            rules_project,    # noqa: F401
                                            rules_recompile,  # noqa: F401
                                            rules_resource,   # noqa: F401

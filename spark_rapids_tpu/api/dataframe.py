@@ -567,10 +567,15 @@ class DataFrame:
         dm = DeviceManager.initialize(self.session.conf)
         cleanups: List = []
         tables = []
-        # spark.rapids.tpu.trace.enabled: the whole action shows up as one
-        # named range in the XLA/TensorBoard profile (NVTX analog); when
-        # metrics are on, per-operator counters land in session.last_metrics
+        # spark.rapids.tpu.trace.enabled: structured span tracing for the
+        # whole action (utils/tracing.py — per-exec spans, transfer/memory/
+        # serving layers, EXPLAIN ANALYZE and the Chrome export) plus the
+        # action-level jax.profiler range (NVTX analog); when metrics are
+        # on, per-operator counters land in session.last_metrics
+        import contextlib
+        from spark_rapids_tpu.utils import tracing as _tracing
         from spark_rapids_tpu.utils.metrics import (NamedRange,
+                                                    action_depth_scope,
                                                     memory_delta,
                                                     memory_snapshot,
                                                     serving_delta,
@@ -578,19 +583,43 @@ class DataFrame:
                                                     transfer_delta,
                                                     transfer_snapshot)
         trace = self.session.conf.get(_cfg.TRACE_ENABLED)
+        if trace:
+            _tracing.TRACER.configure(
+                self.session.conf.get(_cfg.TRACE_BUFFER_SPANS))
+        trace_scope = (_tracing.TRACER.activate() if trace
+                       else contextlib.nullcontext())
         transfer_before = transfer_snapshot()
         memory_before = memory_snapshot()
         serving_before = serving_snapshot()
         import time as _time
+        # stable node ordinals: the span/EXPLAIN-ANALYZE key (pre-order,
+        # matching the f"{i}:{name}" keys of session.last_metrics)
+        for i, nd in enumerate(_iter_execs(final)):
+            nd.plan_id = i
         tenant = query.tenant if query is not None else "default"
         cancel = query.check_cancelled if query is not None else None
+        # one stack for the action-scoped contexts (depth attribution +
+        # tracer activation): entered before the admission wait so the
+        # wait is traced, unwound in the finally below even when a
+        # cleanup fn raises — a stuck activation would leave the
+        # process-wide tracer on for every later query
+        scopes = contextlib.ExitStack()
+        depth_holder = scopes.enter_context(action_depth_scope())
+        scopes.enter_context(trace_scope)
+        trace_mark = _tracing.TRACER.mark()
+        t_wall = _time.perf_counter()
         t_admit = _time.perf_counter()
+        t_admit_ns = _time.perf_counter_ns()
         try:
             # device-admission throttle for the whole task (GpuSemaphore
             # analog), fair-shared by tenant; a cancelled query blocked on
             # admission unwinds here instead of waiting for a permit
             with dm.semaphore.held(tenant=tenant, cancel_check=cancel), \
                     NamedRange("tpu-sql-action", trace=trace):
+                _tracing.record("serving.admission_wait", "serving",
+                                t_admit_ns,
+                                _time.perf_counter_ns() - t_admit_ns,
+                                {"tenant": tenant})
                 if query is not None:
                     query.note_admission_wait(_time.perf_counter() - t_admit)
                 if self.session.conf.get(_cfg.ADAPTIVE_ENABLED) and \
@@ -607,6 +636,8 @@ class DataFrame:
                                             cleanups=cleanups, query=query)
                     final = adaptive_rewrite(final, stage_ctx)
                     self.session.last_plan = final
+                    for i, nd in enumerate(_iter_execs(final)):
+                        nd.plan_id = i      # rewritten plan: fresh ordinals
                 from spark_rapids_tpu.execs.tpu_execs import DeviceToHostExec
                 if (capture_device and isinstance(final, DeviceToHostExec)
                         and not any(getattr(nd, "is_mesh", False)
@@ -674,8 +705,13 @@ class DataFrame:
                             if query is not None:
                                 query.emit_batch(t)
         finally:
-            for fn in cleanups:
-                fn()
+            try:
+                for fn in cleanups:
+                    fn()
+            finally:
+                self.session.last_action_wall_s = (_time.perf_counter()
+                                                   - t_wall)
+                scopes.close()
             if self.session.conf.get(_cfg.METRICS_ENABLED):
                 # build the whole snapshot FIRST, then publish with ONE
                 # attribute store: two interleaved actions used to mutate
@@ -690,15 +726,31 @@ class DataFrame:
                 # per-action delta includes overlapping queries' traffic)
                 snap["transfer"] = transfer_delta(transfer_before)
                 # out-of-core story for the action: pressure events, grace
-                # partitions, recursion peak, bytes spilled per tier
-                # (process-global like the tiered store they observe)
-                snap["memory"] = memory_delta(memory_before)
+                # partitions, recursion peak, bytes spilled per tier. The
+                # recursion peak is the ACTION-SCOPED maximum (thread/
+                # query-bound attribution, not the shared re-armed global
+                # whose concurrent-overlap misattribution PR 11 documented)
+                snap["memory"] = memory_delta(memory_before,
+                                              recursion_peak=(
+                                                  depth_holder.peak))
                 # serving story: wire bytes/batches streamed, preemptions,
                 # footprint-admission rejections over the action's window
                 snap["serving"] = serving_delta(serving_before)
                 if query is not None:
                     query.record_exec_metrics(snap)
                 self.session.last_metrics = snap
+            if trace:
+                # the action's span window: kept on the session for
+                # introspection and exported per trace.export.path (the
+                # file is rewritten per action — last-action semantics)
+                records = _tracing.TRACER.since(trace_mark)
+                self.session.last_trace = records
+                export = self.session.conf.get(_cfg.TRACE_EXPORT_PATH)
+                if export:
+                    _tracing.export_chrome(
+                        records, export,
+                        metadata={"action_wall_s": round(
+                            self.session.last_action_wall_s, 6)})
         return tables
 
     def collect(self) -> pa.Table:
@@ -1170,6 +1222,12 @@ class TpuSession:
         #: Under concurrent serving this is a last-writer-wins alias —
         #: read QueryHandle.exec_metrics for a specific query's snapshot.
         self.last_metrics: Dict[str, Dict[str, int]] = {}
+        #: wall-clock seconds of the last action (EXPLAIN ANALYZE header)
+        self.last_action_wall_s: float = 0.0
+        #: span window of the last TRACED action (trace.enabled) — the
+        #: records export_chrome() writes; last-writer-wins like
+        #: last_metrics (per-query spans live on the QueryHandle)
+        self.last_trace: list = []
         self._views: Dict[str, DataFrame] = {}
         self.cache_manager = CacheManager(self)
         self._scheduler = None
@@ -1180,6 +1238,21 @@ class TpuSession:
         self.cache_manager.clear()
 
     clearCache = clear_cache
+
+    def explain_analyze(self, print_out: bool = False) -> str:
+        """EXPLAIN ANALYZE of the LAST action: the physical plan annotated
+        with each node's OBSERVED rows / batches / wall / self time / spill
+        (Spark-UI style). Requires the action to have run with
+        ``trace.enabled`` — without it the tree renders without stats.
+        Per-node self times sum (within driver slack) to the action wall."""
+        if self.last_plan is None:
+            raise RuntimeError("no action has run yet")
+        text = (f"== Physical plan with observed stats "
+                f"(action wall {self.last_action_wall_s:.3f}s) ==\n"
+                + self.last_plan.tree_string(analyze=True))
+        if print_out:
+            print(text)
+        return text
 
     # ---- concurrent serving -----------------------------------------------
     @property

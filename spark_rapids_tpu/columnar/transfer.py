@@ -36,6 +36,7 @@ import jax
 from spark_rapids_tpu.columnar.batch import (DEFAULT_STRING_MAX_BYTES,
                                              DeviceBatch, fetched_to_arrow)
 from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils import tracing as _tracing
 
 
 def _batch_arrays(batch: DeviceBatch) -> List[Any]:
@@ -100,10 +101,18 @@ def upload_table(table: pa.Table,
     """
     m = um.TRANSFER_METRICS
     t_start = time.perf_counter()
+    t_start_ns = time.perf_counter_ns()
     bounds = chunk_bounds(table, chunk_rows)
     if len(bounds) < 2:
-        batch = DeviceBatch.from_arrow(table, string_max_bytes, device=device,
-                                       with_bits=with_bits)
+        # args dicts build only when tracing is live — the per-upload
+        # disabled cost stays one bool read (the <2% nightly bound)
+        span = (_tracing.span("transfer.upload", "transfer",
+                              {"rows": table.num_rows, "chunks": 1})
+                if _tracing.TRACER.on else _tracing._NULL_SPAN)
+        with span:
+            batch = DeviceBatch.from_arrow(table, string_max_bytes,
+                                           device=device,
+                                           with_bits=with_bits)
         if stats is not None:
             # bench instrumentation wants the honest transfer wall; the
             # engine path must NOT sync — the async device_put overlapping
@@ -136,9 +145,17 @@ def upload_table(table: pa.Table,
         # capacity, so the slice/concat programs of the assembly below hit
         # XLA's compile cache across tables instead of compiling per exact
         # chunk-size tuple (padding is built ON DEVICE — no link bytes)
-        b = DeviceBatch.from_arrow(table.slice(start, end - start),
-                                   string_max_bytes, device=device,
-                                   with_bits=with_bits)
+        # (span timestamps are the staging call boundaries that already
+        # exist — the async device_put is NOT awaited, per R002; the args
+        # dict builds only when tracing is live)
+        span = (_tracing.span("transfer.upload_chunk", "transfer",
+                              {"rows": end - start, "offset": start,
+                               "inflight": len(inflight)})
+                if _tracing.TRACER.on else _tracing._NULL_SPAN)
+        with span:
+            b = DeviceBatch.from_arrow(table.slice(start, end - start),
+                                       string_max_bytes, device=device,
+                                       with_bits=with_bits)
         t1 = time.perf_counter()
         stage_total += t1 - t0
         per_chunk.append(round(t1 - t0, 4))
@@ -160,6 +177,12 @@ def upload_table(table: pa.Table,
     m[um.TRANSFER_UPLOAD_SECONDS].add(wall)
     m[um.TRANSFER_UPLOAD_CHUNKS].add(len(chunks))
     m[um.TRANSFER_INFLIGHT_PEAK].set_max(peak)
+    if _tracing.TRACER.on:
+        _tracing.record("transfer.upload", "transfer", t_start_ns,
+                        time.perf_counter_ns() - t_start_ns,
+                        {"rows": n, "chunks": len(chunks),
+                         "inflight_peak": peak,
+                         "bytes": out.device_size_bytes})
     if stats is not None:
         # fraction of the upload wall covered by productive host staging:
         # 1.0 = every transfer fully hidden behind staging; a serial
@@ -194,6 +217,9 @@ class PendingDownload:
         self._schema = batch.schema
         self._num_rows = batch.num_rows
         self._sliced = batch.sliced_buffers()
+        #: dispatch timestamp — the span start (an existing boundary: the
+        #: copy_to_host_async enqueue; resolution stamps the end, R002)
+        self._t_dispatch_ns = time.perf_counter_ns()
         nbytes = 0
         for data, validity, lengths in self._sliced:
             for arr in (data, validity, lengths):
@@ -217,6 +243,15 @@ class PendingDownload:
         m = um.TRANSFER_METRICS
         m[um.TRANSFER_DOWNLOAD_BYTES].add(self.nbytes)
         m[um.TRANSFER_DOWNLOAD_SECONDS].add(dt)
+        # dispatch -> resolve window: the overlapped D2H the Perfetto view
+        # shows riding under the remaining compute (streaming collect).
+        # Per-batch path: the args dict builds only when tracing is live.
+        if _tracing.TRACER.on:
+            _tracing.record("transfer.download", "transfer",
+                            self._t_dispatch_ns,
+                            time.perf_counter_ns() - self._t_dispatch_ns,
+                            {"bytes": self.nbytes, "rows": self._num_rows,
+                             "resolve_ms": round(dt * 1e3, 3)})
         return fetched_to_arrow(self._schema, fetched, self._num_rows)
 
 

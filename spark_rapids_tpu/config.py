@@ -791,7 +791,41 @@ METRICS_ENABLED = _conf(
 
 TRACE_ENABLED = _conf(
     "trace.enabled", bool, False,
-    "Emit named jax.profiler ranges per operator (analog of NVTX ranges).")
+    "Structured query tracing (utils/tracing.py): record per-operator "
+    "execute() spans (rows/batches/bytes, wall + self time, keyed by plan "
+    "node id), transfer chunk upload / async download spans, shuffle "
+    "fetch/retry events, grace partition/spill events, and serving "
+    "lifecycle/admission/preemption/wire spans into a bounded ring "
+    "buffer, and emit a named jax.profiler range PER OPERATOR (analog of "
+    "the NVTX ranges). Feeds EXPLAIN ANALYZE (tree_string(analyze=True) "
+    "/ QueryHandle.explain_analyze()) and the Chrome/Perfetto trace "
+    "export. Off: every hook reduces to one boolean read (overhead "
+    "gated in the nightly bench).")
+
+TRACE_EXPORT_PATH = _conf(
+    "trace.export.path", str, "",
+    "When set (and trace.enabled), each action writes its span window as "
+    "Chrome trace-event JSON to this path on completion — loadable in "
+    "ui.perfetto.dev / chrome://tracing to inspect overlapped pipelines "
+    "(chunked upload vs compute, streaming D2H). The file is rewritten "
+    "per action (last-action semantics, like session.last_metrics); use "
+    "QueryHandle.export_trace(path) for one specific query's spans.")
+
+TRACE_BUFFER_SPANS = _conf(
+    "trace.maxBufferedSpans", int, 65536,
+    "Capacity of the tracing ring buffer: a long-running traced server "
+    "overwrites its oldest spans past this bound instead of growing "
+    "without limit. Exports and EXPLAIN ANALYZE see at most this many "
+    "trailing spans.", checker=_positive("trace.maxBufferedSpans"))
+
+SERVING_STATS_WINDOW = _conf(
+    "serving.stats.windowSeconds", float, 300.0,
+    "Rolling window of the serve.stats time-series (serving/stats.py): "
+    "per-replica gauge samples (device budget in use, admission queue "
+    "depth, running/queued per tenant) and query wall times older than "
+    "this are dropped; p50/p99 query wall is computed over the window. "
+    "The feed load-aware replica routing consumes (ROADMAP item 4).",
+    checker=_positive("serving.stats.windowSeconds"))
 
 
 class TpuConf:

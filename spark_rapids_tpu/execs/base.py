@@ -8,13 +8,29 @@ GpuColumnarToRowExec analogs).
 """
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.columnar.dtypes import Schema
 from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.utils import tracing as _tracing
 from spark_rapids_tpu.utils.metrics import (MetricSet, NUM_OUTPUT_BATCHES,
                                             NUM_OUTPUT_ROWS, TOTAL_TIME)
+
+
+def _traced_execute(raw):
+    """Span hook around one exec class's ``execute``: with tracing off the
+    only cost is one bool read; on, the iteration is timed per node (self
+    vs child time), observed rows/batches/bytes accumulate for EXPLAIN
+    ANALYZE, and each pull shows as a named jax.profiler range."""
+    @functools.wraps(raw)
+    def execute(self, ctx):
+        if not _tracing.TRACER.on:
+            return raw(self, ctx)
+        return _tracing.trace_exec(self, ctx, raw)
+    execute._tpu_trace_hook = True
+    return execute
 
 
 class ExecContext:
@@ -112,6 +128,18 @@ class PhysicalExec:
     #: runtime pressure (memory/grace.py).
     grace_partitions: int = 0
 
+    #: stable node ordinal within one executed plan (pre-order, stamped by
+    #: the action driver before execution): the span key EXPLAIN ANALYZE
+    #: and the trace export join on — the reference keys per-exec metrics
+    #: the same way (SparkPlan node ids in the SQL UI).
+    plan_id: Optional[int] = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        raw = cls.__dict__.get("execute")
+        if raw is not None and not getattr(raw, "_tpu_trace_hook", False):
+            cls.execute = _traced_execute(raw)
+
     def __init__(self, children: Sequence["PhysicalExec"], output: Schema):
         self.children: Tuple[PhysicalExec, ...] = tuple(children)
         self.output = output
@@ -150,14 +178,20 @@ class PhysicalExec:
         return None
 
     # ---- plan display ---------------------------------------------------------
-    def tree_string(self, indent: int = 0) -> str:
+    def tree_string(self, indent: int = 0, analyze: bool = False) -> str:
+        """Plan tree rendering. ``analyze=True`` appends each node's
+        OBSERVED execution stats — rows / batches / wall / self time /
+        grace spill — collected by the tracing span hooks (EXPLAIN
+        ANALYZE; requires the action to have run with trace.enabled)."""
         tag = ""
         if self.placement is not None:
             from spark_rapids_tpu.parallel.placement import placement_label
             tag = f" @{placement_label(self.placement)}"
+        if analyze:
+            tag += _tracing.analyze_annotation(self)
         lines = ["  " * indent + f"{self.name} [{self.output}]{tag}"]
         for c in self.children:
-            lines.append(c.tree_string(indent + 1))
+            lines.append(c.tree_string(indent + 1, analyze=analyze))
         return "\n".join(lines)
 
     def transform_up(self, fn) -> "PhysicalExec":

@@ -106,16 +106,22 @@ class FusedStageExec(PhysicalExec):
         return self.children[0].size_estimate()
 
     # ---- plan display ------------------------------------------------------
-    def tree_string(self, indent: int = 0) -> str:
+    def tree_string(self, indent: int = 0, analyze: bool = False) -> str:
+        from spark_rapids_tpu.utils import tracing as _tracing
         tag = ""
         if self.placement is not None:
             from spark_rapids_tpu.parallel.placement import placement_label
             tag = f" @{placement_label(self.placement)}"
         lines = []
         for i, (name, schema) in enumerate(self.fused_ops):
+            # observed stats attach to the stage HEAD (the fused interior
+            # never materializes, so per-interior-op rows do not exist)
+            obs = _tracing.analyze_annotation(self) if analyze and i == 0 \
+                else ""
             lines.append("  " * (indent + i)
-                         + f"*({self.stage_id}) {name} [{schema}]{tag}")
-        lines.append(self.children[0].tree_string(indent + len(self.fused_ops)))
+                         + f"*({self.stage_id}) {name} [{schema}]{tag}{obs}")
+        lines.append(self.children[0].tree_string(
+            indent + len(self.fused_ops), analyze=analyze))
         return "\n".join(lines)
 
     # ---- execution ---------------------------------------------------------
@@ -248,17 +254,21 @@ class FusedAggregateStageExec(te.TpuHashAggregateExec):
         self.fused_ops = tuple(fused_ops)   # folded ops below the aggregate
         self.metrics[FUSED_OPS].add(len(self.fused_ops) + 1)
 
-    def tree_string(self, indent: int = 0) -> str:
+    def tree_string(self, indent: int = 0, analyze: bool = False) -> str:
+        from spark_rapids_tpu.utils import tracing as _tracing
         tag = ""
         if self.placement is not None:
             from spark_rapids_tpu.parallel.placement import placement_label
             tag = f" @{placement_label(self.placement)}"
+        if analyze:
+            tag += _tracing.analyze_annotation(self)
         # the folded ops are NOT rendered (their expressions live inside the
         # aggregate now — same display contract as the fuse_device_ops fold)
         lines = ["  " * indent
                  + f"*({self.stage_id}) TpuHashAggregateExec "
                    f"[{self.output}]{tag}"]
-        lines.append(self.children[0].tree_string(indent + 1))
+        lines.append(self.children[0].tree_string(indent + 1,
+                                                  analyze=analyze))
         return "\n".join(lines)
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:

@@ -417,20 +417,28 @@ class TpuParquetScanExec(_ParquetScanBase):
         smax = ctx.string_max_bytes
 
         def produce() -> None:
-            try:
-                for t in self._iter_arrow(ctx):
-                    # staging + device_put happen HERE, ahead of the
-                    # consumer; the upload is already in flight when the
-                    # consumer dequeues the batch. ctx.device rides along so
-                    # multi-device placement doesn't silently default.
-                    b = upload_table_conf(t, smax, ctx.conf,
-                                          device=ctx.device)
-                    if not _put_abortable(q, ("b", b), stop):
-                        return      # consumer abandoned the scan early
-            except BaseException as e:  # noqa: BLE001 - reraised below
-                _put_abortable(q, ("e", e), stop)
-                return
-            _put_abortable(q, ("end", None), stop)
+            # rebind the owning query thread-locally (the PipelinedExec
+            # producer discipline): program-cache attribution AND the
+            # tracing spans this thread records (chunk uploads) carry the
+            # query id, so per-query trace exports include the prefetched
+            # scan's transfer spans
+            from spark_rapids_tpu.serving.lifecycle import bind_query
+            with bind_query(ctx.query):
+                try:
+                    for t in self._iter_arrow(ctx):
+                        # staging + device_put happen HERE, ahead of the
+                        # consumer; the upload is already in flight when
+                        # the consumer dequeues the batch. ctx.device
+                        # rides along so multi-device placement doesn't
+                        # silently default.
+                        b = upload_table_conf(t, smax, ctx.conf,
+                                              device=ctx.device)
+                        if not _put_abortable(q, ("b", b), stop):
+                            return  # consumer abandoned the scan early
+                except BaseException as e:  # noqa: BLE001 - reraised below
+                    _put_abortable(q, ("e", e), stop)
+                    return
+                _put_abortable(q, ("end", None), stop)
 
         worker = threading.Thread(target=produce, daemon=True,
                                   name="parquet-scan-prefetch")

@@ -66,6 +66,7 @@ from spark_rapids_tpu.memory.buffer import BufferId
 from spark_rapids_tpu.memory.faults import plan_for_conf
 from spark_rapids_tpu.memory.store import GRACE_PARTITION_PRIORITY
 from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils import tracing as _tracing
 
 #: table-id namespace for grace partition buffers, distinct from the
 #: shuffle catalog (counts up from 1 << 20) and df_cache (1 << 28)
@@ -238,6 +239,9 @@ class GraceController:
 
     def _record_pressure(self) -> None:
         um.MEMORY_METRICS[um.MEM_PRESSURE_EVENTS].add(1)
+        _tracing.instant("memory.pressure", "memory",
+                         {"op": self.kind,
+                          "exec": type(self.exec).__name__})
         self.triggered = True
 
     # ---- staging ---------------------------------------------------------------
@@ -387,16 +391,24 @@ class GraceController:
             batches = itertools.chain(head, batches)
         parts = SpillablePartitions(self.store, self.catalog, n, depth)
         um.MEMORY_METRICS[um.MEM_SPILL_PARTITIONS].add(n)
-        um.MEMORY_METRICS[um.MEM_RECURSION_DEPTH].set_max(depth + 1)
+        # depth attribution: process-lifetime global + the thread-bound
+        # action scope + the owning query handle (NOT the old re-armed
+        # global, whose concurrent-overlap misattribution PR 11 documented)
+        um.note_recursion_depth(depth + 1,
+                                query=getattr(self.ctx, "query", None))
+        _tracing.note_exec_spill(self.exec, n, depth + 1)
         try:
-            for batch in batches:
-                self.ctx.check_cancelled()
-                if batch.num_rows == 0:
-                    continue
-                for pid, piece in split_batch(self.ctx, batch, key_exprs,
-                                              n, depth, orders=orders,
-                                              bounds=bounds):
-                    parts.add(pid, piece)
+            with _tracing.span("memory.grace_partition", "memory",
+                               {"op": self.kind, "n": n, "depth": depth,
+                                "exec": type(self.exec).__name__}):
+                for batch in batches:
+                    self.ctx.check_cancelled()
+                    if batch.num_rows == 0:
+                        continue
+                    for pid, piece in split_batch(self.ctx, batch, key_exprs,
+                                                  n, depth, orders=orders,
+                                                  bounds=bounds):
+                        parts.add(pid, piece)
         except BaseException:
             parts.close()
             raise

@@ -179,9 +179,13 @@ class BufferStore:
         # resided on host
         if moved.owner_store is not None:
             from spark_rapids_tpu.utils import metrics as um
+            from spark_rapids_tpu.utils import tracing as _tracing
             um.MEMORY_METRICS[um.MEM_SPILLED_TO_HOST
                               if moved.tier == StorageTier.HOST
                               else um.MEM_SPILLED_TO_DISK].add(buf.size_bytes)
+            _tracing.instant("memory.spill", "memory",
+                             {"bytes": buf.size_bytes,
+                              "to_tier": moved.tier.name})
         self.catalog.unregister(buf)
         buf.close()
 

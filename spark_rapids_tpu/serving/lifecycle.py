@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from spark_rapids_tpu.utils import tracing as _tracing
+
 
 class QueryState(enum.Enum):
     QUEUED = "QUEUED"
@@ -215,7 +217,15 @@ class QueryHandle:
             "footprint_est_bytes": None,
             "admission_footprint_wait_s": 0.0,
             "admission_grace_hint": False,
+            #: THIS query's grace-recursion high-water mark (per-handle
+            #: attribution — exact under concurrent out-of-core queries,
+            #: unlike the process-global lifetime maximum)
+            "recursion_depth_peak": 0,
         }
+        #: EXPLAIN ANALYZE text rendered at completion when the query ran
+        #: under trace.enabled (the plan itself is dropped at _finish to
+        #: bound handle memory, so the rendering is captured eagerly)
+        self._analyze_text: Optional[str] = None
         #: per-operator + transfer snapshot of the query's action(s); the
         #: per-handle replacement for session.last_metrics
         self.exec_metrics: Dict[str, Dict] = {}
@@ -313,10 +323,14 @@ class QueryHandle:
                 headroom = ctx.conf.get(_cfg.OOC_HEADROOM)
                 store.spill_to_size(int(store.budget_bytes * headroom))
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         if not sem.yield_to_waiters(task_id=ctx.task_id, tenant=self.tenant,
                                     cancel_check=self.check_cancelled):
             return
         waited = time.perf_counter() - t0
+        _tracing.record("serving.preempt_yield", "serving", t0_ns,
+                        time.perf_counter_ns() - t0_ns,
+                        {"tenant": self.tenant}, query_id=self.query_id)
         um.SERVING_METRICS[um.SERVING_PREEMPTIONS].add(1)
         with self._lock:
             self.metrics["preemptions"] += 1
@@ -329,6 +343,10 @@ class QueryHandle:
             self.state = state
             self.metrics[f"t_{state.value.lower()}"] = (
                 time.perf_counter() - self.submitted_at)
+        _tracing.record(f"serving.state.{state.value}", "serving",
+                        time.perf_counter_ns(), 0,
+                        {"tenant": self.tenant, "label": self.label},
+                        query_id=self.query_id)
 
     def mark_admitted(self) -> None:
         self._transition(QueryState.ADMITTED)
@@ -353,6 +371,11 @@ class QueryHandle:
             if result is not None and hasattr(result, "num_rows"):
                 self.metrics["rows"] = result.num_rows
         self._done_evt.set()
+        _tracing.record(f"serving.state.{state.value}", "serving",
+                        time.perf_counter_ns(), 0,
+                        {"tenant": self.tenant,
+                         "wall_s": self.metrics["wall_s"]},
+                        query_id=self.query_id)
         # terminal state drains to the streaming consumer on EVERY path —
         # worker completion, queued-cancel, scheduler shutdown — so a wire
         # client always observes DONE or the error, never a silent stall
@@ -372,6 +395,34 @@ class QueryHandle:
         self._finish(QueryState.CANCELLED,
                      error=error or QueryCancelledError(
                          f"{self.label} (id {self.query_id}) cancelled"))
+
+    # ---- observability surfaces --------------------------------------------
+    def note_recursion_depth(self, depth: int) -> None:
+        """Grace layer attribution (utils.metrics.note_recursion_depth):
+        this query reached recursion level ``depth``."""
+        with self._lock:
+            if depth > self.metrics["recursion_depth_peak"]:
+                self.metrics["recursion_depth_peak"] = depth
+
+    def explain_analyze(self) -> str:
+        """EXPLAIN ANALYZE of this query's executed plan (per-node
+        observed rows / batches / wall / self time / spill). Rendered by
+        the scheduler worker at completion when the query ran under
+        ``trace.enabled``; raises for untraced or still-running queries."""
+        if self._analyze_text is None:
+            raise RuntimeError(
+                f"{self.label} (id {self.query_id}): no analyzed plan — "
+                f"the query must COMPLETE under trace.enabled")
+        return self._analyze_text
+
+    def export_trace(self, path: str) -> int:
+        """Write THIS query's spans (still present in the bounded ring)
+        as Chrome trace-event JSON; returns the span count."""
+        records = _tracing.TRACER.since(0, query_id=self.query_id)
+        _tracing.export_chrome(records, path,
+                               metadata={"query_id": self.query_id,
+                                         "label": self.label})
+        return len(records)
 
     # ---- metric attribution ------------------------------------------------
     def note_admission_wait(self, seconds: float) -> None:

@@ -21,6 +21,7 @@ so the program cache attributes hits/misses/compile time to it.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -93,6 +94,12 @@ class SessionScheduler:
         #: queries are charged their working_set_estimate against the
         #: device budget instead of being bounded by count alone
         self.admission = FootprintAdmission(conf)
+        #: rolling serve.stats window (serving/stats.py): per-replica
+        #: gauges + p50/p99 query wall over serving.stats.windowSeconds —
+        #: the feed load-aware replica routing consumes
+        from spark_rapids_tpu.serving.stats import ServeStatsWindow
+        self.serve_stats = ServeStatsWindow(
+            conf.get(cfg.SERVING_STATS_WINDOW))
         self._preempt_enabled = conf.get(cfg.SERVING_PREEMPT_ENABLED)
         self._preempt_starve_s = (
             conf.get(cfg.SERVING_PREEMPT_STARVATION_MS) / 1e3)
@@ -207,6 +214,25 @@ class SessionScheduler:
                     self._cv.notify_all()
 
     def _run_handle(self, handle: QueryHandle) -> None:
+        import contextlib
+        from spark_rapids_tpu.utils import tracing as _tracing
+        # trace the WHOLE handle run (lifecycle transitions, planning,
+        # admission) — the action driver's own activation nests inside
+        trace_scope = (_tracing.TRACER.activate()
+                       if self.session.conf.get(cfg.TRACE_ENABLED)
+                       else contextlib.nullcontext())
+        try:
+            with trace_scope:
+                self._run_handle_traced(handle)
+        finally:
+            # EVERY terminal path — completion, failure, queued-cancel —
+            # feeds the serve.stats latency window and takes a gauge
+            # sample, so a replica draining cancellations still reports a
+            # live series to the router
+            self.serve_stats.record_wall(handle.metrics.get("wall_s"))
+            self.serve_stats.sample(self)
+
+    def _run_handle_traced(self, handle: QueryHandle) -> None:
         if handle.cancel_requested:     # cancelled while QUEUED
             handle.mark_admitted()
             handle.finish_cancelled()
@@ -249,6 +275,16 @@ class SessionScheduler:
                     handle._planned = None
                     handle.mark_running()
                     result = df._collect(query=handle, final=final)
+                    if self.session.conf.get(cfg.TRACE_ENABLED):
+                        # render EXPLAIN ANALYZE now: _finish drops the
+                        # plan reference (bounded handle memory), so the
+                        # text is the surviving record
+                        handle._analyze_text = (
+                            f"== Physical plan with observed stats "
+                            f"(query {handle.query_id}, wall "
+                            f"{time.perf_counter() - handle.submitted_at:.3f}"
+                            f"s) ==\n"
+                            + final.tree_string(analyze=True))
                 finally:
                     self.admission.release(handle)
             handle.finish_ok(result)

@@ -48,6 +48,7 @@ from spark_rapids_tpu.serving.lifecycle import ResultStream
 from spark_rapids_tpu.shuffle.codec import checksum_of
 from spark_rapids_tpu.shuffle.transport import AddressLengthTag
 from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils import tracing as _tracing
 
 
 class _ServedQuery:
@@ -219,9 +220,12 @@ class QueryServer:
         # the data plane: one tag-addressed frame through the shuffle
         # transport's server send path (where the chaos harness probes
         # corrupt/delay/dup — exactly like a shuffle block)
-        self.transport.server.send(
-            peer, AddressLengthTag.for_bytes(data, req.tag),
-            lambda tx: None)
+        with _tracing.span("serving.wire_frame", "serving",
+                           {"bytes": len(data), "seq": _seq,
+                            "query_id": req.query_id}):
+            self.transport.server.send(
+                peer, AddressLengthTag.for_bytes(data, req.tag),
+                lambda tx: None)
         um.SERVING_METRICS[um.SERVING_WIRE_BYTES_OUT].add(len(data))
         return b""
 
@@ -245,9 +249,15 @@ class QueryServer:
         return b""
 
     def _handle_stats(self, peer: str, payload: bytes) -> bytes:
-        out = {"scheduler": self.session.scheduler.stats(),
+        sched = self.session.scheduler
+        out = {"scheduler": sched.stats(),
                "serving": um.SERVING_METRICS.snapshot(),
-               "queries_open": len(self._queries)}
+               "queries_open": len(self._queries),
+               # the rolling time-series load-aware routing consumes:
+               # device budget in use, queue depths, running/queued per
+               # tenant, p50/p99 query wall over the window — computed
+               # server-side (serving/stats.py), shipped as JSON
+               "serve_stats": sched.serve_stats.snapshot(sched)}
         return json.dumps(out, default=str).encode()
 
     # ---- lifecycle ---------------------------------------------------------

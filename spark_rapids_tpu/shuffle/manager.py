@@ -276,9 +276,11 @@ class CachingShuffleReader:
 
         from spark_rapids_tpu.shuffle import retry as _retry
         from spark_rapids_tpu.utils import metrics as mt
+        from spark_rapids_tpu.utils import tracing as _tracing
         by_exec = self.tracker.blocks_by_executor(self.shuffle_id,
                                                   self.partition_id)
         local_blocks = by_exec.pop(self.env.executor_id, [])
+        t_fetch_ns = _time.perf_counter_ns() if by_exec else 0
 
         # kick off remote fetches first (overlap with local materialization)
         q: "queue.Queue" = queue.Queue()
@@ -341,6 +343,9 @@ class CachingShuffleReader:
                             f"attempts: {message}", executor_id=peer,
                             blocks=tuple(failed_blocks) or tuple(st.blocks))
                     self.env.metrics[mt.SHUFFLE_FETCH_RETRIES].add(1)
+                    _tracing.instant("shuffle.fetch_retry", "shuffle",
+                                     {"peer": peer, "attempt": st.attempts,
+                                      "shuffle_id": self.shuffle_id})
                     # bounded pause, then re-fetch only the undelivered
                     # blocks on a fresh client (the dead one was evicted on
                     # peer loss)
@@ -363,6 +368,16 @@ class CachingShuffleReader:
                     delivered.add((block, table_idx))
                     hb = unpack_host_batch(raw, meta)
                     yield host_to_device_batch(hb)
+            if t_fetch_ns and _tracing.TRACER.on:
+                # the remote-drain window (start-of-fetch -> last block in;
+                # consumer compute between yields is included — it is a
+                # window, not busy time; retries show as instants inside)
+                _tracing.record(
+                    "shuffle.fetch", "shuffle", t_fetch_ns,
+                    _time.perf_counter_ns() - t_fetch_ns,
+                    {"peers": len(peers), "blocks_delivered": len(delivered),
+                     "shuffle_id": self.shuffle_id,
+                     "partition": self.partition_id})
 
     def _start_fetch(self, q: "queue.Queue", peer: str, blocks) -> None:
         """Kick off (or re-kick after an error) one peer's fetch. A connect

@@ -8,6 +8,7 @@ NvtxWithMetrics ties a range to a SQLMetric.
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import threading
 import time
 from typing import Dict, Optional
@@ -230,27 +231,75 @@ def serving_delta(before: Dict[str, float]) -> Dict[str, float]:
             for name in SERVING_METRIC_NAMES}
 
 
+class _ActionDepth:
+    """Per-action recursion-depth high-water mark, bound thread-locally by
+    the action driver (``action_depth_scope``). This replaces the old
+    re-armed global as the per-action record: the re-arm raced under
+    CONCURRENT out-of-core queries (a later action's reset absorbed part
+    of an overlapping action's peak — the PR 11 round-2 finding). The
+    process-global metric keeps its lifetime high-water mark; per-action
+    and per-query peaks come from this scope and the query handle."""
+
+    __slots__ = ("peak",)
+
+    def __init__(self):
+        self.peak = 0
+
+
+_DEPTH_TLS = threading.local()
+
+
+@_contextlib.contextmanager
+def action_depth_scope():
+    """Context manager binding a fresh per-action depth holder to the
+    calling thread (the thread that drives the operators; grace recursion
+    runs on it). Yields the holder; read ``holder.peak`` after the
+    action."""
+    holder = _ActionDepth()
+    prev = getattr(_DEPTH_TLS, "holder", None)
+    _DEPTH_TLS.holder = holder
+    try:
+        yield holder
+    finally:
+        _DEPTH_TLS.holder = prev
+
+
+def note_recursion_depth(depth: int, query=None) -> None:
+    """One grace recursion level reached: attribute the high-water mark to
+    (1) the process-lifetime global, (2) the thread-bound ACTION scope —
+    the per-action record memory_delta reports — and (3) the owning
+    query's handle when one is bound (mirroring per-handle snapshots)."""
+    MEMORY_METRICS[MEM_RECURSION_DEPTH].set_max(depth)
+    holder = getattr(_DEPTH_TLS, "holder", None)
+    if holder is not None and depth > holder.peak:
+        holder.peak = depth
+    if query is not None:
+        query.note_recursion_depth(depth)
+
+
 def memory_snapshot() -> Dict[str, float]:
-    """Action-start marker for ``memory_delta``. Re-arms the recursion-depth
-    high-water mark so the delta reports THIS action's peak. Process-global
-    like the transfer inflight peak: under CONCURRENT out-of-core queries a
-    later action's re-arm can absorb part of an overlapping action's peak —
-    the same documented overlap caveat as the transfer section
-    (api/dataframe.py); additive counters are unaffected."""
-    snap = MEMORY_METRICS.snapshot()
-    MEMORY_METRICS[MEM_RECURSION_DEPTH].reset()
-    return snap
+    """Action-start marker for ``memory_delta``. (No re-arm: the global
+    recursion-depth metric is a process-lifetime high-water mark; the
+    per-action peak comes from ``action_depth_scope``.)"""
+    return MEMORY_METRICS.snapshot()
 
 
-def memory_delta(before: Dict[str, float]) -> Dict[str, float]:
-    """Per-action out-of-core stats: counter deltas since ``before`` (the
-    recursion-depth peak is the high-water mark since the matching
-    memory_snapshot call)."""
+def memory_delta(before: Dict[str, float],
+                 recursion_peak: Optional[int] = None) -> Dict[str, float]:
+    """Per-action out-of-core stats: counter deltas since ``before``.
+    ``recursion_peak`` is the action-scoped depth high-water mark from
+    ``action_depth_scope`` (exact under concurrency); without it the
+    global lifetime maximum is reported only when it ADVANCED during the
+    window (conservative fallback for callers outside the action driver)."""
     now = MEMORY_METRICS.snapshot()
     out: Dict[str, float] = {}
     for name in MEMORY_METRIC_NAMES:
         if name == MEM_RECURSION_DEPTH:
-            out[name] = now[name]
+            if recursion_peak is not None:
+                out[name] = recursion_peak
+            else:
+                out[name] = (now[name]
+                             if now[name] > before.get(name, 0) else 0)
             continue
         out[name] = now[name] - before.get(name, 0)
     return out

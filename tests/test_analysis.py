@@ -1178,3 +1178,97 @@ def test_stale_check_tolerates_subset_invocation(tmp_path, capsys):
     assert main(["--strict", "--baseline", str(base),
                  str(tmp_path / "a.py")]) == 0
     capsys.readouterr()
+
+
+# ------------------------------------------------------------------ R011
+def test_r011_unregistered_bump_flagged():
+    metrics = src("""
+        TRANSFER_UPLOAD_BYTES = "transfer.upload_bytes"
+        TRANSFER_METRIC_NAMES = (TRANSFER_UPLOAD_BYTES,)
+        class MetricSet:
+            pass
+        TRANSFER_METRICS = MetricSet()
+        """, path="spark_rapids_tpu/utils/metrics.py")
+    engine = src("""
+        from spark_rapids_tpu.utils import metrics as um
+        def f(nbytes):
+            um.TRANSFER_METRICS[um.TRANSFER_UPLOAD_BYTES].add(nbytes)
+            um.TRANSFER_METRICS["transfer.upload_retries"].add(1)
+        """, path="spark_rapids_tpu/engine.py")
+    found = run([metrics, engine], {"R011"})
+    assert len(found) == 1, found
+    assert "transfer.upload_retries" in found[0].message
+    assert "missing" in found[0].message
+
+
+def test_r011_stale_registry_entry_flagged():
+    metrics = src("""
+        MEM_USED = "memory.used_bytes"
+        MEM_DEAD = "memory.never_bumped"
+        MEMORY_METRIC_NAMES = (MEM_USED, MEM_DEAD)
+        class MetricSet:
+            pass
+        MEMORY_METRICS = MetricSet()
+        """, path="spark_rapids_tpu/utils/metrics.py")
+    engine = src("""
+        from spark_rapids_tpu.utils import metrics as um
+        def f(n):
+            um.MEMORY_METRICS[um.MEM_USED].set_max(n)
+        """, path="spark_rapids_tpu/engine.py")
+    found = run([metrics, engine], {"R011"})
+    assert len(found) == 1, found
+    assert "memory.never_bumped" in found[0].message
+    assert "always zero" in found[0].message
+
+
+def test_r011_alias_and_ifexp_bumps_resolve():
+    """The hot-loop alias (m = um.X_METRICS) and the conditional-key
+    bump (m[A if cond else B]) both count as bump sites — the shapes
+    transfer.py and store.py actually use."""
+    metrics = src("""
+        SPILL_HOST = "memory.spilled_host"
+        SPILL_DISK = "memory.spilled_disk"
+        MEMORY_METRIC_NAMES = (SPILL_HOST, SPILL_DISK)
+        class MetricSet:
+            pass
+        MEMORY_METRICS = MetricSet()
+        """, path="spark_rapids_tpu/utils/metrics.py")
+    engine = src("""
+        from spark_rapids_tpu.utils import metrics as um
+        def f(n, to_host):
+            m = um.MEMORY_METRICS
+            m[um.SPILL_HOST if to_host else um.SPILL_DISK].add(n)
+        """, path="spark_rapids_tpu/engine.py")
+    assert run([metrics, engine], {"R011"}) == []
+
+
+def test_r011_non_dotted_names_out_of_scope():
+    """CamelCase per-operator metric names (per-exec MetricSets) and
+    snake_case handle keys are NOT dotted section counters; neither
+    direction applies to them."""
+    metrics = src("""
+        NUM_OUTPUT_ROWS = "numOutputRows"
+        QUEUE_WAIT = "queue_wait_s"
+        QUERY_METRIC_NAMES = (QUEUE_WAIT,)
+        """, path="spark_rapids_tpu/utils/metrics.py")
+    engine = src("""
+        from spark_rapids_tpu.utils import metrics as um
+        def f(exec_node, n):
+            exec_node.metrics[um.NUM_OUTPUT_ROWS].add(n)
+        """, path="spark_rapids_tpu/engine.py")
+    assert run([metrics, engine], {"R011"}) == []
+
+
+def test_r011_needs_registry_in_scope():
+    lone = src("""
+        def f(m, n):
+            m["transfer.upload_bytes"].add(n)
+        """, path="other/engine.py")
+    assert run(lone, {"R011"}) == []
+
+
+def test_r011_real_package_clean():
+    files = collect_files([os.path.join(_repo_root(), "spark_rapids_tpu")],
+                          _repo_root())
+    res = analyze_files(files, rule_ids={"R011"})
+    assert res.findings == [], [f.render() for f in res.findings]

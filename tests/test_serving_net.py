@@ -809,3 +809,75 @@ def test_two_replica_warm_start_through_routing_client(tmp_path):
         if proc_b is not None:
             proc_b.terminate()
             proc_b.wait(timeout=30)
+
+
+# ------------------------------------------------------- serve.stats feed
+def test_serve_stats_time_series_two_replicas():
+    """serve.stats returns the rolling per-replica time-series load-aware
+    routing needs: p50/p99 query wall over the window, device budget in
+    use, admission queue depth, and running/queued per tenant — computed
+    server-side, per replica."""
+    sess_a, server_a, addr_a = serve()
+    sess_b, server_b, addr_b = serve()
+    client = QueryServiceClient([addr_a, addr_b], sess_a.conf)
+    try:
+        # replica A serves three queries; replica B serves one
+        for _ in range(3):
+            assert client.submit(AGG_SQL, tenant="etl",
+                                 replica=0).result().num_rows == 8
+        assert client.submit(FILTER_SQL, replica=1).result().num_rows > 0
+        stats_a = client.stats(replica=0)["serve_stats"]
+        stats_b = client.stats(replica=1)["serve_stats"]
+        for st in (stats_a, stats_b):
+            assert st["window_s"] > 0
+            now = st["now"]
+            for key in ("device_budget_bytes", "device_budget_in_use",
+                        "device_budget_fraction", "admission_queue_depth",
+                        "queued_by_tenant", "running_by_tenant",
+                        "active_workers", "t"):
+                assert key in now, (key, now)
+            assert st["series"], "gauge series must not be empty"
+            assert st["series"][-1]["t"] >= st["series"][0]["t"]
+        # the latency window reflects each replica's OWN traffic
+        assert stats_a["wall_samples"] >= 3, stats_a
+        assert stats_b["wall_samples"] >= 1, stats_b
+        assert stats_a["p99_wall_s"] >= stats_a["p50_wall_s"] > 0, stats_a
+        assert stats_b["p50_wall_s"] > 0, stats_b
+        # everything is idle at sampling time: no queued work remains
+        assert stats_a["now"]["admission_queue_depth"] == 0
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+
+
+def test_serve_stats_window_trims_and_tenant_gauges():
+    """Wall samples and gauge samples older than the window drop; the
+    per-tenant running/queued gauges see live queries."""
+    import time as _time
+    from spark_rapids_tpu.serving.stats import ServeStatsWindow
+
+    class _FakeSched:
+        def __init__(self, session):
+            import threading
+            self._cv = threading.Condition()
+            self._queues = {}
+            self._handles = []
+            self._active = 0
+            self.session = session
+            from spark_rapids_tpu.serving.admission import FootprintAdmission
+            self.admission = FootprintAdmission(session.conf)
+
+    sess = TpuSession(BASE_CONF)
+    win = ServeStatsWindow(window_s=1.0)
+    sched = _FakeSched(sess)
+    win.record_wall(0.25)
+    win.sample(sched)
+    snap = win.snapshot(sched)
+    assert snap["wall_samples"] == 1 and snap["p50_wall_s"] == 0.25
+    _time.sleep(1.1)
+    snap = win.snapshot(sched)      # window passed: old samples trimmed
+    assert snap["wall_samples"] == 0
+    assert snap["p50_wall_s"] == 0.0
+    # only the fresh sample taken by this snapshot remains in the series
+    assert all(s["t"] >= _time.monotonic() - 1.0 for s in snap["series"])

@@ -1,0 +1,253 @@
+"""Structured query tracing (utils/tracing.py): the span ring, per-exec
+spans, EXPLAIN ANALYZE, Chrome export, per-exec jax.profiler ranges, the
+metric-registry coverage contract, and the per-action/per-query
+recursion-depth attribution fix."""
+import json
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils import tracing
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    # single-threaded plan: per-node SELF times sum to the action wall
+    # (producer threads are genuine concurrency and deliberately do not
+    # subtract cross-thread)
+    "spark.rapids.tpu.transfer.pipeline.enabled": "false",
+}
+
+
+def _table(rows: int = 4096) -> pa.Table:
+    rng = np.random.default_rng(7)
+    return pa.table({"k": rng.integers(0, 8, rows).astype("int64"),
+                     "v": rng.random(rows)})
+
+
+def _q(sess, table=None):
+    df = sess.create_dataframe(table if table is not None else _table())
+    return (df.filter(F.col("v") > 0.25)
+            .groupBy("k").agg(F.sum("v").alias("s"),
+                              F.count(F.lit(1)).alias("c")))
+
+
+# ------------------------------------------------------------------ the ring
+def test_ring_buffer_bounded_and_windowed():
+    t = tracing.Tracer(capacity=16)
+    with t.activate():
+        for i in range(40):
+            t.record(f"s{i}", "exec", i, 1)
+        mark = t.mark()
+        t.record("tail", "exec", 99, 1)
+    assert len(t.since(0)) == 16          # bounded: oldest overwritten
+    window = t.since(mark)
+    assert [r.name for r in window] == ["tail"]
+
+
+def test_disabled_mode_records_nothing():
+    t = tracing.Tracer(capacity=32)
+    assert t.span("x", "exec") is tracing._NULL_SPAN
+    with t.span("x", "exec"):
+        pass
+    t.instant("y", "exec")
+    t.record("z", "exec", 0, 1)
+    assert t.since(0) == []
+    assert not t.on
+
+
+def test_span_records_on_exit():
+    t = tracing.Tracer(capacity=32)
+    with t.activate():
+        with t.span("work", "transfer", {"bytes": 10}):
+            pass
+    (rec,) = t.since(0)
+    assert rec.name == "work" and rec.cat == "transfer"
+    assert rec.dur_ns >= 0 and rec.args == {"bytes": 10}
+    ev = rec.to_event()
+    assert ev["ph"] == "X" and ev["cat"] == "transfer"
+
+
+# ------------------------------------------------------- traced action + EA
+def test_explain_analyze_rows_and_wall_sum():
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.trace.enabled": "true"})
+    out = _q(sess).collect()
+    assert out.num_rows == 8
+    text = sess.explain_analyze()
+    assert "rows=8" in text                     # aggregate output observed
+    assert "rows=4096" in text or "rows=" in text
+    assert "wall=" in text and "self=" in text
+    # per-node SELF times sum (within driver slack: planning, to_arrow,
+    # admission live outside exec spans) to the action wall
+    wall_ns = sess.last_action_wall_s * 1e9
+    total_self = sum((tracing.observed_of(nd) or {}).get("self_ns", 0)
+                    for nd in _iter_execs(sess.last_plan))
+    assert 0 < total_self <= wall_ns * 1.1
+    assert total_self >= wall_ns * 0.2
+
+
+def _iter_execs(plan):
+    yield plan
+    for c in plan.children:
+        yield from _iter_execs(c)
+
+
+def test_untraced_action_renders_tree_without_stats():
+    sess = TpuSession(BASE_CONF)
+    _q(sess).collect()
+    text = sess.explain_analyze()
+    assert "TpuHashAggregateExec" in text or "FusedAggregate" in text \
+        or "*(" in text
+    assert "rows=" not in text
+
+
+def test_chrome_export_valid_with_layers(tmp_path):
+    path = str(tmp_path / "trace.json")
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.trace.enabled": "true",
+                       "spark.rapids.tpu.trace.export.path": path,
+                       # grace partitioning on: memory-layer spans
+                       "spark.rapids.tpu.memory.outOfCore."
+                       "forcePartitions": "2"})
+    _q(sess).collect()
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert events, "no trace events exported"
+    cats = {e["cat"] for e in events}
+    # exec spans, transfer uploads, grace partitioning, admission wait
+    assert {"exec", "transfer", "memory", "serving"} <= cats, cats
+    for e in events:
+        assert "name" in e and "ts" in e and e["ph"] in ("X", "i")
+    assert doc["otherData"]["action_wall_s"] > 0
+    counts = tracing.layer_counts(sess.last_trace)
+    assert all(counts[c] >= 1 for c in
+               ("exec", "transfer", "memory", "serving")), counts
+
+
+def test_per_exec_profiler_ranges(monkeypatch):
+    """TRACE_ENABLED's docstring promise (satellite): named profiler
+    ranges PER OPERATOR, not just the one whole-action range."""
+    names = []
+
+    class FakeAnnotation:
+        def __init__(self, name):
+            names.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(tracing, "_TRACE_ANNOTATION", FakeAnnotation)
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.trace.enabled": "true"})
+    _q(sess).collect()
+    per_exec = [n for n in names if "#" in n]
+    assert per_exec, f"no per-exec ranges, saw {sorted(set(names))[:10]}"
+    # range names are op#plan_id — one per operator, not one per action
+    assert any(n.split("#")[0].endswith("Exec") for n in per_exec)
+
+
+def test_query_handle_analyze_export_and_spans(tmp_path):
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.trace.enabled": "true"})
+    handle = sess.submit(_q(sess))
+    out = handle.result(timeout=300)
+    assert out.num_rows == 8
+    text = handle.explain_analyze()
+    assert "rows=8" in text and "wall=" in text
+    path = str(tmp_path / "query.json")
+    n = handle.export_trace(path)
+    assert n >= 1
+    doc = json.load(open(path))
+    qids = {e["args"]["query_id"] for e in doc["traceEvents"]
+            if "args" in e and "query_id" in e["args"]}
+    assert qids == {handle.query_id}
+    # serving lifecycle instants rode the query's spans
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert any(nm.startswith("serving.state.") for nm in names), names
+
+
+def test_handle_analyze_requires_tracing():
+    sess = TpuSession(BASE_CONF)
+    handle = sess.submit(_q(sess))
+    handle.result(timeout=300)
+    with pytest.raises(RuntimeError, match="trace.enabled"):
+        handle.explain_analyze()
+
+
+# ------------------------------------------------- registry coverage (S4)
+def test_every_registry_section_in_last_metrics_and_handle():
+    """Every *_METRIC_NAMES registry entry must be present in its
+    session.last_metrics section after an action that exercises the
+    engine, and in QueryHandle.exec_metrics — the full-tuple contract
+    (was only spot-checked per section before)."""
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore."
+                       "forcePartitions": "2"})
+    df = _q(sess)
+    df.collect()
+    sections = {"transfer": um.TRANSFER_METRIC_NAMES,
+                "memory": um.MEMORY_METRIC_NAMES,
+                "serving": um.SERVING_METRIC_NAMES}
+    for section, name_tuple in sections.items():
+        got = sess.last_metrics[section]
+        missing = [n for n in name_tuple if n not in got]
+        assert not missing, f"last_metrics[{section!r}] missing {missing}"
+    handle = sess.submit(df)
+    handle.result(timeout=300)
+    for section, name_tuple in sections.items():
+        got = handle.exec_metrics[section]
+        missing = [n for n in name_tuple if n not in got]
+        assert not missing, f"exec_metrics[{section!r}] missing {missing}"
+    # the action exercised the memory section for real
+    assert sess.last_metrics["memory"]["memory.spill_partitions"] >= 2
+
+
+# ------------------------------------- recursion-depth attribution (S1 fix)
+def test_recursion_depth_thread_scoped_attribution():
+    """The PR 11 round-2 race: the shared re-armed global misattributed
+    depth under CONCURRENT overlap. The fix binds the peak to the action
+    scope — two overlapping actions each see exactly their own."""
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def run(name, depth):
+        with um.action_depth_scope() as holder:
+            barrier.wait()          # both scopes open concurrently
+            if depth:
+                um.note_recursion_depth(depth)
+            barrier.wait()          # neither scope closed yet
+            results[name] = holder.peak
+
+    threads = [threading.Thread(target=run, args=("deep", 3)),
+               threading.Thread(target=run, args=("shallow", 0))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {"deep": 3, "shallow": 0}
+    # the global keeps the process-lifetime high-water mark
+    assert um.MEMORY_METRICS[um.MEM_RECURSION_DEPTH].value >= 3
+
+
+def test_recursion_depth_per_query_and_per_action():
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.memory.outOfCore."
+                       "forcePartitions": "2"})
+    handle = sess.submit(_q(sess))
+    handle.result(timeout=300)
+    assert handle.metrics["recursion_depth_peak"] >= 1
+    assert handle.exec_metrics["memory"]["memory.recursion_depth_peak"] >= 1
+    # a LATER grace-free action reports 0 even though the process-global
+    # lifetime maximum already advanced (per-action scope, not the global)
+    clean = TpuSession(BASE_CONF)
+    _q(clean).collect()
+    assert clean.last_metrics["memory"]["memory.recursion_depth_peak"] == 0
+    assert um.MEMORY_METRICS[um.MEM_RECURSION_DEPTH].value >= 1
