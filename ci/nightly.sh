@@ -143,6 +143,134 @@ finally:
         p.wait(timeout=30)
 PY
 
+echo "== replica-kill chaos matrix (seeded kill_peer across submit/stream/drain phases) =="
+python - << 'PY'
+import time
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient, WireQueryError
+from spark_rapids_tpu.serving.server import QueryServer
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+CLIENT_CONF = {"spark.rapids.tpu.shuffle.maxRetries": "0",
+               "spark.rapids.tpu.shuffle.connectTimeout": "2",
+               "spark.rapids.tpu.serving.health.probeIntervalSeconds": "0",
+               "spark.rapids.tpu.serving.failover."
+               "breakerFailureThreshold": "1"}
+rng = np.random.default_rng(7)
+table = pa.table({"k": rng.integers(0, 8, 20000).astype("int64"),
+                  "v": rng.random(20000)})
+SQL = "SELECT k, v FROM t WHERE v > 0.5"
+
+def serve(faults=""):
+    sess = TpuSession({**CONF, **({
+        "spark.rapids.tpu.serving.net.faults.plan": faults,
+        "spark.rapids.tpu.serving.net.faults.seed": "7"} if faults else {})})
+    sess.create_dataframe(table).repartition(4).createOrReplaceTempView("t")
+    ref = sess.sql(SQL).collect()
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}", ref
+
+# each phase kills replica A at a different point; the bar is always the
+# same: every query the CALLER sees completes with the correct result
+for phase, plan in (("submit", "kill_peer:req_type=serve.submit,after=1"),
+                    ("stream", "kill_peer:req_type=data,after=2"),
+                    ("drain", "kill_peer:req_type=serve.drain,after=1")):
+    sess_a, server_a, addr_a, ref = serve(plan)
+    sess_b, server_b, addr_b, _ = serve()
+    client = QueryServiceClient([addr_a, addr_b], TpuConf(CLIENT_CONF))
+    try:
+        if phase == "drain":
+            got = client.submit(SQL, replica=0).result()
+            assert got.equals(ref)
+            try:
+                client.drain_replica(0)     # the kill fires HERE
+            except WireQueryError:
+                pass                        # replica died mid-drain
+        else:
+            # submit-phase: the 1st routed submit's handler kills A ->
+            # the submission reroutes; stream-phase: frame 2 kills A ->
+            # the stream resumes on B. Zero caller-visible errors.
+            pin = 0 if phase == "stream" else None
+            got = client.submit(SQL, replica=pin).result()
+            assert got.equals(ref), f"{phase}: wrong result"
+        # after the kill every new submission lands on the survivor
+        for _ in range(2):
+            assert client.submit(SQL).result().equals(ref)
+        fired = [f for f in server_a.transport.plan.fired
+                 if f[0] == "kill_peer"]
+        assert fired, f"{phase}: the seeded kill never fired"
+        print(f"replica-kill ok: {phase} fired={fired}")
+    finally:
+        client.close()
+        server_a.shutdown(); server_b.shutdown()
+        sess_a.scheduler.drain(timeout=60)
+        sess_b.scheduler.drain(timeout=60)
+print("replica-kill chaos matrix ok")
+PY
+
+echo "== drain under load (zero dropped queries, transparent rerouting) =="
+python - << 'PY'
+import time
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.utils import metrics as um
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"}
+rng = np.random.default_rng(7)
+table = pa.table({"k": rng.integers(0, 8, 50000).astype("int64"),
+                  "v": rng.random(50000)})
+SQL = "SELECT k, v FROM t WHERE v > 0.5"
+
+def serve():
+    sess = TpuSession(CONF)
+    sess.create_dataframe(table).repartition(6).createOrReplaceTempView("t")
+    ref = sess.sql(SQL).collect()
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}", ref
+
+sess_a, server_a, addr_a, ref = serve()
+sess_b, server_b, addr_b, _ = serve()
+client = QueryServiceClient(
+    [addr_a, addr_b],
+    TpuConf({"spark.rapids.tpu.serving.health.probeIntervalSeconds": "0"}))
+d0 = um.SERVING_METRICS[um.SERVING_DRAINS].value
+try:
+    # queries in flight on BOTH replicas when the drain lands
+    inflight = [client.submit(SQL) for _ in range(6)]
+    ack = client.drain_replica(0)
+    assert ack["state"] == "DRAINING", ack
+    # new submissions while A drains: transparent rerouting, no errors
+    rerouted = [client.submit(SQL) for _ in range(6)]
+    for h in rerouted:
+        assert h.replica == addr_b, h.replica
+    # ZERO dropped queries: every handle (in-flight at drain time and
+    # after) completes with the correct result
+    for h in inflight + rerouted:
+        assert h.result().equals(ref), "drain dropped a query"
+    assert um.SERVING_METRICS[um.SERVING_DRAINS].value - d0 == 1
+    deadline = time.time() + 60
+    while not server_a.drained() and time.time() < deadline:
+        time.sleep(0.1)
+    assert server_a.drained(), "drained replica never became exit-ready"
+    served_a = sess_a.scheduler.stats()["submitted"]
+    served_b = sess_b.scheduler.stats()["submitted"]
+    assert served_a + served_b == 12, (served_a, served_b)
+    print(f"drain under load ok: A served {served_a}, B served {served_b}, "
+          f"zero dropped")
+finally:
+    client.close()
+    server_a.shutdown(); server_b.shutdown()
+    sess_a.scheduler.drain(timeout=60)
+    sess_b.scheduler.drain(timeout=60)
+PY
+
 echo "== out-of-core tight-budget chaos (1/4 working set + seeded alloc-failure injection) =="
 python - << 'PY'
 from spark_rapids_tpu.api import TpuSession

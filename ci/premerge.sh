@@ -114,6 +114,82 @@ finally:
     proc.wait(timeout=30)
 PY
 
+echo "== failover smoke (2 replicas, seeded kill_peer mid-stream, TPC-H Q1 bit-identical through failover) =="
+python - << 'PY'
+import time
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import gen_lineitem
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.serving.client import QueryServiceClient
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.utils import metrics as um
+
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+        # slice the small Q1 result into 2-row wire frames so the seeded
+        # kill lands MID-STREAM (frame 2) with frame 1 already delivered
+        "spark.rapids.tpu.serving.net.maxStreamBatchRows": "2"}
+Q1_SQL = (
+    "SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty, "
+    "sum(l_extendedprice) AS sum_base_price, "
+    "sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price, "
+    "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge, "
+    "avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price, "
+    "avg(l_discount) AS avg_disc, count(*) AS count_order FROM lineitem "
+    "WHERE l_shipdate <= date '1998-09-02' "
+    "GROUP BY l_returnflag, l_linestatus "
+    "ORDER BY l_returnflag, l_linestatus")
+
+def serve(faults=""):
+    sess = TpuSession({**CONF, **({
+        "spark.rapids.tpu.serving.net.faults.plan": faults,
+        "spark.rapids.tpu.serving.net.faults.seed": "7"} if faults else {})})
+    (sess.create_dataframe(gen_lineitem(scale=0.002, seed=42))
+     .repartition(4).createOrReplaceTempView("lineitem"))
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}"
+
+sess_a, server_a, addr_a = serve("kill_peer:req_type=data,after=2")
+sess_b, server_b, addr_b = serve()
+ref = sess_b.sql(Q1_SQL).collect()          # single-replica collect
+client = QueryServiceClient([addr_a, addr_b], TpuConf({
+    "spark.rapids.tpu.shuffle.maxRetries": "0",
+    "spark.rapids.tpu.shuffle.connectTimeout": "2"}))
+f0 = um.SERVING_METRICS[um.SERVING_FAILOVERS].value
+r0 = um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].value
+try:
+    h = client.submit(Q1_SQL, replica=0)    # starts on A; A dies on frame 2
+    got = h.result()
+    # bit-identical through failover: exact columns bitwise, float aggs
+    # to 1e-9 (the documented distributed float-sum carve-out)
+    assert_tables_equal(ref, got, approx_float=1e-9)
+    assert h.failovers == 1, h.failovers
+    assert h.replica == addr_b
+    assert um.SERVING_METRICS[um.SERVING_FAILOVERS].value - f0 == 1
+    assert um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].value - r0 >= 1
+    assert any(f[0] == "kill_peer" for f in server_a.transport.plan.fired)
+    # zero leaks on the survivor
+    deadline = time.time() + 10
+    while server_b._queries and time.time() < deadline:
+        time.sleep(0.05)
+    assert not server_b._queries
+    sess_a.scheduler.drain(timeout=60); sess_b.scheduler.drain(timeout=60)
+    dm = DeviceManager.peek()
+    if dm is not None:
+        deadline = time.time() + 30
+        while dm.semaphore.active_holders and time.time() < deadline:
+            time.sleep(0.05)
+        assert dm.semaphore.active_holders == 0
+    print("failover smoke ok: failovers=1 resumed=",
+          um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].value - r0)
+finally:
+    client.close()
+    server_a.shutdown()
+    server_b.shutdown()
+PY
+
 echo "== fusion smoke (4 queries fused vs unfused, bit-identical) =="
 python - << 'PY'
 from spark_rapids_tpu.api.dataframe import TpuSession

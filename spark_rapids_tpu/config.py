@@ -783,6 +783,97 @@ SERVING_PREEMPT_PARK = _conf(
     "Disabling leaves parking to the store's reactive pressure path.")
 
 # --------------------------------------------------------------------------------------
+# Serving: replica health, failover, routing (the fleet-resilience layer)
+# --------------------------------------------------------------------------------------
+
+SERVING_NET_REGISTRY = _conf(
+    "serving.net.registryDir", str, "",
+    "Registry directory for serving-replica discovery (the shuffle "
+    "registry-dir rendezvous applied to the query service): each replica "
+    "publishes <dir>/<executor_id> containing host:port and refreshes the "
+    "file's mtime as a liveness heartbeat; clients scan the directory to "
+    "discover replicas, skipping (and garbage-collecting) entries whose "
+    "heartbeat is older than serving.health.livenessWindowSeconds. Empty "
+    "disables discovery — clients then need explicit addresses.")
+
+SERVING_HEALTH_HEARTBEAT = _conf(
+    "serving.health.heartbeatSeconds", float, 1.0,
+    "How often a serving replica refreshes its registry-file mtime (the "
+    "liveness heartbeat). A SIGKILL'd replica stops heartbeating, so its "
+    "entry ages out of the liveness window and clients stop routing to "
+    "it even though the process never removed its file.",
+    checker=_positive("serving.health.heartbeatSeconds"))
+
+SERVING_HEALTH_LIVENESS_WINDOW = _conf(
+    "serving.health.livenessWindowSeconds", float, 5.0,
+    "Registry entries whose heartbeat mtime is older than this are "
+    "considered dead: discovery scans skip them and remove the stale "
+    "file (a crashed replica cannot retract its own entry). Keep this "
+    "a few multiples of serving.health.heartbeatSeconds so a slow "
+    "heartbeat is not mistaken for a death.",
+    checker=_positive("serving.health.livenessWindowSeconds"))
+
+SERVING_HEALTH_PROBE_INTERVAL = _conf(
+    "serving.health.probeIntervalSeconds", float, 2.0,
+    "How often the client re-probes each replica's serve.health RPC "
+    "(liveness + the serve.stats snapshot load-aware routing scores). "
+    "Probes run on the routing path when the last snapshot is older "
+    "than this; 0 probes before every routing decision (tests).",
+    checker=_non_negative("serving.health.probeIntervalSeconds"))
+
+SERVING_HEALTH_PROBE_TIMEOUT = _conf(
+    "serving.health.probeTimeoutSeconds", float, 5.0,
+    "Bound on one serve.health probe RPC — probes must fail fast so a "
+    "hung replica costs the router one bounded wait, not the full "
+    "serving.net.rpcTimeoutSeconds.",
+    checker=_positive("serving.health.probeTimeoutSeconds"))
+
+SERVING_FAILOVER_ENABLED = _conf(
+    "serving.failover.enabled", bool, True,
+    "Resubmit a mid-stream query to a healthy replica when its replica "
+    "dies (connection lost / RPC timeout / exhausted frame retries), "
+    "resuming the result stream from the last delivered batch sequence "
+    "number: the new replica re-runs the query and skips already-"
+    "delivered frames (dedup by seq — exactly-once delivery to the "
+    "caller). Only queries marked idempotent fail over (the default for "
+    "pure SELECTs); non-idempotent queries surface WireQueryError with "
+    "batches_delivered as before.")
+
+SERVING_FAILOVER_MAX_ATTEMPTS = _conf(
+    "serving.failover.maxAttempts", int, 3,
+    "How many times one query may fail over to another replica before "
+    "the client gives up and surfaces the failure.",
+    checker=_positive("serving.failover.maxAttempts"))
+
+SERVING_BREAKER_THRESHOLD = _conf(
+    "serving.failover.breakerFailureThreshold", int, 3,
+    "Consecutive probe/submit/stream failures against one replica that "
+    "flip its client-side circuit breaker OPEN. An OPEN replica receives "
+    "ZERO submissions; only health probes (on the exponential-backoff "
+    "schedule) go there, and one probe success closes the breaker.",
+    checker=_positive("serving.failover.breakerFailureThreshold"))
+
+SERVING_BREAKER_BACKOFF_MS = _conf(
+    "serving.failover.breakerBackoffMs", int, 200,
+    "Base backoff between an OPEN breaker's health probes; successive "
+    "failed probes back off exponentially with deterministic jitter "
+    "(the shuffle/retry.py schedule, seeded by serving.net.faults.seed).",
+    checker=_positive("serving.failover.breakerBackoffMs"))
+
+SERVING_ROUTING_POLICY = _conf(
+    "serving.routing.policy", str, "loadaware",
+    "How the client picks a replica for a new submission: 'loadaware' "
+    "scores each healthy replica's latest serve.health snapshot (free "
+    "device budget after footprint charges, queue depth + running "
+    "count, p99 wall over the stats window) and routes to the best — "
+    "the whale lands on the replica with free budget; 'roundrobin' is "
+    "the PR 12 rotation. Replicas behind an OPEN breaker or DRAINING "
+    "are excluded under either policy.",
+    checker=lambda v: (None if v in ("loadaware", "roundrobin") else
+                       f"serving.routing.policy must be 'loadaware' or "
+                       f"'roundrobin', got {v!r}"))
+
+# --------------------------------------------------------------------------------------
 # Observability (SQLMetrics / NVTX analog)
 # --------------------------------------------------------------------------------------
 METRICS_ENABLED = _conf(

@@ -20,20 +20,31 @@ result):
   working_set_estimate against the device budget, not a bare count;
 - ``wire`` / ``server`` / ``client``: the Arrow-IPC wire protocol over
   the PR 2 TCP shuffle machinery — streaming partial results, retryable
-  checksum failures, disconnect-as-cancel, N routed replicas.
+  checksum failures, disconnect-as-cancel, N routed replicas;
+- ``health``: fleet resilience — per-replica circuit breakers, liveness
+  discovery through the shuffle registry-dir rendezvous (heartbeat
+  mtime, stale-entry GC), and the load-aware routing score; together
+  with stream-resume failover and graceful drain, replica death becomes
+  a recoverable, observable event instead of a client-visible error.
 """
 from spark_rapids_tpu.serving.admission import FootprintAdmission
+from spark_rapids_tpu.serving.health import (CircuitBreaker, ReplicaState,
+                                             routing_score)
 from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
                                                 QueryHandle, QueryState,
                                                 QueryTimeoutError,
-                                                ResultStream, current_query)
+                                                ResultStream,
+                                                SchedulerDrainingError,
+                                                current_query)
 from spark_rapids_tpu.serving.program_cache import (ProgramCache,
                                                     global_program_cache,
                                                     plan_key)
 from spark_rapids_tpu.serving.scheduler import SessionScheduler
 
 __all__ = [
-    "FootprintAdmission", "ProgramCache", "QueryCancelledError",
-    "QueryHandle", "QueryState", "QueryTimeoutError", "ResultStream",
+    "CircuitBreaker", "FootprintAdmission", "ProgramCache",
+    "QueryCancelledError", "QueryHandle", "QueryState", "QueryTimeoutError",
+    "ReplicaState", "ResultStream", "SchedulerDrainingError",
     "SessionScheduler", "current_query", "global_program_cache", "plan_key",
+    "routing_score",
 ]

@@ -58,6 +58,16 @@ class QueryTimeoutError(RuntimeError):
     (conf ``serving.queryTimeoutSeconds`` or ``submit(timeout=...)``)."""
 
 
+class SchedulerDrainingError(RuntimeError):
+    """Submission rejected because the scheduler/replica is DRAINING.
+
+    This is a RETRYABLE REDIRECT, not a failure: running queries finish
+    and streams flush, but no new work is accepted. The wire layer
+    carries the type name to the client, which transparently reroutes
+    the submission to another replica (the graceful-drain contract —
+    zero caller-visible errors during a drain)."""
+
+
 _QUERY_IDS = itertools.count(1)
 
 

@@ -30,7 +30,9 @@ from spark_rapids_tpu.serving.admission import FootprintAdmission
 from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
                                                 QueryHandle,
                                                 QueryTimeoutError,
-                                                ResultStream, bind_query)
+                                                ResultStream,
+                                                SchedulerDrainingError,
+                                                bind_query)
 from spark_rapids_tpu.serving.program_cache import (configure_from_conf,
                                                     plan_key)
 from spark_rapids_tpu.utils.fair_share import (activation_reset, pick_tenant,
@@ -88,6 +90,11 @@ class SessionScheduler:
         self._pruned_states: Dict[str, int] = {}
         self._active = 0
         self._shutdown = False
+        #: graceful drain: set by start_draining() — new submissions are
+        #: rejected with the retryable SchedulerDrainingError while
+        #: running/queued queries finish normally; serve_stats reports
+        #: the state so routers stop sending traffic here
+        self._draining = False
         self._workers: List[threading.Thread] = []
         self.program_cache = configure_from_conf(conf)
         #: footprint admission ledger (serving/admission.py): RUNNING
@@ -148,6 +155,10 @@ class SessionScheduler:
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
+            if self._draining:
+                raise SchedulerDrainingError(
+                    "scheduler is draining: running queries finish, new "
+                    "submissions must route to another replica")
             q = self._queues.get(tenant)
             if not q:
                 # deficit-round-robin activation reset (utils/fair_share
@@ -321,6 +332,19 @@ class SessionScheduler:
     def handles(self) -> List[QueryHandle]:
         with self._cv:
             return list(self._handles)
+
+    def start_draining(self) -> None:
+        """Flip the scheduler to DRAINING: every later submit() raises
+        the retryable SchedulerDrainingError while queued and running
+        queries finish normally — pair with drain() to wait them out.
+        One-way by design: a draining replica is on its way out."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until every submitted query reaches a terminal state.

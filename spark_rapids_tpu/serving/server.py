@@ -38,13 +38,15 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.serving import wire
-from spark_rapids_tpu.serving.lifecycle import ResultStream
+from spark_rapids_tpu.serving.lifecycle import (ResultStream,
+                                                SchedulerDrainingError)
 from spark_rapids_tpu.shuffle.codec import checksum_of
 from spark_rapids_tpu.shuffle.transport import AddressLengthTag
 from spark_rapids_tpu.utils import metrics as um
@@ -57,9 +59,10 @@ class _ServedQuery:
     (sent-but-unacked) wire frame kept for checksum-failure retransmit."""
 
     __slots__ = ("handle", "stream", "peer", "lock", "next_seq", "parked",
-                 "slices")
+                 "slices", "resume_from")
 
-    def __init__(self, handle, stream: ResultStream, peer: str):
+    def __init__(self, handle, stream: ResultStream, peer: str,
+                 resume_from: int = -1):
         self.handle = handle
         self.stream = stream
         self.peer = peer
@@ -69,6 +72,10 @@ class _ServedQuery:
         self.parked: Optional[Tuple[int, bytes, int]] = None
         #: row-sliced remainders of an oversized exec batch, served next
         self.slices: List = []
+        #: stream-resume failover: frames with seq <= resume_from were
+        #: already delivered by the replica that died — the re-run skips
+        #: them (dedup by seq, exactly-once delivery to the caller)
+        self.resume_from = resume_from
 
 
 class QueryServer:
@@ -95,8 +102,13 @@ class QueryServer:
         #: returns; bounded to the newest entries)
         self._lost_peers: "OrderedDict[str, None]" = OrderedDict()
         self._stop_event = threading.Event()
+        #: graceful drain: new submits are rejected with a retryable
+        #: redirect, running queries finish, streams flush, then
+        #: serve_forever returns and the caller deregisters via shutdown
+        self._draining = False
         self.transport = wire.make_serving_transport(
-            f"query-server-{uuid.uuid4().hex[:8]}", self.conf, listen_port)
+            f"query-server-{uuid.uuid4().hex[:8]}", self.conf, listen_port,
+            registry_dir=self.conf.get(cfg.SERVING_NET_REGISTRY))
         server = self.transport.server
         server.register_request_handler(wire.REQ_SUBMIT, self._handle_submit)
         server.register_request_handler(wire.REQ_NEXT, self._handle_next)
@@ -105,9 +117,19 @@ class QueryServer:
         server.register_request_handler(wire.REQ_REGISTER,
                                         self._handle_register)
         server.register_request_handler(wire.REQ_STATS, self._handle_stats)
+        server.register_request_handler(wire.REQ_HEALTH, self._handle_health)
+        server.register_request_handler(wire.REQ_DRAIN, self._handle_drain)
         # a vanished client is a cancellation: its queries release their
         # semaphore holds, catalog buffers and parked frames cooperatively
         self.transport.add_peer_lost_listener(self._on_peer_lost)
+        # liveness heartbeat: refresh the registry-file mtime so replica
+        # discovery (scan_registry + the liveness window) sees this
+        # replica as alive; a killed transport stops refreshing and the
+        # entry ages out — the SIGKILL story without SIGKILL
+        if self.conf.get(cfg.SERVING_NET_REGISTRY):
+            self._heartbeat_s = self.conf.get(cfg.SERVING_HEALTH_HEARTBEAT)
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="serving-heartbeat").start()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -117,13 +139,18 @@ class QueryServer:
 
     # ---- handlers (transport worker threads; every wait bounded) -----------
     def _handle_submit(self, peer: str, payload: bytes) -> bytes:
+        if self._draining:
+            # retryable redirect: the type name rides the wire error and
+            # the client reroutes the submission to another replica
+            raise SchedulerDrainingError(
+                "replica is draining; resubmit to another replica")
         req = wire.SubmitRequest.from_bytes(payload)
         stream = ResultStream(depth=self._stream_depth)
         handle = self.session.scheduler.submit(
             req.sql, tenant=req.tenant,
             timeout=(req.timeout if req.timeout > 0 else None),
             label=req.label or None, stream=stream)
-        sq = _ServedQuery(handle, stream, peer)
+        sq = _ServedQuery(handle, stream, peer, resume_from=req.resume_from)
         with self._lock:
             self._queries[handle.query_id] = sq
             # close the submit-vs-disconnect race: if this peer's
@@ -147,14 +174,26 @@ class QueryServer:
             raise KeyError(f"unknown query id {query_id} for peer {peer!r}")
         return sq
 
-    def _park_locked(self, sq: _ServedQuery, table) -> bytes:
-        data = wire.table_to_ipc(table)
+    def _park_locked(self, sq: _ServedQuery, table) -> Optional[bytes]:
         seq = sq.next_seq
         sq.next_seq += 1
+        if seq <= sq.resume_from:
+            # resumed query: the client already holds this frame from the
+            # replica that died — skip it (dedup by seq, never re-sent)
+            um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].add(1)
+            return None
+        data = wire.table_to_ipc(table)
         sq.parked = (seq, data, checksum_of(data))
         um.SERVING_METRICS[um.SERVING_STREAM_BATCHES].add(1)
         return wire.NextResponse(wire.NEXT_BATCH, seq=seq, nbytes=len(data),
                                  checksum=sq.parked[2]).to_bytes()
+
+    def _serve_slices_locked(self, sq: _ServedQuery) -> Optional[bytes]:
+        while sq.slices:
+            resp = self._park_locked(sq, sq.slices.pop(0))
+            if resp is not None:
+                return resp
+        return None
 
     def _slice(self, table) -> List:
         if self._max_rows <= 0 or table.num_rows <= self._max_rows:
@@ -165,6 +204,7 @@ class QueryServer:
     def _handle_next(self, peer: str, payload: bytes) -> bytes:
         req = wire.NextRequest.from_bytes(payload)
         sq = self._lookup(req.query_id, peer)
+        deadline = time.monotonic() + self._poll_s
         with sq.lock:
             if req.ack_seq >= 0 and sq.parked is not None \
                     and sq.parked[0] == req.ack_seq:
@@ -174,24 +214,34 @@ class QueryServer:
                 return wire.NextResponse(
                     wire.NEXT_BATCH, seq=seq, nbytes=len(data),
                     checksum=crc).to_bytes()
-            if sq.slices:
-                return self._park_locked(sq, sq.slices.pop(0))
+            resp = self._serve_slices_locked(sq)
+            if resp is not None:
+                return resp
         # poll the stream OUTSIDE the query lock, bounded: a dry stream
-        # answers WAIT and frees this worker thread for other clients
-        kind, val = sq.stream.next(timeout=self._poll_s)
-        with sq.lock:
-            if kind == "batch":
-                pieces = self._slice(val)
-                sq.slices.extend(pieces[1:])
-                return self._park_locked(sq, pieces[0])
-            if kind == "done":
-                return self._finish_response(sq)
-            if kind == "error":
-                self._drop_query(sq)
-                return wire.NextResponse(
-                    wire.NEXT_ERROR,
-                    error=f"{type(val).__name__}: {val}").to_bytes()
-            return wire.NextResponse(wire.NEXT_WAIT).to_bytes()
+        # answers WAIT and frees this worker thread for other clients.
+        # The loop exists for resumed queries — a batch whose every slice
+        # was already delivered (skipped by seq) keeps draining within
+        # the same bounded poll budget instead of burning a WAIT per skip
+        while True:
+            left = max(0.0, deadline - time.monotonic())
+            kind, val = sq.stream.next(timeout=left)
+            with sq.lock:
+                if kind == "batch":
+                    sq.slices.extend(self._slice(val))
+                    resp = self._serve_slices_locked(sq)
+                    if resp is not None:
+                        return resp
+                elif kind == "done":
+                    return self._finish_response(sq)
+                elif kind == "error":
+                    self._drop_query(sq)
+                    return wire.NextResponse(
+                        wire.NEXT_ERROR,
+                        error=f"{type(val).__name__}: {val}").to_bytes()
+                else:
+                    return wire.NextResponse(wire.NEXT_WAIT).to_bytes()
+            if time.monotonic() >= deadline:
+                return wire.NextResponse(wire.NEXT_WAIT).to_bytes()
 
     def _finish_response(self, sq: _ServedQuery) -> bytes:
         result = sq.handle.result(timeout=5.0)
@@ -253,6 +303,7 @@ class QueryServer:
         out = {"scheduler": sched.stats(),
                "serving": um.SERVING_METRICS.snapshot(),
                "queries_open": len(self._queries),
+               "state": "DRAINING" if self._draining else "UP",
                # the rolling time-series load-aware routing consumes:
                # device budget in use, queue depths, running/queued per
                # tenant, p50/p99 query wall over the window — computed
@@ -260,7 +311,60 @@ class QueryServer:
                "serve_stats": sched.serve_stats.snapshot(sched)}
         return json.dumps(out, default=str).encode()
 
+    def _handle_health(self, peer: str, payload: bytes) -> bytes:
+        """Liveness + load probe: what circuit-breaker probes and
+        load-aware routing consume — replica state plus the PR 13
+        serve_stats rolling time-series (free budget after footprint
+        charges, queue depths, p50/p99 wall)."""
+        sched = self.session.scheduler
+        return json.dumps({
+            "state": "DRAINING" if self._draining else "UP",
+            #: per-process identity: a restarted replica behind the same
+            #: address reports a NEW id, telling clients to replay their
+            #: temp-view registrations instead of trusting a stale ledger
+            "replica_id": self.transport.executor_id,
+            "queries_open": len(self._queries),
+            "serve_stats": sched.serve_stats.snapshot(sched),
+        }, default=str).encode()
+
+    def _handle_drain(self, peer: str, payload: bytes) -> bytes:
+        self.drain()
+        return json.dumps({"state": "DRAINING",
+                           "queries_open": len(self._queries)}).encode()
+
     # ---- lifecycle ---------------------------------------------------------
+    def drain(self) -> None:
+        """Graceful drain (serve.drain RPC / SIGTERM): flip to DRAINING —
+        new submissions are rejected with the retryable redirect, the
+        scheduler stops accepting work, running queries finish and their
+        streams flush — then serve_forever notices the empty query table
+        and returns so the caller deregisters (transport shutdown removes
+        the registry entry) and exits."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self.session.scheduler.start_draining()
+        um.SERVING_METRICS[um.SERVING_DRAINS].add(1)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once a DRAINING replica has nothing left to serve: every
+        wire stream flushed (its query left ``_queries`` at DONE/ERROR)
+        and every scheduler handle is terminal."""
+        if not self._draining:
+            return False
+        with self._lock:
+            if self._queries:
+                return False
+        return self.session.scheduler.drain(timeout=0)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop_event.wait(self._heartbeat_s):
+            self.transport.heartbeat()
     def _on_peer_lost(self, peer_id: str) -> None:
         """A client's connection died mid-stream: cancel its queries (the
         cooperative chain releases device-semaphore holds and catalog
@@ -281,11 +385,14 @@ class QueryServer:
                 sq.slices.clear()
 
     def serve_forever(self) -> None:
-        """Block until shutdown(): a BOUNDED poll (the R010 accept-loop
-        discipline — an unbounded wait here would pin the process through
-        signals and shutdown races), interrupt-friendly."""
+        """Block until shutdown() — or, once drain() flipped the replica
+        to DRAINING, until every running query finished and every stream
+        flushed. A BOUNDED poll (the R010 accept-loop discipline — an
+        unbounded wait here would pin the process through signals and
+        shutdown races), interrupt-friendly."""
         while not self._stop_event.wait(0.5):
-            pass
+            if self.drained():
+                return
 
     def shutdown(self) -> None:
         self._stop_event.set()
@@ -330,6 +437,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = QueryServer(session, listen_port=args.port)
     host, port = server.address
     print(f"SERVING {host} {port}", flush=True)
+
+    # SIGTERM = graceful drain (the orchestrator's stop signal): running
+    # queries finish and streams flush before the process deregisters and
+    # exits; a SECOND SIGTERM forces immediate shutdown
+    import signal
+
+    def _on_sigterm(signum, frame):
+        if server.draining:
+            server._stop_event.set()
+        else:
+            server.drain()
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass                    # not the main thread (embedded use)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -85,6 +85,11 @@ class ServeStatsWindow:
         in_use = max(charged, resident)
         return {
             "t": round(time.monotonic(), 3),
+            #: UP | DRAINING — a draining replica keeps reporting a live
+            #: series (its running queries still finish here) but routers
+            #: must stop sending new submissions
+            "state": ("DRAINING" if getattr(scheduler, "draining", False)
+                      else "UP"),
             "device_budget_bytes": budget or 0,
             #: budget in use: the admission ledger's charged estimates or
             #: the store's actually-resident bytes, whichever is larger —

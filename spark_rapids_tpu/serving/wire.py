@@ -49,6 +49,14 @@ REQ_FETCH = "serve.fetch"
 REQ_CANCEL = "serve.cancel"
 REQ_REGISTER = "serve.register"
 REQ_STATS = "serve.stats"
+#: liveness + load probe: {"state": "UP"|"DRAINING", "serve_stats": ...}
+#: (the PR 13 rolling time-series) — what circuit-breaker probes and
+#: load-aware routing consume; deliberately cheaper than serve.stats
+REQ_HEALTH = "serve.health"
+#: graceful drain: flip the replica to DRAINING (new submits are
+#: rejected with a retryable redirect, running queries finish, streams
+#: flush, then the server deregisters and exits)
+REQ_DRAIN = "serve.drain"
 
 #: serve.next response kinds
 NEXT_WAIT = 0
@@ -112,10 +120,16 @@ class SubmitRequest:
     tenant: str = "default"
     timeout: float = 0.0
     label: str = ""
+    #: stream-resume failover: the last batch sequence number the client
+    #: already holds from a replica that died mid-stream. The server
+    #: re-runs the query and SKIPS frames with seq <= resume_from (dedup
+    #: by seq — exactly-once delivery to the caller); -1 is a fresh run.
+    resume_from: int = -1
 
     def to_bytes(self) -> bytes:
         return (_pack_str(self.sql) + _pack_str(self.tenant)
-                + _F64.pack(self.timeout) + _pack_str(self.label))
+                + _F64.pack(self.timeout) + _pack_str(self.label)
+                + _I64.pack(self.resume_from))
 
     @staticmethod
     def from_bytes(buf: bytes) -> "SubmitRequest":
@@ -124,7 +138,8 @@ class SubmitRequest:
         timeout, = _F64.unpack_from(buf, pos)
         pos += 8
         label, pos = _unpack_str(buf, pos)
-        return SubmitRequest(sql, tenant, timeout, label)
+        resume_from, = _I64.unpack_from(buf, pos)
+        return SubmitRequest(sql, tenant, timeout, label, resume_from)
 
 
 @dataclass(frozen=True)
@@ -247,18 +262,20 @@ class RegisterRequest:
 
 # ------------------------------------------------------ transport wiring
 def make_serving_transport(executor_id: str, conf, listen_port: Optional[int]
-                           = None):
+                           = None, registry_dir: str = ""):
     """Build the query service's transport from the serving.net.* conf:
     the configured transport class (TCP by default) bound to the serving
-    listen port with NO registry (clients dial ``host:port`` directly),
-    wrapped in the FaultInjectingTransport when a wire-chaos plan is set —
-    the shuffle chaos harness applied verbatim to the serving wire."""
+    listen port, wrapped in the FaultInjectingTransport when a wire-chaos
+    plan is set — the shuffle chaos harness applied verbatim to the
+    serving wire. ``registry_dir`` (servers only: replica discovery +
+    liveness heartbeats ride the registry file's mtime) defaults to ""
+    so CLIENTS never publish themselves as replicas."""
     import importlib
     from spark_rapids_tpu import config as cfg
     overrides = {
         cfg.SHUFFLE_TCP_PORT.key: (listen_port if listen_port is not None
                                    else conf.get(cfg.SERVING_NET_PORT)),
-        cfg.SHUFFLE_TCP_REGISTRY.key: "",
+        cfg.SHUFFLE_TCP_REGISTRY.key: registry_dir,
     }
     plan = conf.get(cfg.SERVING_NET_FAULTS_PLAN)
     cls_name = conf.get(cfg.SERVING_NET_TRANSPORT)

@@ -31,6 +31,13 @@ injection points:
   the reader's (block, table) dedup must absorb it).
 - ``fail_request`` — a client request (``req_type`` filter, default any)
   fails without reaching the peer (lost/failed RPC handler).
+- ``kill_peer``    — process-local peer DEATH on this (server) side: the
+  Nth matching event kills the whole wrapped transport — listener and
+  every peer socket close AND the liveness heartbeat stops (the registry
+  entry is deliberately left behind, exactly like SIGKILL) — so remotes
+  observe a dead replica and must fail over. Countable events: a handled
+  request (``req_type`` filters, e.g. ``serve.submit``) or an outgoing
+  data frame (``req_type=data`` — the Nth result frame, mid-stream death).
 
 Keys: ``peer`` (exact executor id, default ``*``), ``after`` (1-based Nth
 matching event, default 1), ``count`` (how many consecutive events fire,
@@ -55,7 +62,7 @@ from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 TransactionStatus)
 
 KINDS = ("drop_conn", "corrupt_frame", "delay_frame", "dup_frame",
-         "fail_request")
+         "fail_request", "kill_peer")
 #: spec kinds probed on the server→client data path
 _SEND_KINDS = ("corrupt_frame", "delay_frame", "dup_frame")
 
@@ -158,6 +165,16 @@ class FaultPlan:
     def on_frame_recv(self, peer: str) -> bool:
         """drop_conn probe for one received data frame."""
         return bool(self._advance(("drop_conn",), peer))
+
+    def on_kill_request(self, peer: str, req_type: str) -> bool:
+        """kill_peer probe for one server-handled request (submit/stream/
+        drain phase targeting via the ``req_type`` filter)."""
+        return bool(self._advance(("kill_peer",), peer, req_type))
+
+    def on_kill_frame(self, peer: str) -> bool:
+        """kill_peer probe for one outgoing data frame (``req_type=data``
+        specs: the Nth result frame = mid-stream replica death)."""
+        return bool(self._advance(("kill_peer",), peer, "data"))
 
     def corrupt(self, data: bytearray) -> bytearray:
         """Flip one seeded byte (in place) — the minimal corruption a
@@ -287,10 +304,28 @@ class _FaultyServerConnection(ServerConnection):
     def register_request_handler(self, req_type: str,
                                  handler: Callable[[str, bytes], bytes]
                                  ) -> None:
-        self._inner.register_request_handler(req_type, handler)
+        def probed(peer: str, payload: bytes) -> bytes:
+            # kill_peer probe at request dispatch: phase-targeted replica
+            # death (req_type=serve.submit dies at submit, =serve.drain
+            # dies mid-drain); the kill closes every socket, so the error
+            # below never reaches the peer — it observes a dead replica
+            if self._t.plan.on_kill_request(peer, req_type):
+                self._t.kill()
+                raise ConnectionError(
+                    f"injected peer death handling {req_type}")
+            return handler(peer, payload)
+        self._inner.register_request_handler(req_type, probed)
 
     def send(self, peer_executor_id: str, alt: AddressLengthTag,
              cb) -> Transaction:
+        if self._t.plan.on_kill_frame(peer_executor_id):
+            # mid-stream replica death: the frame is never sent and the
+            # whole transport dies (listener + sockets + heartbeat)
+            self._t.kill()
+            tx = Transaction(alt.tag).start(cb)
+            self._t._defer(lambda: tx.complete(
+                TransactionStatus.ERROR, "injected peer death (kill_peer)"))
+            return tx
         hits = self._t.plan.on_frame_send(peer_executor_id)
         if not hits:
             return self._inner.send(peer_executor_id, alt, cb)
@@ -333,6 +368,7 @@ class FaultInjectingTransport(ShuffleTransport):
 
     def __init__(self, executor_id: str, conf=None):
         super().__init__(executor_id, conf)
+        self.killed = False
         cls_name = self.conf.shuffle_faults_transport_class
         mod_name, _, cls = cls_name.rpartition(".")
         self._inner: ShuffleTransport = getattr(
@@ -393,6 +429,18 @@ class FaultInjectingTransport(ShuffleTransport):
     @property
     def server(self) -> _FaultyServerConnection:
         return self._server
+
+    def heartbeat(self) -> None:
+        """A killed replica stops heartbeating — its registry entry ages
+        out of the liveness window like a real SIGKILL'd process's."""
+        if not self.killed:
+            self._inner.heartbeat()
+
+    def kill(self) -> None:
+        if self.killed:
+            return
+        self.killed = True
+        self._inner.kill()
 
     def shutdown(self) -> None:
         self._inner.shutdown()

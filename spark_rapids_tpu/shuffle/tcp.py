@@ -40,6 +40,43 @@ from spark_rapids_tpu.utils import metrics as mt
 _HDR = struct.Struct(">cQI")
 
 
+def scan_registry(registry_dir: str,
+                  stale_after_s: Optional[float] = None
+                  ) -> Dict[str, str]:
+    """Scan a registry directory: ``{executor_id: "host:port"}`` of every
+    published entry. With ``stale_after_s``, entries whose heartbeat mtime
+    is older than the window are SKIPPED and garbage-collected — a
+    SIGKILL'd process cannot retract its own file (``shutdown`` never
+    ran), so without the GC dead entries would be handed out forever.
+    Unlinks race benignly: losing the race to another scanner (or to the
+    owner re-publishing) is a no-op."""
+    out: Dict[str, str] = {}
+    try:
+        names = os.listdir(registry_dir)
+    except FileNotFoundError:
+        return out      # nothing published yet: a genuinely empty fleet
+    # any OTHER listdir failure propagates: a transient EACCES/ESTALE on
+    # a network FS must read as "registry unreadable right now", never as
+    # "every replica is dead" — callers keep their previous view
+    now = time.time()
+    for name in names:
+        if name.endswith(".tmp"):       # half-written publication
+            continue
+        path = os.path.join(registry_dir, name)
+        try:
+            if (stale_after_s is not None
+                    and now - os.path.getmtime(path) > stale_after_s):
+                os.unlink(path)         # dead: heartbeat stopped
+                continue
+            with open(path) as f:
+                addr = f.read().strip()
+        except OSError:
+            continue
+        if ":" in addr:
+            out[name] = addr
+    return out
+
+
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: bytes,
                 tag: int, payload: bytes) -> None:
     # justified per-socket writer lock: frames must hit the stream whole
@@ -219,13 +256,17 @@ class TcpTransport(ShuffleTransport):
         self.address = self._listener.getsockname()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"tcp-shuffle-accept-{executor_id}").start()
+        self._killed = False
         self._registry = self.conf.shuffle_tcp_registry
         if self._registry:
             os.makedirs(self._registry, exist_ok=True)
-            path = os.path.join(self._registry, executor_id)
-            with open(path + ".tmp", "w") as f:
-                f.write(f"{self.address[0]}:{self.address[1]}")
-            os.replace(path + ".tmp", path)
+            self._publish_registry()
+
+    def _publish_registry(self) -> None:
+        path = os.path.join(self._registry, self.executor_id)
+        with open(path + ".tmp", "w") as f:
+            f.write(f"{self.address[0]}:{self.address[1]}")
+        os.replace(path + ".tmp", path)
 
     # ---- plumbing ----------------------------------------------------------
     def _progress_loop(self) -> None:
@@ -470,6 +511,49 @@ class TcpTransport(ShuffleTransport):
     def server(self) -> TcpServerConnection:
         return self._server_conn
 
+    def heartbeat(self) -> None:
+        """Refresh the registry entry's mtime — the liveness signal
+        serving-replica discovery reads (``scan_registry`` with a
+        staleness window). A killed transport stops heartbeating, so
+        its entry ages out exactly like a SIGKILL'd process's would."""
+        if not self._registry or self._killed:
+            return
+        try:
+            os.utime(os.path.join(self._registry, self.executor_id))
+        except OSError:
+            # the entry vanished — a liveness-window GC raced a stall
+            # (pause longer than the window, then resume). A LIVE replica
+            # must re-enter discovery, not stay ejected forever, so
+            # republish instead of silently shrugging.
+            try:
+                self._publish_registry()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        """Simulate abrupt process death (SIGKILL) process-locally: close
+        the listener and every peer socket so remotes observe a dead
+        replica, stop heartbeating — and deliberately LEAVE the registry
+        file behind (a killed process never runs its shutdown), which is
+        exactly the stale entry ``scan_registry``'s GC must absorb."""
+        self._killed = True
+        self._close_listener()
+        for p in list(self._peers.values()):
+            p.close()
+
+    def _close_listener(self) -> None:
+        # SHUT_RDWR first, same discipline as _Peer.close: a bare close()
+        # is deferred by CPython while the accept thread is blocked in
+        # accept(), leaving the port LIVE — new dials would still land
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
     def shutdown(self) -> None:
         # retract the registry entry FIRST: a restarted executor re-binds an
         # ephemeral port, and a stale file would hand peers a dead address
@@ -479,10 +563,7 @@ class TcpTransport(ShuffleTransport):
                 os.remove(os.path.join(self._registry, self.executor_id))
             except OSError:
                 pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self._close_listener()
         for p in list(self._peers.values()):
             p.close()
         for _ in range(self._num_workers):

@@ -275,6 +275,16 @@ class ShuffleTransport:
     def server(self) -> ServerConnection:
         raise NotImplementedError
 
+    def heartbeat(self) -> None:
+        """Refresh this transport's liveness signal (registry-file mtime
+        on the TCP transport; no-op for transports without a registry)."""
+
+    def kill(self) -> None:
+        """Simulate abrupt process death for chaos testing: drop every
+        peer-visible resource WITHOUT the graceful shutdown() cleanup
+        (registry retraction stays undone, exactly like SIGKILL)."""
+        self.shutdown()
+
     def shutdown(self) -> None:
         pass
 

@@ -117,10 +117,25 @@ SERVING_PREEMPTIONS = "serving.preemptions"
 SERVING_ADMISSION_REJECTIONS = "serving.admission_rejections_footprint"
 #: corrupted result frames a client caught by checksum and re-fetched
 SERVING_WIRE_RETRIES = "serving.wire_retries"
+#: queries resubmitted to another replica after their replica died
+#: mid-stream (client-side; each failover counts once per resubmission)
+SERVING_FAILOVERS = "serving.failovers"
+#: result frames a resumed query re-produced but SKIPPED because the
+#: client already held them (dedup by batch sequence number — the
+#: exactly-once delivery contract's server-side evidence)
+SERVING_RESUMED_BATCHES = "serving.resumed_batches"
+#: client-side circuit-breaker CLOSED->OPEN transitions (a replica hit
+#: its consecutive-failure threshold and left the routing rotation)
+SERVING_BREAKER_OPENS = "serving.breaker_opens"
+#: graceful-drain initiations (serve.drain RPC or SIGTERM): the replica
+#: flipped to DRAINING, redirecting new submissions while running
+#: queries finish and streams flush
+SERVING_DRAINS = "serving.drains"
 
 SERVING_METRIC_NAMES = (
     SERVING_WIRE_BYTES_OUT, SERVING_STREAM_BATCHES, SERVING_PREEMPTIONS,
-    SERVING_ADMISSION_REJECTIONS, SERVING_WIRE_RETRIES)
+    SERVING_ADMISSION_REJECTIONS, SERVING_WIRE_RETRIES, SERVING_FAILOVERS,
+    SERVING_RESUMED_BATCHES, SERVING_BREAKER_OPENS, SERVING_DRAINS)
 
 # Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
 # unlike the per-operator MetricSets — which live on per-action plan nodes —

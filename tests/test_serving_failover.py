@@ -1,0 +1,718 @@
+"""Serving-fleet resilience: health-checked failover routing, stream-resume
+retry, graceful drain.
+
+Covers the fleet-resilience contracts (docs/serving.md "Failure model"):
+- circuit breaker: consecutive failures -> OPEN (zero submissions) ->
+  backed-off probes -> HALF_OPEN trial -> success closes;
+- liveness: replicas heartbeat their registry-file mtime; discovery scans
+  skip AND garbage-collect entries whose heartbeat stopped (a SIGKILL'd
+  replica cannot retract its own file);
+- failover with stream resume: a seeded mid-stream kill_peer on replica A
+  resubmits the query to replica B with resume_from=<last seq delivered>;
+  B re-runs and skips already-delivered frames (dedup by seq) — the
+  assembled result is bit-identical with ZERO client-visible error, and
+  serving.failovers / serving.resumed_batches attribute the event;
+- graceful drain: serve.drain flips a replica to DRAINING — running
+  queries finish, streams flush, new submissions reroute transparently;
+- load-aware routing: the whale lands on the replica with free budget;
+  an OPEN breaker receives zero submissions until its probe succeeds;
+- deferred registration: a replica that was down (or undiscovered) at
+  register_table time gets the missing views replayed on first route.
+"""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving import wire
+from spark_rapids_tpu.serving.client import (QueryServiceClient,
+                                             RemoteQueryHandle,
+                                             WireQueryError)
+from spark_rapids_tpu.serving.health import (BREAKER_CLOSED, BREAKER_OPEN,
+                                             CircuitBreaker, routing_score)
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.shuffle.faults import FaultPlan
+from spark_rapids_tpu.shuffle.tcp import scan_registry
+from spark_rapids_tpu.utils import metrics as um
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.string.maxBytes": "16",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+}
+
+FILTER_SQL = "SELECT k, v FROM t WHERE v > 0.5"
+AGG_SQL = "SELECT k, sum(v) AS s FROM t GROUP BY k ORDER BY k"
+
+
+def make_table(n=20000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 8, n).astype("int64"),
+                     "v": rng.random(n)})
+
+
+def serve(extra_conf=None, partitions=3, n=20000):
+    """One in-process server over a session with view ``t`` registered."""
+    sess = TpuSession({**BASE_CONF, **(extra_conf or {})})
+    df = sess.create_dataframe(make_table(n))
+    if partitions > 1:
+        df = df.repartition(partitions)
+    df.createOrReplaceTempView("t")
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}"
+
+
+def _drain_schedulers(*sessions, timeout=60):
+    for s in sessions:
+        s.scheduler.drain(timeout=timeout)
+
+
+def _zero_leak_check():
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    dm = DeviceManager.peek()
+    if dm is None:
+        return
+    deadline = time.time() + 30
+    while dm.semaphore.active_holders > 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert dm.semaphore.active_holders == 0
+    assert dm.semaphore.waiting == 0
+
+
+def _dead_address():
+    """host:port nothing listens on (bound then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    host, port = s.getsockname()
+    s.close()
+    return f"{host}:{port}"
+
+
+FAST_DIAL = {
+    # a dead replica must cost milliseconds, not the default backoff walk
+    "spark.rapids.tpu.shuffle.maxRetries": "0",
+    "spark.rapids.tpu.shuffle.connectTimeout": "2",
+}
+
+
+# ------------------------------------------------------- circuit breaker
+def test_circuit_breaker_threshold_open_probe_halfopen_close():
+    before = um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value
+    br = CircuitBreaker(threshold=2, backoff_ms=30.0, seed=7, key="r1")
+    assert br.allow_submit()
+    br.record_failure()
+    assert br.allow_submit(), "below threshold must stay CLOSED"
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    assert not br.allow_submit()
+    assert um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value - before == 1
+    # no probe before the backoff elapses
+    assert not br.probe_due(time.monotonic())
+    deadline = time.time() + 5
+    while not br.probe_due():
+        assert time.time() < deadline, "backoff never elapsed"
+        time.sleep(0.01)
+    # HALF_OPEN trial: a failed probe re-opens with a DEEPER backoff and
+    # does NOT re-count in breaker_opens (only CLOSED->OPEN transitions)
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    assert um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value - before == 1
+    while not br.probe_due():
+        assert time.time() < deadline
+        time.sleep(0.01)
+    br.record_success()
+    assert br.state == BREAKER_CLOSED and br.allow_submit()
+    # a success resets the consecutive-failure count
+    br.record_failure()
+    assert br.allow_submit()
+
+
+def test_breaker_backoff_schedule_is_deterministic():
+    a = CircuitBreaker(threshold=1, backoff_ms=100.0, seed=3, key="x")
+    b = CircuitBreaker(threshold=1, backoff_ms=100.0, seed=3, key="x")
+    a.record_failure()
+    b.record_failure()
+    assert abs(a._probe_at - b._probe_at) < 0.05
+
+
+def test_breaker_half_open_admits_one_trial_at_a_time():
+    """Review regression: while HALF_OPEN, only ONE probe trial owns the
+    slot — concurrent submissions must not pile probes onto a dead
+    replica (the claim re-offers only after the trial-timeout guard)."""
+    br = CircuitBreaker(threshold=1, backoff_ms=1.0, trial_timeout_s=30.0)
+    br.record_failure()                 # OPEN
+    deadline = time.time() + 5
+    while not br.probe_due():
+        assert time.time() < deadline
+        time.sleep(0.005)
+    # the trial is claimed: every further caller is refused
+    assert not br.probe_due()
+    assert not br.probe_due()
+    br.record_failure()                 # trial reported: OPEN again
+    # a crashed trial must not wedge the breaker: an expired claim
+    # re-offers the slot
+    br2 = CircuitBreaker(threshold=1, backoff_ms=1.0, trial_timeout_s=0.01)
+    br2.record_failure()
+    while not br2.probe_due():
+        assert time.time() < deadline
+        time.sleep(0.005)
+    time.sleep(0.03)                    # the claim expires unreported
+    assert br2.probe_due()
+
+
+def test_routing_score_prefers_free_budget():
+    free = {"now": {"device_budget_bytes": 100, "device_budget_in_use": 0,
+                    "admission_queue_depth": 0, "running_by_tenant": {}},
+            "p99_wall_s": 0.0}
+    busy = {"now": {"device_budget_bytes": 100, "device_budget_in_use": 90,
+                    "admission_queue_depth": 2,
+                    "running_by_tenant": {"etl": 1}},
+            "p99_wall_s": 4.0}
+    assert routing_score(free) > routing_score(None) > routing_score(busy)
+
+
+# ------------------------------------------------------ kill_peer faults
+def test_kill_peer_spec_parses_and_fires_deterministically():
+    plan = FaultPlan.parse("kill_peer:req_type=data,after=2", seed=7)
+    assert not plan.on_kill_frame("peer-1")
+    assert plan.on_kill_frame("peer-1")
+    assert ("kill_peer", "peer-1", 2) in plan.fired
+    # request-phase targeting: only the filtered req_type counts
+    plan2 = FaultPlan.parse("kill_peer:req_type=serve.submit,after=1")
+    assert not plan2.on_kill_request("p", "serve.next")
+    assert plan2.on_kill_request("p", "serve.submit")
+
+
+# --------------------------------------------------- registry / liveness
+def test_registry_scan_skips_and_gcs_stale_entries(tmp_path):
+    reg = str(tmp_path)
+    fresh, stale = os.path.join(reg, "query-server-aa"), \
+        os.path.join(reg, "query-server-bb")
+    for path, addr in ((fresh, "127.0.0.1:1111"), (stale, "127.0.0.1:2222")):
+        with open(path, "w") as f:
+            f.write(addr)
+    with open(os.path.join(reg, "query-server-cc.tmp"), "w") as f:
+        f.write("127.0.0.1:3333")        # half-written publication
+    old = time.time() - 120
+    os.utime(stale, (old, old))          # SIGKILL'd replica: no heartbeat
+    live = scan_registry(reg, stale_after_s=5.0)
+    assert live == {"query-server-aa": "127.0.0.1:1111"}
+    assert not os.path.exists(stale), "stale entry must be GC'd"
+    assert os.path.exists(fresh)
+    # without a window nothing is GC'd (the shuffle layer's plain scan)
+    assert "query-server-aa" in scan_registry(reg)
+
+
+def test_registry_scan_distinguishes_missing_dir_from_unreadable(tmp_path):
+    """Review regression: a registry dir that does not exist YET is an
+    empty fleet ({}), but a transient scan failure must RAISE — reading
+    it as 'every replica died' would eject a healthy fleet."""
+    assert scan_registry(str(tmp_path / "not-yet")) == {}
+    not_a_dir = tmp_path / "file"
+    not_a_dir.write_text("127.0.0.1:1")
+    with pytest.raises(OSError):
+        scan_registry(str(not_a_dir))
+
+
+def test_refresh_keeps_previous_view_when_registry_unreadable(tmp_path):
+    """The client keeps its replica table through a transient registry
+    failure instead of dropping every discovered replica."""
+    reg = tmp_path / "reg"
+    reg.mkdir()
+    (reg / "query-server-aa").write_text("127.0.0.1:12345")
+    client = QueryServiceClient(
+        registry_dir=str(reg),
+        conf=TpuConf({**BASE_CONF,
+                      "spark.rapids.tpu.serving.health."
+                      "probeIntervalSeconds": "0"}))
+    try:
+        assert {s.addr for s in client.replica_states()} \
+            == {"127.0.0.1:12345"}
+        # the dir becomes unreadable (simulated: swap it for a file)
+        client.registry_dir = str(reg / "query-server-aa")
+        client._refresh_replicas(force=True)
+        assert {s.addr for s in client.replica_states()} \
+            == {"127.0.0.1:12345"}, "transient failure ejected the fleet"
+    finally:
+        client.close()
+
+
+def test_heartbeat_republishes_entry_gced_during_a_stall(tmp_path):
+    """Review regression: a live replica whose entry was GC'd while it
+    stalled past the liveness window must re-enter discovery on its next
+    heartbeat, not stay ejected forever."""
+    from spark_rapids_tpu.shuffle.tcp import TcpTransport
+    reg = str(tmp_path / "reg")
+    conf = TpuConf({"spark.rapids.tpu.shuffle.tcp.registryDir": reg})
+    t = TcpTransport("exec-stalled", conf)
+    try:
+        path = os.path.join(reg, "exec-stalled")
+        os.unlink(path)                 # a scanner GC'd us mid-stall
+        t.heartbeat()                   # resume: must republish
+        assert os.path.exists(path)
+        host, port = t.address
+        assert scan_registry(reg)["exec-stalled"] == f"{host}:{port}"
+    finally:
+        t.shutdown()
+
+
+def test_replica_discovery_and_heartbeat_through_registry(tmp_path):
+    reg = str(tmp_path / "serving-registry")
+    conf = {"spark.rapids.tpu.serving.net.registryDir": reg,
+            "spark.rapids.tpu.serving.health.heartbeatSeconds": "0.1"}
+    sess_a, server_a, addr_a = serve(conf)
+    sess_b, server_b, addr_b = serve(conf)
+    client = QueryServiceClient(
+        conf=TpuConf({**BASE_CONF,
+                      "spark.rapids.tpu.serving.net.registryDir": reg,
+                      "spark.rapids.tpu.serving.health."
+                      "probeIntervalSeconds": "0"}))
+    try:
+        assert {s.addr for s in client.replica_states()} == {addr_a, addr_b}
+        got = client.submit(AGG_SQL).result()
+        assert got.equals(sess_a.sql(AGG_SQL).collect())
+        # the heartbeat refreshes the registry mtime while the replica
+        # lives, so a liveness-windowed scan keeps both entries
+        time.sleep(0.3)
+        assert len(scan_registry(reg, stale_after_s=5.0)) == 2
+        # a KILLED replica stops heartbeating: its (lingering) entry ages
+        # out of the window and discovery drops it from the rotation
+        server_b.transport.kill()
+        deadline = time.time() + 10
+        while len(scan_registry(reg, stale_after_s=0.3)) > 1:
+            assert time.time() < deadline, "killed replica never aged out"
+            time.sleep(0.1)
+        client._refresh_replicas(force=True)
+        client.liveness_window = 0.3
+        client._refresh_replicas(force=True)
+        assert {s.addr for s in client.replica_states()} == {addr_a}
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+        _drain_schedulers(sess_a, sess_b)
+
+
+# ------------------------------------------- failover with stream resume
+def test_failover_mid_stream_kill_bit_identical_with_resume():
+    """The chaos bar: 2 replicas, a seeded kill_peer mid-stream on A; the
+    submitted query completes through failover with results bit-identical
+    to the single-replica collect, zero client-visible error, zero leaks
+    on the survivor, and serving.failovers / serving.resumed_batches
+    attribute the event."""
+    sess_a, server_a, addr_a = serve(
+        {"spark.rapids.tpu.serving.net.faults.plan":
+             "kill_peer:req_type=data,after=2",
+         "spark.rapids.tpu.serving.net.faults.seed": "7"}, partitions=5)
+    sess_b, server_b, addr_b = serve(partitions=5)
+    ref = sess_b.sql(FILTER_SQL).collect()
+    client = QueryServiceClient([addr_a, addr_b],
+                                TpuConf({**BASE_CONF, **FAST_DIAL}))
+    f0 = um.SERVING_METRICS[um.SERVING_FAILOVERS].value
+    r0 = um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].value
+    try:
+        h = client.submit(FILTER_SQL, replica=0)    # starts on A
+        got = h.result()                            # A dies on frame 2
+        assert got.equals(ref), "failover result diverged"
+        assert h.failovers == 1
+        assert h.replica == addr_b
+        assert h.batches_delivered == 5
+        assert um.SERVING_METRICS[um.SERVING_FAILOVERS].value - f0 == 1
+        # B re-ran the query and SKIPPED the frame the client already
+        # held (seq 0 was delivered before the kill; dedup by seq)
+        assert um.SERVING_METRICS[
+            um.SERVING_RESUMED_BATCHES].value - r0 >= 1
+        fired = [f for f in server_a.transport.plan.fired
+                 if f[0] == "kill_peer"]
+        assert fired, "the seeded kill never fired"
+        # zero leaks on the survivor: its query table drained at DONE
+        deadline = time.time() + 10
+        while server_b._queries and time.time() < deadline:
+            time.sleep(0.05)
+        assert not server_b._queries
+        _drain_schedulers(sess_a, sess_b)
+        _zero_leak_check()
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+
+
+def test_failover_disabled_for_non_idempotent_submission():
+    sess_a, server_a, addr_a = serve(
+        {"spark.rapids.tpu.serving.net.faults.plan":
+             "kill_peer:req_type=data,after=2",
+         "spark.rapids.tpu.serving.net.faults.seed": "7"}, partitions=5)
+    sess_b, server_b, addr_b = serve(partitions=5)
+    client = QueryServiceClient([addr_a, addr_b],
+                                TpuConf({**BASE_CONF, **FAST_DIAL}))
+    try:
+        h = client.submit(FILTER_SQL, replica=0, idempotent=False)
+        with pytest.raises(WireQueryError) as ei:
+            h.result()
+        assert ei.value.batches_delivered == 1
+        assert h.failovers == 0
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+        _drain_schedulers(sess_a, sess_b)
+
+
+def test_resume_from_skips_already_delivered_frames():
+    """Dedup-by-seq unit: a submission carrying resume_from=N receives
+    ONLY frames with seq > N, and they are byte-identical to the tail of
+    a full-stream run."""
+    sess, server, addr = serve(partitions=4)
+    client = QueryServiceClient([addr], sess.conf)
+    r0 = um.SERVING_METRICS[um.SERVING_RESUMED_BATCHES].value
+    try:
+        full = client.submit(FILTER_SQL)
+        batches = list(full._drive(retain=False))
+        assert len(batches) == 4
+        addr2, conn, qid = client._submit_routed(
+            FILTER_SQL, "default", 0.0, "", resume_from=1)
+        h = RemoteQueryHandle(client, addr2, conn, qid, "", sql=FILTER_SQL)
+        tail = list(h._drive(retain=False))
+        assert len(tail) == 2, "frames 0 and 1 must be skipped"
+        assert pa.concat_tables(tail).equals(pa.concat_tables(batches[2:]))
+        assert um.SERVING_METRICS[
+            um.SERVING_RESUMED_BATCHES].value - r0 == 2
+    finally:
+        client.close()
+        server.shutdown()
+        _drain_schedulers(sess)
+
+
+# -------------------------------------------------------- graceful drain
+def test_graceful_drain_finishes_running_and_reroutes_new():
+    """Drain-under-load: the running query on the draining replica
+    finishes and its stream flushes; every new submission reroutes to the
+    healthy replica with NO caller-visible error; the drained replica
+    reports DRAINING and reaches the drained (exit-ready) state."""
+    sess_a, server_a, addr_a = serve(
+        {"spark.rapids.tpu.serving.net.streamQueueDepth": "1"},
+        partitions=6)
+    sess_b, server_b, addr_b = serve(partitions=6)
+    ref = sess_b.sql(FILTER_SQL).collect()
+    client = QueryServiceClient(
+        [addr_a, addr_b],
+        TpuConf({**BASE_CONF,
+                 "spark.rapids.tpu.serving.health.probeIntervalSeconds":
+                     "0"}))
+    d0 = um.SERVING_METRICS[um.SERVING_DRAINS].value
+    try:
+        h1 = client.submit(FILTER_SQL, replica=0)   # running on A
+        it = h1.batches()
+        first = next(it)                            # mid-stream
+        ack = client.drain_replica(0)
+        assert ack["state"] == "DRAINING"
+        assert um.SERVING_METRICS[um.SERVING_DRAINS].value - d0 == 1
+        assert server_a.draining
+        # a second drain is idempotent
+        client.drain_replica(0)
+        assert um.SERVING_METRICS[um.SERVING_DRAINS].value - d0 == 1
+        # serve_stats reports the state (what routers read)
+        health = client.health(replica=0)
+        assert health["state"] == "DRAINING"
+        assert health["serve_stats"]["now"]["state"] == "DRAINING"
+        # new submissions reroute transparently — zero caller-visible
+        # errors while A is draining
+        for _ in range(3):
+            nh = client.submit(FILTER_SQL)
+            assert nh.replica == addr_b
+            assert nh.result().equals(ref)
+        assert server_b.session.scheduler.stats()["submitted"] == 3
+        assert sess_a.scheduler.stats()["submitted"] == 1
+        # in-process submits to a draining scheduler are rejected too
+        from spark_rapids_tpu.serving import SchedulerDrainingError
+        with pytest.raises(SchedulerDrainingError):
+            sess_a.submit(sess_a.sql(AGG_SQL))
+        # the RUNNING query finishes and its stream flushes
+        rest = list(it)
+        assert pa.concat_tables([first] + rest).equals(ref)
+        deadline = time.time() + 30
+        while not server_a.drained() and time.time() < deadline:
+            time.sleep(0.05)
+        assert server_a.drained(), "drained replica never became exit-ready"
+        _drain_schedulers(sess_a, sess_b)
+        _zero_leak_check()
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+
+
+# ------------------------------------------------- load-aware routing bar
+def test_loadaware_routing_lands_on_free_replica_and_breaker_blocks():
+    """Routing bar: with one replica footprint-saturated, new submissions
+    land on the free replica; an OPEN breaker receives zero submissions
+    until its probe succeeds."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.serving import QueryHandle
+    DeviceManager.shutdown()
+    budget_conf = {"spark.rapids.tpu.memory.tpu.poolSizeBytes":
+                   str(64 << 20)}
+    sess_a, server_a, addr_a = serve(budget_conf)
+    sess_b, server_b, addr_b = serve(budget_conf)
+    client = QueryServiceClient(
+        [addr_a, addr_b],
+        TpuConf({**BASE_CONF, **FAST_DIAL,
+                 "spark.rapids.tpu.serving.health.probeIntervalSeconds": "0",
+                 "spark.rapids.tpu.serving.failover."
+                 "breakerFailureThreshold": "1",
+                 "spark.rapids.tpu.serving.failover.breakerBackoffMs":
+                     "100"}))
+    b0 = um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value
+    try:
+        # saturate A's footprint ledger: half the device budget charged
+        whale = QueryHandle("whale-ledger")
+        server_a.session.scheduler.admission.admit(whale, 32 << 20)
+        for _ in range(4):
+            h = client.submit(AGG_SQL)
+            assert h.replica == addr_b, "whale landed on the full replica"
+            assert h.result().num_rows == 8
+        assert server_b.session.scheduler.stats()["submitted"] == 4
+        assert sess_a.scheduler.stats()["submitted"] == 0
+        # per-replica serve_stats show the asymmetry the router used
+        assert (client.stats(replica=0)["serve_stats"]["now"]
+                ["device_budget_fraction"] > 0.4)
+        assert (client.stats(replica=1)["serve_stats"]["now"]
+                ["device_budget_fraction"] < 0.1)
+        server_a.session.scheduler.admission.release(whale)
+
+        # now KILL A: the first probe failure opens the breaker
+        # (threshold 1) and A receives ZERO submissions while OPEN
+        server_a.transport.kill()
+        for _ in range(4):
+            h = client.submit(AGG_SQL)
+            assert h.replica == addr_b
+            assert h.result().num_rows == 8
+        st_a = client._replica_state(addr_a)
+        assert st_a.breaker.state == BREAKER_OPEN
+        assert um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value - b0 >= 1
+        assert sess_a.scheduler.stats()["submitted"] == 0, \
+            "an OPEN breaker must receive zero submissions"
+
+        # replica returns on the SAME address: once the breaker's backoff
+        # elapses, one health probe succeeds and closes it
+        _host, port = server_a.address
+        server_a.shutdown()
+        sess_a2 = TpuSession(dict(BASE_CONF))
+        sess_a2.create_dataframe(make_table()).repartition(3) \
+            .createOrReplaceTempView("t")
+        server_a2 = QueryServer(sess_a2, listen_port=port)
+        try:
+            deadline = time.time() + 15
+            while st_a.breaker.state != BREAKER_CLOSED:
+                assert time.time() < deadline, "breaker never closed"
+                time.sleep(0.05)
+                client.submit(AGG_SQL).result()     # probes ride routing
+            # the recovered replica rejoins the rotation
+            for _ in range(4):
+                client.submit(AGG_SQL).result()
+            assert sess_a2.scheduler.stats()["submitted"] >= 1
+        finally:
+            server_a2.shutdown()
+            _drain_schedulers(sess_a2)
+    finally:
+        client.close()
+        server_a.shutdown()
+        server_b.shutdown()
+        _drain_schedulers(sess_a, sess_b)
+        DeviceManager.shutdown()
+
+
+# ------------------------------------------------- deferred registration
+def test_register_table_tolerates_down_replica():
+    """One dead address must not brick registration or the client: the
+    broadcast succeeds on the live replica and routing skips the corpse."""
+    dead = _dead_address()
+    sess_b, server_b, addr_b = serve()
+    client = QueryServiceClient(
+        [dead, addr_b],
+        TpuConf({**BASE_CONF, **FAST_DIAL,
+                 "spark.rapids.tpu.serving.health.probeIntervalSeconds":
+                     "0"}))
+    try:
+        extra = pa.table({"x": [1, 2, 3]})
+        client.register_table("extra", extra)       # must NOT raise
+        got = client.submit("SELECT x FROM extra WHERE x > 1").result()
+        assert got.to_pydict() == {"x": [2, 3]}
+    finally:
+        client.close()
+        server_b.shutdown()
+        _drain_schedulers(sess_b)
+
+
+def test_breaker_open_resets_registration_ledger():
+    """A replica declared dead (breaker OPEN) may come back as a NEW
+    process on the same address: the client must forget what it thinks
+    is registered there so the views are replayed, not skipped."""
+    dead = _dead_address()
+    client = QueryServiceClient(
+        [dead],
+        TpuConf({**BASE_CONF, **FAST_DIAL,
+                 "spark.rapids.tpu.serving.failover."
+                 "breakerFailureThreshold": "2"}))
+    try:
+        st = client._replica_state(dead)
+        st.registered.add("extra")          # believed registered
+        client._note_replica_failure(st)
+        assert "extra" in st.registered     # one failure: still CLOSED
+        client._note_replica_failure(st)    # threshold: OPEN
+        assert st.breaker.state == BREAKER_OPEN
+        assert not st.registered, "dead replica's ledger must reset"
+    finally:
+        client.close()
+
+
+def test_probe_detects_restarted_incarnation_and_replays_views():
+    """Review regression: a replica restarting behind the same address
+    FASTER than the breaker threshold could notice reports a new
+    replica_id in serve.health — the client must replay its temp views
+    there, not trust the dead incarnation's ledger."""
+    sess_a, server_a, addr_a = serve()
+    client = QueryServiceClient(
+        [addr_a],
+        TpuConf({**BASE_CONF, **FAST_DIAL,
+                 "spark.rapids.tpu.serving.health.probeIntervalSeconds":
+                     "0"}))
+    sess_a2 = server_a2 = None
+    try:
+        extra = pa.table({"x": [1, 2, 3]})
+        client.register_table("extra", extra)
+        sql = "SELECT x FROM extra WHERE x > 1"
+        assert client.submit(sql).result().to_pydict() == {"x": [2, 3]}
+        st = client._replica_state(addr_a)
+        assert st.incarnation and "extra" in st.registered
+        # restart on the SAME port: one observed failure at most (under
+        # the default threshold 3 — the breaker never opens)
+        _host, port = server_a.address
+        server_a.shutdown()
+        sess_a2 = TpuSession(dict(BASE_CONF))
+        (sess_a2.create_dataframe(make_table()).repartition(3)
+         .createOrReplaceTempView("t"))
+        server_a2 = QueryServer(sess_a2, listen_port=port)
+        deadline = time.time() + 30
+        got = None
+        while got is None:
+            assert time.time() < deadline
+            try:
+                got = client.submit(sql).result()
+            except WireQueryError:
+                time.sleep(0.1)         # restart race: dial again
+        assert got.to_pydict() == {"x": [2, 3]}, \
+            "view was not replayed onto the new incarnation"
+        assert st.incarnation == server_a2.transport.executor_id
+    finally:
+        client.close()
+        server_a.shutdown()
+        if server_a2 is not None:
+            server_a2.shutdown()
+            _drain_schedulers(sess_a2)
+        _drain_schedulers(sess_a)
+
+
+def test_register_table_fails_only_when_no_replica_reachable():
+    client = QueryServiceClient([_dead_address()],
+                                TpuConf({**BASE_CONF, **FAST_DIAL}))
+    try:
+        with pytest.raises(WireQueryError, match="no replica"):
+            client.register_table("v", pa.table({"x": [1]}))
+    finally:
+        client.close()
+
+
+def test_deferred_register_replays_on_late_discovered_replica(tmp_path):
+    """A replica that joins AFTER the register_table broadcast gets the
+    missing views replayed before its first routed submission."""
+    reg = str(tmp_path / "reg")
+    conf = {"spark.rapids.tpu.serving.net.registryDir": reg,
+            "spark.rapids.tpu.serving.health.heartbeatSeconds": "0.1"}
+    sess_a, server_a, addr_a = serve(conf)
+    client = QueryServiceClient(
+        conf=TpuConf({**BASE_CONF,
+                      "spark.rapids.tpu.serving.net.registryDir": reg,
+                      "spark.rapids.tpu.serving.health."
+                      "probeIntervalSeconds": "0"}))
+    sess_b = server_b = None
+    try:
+        extra = pa.table({"x": [1, 2, 3]})
+        client.register_table("extra", extra)       # only A exists yet
+        sql = "SELECT x FROM extra WHERE x > 1"
+        assert client.submit(sql).result().to_pydict() == {"x": [2, 3]}
+        sess_b, server_b, addr_b = serve(conf)      # late joiner
+        client._refresh_replicas(force=True)
+        assert {s.addr for s in client.replica_states()} == {addr_a, addr_b}
+        # run the mix until B serves one — its first routed submission
+        # must replay the registration, not fail with an unknown view
+        deadline = time.time() + 30
+        while server_b.session.scheduler.stats()["submitted"] == 0:
+            assert time.time() < deadline, "routing never reached B"
+            assert client.submit(sql).result().to_pydict() == {"x": [2, 3]}
+        st_b = client._replica_state(addr_b)
+        assert "extra" in st_b.registered
+    finally:
+        client.close()
+        server_a.shutdown()
+        if server_b is not None:
+            server_b.shutdown()
+            _drain_schedulers(sess_b)
+        _drain_schedulers(sess_a)
+
+
+# ------------------------------------------- serve_stats churn edge cases
+def test_serve_stats_empty_window_percentiles_and_draining_state():
+    from spark_rapids_tpu.serving.stats import ServeStatsWindow
+    from spark_rapids_tpu.utils.metrics import percentile
+    assert percentile([], 50.0) == 0.0
+    assert percentile([], 99.0) == 0.0
+    sess = TpuSession(BASE_CONF)
+    win = ServeStatsWindow(window_s=1.0)    # windows clamp to >= 1 s
+    win.record_wall(0.5)
+    sched = sess.scheduler
+    time.sleep(1.1)
+    snap = win.snapshot(sched)          # wall aged out of the window
+    assert snap["wall_samples"] == 0
+    assert snap["p50_wall_s"] == 0.0 and snap["p99_wall_s"] == 0.0
+    assert snap["now"]["state"] == "UP"
+    # a DRAINING replica still reports a live series with its state
+    sched.start_draining()
+    snap = win.snapshot(sched)
+    assert snap["now"]["state"] == "DRAINING"
+    assert snap["series"], "a draining replica must keep sampling"
+    sess.scheduler.shutdown(wait=False)
+
+
+def test_serve_stats_tenant_gauges_after_cancelled_while_queued():
+    """A cancelled-while-queued terminal must leave the per-tenant gauges
+    sane: nothing queued for the tenant, no phantom running entry, and
+    its wall sample still feeds the latency window."""
+    sess = TpuSession({**BASE_CONF,
+                       "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    # h1 occupies the single worker; h2 waits QUEUED and is cancelled there
+    big = sess.create_dataframe(make_table(200000)).repartition(8)
+    h1 = sess.submit(big, tenant="etl")
+    h2 = sess.submit(big, tenant="adhoc")
+    assert h2.cancel()
+    h1.result(timeout=300)
+    deadline = time.time() + 30
+    while not h2.done and time.time() < deadline:
+        time.sleep(0.05)
+    assert h2.done
+    sched = sess.scheduler
+    sample = sched.serve_stats.sample(sched)
+    assert sample["queued_by_tenant"].get("adhoc", 0) == 0
+    assert sample["running_by_tenant"].get("adhoc", 0) == 0
+    assert sample["admission_queue_depth"] == 0
+    snap = sched.serve_stats.snapshot(sched)
+    # both terminals (DONE and CANCELLED) recorded wall samples
+    assert snap["wall_samples"] >= 2
+    sess.scheduler.shutdown(wait=False)

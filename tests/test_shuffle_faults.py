@@ -119,6 +119,58 @@ def test_fault_plan_parsing():
     assert [spec.fires(n) for n in (1, 2, 3, 4)] == [False, True, True, False]
 
 
+def test_kill_peer_plan_counts_requests_and_frames_separately():
+    """kill_peer (the failover chaos matrix's fault kind) is seeded and
+    phase-targetable: req_type filters pick the submit/stream/drain phase
+    (``data`` = the Nth outgoing data frame, mid-stream death), and the
+    per-peer counters replay identically under a fixed plan."""
+    plan = FaultPlan.parse("kill_peer:req_type=data,after=3", seed=7)
+    assert [plan.on_kill_frame("p")
+            for _ in range(3)] == [False, False, True]
+    # request events with another req_type never advance the data spec
+    assert not plan.on_kill_request("p", "serve.submit")
+    replay = FaultPlan.parse("kill_peer:req_type=data,after=3", seed=7)
+    assert [replay.on_kill_frame("p")
+            for _ in range(3)] == [False, False, True]
+    assert replay.fired == plan.fired == [("kill_peer", "p", 3)]
+    # phase targeting: a submit-phase kill ignores stream traffic
+    sub = FaultPlan.parse("kill_peer:req_type=serve.submit,after=1")
+    assert not sub.on_kill_frame("p")
+    assert sub.on_kill_request("p", "serve.submit")
+
+
+def test_kill_peer_leaves_registry_entry_for_gc(tmp_path):
+    """kill() is SIGKILL-shaped: the listener and sockets die, the
+    heartbeat stops, but the registry file LINGERS — exactly the stale
+    entry scan_registry's liveness-window GC must skip and collect."""
+    import os
+    import socket
+    from spark_rapids_tpu.shuffle.tcp import TcpTransport, scan_registry
+    reg = str(tmp_path / "reg")
+    conf = TpuConf({"spark.rapids.tpu.shuffle.tcp.registryDir": reg})
+    t = TcpTransport("exec-victim", conf)
+    path = os.path.join(reg, "exec-victim")
+    assert os.path.exists(path)
+    mtime0 = os.path.getmtime(path)
+    t.heartbeat()
+    assert os.path.getmtime(path) >= mtime0
+    host, port = t.address
+    t.kill()
+    # dead to the outside: new dials are refused...
+    with pytest.raises(OSError):
+        socket.create_connection((host, port), timeout=2)
+    # ...the heartbeat is a no-op...
+    old = time.time() - 120
+    os.utime(path, (old, old))
+    t.heartbeat()
+    assert os.path.getmtime(path) == old, "killed transport heartbeat"
+    # ...but the entry lingers (SIGKILL cannot retract it) until a
+    # liveness-windowed scan garbage-collects it
+    assert os.path.exists(path)
+    assert scan_registry(reg, stale_after_s=5.0) == {}
+    assert not os.path.exists(path)
+
+
 # ---------------------------------------------------------------------------------
 # chaos: one deterministic test per fault class
 # ---------------------------------------------------------------------------------
