@@ -19,8 +19,14 @@ Options:
     --list-rules        print the rule catalog and exit
     --list-suppressions inventory every inline ``# tpu-lint: disable=``
                         with file:line and its justification text
-    --format MODE       output format: text (default) or json — json emits
-                        one machine-readable object for CI annotation
+    --format MODE       output format: text (default), json — one
+                        machine-readable object for CI annotation — or
+                        sarif — a SARIF 2.1.0 log so CI publishes the
+                        findings as code annotations (ci/premerge.sh
+                        emits tpu-lint.sarif as an artifact)
+    --profile           per-rule wall-time breakdown, printed to stderr
+                        slowest-first (the premerge 30 s guard prints the
+                        three slowest rules from it when it trips)
     --check-configs     verify docs/configs.md matches the registry (the
                         premerge docs-sync gate; R004 drift runs in the
                         normal lint pass with baseline semantics)
@@ -152,8 +158,58 @@ def list_suppressions(files: List[SourceFile], fmt: str) -> int:
     return 0
 
 
+def _sarif_doc(findings, errors, stale, files_scanned: int, absorbed: int,
+               rule_seconds) -> Dict[str, object]:
+    """SARIF 2.1.0: the interchange format CI systems ingest to render
+    findings as inline code annotations on the PR diff."""
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    # plain repo-relative URIs, no uriBaseId: consumers
+                    # resolve against the checkout (GitHub code scanning
+                    # does; a bogus file:/// base would break the strict
+                    # ones that honor originalUriBaseIds)
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "snippet": {"text": f.code}},
+                },
+            }],
+        })
+    driver = {
+        "name": "tpu-lint",
+        "informationUri": "docs/static-analysis.md",
+        "rules": [{"id": r.rule_id,
+                   "shortDescription": {"text": r.title}}
+                  for r in all_rules()],
+    }
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": driver},
+            "results": results,
+            "properties": {
+                "filesScanned": files_scanned,
+                "baselined": absorbed,
+                "parseErrors": list(errors),
+                "staleBaseline": list(stale),
+                "ruleSeconds": dict(rule_seconds or {}),
+            },
+        }],
+    }
+
+
 def _emit(findings, errors, stale, files_scanned: int, absorbed: int,
-          fmt: str) -> None:
+          fmt: str, rule_seconds=None) -> None:
+    if fmt == "sarif":
+        print(json.dumps(_sarif_doc(findings, errors, stale, files_scanned,
+                                    absorbed, rule_seconds), indent=2))
+        return
     if fmt == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
@@ -161,6 +217,7 @@ def _emit(findings, errors, stale, files_scanned: int, absorbed: int,
             "stale_baseline": list(stale),
             "files_scanned": files_scanned,
             "baselined": absorbed,
+            "rule_seconds": dict(rule_seconds or {}),
         }, indent=2))
         return
     for f in findings:
@@ -194,7 +251,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--rules", default=None, metavar="IDS")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--list-suppressions", action="store_true")
-    ap.add_argument("--format", default="text", choices=("text", "json"))
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"))
+    ap.add_argument("--profile", action="store_true",
+                    help="per-rule wall-time breakdown on stderr")
     ap.add_argument("--check-configs", action="store_true")
     args = ap.parse_args(argv)
 
@@ -237,7 +297,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # is debt pretending to still exist — fail with a remove-me
         stale = bl.stale_entries(baseline_path, files, root)
     _emit(findings, result.errors, stale, result.files_scanned, absorbed,
-          args.format)
+          args.format, rule_seconds=result.rule_seconds)
+    if args.profile:
+        # stderr, slowest first: machine formats on stdout stay parseable
+        # and the premerge guard can `head -3` the culprits
+        for rid, secs in sorted(result.rule_seconds.items(),
+                                key=lambda kv: -kv[1]):
+            print(f"profile: {rid} {secs:.3f}s", file=sys.stderr)
     return 1 if (findings or result.errors or stale) else 0
 
 

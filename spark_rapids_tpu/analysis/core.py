@@ -105,6 +105,7 @@ def _load_builtin_rules() -> None:
                                            rules_locks,      # noqa: F401
                                            rules_metrics,    # noqa: F401
                                            rules_project,    # noqa: F401
+                                           rules_races,      # noqa: F401
                                            rules_recompile,  # noqa: F401
                                            rules_resource,   # noqa: F401
                                            rules_serving,    # noqa: F401
@@ -242,6 +243,9 @@ class AnalysisResult:
     findings: List[Finding] = field(default_factory=list)
     files_scanned: int = 0
     errors: List[str] = field(default_factory=list)
+    #: per-rule wall seconds (the --profile surface: when the premerge
+    #: 30 s guard trips, the three slowest rules name the culprit)
+    rule_seconds: Dict[str, float] = field(default_factory=dict)
 
 
 def load_source(path: str, display_path: Optional[str] = None,
@@ -263,17 +267,21 @@ def analyze_files(files: Sequence[SourceFile],
                   with_project_rules: bool = True) -> AnalysisResult:
     """Run every (selected) rule over ``files``; suppressions applied here so
     rules stay oblivious to them."""
+    import time as _time
     result = AnalysisResult(files_scanned=len(files))
     rules = [r for r in all_rules()
              if rule_ids is None or r.rule_id in rule_ids]
     for rule in rules:
         raw: List[Finding] = []
+        t0 = _time.perf_counter()
         if rule.is_project_rule:
             if with_project_rules:
                 raw = rule.check_project(files)
         else:
             for src in files:
                 raw.extend(rule.check(src))
+        result.rule_seconds[rule.rule_id] = round(
+            _time.perf_counter() - t0, 4)
         by_path = {f.display_path: f for f in files}
         for finding in raw:
             src = by_path.get(finding.path)

@@ -1229,6 +1229,10 @@ class TpuSession:
         #: last_metrics (per-query spans live on the QueryHandle)
         self.last_trace: list = []
         self._views: Dict[str, DataFrame] = {}
+        #: guards the view table: concurrent serve.register handlers (the
+        #: transport worker pool) register views while SQL planning reads
+        #: them (R012)
+        self._views_lock = threading.Lock()
         self.cache_manager = CacheManager(self)
         self._scheduler = None
         self._scheduler_lock = threading.Lock()
@@ -1278,13 +1282,16 @@ class TpuSession:
 
     # ---- SQL frontend -----------------------------------------------------
     def table(self, name: str) -> "DataFrame":
-        try:
-            return self._views[name.lower()]
-        except KeyError:
-            raise KeyError(f"table or view not found: {name}") from None
+        with self._views_lock:
+            try:
+                return self._views[name.lower()]
+            except KeyError:
+                raise KeyError(
+                    f"table or view not found: {name}") from None
 
     def register_view(self, name: str, df: "DataFrame") -> None:
-        self._views[name.lower()] = df
+        with self._views_lock:
+            self._views[name.lower()] = df
 
     def sql(self, query: str) -> "DataFrame":
         """Run a SQL query over registered temp views (the role Catalyst's

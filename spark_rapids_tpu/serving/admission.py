@@ -74,7 +74,7 @@ class FootprintAdmission:
         if not budget:
             return True
         from spark_rapids_tpu import config as cfg
-        handle.metrics["footprint_est_bytes"] = int(estimate)
+        handle.note_metric("footprint_est_bytes", int(estimate))
         grace = int(estimate) > budget
         if grace:
             # over-the-whole-budget whale: the OOC layer will partition
@@ -85,7 +85,7 @@ class FootprintAdmission:
             # whole runtime)
             charged = max(1, int(budget
                                  * self._conf.get(cfg.OOC_HEADROOM)))
-            handle.metrics["admission_grace_hint"] = True
+            handle.note_metric("admission_grace_hint", True)
         else:
             charged = int(estimate)
         with self._cv:
@@ -98,8 +98,8 @@ class FootprintAdmission:
             self._holds[handle.query_id] = charged
             self._used += charged
         if handle._admission_rejected_at is not None:
-            handle.metrics["admission_footprint_wait_s"] = round(
-                time.perf_counter() - handle._admission_rejected_at, 6)
+            handle.note_metric("admission_footprint_wait_s", round(
+                time.perf_counter() - handle._admission_rejected_at, 6))
         return True
 
     def admit(self, handle, estimate: Optional[int]) -> None:

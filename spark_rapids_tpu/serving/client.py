@@ -373,9 +373,12 @@ class QueryServiceClient:
         if not self.registry_dir:
             return
         now = time.monotonic()
-        if not force and now - self._last_scan < self.probe_interval:
-            return
-        self._last_scan = now
+        with self._lock:
+            # check-and-set under the lock: two submitting threads must
+            # not both decide the scan is due and double-scan (R012)
+            if not force and now - self._last_scan < self.probe_interval:
+                return
+            self._last_scan = now
         try:
             live = scan_registry(self.registry_dir,
                                  stale_after_s=self.liveness_window)
@@ -424,10 +427,13 @@ class QueryServiceClient:
         """Feed one failure to the replica's breaker; a breaker that just
         OPENED declares the replica dead, so its registration ledger is
         reset — a NEW process behind the same address (restart) has none
-        of the old incarnation's temp views and must get them replayed."""
+        of the old incarnation's temp views and must get them replayed.
+        The ledger is a plain set shared by every submitting thread, so
+        every mutation takes the client lock (R012)."""
         st.breaker.record_failure()
         if not st.breaker.allow_submit():
-            st.registered.clear()
+            with self._lock:
+                st.registered.clear()
 
     def _probe(self, st: ReplicaState) -> bool:
         """One serve.health probe: refresh the replica's stats/DRAINING
@@ -449,7 +455,8 @@ class QueryServiceClient:
                 # a DIFFERENT process answered on this address (restart
                 # faster than the breaker threshold could notice): it has
                 # none of the old incarnation's temp views — replay them
-                st.registered.clear()
+                with self._lock:
+                    st.registered.clear()
             st.incarnation = incarnation
         st.breaker.record_success()
         return True
@@ -499,7 +506,10 @@ class QueryServiceClient:
         """Pinned routing (tests / per-replica introspection): index into
         the stable pin table, bypassing health checks."""
         if replica is not None:
-            return self.addresses[replica % len(self.addresses)]
+            with self._lock:
+                # discovery appends to the pin table concurrently (R012)
+                addresses = list(self.addresses)
+            return addresses[replica % len(addresses)]
         return self._pick(exclude=())
 
     def _ensure_registered(self, st: ReplicaState, conn) -> None:
@@ -510,8 +520,11 @@ class QueryServiceClient:
             missing = [(n, req) for n, req in self._registered.items()
                        if n not in st.registered]
         for name, req in missing:
+            # the RPC stays OUTSIDE the lock (R006); only the ledger
+            # mutation itself takes it (R012)
             self._rpc(conn, wire.REQ_REGISTER, req)
-            st.registered.add(name)
+            with self._lock:
+                st.registered.add(name)
 
     # ---- API ---------------------------------------------------------------
     @staticmethod
@@ -607,7 +620,8 @@ class QueryServiceClient:
                 self._note_replica_failure(st)
                 errors.append(f"{st.addr}: {e}")
                 continue
-            st.registered.add(name)
+            with self._lock:
+                st.registered.add(name)
             st.breaker.record_success()
             delivered += 1
         if states and not delivered:

@@ -360,8 +360,8 @@ class QueryHandle:
 
     def mark_admitted(self) -> None:
         self._transition(QueryState.ADMITTED)
-        self.metrics["queue_wait_s"] = round(
-            time.perf_counter() - self.submitted_at, 6)
+        self.note_metric("queue_wait_s", round(
+            time.perf_counter() - self.submitted_at, 6))
 
     def mark_running(self) -> None:
         self._transition(QueryState.RUNNING)
@@ -376,15 +376,14 @@ class QueryHandle:
             self._error = error
             self._result = result
             self._work = None       # free the plan; the result is kept
-            self.metrics["wall_s"] = round(
+            wall = self.metrics["wall_s"] = round(
                 time.perf_counter() - self.submitted_at, 6)
             if result is not None and hasattr(result, "num_rows"):
                 self.metrics["rows"] = result.num_rows
         self._done_evt.set()
         _tracing.record(f"serving.state.{state.value}", "serving",
                         time.perf_counter_ns(), 0,
-                        {"tenant": self.tenant,
-                         "wall_s": self.metrics["wall_s"]},
+                        {"tenant": self.tenant, "wall_s": wall},
                         query_id=self.query_id)
         # terminal state drains to the streaming consumer on EVERY path —
         # worker completion, queued-cancel, scheduler shutdown — so a wire
@@ -435,14 +434,28 @@ class QueryHandle:
         return len(records)
 
     # ---- metric attribution ------------------------------------------------
+    def note_metric(self, key: str, value: Any) -> None:
+        """Set one metrics key under the handle lock. The metrics dict is
+        read by snapshot()/serve.stats from other threads while the
+        owning worker fills it — every writer goes through the lock so a
+        concurrent snapshot never iterates a resizing dict (R012)."""
+        with self._lock:
+            self.metrics[key] = value
+
+    def metric(self, key: str, default: Any = None) -> Any:
+        """Read one metrics key under the handle lock (the cross-thread
+        read counterpart of note_metric)."""
+        with self._lock:
+            return self.metrics.get(key, default)
+
     def note_admission_wait(self, seconds: float) -> None:
         with self._lock:
             self.metrics["admission_wait_s"] = round(
                 self.metrics["admission_wait_s"] + seconds, 6)
 
     def count_program(self, *, hit: bool, from_disk: bool = False) -> None:
-        pc = self.metrics["program_cache"]
         with self._lock:
+            pc = self.metrics["program_cache"]
             if hit:
                 pc["hits"] += 1
             else:

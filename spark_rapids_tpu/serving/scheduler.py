@@ -124,11 +124,16 @@ class SessionScheduler:
 
     def _push_weights_to_semaphore(self) -> None:
         """Mirror the scheduler's weights into the device-admission
-        semaphore so both layers share one fairness policy."""
+        semaphore so both layers share one fairness policy. The weight
+        table is snapshotted under the scheduler cv: set_tenant_weight
+        mutates it concurrently and a dict resized mid-iteration raises
+        (R012)."""
         from spark_rapids_tpu.memory.device_manager import DeviceManager
         dm = DeviceManager.peek()
         if dm is not None:
-            for tenant, w in dict(self._weights).items():
+            with self._cv:
+                weights = dict(self._weights)
+            for tenant, w in weights.items():
                 dm.semaphore.set_tenant_weight(tenant, w)
 
     def _weight(self, tenant: str) -> float:
@@ -240,7 +245,7 @@ class SessionScheduler:
             # feeds the serve.stats latency window and takes a gauge
             # sample, so a replica draining cancellations still reports a
             # live series to the router
-            self.serve_stats.record_wall(handle.metrics.get("wall_s"))
+            self.serve_stats.record_wall(handle.metric("wall_s"))
             self.serve_stats.sample(self)
 
     def _run_handle_traced(self, handle: QueryHandle) -> None:
@@ -249,7 +254,9 @@ class SessionScheduler:
             handle.finish_cancelled()
             return
         handle.mark_admitted()
-        if self._weights:
+        with self._cv:
+            has_weights = bool(self._weights)
+        if has_weights:
             # the DeviceManager is created lazily by the first action, so
             # weights pushed at scheduler construction may have found no
             # semaphore yet — re-mirror them on the running path (cheap,
@@ -263,8 +270,8 @@ class SessionScheduler:
                 if handle._planned is None:
                     df = self._as_dataframe(handle._work)
                     final = df._executed_plan()
-                    handle.metrics["plan_key"] = plan_key(final,
-                                                          self.session.conf)
+                    handle.note_metric("plan_key",
+                                       plan_key(final, self.session.conf))
                     from spark_rapids_tpu.plan.footprint import \
                         plan_working_set_estimate
                     handle._planned = (df, final,
@@ -384,10 +391,13 @@ class SessionScheduler:
             queued = [h for q in self._queues.values() for h in q]
             for q in self._queues.values():
                 q.clear()
+            # snapshot under the cv: a submit racing shutdown may still
+            # be appending to the worker list (R012)
+            workers = list(self._workers)
             self._cv.notify_all()
         for h in queued:
             h.cancel()
             h.finish_cancelled()
         if wait:
-            for t in self._workers:
+            for t in workers:
                 t.join(timeout)

@@ -66,7 +66,10 @@ class _ServedQuery:
         self.handle = handle
         self.stream = stream
         self.peer = peer
-        self.lock = threading.Lock()
+        # re-entrant: _drop_query guards the parked/slices teardown and
+        # is reached both bare (cancel, peer-lost, shutdown) and from
+        # under the serve.next poll's hold (R012)
+        self.lock = threading.RLock()
         self.next_seq = 0
         #: (seq, wire bytes, crc32) of the frame awaiting the client's ack
         self.parked: Optional[Tuple[int, bytes, int]] = None
@@ -253,8 +256,11 @@ class QueryServer:
             schema_ipc=wire.schema_to_ipc(result.schema)).to_bytes()
 
     def _drop_query(self, sq: _ServedQuery) -> None:
-        sq.parked = None
-        sq.slices.clear()
+        # under sq.lock: a cancel/peer-lost teardown must not clear the
+        # slice list out from under a serve.next handler mid-pop (R012)
+        with sq.lock:
+            sq.parked = None
+            sq.slices.clear()
         with self._lock:
             self._queries.pop(sq.handle.query_id, None)
 
@@ -298,11 +304,15 @@ class QueryServer:
         df.createOrReplaceTempView(req.name)
         return b""
 
+    def _queries_open(self) -> int:
+        with self._lock:
+            return len(self._queries)
+
     def _handle_stats(self, peer: str, payload: bytes) -> bytes:
         sched = self.session.scheduler
         out = {"scheduler": sched.stats(),
                "serving": um.SERVING_METRICS.snapshot(),
-               "queries_open": len(self._queries),
+               "queries_open": self._queries_open(),
                "state": "DRAINING" if self._draining else "UP",
                # the rolling time-series load-aware routing consumes:
                # device budget in use, queue depths, running/queued per
@@ -323,14 +333,14 @@ class QueryServer:
             #: address reports a NEW id, telling clients to replay their
             #: temp-view registrations instead of trusting a stale ledger
             "replica_id": self.transport.executor_id,
-            "queries_open": len(self._queries),
+            "queries_open": self._queries_open(),
             "serve_stats": sched.serve_stats.snapshot(sched),
         }, default=str).encode()
 
     def _handle_drain(self, peer: str, payload: bytes) -> bytes:
         self.drain()
         return json.dumps({"state": "DRAINING",
-                           "queries_open": len(self._queries)}).encode()
+                           "queries_open": self._queries_open()}).encode()
 
     # ---- lifecycle ---------------------------------------------------------
     def drain(self) -> None:

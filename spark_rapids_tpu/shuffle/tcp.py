@@ -160,14 +160,13 @@ class TcpClientConnection(ClientConnection):
     def request(self, req_type: str, payload: bytes,
                 cb: Callable[[Transaction], None]) -> Transaction:
         tx = Transaction().start(cb)
-        rid = self._t._next_request_id()
-        self._t._pending_rpcs[rid] = (tx, self._peer)
+        rid = self._t._register_rpc(tx, self._peer)
         body = (struct.pack(">H", len(req_type)) + req_type.encode()
                 + payload)
         try:
             _send_frame(self._peer.sock, self._peer.wlock, b"Q", rid, body)
         except OSError as e:
-            self._t._pending_rpcs.pop(rid, None)
+            self._t._drop_rpc(rid)
             tx.complete(TransactionStatus.ERROR, f"send failed: {e}")
         return tx
 
@@ -218,7 +217,10 @@ class TcpTransport(ShuffleTransport):
         super().__init__(executor_id, conf)
         self._handlers: Dict[str, Callable[[str, bytes], bytes]] = {}
         # pending tables track the OWNING peer per transaction, so a lost
-        # peer fails only its own transactions (scoped failure domains)
+        # peer fails only its own transactions (scoped failure domains).
+        # _rpc_lock guards the rpc table AND the id counter: caller
+        # threads insert while reader threads pop completions and the
+        # peer-lost sweep iterates (R012)
         self._pending_rpcs: Dict[int, Tuple[Transaction, "_Peer"]] = {}
         self._rpc_id = 0
         self._rpc_lock = threading.Lock()
@@ -226,10 +228,20 @@ class TcpTransport(ShuffleTransport):
         self._pending_recvs: Dict[
             int, Tuple[AddressLengthTag, Transaction, "_Peer"]] = {}
         self._early_data: Dict[int, bytes] = {}
+        # _peers_lock guards the peer table: reader threads register on
+        # hello, the accept loop creates, connect() callers register,
+        # peer-lost evicts with a check-then-act that must be atomic
+        # (a NEWER peer registered between the check and the pop must
+        # survive the old reader's eviction) — R012
         self._peers: Dict[str, _Peer] = {}
+        self._peers_lock = threading.Lock()
         self._clients: Dict[str, TcpClientConnection] = {}
         self._clients_lock = threading.Lock()
         self._server_conn = TcpServerConnection(self)
+        # init-before-spawn (R012): every attribute the worker/progress/
+        # accept/heartbeat threads read exists BEFORE the first spawn
+        self._killed = False
+        self._registry = self.conf.shuffle_tcp_registry
         # worker pool for request handlers (the server copy-executor role);
         # sized by conf: the shuffle data plane needs few, the serving wire
         # protocol raises it so bounded-poll serve.next handlers from many
@@ -256,8 +268,6 @@ class TcpTransport(ShuffleTransport):
         self.address = self._listener.getsockname()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"tcp-shuffle-accept-{executor_id}").start()
-        self._killed = False
-        self._registry = self.conf.shuffle_tcp_registry
         if self._registry:
             os.makedirs(self._registry, exist_ok=True)
             self._publish_registry()
@@ -319,7 +329,8 @@ class TcpTransport(ShuffleTransport):
             _Peer(self, sock)
 
     def _register_peer(self, peer_id: str, peer: _Peer) -> None:
-        self._peers[peer_id] = peer
+        with self._peers_lock:
+            self._peers[peer_id] = peer
 
     def _peer_lost(self, peer: _Peer, reason: str) -> None:
         """A reader exited: every pending transaction OWNED BY THAT PEER
@@ -332,18 +343,23 @@ class TcpTransport(ShuffleTransport):
             dead_tags = [t for t, (_, _, owner) in self._pending_recvs.items()
                          if owner is peer]
             recvs = [self._pending_recvs.pop(t)[1] for t in dead_tags]
-        dead_rids = [r for r, (_, owner) in list(self._pending_rpcs.items())
-                     if owner is peer]
-        rpcs = [tx for rid in dead_rids
-                for tx in (self._pending_rpcs.pop(rid, (None,))[0],)
-                if tx is not None]
+        with self._rpc_lock:
+            dead_rids = [r for r, (_, owner) in self._pending_rpcs.items()
+                         if owner is peer]
+            rpcs = [tx for rid in dead_rids
+                    for tx in (self._pending_rpcs.pop(rid, (None,))[0],)
+                    if tx is not None]
         # drop the dead peer from the connection tables so the next
         # connect() dials a fresh socket instead of reusing a corpse —
         # guard against a STALE reader (a replaced connection's old socket)
-        # evicting the live one
-        was_current = self._peers.get(peer.peer_id) is peer
+        # evicting the live one. Check-then-act is atomic under the peers
+        # lock: a NEWER peer registered between the check and the pop
+        # must survive the old reader's eviction (R012).
+        with self._peers_lock:
+            was_current = self._peers.get(peer.peer_id) is peer
+            if was_current:
+                self._peers.pop(peer.peer_id, None)
         if was_current:
-            self._peers.pop(peer.peer_id, None)
             with self._clients_lock:
                 self._clients.pop(peer.peer_id, None)
 
@@ -358,12 +374,18 @@ class TcpTransport(ShuffleTransport):
             self.notify_peer_lost(peer.peer_id)
 
     def _peer_by_id(self, peer_id: str) -> Optional[_Peer]:
-        return self._peers.get(peer_id)
+        with self._peers_lock:
+            return self._peers.get(peer_id)
 
-    def _next_request_id(self) -> int:
+    def _register_rpc(self, tx: Transaction, peer: _Peer) -> int:
         with self._rpc_lock:
             self._rpc_id += 1
+            self._pending_rpcs[self._rpc_id] = (tx, peer)
             return self._rpc_id
+
+    def _drop_rpc(self, rid: int) -> None:
+        with self._rpc_lock:
+            self._pending_rpcs.pop(rid, None)
 
     def _post_receive(self, alt: AddressLengthTag, tx: Transaction,
                       peer: _Peer) -> None:
@@ -408,7 +430,8 @@ class TcpTransport(ShuffleTransport):
         tx.complete(TransactionStatus.SUCCESS)
 
     def _on_response(self, rid: int, payload: bytes) -> None:
-        entry = self._pending_rpcs.pop(rid, None)
+        with self._rpc_lock:
+            entry = self._pending_rpcs.pop(rid, None)
         if entry is None:
             return
         tx, _owner = entry
@@ -538,7 +561,9 @@ class TcpTransport(ShuffleTransport):
         exactly the stale entry ``scan_registry``'s GC must absorb."""
         self._killed = True
         self._close_listener()
-        for p in list(self._peers.values()):
+        with self._peers_lock:
+            peers = list(self._peers.values())
+        for p in peers:
             p.close()
 
     def _close_listener(self) -> None:
@@ -564,7 +589,9 @@ class TcpTransport(ShuffleTransport):
             except OSError:
                 pass
         self._close_listener()
-        for p in list(self._peers.values()):
+        with self._peers_lock:
+            peers = list(self._peers.values())
+        for p in peers:
             p.close()
         for _ in range(self._num_workers):
             self._work.put(None)

@@ -1,0 +1,312 @@
+"""Regression tests for the data races R012 found and this PR fixed.
+
+Each test hammers one fixed race from concurrent threads and asserts the
+invariant the fix restored. They are regression DOCUMENTATION as much as
+detection: the static gate (tests/test_analysis.py::
+test_r012_real_package_clean) is what proves the locksets; these prove
+the locked code still behaves under real contention — the circuit
+breaker's single-trial claim, the metrics dict surviving concurrent
+snapshots, the registration ledger staying consistent, the wire stream
+surviving cancel-vs-next, the TCP rpc/peer tables under load.
+"""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import (QueryServiceClient,
+                                             WireQueryError)
+from spark_rapids_tpu.serving.health import (BREAKER_CLOSED, BREAKER_OPEN,
+                                             CircuitBreaker)
+from spark_rapids_tpu.serving.lifecycle import QueryHandle
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.shuffle.tcp import TcpTransport
+from spark_rapids_tpu.shuffle.transport import TransactionStatus
+from spark_rapids_tpu.utils import metrics as um
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.string.maxBytes": "16",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+}
+
+
+def _run_threads(fns):
+    errors = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:   # noqa: BLE001 - surfaced by assert
+            errors.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errors, errors
+    return errors
+
+
+# ------------------------------------------------- circuit breaker (PR 14)
+def test_breaker_opens_once_under_concurrent_failures():
+    """8 threads hammer record_failure on a CLOSED breaker: exactly ONE
+    transition to OPEN (one serving.breaker_opens bump), never several —
+    the consecutive-failure counter and state flip share one lock."""
+    br = CircuitBreaker(threshold=4, backoff_ms=10_000.0, key="x")
+    before = um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value
+    barrier = threading.Barrier(8)
+
+    def fail():
+        barrier.wait(5)
+        for _ in range(50):
+            br.record_failure()
+
+    _run_threads([fail] * 8)
+    assert br.snapshot()["state"] == BREAKER_OPEN
+    assert um.SERVING_METRICS[um.SERVING_BREAKER_OPENS].value - before == 1
+    assert br.snapshot()["opens"] == 1
+
+
+def test_breaker_single_half_open_trial():
+    """Once the OPEN backoff elapses, concurrent probe_due callers race
+    for the HALF_OPEN trial: exactly one wins the claim; the rest are
+    refused until the trial reports."""
+    br = CircuitBreaker(threshold=1, backoff_ms=0.0, key="y")
+    br.record_failure()                  # -> OPEN, probe due immediately
+    now = time.monotonic() + 1.0
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def probe():
+        barrier.wait(5)
+        if br.probe_due(now):
+            wins.append(threading.get_ident())
+
+    _run_threads([probe] * 8)
+    assert len(wins) == 1, wins
+    # the losing callers also must not have flipped anything: still
+    # HALF_OPEN with the single trial in flight, zero submissions pass
+    assert br.snapshot()["state"] == "HALF_OPEN"
+    assert not br.allow_submit()
+
+
+def test_breaker_probe_thread_racing_submit_threads():
+    """The PR 14 shape end-to-end: submit threads drive failures and
+    successes through CLOSED->OPEN->HALF_OPEN while a probe thread runs
+    the trial schedule. Invariants: an OPEN breaker passes zero
+    submissions, every transition lands in a legal state, and the final
+    successful trial closes it."""
+    br = CircuitBreaker(threshold=3, backoff_ms=1.0, seed=7, key="z")
+    stop = threading.Event()
+    illegal = []
+
+    def submitter():
+        while not stop.is_set():
+            snap = br.snapshot()
+            if snap["state"] not in ("CLOSED", "OPEN", "HALF_OPEN"):
+                illegal.append(snap)
+            if br.allow_submit():
+                # a passed submission reports its outcome (mostly bad,
+                # so the breaker keeps flipping under the prober)
+                br.record_failure()
+            time.sleep(0)
+
+    def prober():
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if br.probe_due():
+                if br.snapshot()["state"] != "HALF_OPEN":
+                    illegal.append(br.snapshot())
+                br.record_failure()      # failed trial: deeper backoff
+            time.sleep(0.001)
+        stop.set()
+
+    _run_threads([submitter, submitter, submitter, prober])
+    assert not illegal, illegal
+    # one real probe success closes it from wherever it stands
+    while not br.probe_due():
+        time.sleep(0.001)
+    br.record_success()
+    assert br.snapshot()["state"] == BREAKER_CLOSED
+    assert br.allow_submit()
+
+
+# --------------------------------------------- handle metrics (scheduler)
+def test_handle_metrics_writers_vs_concurrent_snapshots():
+    """Pre-fix, admission/scheduler wrote handle.metrics keys without the
+    handle lock while snapshot() iterated it under the lock — a growing
+    dict iterated mid-resize raises RuntimeError. note_metric/metric
+    route every cross-thread touch through the lock."""
+    h = QueryHandle("SELECT 1")
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            h.note_metric(f"k{i % 64}", i)
+            h.count_program(hit=bool(i % 2))
+            i += 1
+
+    def reader():
+        for _ in range(150):
+            snap = h.snapshot()
+            assert snap["query_id"] == h.query_id
+            h.metric("k1")
+        stop.set()
+
+    _run_threads([writer, writer, reader])
+
+
+def test_set_tenant_weight_racing_stats_and_push():
+    """Pre-fix, _push_weights_to_semaphore iterated the weight table
+    while set_tenant_weight resized it under the cv."""
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    sess = TpuSession(BASE_CONF)
+    sched = sess.scheduler
+    DeviceManager.initialize(sess.conf)   # so the push actually iterates
+
+    def setter(base):
+        for i in range(80):
+            sched.set_tenant_weight(f"t{base}-{i % 17}", 1.0 + i % 3)
+
+    def pusher():
+        for _ in range(80):
+            sched._push_weights_to_semaphore()
+            sched.stats()
+
+    _run_threads([lambda: setter(0), lambda: setter(1), pusher])
+    assert sched.stats()["weights"]
+
+
+# ------------------------------------------------ wire serving (PR 12/14)
+def _serve(extra=None, n=6000, partitions=3):
+    sess = TpuSession({**BASE_CONF, **(extra or {})})
+    rng = np.random.default_rng(11)
+    df = sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 8, n).astype("int64"),
+        "v": rng.random(n)})).repartition(partitions)
+    df.createOrReplaceTempView("t")
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}"
+
+
+@pytest.mark.slow
+def test_registered_ledger_concurrent_register_and_submit():
+    """register_table from several threads while others submit: the
+    per-replica registration ledger (a plain set, now mutated only under
+    the client lock) ends complete and every query succeeds."""
+    sess, server, addr = _serve()
+    client = QueryServiceClient([addr], TpuConf(BASE_CONF))
+    tables = {f"extra{i}": pa.table({"x": [i, i + 1]}) for i in range(4)}
+    try:
+        def register(name):
+            def go():
+                client.register_table(name, tables[name])
+            return go
+
+        def submit():
+            got = client.submit(
+                "SELECT k, sum(v) AS s FROM t GROUP BY k").result()
+            assert got.num_rows > 0
+
+        _run_threads([register(n) for n in tables] + [submit] * 3)
+        st = client.replica_states()[0]
+        assert set(tables) <= st.registered
+        for name in tables:
+            got = client.submit(f"SELECT x FROM {name}").result()
+            assert got.num_rows == 2
+    finally:
+        client.close()
+        server.shutdown()
+        sess.scheduler.shutdown(wait=False)
+
+
+@pytest.mark.slow
+def test_cancel_racing_stream_next():
+    """Client cancel races the serve.next poll: pre-fix _drop_query
+    cleared the slice list without the stream lock while the poll popped
+    it. The hammer asserts no crash and a fully-drained server table."""
+    sess, server, addr = _serve(
+        extra={"spark.rapids.tpu.serving.net.maxStreamBatchRows": "2"})
+    client = QueryServiceClient([addr], TpuConf({
+        **BASE_CONF,
+        "spark.rapids.tpu.serving.failover.enabled": "false"}))
+    try:
+        for _ in range(6):
+            h = client.submit("SELECT k, v FROM t WHERE v > 0.2")
+            it = h.batches()
+            next(it)                      # stream running
+
+            def consume():
+                try:
+                    for _b in it:
+                        pass
+                except (WireQueryError, RuntimeError):
+                    pass                  # cancelled underneath us: fine
+
+            def cancel():
+                try:
+                    h.cancel()
+                except WireQueryError:
+                    pass                  # already gone: fine
+
+            _run_threads([consume, cancel])
+        deadline = time.time() + 10
+        while server._queries and time.time() < deadline:
+            time.sleep(0.05)
+        assert not server._queries
+    finally:
+        client.close()
+        server.shutdown()
+        sess.scheduler.shutdown(wait=False)
+
+
+# --------------------------------------------------- tcp transport (PR 2)
+def test_tcp_rpc_table_under_concurrent_requests(tmp_path):
+    """Caller threads insert rpcs while reader threads pop completions
+    and the peer-lost sweep iterates — all through _rpc_lock now. After
+    a kill, new requests fail with an error instead of hanging."""
+    conf = TpuConf({
+        "spark.rapids.tpu.shuffle.transport.class":
+            "spark_rapids_tpu.shuffle.tcp.TcpTransport",
+        "spark.rapids.tpu.shuffle.tcp.registryDir": str(tmp_path / "reg"),
+        "spark.rapids.tpu.shuffle.maxRetries": "0",
+        "spark.rapids.tpu.shuffle.connectTimeout": "5",
+    })
+    a = TcpTransport("races-a", conf)
+    b = TcpTransport("races-b", conf)
+    try:
+        b.server.register_request_handler(
+            "echo", lambda peer, payload: payload)
+        conn = a.connect("races-b")
+
+        def hammer(tag):
+            for i in range(40):
+                payload = f"{tag}:{i}".encode()
+                tx = conn.request("echo", payload, lambda t: None)
+                tx.wait(10)
+                assert tx.status is TransactionStatus.SUCCESS
+                assert tx.response == payload
+
+        _run_threads([lambda: hammer(0), lambda: hammer(1),
+                      lambda: hammer(2), lambda: hammer(3)])
+        b.kill()
+        # the reader observes the death, sweeps the rpc table and evicts
+        # the peer atomically (the check-then-act the peers lock guards);
+        # a fresh connect() then re-dials and fails fast, never hangs
+        deadline = time.time() + 10
+        while a._peer_by_id("races-b") is not None and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        assert a._peer_by_id("races-b") is None
+        with pytest.raises(ConnectionError):
+            a.connect("races-b")
+    finally:
+        a.shutdown()
+        b.shutdown()
