@@ -23,6 +23,9 @@ echo "== shuffle fault injection over lz4-compressed payloads =="
 # compressed frames, not just copy-codec ones
 SHUFFLE_FAULTS_CODEC=lz4 python -m pytest tests/test_shuffle_faults.py -q
 
+echo "== lineage-scoped stage recompute suite (seeded kill_peer, scope fidelity, spill crc) =="
+python -m pytest tests/test_recompute.py -q
+
 echo "== serving wire fault matrix (seeded chaos against query submission + result streams) =="
 python - << 'PY'
 import time
@@ -209,6 +212,69 @@ for phase, plan in (("submit", "kill_peer:req_type=serve.submit,after=1"),
         sess_a.scheduler.drain(timeout=60)
         sess_b.scheduler.drain(timeout=60)
 print("replica-kill chaos matrix ok")
+PY
+
+echo "== cluster recompute chaos matrix (drop_conn / corrupt beyond retry / kill_peer, zero caller-visible errors) =="
+python - << 'PY'
+import pyarrow as pa
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.shuffle.inprocess import _Fabric
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.utils import metrics as mt
+
+BASE = {"spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+        "spark.rapids.tpu.shuffle.retryBackoffMs": "5",
+        "spark.rapids.tpu.shuffle.maxRetries": "1",
+        "spark.rapids.tpu.shuffle.fetch.timeoutSeconds": "10"}
+N = 4000
+fact = pa.table({"k": [i % 8 for i in range(N)], "v": list(range(N)),
+                 "f": [i * 0.25 for i in range(N)]})
+dim = pa.table({"k": list(range(8)), "name": [f"n{i}" for i in range(8)]})
+
+def run(s):
+    return (s.create_dataframe(fact).repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.sum("f").alias("sf"))
+            .join(s.create_dataframe(dim), "k")
+            .filter(F.col("sv") > -500).sort("sv", "k")).collect()
+
+ref_s = TpuSession(dict(BASE))
+ref = run(ref_s)
+ref_s._cluster_scheduler.close()
+_Fabric.reset()
+
+# every column breaches the transfer-retry layer (PR 2) a different way;
+# the bar is always the same: the lineage recompute layer absorbs it with
+# zero caller-visible errors and a bit-identical collect
+# - drop_conn count=0: exec-0's receive path from exec-1 is permanently
+#   dead -> retries exhaust, exec-1's blocks replay onto exec-0
+# - corrupt_frame count=0: every frame exec-1 sends fails the checksum
+#   beyond retry -> same scoped replay, survivors serve locally
+# - kill_peer: exec-1 dies mid-stream on its 1st data frame
+MATRIX = (("drop_conn", "drop_conn:owner=exec-0,peer=exec-1,count=0"),
+          ("corrupt-beyond-retry", "corrupt_frame:owner=exec-1,count=0"),
+          ("kill_peer", "kill_peer:owner=exec-1,req_type=data,after=1"))
+for name, plan in MATRIX:
+    s = TpuSession({**BASE,
+                    "spark.rapids.tpu.shuffle.transport.class":
+                        "spark_rapids_tpu.shuffle.faults."
+                        "FaultInjectingTransport",
+                    "spark.rapids.tpu.shuffle.faults.plan": plan,
+                    "spark.rapids.tpu.shuffle.faults.seed": "7"})
+    before = mt.recompute_snapshot()
+    got = run(s)                            # zero caller-visible errors
+    delta = mt.recompute_delta(before)
+    assert delta["shuffle.recomputes"] >= 1, (name, delta)
+    assert delta["shuffle.recompute_escalations"] == 0, (name, delta)
+    sched = s._cluster_scheduler
+    total_maps = sum(st.num_tasks for st in sched.last_stages
+                     if not st.is_result)
+    assert delta["shuffle.recomputed_map_tasks"] < total_maps, (name, delta)
+    assert_tables_equal(ref, got, ignore_order=True, approx_float=1e-9)
+    sched.close()
+    _Fabric.reset()
+    print(f"recompute chaos ok: {name} {delta}")
+print("cluster recompute chaos matrix ok")
 PY
 
 echo "== drain under load (zero dropped queries, transparent rerouting) =="

@@ -220,6 +220,62 @@ finally:
     server_b.shutdown()
 PY
 
+echo "== recompute smoke (2-peer cluster, seeded mid-reduce kill_peer, lineage-scoped stage recompute, bit-identical) =="
+python - << 'PY'
+import pyarrow as pa
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.shuffle.inprocess import _Fabric
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.utils import metrics as mt
+
+BASE = {"spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+        "spark.rapids.tpu.shuffle.retryBackoffMs": "5",
+        "spark.rapids.tpu.shuffle.maxRetries": "1",
+        "spark.rapids.tpu.shuffle.fetch.timeoutSeconds": "5"}
+N = 4000
+fact = pa.table({"k": [i % 8 for i in range(N)], "v": list(range(N)),
+                 "f": [i * 0.25 for i in range(N)]})
+dim = pa.table({"k": list(range(8)), "name": [f"n{i}" for i in range(8)]})
+
+def run(s):
+    return (s.create_dataframe(fact).repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.sum("f").alias("sf"))
+            .join(s.create_dataframe(dim), "k")
+            .filter(F.col("sv") > -500).sort("sv", "k")).collect()
+
+ref_s = TpuSession(dict(BASE))
+ref = run(ref_s)
+ref_s._cluster_scheduler.close()
+_Fabric.reset()
+
+# exec-1 dies mid-stream on its 1st outgoing data frame (the seeded Nth
+# data frame); the stage driver must recompute ONLY its map tasks
+s = TpuSession({**BASE,
+                "spark.rapids.tpu.shuffle.transport.class":
+                    "spark_rapids_tpu.shuffle.faults.FaultInjectingTransport",
+                "spark.rapids.tpu.shuffle.faults.plan":
+                    "kill_peer:owner=exec-1,req_type=data,after=1",
+                "spark.rapids.tpu.shuffle.faults.seed": "7"})
+before = mt.recompute_snapshot()
+got = run(s)                                # zero caller-visible errors
+delta = mt.recompute_delta(before)
+sched = s._cluster_scheduler
+total_maps = sum(st.num_tasks for st in sched.last_stages
+                 if not st.is_result)
+assert delta["shuffle.recomputes"] >= 1, delta
+assert 1 <= delta["shuffle.recomputed_map_tasks"] < total_maps, (
+    delta, total_maps)
+assert delta["shuffle.recompute_escalations"] == 0, delta
+dead = [ex.executor_id for ex in sched.executors
+        if not sched._executor_alive(ex)]
+assert dead == ["exec-1"], f"the seeded kill never fired: {dead}"
+# bit-identical collect (float aggs within the documented 1e-9 carve-out)
+assert_tables_equal(ref, got, ignore_order=True, approx_float=1e-9)
+sched.close()
+print("recompute smoke ok:", delta, f"total_maps={total_maps}")
+PY
+
 echo "== fusion smoke (4 queries fused vs unfused, bit-identical) =="
 python - << 'PY'
 from spark_rapids_tpu.api.dataframe import TpuSession

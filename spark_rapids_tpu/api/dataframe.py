@@ -557,8 +557,20 @@ class DataFrame:
         if (self.session.conf.get(_cfg.CLUSTER_EXECUTORS) >= 1
                 and not self.session.conf.get(_cfg.MESH_ENABLED)):
             from spark_rapids_tpu.parallel.cluster import cluster_scheduler_for
+            from spark_rapids_tpu.utils.metrics import (recompute_delta,
+                                                        recompute_snapshot)
+            # the cluster driver is the only executor of lineage recomputes,
+            # and it returns before the single-process metrics block below —
+            # snapshot around the run so a query served through the stage
+            # scheduler still records its fault-recovery story
+            recompute_before = recompute_snapshot()
             tables = cluster_scheduler_for(self.session).run(final)
             if tables is not None:
+                if self.session.conf.get(_cfg.METRICS_ENABLED):
+                    snap = {"shuffle": recompute_delta(recompute_before)}
+                    if query is not None:
+                        query.record_exec_metrics(snap)
+                    self.session.last_metrics = snap
                 if query is not None:
                     for t in tables:
                         query.emit_batch(t)
@@ -578,6 +590,8 @@ class DataFrame:
                                                     action_depth_scope,
                                                     memory_delta,
                                                     memory_snapshot,
+                                                    recompute_delta,
+                                                    recompute_snapshot,
                                                     serving_delta,
                                                     serving_snapshot,
                                                     transfer_delta,
@@ -591,6 +605,7 @@ class DataFrame:
         transfer_before = transfer_snapshot()
         memory_before = memory_snapshot()
         serving_before = serving_snapshot()
+        recompute_before = recompute_snapshot()
         import time as _time
         # stable node ordinals: the span/EXPLAIN-ANALYZE key (pre-order,
         # matching the f"{i}:{name}" keys of session.last_metrics)
@@ -736,6 +751,10 @@ class DataFrame:
                 # serving story: wire bytes/batches streamed, preemptions,
                 # footprint-admission rejections over the action's window
                 snap["serving"] = serving_delta(serving_before)
+                # fault-recovery story for the action: lineage-scoped stage
+                # recomputes the cluster driver ran (and escalations to the
+                # failover path) while this action was collecting
+                snap["shuffle"] = recompute_delta(recompute_before)
                 if query is not None:
                     query.record_exec_metrics(snap)
                 self.session.last_metrics = snap

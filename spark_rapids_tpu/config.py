@@ -588,6 +588,17 @@ SHUFFLE_CHECKSUM_ENABLED = _conf(
     "silently producing wrong rows; disabling skips client-side "
     "verification only.")
 
+SHUFFLE_RECOMPUTE_MAX_STAGE_ATTEMPTS = _conf(
+    "shuffle.recompute.maxStageAttempts", int, 2,
+    "How many lineage-scoped recompute rounds one stage may run after its "
+    "reduce side exhausts per-peer fetch retries (ShuffleFetchFailedError). "
+    "Each round re-executes ONLY the lost map tasks on surviving executors "
+    "and replaces their blocks exactly-once; past the budget the error "
+    "re-surfaces and the serving failover path (replica re-run) owns "
+    "recovery. 0 disables recompute — every fetch failure escalates "
+    "directly, the pre-lineage behavior.",
+    checker=_non_negative("maxStageAttempts"))
+
 SHUFFLE_FAULTS_PLAN = _conf(
     "shuffle.faults.plan", str, "",
     "Deterministic fault-injection plan for chaos testing the shuffle stack "
@@ -1015,6 +1026,10 @@ class TpuConf:
     @property
     def shuffle_checksum_enabled(self) -> bool:
         return self.get(SHUFFLE_CHECKSUM_ENABLED)
+
+    @property
+    def shuffle_recompute_max_stage_attempts(self) -> int:
+        return self.get(SHUFFLE_RECOMPUTE_MAX_STAGE_ATTEMPTS)
 
     @property
     def shuffle_faults_plan(self) -> str: return self.get(SHUFFLE_FAULTS_PLAN)

@@ -9,8 +9,10 @@ can rebuild the batch.
 from __future__ import annotations
 
 import enum
+import io
 import os
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -26,6 +28,25 @@ class StorageTier(enum.IntEnum):
     DEVICE = 0
     HOST = 1
     DISK = 2
+
+
+class SpillCorruptionError(RuntimeError):
+    """A disk-tier spill file failed its crc32 integrity check on unspill.
+
+    Raised INSTEAD of handing a garbage batch back up the tier chain: the
+    npz on disk no longer matches the checksum stamped when it was written
+    (bit rot, torn write, external truncation). Scoped to one buffer — the
+    caller decides recovery: operators surface it as a query error, the
+    shuffle server drops the block from its catalog so the reduce side
+    observes a LOST block and the lineage-recompute path rebuilds it."""
+
+    def __init__(self, path: str, expected: int, actual: int):
+        super().__init__(
+            f"spill file {path!r} is corrupt: crc32 {actual:#010x} != "
+            f"stamped {expected:#010x} — refusing to unspill garbage")
+        self.path = path
+        self.expected = expected
+        self.actual = actual
 
 
 @dataclass
@@ -117,7 +138,7 @@ class SpillableBuffer(Retainable):
     def __init__(self, buffer_id: BufferId, schema: Schema, num_rows: int,
                  tier: StorageTier, payload, size_bytes: int,
                  spill_priority: float, bits_mask: Tuple[bool, ...] = (),
-                 encodings: Tuple = ()):
+                 encodings: Tuple = (), disk_crc32: Optional[int] = None):
         super().__init__()
         self.id = buffer_id
         self.schema = schema
@@ -133,6 +154,9 @@ class SpillableBuffer(Retainable):
         self.size_bytes = size_bytes
         self.spill_priority = spill_priority
         self.bits_mask = bits_mask      # per-column f64 bits-sibling presence
+        #: crc32 over the npz file bytes, stamped by to_disk and verified by
+        #: every unspill read (DISK tier only; None elsewhere)
+        self.disk_crc32 = disk_crc32
         self.owner_store = None         # set by BufferStore.add_buffer
 
     # ---- materialization -------------------------------------------------------
@@ -148,7 +172,7 @@ class SpillableBuffer(Retainable):
                             self.bits_mask, self.encodings)
         if self.tier == StorageTier.DISK:
             # one npz read serves both the column arrays and the encodings
-            with np.load(self.payload) as z:
+            with self._open_npz() as z:
                 arrays = self._disk_arrays(z)
                 host_encs = self._disk_encodings(z)
             encs = self._device_put_encodings(host_encs)
@@ -216,9 +240,25 @@ class SpillableBuffer(Retainable):
         if self.tier == StorageTier.HOST:
             return self.payload
         if self.tier == StorageTier.DISK:
-            with np.load(self.payload) as z:
+            with self._open_npz() as z:
                 return self._disk_arrays(z)
         return [np.asarray(a) for a in self.payload]
+
+    def _open_npz(self):
+        """Open the disk payload with its crc32 verified FIRST: the whole
+        file is read once, checked against the stamp ``to_disk`` recorded,
+        and only then parsed (so np.load never sees corrupt bytes — a torn
+        npz header would otherwise raise an untyped zipfile error, and a
+        corrupt array body would silently decode). One read serves both
+        the check and the load via the in-memory buffer."""
+        with open(self.payload, "rb") as f:
+            data = f.read()
+        if self.disk_crc32 is not None:
+            actual = zlib.crc32(data)
+            if actual != self.disk_crc32:
+                raise SpillCorruptionError(self.payload, self.disk_crc32,
+                                           actual)
+        return np.load(io.BytesIO(data))
 
     @staticmethod
     def _disk_arrays(z) -> List[np.ndarray]:
@@ -249,7 +289,7 @@ class SpillableBuffer(Retainable):
                                          for e in self.encodings):
             return ()
         if self.tier == StorageTier.DISK:
-            with np.load(self.payload) as z:
+            with self._open_npz() as z:
                 return self._disk_encodings(z)
         out: List[Optional[HostDictEncoding]] = []
         for e in self.encodings:
@@ -308,7 +348,7 @@ class SpillableBuffer(Retainable):
         """(compact arrays, host encodings) — one npz read on the DISK tier
         (disk layouts are already compact; see _compact_host_arrays)."""
         if self.tier == StorageTier.DISK:
-            with np.load(self.payload) as z:
+            with self._open_npz() as z:
                 return self._disk_arrays(z), self._disk_encodings(z)
         return self._compact_host_arrays(), self._host_encodings()
 
@@ -338,11 +378,15 @@ class SpillableBuffer(Retainable):
             markers.append(DiskDictEncoding(e.lengths is not None,
                                             e.k_real, e.token))
         np.savez(path, **payload)
-        size = os.path.getsize(path)
+        # integrity stamp: crc32 over the exact bytes on disk, verified by
+        # every future unspill read (_open_npz) before np.load parses them
+        with open(path, "rb") as f:
+            data = f.read()
         return SpillableBuffer(self.id, self.schema, self.num_rows,
-                               StorageTier.DISK, path, size,
+                               StorageTier.DISK, path, len(data),
                                self.spill_priority, self.bits_mask,
-                               encodings=(tuple(markers) if encs else ()))
+                               encodings=(tuple(markers) if encs else ()),
+                               disk_crc32=zlib.crc32(data))
 
     def _on_release(self) -> None:
         if self.tier == StorageTier.DISK and isinstance(self.payload, str):

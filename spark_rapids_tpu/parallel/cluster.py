@@ -31,8 +31,8 @@ import subprocess
 import sys
 import tempfile
 import threading
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field, replace as _dc_replace
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import pyarrow as pa
 
@@ -42,7 +42,9 @@ from spark_rapids_tpu.config import TpuConf
 from spark_rapids_tpu.execs.base import ExecContext, LeafExec, PhysicalExec
 from spark_rapids_tpu.shuffle.manager import (CachingShuffleReader,
                                               CachingShuffleWriter, MapStatus,
-                                              MapOutputTracker, ShuffleEnv)
+                                              MapOutputTracker, ShuffleEnv,
+                                              ShuffleFetchFailedError)
+from spark_rapids_tpu.utils import metrics as mt
 
 _TCP_TRANSPORT = "spark_rapids_tpu.shuffle.tcp.TcpTransport"
 
@@ -228,6 +230,29 @@ class _TaskSpec:
     conf: TpuConf
 
 
+@dataclass
+class _StageLineage:
+    """The deterministic replay record of one map stage (Spark's lineage,
+    SURVEY.md §5): everything needed to re-execute ANY of the stage's map
+    tasks after its outputs are lost — the resolved sub-plan snapshot (an
+    immutable pickle: the driver's ``fix`` transform mutates shared tree
+    nodes, so the blob is the only stable copy), the plan-signature replay
+    key (program-cache machinery — a replayed task must run the exact plan
+    the original ran), the input split assignment per map id, and the dep
+    stage indices whose LIVE statuses feed the replay (so a replay whose
+    own inputs were lost recomputes them first, recursively)."""
+    stage_index: int
+    plan_blob: bytes
+    signature: str
+    num_source_parts: int
+    num_reduce_parts: int
+    dep_stage_indices: Tuple[int, ...]
+    #: map_id -> the source partitions its task maps (identity for hash
+    #: partitioning; ``{0: (0,)}`` for range — the single task re-samples
+    #: and maps every partition, exactly like the original run)
+    task_partitions: Dict[int, Tuple[int, ...]]
+
+
 def _run_task(env: ShuffleEnv, spec: _TaskSpec) -> bytes:
     """Execute one task against this executor's shuffle env. Returns pickled
     [MapStatus...] for map tasks or arrow-IPC table bytes for result tasks."""
@@ -297,8 +322,19 @@ class InProcessExecutor:
     def submit(self, spec: _TaskSpec) -> bytes:
         return _run_task(self.env, spec)
 
+    def alive(self) -> bool:
+        """Liveness for recompute scheduling: an executor whose transport
+        was killed (chaos kill_peer / real peer death) serves no tasks and
+        is excluded from replay targets."""
+        t = self.env.transport
+        return not (getattr(t, "killed", False) or getattr(t, "_killed",
+                                                           False))
+
     def cleanup_shuffle(self, shuffle_id: int) -> None:
         self.env.shuffle_catalog.remove_shuffle(shuffle_id)
+
+    def cleanup_map_outputs(self, shuffle_id: int, map_id: int) -> None:
+        self.env.shuffle_catalog.remove_map_outputs(shuffle_id, map_id)
 
     def send_broadcast(self, broadcast_id: int, ipc: bytes) -> None:
         # in-process executors share the driver's BroadcastManager, which
@@ -432,12 +468,33 @@ class ProcessExecutor:
     def submit(self, spec: _TaskSpec) -> bytes:
         resp = self._request({"type": "task", "spec": spec})
         if resp["type"] == "error":
+            if resp.get("error_kind") == "shuffle_fetch_failed":
+                # re-raise the daemon's structured payload as the real
+                # scoped error: the recompute driver keys off executor_id
+                # + blocks, which a flattened traceback string would lose
+                raise ShuffleFetchFailedError(
+                    f"task failed on {self.executor_id}: {resp['message']}",
+                    executor_id=resp.get("executor_id", ""),
+                    blocks=tuple(resp.get("blocks", ())))
             raise RuntimeError(
                 f"task failed on {self.executor_id}: {resp['message']}")
         return resp["blob"]
 
+    def alive(self) -> bool:
+        """Liveness probe over the control socket: a dead process (reader
+        loop exited) or a daemon whose shuffle transport was killed counts
+        as gone for recompute scheduling."""
+        if self._dead:
+            return False
+        resp = self._request({"type": "ping"})
+        return resp.get("type") == "pong" and not resp.get("killed", False)
+
     def cleanup_shuffle(self, shuffle_id: int) -> None:
         self._request({"type": "cleanup", "shuffle_id": shuffle_id})
+
+    def cleanup_map_outputs(self, shuffle_id: int, map_id: int) -> None:
+        self._request({"type": "cleanup_map", "shuffle_id": shuffle_id,
+                       "map_id": map_id})
 
     def send_broadcast(self, broadcast_id: int, ipc: bytes) -> None:
         resp = self._request({"type": "broadcast", "bid": broadcast_id,
@@ -498,6 +555,10 @@ class ClusterScheduler:
                                   os.path.join(self._tmp, f"exec-{i}"))
                 for i in range(self.n)]
         self._next_shuffle = 0
+        #: shuffle_id -> replay record, written when a map stage's tasks
+        #: are built and consulted when a reduce-side fetch failure scopes
+        #: lost map outputs back to this shuffle
+        self._lineage: Dict[int, _StageLineage] = {}
         #: (executor identity, cache table_id) -> shipped generation
         self._shipped_caches: Dict[Tuple[int, int], int] = {}
         atexit.register(self.close)
@@ -561,6 +622,7 @@ class ClusterScheduler:
         finally:
             from spark_rapids_tpu.parallel.broadcast import BroadcastManager
             for sid in shuffle_ids:
+                self._lineage.pop(sid, None)
                 for ex in self.executors:
                     try:
                         ex.cleanup_shuffle(sid)
@@ -761,7 +823,22 @@ class ClusterScheduler:
             dep_statuses=dep_statuses, conf=self.conf)
             for p in range(stage.num_tasks)]
 
-        results = self._run_tasks(tasks)
+        if not stage.is_result:
+            # lineage capture: the blob is the immutable sub-plan snapshot
+            # (fix mutates shared nodes, so re-pickling later would drift),
+            # and the program-cache signature is the stable replay key a
+            # re-execution is checked against
+            from spark_rapids_tpu.serving.program_cache import plan_key
+            self._lineage[stage.shuffle_id] = _StageLineage(
+                stage_index=stage.index, plan_blob=blob,
+                signature=plan_key(root, self.conf),
+                num_source_parts=num_source,
+                num_reduce_parts=stage.root.partitioning.num_partitions,
+                dep_stage_indices=tuple(stage.deps),
+                task_partitions={p: t.partitions
+                                 for t in tasks for p in t.partitions})
+
+        results = self._run_recomputing(tasks, stages, stage.deps, [0])
 
         if stage.is_result:
             per_part: List[Tuple[int, bytes]] = []
@@ -779,25 +856,187 @@ class ClusterScheduler:
                 statuses.extend(pickle.loads(blob_out))
             stage.statuses = statuses
 
-    def _run_tasks(self, tasks: List[_TaskSpec]) -> List[Optional[bytes]]:
-        """Run one stage's tasks across the executors: a shared work queue
-        drained by ``taskSlots`` worker threads per executor, so up to
-        numExecutors * taskSlots tasks are in flight and stage wall-clock
-        scales with partitions, not executors. Errors fail the stage fast
-        (remaining queued tasks are abandoned; Spark's task-retry story is
-        stage re-execution via lineage, SURVEY.md §5)."""
+    # -------------------------------------------------------- lineage recompute
+    def _executor_alive(self, ex) -> bool:
+        try:
+            return bool(ex.alive())
+        except Exception:
+            return False
+
+    @staticmethod
+    def _dep_statuses(stages: List[_Stage],
+                      dep_indices: Sequence[int]
+                      ) -> Dict[int, List[MapStatus]]:
+        """LIVE dep map statuses (broadcast deps have no shuffle): read at
+        (re)dispatch time so a replay observes replacements a recompute
+        round just made."""
+        return {stages[d].shuffle_id: stages[d].statuses
+                for d in dep_indices if stages[d].shuffle_id is not None}
+
+    def _run_recomputing(self, tasks: List[_TaskSpec], stages: List[_Stage],
+                         dep_indices: Sequence[int], budget: List[int],
+                         exclude: Set[str] = frozenset()
+                         ) -> List[Optional[bytes]]:
+        """Drive ``tasks`` to completion through the lineage-recompute loop
+        (the stage half of Spark's "task retry IS stage re-execution"):
+
+        - a task failing because the executor it ran ON died is merely LOST
+          work — requeued on the survivors (its fetch error, if any, names
+          whichever remote it happened to be reading and must not steer a
+          recompute);
+        - a ``ShuffleFetchFailedError`` from a live executor is the scoped
+          recompute signal: the named peer's lost map tasks are re-executed
+          from lineage on surviving peers, dep statuses refresh, and ONLY
+          the unfinished tasks re-dispatch;
+        - anything else is a real failure and surfaces unchanged.
+
+        ``budget`` is the stage-attempt counter (one mutable cell shared
+        with nested replays so a flapping fault cannot recurse forever);
+        past ``shuffle.recompute.maxStageAttempts`` the fetch error
+        re-surfaces and the serving failover path owns recovery."""
+        results: List[Optional[bytes]] = [None] * len(tasks)
+        work = list(enumerate(tasks))
+        while True:
+            live = [ex for ex in self.executors if self._executor_alive(ex)]
+            targets = ([ex for ex in live if ex.executor_id not in exclude]
+                       or live)
+            if not targets:
+                raise RuntimeError("no live executors remain to run stage "
+                                   "tasks")
+            errors = self._run_tasks(work, results, targets)
+            if not errors:
+                return results
+            recompute: List[ShuffleFetchFailedError] = []
+            only_lost = True
+            for ex, e in errors:
+                if not self._executor_alive(ex):
+                    continue                  # lost work, not a signal
+                only_lost = False
+                if not isinstance(e, ShuffleFetchFailedError):
+                    raise e
+                recompute.append(e)
+            if not only_lost:
+                budget[0] += 1
+                max_attempts = self.conf.get(
+                    cfg.SHUFFLE_RECOMPUTE_MAX_STAGE_ATTEMPTS)
+                if budget[0] > max_attempts:
+                    mt.RECOMPUTE_METRICS[
+                        mt.SHUFFLE_RECOMPUTE_ESCALATIONS].add(1)
+                    raise recompute[0]
+                for err in recompute:
+                    self._recompute_lost_maps(err, stages, dep_indices,
+                                              budget)
+            refreshed = self._dep_statuses(stages, dep_indices)
+            work = [(i, _dc_replace(tasks[i], dep_statuses=refreshed))
+                    for i in range(len(tasks)) if results[i] is None]
+
+    def _recompute_lost_maps(self, err: ShuffleFetchFailedError,
+                             stages: List[_Stage],
+                             dep_indices: Sequence[int],
+                             budget: List[int]) -> None:
+        """Scope one fetch failure to the map tasks that must replay. The
+        error's blocks are the per-shuffle scope; a DEAD peer additionally
+        widens to every map id it owned in the dep shuffles, because
+        zero-row blocks never register in the catalog — the block list a
+        single reduce partition observed can under-count a dead peer's map
+        tasks whose pieces for THAT partition were empty."""
+        by_shuffle: Dict[int, Set[int]] = {}
+        for b in err.blocks:
+            by_shuffle.setdefault(b.shuffle_id, set()).add(b.map_id)
+        peer = err.executor_id
+        peer_ex = next((ex for ex in self.executors
+                        if ex.executor_id == peer), None)
+        peer_dead = peer_ex is None or not self._executor_alive(peer_ex)
+        if peer_dead:
+            for d in dep_indices:
+                sid = stages[d].shuffle_id
+                if sid is None:
+                    continue
+                owned = {st.map_id for st in stages[d].statuses
+                         if st.executor_id == peer}
+                if owned:
+                    by_shuffle.setdefault(sid, set()).update(owned)
+        for sid in sorted(by_shuffle):
+            self._replay_map_tasks(sid, sorted(by_shuffle[sid]), {peer},
+                                   stages, budget)
+
+    def _replay_map_tasks(self, shuffle_id: int, map_ids: List[int],
+                          exclude: Set[str], stages: List[_Stage],
+                          budget: List[int]) -> None:
+        """Re-execute the lost map tasks of one shuffle from lineage on
+        surviving peers and REPLACE their outputs exactly-once: stale
+        catalog entries drop first on every live executor (a replay landing
+        where the originals still live must not double rows for a later
+        reader), then the fresh MapStatus entries replace the lost ones
+        by map id in the owning stage's statuses."""
+        lin = self._lineage.get(shuffle_id)
+        if lin is None:
+            raise RuntimeError(
+                f"no lineage recorded for shuffle {shuffle_id}; cannot "
+                f"recompute map tasks {map_ids}")
+        from spark_rapids_tpu.serving.program_cache import plan_key
+        root = pickle.loads(lin.plan_blob)
+        sig = plan_key(root, self.conf)
+        if sig != lin.signature:
+            raise RuntimeError(
+                f"lineage replay key mismatch for shuffle {shuffle_id}: "
+                f"{sig} != {lin.signature} — replay would not be "
+                f"deterministic, escalating")
+        mt.RECOMPUTE_METRICS[mt.SHUFFLE_RECOMPUTES].add(1)
+        mt.RECOMPUTE_METRICS[mt.SHUFFLE_RECOMPUTED_MAP_TASKS].add(
+            len(map_ids))
+        for ex in self.executors:
+            if not self._executor_alive(ex):
+                continue
+            for m in map_ids:
+                try:
+                    ex.cleanup_map_outputs(shuffle_id, m)
+                except Exception:
+                    pass          # best-effort: a dying executor's catalog
+        specs = [_TaskSpec(
+            kind="map", plan_blob=lin.plan_blob,
+            partitions=lin.task_partitions[m],
+            num_source_parts=lin.num_source_parts,
+            shuffle_id=shuffle_id, num_reduce_parts=lin.num_reduce_parts,
+            dep_statuses=self._dep_statuses(stages, lin.dep_stage_indices),
+            conf=self.conf)
+            for m in map_ids]
+        # the shared attempt budget rides into the nested run: a replay
+        # whose own dep shuffle was lost recomputes it recursively, bounded
+        # by the same maxStageAttempts cell
+        blobs = self._run_recomputing(specs, stages, lin.dep_stage_indices,
+                                      budget, exclude=exclude)
+        fresh: List[MapStatus] = []
+        for blob in blobs:
+            fresh.extend(pickle.loads(blob))
+        owner = stages[lin.stage_index]
+        replaced = set(map_ids)
+        # in-place: every dep_statuses dict built earlier references THIS
+        # list object, so readers of the next dispatch see the replacement
+        owner.statuses[:] = [st for st in owner.statuses
+                             if st.map_id not in replaced] + fresh
+
+    def _run_tasks(self, work: List[Tuple[int, _TaskSpec]],
+                   results: List[Optional[bytes]],
+                   executors: List) -> List[Tuple[object, Exception]]:
+        """Run one round of (index, spec) work items across ``executors``:
+        a work queue per executor drained by ``taskSlots`` worker threads,
+        so up to executors * taskSlots tasks are in flight and stage
+        wall-clock scales with partitions, not executors. Errors stop the
+        round fast (remaining queued items are abandoned) and return as
+        (executor, error) pairs for the recompute loop to triage — stage
+        re-execution via lineage, SURVEY.md §5."""
         import collections
         # tasks pin to executors round-robin (Spark's locality preference:
         # an executor's map outputs stay in ITS shuffle catalog, so spreading
         # map tasks keeps reduce reads mostly local); each executor drains
         # its queue with `taskSlots` concurrent workers
-        n_ex = len(self.executors)
+        n_ex = len(executors)
         queues = [collections.deque() for _ in range(n_ex)]
-        for idx, spec in enumerate(tasks):
-            queues[idx % n_ex].append((idx, spec))
+        for k, item in enumerate(work):
+            queues[k % n_ex].append(item)
         qlock = threading.Lock()
-        results: List[Optional[bytes]] = [None] * len(tasks)
-        errors: List[Exception] = []
+        errors: List[Tuple[object, Exception]] = []
         slots = max(1, self.conf.get(cfg.CLUSTER_TASK_SLOTS))
 
         def worker(home: int, ex) -> None:
@@ -808,21 +1047,19 @@ class ClusterScheduler:
                     idx, spec = queues[home].popleft()
                 try:
                     results[idx] = ex.submit(spec)
-                except Exception as e:       # surfaced after join
-                    errors.append(e)
+                except Exception as e:       # triaged after join
+                    errors.append((ex, e))
                     return
 
         threads = [threading.Thread(target=worker, args=(i, ex),
                                     name=f"task-slot-{i}-{s}")
-                   for i, ex in enumerate(self.executors)
+                   for i, ex in enumerate(executors)
                    for s in range(min(slots, len(queues[i])))]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        if errors:
-            raise errors[0]
-        return results
+        return errors
 
     def close(self) -> None:
         import shutil

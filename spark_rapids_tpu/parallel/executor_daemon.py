@@ -110,8 +110,21 @@ def main() -> int:
             rid = msg.get("id")
             if kind == "stop":
                 return 0
+            if kind == "ping":
+                # liveness probe: the control socket can outlive a killed
+                # shuffle transport (chaos kill_peer), so report both
+                t = env.transport
+                killed = bool(getattr(t, "killed", False)
+                              or getattr(t, "_killed", False))
+                send({"type": "pong", "killed": killed, "id": rid})
+                continue
             if kind == "cleanup":
                 env.shuffle_catalog.remove_shuffle(msg["shuffle_id"])
+                send({"type": "ok", "id": rid})
+                continue
+            if kind == "cleanup_map":
+                env.shuffle_catalog.remove_map_outputs(msg["shuffle_id"],
+                                                       msg["map_id"])
                 send({"type": "ok", "id": rid})
                 continue
             if kind == "broadcast":
@@ -143,9 +156,20 @@ def main() -> int:
                 # tasks to taskSlots per executor; device entry inside the
                 # task is gated by the admission semaphore)
                 def run(spec=msg["spec"], rid=rid) -> None:
+                    from spark_rapids_tpu.shuffle.manager import \
+                        ShuffleFetchFailedError
                     try:
                         blob = _run_task(env, spec)
                         send({"type": "done", "blob": blob, "id": rid})
+                    except ShuffleFetchFailedError as e:
+                        # the scoped payload must survive the control
+                        # socket: the driver's recompute loop keys off
+                        # executor_id + blocks (both plain picklable)
+                        send({"type": "error", "id": rid,
+                              "error_kind": "shuffle_fetch_failed",
+                              "executor_id": e.executor_id,
+                              "blocks": e.blocks,
+                              "message": str(e)})
                     except Exception:
                         send({"type": "error", "id": rid,
                               "message": traceback.format_exc()})

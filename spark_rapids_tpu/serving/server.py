@@ -312,6 +312,10 @@ class QueryServer:
         sched = self.session.scheduler
         out = {"scheduler": sched.stats(),
                "serving": um.SERVING_METRICS.snapshot(),
+               # lineage-recompute story: how often this replica repaired a
+               # lost shuffle block by scoped re-execution instead of
+               # failing the query over to another replica
+               "shuffle": um.RECOMPUTE_METRICS.snapshot(),
                "queries_open": self._queries_open(),
                "state": "DRAINING" if self._draining else "UP",
                # the rolling time-series load-aware routing consumes:

@@ -94,6 +94,33 @@ class ShuffleBufferCatalog:
             raise
         return out
 
+    def remove_map_outputs(self, shuffle_id: int, map_id: int) -> int:
+        """Unregister every block one map task produced — the exactly-once
+        half of lineage recompute: a replayed map task REPLACES its old
+        blocks (this call, then fresh add_batch registrations) instead of
+        appending to them, so a recompute landing on an executor that
+        still holds stale entries can never double rows for a later
+        reader. Readers that already consumed the old buffers are safe —
+        their (block, table_idx) dedup is per-read() and a removed buffer
+        stays alive until its refcount drains."""
+        with self._lock:
+            keep, victims = [], []
+            for block in self._by_shuffle.get(shuffle_id, []):
+                (victims if block.map_id == map_id else keep).append(block)
+            if not victims:
+                return 0
+            self._by_shuffle[shuffle_id] = keep
+            removed = 0
+            for block in victims:
+                for buffer_id, _ in self._blocks.pop(block, []):
+                    buf = self._catalog.acquire(buffer_id)
+                    if buf is not None:
+                        owner = buf.owner_store or self._device_store
+                        buf.close()
+                        owner.remove(buffer_id)
+                        removed += 1
+            return removed
+
     def remove_shuffle(self, shuffle_id: int) -> int:
         """Unregister a completed shuffle (unregisterShuffle analog)."""
         with self._lock:

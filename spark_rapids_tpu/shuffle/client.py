@@ -222,7 +222,12 @@ class ShuffleClient:
             answered = {p.block for p in pending}
             missing = [b for b in blocks if b not in answered]
             if missing:
-                state.register([(b, 0) for b in missing])
+                # register EVERY requested block, not just the missing ones:
+                # the answered blocks' transfers are never issued either, so
+                # the ShuffleFetchFailedError must scope the whole
+                # undelivered set for the recompute round to be complete on
+                # the first signal
+                state.register([(b, 0) for b in blocks])
                 state.fail(
                     f"peer {self.connection.peer_executor_id} lost blocks: "
                     f"{missing[:3]}{'...' if len(missing) > 3 else ''}",

@@ -42,8 +42,17 @@ injection points:
 Keys: ``peer`` (exact executor id, default ``*``), ``after`` (1-based Nth
 matching event, default 1), ``count`` (how many consecutive events fire,
 default 1, ``0`` = every event from ``after`` on), ``delay_ms``,
-``req_type``. Event counters run PER PEER, so ``drop_conn:after=2`` drops
-each remote peer's connection once.
+``req_type``, ``owner`` (exact executor id of the transport that INJECTS
+the fault, default ``*``). Event counters run PER PEER, so
+``drop_conn:after=2`` drops each remote peer's connection once.
+
+``owner`` exists because the conf — and therefore the plan — is shared by
+every executor in a cluster session: ``kill_peer:req_type=data`` alone
+would kill ALL executors on their first data frame. With
+``kill_peer:owner=exec-1,req_type=data,after=2`` only exec-1's transport
+honors the spec, the deterministic single-executor death the recompute
+tests are built on (``peer`` filters the REMOTE side of the event;
+``owner`` filters the local, injecting side).
 """
 from __future__ import annotations
 
@@ -77,6 +86,7 @@ class FaultSpec:
     count: int = 1
     delay_ms: float = 50.0
     req_type: str = "*"
+    owner: str = "*"
 
     def matches(self, peer: str, req_type: str = "*") -> bool:
         return (self.peer in ("*", peer)
@@ -107,6 +117,8 @@ class FaultSpec:
                     spec.delay_ms = float(val)
                 elif key == "req_type":
                     spec.req_type = val.strip()
+                elif key == "owner":
+                    spec.owner = val.strip()
                 else:
                     raise ValueError(f"unknown fault key {key!r} in {text!r}")
         return spec
@@ -117,18 +129,22 @@ class FaultPlan:
     one seeded PRNG for the plan's random choices. ``fired`` records every
     injected fault for test assertions."""
 
-    def __init__(self, specs: Tuple[FaultSpec, ...] = (), seed: int = 0):
+    def __init__(self, specs: Tuple[FaultSpec, ...] = (), seed: int = 0,
+                 owner: str = "*"):
         self.specs = tuple(specs)
         self.seed = seed
+        #: executor id of the transport this plan instance belongs to —
+        #: specs with an ``owner`` filter only fire on that transport
+        self.owner = owner
         self._rng = random.Random(seed)
         self._counts: Dict[Tuple[int, str], int] = {}
         self._lock = threading.Lock()
         self.fired: List[Tuple[str, str, int]] = []   # (kind, peer, event#)
 
     @classmethod
-    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+    def parse(cls, text: str, seed: int = 0, owner: str = "*") -> "FaultPlan":
         specs = [FaultSpec.parse(s) for s in text.split(";") if s.strip()]
-        return cls(tuple(specs), seed)
+        return cls(tuple(specs), seed, owner)
 
     @property
     def empty(self) -> bool:
@@ -142,6 +158,8 @@ class FaultPlan:
         with self._lock:
             for i, spec in enumerate(self.specs):
                 if spec.kind not in kinds or not spec.matches(peer, req_type):
+                    continue
+                if spec.owner not in ("*", self.owner):
                     continue
                 key = (i, peer)
                 n = self._counts.get(key, 0) + 1
@@ -382,7 +400,8 @@ class FaultInjectingTransport(ShuffleTransport):
         self.throttle = self._inner.throttle
         self.metrics = self._inner.metrics
         self.plan = FaultPlan.parse(self.conf.shuffle_faults_plan,
-                                    self.conf.shuffle_faults_seed)
+                                    self.conf.shuffle_faults_seed,
+                                    owner=executor_id)
         # real peer deaths in the wrapped transport surface through us too
         self._inner.add_peer_lost_listener(self.notify_peer_lost)
         self._conns: Dict[str, _FaultyClientConnection] = {}

@@ -30,7 +30,8 @@ from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ShuffleTransport, Transaction,
                                                 TransactionStatus)
 from spark_rapids_tpu.columnar.dtypes import DType
-from spark_rapids_tpu.memory.buffer import SpillableBuffer, StorageTier
+from spark_rapids_tpu.memory.buffer import (SpillCorruptionError,
+                                            SpillableBuffer, StorageTier)
 
 
 def _pack_spillable(buf: SpillableBuffer) -> bytes:
@@ -170,6 +171,16 @@ class ShuffleServer:
         buf, meta = acquired[req.table_idx]
         try:
             raw = _pack_spillable(buf)
+        except SpillCorruptionError:
+            # a spill file that fails its crc is a LOST block, not a
+            # transient transfer error: drop the whole map task's outputs
+            # from the catalog so the peer's next metadata request reports
+            # them missing — the permanent lost-block signal that feeds
+            # the lineage-recompute path (the replayed map task replaces
+            # the dropped blocks exactly-once)
+            self.catalog.remove_map_outputs(req.block.shuffle_id,
+                                            req.block.map_id)
+            raise
         finally:
             buf.close()
         codec = self._negotiate_codec(req.codec)

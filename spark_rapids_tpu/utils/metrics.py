@@ -137,6 +137,27 @@ SERVING_METRIC_NAMES = (
     SERVING_ADMISSION_REJECTIONS, SERVING_WIRE_RETRIES, SERVING_FAILOVERS,
     SERVING_RESUMED_BATCHES, SERVING_BREAKER_OPENS, SERVING_DRAINS)
 
+# Lineage-recompute counters (driver-process-global: the stage driver in
+# parallel/cluster.py owns every bump — executors never recompute on their
+# own). The escalation ladder in one glance: how often a lost map output
+# was repaired by a scoped stage re-execution (instead of a whole-query
+# failover), how many map tasks each repair replayed, and how often the
+# per-stage attempt budget ran dry and the query escalated to PR 14's
+# replica failover.
+#: scoped stage re-executions triggered by a ShuffleFetchFailedError
+#: (one per recompute round, however many map tasks it replays)
+SHUFFLE_RECOMPUTES = "shuffle.recomputes"
+#: lost map tasks re-executed on surviving peers (the "bounded" in
+#: bounded re-execution: asserted < total map tasks by CI)
+SHUFFLE_RECOMPUTED_MAP_TASKS = "shuffle.recomputed_map_tasks"
+#: recompute rounds abandoned because shuffle.recompute.maxStageAttempts
+#: was exhausted — the error re-surfaces and the failover path owns it
+SHUFFLE_RECOMPUTE_ESCALATIONS = "shuffle.recompute_escalations"
+
+RECOMPUTE_METRIC_NAMES = (
+    SHUFFLE_RECOMPUTES, SHUFFLE_RECOMPUTED_MAP_TASKS,
+    SHUFFLE_RECOMPUTE_ESCALATIONS)
+
 # Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
 # unlike the per-operator MetricSets — which live on per-action plan nodes —
 # and the process-global transfer counters, these are scoped to ONE query
@@ -229,6 +250,25 @@ MEMORY_METRICS = MetricSet(*MEMORY_METRIC_NAMES)
 
 #: process-global network-serving counters (see SERVING_METRIC_NAMES above)
 SERVING_METRICS = MetricSet(*SERVING_METRIC_NAMES)
+
+#: driver-global lineage-recompute counters (see RECOMPUTE_METRIC_NAMES)
+RECOMPUTE_METRICS = MetricSet(*RECOMPUTE_METRIC_NAMES)
+
+
+def recompute_snapshot() -> Dict[str, float]:
+    """Action-start marker for ``recompute_delta`` (all counters additive)."""
+    return RECOMPUTE_METRICS.snapshot()
+
+
+def recompute_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-action recompute stats: counter deltas since ``before``. The
+    counters live in the DRIVER process (the stage driver is the only bump
+    site), so unlike the transfer/serving sections there is no executor-side
+    aggregation to fold in; under concurrent queries a delta can still
+    include an overlapping query's recompute rounds."""
+    now = RECOMPUTE_METRICS.snapshot()
+    return {name: now[name] - before.get(name, 0)
+            for name in RECOMPUTE_METRIC_NAMES}
 
 
 def serving_snapshot() -> Dict[str, float]:

@@ -1,0 +1,382 @@
+"""Lineage-scoped stage recompute tests (the robustness tentpole).
+
+The escalation ladder under test: a reduce-side fetch that exhausts its
+retries (PR 2) no longer fails the query — the stage driver re-executes
+ONLY the lost map tasks from recorded lineage on surviving peers, replaces
+their blocks exactly-once, and resumes the blocked reduce. Past
+``shuffle.recompute.maxStageAttempts`` the scoped error re-surfaces for
+the serving failover layer (PR 14) to own.
+
+Three layers are covered:
+- session-level chaos: a seeded mid-reduce ``kill_peer`` on a multi-peer
+  cluster run completes with zero caller-visible errors, recomputes only
+  the dead peer's map tasks, and collects bit-identically (float aggs
+  within the documented 1e-9 carve-out — post-recompute row arrival order
+  legitimately differs);
+- the scoped error payload (executor_id + undelivered blocks) round-trips
+  every boundary it crosses: multi-table blocks, metadata-missing blocks,
+  two dead peers in one reduce window, and the process-executor control
+  socket;
+- disk-spill integrity: a corrupt spill file is a crc-detected LOST block
+  (typed error, catalog drop) feeding the same recompute signal, never
+  silently wrong bytes.
+"""
+import pickle
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.shuffle.inprocess import _Fabric
+from spark_rapids_tpu.shuffle.manager import ShuffleFetchFailedError
+from spark_rapids_tpu.testing import assert_tables_equal
+from spark_rapids_tpu.utils import metrics as mt
+from tests.test_shuffle import (collect_partition, sample_table,
+                                write_partitioned)
+from tests.test_shuffle_faults import fault_cluster
+
+FAULT_TRANSPORT = "spark_rapids_tpu.shuffle.faults.FaultInjectingTransport"
+
+
+@pytest.fixture(autouse=True)
+def fresh_fabric():
+    _Fabric.reset()
+    yield
+    _Fabric.reset()
+
+
+def _cluster_conf(extra=None):
+    """Two in-process executors; tight retry/timeout knobs keep the faulted
+    paths fast (the 300 s fetch-timeout default is sized for cold serving
+    clusters, not chaos tests)."""
+    conf = {
+        "spark.rapids.tpu.sql.cluster.numExecutors": "2",
+        "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+        "spark.rapids.tpu.shuffle.retryBackoffMs": "5",
+        "spark.rapids.tpu.shuffle.maxRetries": "1",
+        "spark.rapids.tpu.shuffle.fetch.timeoutSeconds": "5",
+    }
+    conf.update(extra or {})
+    return conf
+
+
+def _kill_exec1_conf(extra=None):
+    """exec-1 dies mid-stream on its first outgoing data frame (the
+    ``owner`` filter keeps the shared plan from killing every executor)."""
+    return _cluster_conf({
+        "spark.rapids.tpu.shuffle.transport.class": FAULT_TRANSPORT,
+        "spark.rapids.tpu.shuffle.faults.plan":
+            "kill_peer:owner=exec-1,req_type=data,after=1",
+        "spark.rapids.tpu.shuffle.faults.seed": "7",
+        **(extra or {})})
+
+
+def _tables(n=4000):
+    fact = pa.table({"k": [i % 8 for i in range(n)],
+                     "v": list(range(n)),
+                     "f": [i * 0.25 for i in range(n)]})
+    dim = pa.table({"k": list(range(8)),
+                    "name": [f"n{i}" for i in range(8)]})
+    return fact, dim
+
+
+def _query(s, fact, dim):
+    return (s.create_dataframe(fact).repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.sum("f").alias("sf"))
+            .join(s.create_dataframe(dim), "k")
+            .filter(F.col("sv") > -500).sort("sv", "k"))
+
+
+# ---------------------------------------------------------------------------------
+# session-level: seeded mid-reduce executor death
+# ---------------------------------------------------------------------------------
+
+def test_kill_peer_mid_reduce_recomputes_only_lost_maps():
+    """THE acceptance bar: a peer dying mid-reduce is a bounded
+    re-execution, not a query loss — no caller-visible error, only the
+    dead peer's map tasks replay, and the collect is bit-identical to the
+    fault-free run (float aggs within 1e-9)."""
+    fact, dim = _tables()
+    ref_s = TpuSession(_cluster_conf())
+    try:
+        ref = _query(ref_s, fact, dim).collect()
+    finally:
+        ref_s._cluster_scheduler.close()
+    _Fabric.reset()
+
+    s = TpuSession(_kill_exec1_conf())
+    try:
+        before = mt.recompute_snapshot()
+        got = _query(s, fact, dim).collect()
+        delta = mt.recompute_delta(before)
+        sched = s._cluster_scheduler
+        total_maps = sum(st.num_tasks for st in sched.last_stages
+                         if not st.is_result)
+        assert delta["shuffle.recomputes"] >= 1, delta
+        assert 1 <= delta["shuffle.recomputed_map_tasks"] < total_maps, (
+            f"recompute must be SCOPED to the dead peer's maps: {delta} "
+            f"vs {total_maps} total")
+        assert delta["shuffle.recompute_escalations"] == 0, delta
+        # the kill really happened (a green run must prove the fault fired)
+        dead = [ex.executor_id for ex in sched.executors
+                if not sched._executor_alive(ex)]
+        assert dead == ["exec-1"], dead
+        # per-shuffle lineage is driver memory, released with the shuffles
+        assert sched._lineage == {}
+        assert_tables_equal(ref, got, ignore_order=True, approx_float=1e-9)
+    finally:
+        s._cluster_scheduler.close()
+
+
+def test_recompute_disabled_escalates_scoped_error():
+    """maxStageAttempts=0 disables recompute: the scoped fetch error
+    surfaces unchanged (the failover layer's signal) and the escalation
+    counter records the handoff."""
+    fact, dim = _tables(800)
+    s = TpuSession(_kill_exec1_conf(
+        {"spark.rapids.tpu.shuffle.recompute.maxStageAttempts": "0"}))
+    try:
+        before = mt.recompute_snapshot()
+        with pytest.raises(ShuffleFetchFailedError) as ei:
+            _query(s, fact, dim).collect()
+        delta = mt.recompute_delta(before)
+        assert delta["shuffle.recompute_escalations"] == 1, delta
+        assert delta["shuffle.recomputes"] == 0, delta
+        assert ei.value.executor_id == "exec-1"
+        assert ei.value.blocks
+    finally:
+        s._cluster_scheduler.close()
+
+
+def test_serving_submit_absorbs_recompute_and_records_metrics():
+    """Serving integration: a submitted query rides out the mid-reduce
+    death with no client-visible error and its handle carries the
+    fault-recovery story (the ``shuffle`` exec-metrics block)."""
+    fact, dim = _tables()
+    s = TpuSession(_kill_exec1_conf())
+    try:
+        handle = s.submit(_query(s, fact, dim))
+        got = handle.result(timeout=120)
+        assert handle.error is None
+        assert got.num_rows == 8
+        shuf = handle.exec_metrics.get("shuffle", {})
+        assert shuf.get("shuffle.recomputes", 0) >= 1, handle.exec_metrics
+        assert shuf.get("shuffle.recompute_escalations", 1) == 0
+    finally:
+        s._cluster_scheduler.close()
+
+
+@pytest.mark.slow
+def test_tpch_q3_kill_peer_recompute():
+    """TPC-H Q3 across two executors with a seeded mid-reduce kill:
+    completes with zero caller-visible errors, recomputes a strict subset
+    of the map tasks, and matches the CPU session bit-for-bit (1e-9 float
+    carve-out)."""
+    from spark_rapids_tpu.benchmarks.tpch import BENCH_CONF
+    from spark_rapids_tpu.benchmarks.tpch_data import gen_all
+    from spark_rapids_tpu.benchmarks.tpch_queries import QUERIES
+    tables = gen_all(0.002, seed=7)
+    cpu = TpuSession({"spark.rapids.tpu.sql.enabled": "false"})
+    cdfs = {k: cpu.create_dataframe(v).repartition(2)
+            for k, v in tables.items()}
+    exp = QUERIES[3](cdfs).collect()
+
+    s = TpuSession({**BENCH_CONF, **_kill_exec1_conf()})
+    try:
+        before = mt.recompute_snapshot()
+        dfs = {k: s.create_dataframe(v).repartition(2)
+               for k, v in tables.items()}
+        out = QUERIES[3](dfs).collect()
+        delta = mt.recompute_delta(before)
+        sched = s._cluster_scheduler
+        total_maps = sum(st.num_tasks for st in sched.last_stages
+                         if not st.is_result)
+        assert delta["shuffle.recomputes"] >= 1, delta
+        assert delta["shuffle.recomputed_map_tasks"] < total_maps, delta
+        assert_tables_equal(exp, out, ignore_order=True, approx_float=1e-9)
+    finally:
+        s._cluster_scheduler.close()
+
+
+# ---------------------------------------------------------------------------------
+# scoped error payload: executor_id + blocks round-trips every boundary
+# ---------------------------------------------------------------------------------
+
+def test_metadata_missing_blocks_reports_all_undelivered(tmp_path):
+    """Regression (satellite fix): when the metadata response is missing
+    SOME blocks, the scoped error must report ALL undelivered blocks for
+    that peer — the answered blocks' transfers are never issued either, so
+    under-reporting would leave the recompute scope short."""
+    mgr, e0, e1 = fault_cluster(tmp_path)
+    sid, _ = mgr.register_shuffle(1)
+    write_partitioned(mgr, e1, sid, 0, sample_table(60, seed=1), 1)
+    write_partitioned(mgr, e1, sid, 1, sample_table(60, seed=2), 1)
+    # map 1's outputs vanish (spill corruption, eviction): metadata still
+    # answers for map 0
+    e1.shuffle_catalog.remove_map_outputs(sid, 1)
+    with pytest.raises(ShuffleFetchFailedError, match="lost blocks") as ei:
+        collect_partition(mgr, e0, sid, 0)
+    assert ei.value.executor_id == "exec-1"
+    got_maps = {b.map_id for b in ei.value.blocks}
+    assert got_maps == {0, 1}, (
+        f"ALL undelivered blocks must ride the error, got maps {got_maps}")
+
+
+def test_multi_table_blocks_roundtrip_and_error_scope(tmp_path):
+    """A block holding multiple tables (a map task that wrote its partition
+    in several batches) delivers every table exactly once, and when lost it
+    appears in the error payload once per BLOCK, not once per table."""
+    mgr, e0, e1 = fault_cluster(tmp_path)
+    sid, _ = mgr.register_shuffle(1)
+    t = sample_table(80, seed=3)
+    # two write rounds for the same map id -> two tables under one block
+    write_partitioned(mgr, e1, sid, 0, t, 1)
+    write_partitioned(mgr, e1, sid, 0, t, 1)
+    assert len(e1.shuffle_catalog.metas(
+        next(iter(e1.shuffle_catalog._by_shuffle[sid])))) == 2
+    got = collect_partition(mgr, e0, sid, 0)
+    assert got.num_rows == 2 * t.num_rows       # both tables, no dedup loss
+    assert sorted(got["f"].to_pylist()) == sorted(t["f"].to_pylist() * 2)
+
+    _Fabric.reset()
+    mgr2, e0b, e1b = fault_cluster(tmp_path / "b")
+    sid2, _ = mgr2.register_shuffle(1)
+    write_partitioned(mgr2, e1b, sid2, 0, t, 1)
+    write_partitioned(mgr2, e1b, sid2, 0, t, 1)
+    e1b.shuffle_catalog.remove_shuffle(sid2)
+    with pytest.raises(ShuffleFetchFailedError) as ei:
+        collect_partition(mgr2, e0b, sid2, 0)
+    blocks = list(ei.value.blocks)
+    assert len(blocks) == len(set(blocks)) == 1, (
+        f"one lost BLOCK, not one entry per table: {blocks}")
+
+
+def test_two_dead_peers_scope_non_overlapping(tmp_path):
+    """Two peers failing inside one reduce window: the scoped error names
+    one peer and carries ONLY that peer's blocks — recompute sets derived
+    per error never overlap."""
+    mgr, e0, e1, e2 = fault_cluster(
+        tmp_path, n=3,
+        extra={"spark.rapids.tpu.shuffle.maxRetries": 1,
+               "spark.rapids.tpu.shuffle.fetch.timeoutSeconds": 30})
+    sid, _ = mgr.register_shuffle(1)
+    write_partitioned(mgr, e1, sid, 0, sample_table(50, seed=4), 1)
+    write_partitioned(mgr, e2, sid, 1, sample_table(50, seed=5), 1)
+    owner_of = {st.map_id: st.executor_id
+                for st in mgr.tracker._shuffles[sid].values()}
+    _Fabric.get().kill("exec-1")
+    _Fabric.get().kill("exec-2")
+    with pytest.raises(ShuffleFetchFailedError) as ei:
+        collect_partition(mgr, e0, sid, 0)
+    err = ei.value
+    assert err.executor_id in ("exec-1", "exec-2")
+    assert err.blocks
+    # every block in the payload belongs to the NAMED peer: the recompute
+    # set for this error cannot overlap the other dead peer's
+    for b in err.blocks:
+        assert owner_of[b.map_id] == err.executor_id, (err.executor_id,
+                                                       b, owner_of)
+
+
+def test_fetch_error_payload_survives_daemon_boundary(tmp_path):
+    """The ProcessExecutor control socket carries the scoped payload as a
+    plain dict (executor daemon) and the driver reconstructs a faithful
+    ShuffleFetchFailedError — pickle round-trip AND dict round-trip."""
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    blocks = (ShuffleBlockId(3, 1, 0), ShuffleBlockId(3, 4, 0))
+    err = ShuffleFetchFailedError("lost blocks on exec-9",
+                                  executor_id="exec-9", blocks=blocks)
+    back = pickle.loads(pickle.dumps(err))
+    assert back.executor_id == "exec-9" and tuple(back.blocks) == blocks
+
+    # the daemon's wire dict (parallel/executor_daemon.py) -> driver rebuild
+    wire = {"error_kind": "shuffle_fetch_failed",
+            "executor_id": err.executor_id, "blocks": err.blocks,
+            "message": str(err)}
+    rebuilt = ShuffleFetchFailedError(wire["message"],
+                                      executor_id=wire.get("executor_id", ""),
+                                      blocks=tuple(wire.get("blocks", ())))
+    assert rebuilt.executor_id == "exec-9"
+    assert tuple(rebuilt.blocks) == blocks
+    assert "lost blocks" in str(rebuilt)
+
+
+def test_remove_map_outputs_scoped_to_one_map(tmp_path):
+    """Exactly-once replacement's first half: dropping ONE map's outputs
+    leaves sibling maps' blocks serving, and a second drop is a no-op."""
+    mgr, e0, e1 = fault_cluster(tmp_path)
+    sid, _ = mgr.register_shuffle(1)
+    write_partitioned(mgr, e1, sid, 0, sample_table(40, seed=6), 1)
+    write_partitioned(mgr, e1, sid, 1, sample_table(40, seed=7), 1)
+    removed = e1.shuffle_catalog.remove_map_outputs(sid, 1)
+    assert removed >= 1
+    assert e1.shuffle_catalog.remove_map_outputs(sid, 1) == 0   # idempotent
+    blocks = list(e1.shuffle_catalog._by_shuffle.get(sid, []))
+    assert blocks and all(b.map_id == 0 for b in blocks)
+    # map 0's block still serves
+    assert e1.shuffle_catalog.metas(blocks[0])
+
+
+# ---------------------------------------------------------------------------------
+# disk-spill integrity: crc on every spill write, verified on unspill
+# ---------------------------------------------------------------------------------
+
+def test_spill_crc_detects_disk_corruption(tmp_path):
+    """A flipped byte in a spill file surfaces as SpillCorruptionError on
+    unspill — typed, path-carrying, never silently wrong bytes."""
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import (BufferId,
+                                                SpillCorruptionError,
+                                                SpillableBuffer)
+    b = DeviceBatch.from_arrow(sample_table(128, seed=8))
+    disk = SpillableBuffer.from_batch(BufferId(4242), b).to_host().to_disk(
+        str(tmp_path))
+    assert disk.disk_crc32 is not None
+    data = bytearray(open(disk.payload, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(disk.payload, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(SpillCorruptionError) as ei:
+        disk.get_batch()
+    assert ei.value.path == disk.payload
+    assert ei.value.expected != ei.value.actual
+
+
+def test_spill_crc_clean_roundtrip(tmp_path):
+    """Control: an untouched spill file unspills bit-exactly through the
+    crc gate."""
+    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    from spark_rapids_tpu.memory.buffer import BufferId, SpillableBuffer
+    t = sample_table(128, seed=9)
+    disk = SpillableBuffer.from_batch(
+        BufferId(4243), DeviceBatch.from_arrow(t)).to_host().to_disk(
+        str(tmp_path))
+    assert disk.get_batch().to_arrow().equals(t)
+
+
+def test_corrupt_shuffle_spill_is_lost_block_recompute_signal(tmp_path):
+    """A shuffle-owned buffer whose spill file rots is a LOST block: the
+    server drops the whole map task's outputs and the reader's next
+    metadata pass reports them missing — the permanent scoped error that
+    feeds the lineage recompute, not a retry loop over bad bytes."""
+    import glob
+    mgr, e0, e1 = fault_cluster(
+        tmp_path, extra={"spark.rapids.tpu.shuffle.maxRetries": 1})
+    sid, _ = mgr.register_shuffle(1)
+    t = sample_table(300, seed=10)
+    write_partitioned(mgr, e1, sid, 0, t, 1)
+    # force the map output all the way to disk, then rot every spill file
+    assert e1.device_store.spill_to_size(0) > 0
+    e1.host_store.spill_to_size(0)
+    files = glob.glob(str(tmp_path / "e1" / "**" / "*.npz"), recursive=True)
+    assert files, "expected on-disk spill files"
+    for path in files:
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+    with pytest.raises(ShuffleFetchFailedError) as ei:
+        collect_partition(mgr, e0, sid, 0)
+    assert ei.value.executor_id == "exec-1" and ei.value.blocks
+    # the corrupt map task's outputs are GONE from the serving catalog
+    assert not e1.shuffle_catalog._by_shuffle.get(sid)
