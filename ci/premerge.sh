@@ -11,7 +11,7 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 echo "== config docs in sync =="
 python -m spark_rapids_tpu.analysis --check-configs
 
-echo "== tpu-lint (full rule set R001-R012 incl. interprocedural R008-R010 + the R012 race detector; fails on non-baselined findings) =="
+echo "== tpu-lint (full rule set R001-R015 incl. interprocedural R008-R010, the R012 race detector + the R013-R015 exception-flow ladder rules; fails on non-baselined findings) =="
 # one pass, three outputs: the gate (exit code), the SARIF artifact CI
 # publishes as code annotations, and the per-rule profile on stderr
 lint_start=$(date +%s)

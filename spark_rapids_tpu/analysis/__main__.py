@@ -137,24 +137,82 @@ def _suppression_justification(src: SourceFile, lineno: int) -> str:
         (pieces[0] if pieces else "")
 
 
-def list_suppressions(files: List[SourceFile], fmt: str) -> int:
-    entries: List[Dict[str, object]] = []
+def _suppression_status(files: List[SourceFile], result: AnalysisResult):
+    """Per inline-suppression line: (src, lineno, declared ids, dead ids).
+
+    An id is DEAD when no finding of that rule was absorbed at that line
+    this run (``AnalysisResult.suppressions_hit``). A blanket ``ALL`` is
+    dead only when the line absorbed nothing at all."""
+    hit_by_line: Dict[tuple, set] = {}
+    for path, ln, rid in result.suppressions_hit:
+        hit_by_line.setdefault((path, ln), set()).add(rid)
+    rows = []
     for src in files:
         for lineno in sorted(src.suppressions):
-            entries.append({
-                "path": src.display_path,
-                "line": lineno,
-                "rules": sorted(src.suppressions[lineno]),
-                "justification": _suppression_justification(src, lineno),
-                "code": src.line_text(lineno),
-            })
+            declared = sorted(src.suppressions[lineno])
+            hits = hit_by_line.get((src.display_path, lineno), set())
+            if "ALL" in declared:
+                dead = [] if hits else ["ALL"]
+            else:
+                dead = [r for r in declared if r not in hits]
+            rows.append((src, lineno, declared, dead))
+    return rows
+
+
+def _covers_package(files: List[SourceFile], root: str) -> bool:
+    """True when the analyzed set includes every .py of the package —
+    the precondition for suppression staleness: a subset run would not
+    re-derive interprocedural findings and would condemn live
+    suppressions as stale."""
+    pkg = os.path.join(root, "spark_rapids_tpu")
+    have = {os.path.abspath(src.path) for src in files}
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in filenames:
+            if f.endswith(".py") and \
+                    os.path.abspath(os.path.join(dirpath, f)) not in have:
+                return False
+    return True
+
+
+def stale_suppressions(files: List[SourceFile],
+                       result: AnalysisResult) -> List[str]:
+    msgs = []
+    for src, lineno, _declared, dead in _suppression_status(files, result):
+        if dead:
+            msgs.append(f"STALE SUPPRESSION: {src.display_path}:{lineno}: "
+                        f"disable={','.join(dead)} absorbed no finding — "
+                        f"remove it")
+    return msgs
+
+
+def list_suppressions(files: List[SourceFile], result: AnalysisResult,
+                      fmt: str) -> int:
+    entries: List[Dict[str, object]] = []
+    for src, lineno, declared, dead in _suppression_status(files, result):
+        entries.append({
+            "path": src.display_path,
+            "line": lineno,
+            "rules": declared,
+            "stale_rules": dead,
+            "status": "stale" if dead else "live",
+            "justification": _suppression_justification(src, lineno),
+            "code": src.line_text(lineno),
+        })
     if fmt == "json":
         print(json.dumps({"suppressions": entries}, indent=2))
         return 0
     for e in entries:
         just = e["justification"] or "(no justification text)"
-        print(f"{e['path']}:{e['line']}: {','.join(e['rules'])} — {just}")
-    print(f"{len(entries)} inline suppression(s) in {len(files)} files")
+        mark = "live" if e["status"] == "live" else \
+            f"STALE:{','.join(e['stale_rules'])}"
+        print(f"{e['path']}:{e['line']}: {','.join(e['rules'])} "
+              f"[{mark}] — {just}")
+    n_stale = sum(1 for e in entries if e["status"] == "stale")
+    print(f"{len(entries)} inline suppression(s) in {len(files)} files"
+          f" ({n_stale} stale)" if entries else
+          f"0 inline suppression(s) in {len(files)} files")
     return 0
 
 
@@ -184,7 +242,10 @@ def _sarif_doc(findings, errors, stale, files_scanned: int, absorbed: int,
         "name": "tpu-lint",
         "informationUri": "docs/static-analysis.md",
         "rules": [{"id": r.rule_id,
-                   "shortDescription": {"text": r.title}}
+                   "shortDescription": {"text": r.title},
+                   # per-rule catalog anchor: CI annotations deep-link
+                   # straight to the rule's docs section
+                   "helpUri": r.help_uri()}
                   for r in all_rules()],
     }
     return {
@@ -231,7 +292,7 @@ def _emit(findings, errors, stale, files_scanned: int, absorbed: int,
         bits = [f"{len(findings)} finding(s)",
                 f"{len(errors)} unparseable file(s)"]
         if stale:
-            bits.append(f"{len(stale)} stale baseline entr"
+            bits.append(f"{len(stale)} stale baseline/suppression entr"
                         f"{'ies' if len(stale) > 1 else 'y'}")
         print(f"tpu-lint: {', '.join(bits)} in {files_scanned} "
               f"files{note}")
@@ -273,7 +334,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parse_errors: List[str] = []
     files = collect_files(paths, root, parse_errors)
     if args.list_suppressions:
-        return list_suppressions(files, args.format)
+        # run the full rule set so live/stale marking reflects reality
+        return list_suppressions(files, analyze_files(files), args.format)
     if not files and not parse_errors:
         print("no python files found under", paths)
         return 1
@@ -296,6 +358,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # nightly hygiene: a baseline entry no source line matches anymore
         # is debt pretending to still exist — fail with a remove-me
         stale = bl.stale_entries(baseline_path, files, root)
+        # same hygiene for inline suppressions — but only when the whole
+        # package (and the whole rule set) was analyzed, else subset runs
+        # would condemn suppressions whose findings they never re-derived
+        if rule_ids is None and _covers_package(files, root):
+            stale = stale + stale_suppressions(files, result)
     _emit(findings, result.errors, stale, result.files_scanned, absorbed,
           args.format, rule_seconds=result.rule_seconds)
     if args.profile:

@@ -236,14 +236,15 @@ class CallGraph:
 
         if len(parts) == 1:
             bare = parts[0]
-            # nested sibling: outer.inner defined in the same function scope
-            sibling = f"{mod}::{caller.qualname}.{bare}"
-            if sibling in self.functions:
-                return [sibling]
-            scope = caller.qualname.rsplit(".", 1)[0]
-            sibling = f"{mod}::{scope}.{bare}"
-            if sibling in self.functions:
-                return [sibling]
+            # nested sibling: outer.inner defined in this function scope or
+            # any enclosing one (a closure two defs deep still sees the
+            # helpers of every scope above it)
+            scope = caller.qualname
+            while scope:
+                sibling = f"{mod}::{scope}.{bare}"
+                if sibling in self.functions:
+                    return [sibling]
+                scope = scope.rsplit(".", 1)[0] if "." in scope else ""
             funcs = self._module_funcs.get(mod, {})
             if bare in funcs:
                 return [funcs[bare]]

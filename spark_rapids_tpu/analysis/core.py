@@ -70,6 +70,12 @@ class Rule:
     title: str = ""
     #: project rules need the whole package file set, not one module
     is_project_rule: bool = False
+    #: anchor into docs/static-analysis.md (SARIF helpUri); defaults to the
+    #: lowercased rule id — every catalog entry carries a matching anchor
+    help_anchor: str = ""
+
+    def help_uri(self) -> str:
+        return f"docs/static-analysis.md#{self.help_anchor or self.rule_id.lower()}"
 
     def check(self, src: "SourceFile") -> List[Finding]:
         return []
@@ -99,17 +105,18 @@ def all_rules() -> List[Rule]:
 
 def _load_builtin_rules() -> None:
     # import for side effect: each module registers its rules
-    from spark_rapids_tpu.analysis import (rules_cancel,     # noqa: F401
-                                           rules_dtype,      # noqa: F401
-                                           rules_lockorder,  # noqa: F401
-                                           rules_locks,      # noqa: F401
-                                           rules_metrics,    # noqa: F401
-                                           rules_project,    # noqa: F401
-                                           rules_races,      # noqa: F401
-                                           rules_recompile,  # noqa: F401
-                                           rules_resource,   # noqa: F401
-                                           rules_serving,    # noqa: F401
-                                           rules_sync)       # noqa: F401
+    from spark_rapids_tpu.analysis import (rules_cancel,      # noqa: F401
+                                           rules_dtype,       # noqa: F401
+                                           rules_exceptions,  # noqa: F401
+                                           rules_lockorder,   # noqa: F401
+                                           rules_locks,       # noqa: F401
+                                           rules_metrics,     # noqa: F401
+                                           rules_project,     # noqa: F401
+                                           rules_races,       # noqa: F401
+                                           rules_recompile,   # noqa: F401
+                                           rules_resource,    # noqa: F401
+                                           rules_serving,     # noqa: F401
+                                           rules_sync)        # noqa: F401
 
 
 class SourceFile:
@@ -246,6 +253,10 @@ class AnalysisResult:
     #: per-rule wall seconds (the --profile surface: when the premerge
     #: 30 s guard trips, the three slowest rules name the culprit)
     rule_seconds: Dict[str, float] = field(default_factory=dict)
+    #: (path, suppression line, RULE_ID) triples for every inline
+    #: suppression that actually absorbed a finding this run — the
+    #: staleness check condemns suppression lines absent from this set
+    suppressions_hit: Set[Tuple[str, int, str]] = field(default_factory=set)
 
 
 def load_source(path: str, display_path: Optional[str] = None,
@@ -287,6 +298,11 @@ def analyze_files(files: Sequence[SourceFile],
             src = by_path.get(finding.path)
             if src is not None and src.is_suppressed(finding.rule,
                                                      finding.line):
+                rid = finding.rule.upper()
+                for ln in (finding.line, finding.line - 1):
+                    ids = src.suppressions.get(ln)
+                    if ids and (rid in ids or "ALL" in ids):
+                        result.suppressions_hit.add((finding.path, ln, rid))
                 continue
             result.findings.append(finding)
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))

@@ -230,7 +230,7 @@ class FusedStageExec(PhysicalExec):
                 # justified sync: the engine's designed one-scalar-per-batch
                 # download — the logical row count must reach the host to
                 # pick the output capacity bucket (see tpu_execs docstring)
-                n = int(res[i + nflat_out])  # tpu-lint: disable=R002
+                n = int(res[i + nflat_out])
                 i += nflat_out + 1
                 out = te._to_batch(out_schema, flat, n)
                 self.count_output(n)

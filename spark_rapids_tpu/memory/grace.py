@@ -556,7 +556,7 @@ def split_batch(ctx, batch: DeviceBatch, key_exprs, n: int, depth: int,
              *flatten_colvs(ext_colvs))
     # justified sync: the DEGRADED path's one per-batch counts download —
     # partition sizes must reach the host to slice pieces; the no-pressure
-    # hot path never runs this program  # tpu-lint: disable=R002
+    # hot path never runs this program
     counts = np.asarray(res[-1])
     from spark_rapids_tpu.exprs.core import unflatten_colvs
     sorted_cols = unflatten_colvs(ext_schema, res[:-1])
@@ -605,7 +605,7 @@ def _sample_range_bounds(ctx, batches: Sequence[DeviceBatch], orders,
 
         fn = _cached_jit(key, build)
         # justified download: <= 4096 sampled key rows total on the
-        # degraded path, never full columns  # tpu-lint: disable=R002
+        # degraded path, never full columns
         flat = [np.asarray(a) for a in fn(jnp.asarray(idx), *_flatten(db))]
         keys = []
         i = 0

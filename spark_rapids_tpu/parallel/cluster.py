@@ -44,6 +44,7 @@ from spark_rapids_tpu.shuffle.manager import (CachingShuffleReader,
                                               CachingShuffleWriter, MapStatus,
                                               MapOutputTracker, ShuffleEnv,
                                               ShuffleFetchFailedError)
+from spark_rapids_tpu.utils import errors as uerr
 from spark_rapids_tpu.utils import metrics as mt
 
 _TCP_TRANSPORT = "spark_rapids_tpu.shuffle.tcp.TcpTransport"
@@ -468,14 +469,20 @@ class ProcessExecutor:
     def submit(self, spec: _TaskSpec) -> bytes:
         resp = self._request({"type": "task", "spec": spec})
         if resp["type"] == "error":
-            if resp.get("error_kind") == "shuffle_fetch_failed":
-                # re-raise the daemon's structured payload as the real
-                # scoped error: the recompute driver keys off executor_id
-                # + blocks, which a flattened traceback string would lose
+            payload = resp.get("error")
+            decoded = (uerr.decode_error(payload) if payload is not None
+                       else None)
+            if isinstance(decoded, ShuffleFetchFailedError):
+                # the daemon's scoped payload survived the control socket
+                # via the wire codec (utils/errors.py): the recompute
+                # driver keys off executor_id + blocks, which a flattened
+                # traceback string would lose
                 raise ShuffleFetchFailedError(
                     f"task failed on {self.executor_id}: {resp['message']}",
-                    executor_id=resp.get("executor_id", ""),
-                    blocks=tuple(resp.get("blocks", ())))
+                    executor_id=decoded.executor_id,
+                    blocks=decoded.blocks)
+            # every other classified or OPAQUE error surfaces as a plain
+            # driver-side failure (the recompute loop re-raises non-signals)
             raise RuntimeError(
                 f"task failed on {self.executor_id}: {resp['message']}")
         return resp["blob"]
@@ -873,6 +880,8 @@ class ClusterScheduler:
         return {stages[d].shuffle_id: stages[d].statuses
                 for d in dep_indices if stages[d].shuffle_id is not None}
 
+    # rung 2 of the failure ladder: the lineage-recompute triage loop
+    @uerr.triage_boundary
     def _run_recomputing(self, tasks: List[_TaskSpec], stages: List[_Stage],
                          dep_indices: Sequence[int], budget: List[int],
                          exclude: Set[str] = frozenset()
@@ -1039,6 +1048,10 @@ class ClusterScheduler:
         errors: List[Tuple[object, Exception]] = []
         slots = max(1, self.conf.get(cfg.CLUSTER_TASK_SLOTS))
 
+        # the collection point of the recompute triage: every task failure
+        # (the scoped ShuffleFetchFailedError signal above all) lands in
+        # the errors ledger for _run_recomputing to route — never dropped
+        @uerr.triage_boundary
         def worker(home: int, ex) -> None:
             while not errors:
                 with qlock:

@@ -85,6 +85,7 @@ def main() -> int:
     from spark_rapids_tpu.parallel.cluster import (_recv_msg, _run_task,
                                                    _send_msg)
     from spark_rapids_tpu.shuffle.manager import ShuffleEnv
+    from spark_rapids_tpu.utils import errors as uerr
 
     env = None
     cached_parts: dict = {}      # df.cache() table_id -> [BufferId...]
@@ -155,6 +156,7 @@ def main() -> int:
                 # one thread per in-flight task (the driver bounds in-flight
                 # tasks to taskSlots per executor; device entry inside the
                 # task is gated by the admission semaphore)
+                @uerr.wire_boundary
                 def run(spec=msg["spec"], rid=rid) -> None:
                     from spark_rapids_tpu.shuffle.manager import \
                         ShuffleFetchFailedError
@@ -162,16 +164,19 @@ def main() -> int:
                         blob = _run_task(env, spec)
                         send({"type": "done", "blob": blob, "id": rid})
                     except ShuffleFetchFailedError as e:
-                        # the scoped payload must survive the control
-                        # socket: the driver's recompute loop keys off
-                        # executor_id + blocks (both plain picklable)
+                        # structured codec (utils/errors.py): the scoped
+                        # payload must survive the control socket — the
+                        # driver's recompute loop keys off executor_id +
+                        # blocks, which a flattened traceback would lose
                         send({"type": "error", "id": rid,
-                              "error_kind": "shuffle_fetch_failed",
-                              "executor_id": e.executor_id,
-                              "blocks": e.blocks,
+                              "error": uerr.encode_error(e),
                               "message": str(e)})
-                    except Exception:
+                    except Exception as e:
+                        # unregistered types ship OPAQUE (non-retryable
+                        # driver-side) with the traceback as message
                         send({"type": "error", "id": rid,
+                              "error": uerr.encode_error(
+                                  e, message=traceback.format_exc()),
                               "message": traceback.format_exc()})
 
                 threading.Thread(target=run, daemon=True).start()

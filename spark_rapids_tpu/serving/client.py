@@ -56,6 +56,8 @@ from spark_rapids_tpu.shuffle.tcp import scan_registry
 from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 TransactionStatus)
 from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils.errors import (OpaqueWireError, absorb,
+                                           decode_error, triage_boundary)
 
 
 class WireQueryError(RuntimeError):
@@ -73,10 +75,27 @@ class WireQueryError(RuntimeError):
         self.retryable = retryable
 
 
+def _decode_wire_error(blob) -> BaseException:
+    """Rebuild the server-side exception from a NEXT_ERROR payload (the
+    utils/errors.py wire codec); anything undecodable — including frames
+    from a pre-codec server — degrades to OpaqueWireError."""
+    try:
+        payload = json.loads(blob)
+        if not isinstance(payload, dict):
+            raise ValueError(blob)
+    except (TypeError, ValueError):
+        return OpaqueWireError(str(blob))
+    return decode_error(payload)
+
+
 def _is_draining_error(err: BaseException) -> bool:
-    """The server carries the rejection type name over the wire
-    (``SchedulerDrainingError: ...``) — a retryable redirect, not a
-    replica failure."""
+    """A DRAINING rejection is a retryable redirect, not a replica
+    failure.  Decoded wire errors carry their taxonomy code; errors that
+    rode a transport error-message string (the submit path) fall back to
+    the type name the server put on the wire."""
+    code = getattr(err, "wire_code", None)
+    if code is not None:
+        return code == "SCHEDULER_DRAINING"
     return "DrainingError" in str(err)
 
 
@@ -148,8 +167,11 @@ class RemoteQueryHandle:
             if not self._done:
                 try:
                     self.cancel()
-                except WireQueryError:
-                    pass
+                except WireQueryError as e:
+                    # terminal absorption: a cancel that failed while the
+                    # stream is already unwinding must not mask the
+                    # primary failure — counted, not propagated
+                    absorb(e, "serving.client.stream_abandon_cancel")
 
     def _stream_once(self, retain: bool):
         """Drive the stream against the CURRENT replica until DONE; a
@@ -171,8 +193,14 @@ class RemoteQueryHandle:
                 return
             if nr.kind == wire.NEXT_ERROR:
                 # the QUERY failed server-side — rerunning it on another
-                # replica would fail identically, so never retryable
-                raise WireQueryError(nr.error, self.batches_delivered)
+                # replica would fail identically, so never retryable; the
+                # decoded cause's taxonomy code rides along so callers
+                # can classify (cancellation vs permanent) without
+                # string-sniffing
+                decoded = _decode_wire_error(nr.error)
+                err = WireQueryError(str(decoded), self.batches_delivered)
+                err.wire_code = getattr(decoded, "wire_code", "OPAQUE")
+                raise err
             table = self._fetch(nr)
             self.batches_delivered += 1
             self._last_seq = nr.seq
@@ -181,6 +209,7 @@ class RemoteQueryHandle:
                 self._tables.append(table)
             yield table
 
+    @triage_boundary
     def _maybe_failover(self, err: WireQueryError) -> bool:
         """Resubmit to a healthy replica with ``resume_from=last seq
         delivered``; True when the stream may continue on a new conn."""
@@ -423,6 +452,7 @@ class QueryServiceClient:
         return tx.response
 
     # ---- health + routing --------------------------------------------------
+    @triage_boundary
     def _note_replica_failure(self, st: ReplicaState) -> None:
         """Feed one failure to the replica's breaker; a breaker that just
         OPENED declares the replica dead, so its registration ledger is
@@ -554,8 +584,12 @@ class QueryServiceClient:
             else:
                 try:
                     addr = self._pick(exclude)
-                except WireQueryError as e:
-                    raise last_err or e
+                except WireQueryError:
+                    # routing exhausted: surface the LAST submission error
+                    # (the root cause) over the generic no-replica one
+                    if last_err is not None:
+                        raise last_err
+                    raise
             st = self._replica_state(addr)
             try:
                 conn = self._connection(addr)

@@ -35,6 +35,7 @@ from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
                                                 bind_query)
 from spark_rapids_tpu.serving.program_cache import (configure_from_conf,
                                                     plan_key)
+from spark_rapids_tpu.utils.errors import triage_boundary, wire_boundary
 from spark_rapids_tpu.utils.fair_share import (activation_reset, pick_tenant,
                                                weight_of)
 
@@ -248,6 +249,13 @@ class SessionScheduler:
             self.serve_stats.record_wall(handle.metric("wall_s"))
             self.serve_stats.sample(self)
 
+    # the ladder's cancellation sink AND the serving-wire serialization
+    # boundary: exceptions caught here become the handle's terminal state,
+    # which the server ships to clients via the utils/errors.py codec —
+    # R014 checks arriving types are classified, R015 that they survive
+    # the wire
+    @triage_boundary
+    @wire_boundary
     def _run_handle_traced(self, handle: QueryHandle) -> None:
         if handle.cancel_requested:     # cancelled while QUEUED
             handle.mark_admitted()

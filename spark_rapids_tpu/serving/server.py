@@ -51,6 +51,7 @@ from spark_rapids_tpu.shuffle.codec import checksum_of
 from spark_rapids_tpu.shuffle.transport import AddressLengthTag
 from spark_rapids_tpu.utils import metrics as um
 from spark_rapids_tpu.utils import tracing as _tracing
+from spark_rapids_tpu.utils.errors import encode_error
 
 
 class _ServedQuery:
@@ -238,9 +239,13 @@ class QueryServer:
                     return self._finish_response(sq)
                 elif kind == "error":
                     self._drop_query(sq)
+                    # structured codec (utils/errors.py): registered types
+                    # survive the wire with their classification and
+                    # payload; anything else degrades to OPAQUE
                     return wire.NextResponse(
                         wire.NEXT_ERROR,
-                        error=f"{type(val).__name__}: {val}").to_bytes()
+                        error=json.dumps(encode_error(val),
+                                         default=str)).to_bytes()
                 else:
                     return wire.NextResponse(wire.NEXT_WAIT).to_bytes()
             if time.monotonic() >= deadline:

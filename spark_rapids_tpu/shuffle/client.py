@@ -38,6 +38,7 @@ from spark_rapids_tpu.shuffle.transport import (AddressLengthTag,
                                                 ShuffleTransport, Transaction,
                                                 TransactionStatus)
 from spark_rapids_tpu.utils import metrics as mt
+from spark_rapids_tpu.utils.errors import triage_boundary
 
 
 class ShuffleFetchHandler:
@@ -240,6 +241,10 @@ class ShuffleClient:
                 self._issue_transfer(state, p, attempt=0)
         self.connection.request(msg.REQ_METADATA, req.to_bytes(), on_meta)
 
+    # rung 1 of the failure ladder: transfer-retry triage (deterministic
+    # backoff; exhaustion fails the fetch state, which escalates to the
+    # driver's recompute rung as ShuffleFetchFailedError)
+    @triage_boundary
     def _retry_metadata(self, state: _FetchState, attempt: int,
                         error: str) -> None:
         if attempt >= self.max_retries or state.failed:
@@ -269,6 +274,10 @@ class ShuffleClient:
                 released.set()
                 self.transport.throttle.release(p.meta.packed_size)
 
+        # rung-1 triage point: a corrupt/failed transfer retries in place
+        # with deterministic backoff, or fails the fetch state on
+        # exhaustion (escalating to the recompute rung)
+        @triage_boundary
         def fail_or_retry(error: str, corrupt: bool = False):
             release_once()
             if corrupt:

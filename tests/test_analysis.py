@@ -1014,7 +1014,8 @@ def _interprocedural_package_result():
                               root)
         from spark_rapids_tpu.analysis import analyze_files as _af
         t0 = time.monotonic()
-        res = _af(files, rule_ids={"R008", "R009", "R010", "R012"})
+        res = _af(files, rule_ids={"R008", "R009", "R010", "R012",
+                                   "R013", "R014", "R015"})
         _INTERPROC_CACHE["res"] = res
         _INTERPROC_CACHE["elapsed"] = time.monotonic() - t0
     return _INTERPROC_CACHE["res"]
@@ -1647,3 +1648,323 @@ def test_profile_prints_per_rule_timings(tmp_path, capsys):
     # slowest-first ordering: premerge's guard takes head -3 verbatim
     secs = [float(ln.split()[-1].rstrip("s")) for ln in lines]
     assert secs == sorted(secs, reverse=True)
+
+
+# --------------------------------------------------- R013 (v4 ladder rules)
+def test_r013_swallowed_signal_flagged():
+    fs = src("""
+        def fetch():
+            raise ShuffleFetchFailedError("lost blocks")
+        def caller():
+            try:
+                fetch()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    found = run(fs, {"R013"})
+    assert len(found) == 1
+    assert "ShuffleFetchFailedError" in found[0].message
+    assert "triage" in found[0].message
+
+
+def test_r013_bare_reraise_clean():
+    fs = src("""
+        def fetch():
+            raise ChecksumError("bad frame")
+        def caller():
+            try:
+                fetch()
+            except Exception:
+                log()
+                raise
+        def log():
+            pass
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R013"}) == []
+
+
+def test_r013_convert_to_registered_type_clean():
+    fs = src("""
+        def fetch():
+            raise ChecksumError("bad frame")
+        def caller():
+            try:
+                fetch()
+            except Exception as e:
+                raise WireQueryError(str(e), 0) from e
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R013"}) == []
+
+
+def test_r013_triage_boundary_owner_exempt():
+    fs = src("""
+        from spark_rapids_tpu.utils.errors import triage_boundary
+        def fetch():
+            raise ShuffleFetchFailedError("lost blocks")
+        @triage_boundary
+        def retry_loop():
+            try:
+                fetch()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R013"}) == []
+
+
+def test_r013_handler_routing_to_triage_boundary_clean():
+    fs = src("""
+        from spark_rapids_tpu.utils.errors import triage_boundary
+        @triage_boundary
+        def route(e):
+            pass
+        def fetch():
+            raise SpillCorruptionError(path="p", expected=1, actual=2)
+        def caller():
+            try:
+                fetch()
+            except Exception as e:
+                route(e)
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R013"}) == []
+
+
+def test_r013_no_signal_on_path_clean():
+    """A broad except on a path where no ladder signal may-raise is out of
+    scope — the engine under-approximates, silence costs nothing here."""
+    fs = src("""
+        def load():
+            raise ValueError("bad input")
+        def caller():
+            try:
+                load()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R013"}) == []
+
+
+def test_r013_real_package_clean():
+    """Acceptance gate: zero R013 findings on the package after the PR's
+    ladder fixes — every broad except on a signal path now re-raises,
+    converts, or routes to a @triage_boundary."""
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R013"]
+    assert found == [], [f.render() for f in found]
+
+
+# ------------------------------------------------------------------ R014
+def test_r014_cancellation_laundering_flagged():
+    fs = src("""
+        def work():
+            raise QueryCancelledError("caller gave up")
+        def caller():
+            try:
+                work()
+            except QueryCancelledError as e:
+                raise ChecksumError("retry me") from e
+        """, path="spark_rapids_tpu/engine.py")
+    found = run(fs, {"R014"})
+    assert len(found) == 1
+    assert "CANCELLATION" in found[0].message
+    assert "never be retried" in found[0].message
+
+
+def test_r014_cancellation_to_cancellation_clean():
+    fs = src("""
+        def work():
+            raise QueryCancelledError("caller gave up")
+        def caller():
+            try:
+                work()
+            except QueryCancelledError as e:
+                raise QueryTimeoutError("deadline") from e
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R014"}) == []
+
+
+def test_r014_unregistered_class_at_triage_boundary_flagged():
+    fs = src("""
+        from spark_rapids_tpu.utils.errors import triage_boundary
+        class WeirdError(Exception):
+            pass
+        def work():
+            raise WeirdError("x")
+        @triage_boundary
+        def boundary():
+            try:
+                work()
+            except WeirdError:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    found = run(fs, {"R014"})
+    assert len(found) == 1
+    assert "WeirdError" in found[0].message
+    assert "not registered" in found[0].message
+    # anchored at the raise site, where the registration fix belongs
+    assert found[0].line == 6
+
+
+def test_r014_registered_class_at_triage_boundary_clean():
+    fs = src("""
+        from spark_rapids_tpu.utils.errors import triage_boundary
+        class ChecksumError(Exception):
+            pass
+        def work():
+            raise ChecksumError("x")
+        @triage_boundary
+        def boundary():
+            try:
+                work()
+            except ChecksumError:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R014"}) == []
+
+
+def test_r014_real_package_clean():
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R014"]
+    assert found == [], [f.render() for f in found]
+
+
+# ------------------------------------------------------------------ R015
+def test_r015_codecless_class_crossing_wire_flagged():
+    fs = src("""
+        from spark_rapids_tpu.utils import errors as uerr
+        class LocalOnlyError(Exception):
+            pass
+        def work():
+            raise LocalOnlyError("x")
+        @uerr.wire_boundary
+        def serve():
+            try:
+                work()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    found = run(fs, {"R015"})
+    assert len(found) == 1
+    assert "LocalOnlyError" in found[0].message
+    assert "OpaqueWireError" in found[0].message
+    assert found[0].line == 6        # the raise site, not the boundary
+
+
+def test_r015_registered_class_clean():
+    fs = src("""
+        from spark_rapids_tpu.utils import errors as uerr
+        class ShuffleFetchFailedError(Exception):
+            pass
+        def work():
+            raise ShuffleFetchFailedError("lost")
+        @uerr.wire_boundary
+        def serve():
+            try:
+                work()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R015"}) == []
+
+
+def test_r015_builtins_degrade_by_design_clean():
+    fs = src("""
+        from spark_rapids_tpu.utils import errors as uerr
+        def work():
+            raise ValueError("x")
+        @uerr.wire_boundary
+        def serve():
+            try:
+                work()
+            except Exception:
+                return None
+        """, path="spark_rapids_tpu/engine.py")
+    assert run(fs, {"R015"}) == []
+
+
+def test_r015_real_package_clean():
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R015"]
+    assert found == [], [f.render() for f in found]
+
+
+# ----------------------------------------------- inline-suppression staleness
+def test_stale_suppression_reported():
+    from spark_rapids_tpu.analysis.__main__ import stale_suppressions
+    fs = src("x = 1  # tpu-lint: disable=R002\n", path="a.py")
+    res = analyze_files([fs])
+    msgs = stale_suppressions([fs], res)
+    assert len(msgs) == 1
+    assert "a.py:1" in msgs[0] and "R002" in msgs[0] and "remove" in msgs[0]
+
+
+def test_live_suppression_not_stale():
+    from spark_rapids_tpu.analysis.__main__ import stale_suppressions
+    fs = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()  # tpu-lint: disable=R002
+        """, path="spark_rapids_tpu/execs/engine.py")
+    res = analyze_files([fs])
+    assert [f for f in res.findings if f.rule == "R002"] == []
+    assert stale_suppressions([fs], res) == []
+
+
+def test_partially_stale_suppression_names_only_the_dead_ids():
+    from spark_rapids_tpu.analysis.__main__ import stale_suppressions
+    fs = src(GUARD + """
+        def f(arr):
+            return arr.sum().item()  # tpu-lint: disable=R002,R006
+        """, path="spark_rapids_tpu/execs/engine.py")
+    res = analyze_files([fs])
+    (msg,) = stale_suppressions([fs], res)
+    assert "R006" in msg and "R002" not in msg.split("disable=")[1]
+
+
+def test_strict_subset_run_skips_suppression_staleness(tmp_path, capsys):
+    """Staleness only fires on full-package runs: a subset run never
+    re-derives interprocedural findings and would condemn live
+    suppressions."""
+    (tmp_path / "a.py").write_text("x = 1  # tpu-lint: disable=R002\n")
+    rc = main(["--strict", str(tmp_path)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "STALE SUPPRESSION" not in text
+
+
+def test_list_suppressions_marks_live_and_stale(tmp_path, capsys):
+    hot = tmp_path / "execs"          # R002 only scans hot-path dirs
+    hot.mkdir()
+    (hot / "a.py").write_text(
+        "from spark_rapids_tpu import device as _device\n"
+        "def f(arr):\n"
+        "    # justified: one designed scalar sync per batch\n"
+        "    live = arr.sum().item()  # tpu-lint: disable=R002\n"
+        "    return live  # tpu-lint: disable=R006\n")
+    rc = main(["--list-suppressions", "--format", "json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_line = {e["line"]: e for e in out["suppressions"]}
+    assert by_line[4]["status"] == "live"
+    assert by_line[5]["status"] == "stale"
+    assert by_line[5]["stale_rules"] == ["R006"]
+
+
+def test_package_suppressions_all_live():
+    """The strict gate's suppression-hygiene contract on the real tree:
+    every inline suppression still absorbs a finding."""
+    from spark_rapids_tpu.analysis.__main__ import stale_suppressions
+    root = _repo_root()
+    files = collect_files([os.path.join(root, "spark_rapids_tpu")], root)
+    res = analyze_files(files)
+    assert stale_suppressions(files, res) == []
+
+
+def test_sarif_rules_carry_help_uris(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    rc = main(["--format", "sarif", str(tmp_path / "ok.py")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    rules = {r["id"]: r for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"R013", "R014", "R015"} <= set(rules)
+    for rid, entry in rules.items():
+        assert entry["helpUri"] == \
+            f"docs/static-analysis.md#{rid.lower()}", entry

@@ -11,6 +11,7 @@ from spark_rapids_tpu.analysis.cfg import (Cond, LoopIter, WithEnter,
                                            WithExit, build_cfg,
                                            iter_functions, walk_local)
 from spark_rapids_tpu.analysis import dataflow
+from spark_rapids_tpu.analysis.exceptions import ExceptionFlow
 
 
 def parse(text: str, path: str = "pkg/mod.py") -> SourceFile:
@@ -572,3 +573,203 @@ def test_bare_call_does_not_capture_method_leaf_name():
             return drain()
         """, "pkg/m.py"))
     assert g.callees("pkg/m.py::run_cb") == set()
+
+
+# ------------------------------------------------------- exception flow (v4)
+def flow(*files) -> ExceptionFlow:
+    return ExceptionFlow([parse(t, p) for (t, p) in files])
+
+
+def test_may_raise_direct_and_propagated():
+    f = flow(("""
+        class BoomError(Exception):
+            pass
+        def leaf():
+            raise BoomError("x")
+        def mid():
+            leaf()
+        def top():
+            mid()
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::leaf") == {"BoomError"}
+    assert f.raises("pkg/m.py::mid") == {"BoomError"}
+    assert f.raises("pkg/m.py::top") == {"BoomError"}
+
+
+def test_handler_subtracts_by_builtin_hierarchy():
+    """``except OSError`` catches a propagated ConnectionResetError; a
+    sibling ``except ValueError`` does not."""
+    f = flow(("""
+        def leaf():
+            raise ConnectionResetError("peer gone")
+        def caught():
+            try:
+                leaf()
+            except OSError:
+                return None
+        def missed():
+            try:
+                leaf()
+            except ValueError:
+                return None
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::caught") == frozenset()
+    assert f.raises("pkg/m.py::missed") == {"ConnectionResetError"}
+
+
+def test_handler_subtracts_by_package_class_hierarchy():
+    f = flow(("""
+        class EngineError(Exception):
+            pass
+        class FetchError(EngineError):
+            pass
+        def leaf():
+            raise FetchError("x")
+        def caught():
+            try:
+                leaf()
+            except EngineError:
+                return None
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::caught") == frozenset()
+
+
+def test_bare_raise_and_raise_e_propagate_the_caught_subset():
+    f = flow(("""
+        def leaf():
+            raise KeyError("k")
+        def bare():
+            try:
+                leaf()
+            except Exception:
+                raise
+        def named():
+            try:
+                leaf()
+            except Exception as e:
+                raise e
+        def swallowed():
+            try:
+                leaf()
+            except Exception:
+                return None
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::bare") == {"KeyError"}
+    assert f.raises("pkg/m.py::named") == {"KeyError"}
+    assert f.raises("pkg/m.py::swallowed") == frozenset()
+
+
+def test_convert_records_conversion_and_rewrites_the_escape_set():
+    f = flow(("""
+        class WrapError(Exception):
+            pass
+        def leaf():
+            raise ValueError("v")
+        def convert():
+            try:
+                leaf()
+            except ValueError as e:
+                raise WrapError("wrapped") from e
+        def top():
+            convert()
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::convert") == {"WrapError"}
+    assert f.raises("pkg/m.py::top") == {"WrapError"}
+    convs = [c for c in f.conversions if c.func.key == "pkg/m.py::convert"]
+    assert len(convs) == 1
+    assert convs[0].caught == {"ValueError"}
+    assert convs[0].to_name == "WrapError"
+
+
+def test_fixpoint_terminates_on_mutual_recursion():
+    f = flow(("""
+        def ping(n):
+            if n:
+                pong(n - 1)
+            raise RuntimeError("depth")
+        def pong(n):
+            if n:
+                ping(n - 1)
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::ping") == {"RuntimeError"}
+    assert f.raises("pkg/m.py::pong") == {"RuntimeError"}
+
+
+def test_finally_raises_union_in_and_body_escapes_survive():
+    f = flow(("""
+        def f():
+            try:
+                raise KeyError("k")
+            finally:
+                cleanup()
+        def cleanup():
+            raise OSError("close failed")
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::f") == {"KeyError", "OSError"}
+
+
+def test_unresolved_clause_subtracts_all_but_records_no_handler_fact():
+    """A dynamically-computed except clause keeps may-raise an
+    under-approximation (subtracts everything) without fabricating a
+    HandlerFlow fact the rules could flag."""
+    f = flow(("""
+        def classes():
+            return (ValueError,)
+        def f():
+            try:
+                raise KeyError("k")
+            except classes():
+                return None
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::f") == frozenset()
+    assert [hf for hf in f.handler_flows
+            if hf.func.key == "pkg/m.py::f"] == []
+
+
+def test_handler_flow_reports_arrivals_and_departures():
+    f = flow(("""
+        def leaf():
+            raise KeyError("k")
+        def f():
+            try:
+                leaf()
+            except LookupError as e:
+                raise ValueError("bad lookup")
+        """, "pkg/m.py"))
+    (hf,) = [h for h in f.handler_flows if h.func.key == "pkg/m.py::f"]
+    assert hf.clause_names == ("LookupError",)
+    assert hf.caught == {"KeyError"}
+    assert hf.raised == {"ValueError"}
+
+
+def test_decorated_finds_boundary_markers_by_leaf_name():
+    f = flow(("""
+        from spark_rapids_tpu.utils.errors import triage_boundary
+        from spark_rapids_tpu.utils import errors as uerr
+        @triage_boundary
+        def a():
+            pass
+        @uerr.wire_boundary
+        def b():
+            pass
+        def c():
+            pass
+        """, "pkg/m.py"))
+    assert [i.key for i in f.decorated("triage_boundary")] == ["pkg/m.py::a"]
+    assert [i.key for i in f.decorated("wire_boundary")] == ["pkg/m.py::b"]
+
+
+def test_nested_def_body_does_not_raise_on_the_defining_path():
+    """Defining a nested function whose body raises contributes nothing
+    until the nested function is actually called."""
+    f = flow(("""
+        def outer():
+            def inner():
+                raise ValueError("x")
+            return inner
+        def caller():
+            outer()
+        """, "pkg/m.py"))
+    assert f.raises("pkg/m.py::outer") == frozenset()
+    assert f.raises("pkg/m.py::caller") == frozenset()
+    assert f.raises("pkg/m.py::outer.inner") == {"ValueError"}

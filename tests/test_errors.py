@@ -1,0 +1,142 @@
+"""Failure-ladder taxonomy + wire codec (utils/errors.py): the declared
+classification contract, MRO-aware spec lookup, the lazy re-exports, and
+codec round-trips over both transports (pickle keeps namedtuple fidelity;
+a JSON hop list-ifies tuples and decode coerces them back)."""
+import json
+import pickle
+
+import pytest
+
+from spark_rapids_tpu.utils import errors as uerr
+
+
+# ------------------------------------------------------------------ registry
+def test_every_spec_resolves_to_its_home_class():
+    for spec in uerr.TAXONOMY:
+        klass = uerr.resolve(spec)
+        assert issubclass(klass, BaseException), spec.home
+        assert klass.__name__ == spec.name
+
+
+def test_lazy_reexports_match_home_definitions():
+    from spark_rapids_tpu.shuffle.manager import ShuffleFetchFailedError
+    from spark_rapids_tpu.serving.lifecycle import QueryCancelledError
+    assert uerr.ShuffleFetchFailedError is ShuffleFetchFailedError
+    assert uerr.QueryCancelledError is QueryCancelledError
+    with pytest.raises(AttributeError):
+        uerr.NoSuchError
+
+
+def test_classification_lookup_walks_the_mro():
+    from spark_rapids_tpu.shuffle.manager import ShuffleFetchFailedError
+
+    class ScopedFetchError(ShuffleFetchFailedError):
+        pass
+
+    err = ScopedFetchError("x", executor_id="e", blocks=())
+    assert uerr.classification_for(err) == uerr.ESCALATION_SIGNAL
+    spec = uerr.spec_for(err)
+    assert spec is not None and spec.wire_code == "SHUFFLE_FETCH_FAILED"
+    assert uerr.classification_for(ValueError("x")) is None
+    assert not uerr.is_retryable(ValueError("x"))
+
+
+def test_ladder_signals_cover_the_declared_set():
+    assert set(uerr.ladder_signals()) == {
+        "ShuffleFetchFailedError", "SpillCorruptionError", "WireQueryError",
+        "ChecksumError", "QueryCancelledError"}
+
+
+def test_cancellation_and_retryable_predicates():
+    from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
+                                                    SchedulerDrainingError)
+    assert uerr.is_cancellation(QueryCancelledError("bye"))
+    assert uerr.is_retryable(SchedulerDrainingError("draining"))
+    assert not uerr.is_retryable(QueryCancelledError("bye"))
+
+
+# ---------------------------------------------------------------- wire codec
+def test_fetch_error_roundtrip_keeps_namedtuple_blocks():
+    """Pickle transport (executor-daemon control socket): block ids must
+    arrive as the same namedtuples — recompute reads b.shuffle_id/b.map_id
+    off the payload."""
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    from spark_rapids_tpu.shuffle.manager import ShuffleFetchFailedError
+    blocks = (ShuffleBlockId(7, 2, 0), ShuffleBlockId(7, 3, 1))
+    err = ShuffleFetchFailedError("lost", executor_id="exec-3", blocks=blocks)
+    wire = pickle.loads(pickle.dumps(uerr.encode_error(err)))
+    back = uerr.decode_error(wire)
+    assert isinstance(back, ShuffleFetchFailedError)
+    assert back.executor_id == "exec-3"
+    assert back.blocks == blocks
+    assert back.blocks[0].map_id == 2
+    assert back.wire_code == "SHUFFLE_FETCH_FAILED"
+
+
+def test_json_hop_roundtrip_recoerces_tuples():
+    from spark_rapids_tpu.serving.client import WireQueryError
+    err = WireQueryError("stream died", 5)
+    wire = json.loads(json.dumps(uerr.encode_error(err), default=str))
+    back = uerr.decode_error(wire)
+    assert isinstance(back, WireQueryError)
+    assert back.batches_delivered == 5
+    assert "stream died" in str(back)
+
+
+def test_fields_ctor_roundtrip():
+    from spark_rapids_tpu.memory.buffer import SpillCorruptionError
+    err = SpillCorruptionError(path="/spill/x", expected=1, actual=2)
+    back = uerr.decode_error(uerr.encode_error(err))
+    assert isinstance(back, SpillCorruptionError)
+    assert back.path == "/spill/x"
+    assert (back.expected, back.actual) == (1, 2)
+
+
+def test_unregistered_type_degrades_to_opaque():
+    class HomegrownError(Exception):
+        pass
+
+    wire = uerr.encode_error(HomegrownError("who am i"))
+    assert wire["code"] == "OPAQUE"
+    back = uerr.decode_error(wire)
+    assert isinstance(back, uerr.OpaqueWireError)
+    assert not uerr.is_retryable(back)        # opaque is never retried
+
+
+def test_decode_never_raises_on_garbage():
+    for blob in (None, "not a dict", {"no": "code"},
+                 {"code": "UNKNOWN_FUTURE", "message": "from v99"}):
+        back = uerr.decode_error(blob)
+        assert isinstance(back, uerr.OpaqueWireError), blob
+    # unknown-but-coded payloads keep their code for observability
+    assert uerr.decode_error(
+        {"code": "UNKNOWN_FUTURE", "message": "m"}).wire_code == \
+        "UNKNOWN_FUTURE"
+
+
+def test_message_override_ships_traceback():
+    wire = uerr.encode_error(ValueError("boom"), message="Traceback ...")
+    assert wire["message"] == "Traceback ..."
+
+
+# -------------------------------------------------------------------- absorb
+def test_absorb_counts_by_context_and_type():
+    from spark_rapids_tpu.serving.client import WireQueryError
+    key = "test.ctx:WireQueryError"
+    before = uerr.ABSORBED_COUNTS.get(key, 0)
+    uerr.absorb(WireQueryError("dying stream", 1), "test.ctx")
+    uerr.absorb(WireQueryError("dying stream", 2), "test.ctx")
+    assert uerr.ABSORBED_COUNTS[key] == before + 2
+
+
+def test_boundary_markers_are_transparent():
+    @uerr.triage_boundary
+    def t(x):
+        return x + 1
+
+    @uerr.wire_boundary
+    def w(x):
+        return x * 2
+
+    assert t(1) == 2 and w(2) == 4
+    assert t.__ladder_triage_boundary__ and w.__ladder_wire_boundary__

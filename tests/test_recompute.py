@@ -289,16 +289,18 @@ def test_fetch_error_payload_survives_daemon_boundary(tmp_path):
     back = pickle.loads(pickle.dumps(err))
     assert back.executor_id == "exec-9" and tuple(back.blocks) == blocks
 
-    # the daemon's wire dict (parallel/executor_daemon.py) -> driver rebuild
-    wire = {"error_kind": "shuffle_fetch_failed",
-            "executor_id": err.executor_id, "blocks": err.blocks,
-            "message": str(err)}
-    rebuilt = ShuffleFetchFailedError(wire["message"],
-                                      executor_id=wire.get("executor_id", ""),
-                                      blocks=tuple(wire.get("blocks", ())))
+    # the daemon's wire codec (parallel/executor_daemon.py encodes, the
+    # driver's ProcessExecutor.submit decodes) -> faithful reconstruction
+    from spark_rapids_tpu.utils import errors as uerr
+    wire = uerr.encode_error(err)
+    assert wire["code"] == "SHUFFLE_FETCH_FAILED"
+    rebuilt = uerr.decode_error(wire)
+    assert isinstance(rebuilt, ShuffleFetchFailedError)
     assert rebuilt.executor_id == "exec-9"
     assert tuple(rebuilt.blocks) == blocks
     assert "lost blocks" in str(rebuilt)
+    # block ids keep their namedtuple shape: recompute reads b.map_id
+    assert rebuilt.blocks[0].map_id == 1
 
 
 def test_remove_map_outputs_scoped_to_one_map(tmp_path):
