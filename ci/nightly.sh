@@ -8,7 +8,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
-echo "== tpu-lint strict (baseline ignored: grandfathered debt stays visible; stale baseline entries AND stale inline suppressions fail with remove-me; R012 races + R013-R015 exception-flow rules run with ZERO baseline entries) =="
+echo "== tpu-lint strict (baseline ignored: grandfathered debt stays visible; stale baseline entries AND stale inline suppressions fail with remove-me; R012 races, R013-R015 exception-flow AND R016-R018 program-cache key-soundness rules run with ZERO baseline entries) =="
 python -m spark_rapids_tpu.analysis --strict --profile spark_rapids_tpu/
 
 echo "== full suite (incl. slow) =="

@@ -11,7 +11,14 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 echo "== config docs in sync =="
 python -m spark_rapids_tpu.analysis --check-configs
 
-echo "== tpu-lint (full rule set R001-R015 incl. interprocedural R008-R010, the R012 race detector + the R013-R015 exception-flow ladder rules; fails on non-baselined findings) =="
+echo "== tpu-lint fast gate (--changed-only: findings filtered to the merge-base diff; project rules keep full interprocedural context) =="
+# fail-fast ordering: a finding in the files this PR touches surfaces in
+# seconds, before the full-package pass and the test suite spend minutes.
+# The full run below remains the gate of record — the fast gate can only
+# fail earlier, never pass something the full run would catch.
+python -m spark_rapids_tpu.analysis --changed-only spark_rapids_tpu/
+
+echo "== tpu-lint (full rule set R001-R018 incl. interprocedural R008-R010, the R012 race detector, the R013-R015 exception-flow ladder + the R016-R018 capture-provenance/program-cache key-soundness rules; fails on non-baselined findings) =="
 # one pass, three outputs: the gate (exit code), the SARIF artifact CI
 # publishes as code annotations, and the per-rule profile on stderr
 lint_start=$(date +%s)

@@ -27,6 +27,17 @@ Options:
     --profile           per-rule wall-time breakdown, printed to stderr
                         slowest-first (the premerge 30 s guard prints the
                         three slowest rules from it when it trips)
+    --changed-only      fast-gate mode: findings restricted to files changed
+                        vs the git merge-base (plus untracked files). File
+                        rules run only on the changed subset; project rules
+                        still see the FULL file set — interprocedural
+                        context never shrinks — with their findings filtered
+                        afterwards. Baseline- and suppression-staleness
+                        gates are skipped (a subset run cannot judge them);
+                        nightly's full --strict run keeps that job. Falls
+                        back to a full run if no merge-base resolves.
+    --base REF          merge-base reference for --changed-only (default:
+                        origin/main, then main)
     --check-configs     verify docs/configs.md matches the registry (the
                         premerge docs-sync gate; R004 drift runs in the
                         normal lint pass with baseline semantics)
@@ -37,8 +48,9 @@ import argparse
 import json
 import os
 import re
+import subprocess
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from spark_rapids_tpu.analysis import baseline as bl
 from spark_rapids_tpu.analysis.core import (_SUPPRESS_RE, AnalysisResult,
@@ -77,6 +89,32 @@ def collect_files(paths: List[str], root: str,
             if src is not None:
                 files.append(src)
     return files
+
+
+def changed_paths(root: str, base: Optional[str]) -> Optional[Set[str]]:
+    """Repo-relative paths of files changed vs the merge-base with ``base``
+    (tracked diff + untracked), or None when no merge-base resolves — the
+    caller falls back to a full run. Fail OPEN: a broken git state must
+    widen the lint, never silently skip findings."""
+    def run(*cmd: str):
+        return subprocess.run(cmd, cwd=root, capture_output=True, text=True)
+
+    mb = None
+    for ref in ([base] if base else ["origin/main", "main"]):
+        r = run("git", "merge-base", "HEAD", ref)
+        if r.returncode == 0 and r.stdout.strip():
+            mb = r.stdout.strip()
+            break
+    if mb is None:
+        return None
+    diff = run("git", "diff", "--name-only", "-z", mb)
+    if diff.returncode != 0:
+        return None
+    changed = {p for p in diff.stdout.split("\0") if p}
+    untracked = run("git", "ls-files", "--others", "--exclude-standard", "-z")
+    if untracked.returncode == 0:
+        changed |= {p for p in untracked.stdout.split("\0") if p}
+    return changed
 
 
 def check_configs(root: str) -> int:
@@ -316,6 +354,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=("text", "json", "sarif"))
     ap.add_argument("--profile", action="store_true",
                     help="per-rule wall-time breakdown on stderr")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="restrict findings to files changed vs the git "
+                         "merge-base; project rules keep full context")
+    ap.add_argument("--base", default=None, metavar="REF",
+                    help="merge-base ref for --changed-only "
+                         "(default origin/main, then main)")
     ap.add_argument("--check-configs", action="store_true")
     args = ap.parse_args(argv)
 
@@ -339,7 +383,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not files and not parse_errors:
         print("no python files found under", paths)
         return 1
-    result: AnalysisResult = analyze_files(files, rule_ids=rule_ids)
+    changed: Optional[Set[str]] = None
+    if args.changed_only:
+        changed = changed_paths(root, args.base)
+        if changed is None:
+            print("tpu-lint: --changed-only found no merge-base; "
+                  "falling back to a full run", file=sys.stderr)
+
+    if changed is not None:
+        changed_srcs = [f for f in files if f.display_path in changed]
+        if changed_srcs:
+            # project rules over the FULL set (interprocedural context
+            # never shrinks), file rules over the changed subset only;
+            # project findings filter to changed files afterwards
+            result = analyze_files(files, rule_ids=rule_ids,
+                                   with_file_rules=False)
+            result.findings = [f for f in result.findings
+                               if f.path in changed]
+            fres = analyze_files(changed_srcs, rule_ids=rule_ids,
+                                 with_project_rules=False)
+            result.findings.extend(fres.findings)
+            result.suppressions_hit |= fres.suppressions_hit
+            for rid, secs in fres.rule_seconds.items():
+                result.rule_seconds[rid] = round(
+                    result.rule_seconds.get(rid, 0.0) + secs, 4)
+            result.files_scanned = len(files)
+        else:
+            result = AnalysisResult(files_scanned=len(files))
+    else:
+        result = analyze_files(files, rule_ids=rule_ids)
     result.errors.extend(parse_errors)
 
     baseline_path = args.baseline or os.path.join(root, bl.DEFAULT_BASELINE)
@@ -354,6 +426,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     stale: List[str] = []
     if not args.strict:
         findings, absorbed = bl.apply_baseline(findings, baseline_path)
+    elif args.changed_only:
+        # a subset run cannot judge baseline/suppression staleness — the
+        # findings it never re-derived would all look dead. Nightly's full
+        # --strict run owns that hygiene.
+        pass
     else:
         # nightly hygiene: a baseline entry no source line matches anymore
         # is debt pretending to still exist — fail with a remove-me

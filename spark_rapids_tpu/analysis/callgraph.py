@@ -90,6 +90,14 @@ class CallGraph:
     def __init__(self, files: Sequence[SourceFile]):
         self.functions: Dict[str, FunctionInfo] = {}
         self.edges: Dict[str, Set[str]] = {}
+        #: calls made inside lambda bodies nested in each function. They are
+        #: DEFERRED: the lambda runs in its own activation, possibly long
+        #: after (and far from) the enclosing function's paths, so they must
+        #: not join ``edges`` — R009's lock-order reachability would otherwise
+        #: claim a closure defined under a lock runs under it. The capture
+        #: analysis (R016-R018) needs them: a jit builder written as
+        #: ``lambda: make(...)`` observes everything ``make`` observes.
+        self.deferred_edges: Dict[str, Set[str]] = {}
         #: class name -> ClassInfo (package class names are unique enough;
         #: a collision keeps the first and is logged nowhere — conservative)
         self.classes: Dict[str, ClassInfo] = {}
@@ -294,17 +302,37 @@ class CallGraph:
     def _link(self, files: Sequence[SourceFile]) -> None:
         for key, info in self.functions.items():
             targets: Set[str] = set()
-            # calls inside nested defs belong to the nested function
+            deferred: Set[str] = set()
+            # calls inside nested defs belong to the nested function; calls
+            # inside lambda bodies are collected separately (deferred) — a
+            # lambda body has no statements, so ast.walk over it only ever
+            # meets expressions and nested lambdas/comprehensions
             for node in walk_local(info.node):
                 if isinstance(node, ast.Call):
                     for t in self.resolve_call(info, node):
                         if t != key:
                             targets.add(t)
+                elif isinstance(node, ast.Lambda):
+                    for sub in ast.walk(node.body):
+                        if isinstance(sub, ast.Call):
+                            for t in self.resolve_call(info, sub):
+                                if t != key:
+                                    deferred.add(t)
             self.edges[key] = targets
+            self.deferred_edges[key] = deferred
 
     # ---- queries ------------------------------------------------------------
     def callees(self, key: str) -> Set[str]:
         return self.edges.get(key, set())
+
+    def callees_all(self, key: str) -> Set[str]:
+        """Immediate callees INCLUDING calls deferred inside lambda bodies.
+
+        ``callees``/``reachable`` stay lambda-blind on purpose (R009: a
+        closure defined under a lock is not running under it); capture
+        provenance wants the opposite — whatever a builder lambda calls, the
+        compiled program observed."""
+        return self.edges.get(key, set()) | self.deferred_edges.get(key, set())
 
     def reachable(self, roots: Sequence[str],
                   max_depth: int = DEFAULT_DEPTH) -> Set[str]:

@@ -106,6 +106,7 @@ def all_rules() -> List[Rule]:
 def _load_builtin_rules() -> None:
     # import for side effect: each module registers its rules
     from spark_rapids_tpu.analysis import (rules_cancel,      # noqa: F401
+                                           rules_captures,    # noqa: F401
                                            rules_dtype,       # noqa: F401
                                            rules_exceptions,  # noqa: F401
                                            rules_lockorder,   # noqa: F401
@@ -275,9 +276,15 @@ def load_source(path: str, display_path: Optional[str] = None,
 
 def analyze_files(files: Sequence[SourceFile],
                   rule_ids: Optional[Set[str]] = None,
-                  with_project_rules: bool = True) -> AnalysisResult:
+                  with_project_rules: bool = True,
+                  with_file_rules: bool = True) -> AnalysisResult:
     """Run every (selected) rule over ``files``; suppressions applied here so
-    rules stay oblivious to them."""
+    rules stay oblivious to them.
+
+    ``--changed-only`` splits one logical run into two calls: file rules
+    over the changed subset (``with_project_rules=False``) and project
+    rules over the FULL set (``with_file_rules=False``) so interprocedural
+    context never shrinks; the CLI merges and filters the findings."""
     import time as _time
     result = AnalysisResult(files_scanned=len(files))
     rules = [r for r in all_rules()
@@ -288,7 +295,7 @@ def analyze_files(files: Sequence[SourceFile],
         if rule.is_project_rule:
             if with_project_rules:
                 raw = rule.check_project(files)
-        else:
+        elif with_file_rules:
             for src in files:
                 raw.extend(rule.check(src))
         result.rule_seconds[rule.rule_id] = round(

@@ -724,10 +724,13 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                 return
         bounds_flat = tuple(flatten_colvs(bounds)) if bounds else ()
         nb = bounds[0].validity.shape[0] if bounds else 0
-        key = ("exchange", part, schema, cap, smax, nb, offset)
+        # n is keyed: the traced program returns an n-length counts vector,
+        # so repartitions differing only in partition count must not share
+        # a compiled split (R016)
+        key = ("exchange", part, schema, cap, smax, nb, offset, n)
 
         def build(part=part, schema=schema, cap=cap, smax=smax,
-                  offset=offset, nb=nb):
+                  offset=offset, nb=nb, n=n):
             def fn(num_rows, *args):
                 bnd = None
                 consumed = 0
@@ -800,12 +803,14 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
                     wire_flat.append(c.lengths)
         bounds_flat = tuple(flatten_colvs(bounds)) if bounds else ()
         nb = bounds[0].validity.shape[0] if bounds else 0
+        # n keyed for the same reason as the decoded sort path: the counts
+        # vector the program returns has length n (R016)
         key = ("exchange-enc", part, schema, wire_schema, cap, smax, nb,
-               offset, tuple(enc_sig))
+               offset, tuple(enc_sig), n)
 
         def build(part=part, schema=schema, wire_schema=wire_schema,
                   cap=cap, smax=smax, offset=offset, nb=nb,
-                  enc_sig=tuple(enc_sig)):
+                  enc_sig=tuple(enc_sig), n=n):
             def fn(num_rows, *args):
                 bnd = None
                 consumed = 0
@@ -891,7 +896,11 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         # round-robin repartition cycles offsets per source batch, and each
         # distinct key value would retrace the heavyweight pack+Pallas
         # program (the pids math is shape-stable in offset)
-        key = ("exchange-fused", part, spec, geom, cap, smax, interpret)
+        # schema is keyed explicitly: spec.plans usually pins it, but the
+        # traced fn zips schema's dtypes against the plans and nothing in
+        # PackSpec's equality promises the field types round-trip (R016)
+        key = ("exchange-fused", part, spec, geom, schema, cap, smax,
+               interpret)
 
         def build(part=part, spec=spec, geom=geom, schema=schema, cap=cap,
                   smax=smax, interpret=interpret):

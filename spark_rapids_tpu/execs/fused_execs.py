@@ -217,7 +217,12 @@ class FusedStageExec(PhysicalExec):
             ctx.check_cancelled()
             cap = batch.capacity
             variants, used = self._rewrite_encoded(batch, use_enc)
-            key = ("stage", variants, used, in_schema, cap, smax, attrs)
+            # out_schema is keyed: the traced fn zips each variant's
+            # expressions against the output fields, so two stages sharing
+            # (variants, in_schema) but projecting different output dtypes
+            # must not share a program (R016)
+            key = ("stage", variants, used, in_schema, out_schema, cap,
+                   smax, attrs)
             fn = self.cached_program(key, lambda: make(variants, used, cap))
             res = fn(np.int32(batch.num_rows), *te._flatten(batch),
                      *cenc.flatten_encodings(batch, used))

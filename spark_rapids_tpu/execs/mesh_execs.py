@@ -58,13 +58,22 @@ EXCHANGE_STATS: list = []
 
 
 def _shard_jit(mesh: Mesh, key: Tuple, builder, in_specs, out_specs):
-    """Cached jit(shard_map(...)) keyed like the single-chip program cache."""
-    def make():
-        from spark_rapids_tpu import shims
-        return shims.get().shard_map(builder(), mesh=mesh,
-                                     in_specs=in_specs,
-                                     out_specs=out_specs, check_vma=False)
-    return _cached_jit(("mesh", mesh, key), make)
+    """Cached jit(shard_map(...)) keyed like the single-chip program cache.
+
+    The inner key carries everything ``make`` observes beyond the caller's
+    key (R016): the active shim's identity — a provider swap must not serve
+    the old backend's shard_map program — the mesh, and both sharding-spec
+    tuples, so two callers sharing (mesh, key) but sharding differently
+    never share a compiled program. The shim is resolved here, once, not
+    re-read inside the cached builder."""
+    from spark_rapids_tpu import shims
+    shim = shims.get()
+
+    def make(shim=shim):
+        return shim.shard_map(builder(), mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    return _cached_jit(
+        ("mesh", type(shim).__name__, mesh, key, in_specs, out_specs), make)
 
 
 def _specs(n: int, spec=P(DATA_AXIS)) -> Tuple:
@@ -572,6 +581,10 @@ def _mesh_repartition(mb: MeshBatch, op_key: Tuple, pid_builder,
     schema = mb.schema
     nflat = flat_len(schema)
     rows = mb.rows_dev()
+    # self-sufficient key: everything the traced exchange observes beyond
+    # op_key rides in the key itself instead of relying on every caller's
+    # op_key discipline (R016 — schema/cap/n_dev/smax specialize the trace)
+    base_key = op_key + (schema, cap, n_dev, smax, n_extra)
 
     def build_count():
         def fn(rows, *args):
@@ -586,7 +599,7 @@ def _mesh_repartition(mb: MeshBatch, op_key: Tuple, pid_builder,
             return counts
         return fn
 
-    fnc = _shard_jit(mesh, op_key + ("count",), build_count,
+    fnc = _shard_jit(mesh, base_key + ("count",), build_count,
                      (P(DATA_AXIS),) + _specs(n_extra, P()) + _specs(nflat),
                      P(DATA_AXIS))
     cmat = np.asarray(fnc(rows, *extra_flat, *flatten_mesh(mb))).reshape(
@@ -646,7 +659,7 @@ def _mesh_repartition(mb: MeshBatch, op_key: Tuple, pid_builder,
             return tuple(outs)
         return fn
 
-    fne = _shard_jit(mesh, op_key + ("exchange", chunk_cap, out_cap),
+    fne = _shard_jit(mesh, base_key + ("exchange", chunk_cap, out_cap),
                      build_exchange,
                      (P(DATA_AXIS),) + _specs(n_extra, P()) + _specs(nflat),
                      (P(DATA_AXIS),) + _specs(nflat))
@@ -1031,8 +1044,10 @@ class MeshHashAggregateExec(MeshExec):
         # merged total comes back from the program and trims rows_per_shard
         per = -(-total // n_dev) if total else 0
         out_cap = max(bucket_capacity(per), 1)
+        # n_dev is keyed: the merge gathers pcap * n_dev rows, so meshes
+        # of different device counts must not share a program (R016)
         key = ("magg_merge_ag", self.grouping, fns, pschema, pcap, out_cap,
-               smax, per)
+               smax, per, n_dev)
 
         def build(fns=fns, pschema=pschema, pcap=pcap, out_cap=out_cap,
                   nkeys=nkeys, n_dev=n_dev, per=per):

@@ -485,8 +485,12 @@ class TpuHashAggregateExec(PhysicalExec):
         # count, exact overflow/collision flag), then hash-ordered grouping
         # (one variadic sort), then the exact lexsort — each escalation only
         # on a flagged run
-        key = ("agg", grouping, fns, pre_filter, used, schema, cap,
-               ctx.string_max_bytes)
+        # subs is keyed: it decides which key columns materialize from the
+        # encoded domain inside the trace, and ``used`` alone does not pin
+        # it — the predicate can contribute specs to used without touching
+        # the grouping rewrite (R016)
+        key = ("agg", grouping, fns, pre_filter, used, tuple(subs.items()),
+               schema, cap, ctx.string_max_bytes)
         from spark_rapids_tpu.ops.aggregate import grouping_modes
         modes = grouping_modes(grouping, fns)
         enc_flat = cenc.flatten_encodings(batch, used)

@@ -82,9 +82,12 @@ def build_distributed_aggregate(mesh: Mesh, schema: Schema,
 
     from spark_rapids_tpu import shims
     from spark_rapids_tpu.execs.tpu_execs import _cached_jit
-    key = ("dist-agg", mesh, schema, tuple(key_exprs), tuple(agg_fns),
-           local_capacity, string_max_bytes, axis)
-    return _cached_jit(key, lambda: shims.get().shard_map(
+    # shim resolved here, once: its identity is part of the key, so a
+    # provider swap can never serve the old backend's program (R016)
+    shim = shims.get()
+    key = ("dist-agg", type(shim).__name__, mesh, schema, tuple(key_exprs),
+           tuple(agg_fns), local_capacity, string_max_bytes, axis)
+    return _cached_jit(key, lambda: shim.shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False))
 

@@ -173,7 +173,10 @@ def gather_mesh(mb: MeshBatch) -> DeviceBatch:
     total_rows = mb.num_rows
     out_cap = max(bucket_capacity(total_rows), 1)
     rows = mb.rows_dev()
-    key = ("mesh-gather", mb.mesh, mb.schema, cap,
+    # n_dev is keyed explicitly: the traced gather reshapes over
+    # n_dev * cap, so two meshes sharing (schema, cap, out_cap) but
+    # differing in device count must not share a program (R016)
+    key = ("mesh-gather", mb.mesh, mb.schema, cap, n_dev,
            tuple(c.data.shape[1:] for c in mb.columns), out_cap)
 
     from spark_rapids_tpu.execs.tpu_execs import _cached_jit

@@ -124,8 +124,12 @@ def build_ici_repartition(mesh: Mesh, schema: Schema, local_capacity: int,
     # the compiled exchange instead of paying XLA compilation per call
     from spark_rapids_tpu.execs.tpu_execs import _cached_jit
     from spark_rapids_tpu import shims
-    key = ("ici-repart", mesh, schema, local_capacity, chunk_cap, axis)
-    return _cached_jit(key, lambda: shims.get().shard_map(
+    # shim resolved here, once: its identity is part of the key, so a
+    # provider swap can never serve the old backend's program (R016)
+    shim = shims.get()
+    key = ("ici-repart", type(shim).__name__, mesh, schema, local_capacity,
+           chunk_cap, axis)
+    return _cached_jit(key, lambda: shim.shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False))
 

@@ -1015,7 +1015,8 @@ def _interprocedural_package_result():
         from spark_rapids_tpu.analysis import analyze_files as _af
         t0 = time.monotonic()
         res = _af(files, rule_ids={"R008", "R009", "R010", "R012",
-                                   "R013", "R014", "R015"})
+                                   "R013", "R014", "R015", "R016",
+                                   "R017", "R018"})
         _INTERPROC_CACHE["res"] = res
         _INTERPROC_CACHE["elapsed"] = time.monotonic() - t0
     return _INTERPROC_CACHE["res"]
@@ -1968,3 +1969,300 @@ def test_sarif_rules_carry_help_uris(tmp_path, capsys):
     for rid, entry in rules.items():
         assert entry["helpUri"] == \
             f"docs/static-analysis.md#{rid.lower()}", entry
+
+# ------------------------------------------------------------------ R016
+def test_r016_unkeyed_closure_capture_flagged():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def split(schema, cap, n):
+            key = ("exchange", schema, cap)
+            def build():
+                def fn(rows):
+                    return rows * n
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R016"})
+    assert len(found) == 1
+    assert "'n'" in found[0].message
+    assert "stale specialization" in found[0].message
+    assert "widen the key" in found[0].message
+
+
+def test_r016_keyed_capture_clean():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def split(schema, cap, n):
+            key = ("exchange", schema, cap, n)
+            def build():
+                def fn(rows):
+                    return rows * n
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    assert run(fs, {"R016"}) == []
+
+
+def test_r016_lambda_builder_and_sibling_def_contribute_captures():
+    """The satellite engine fix: a builder written as ``lambda: make(...)``
+    observes everything the sibling ``make`` observes — both the lambda's
+    own frees and the sibling's must classify."""
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def outer(key, cap, smax):
+            def make(cap):
+                def fn(x):
+                    return x[:cap] * smax
+                return fn
+            return _cached_jit(key, lambda: make(cap))
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R016"})
+    flagged = {f.message.split("captures '")[1].split("'")[0]
+               for f in found}
+    assert flagged == {"cap", "smax"}
+
+
+def test_r016_listcomp_capture_flagged():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def go(key, cols):
+            def build():
+                def fn(x):
+                    return [x * c for c in cols]
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R016"})
+    assert len(found) == 1 and "'cols'" in found[0].message
+
+
+def test_r016_forwarding_wrapper_clean():
+    """A wrapper that routes its caller's builder through the cache is
+    not a capture site: the builder parameter is invoked, so its contents
+    are the CALLER's responsibility (the caller's own site is analyzed)."""
+    fs = src("""
+        from spark_rapids_tpu.serving.program_cache import global_program_cache
+        def cached(key, builder):
+            return global_program_cache().get_or_build(
+                key, lambda: builder())
+        """, path="spark_rapids_tpu/execs/engine.py")
+    assert run(fs, {"R016"}) == []
+
+
+def test_r016_const_and_derived_clean():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        _WIDTH = 64
+        def go(schema, cap):
+            key = ("k", schema, cap)
+            total = cap * 2
+            def build():
+                def fn(x):
+                    return x[:total] + _WIDTH
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    assert run(fs, {"R016"}) == []
+
+
+def test_r016_keyed_default_arg_clean_unkeyed_flagged():
+    """Pinning via a default arg does not sanction by itself — the pinned
+    value must still be key-derived."""
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def go(schema, cap, extra):
+            key = ("k", schema, cap)
+            def build(cap=cap, extra=extra):
+                def fn(x):
+                    return x[:cap] + extra
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R016"})
+    assert len(found) == 1 and "'extra'" in found[0].message
+
+
+def test_r016_real_package_clean():
+    """Acceptance gate: every cached-program builder in the package
+    observes only key-derived, traced, or constant values — the PR's
+    site fixes (widened keys, hoisted shim reads) hold."""
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R016"]
+    assert found == [], [f.render() for f in found]
+
+
+# ------------------------------------------------------------------ R017
+def test_r017_mutated_module_global_flagged():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        TABLE = {}
+        def register(k, v):
+            TABLE[k] = v
+        def go(key):
+            def build():
+                def fn(x):
+                    return x + len(TABLE)
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R017"})
+    assert len(found) == 1
+    assert "'TABLE'" in found[0].message
+    assert "mutated in place" in found[0].message
+
+
+def test_r017_keyed_mutable_attr_flagged():
+    """Keying a mutable attr does not make it safe: the key repr may not
+    change with the mutation, and the trace snapshot never does."""
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        class Exec:
+            def __init__(self):
+                self.caps = []
+            def grow(self, c):
+                self.caps.append(c)
+            def run(self):
+                key = ("k", self.caps)
+                def build():
+                    def fn(x):
+                        return x * len(self.caps)
+                    return fn
+                return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R017"})
+    assert len(found) == 1
+    assert "'self.caps'" in found[0].message
+    assert "in-place write sites" in found[0].message
+
+
+def test_r017_unmutated_global_and_attr_clean():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        WIDTHS = (8, 16)
+        class Exec:
+            def __init__(self):
+                self.caps = ()
+            def run(self):
+                key = ("k", self.caps)
+                def build():
+                    def fn(x):
+                        return x * len(self.caps) + WIDTHS[0]
+                    return fn
+                return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    assert run(fs, {"R017"}) == []
+
+
+def test_r017_real_package_clean():
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R017"]
+    assert found == [], [f.render() for f in found]
+
+
+# ------------------------------------------------------------------ R018
+def test_r018_metric_bump_in_trace_flagged():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def go(key, metrics):
+            def build():
+                def fn(x):
+                    metrics.add(1)
+                    return x + 1
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R018"})
+    assert len(found) == 1
+    assert "metric bump" in found[0].message
+    assert "once per compile" in found[0].message
+
+
+def test_r018_lock_and_host_io_in_trace_flagged():
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        class Exec:
+            def run(self, key):
+                def build():
+                    def fn(x):
+                        with self.lock:
+                            print("running")
+                        return x + 1
+                    return fn
+                return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    found = run(fs, {"R018"})
+    kinds = sorted(f.message.split(" inside")[0] for f in found)
+    assert len(found) == 2
+    assert any("lock acquisition" in k for k in kinds)
+    assert any("host call" in k for k in kinds)
+
+
+def test_r018_effect_outside_trace_clean():
+    """Effects in the BUILDER (but outside the returned callable) run once
+    per build on the host — exactly where a compile-time log belongs."""
+    fs = src("""
+        from spark_rapids_tpu.execs.tpu_execs import _cached_jit
+        def go(key, metrics):
+            def build():
+                metrics.add(1)
+                def fn(x):
+                    return x + 1
+                return fn
+            return _cached_jit(key, build)
+        """, path="spark_rapids_tpu/execs/engine.py")
+    assert run(fs, {"R018"}) == []
+
+
+def test_r018_real_package_clean():
+    res = _interprocedural_package_result()
+    found = [f for f in res.findings if f.rule == "R018"]
+    assert found == [], [f.render() for f in found]
+
+
+# ------------------------------------------------------ --changed-only gate
+def _seed_git_repo(tmp_path):
+    import subprocess
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+    hot = tmp_path / "execs"
+    hot.mkdir()
+    (hot / "old.py").write_text(
+        "def f(arr):\n    return arr.sum().item()\n")
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", "-A")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "seed")
+    (hot / "new.py").write_text(
+        "def g(arr):\n    return arr.sum().item()\n")
+
+
+def test_changed_only_filters_findings_to_changed_files(tmp_path, capsys,
+                                                        monkeypatch):
+    """Fast-gate contract: the committed-and-unchanged file's finding is
+    filtered; the untracked file's finding survives."""
+    _seed_git_repo(tmp_path)
+    monkeypatch.setattr("spark_rapids_tpu.analysis.__main__._repo_root",
+                        lambda: str(tmp_path))
+    rc = main(["--changed-only", "--base", "HEAD", "--rules", "R002",
+               "--format", "json", str(tmp_path),
+               "--baseline", str(tmp_path / "none.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in out["findings"]} == {"execs/new.py"}
+
+
+def test_changed_only_without_git_falls_back_to_full_run(tmp_path, capsys,
+                                                         monkeypatch):
+    """Fail OPEN: no merge-base -> the full set is linted, never silently
+    skipped."""
+    hot = tmp_path / "execs"
+    hot.mkdir()
+    (hot / "a.py").write_text("def f(arr):\n    return arr.sum().item()\n")
+    monkeypatch.setattr("spark_rapids_tpu.analysis.__main__._repo_root",
+                        lambda: str(tmp_path))
+    rc = main(["--changed-only", "--rules", "R002", "--format", "json",
+               str(tmp_path), "--baseline", str(tmp_path / "none.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in out["findings"]} == {"execs/a.py"}

@@ -773,3 +773,78 @@ def test_nested_def_body_does_not_raise_on_the_defining_path():
     assert f.raises("pkg/m.py::outer") == frozenset()
     assert f.raises("pkg/m.py::caller") == frozenset()
     assert f.raises("pkg/m.py::outer.inner") == {"ValueError"}
+
+# ------------------------------------------ capture engine (v5, captures.py)
+def test_free_paths_lambda_and_comprehension_scoping():
+    """The v5 free-variable extractor sees through lambda and
+    comprehension bodies with proper shadowing: their params/targets bind,
+    everything else is free."""
+    from spark_rapids_tpu.analysis.captures import free_paths
+    tree = ast.parse(textwrap.dedent("""
+        def f(a):
+            g = lambda y: y + b + a
+            xs = [c * i for i in range(3)]
+            def h():
+                return d
+            return g, xs, h
+        """))
+    free = free_paths(tree.body[0])
+    assert {"b", "c", "d"} <= free
+    assert not {"a", "y", "i", "g", "xs", "h"} & free
+
+
+def test_free_paths_attr_chain_and_store_receiver():
+    from spark_rapids_tpu.analysis.captures import free_paths
+    tree = ast.parse(textwrap.dedent("""
+        def f():
+            obj.slot = other.deep.value
+            return conf.get
+        """))
+    free = free_paths(tree.body[0])
+    assert "obj" in free           # store target's receiver is a READ
+    assert "other.deep.value" in free
+    assert "conf.get" in free
+
+
+def test_free_paths_nested_def_shadowing():
+    from spark_rapids_tpu.analysis.captures import free_paths
+    tree = ast.parse(textwrap.dedent("""
+        def f(cap):
+            def inner(cap):
+                return cap + smax
+            return inner
+        """))
+    free = free_paths(tree.body[0])
+    assert "smax" in free and "cap" not in free
+
+
+def test_lambda_calls_are_deferred_edges_not_reachability_edges():
+    """R009's semantics must not regress: a closure defined under a lock
+    is not RUNNING under it, so lambda-body calls stay out of
+    ``edges``/``reachable`` — but the capture analysis can see them via
+    ``deferred_edges``/``callees_all``."""
+    src = parse("""
+        def helper():
+            return 1
+        def f():
+            g = lambda: helper()
+            return g
+        """, path="pkg/m.py")
+    cg = CallGraph([src])
+    f_key, h_key = "pkg/m.py::f", "pkg/m.py::helper"
+    assert h_key not in cg.edges[f_key]
+    assert h_key in cg.deferred_edges[f_key]
+    assert h_key in cg.callees_all(f_key)
+    assert cg.reachable([f_key]) == {f_key}
+
+
+def test_direct_calls_do_not_duplicate_into_deferred_edges():
+    src = parse("""
+        def helper():
+            return 1
+        def f():
+            return helper()
+        """, path="pkg/m.py")
+    cg = CallGraph([src])
+    assert "pkg/m.py::helper" in cg.edges["pkg/m.py::f"]
+    assert cg.deferred_edges["pkg/m.py::f"] == set()

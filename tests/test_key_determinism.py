@@ -1,0 +1,82 @@
+"""Cross-process determinism of cache keys (the R016 root assumption).
+
+The serving tier's warm-start contract (PR 8) and every R016 fix assume
+that ``plan_key()`` and ``stable_key_hash()`` are pure functions of the
+plan + conf — not of the process that computed them. Python's per-process
+hash randomization (PYTHONHASHSEED) is the classic way this breaks: any
+set/dict-iteration order leaking into a key repr produces keys that agree
+within one process and disagree across restarts, which silently defeats
+the on-disk program index (every warm start misses) without ever failing
+a single-process test.
+
+These tests run the key computation in TWO subprocesses with DIFFERENT
+hash seeds and assert bit-for-bit agreement.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import json, sys
+import pyarrow as pa
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+from spark_rapids_tpu.serving.program_cache import plan_key, stable_key_hash
+
+sess = TpuSession({"spark.rapids.tpu.sql.string.maxBytes": "16"})
+table = pa.table({
+    "k": pa.array([1, 2, 3, 1], type=pa.int64()),
+    "v": pa.array([0.5, 1.5, 2.5, 3.5], type=pa.float64()),
+})
+df = (sess.create_dataframe(table)
+      .filter(F.col("v") > 1.0)
+      .groupBy("k").agg(F.sum("v").alias("s")))
+pk = plan_key(df._executed_plan(), sess.conf)
+
+# representative program-cache keys: the shapes the R007 idiom set routes
+# (agg / exchange / fused-stage), mixing Schema, DType and scalar buckets
+schema = Schema([Field("k", DType.INT, True), Field("s", DType.STRING, True)])
+keys = [
+    ("agg", ("k",), ("sum",), None, (), (), schema, 1024, 16),
+    ("exchange", schema, 2048, 16, 0, 0, 4),
+    ("stage", ("project", "filter"), (), schema, schema, 4096, 16),
+    ("mesh", "Jax05PlusShims", schema, 128, 64, "data"),
+]
+json.dump({"plan_key": pk,
+           "hashes": [stable_key_hash(k) for k in keys]}, sys.stdout)
+"""
+
+
+def _run_keys(hashseed: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = hashseed
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", _SCRIPT], cwd=repo,
+                          capture_output=True, text=True, env=env,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout)
+
+
+def test_plan_key_and_hashes_agree_across_processes():
+    a = _run_keys("1")
+    b = _run_keys("2")
+    assert a["plan_key"] == b["plan_key"]
+    assert a["hashes"] == b["hashes"]
+
+
+def test_stable_key_hash_is_repr_deterministic():
+    """In-process spot check of the same property: the key vocabulary's
+    reprs carry no memory addresses or unordered-collection iteration —
+    the precondition for the subprocess test's bit-for-bit claim."""
+    from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+    from spark_rapids_tpu.serving.program_cache import stable_key_hash
+    s1 = Schema([Field("a", DType.INT, True), Field("b", DType.STRING, False)])
+    s2 = Schema([Field("a", DType.INT, True), Field("b", DType.STRING, False)])
+    k1 = ("agg", ("a",), s1, 1024, 16)
+    k2 = ("agg", ("a",), s2, 1024, 16)
+    assert stable_key_hash(k1) == stable_key_hash(k2)
+    assert "0x" not in repr(k1)

@@ -1,0 +1,110 @@
+"""Stale-program regressions for the R016 site fixes.
+
+Each cached-program key widened in the capture-provenance PR guards a
+concrete wrong-results shape: a builder observing a value the key omitted
+would serve the FIRST caller's specialization to every later caller. These
+tests pin (a) the failure mode itself against the real cache, and (b) the
+widened keys at the real sites — provider identity, sharding specs, device
+count — so a future key "simplification" reintroducing the collision fails
+here, not in production results.
+"""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import device as _device  # noqa: F401 - jax setup
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_tpu.columnar.dtypes import DType, Field, Schema
+from spark_rapids_tpu.execs.mesh_execs import _shard_jit
+from spark_rapids_tpu.execs.tpu_execs import _JIT_CACHE, _cached_jit
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from spark_rapids_tpu.parallel.mesh_batch import gather_mesh, scatter_arrow
+from spark_rapids_tpu.shuffle.ici import build_ici_repartition
+
+
+def test_unkeyed_capture_serves_stale_program():
+    """The hazard R016 machine-checks, reproduced against the real cache:
+    a builder closing over a value its key omits returns the OLD
+    specialization after the value changes — silently wrong results. The
+    keyed variant below is the fix discipline every site in the package
+    now follows."""
+    captured = {"m": 2}
+
+    def build():
+        m = captured["m"]
+        return lambda x: x * m
+
+    f1 = _cached_jit(("r016-repro", "collision"), build)
+    assert int(f1(jnp.int32(5))) == 10
+    captured["m"] = 3
+    f2 = _cached_jit(("r016-repro", "collision"), build)
+    assert int(f2(jnp.int32(5))) == 10      # stale: still the m=2 program
+    f3 = _cached_jit(("r016-repro", "keyed", captured["m"]), build)
+    assert int(f3(jnp.int32(5))) == 15      # keyed: fresh specialization
+
+
+def test_shard_jit_distinct_specs_get_distinct_programs():
+    """Two callers sharing (mesh, key) but sharding differently must not
+    share a compiled program — in_specs/out_specs are part of _shard_jit's
+    inner key now."""
+    mesh = make_mesh(2)
+
+    def build():
+        def fn(x):
+            return x + 1
+        return fn
+
+    before = set(_JIT_CACHE)
+    _shard_jit(mesh, ("r016-specs",), build, (P(DATA_AXIS),), (P(DATA_AXIS),))
+    _shard_jit(mesh, ("r016-specs",), build, (P(),), (P(),))
+    assert len(set(_JIT_CACHE) - before) == 2
+
+
+def test_shard_jit_key_carries_shim_identity():
+    """A shim-provider swap must never serve the old backend's shard_map
+    program: the active provider's class name is an inner key component,
+    resolved ONCE at key time (not re-read inside the cached builder)."""
+    from spark_rapids_tpu import shims
+    mesh = make_mesh(2)
+
+    def build():
+        def fn(x):
+            return x * 2
+        return fn
+
+    _shard_jit(mesh, ("r016-shim",), build, (P(),), (P(),))
+    name = type(shims.get()).__name__
+    hits = [k for k in _JIT_CACHE
+            if isinstance(k, tuple) and len(k) > 3 and k[0] == "mesh"
+            and k[3] == ("r016-shim",)]
+    assert hits and all(k[1] == name for k in hits)
+
+
+def test_ici_repartition_key_carries_shim_identity():
+    from spark_rapids_tpu import shims
+    mesh = make_mesh(2)
+    schema = Schema([Field("a", DType.INT, True)])
+    build_ici_repartition(mesh, schema, 128)
+    name = type(shims.get()).__name__
+    hits = [k for k in _JIT_CACHE
+            if isinstance(k, tuple) and k and k[0] == "ici-repart"]
+    assert hits and all(k[1] == name for k in hits)
+
+
+def test_gather_mesh_correct_across_device_counts():
+    """The mesh-gather program reshapes over n_dev * cap: meshes of
+    different device counts share the same (schema, local capacity) here
+    — distinct programs must compile, and both must compact correctly in
+    shard-major row order."""
+    table = pa.table({"a": pa.array(range(12), type=pa.int64())})
+    for n_dev in (2, 4):
+        mb = scatter_arrow(table, make_mesh(n_dev), 16)
+        db = gather_mesh(mb)
+        assert db.num_rows == 12
+        got = db.to_arrow().column("a").to_pylist()
+        assert got == list(range(12)), (n_dev, got)
+    keys = [k for k in _JIT_CACHE
+            if isinstance(k, tuple) and k and k[0] == "mesh-gather"]
+    n_devs = {k[4] for k in keys}
+    assert {2, 4} <= n_devs
