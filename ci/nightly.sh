@@ -337,6 +337,190 @@ finally:
     sess_b.scheduler.drain(timeout=60)
 PY
 
+echo "== autoscale chaos (supervised fleet under sustained load + seeded kill_peer: scale up, heal, shed with retry-after, converge to floor — zero caller-visible errors) =="
+python - << 'PY'
+import threading
+import time
+import numpy as np, pyarrow as pa
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import QueryServiceClient
+from spark_rapids_tpu.serving.controller import FleetController
+from spark_rapids_tpu.serving.lifecycle import OverloadedError
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.serving.supervisor import ReplicaSupervisor
+from spark_rapids_tpu.shuffle.tcp import scan_registry
+from spark_rapids_tpu.utils import metrics as um
+
+import tempfile
+REG = tempfile.mkdtemp(prefix="autoscale-reg-")
+rng = np.random.default_rng(7)
+TABLE = pa.table({"k": rng.integers(0, 8, 20000).astype("int64"),
+                  "v": rng.random(20000)})
+SQL = "SELECT k, v FROM t WHERE v > 0.5"
+SERVE_CONF = {
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+    "spark.rapids.tpu.serving.net.registryDir": REG,
+    "spark.rapids.tpu.serving.health.heartbeatSeconds": "0.1",
+    "spark.rapids.tpu.serving.health.livenessWindowSeconds": "0.5",
+    "spark.rapids.tpu.serving.maxConcurrentQueries": "1",
+    "spark.rapids.tpu.serving.maxQueuedPerTenant": "2",
+    "spark.rapids.tpu.serving.overload.retryAfterSeconds": "0.1",
+    "spark.rapids.tpu.serving.stats.sampleIntervalSeconds": "0.2",
+}
+FLEET_CONF = {
+    **SERVE_CONF,
+    "spark.rapids.tpu.serving.fleet.minReplicas": "1",
+    "spark.rapids.tpu.serving.fleet.maxReplicas": "3",
+    "spark.rapids.tpu.serving.fleet.scaleUpWatermark": "0.8",
+    "spark.rapids.tpu.serving.fleet.scaleDownWatermark": "0.2",
+    "spark.rapids.tpu.serving.fleet.scaleUpStableTicks": "1",
+    "spark.rapids.tpu.serving.fleet.scaleDownStableTicks": "4",
+    "spark.rapids.tpu.serving.fleet.scaleUpCooldownSeconds": "1",
+    "spark.rapids.tpu.serving.fleet.scaleDownCooldownSeconds": "2",
+    "spark.rapids.tpu.serving.fleet.superviseIntervalSeconds": "0.1",
+    "spark.rapids.tpu.serving.fleet.restartBackoffMs": "50",
+    "spark.rapids.tpu.serving.fleet.crashLoopThreshold": "4",
+    "spark.rapids.tpu.serving.fleet.crashLoopWindowSeconds": "1",
+}
+
+class InProcReplica:
+    def __init__(self, conf):
+        self.sess = TpuSession(conf)
+        (self.sess.create_dataframe(TABLE).repartition(3)
+         .createOrReplaceTempView("t"))
+        self.server = QueryServer(self.sess)
+        host, port = self.server.address
+        self.addr = f"{host}:{port}"
+        self._exited = False
+
+    def poll(self):
+        return 0 if self._exited else None
+
+    def terminate(self):
+        def run():
+            self.server.drain()
+            deadline = time.time() + 60
+            while not self.server.drained() and time.time() < deadline:
+                time.sleep(0.05)
+            self.server.shutdown()
+            self.sess.scheduler.shutdown(wait=False)
+            self._exited = True
+        threading.Thread(target=run, daemon=True).start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.sess.scheduler.shutdown(wait=False)
+        self._exited = True
+
+replicas = []
+chaos_armed = [True]
+
+def spawn(slot_index):
+    conf = dict(SERVE_CONF)
+    if slot_index == 0 and chaos_armed[0]:
+        # the seeded chaos: slot 0's FIRST incarnation kills its own
+        # transport after 3 served data frames (heartbeats stop, the
+        # supervisor's missed-heartbeat path must heal it); the respawn
+        # comes back clean
+        chaos_armed[0] = False
+        conf["spark.rapids.tpu.serving.net.faults.plan"] = \
+            "kill_peer:req_type=data,after=3"
+        conf["spark.rapids.tpu.serving.net.faults.seed"] = "7"
+    r = InProcReplica(conf)
+    replicas.append(r)
+    return r
+
+sup = ReplicaSupervisor(TpuConf(FLEET_CONF), spawn=spawn)
+ctl = FleetController(TpuConf(FLEET_CONF), sup)
+client = QueryServiceClient(registry_dir=REG, conf=TpuConf({
+    "spark.rapids.tpu.shuffle.maxRetries": "0",
+    "spark.rapids.tpu.shuffle.connectTimeout": "2",
+    "spark.rapids.tpu.serving.overload.clientRetries": "0",
+    "spark.rapids.tpu.serving.health.probeIntervalSeconds": "0"}))
+
+ref_sess = TpuSession({"spark.rapids.tpu.sql."
+                       "variableFloatAgg.enabled": "true"})
+(ref_sess.create_dataframe(TABLE).repartition(3)
+ .createOrReplaceTempView("t"))
+REF = ref_sess.sql(SQL).collect()
+
+m0 = {k: um.SERVING_METRICS[k].value
+      for k in (um.SERVING_RESTARTS, um.SERVING_SCALE_UPS,
+                um.SERVING_SCALE_DOWNS, um.SERVING_SHEDS)}
+hard_errors = []            # anything but a structured retryable shed
+shed_hints = []
+completed = [0]
+count_lock = threading.Lock()
+
+def load_worker(n_queries):
+    for _ in range(n_queries):
+        while True:
+            try:
+                got = client.submit(SQL).result()
+                assert got.equals(REF), "wrong result under chaos"
+                with count_lock:
+                    completed[0] += 1
+                break
+            except OverloadedError as e:
+                # backpressure, not an error: the shed carries the hint
+                # the caller honors before resubmitting
+                with count_lock:
+                    shed_hints.append(e.retry_after_s)
+                time.sleep(max(e.retry_after_s, 0.05))
+            except Exception as e:          # noqa: BLE001
+                with count_lock:
+                    hard_errors.append(repr(e))
+                return
+
+try:
+    sup.start(2)
+    workers = [threading.Thread(target=load_worker, args=(5,))
+               for _ in range(10)]
+    for w in workers:
+        w.start()
+    # the control loop runs while the flood is on (and a grace period
+    # after, so the calm fleet walks back down to the floor)
+    deadline = time.time() + 300
+    while any(w.is_alive() for w in workers):
+        assert time.time() < deadline, "load never completed"
+        ctl.tick()
+        time.sleep(0.2)
+    while sup.active_count() > 1 and time.time() < deadline:
+        ctl.tick()
+        time.sleep(0.2)
+    for w in workers:
+        w.join(timeout=60)
+
+    delta = {k: um.SERVING_METRICS[k].value - v for k, v in m0.items()}
+    assert not hard_errors, f"caller-visible errors: {hard_errors[:5]}"
+    assert completed[0] == 50, completed
+    assert delta[um.SERVING_SCALE_UPS] >= 1, delta
+    assert delta[um.SERVING_SCALE_DOWNS] >= 1, delta
+    assert delta[um.SERVING_RESTARTS] >= 1, \
+        f"seeded kill never healed: {delta}"
+    assert delta[um.SERVING_SHEDS] >= 1, delta
+    assert shed_hints and all(h > 0 for h in shed_hints), \
+        "a shed without a retry-after hint"
+    # converged: back at the floor, every slot UP or retired, none
+    # crash-looped, and the registry holds exactly the live fleet
+    assert sup.active_count() == 1, sup.fleet_stats()
+    states = sup.fleet_stats()["states"]
+    assert set(states) <= {"UP", "STOPPED"}, states
+    deadline = time.time() + 10
+    while (len(scan_registry(REG, stale_after_s=0.5)) != 1
+           and time.time() < deadline):
+        time.sleep(0.2)
+    live = scan_registry(REG, stale_after_s=0.5)
+    assert len(live) == 1, f"registry does not match the fleet: {live}"
+    print(f"autoscale chaos ok: {delta}, sheds={len(shed_hints)}, "
+          f"final fleet={states}")
+finally:
+    client.close()
+    ctl.stop()
+    sup.stop(graceful=True)
+PY
+
 echo "== out-of-core tight-budget chaos (1/4 working set + seeded alloc-failure injection) =="
 python - << 'PY'
 from spark_rapids_tpu.api import TpuSession

@@ -227,6 +227,72 @@ finally:
     server_b.shutdown()
 PY
 
+echo "== supervisor smoke (SIGKILL a supervised replica subprocess: restart + re-discovery + query completes) =="
+python - << 'PY'
+import tempfile, time
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.benchmarks.tpch import gen_lineitem
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import (QueryServiceClient,
+                                             WireQueryError)
+from spark_rapids_tpu.serving.lifecycle import OverloadedError
+from spark_rapids_tpu.serving.supervisor import ReplicaSupervisor
+from spark_rapids_tpu.utils import metrics as um
+
+reg = tempfile.mkdtemp(prefix="fleet-reg-")
+CONF = {"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+        "spark.rapids.tpu.serving.net.registryDir": reg,
+        "spark.rapids.tpu.serving.health.heartbeatSeconds": "0.2",
+        "spark.rapids.tpu.serving.health.livenessWindowSeconds": "2",
+        "spark.rapids.tpu.serving.fleet.superviseIntervalSeconds": "0.2",
+        "spark.rapids.tpu.serving.fleet.restartBackoffMs": "100"}
+sup = ReplicaSupervisor(TpuConf(CONF),
+                        server_args=["--tpch-lineitem", "0.002",
+                                     "--partitions", "4"])
+sql = ("SELECT l_orderkey, l_extendedprice FROM lineitem "
+       "WHERE l_discount > 0.05")
+sess = TpuSession({"spark.rapids.tpu.sql.variableFloatAgg.enabled": "true"})
+(sess.create_dataframe(gen_lineitem(scale=0.002, seed=42))
+ .repartition(4).createOrReplaceTempView("lineitem"))
+ref = sess.sql(sql).collect()
+client = QueryServiceClient(registry_dir=reg, conf=TpuConf({
+    "spark.rapids.tpu.shuffle.maxRetries": "0",
+    "spark.rapids.tpu.shuffle.connectTimeout": "2",
+    "spark.rapids.tpu.serving.health.probeIntervalSeconds": "0"}))
+
+def query_until_ok(deadline_s=180):
+    # a pass that races replica startup/discovery retries — but the
+    # terminal result must be the bit-identical scan, never a wrong one
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            assert client.submit(sql).result().equals(ref)
+            return
+        except (WireQueryError, OverloadedError):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+r0 = um.SERVING_METRICS[um.SERVING_RESTARTS].value
+try:
+    sup.start(1)
+    query_until_ok()
+    assert sup.fleet_stats()["slots"][0]["state"] == "UP"
+    # SIGKILL the replica's OS process: death by exit, no shutdown hooks
+    sup._slots[0].proc.proc.kill()
+    deadline = time.time() + 60
+    while um.SERVING_METRICS[um.SERVING_RESTARTS].value - r0 < 1:
+        assert time.time() < deadline, "supervisor never restarted"
+        time.sleep(0.2)
+    query_until_ok()                # re-discovery + correct result
+    slot = sup.fleet_stats()["slots"][0]
+    assert slot["state"] in ("UP", "STARTING") and slot["restarts"] == 1, slot
+    print("supervisor smoke ok:", sup.fleet_stats()["states"])
+finally:
+    client.close()
+    sup.stop()
+PY
+
 echo "== recompute smoke (2-peer cluster, seeded mid-reduce kill_peer, lineage-scoped stage recompute, bit-identical) =="
 python - << 'PY'
 import pyarrow as pa

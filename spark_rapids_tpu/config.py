@@ -885,6 +885,159 @@ SERVING_ROUTING_POLICY = _conf(
                        f"'roundrobin', got {v!r}"))
 
 # --------------------------------------------------------------------------------------
+# Serving: elastic fleet (supervisor + autoscaler) and overload shedding
+# --------------------------------------------------------------------------------------
+
+SERVING_FLEET_MIN_REPLICAS = _conf(
+    "serving.fleet.minReplicas", int, 1,
+    "Lower bound on supervised replica slots: the autoscaler never "
+    "scales the fleet below this many (DEGRADED crash-looping slots "
+    "still count toward the bound — the controller cannot drain its "
+    "way to an empty fleet).",
+    checker=_positive("serving.fleet.minReplicas"))
+
+SERVING_FLEET_MAX_REPLICAS = _conf(
+    "serving.fleet.maxReplicas", int, 4,
+    "Upper bound on supervised replica slots: scale-up stops here no "
+    "matter the pressure — past it the front door sheds "
+    "(serving.maxQueuedPerTenant / OverloadedError) instead of growing.",
+    checker=_positive("serving.fleet.maxReplicas"))
+
+SERVING_FLEET_SUPERVISE_INTERVAL = _conf(
+    "serving.fleet.superviseIntervalSeconds", float, 0.2,
+    "Supervisor sweep period: each tick polls every slot's process for "
+    "exit, checks registry heartbeats against the liveness window, and "
+    "restarts due slots on the deterministic backoff schedule.",
+    checker=_positive("serving.fleet.superviseIntervalSeconds"))
+
+SERVING_FLEET_RESTART_BACKOFF_MS = _conf(
+    "serving.fleet.restartBackoffMs", int, 200,
+    "Base delay before restarting a dead replica slot; successive "
+    "deaths of the same slot back off exponentially with deterministic "
+    "jitter (the shuffle/retry.py schedule, keyed by slot index), and "
+    "the attempt counter resets after "
+    "serving.fleet.stableUptimeSeconds of healthy uptime.",
+    checker=_positive("serving.fleet.restartBackoffMs"))
+
+SERVING_FLEET_STABLE_UPTIME = _conf(
+    "serving.fleet.stableUptimeSeconds", float, 30.0,
+    "A replica that stays up this long is considered stable: its slot's "
+    "restart-backoff attempt counter resets, so the next (unrelated) "
+    "death restarts fast instead of inheriting an old slow schedule.",
+    checker=_positive("serving.fleet.stableUptimeSeconds"))
+
+SERVING_FLEET_CRASH_LOOP_THRESHOLD = _conf(
+    "serving.fleet.crashLoopThreshold", int, 3,
+    "Crash-loop breaker: this many deaths of one slot within "
+    "serving.fleet.crashLoopWindowSeconds stops the restart storm — the "
+    "slot is marked DEGRADED (no further restarts, surfaced in fleet "
+    "stats and excluded from the autoscaler's healthy count) instead of "
+    "burning CPU forever. reset_slot() re-arms it after the operator "
+    "fixes the cause.",
+    checker=_positive("serving.fleet.crashLoopThreshold"))
+
+SERVING_FLEET_CRASH_LOOP_WINDOW = _conf(
+    "serving.fleet.crashLoopWindowSeconds", float, 10.0,
+    "Sliding window the crash-loop breaker counts slot deaths over: "
+    "deaths older than this no longer count toward the threshold.",
+    checker=_positive("serving.fleet.crashLoopWindowSeconds"))
+
+SERVING_FLEET_CONTROL_INTERVAL = _conf(
+    "serving.fleet.controlIntervalSeconds", float, 1.0,
+    "Autoscaler control-loop period: each tick aggregates serve.health "
+    "snapshots across the fleet and makes one scaling decision "
+    "(watermarks + hysteresis + cooldowns).",
+    checker=_positive("serving.fleet.controlIntervalSeconds"))
+
+SERVING_FLEET_SCALE_UP_WATERMARK = _conf(
+    "serving.fleet.scaleUpWatermark", float, 0.8,
+    "High watermark on the fleet pressure signal (max of normalized "
+    "admission queue depth and device-budget fraction across healthy "
+    "replicas): pressure at or above this for "
+    "serving.fleet.scaleUpStableTicks consecutive ticks requests one "
+    "more replica (bounded by maxReplicas and the up-cooldown).",
+    checker=_fraction("serving.fleet.scaleUpWatermark"))
+
+SERVING_FLEET_SCALE_DOWN_WATERMARK = _conf(
+    "serving.fleet.scaleDownWatermark", float, 0.25,
+    "Low watermark on the fleet pressure signal: pressure at or below "
+    "this for serving.fleet.scaleDownStableTicks consecutive ticks "
+    "retires one replica through the graceful-drain path (bounded by "
+    "minReplicas and the down-cooldown). Keep it well under the high "
+    "watermark — the dead band between them is the hysteresis that "
+    "stops flapping.",
+    checker=_fraction("serving.fleet.scaleDownWatermark"))
+
+SERVING_FLEET_SCALE_UP_STABLE_TICKS = _conf(
+    "serving.fleet.scaleUpStableTicks", int, 2,
+    "Consecutive control ticks the pressure must hold at/above the high "
+    "watermark before a scale-up fires (a one-tick spike is noise, not "
+    "a trend).", checker=_positive("serving.fleet.scaleUpStableTicks"))
+
+SERVING_FLEET_SCALE_DOWN_STABLE_TICKS = _conf(
+    "serving.fleet.scaleDownStableTicks", int, 5,
+    "Consecutive control ticks the pressure must hold at/below the low "
+    "watermark before a scale-down fires — longer than the up "
+    "requirement on purpose: growing late queues work, shrinking early "
+    "sheds it.", checker=_positive("serving.fleet.scaleDownStableTicks"))
+
+SERVING_FLEET_SCALE_UP_COOLDOWN = _conf(
+    "serving.fleet.scaleUpCooldownSeconds", float, 5.0,
+    "Minimum wall time between two scale-ups: a freshly started replica "
+    "needs time to register and absorb load before the controller may "
+    "conclude the fleet is still too small.",
+    checker=_non_negative("serving.fleet.scaleUpCooldownSeconds"))
+
+SERVING_FLEET_SCALE_DOWN_COOLDOWN = _conf(
+    "serving.fleet.scaleDownCooldownSeconds", float, 30.0,
+    "Minimum wall time between two scale-downs, and after any scale-up "
+    "before the first scale-down — the asymmetry (longer than the up "
+    "cooldown) biases the fleet toward capacity under oscillating load.",
+    checker=_non_negative("serving.fleet.scaleDownCooldownSeconds"))
+
+SERVING_FLEET_P99_OBJECTIVE = _conf(
+    "serving.fleet.p99ObjectiveSeconds", float, 0.0,
+    "Latency objective the autoscaler folds into fleet pressure: a "
+    "replica's rolling-window p99 query wall divided by this objective "
+    "becomes a pressure component alongside footprint and queue depth, "
+    "so a fleet that is slow (not just full) still scales up. 0 "
+    "disables the latency component.",
+    checker=_non_negative("serving.fleet.p99ObjectiveSeconds"))
+
+SERVING_MAX_QUEUED_PER_TENANT = _conf(
+    "serving.maxQueuedPerTenant", int, 256,
+    "Bound on one tenant's scheduler queue depth: a submission past it "
+    "is shed at the front door with a structured RETRYABLE "
+    "OverloadedError carrying a retry-after hint (counted in "
+    "serving.sheds) instead of queueing without limit — one flooding "
+    "tenant cannot OOM the scheduler. 0 disables the bound.",
+    checker=_non_negative("serving.maxQueuedPerTenant"))
+
+SERVING_QUOTA_MAX_PER_CLIENT = _conf(
+    "serving.quota.maxConcurrentPerClient", int, 0,
+    "Per-client concurrent-query quota at the serving wire: a client "
+    "(wire peer) with this many open queries on a replica gets further "
+    "submits rejected with a structured RETRYABLE QuotaExceededError "
+    "(counted in serving.quota_rejections). 0 disables the quota.",
+    checker=_non_negative("serving.quota.maxConcurrentPerClient"))
+
+SERVING_OVERLOAD_RETRY_AFTER = _conf(
+    "serving.overload.retryAfterSeconds", float, 0.25,
+    "Base retry-after hint shipped inside OverloadedError / "
+    "QuotaExceededError rejections; the server scales it with how far "
+    "past the bound the tenant's queue is, and the client honors the "
+    "hint (floored by its deterministic backoff schedule) before "
+    "retrying.", checker=_positive("serving.overload.retryAfterSeconds"))
+
+SERVING_OVERLOAD_CLIENT_RETRIES = _conf(
+    "serving.overload.clientRetries", int, 2,
+    "How many full rotation passes the client retries a submission that "
+    "EVERY replica shed (each pass sleeps the max of the replicas' "
+    "retry-after hints and the deterministic backoff for that attempt) "
+    "before surfacing the OverloadedError to the caller.",
+    checker=_non_negative("serving.overload.clientRetries"))
+
+# --------------------------------------------------------------------------------------
 # Observability (SQLMetrics / NVTX analog)
 # --------------------------------------------------------------------------------------
 METRICS_ENABLED = _conf(
@@ -928,6 +1081,25 @@ SERVING_STATS_WINDOW = _conf(
     "this are dropped; p50/p99 query wall is computed over the window. "
     "The feed load-aware replica routing consumes (ROADMAP item 4).",
     checker=_positive("serving.stats.windowSeconds"))
+
+SERVING_STATS_SAMPLE_INTERVAL = _conf(
+    "serving.stats.sampleIntervalSeconds", float, 1.0,
+    "Period of the scheduler's background gauge-sampler tick: before it, "
+    "gauges were sampled only at terminal queries and stats requests, so "
+    "an idle or wedged replica reported a stale time-series exactly when "
+    "the autoscaler most needed truth. The daemon tick keeps the series "
+    "fresh and snapshot() stamps its age (age_s) so consumers can treat "
+    "a stalled sampler as unhealthy. 0 disables the tick (tests).",
+    checker=_non_negative("serving.stats.sampleIntervalSeconds"))
+
+SERVING_STATS_STALE_AFTER = _conf(
+    "serving.stats.staleAfterSeconds", float, 10.0,
+    "Snapshot age (serve_stats age_s — seconds since the last sampler "
+    "tick) past which the autoscaler treats a replica's stats as stale: "
+    "a stale replica is excluded from the pressure signal AND from the "
+    "healthy count, so a wedged replica flat-lining its gauges cannot "
+    "read as idle and trigger a scale-down.",
+    checker=_positive("serving.stats.staleAfterSeconds"))
 
 
 class TpuConf:

@@ -25,14 +25,27 @@ result):
   discovery through the shuffle registry-dir rendezvous (heartbeat
   mtime, stale-entry GC), and the load-aware routing score; together
   with stream-resume failover and graceful drain, replica death becomes
-  a recoverable, observable event instead of a client-visible error.
+  a recoverable, observable event instead of a client-visible error;
+- ``supervisor`` / ``controller``: the elastic self-healing fleet —
+  replica slots supervised with deterministic restart backoff and a
+  crash-loop breaker, plus the autoscaling control loop (pure decision
+  core over serve.health pressure with hysteresis and cooldowns) whose
+  scale-down routes through the graceful-drain path; overload sheds at
+  the front door as structured retryable OverloadedError rejections
+  carrying a retry-after hint.
 """
 from spark_rapids_tpu.serving.admission import FootprintAdmission
+from spark_rapids_tpu.serving.controller import (ControllerState, Decision,
+                                                 FleetController,
+                                                 ReplicaSnapshot,
+                                                 ScalingPolicy, decide)
 from spark_rapids_tpu.serving.health import (CircuitBreaker, ReplicaState,
                                              routing_score)
-from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
+from spark_rapids_tpu.serving.lifecycle import (OverloadedError,
+                                                QueryCancelledError,
                                                 QueryHandle, QueryState,
                                                 QueryTimeoutError,
+                                                QuotaExceededError,
                                                 ResultStream,
                                                 SchedulerDrainingError,
                                                 current_query)
@@ -40,11 +53,15 @@ from spark_rapids_tpu.serving.program_cache import (ProgramCache,
                                                     global_program_cache,
                                                     plan_key)
 from spark_rapids_tpu.serving.scheduler import SessionScheduler
+from spark_rapids_tpu.serving.supervisor import (ReplicaSupervisor, SlotState)
 
 __all__ = [
-    "CircuitBreaker", "FootprintAdmission", "ProgramCache",
+    "CircuitBreaker", "ControllerState", "Decision", "FleetController",
+    "FootprintAdmission", "OverloadedError", "ProgramCache",
     "QueryCancelledError", "QueryHandle", "QueryState", "QueryTimeoutError",
-    "ReplicaState", "ResultStream", "SchedulerDrainingError",
-    "SessionScheduler", "current_query", "global_program_cache", "plan_key",
+    "QuotaExceededError", "ReplicaSnapshot", "ReplicaState",
+    "ReplicaSupervisor", "ResultStream", "ScalingPolicy",
+    "SchedulerDrainingError", "SessionScheduler", "SlotState",
+    "current_query", "decide", "global_program_cache", "plan_key",
     "routing_score",
 ]

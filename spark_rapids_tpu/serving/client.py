@@ -50,6 +50,8 @@ from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.serving import wire
 from spark_rapids_tpu.serving.health import (CircuitBreaker, ReplicaState,
                                              routing_score)
+from spark_rapids_tpu.serving.lifecycle import (OverloadedError,
+                                                QuotaExceededError)
 from spark_rapids_tpu.shuffle import retry
 from spark_rapids_tpu.shuffle.codec import ChecksumError, verify_checksum
 from spark_rapids_tpu.shuffle.tcp import scan_registry
@@ -134,6 +136,10 @@ class RemoteQueryHandle:
         #: from (the new replica skips frames with seq <= this)
         self._last_seq = -1
         self._ack = -1
+        #: True once THIS handle asked the server to cancel — separates
+        #: a requested cancellation (terminal) from a server-side
+        #: peer-lost/shutdown cancellation (replica loss: may fail over)
+        self._cancel_sent = False
 
     # ---- streaming ---------------------------------------------------------
     def batches(self):
@@ -193,13 +199,21 @@ class RemoteQueryHandle:
                 return
             if nr.kind == wire.NEXT_ERROR:
                 # the QUERY failed server-side — rerunning it on another
-                # replica would fail identically, so never retryable; the
+                # replica would fail identically, so not retryable; the
                 # decoded cause's taxonomy code rides along so callers
                 # can classify (cancellation vs permanent) without
-                # string-sniffing
+                # string-sniffing. One carve-out: a cancellation THIS
+                # handle never requested is the replica's peer-lost /
+                # shutdown cleanup racing the stream (the socket lived
+                # just long enough to deliver the error) — that is
+                # replica loss, not query failure, so it may fail over
                 decoded = _decode_wire_error(nr.error)
-                err = WireQueryError(str(decoded), self.batches_delivered)
-                err.wire_code = getattr(decoded, "wire_code", "OPAQUE")
+                code = getattr(decoded, "wire_code", "OPAQUE")
+                err = WireQueryError(
+                    str(decoded), self.batches_delivered,
+                    retryable=(code == "QUERY_CANCELLED"
+                               and not self._cancel_sent))
+                err.wire_code = code
                 raise err
             table = self._fetch(nr)
             self.batches_delivered += 1
@@ -212,7 +226,10 @@ class RemoteQueryHandle:
     @triage_boundary
     def _maybe_failover(self, err: WireQueryError) -> bool:
         """Resubmit to a healthy replica with ``resume_from=last seq
-        delivered``; True when the stream may continue on a new conn."""
+        delivered``; True when the stream may continue on a new conn,
+        False when the original error should surface. Raises the
+        structured rejection instead when the resubmission was shed or
+        quota-bounced (retryable with a hint — not a dead fleet)."""
         c = self._client
         if not (err.retryable and self.idempotent and c.failover_enabled):
             return False
@@ -226,8 +243,18 @@ class RemoteQueryHandle:
             addr, conn, qid = c._submit_routed(
                 self.sql, self.tenant, self.timeout_s, self.label,
                 resume_from=self._last_seq, exclude={failed})
+        except (OverloadedError, QuotaExceededError):
+            # the failover resubmission was rejected at the front door:
+            # the fleet is alive, just saturated (or the caller's quota
+            # is burned). Surface the structured retryable rejection
+            # WITH its retry-after hint — not the stale stream error —
+            # so a displaced query rides the caller's normal overload
+            # retry loop like any other resubmission
+            raise
         except WireQueryError:
-            return False                # no healthy replica: surface err
+            # no healthy replica took it: surface the ORIGINAL stream
+            # error with its batches_delivered count
+            return False
         self.failovers += 1
         um.SERVING_METRICS[um.SERVING_FAILOVERS].add(1)
         self.replica, self._conn, self.query_id = addr, conn, qid
@@ -320,6 +347,7 @@ class RemoteQueryHandle:
         return wire.ipc_to_table(self._schema_ipc)
 
     def cancel(self) -> None:
+        self._cancel_sent = True
         self._client._rpc(self._conn, wire.REQ_CANCEL,
                           wire.CancelRequest(self.query_id).to_bytes(),
                           delivered=self.batches_delivered)
@@ -349,6 +377,11 @@ class QueryServiceClient:
         self.failover_enabled = self.conf.get(cfg.SERVING_FAILOVER_ENABLED)
         self.failover_max_attempts = self.conf.get(
             cfg.SERVING_FAILOVER_MAX_ATTEMPTS)
+        #: extra rotation passes when EVERY replica shed the submission
+        #: (OverloadedError): each pass honors the shed retry-after hint,
+        #: floored by the deterministic backoff for that attempt
+        self.overload_retries = self.conf.get(
+            cfg.SERVING_OVERLOAD_CLIENT_RETRIES)
         self.routing_policy = self.conf.get(cfg.SERVING_ROUTING_POLICY)
         self.probe_interval = self.conf.get(cfg.SERVING_HEALTH_PROBE_INTERVAL)
         self.probe_timeout = self.conf.get(cfg.SERVING_HEALTH_PROBE_TIMEOUT)
@@ -571,13 +604,45 @@ class QueryServiceClient:
         """Route one submission, rerouting around dead and DRAINING
         replicas; returns ``(addr, conn, query_id)``. Pinned submissions
         (``replica=``) never reroute — tests rely on the pin being
-        absolute."""
+        absolute. When EVERY routable replica SHEDS the submission
+        (structured OverloadedError), the rotation retries up to
+        ``serving.overload.clientRetries`` more passes, sleeping the shed
+        retry-after hint (floored by the deterministic backoff for the
+        attempt) between passes, then surfaces the OverloadedError."""
         req = wire.SubmitRequest(sql, tenant, timeout, label,
                                  resume_from).to_bytes()
-        exclude = set(exclude)
+        shed: Optional[OverloadedError] = None
+        for attempt in range(self.overload_retries + 1):
+            if shed is not None:
+                # every replica shed last pass: honor the server's hint —
+                # the whole point of retry-after is that the SERVER knows
+                # its drain rate — but never sleep less than the seeded
+                # backoff schedule for this attempt (thundering-herd
+                # hygiene when many clients got the same hint)
+                hint = getattr(shed, "retry_after_s", 0.0) or 0.0
+                floor_s = retry.backoff_ms(
+                    attempt - 1, self.backoff_ms, self.retry_seed,
+                    key=f"serve-overload:{label or sql[:48]}") / 1e3
+                time.sleep(max(hint, floor_s))
+            try:
+                return self._submit_pass(req, replica, set(exclude))
+            except OverloadedError as e:
+                shed = e
+                if replica is not None:
+                    raise               # pinned: the pin is the contract
+        raise shed
+
+    def _submit_pass(self, req: bytes, replica: Optional[int], exclude):
+        """One rotation pass over the routable replicas. Raises
+        OverloadedError when nobody accepted and at least one replica
+        shed (the caller's retry-after loop owns that — a shed is a live
+        replica that will take the query later); QuotaExceededError
+        surfaces immediately — the quota is per CLIENT, so shopping the
+        submission to another replica just burns its quota there too."""
         with self._lock:
             bound = len(self._replicas) + 1
         last_err: Optional[WireQueryError] = None
+        last_shed: Optional[OverloadedError] = None
         for _ in range(max(2, bound)):
             if replica is not None:
                 addr = self._route(replica)
@@ -585,8 +650,13 @@ class QueryServiceClient:
                 try:
                     addr = self._pick(exclude)
                 except WireQueryError:
-                    # routing exhausted: surface the LAST submission error
-                    # (the root cause) over the generic no-replica one
+                    # routing exhausted: a shed outranks everything — it
+                    # proves a LIVE replica that will take the query
+                    # later, and it carries the actionable retry-after
+                    # hint; a dial/submission error outranks only the
+                    # generic no-replica error
+                    if last_shed is not None:
+                        raise last_shed
                     if last_err is not None:
                         raise last_err
                     raise
@@ -613,8 +683,26 @@ class QueryServiceClient:
                 last_err = err
                 continue
             if st is not None:
+                # any structured answer — accept, shed or quota — is a
+                # LIVE replica: the breaker tracks reachability, not load
                 st.breaker.record_success()
+            if resp.error_json:
+                decoded = _decode_wire_error(resp.error_json)
+                if isinstance(decoded, QuotaExceededError):
+                    raise decoded
+                if isinstance(decoded, OverloadedError):
+                    if replica is not None:
+                        raise decoded
+                    exclude.add(addr)
+                    last_shed = decoded
+                    continue
+                raise decoded           # unknown structured rejection
             return addr, conn, resp.query_id
+        # a shed outranks a dead-replica error: mixed passes (one replica
+        # down, another at its bound) surface the structured retryable
+        # signal with its hint, not the opaque dial failure
+        if last_shed is not None:
+            raise last_shed
         raise last_err or WireQueryError(
             "no replica accepted the submission")
 

@@ -68,6 +68,34 @@ class SchedulerDrainingError(RuntimeError):
     zero caller-visible errors during a drain)."""
 
 
+class OverloadedError(RuntimeError):
+    """Submission shed at the front door: the tenant's scheduler queue is
+    at its bound (``serving.maxQueuedPerTenant``) — the replica refuses
+    to queue more rather than grow without limit. RETRYABLE by taxonomy;
+    ``retry_after_s`` is the server's hint for when capacity is likely
+    back (scaled with queue depth), which the routing client honors on
+    its deterministic backoff before retrying the rotation. Load sheds
+    BEFORE it queues, never mid-query: admitted queries are unaffected."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s or 0.0)
+
+
+class QuotaExceededError(RuntimeError):
+    """Submission rejected by the per-client concurrent-query quota
+    (``serving.quota.maxConcurrentPerClient``): this wire peer already
+    has its full allowance of open queries on the replica. RETRYABLE —
+    the client's own queries finishing is what frees quota — but NOT
+    reroutable: the quota is per client, so the client surfaces it to
+    the caller (after honoring ``retry_after_s``) instead of shopping
+    the submission to a peer replica."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s or 0.0)
+
+
 _QUERY_IDS = itertools.count(1)
 
 

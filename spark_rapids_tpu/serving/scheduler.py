@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional
 
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.serving.admission import FootprintAdmission
-from spark_rapids_tpu.serving.lifecycle import (QueryCancelledError,
+from spark_rapids_tpu.serving.lifecycle import (OverloadedError,
+                                                QueryCancelledError,
                                                 QueryHandle,
                                                 QueryTimeoutError,
                                                 ResultStream,
@@ -112,6 +113,18 @@ class SessionScheduler:
         self._preempt_starve_s = (
             conf.get(cfg.SERVING_PREEMPT_STARVATION_MS) / 1e3)
         self._preempt_park = conf.get(cfg.SERVING_PREEMPT_PARK)
+        #: front-door overload shed: one tenant's queue never grows past
+        #: this bound — the submission is rejected with the RETRYABLE
+        #: OverloadedError instead (0 disables)
+        self._max_queued_per_tenant = conf.get(
+            cfg.SERVING_MAX_QUEUED_PER_TENANT)
+        self._retry_after_base = conf.get(cfg.SERVING_OVERLOAD_RETRY_AFTER)
+        #: background gauge-sampler tick (started lazily beside the worker
+        #: pool): keeps the serve.stats series fresh on an idle replica so
+        #: snapshot age reads sampler liveness, not traffic
+        self._sample_interval = conf.get(cfg.SERVING_STATS_SAMPLE_INTERVAL)
+        self._sampler_stop = threading.Event()
+        self._sampler: Optional[threading.Thread] = None
         self._push_weights_to_semaphore()
 
     # ---- configuration -----------------------------------------------------
@@ -158,6 +171,7 @@ class SessionScheduler:
         handle.preemptible = self._preempt_enabled
         handle.preempt_starvation_s = self._preempt_starve_s
         handle.preempt_park_spillable = self._preempt_park
+        shed_depth = None
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
@@ -166,29 +180,57 @@ class SessionScheduler:
                     "scheduler is draining: running queries finish, new "
                     "submissions must route to another replica")
             q = self._queues.get(tenant)
-            if not q:
-                # deficit-round-robin activation reset (utils/fair_share
-                # .py): a late joiner cannot monopolize the workers, and a
-                # returning tenant is not starved by its own history
-                activation_reset(tenant,
-                                 (t for t, w in self._queues.items() if w),
-                                 self._served, self._weights)
-            self._queues.setdefault(tenant, deque()).append(handle)
-            self._handles.append(handle)
-            if len(self._handles) > _HANDLE_HISTORY:
-                keep = []
-                excess = len(self._handles) - _HANDLE_HISTORY
-                for h in self._handles:
-                    if excess > 0 and h.state.is_terminal:
-                        self._pruned_states[h.state.value] = \
-                            self._pruned_states.get(h.state.value, 0) + 1
-                        excess -= 1
-                    else:
-                        keep.append(h)
-                self._handles = keep
-            self._ensure_workers_locked()
+            if (self._max_queued_per_tenant
+                    and q is not None
+                    and len(q) >= self._max_queued_per_tenant):
+                # front-door shed: the bound holds BEFORE the handle would
+                # queue, so admitted/running queries are untouched and the
+                # scheduler's memory stays bounded under a flooding tenant
+                shed_depth = len(q)
+            if shed_depth is None:
+                if not q:
+                    # deficit-round-robin activation reset (utils/
+                    # fair_share.py): a late joiner cannot monopolize the
+                    # workers, and a returning tenant is not starved by
+                    # its own history
+                    activation_reset(tenant,
+                                     (t for t, w in self._queues.items()
+                                      if w),
+                                     self._served, self._weights)
+                self._queues.setdefault(tenant, deque()).append(handle)
+                self._handles.append(handle)
+                if len(self._handles) > _HANDLE_HISTORY:
+                    keep = []
+                    excess = len(self._handles) - _HANDLE_HISTORY
+                    for h in self._handles:
+                        if excess > 0 and h.state.is_terminal:
+                            self._pruned_states[h.state.value] = \
+                                self._pruned_states.get(h.state.value, 0) + 1
+                            excess -= 1
+                        else:
+                            keep.append(h)
+                    self._handles = keep
+                self._ensure_workers_locked()
+            self._ensure_sampler_locked()
             self._cv.notify_all()
+        if shed_depth is not None:
+            from spark_rapids_tpu.utils import metrics as um
+            um.SERVING_METRICS[um.SERVING_SHEDS].add(1)
+            raise OverloadedError(
+                f"tenant {tenant!r} queue at its bound "
+                f"({shed_depth}/{self._max_queued_per_tenant}): submission "
+                f"shed, retry after the hint",
+                retry_after_s=self.shed_retry_after(shed_depth))
         return handle
+
+    def shed_retry_after(self, depth: int) -> float:
+        """Retry-after hint for a shed submission: the base conf hint
+        scaled with how deep the tenant's queue is relative to the worker
+        pool — a deeper backlog drains slower, so the hint grows with it
+        (deterministic: no jitter here, the CLIENT adds its seeded
+        backoff)."""
+        scale = 1.0 + depth / max(1, self.max_concurrent)
+        return round(self._retry_after_base * scale, 4)
 
     def _ensure_workers_locked(self) -> None:
         while len(self._workers) < self.max_concurrent:
@@ -196,6 +238,33 @@ class SessionScheduler:
                                  name=f"serving-worker-{len(self._workers)}")
             self._workers.append(t)
             t.start()
+
+    def _ensure_sampler_locked(self) -> None:
+        """Start the periodic gauge-sampler daemon (once; caller holds
+        the cv). Before this tick existed, gauges were sampled only at
+        terminal queries and stats requests — an idle or wedged replica
+        reported a stale series exactly when the autoscaler most needed
+        truth. The tick keeps the series (and its age_s stamp) honest."""
+        if (self._sampler is not None or self._shutdown
+                or not self._sample_interval):
+            return
+        t = threading.Thread(target=self._sampler_loop, daemon=True,
+                             name="serving-stats-sampler")
+        self._sampler = t
+        t.start()
+
+    def start_stats_sampler(self) -> None:
+        """Public start hook (the wire server calls it at startup so a
+        replica reports a fresh series before its first query)."""
+        with self._cv:
+            self._ensure_sampler_locked()
+
+    def _sampler_loop(self) -> None:
+        # Event.wait is the bounded sleep (R010); no scheduler lock is
+        # held anywhere in the loop — sample() takes the cv only inside
+        # its gauge read (R006)
+        while not self._sampler_stop.wait(self._sample_interval):
+            self.serve_stats.sample(self)
 
     # ---- fair-share pick ---------------------------------------------------
     def _next_locked(self) -> Optional[QueryHandle]:
@@ -394,6 +463,7 @@ class SessionScheduler:
         """Stop accepting work; cancel queued queries; optionally wait for
         running ones (cancellation stays cooperative — running queries
         finish or observe their cancel flag at the next checkpoint)."""
+        self._sampler_stop.set()
         with self._cv:
             self._shutdown = True
             queued = [h for q in self._queues.values() for h in q]

@@ -45,13 +45,15 @@ from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.serving import wire
-from spark_rapids_tpu.serving.lifecycle import (ResultStream,
+from spark_rapids_tpu.serving.lifecycle import (OverloadedError,
+                                                QuotaExceededError,
+                                                ResultStream,
                                                 SchedulerDrainingError)
 from spark_rapids_tpu.shuffle.codec import checksum_of
 from spark_rapids_tpu.shuffle.transport import AddressLengthTag
 from spark_rapids_tpu.utils import metrics as um
 from spark_rapids_tpu.utils import tracing as _tracing
-from spark_rapids_tpu.utils.errors import encode_error
+from spark_rapids_tpu.utils.errors import encode_error, wire_boundary
 
 
 class _ServedQuery:
@@ -97,6 +99,11 @@ class QueryServer:
         self._poll_s = self.conf.get(cfg.SERVING_NET_POLL_MS) / 1e3
         self._stream_depth = self.conf.get(cfg.SERVING_NET_STREAM_DEPTH)
         self._max_rows = self.conf.get(cfg.SERVING_NET_MAX_STREAM_ROWS)
+        #: per-client concurrent-query quota (0 = unlimited): enforced at
+        #: the wire seam where the peer identity lives — the scheduler
+        #: only knows tenants, and one tenant can span many clients
+        self._quota_max = self.conf.get(cfg.SERVING_QUOTA_MAX_PER_CLIENT)
+        self._retry_after_base = self.conf.get(cfg.SERVING_OVERLOAD_RETRY_AFTER)
         self._lock = threading.Lock()
         self._queries: Dict[int, _ServedQuery] = {}
         #: peers whose connection already died — a serve.submit dispatched
@@ -134,6 +141,10 @@ class QueryServer:
             self._heartbeat_s = self.conf.get(cfg.SERVING_HEALTH_HEARTBEAT)
             threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="serving-heartbeat").start()
+        # start the periodic gauge-sampler now, not at first submit: an
+        # idle replica must report a FRESH serve_stats series (age_s near
+        # the tick interval) or the autoscaler would treat it as unhealthy
+        session.scheduler.start_stats_sampler()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -142,6 +153,18 @@ class QueryServer:
         return (inner or t).address
 
     # ---- handlers (transport worker threads; every wait bounded) -----------
+    @staticmethod
+    def _rejection(exc) -> bytes:
+        """Structured front-door rejection: the taxonomy payload rides the
+        SubmitResponse blob (query_id 0 — no handle exists) because the
+        transport's exception path is a bare string that cannot carry
+        retry_after_s across the wire."""
+        return wire.SubmitResponse(0, error_json=json.dumps(
+            encode_error(exc), default=str).encode()).to_bytes()
+
+    # serializes taxonomy errors (overload shed / quota) into the
+    # SubmitResponse blob — R015's wire seam, like _run_handle_traced
+    @wire_boundary
     def _handle_submit(self, peer: str, payload: bytes) -> bytes:
         if self._draining:
             # retryable redirect: the type name rides the wire error and
@@ -149,11 +172,27 @@ class QueryServer:
             raise SchedulerDrainingError(
                 "replica is draining; resubmit to another replica")
         req = wire.SubmitRequest.from_bytes(payload)
+        if self._quota_max:
+            with self._lock:
+                open_for_peer = sum(1 for sq in self._queries.values()
+                                    if sq.peer == peer)
+            if open_for_peer >= self._quota_max:
+                um.SERVING_METRICS[um.SERVING_QUOTA_REJECTIONS].add(1)
+                return self._rejection(QuotaExceededError(
+                    f"client {peer!r} at its concurrent-query quota "
+                    f"({open_for_peer}/{self._quota_max}); retry after "
+                    f"your own queries finish",
+                    retry_after_s=self._retry_after_base))
         stream = ResultStream(depth=self._stream_depth)
-        handle = self.session.scheduler.submit(
-            req.sql, tenant=req.tenant,
-            timeout=(req.timeout if req.timeout > 0 else None),
-            label=req.label or None, stream=stream)
+        try:
+            handle = self.session.scheduler.submit(
+                req.sql, tenant=req.tenant,
+                timeout=(req.timeout if req.timeout > 0 else None),
+                label=req.label or None, stream=stream)
+        except OverloadedError as e:
+            # shed at the scheduler's per-tenant bound: ship the
+            # structured rejection (code + retry_after_s) to the client
+            return self._rejection(e)
         sq = _ServedQuery(handle, stream, peer, resume_from=req.resume_from)
         with self._lock:
             self._queries[handle.query_id] = sq

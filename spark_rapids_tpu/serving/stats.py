@@ -44,6 +44,10 @@ class ServeStatsWindow:
         self._walls: deque = deque(maxlen=_MAX_WALL_SAMPLES)
         #: gauge sample dicts (see _sample_locked)
         self._samples: deque = deque(maxlen=_MAX_GAUGE_SAMPLES)
+        #: monotonic time of the newest appended sample — snapshot() stamps
+        #: its age so consumers (the autoscaler above all) can tell a fresh
+        #: series from one that flat-lined when the replica wedged
+        self._last_sample_t: Optional[float] = None
 
     # ---- producers ---------------------------------------------------------
     def record_wall(self, wall_s: Optional[float]) -> None:
@@ -59,7 +63,18 @@ class ServeStatsWindow:
         with self._lock:
             self._trim_locked()
             self._samples.append(gauges)
+            self._last_sample_t = gauges["t"]
         return gauges
+
+    def age_s(self) -> Optional[float]:
+        """Seconds since the newest sample was appended; None before the
+        first sample. This is the staleness signal: the periodic sampler
+        tick keeps it near the tick interval on a healthy replica, so a
+        large age means the sampler (and likely the replica) is wedged."""
+        with self._lock:
+            if self._last_sample_t is None:
+                return None
+            return max(0.0, time.monotonic() - self._last_sample_t)
 
     # ---- gauge collection --------------------------------------------------
     @staticmethod
@@ -115,13 +130,23 @@ class ServeStatsWindow:
 
     def snapshot(self, scheduler) -> Dict[str, Any]:
         """The full serve.stats payload: one fresh sample + the rolling
-        series + window latency percentiles."""
+        series + window latency percentiles. ``age_s`` is the staleness of
+        the series BEFORE this call's inline sample — a health RPC always
+        samples fresh on its way out, so the inline sample's own age says
+        nothing about whether the background tick is alive; the pre-call
+        age does."""
+        pre_age = self.age_s()
         now = self.sample(scheduler)
         with self._lock:
             walls = sorted(w for _, w in self._walls)
             series = list(self._samples)
         return {
             "window_s": self.window_s,
+            #: seconds the series had gone without a sample when this
+            #: snapshot was requested (None: no sample ever) — the
+            #: autoscaler treats ages past serving.stats.staleAfterSeconds
+            #: as an unhealthy replica
+            "age_s": (round(pre_age, 3) if pre_age is not None else None),
             "now": now,
             "series": series,
             "wall_samples": len(walls),

@@ -145,13 +145,23 @@ class SubmitRequest:
 @dataclass(frozen=True)
 class SubmitResponse:
     query_id: int
+    #: structured front-door rejection (overload shed / quota): an
+    #: ``encode_error`` JSON payload — query_id 0, no handle was created.
+    #: Empty for accepted submissions. Rides the SUCCESS response path
+    #: because the transport's error path is a bare string (type: message)
+    #: that cannot carry the taxonomy's structured fields (retry_after_s).
+    error_json: bytes = b""
 
     def to_bytes(self) -> bytes:
-        return _U64.pack(self.query_id)
+        return _U64.pack(self.query_id) + _pack_blob(self.error_json)
 
     @staticmethod
     def from_bytes(buf: bytes) -> "SubmitResponse":
-        return SubmitResponse(_U64.unpack_from(buf, 0)[0])
+        qid, = _U64.unpack_from(buf, 0)
+        if len(buf) <= 8:       # pre-elasticity peer: no rejection blob
+            return SubmitResponse(qid)
+        ej, _pos = _unpack_blob(buf, 8)
+        return SubmitResponse(qid, error_json=ej)
 
 
 @dataclass(frozen=True)

@@ -115,6 +115,16 @@ TAXONOMY: Tuple[ErrorSpec, ...] = (
     ErrorSpec("spark_rapids_tpu.serving.lifecycle:SchedulerDrainingError",
               RETRYABLE, "SCHEDULER_DRAINING",
               doc="replica refusing new work; redirect to a peer"),
+    ErrorSpec("spark_rapids_tpu.serving.lifecycle:OverloadedError",
+              RETRYABLE, "OVERLOADED",
+              fields=("retry_after_s",), ctor="message+fields",
+              doc="front-door shed (tenant queue at bound); client honors "
+                  "retry_after_s on its deterministic backoff"),
+    ErrorSpec("spark_rapids_tpu.serving.lifecycle:QuotaExceededError",
+              RETRYABLE, "QUOTA_EXCEEDED",
+              fields=("retry_after_s",), ctor="message+fields",
+              doc="per-client concurrency quota hit; retry after own "
+                  "queries finish — rerouting cannot help"),
     # --- cancellation: must never be retried into life ---------------------
     ErrorSpec("spark_rapids_tpu.serving.lifecycle:QueryCancelledError",
               CANCELLATION, "QUERY_CANCELLED", ladder_signal=True,

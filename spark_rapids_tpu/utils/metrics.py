@@ -131,11 +131,29 @@ SERVING_BREAKER_OPENS = "serving.breaker_opens"
 #: flipped to DRAINING, redirecting new submissions while running
 #: queries finish and streams flush
 SERVING_DRAINS = "serving.drains"
+#: supervised replica restarts (supervisor-side: one per respawn of a dead
+#: slot, after its deterministic backoff elapsed; crash-loop-halted slots
+#: stop counting because they stop restarting)
+SERVING_RESTARTS = "serving.restarts"
+#: autoscaler scale-up decisions that started a new supervised replica
+SERVING_SCALE_UPS = "serving.scale_ups"
+#: autoscaler scale-down decisions that retired a replica through the
+#: graceful-drain path (zero in-flight queries dropped)
+SERVING_SCALE_DOWNS = "serving.scale_downs"
+#: submissions shed at the front door with a structured RETRYABLE
+#: OverloadedError (per-tenant queue bound serving.maxQueuedPerTenant) —
+#: load sheds before it queues, never mid-query
+SERVING_SHEDS = "serving.sheds"
+#: submissions rejected by the per-client concurrent-query quota
+#: (serving.quota.maxConcurrentPerClient) with QuotaExceededError
+SERVING_QUOTA_REJECTIONS = "serving.quota_rejections"
 
 SERVING_METRIC_NAMES = (
     SERVING_WIRE_BYTES_OUT, SERVING_STREAM_BATCHES, SERVING_PREEMPTIONS,
     SERVING_ADMISSION_REJECTIONS, SERVING_WIRE_RETRIES, SERVING_FAILOVERS,
-    SERVING_RESUMED_BATCHES, SERVING_BREAKER_OPENS, SERVING_DRAINS)
+    SERVING_RESUMED_BATCHES, SERVING_BREAKER_OPENS, SERVING_DRAINS,
+    SERVING_RESTARTS, SERVING_SCALE_UPS, SERVING_SCALE_DOWNS,
+    SERVING_SHEDS, SERVING_QUOTA_REJECTIONS)
 
 # Lineage-recompute counters (driver-process-global: the stage driver in
 # parallel/cluster.py owns every bump — executors never recompute on their

@@ -1,0 +1,785 @@
+"""Elastic self-healing fleet: supervisor, autoscaler, overload shedding.
+
+Covers the elasticity contracts (docs/serving.md "Elasticity and
+overload"):
+- supervisor: replica slots restart on death (process exit AND missed
+  registry heartbeats) on the deterministic backoff schedule; the
+  crash-loop breaker provably halts a replica that dies after every
+  start (DEGRADED, surfaced in fleet_stats, re-armed by reset_slot);
+  intentional scale-down drains gracefully and is never counted as a
+  death;
+- autoscaler decision core: watermark crossings scale only after the
+  stability streak, in-band readings reset hysteresis (no flap),
+  cooldowns suppress back-to-back actions, targets clamp to
+  fleet.{min,max}Replicas, DEGRADED/stale/draining replicas are
+  excluded from pressure and capacity;
+- overload shedding: a tenant queue at serving.maxQueuedPerTenant sheds
+  new submissions with a structured RETRYABLE OverloadedError carrying
+  a retry-after hint (at the front door — admitted queries keep
+  completing); the wire client honors the hint on its deterministic
+  backoff; the per-client quota rejects with QuotaExceededError;
+- serve_stats staleness: the background sampler tick keeps ``age_s``
+  fresh; the snapshot stamps the PRE-call age so a dead sampler is
+  visible despite the inline sample;
+- convergence: a killed replica in a supervised registry fleet comes
+  back within the restart-backoff bound and queries complete
+  bit-identically with zero caller-visible errors.
+"""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar.dtypes import DType
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.serving.client import (QueryServiceClient,
+                                             WireQueryError)
+from spark_rapids_tpu.serving.controller import (ControllerState, Decision,
+                                                 FleetController,
+                                                 ReplicaSnapshot,
+                                                 ScalingPolicy, decide,
+                                                 healthy_snapshots,
+                                                 pick_scale_down_target,
+                                                 replica_pressure)
+from spark_rapids_tpu.serving.lifecycle import (OverloadedError,
+                                                QuotaExceededError)
+from spark_rapids_tpu.serving.server import QueryServer
+from spark_rapids_tpu.serving.stats import ServeStatsWindow
+from spark_rapids_tpu.serving.supervisor import (ReplicaSupervisor,
+                                                 SlotState)
+from spark_rapids_tpu.shuffle import retry
+from spark_rapids_tpu.utils import metrics as um
+from spark_rapids_tpu.utils.errors import (RETRYABLE, classification_for,
+                                           decode_error, encode_error,
+                                           is_retryable)
+
+BASE_CONF = {
+    "spark.rapids.tpu.sql.string.maxBytes": "16",
+    "spark.rapids.tpu.sql.variableFloatAgg.enabled": "true",
+}
+
+FILTER_SQL = "SELECT k, v FROM t WHERE v > 0.5"
+
+FAST_DIAL = {
+    "spark.rapids.tpu.shuffle.maxRetries": "0",
+    "spark.rapids.tpu.shuffle.connectTimeout": "2",
+}
+
+
+def make_session(extra=None):
+    return TpuSession({**BASE_CONF, **(extra or {})})
+
+
+def small_df(sess, n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 8, n).astype("int64"),
+        "v": rng.random(n)}))
+
+
+def blocking_udf_df(sess, started, release, n_rows=2):
+    """A DataFrame whose execution signals ``started`` then blocks on
+    ``release`` — the controllable long query the shed tests drive."""
+    def slow(x):
+        started.set()
+        release.wait(20)
+        return x
+
+    df = sess.create_dataframe(pa.table({"a": list(range(n_rows))}))
+    return df.select(F.udf(slow, DType.LONG)(F.col("a")).alias("b"))
+
+
+# ================================================== supervisor (FakeProc)
+
+class FakeProc:
+    """Injectable replica process: the supervisor state machine's unit-
+    test double (poll/terminate/kill/addr, deaths on command)."""
+
+    def __init__(self, addr):
+        self.addr = addr
+        self._rc = None
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self._rc
+
+    def exit(self, rc=1):
+        self._rc = rc
+
+    def terminate(self):
+        self.terminated = True
+        self._rc = 0            # graceful drain finishes instantly
+
+    def kill(self):
+        self.killed = True
+        self._rc = -9
+
+
+SUP_CONF = {
+    # the loop thread must never race the test's manual tick()s
+    "spark.rapids.tpu.serving.fleet.superviseIntervalSeconds": "60",
+    "spark.rapids.tpu.serving.fleet.restartBackoffMs": "1",
+    "spark.rapids.tpu.serving.fleet.crashLoopThreshold": "3",
+    "spark.rapids.tpu.serving.fleet.crashLoopWindowSeconds": "10",
+}
+
+
+def make_supervisor(spawned, extra=None):
+    def spawn(slot_index):
+        p = FakeProc(addr=f"127.0.0.1:{9000 + len(spawned)}")
+        spawned.append(p)
+        return p
+
+    conf = TpuConf({**BASE_CONF, **SUP_CONF, **(extra or {})})
+    return ReplicaSupervisor(conf, spawn=spawn)
+
+
+def tick_until(sup, pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while not pred():
+        assert time.time() < deadline, "supervisor never converged"
+        sup.tick()
+        time.sleep(0.005)
+
+
+def test_supervisor_spawns_fleet_and_restarts_dead_replica():
+    spawned = []
+    sup = make_supervisor(spawned)
+    r0 = um.SERVING_METRICS[um.SERVING_RESTARTS].value
+    try:
+        sup.start(2)
+        assert len(spawned) == 2
+        assert sup.active_count() == 2
+        assert sorted(sup.addresses()) == ["127.0.0.1:9000",
+                                           "127.0.0.1:9001"]
+        stats = sup.fleet_stats()
+        assert stats["states"] == {"UP": 2}
+        # death by exit -> BACKOFF on the retry schedule -> respawn
+        spawned[0].exit(3)
+        sup.tick()
+        assert sup.fleet_stats()["states"].get("BACKOFF") == 1
+        tick_until(sup, lambda: len(spawned) == 3)
+        assert sup.fleet_stats()["states"] == {"UP": 2}
+        assert um.SERVING_METRICS[um.SERVING_RESTARTS].value - r0 == 1
+        # the initial spawns were NOT restarts
+        assert sum(s["restarts"] for s in sup.fleet_stats()["slots"]) == 1
+    finally:
+        sup.stop()
+
+
+def test_restart_backoff_is_deterministic_and_keyed_per_slot():
+    """Two fleets with the same seed restart on IDENTICAL schedules
+    (replayable chaos); distinct slots get distinct schedules."""
+    base, seed = 200, 7
+    a = [retry.backoff_ms(i, base, seed, key="supervisor:slot0")
+         for i in range(4)]
+    b = [retry.backoff_ms(i, base, seed, key="supervisor:slot0")
+         for i in range(4)]
+    other = [retry.backoff_ms(i, base, seed, key="supervisor:slot1")
+             for i in range(4)]
+    assert a == b
+    assert a != other
+    # and the supervisor schedules its respawn on exactly that delay
+    spawned = []
+    sup = make_supervisor(spawned, {
+        "spark.rapids.tpu.serving.fleet.restartBackoffMs": str(base),
+        "spark.rapids.tpu.serving.net.faults.seed": str(seed)})
+    try:
+        sup.start(1)
+        spawned[0].exit(1)
+        t0 = time.monotonic()
+        sup.tick()
+        slot = sup.fleet_stats()["slots"][0]
+        assert slot["state"] == "BACKOFF" and slot["attempt"] == 1
+        expected = retry.backoff_ms(0, base, seed,
+                                    key="supervisor:slot0") / 1e3
+        with sup._lock:
+            delay = sup._slots[0].not_before - t0
+        assert abs(delay - expected) < 0.1
+    finally:
+        sup.stop()
+
+
+def test_crash_loop_breaker_halts_replica_that_always_dies():
+    """The acceptance bound: a replica dying immediately after EVERY
+    start stops being restarted after exactly crashLoopThreshold deaths
+    — DEGRADED, surfaced, and excluded from capacity."""
+    spawned = []
+
+    def doomed_spawn(slot_index):
+        p = FakeProc(addr=f"127.0.0.1:{9100 + len(spawned)}")
+        p.exit(1)               # dies before the first supervision pass
+        spawned.append(p)
+        return p
+
+    conf = TpuConf({**BASE_CONF, **SUP_CONF})
+    sup = ReplicaSupervisor(conf, spawn=doomed_spawn)
+    try:
+        sup.start(1)
+        tick_until(sup, lambda: sup.degraded_count() == 1)
+        assert len(spawned) == 3        # threshold deaths, then silence
+        n = len(spawned)
+        for _ in range(20):
+            sup.tick()
+        assert len(spawned) == n, "DEGRADED slot must not respawn"
+        stats = sup.fleet_stats()
+        assert stats["degraded"] == 1 and stats["active"] == 0
+        slot = stats["slots"][0]
+        assert slot["state"] == "DEGRADED" and slot["recent_deaths"] >= 3
+        # reset_slot re-arms the breaker once the cause is fixed
+        assert sup.reset_slot(0)
+        tick_until(sup, lambda: len(spawned) == n + 1)
+        assert not sup.reset_slot(99)   # unknown slot: no-op
+    finally:
+        sup.stop()
+
+
+def test_scale_down_drains_gracefully_and_is_not_a_death():
+    spawned = []
+    sup = make_supervisor(spawned)
+    r0 = um.SERVING_METRICS[um.SERVING_RESTARTS].value
+    try:
+        sup.start(2)
+        idx = sup.scale_down()          # newest active slot
+        assert idx == 1
+        assert spawned[1].terminated and not spawned[1].killed
+        sup.tick()
+        stats = sup.fleet_stats()
+        assert stats["states"] == {"UP": 1, "STOPPED": 1}
+        assert sup.active_count() == 1
+        # an intentional stop is not a death: no restart, no breaker hit
+        for _ in range(5):
+            sup.tick()
+        assert len(spawned) == 2
+        assert um.SERVING_METRICS[um.SERVING_RESTARTS].value == r0
+        assert stats["slots"][1]["recent_deaths"] == 0
+        # scale_down by address picks the matching replica
+        assert sup.scale_down("127.0.0.1:9000") == 0
+        assert sup.scale_down() is None     # nothing left to retire
+    finally:
+        sup.stop()
+
+
+def test_missed_heartbeat_counts_as_death(tmp_path):
+    """A replica whose process is alive but whose registry heartbeat
+    aged out is wedged: the supervisor kills and restarts it."""
+    reg = tmp_path / "reg"
+    reg.mkdir()
+    spawned = []
+    sup = make_supervisor(spawned, {
+        "spark.rapids.tpu.serving.net.registryDir": str(reg),
+        "spark.rapids.tpu.serving.health.livenessWindowSeconds": "0.2"})
+    try:
+        sup.start(1)
+        # a fresh heartbeat: healthy, nothing happens
+        (reg / "replica-0").write_text(spawned[0].addr)
+        with sup._lock:
+            sup._slots[0].started_at -= 10.0    # past the startup grace
+        sup.tick()
+        assert not spawned[0].killed
+        # heartbeat stops (mtime ages past the liveness window)
+        t = time.time() - 5
+        import os
+        os.utime(reg / "replica-0", (t, t))
+        sup.tick()
+        assert spawned[0].killed, "wedged replica must be killed"
+        tick_until(sup, lambda: len(spawned) == 2)
+    finally:
+        sup.stop()
+
+
+# ===================================================== autoscaler (pure)
+
+POL = ScalingPolicy(min_replicas=1, max_replicas=4, up_watermark=0.8,
+                    down_watermark=0.25, up_stable_ticks=2,
+                    down_stable_ticks=3, up_cooldown_s=5.0,
+                    down_cooldown_s=30.0, stale_after_s=10.0, queue_norm=4)
+
+
+def snap(addr="a", state="UP", age=0.5, queue=0, budget=0.0, p99=0.0,
+         open_q=0):
+    return ReplicaSnapshot(addr=addr, state=state, age_s=age,
+                           queue_depth=queue, budget_fraction=budget,
+                           p99_wall_s=p99, queries_open=open_q)
+
+
+def test_scale_up_fires_only_after_the_stability_streak():
+    st = ControllerState()
+    hot = [snap(budget=0.9)]
+    d1 = decide(hot, 1, st, POL, now=100.0)
+    assert d1.action == 0 and d1.pressure == 0.9
+    d2 = decide(hot, 1, st, POL, now=101.0)
+    assert d2.action == +1
+
+
+def test_hysteresis_in_band_reading_resets_the_streak_no_flap():
+    st = ControllerState()
+    hot, mid = [snap(budget=0.9)], [snap(budget=0.5)]
+    actions = []
+    for i, snaps in enumerate([hot, mid, hot, mid, hot, mid, hot, mid]):
+        actions.append(decide(snaps, 2, st, POL, now=100.0 + i).action)
+    assert actions == [0] * 8, "oscillating load must never flap the fleet"
+
+
+def test_cooldown_suppresses_back_to_back_scale_ups():
+    st = ControllerState()
+    hot = [snap(budget=0.95)]
+    decide(hot, 1, st, POL, now=100.0)
+    assert decide(hot, 1, st, POL, now=101.0).action == +1
+    # streak rebuilds immediately, but the cooldown holds the action
+    decide(hot, 2, st, POL, now=102.0)
+    held = decide(hot, 2, st, POL, now=103.0)
+    assert held.action == 0 and "cooldown" in held.reason
+    # past the cooldown the pent-up streak releases
+    assert decide(hot, 2, st, POL, now=106.5).action == +1
+
+
+def test_scale_down_streak_floor_and_ceiling_clamps():
+    st = ControllerState()
+    cold = [snap(budget=0.05)]
+    assert decide(cold, 2, st, POL, now=100.0).action == 0
+    assert decide(cold, 2, st, POL, now=101.0).action == 0
+    assert decide(cold, 2, st, POL, now=102.0).action == -1
+    # at the floor a cold fleet holds instead of shrinking below min
+    st2 = ControllerState()
+    for i in range(6):
+        d = decide(cold, 1, st2, POL, now=100.0 + i)
+        assert d.action == 0
+    assert "floor" in d.reason
+    # at the ceiling a hot fleet holds instead of growing past max
+    st3 = ControllerState()
+    hot = [snap(budget=0.95)]
+    for i in range(4):
+        d = decide(hot, 4, st3, POL, now=100.0 + i)
+        assert d.action == 0
+    assert "ceiling" in d.reason
+    # below the floor scales up immediately, pressure or not
+    st4 = ControllerState()
+    d = decide([], 0, st4, POL, now=100.0)
+    assert d.action == +1 and "floor" in d.reason
+
+
+def test_degraded_stale_and_draining_replicas_are_excluded():
+    healthy_hot = snap(addr="a", budget=0.95)
+    stale = snap(addr="b", age=99.0, budget=0.0)
+    draining = snap(addr="c", state="DRAINING", budget=0.0)
+    kept = healthy_snapshots([healthy_hot, stale, draining], POL)
+    assert [s.addr for s in kept] == ["a"]
+    st = ControllerState()
+    # the stale idle replicas must not dilute the hot one's pressure
+    d = decide([healthy_hot, stale, draining], 3, st, POL, now=100.0)
+    assert d.pressure == 0.95 and d.healthy == 1
+    # a replica that never sampled yet (age None) is fresh, not stale
+    assert healthy_snapshots([snap(age=None)], POL)
+    # ALL signals stale: hold rather than act on noise
+    st2 = ControllerState()
+    d = decide([stale], 2, st2, POL, now=100.0)
+    assert d.action == 0 and d.pressure is None and d.healthy == 0
+
+
+def test_pressure_folds_queue_budget_and_latency_signals():
+    assert replica_pressure(snap(budget=0.6), POL) == 0.6
+    assert replica_pressure(snap(queue=8), POL) == 2.0   # 8 / queue_norm 4
+    assert replica_pressure(snap(budget=0.3, queue=2), POL) == 0.5
+    lat = ScalingPolicy(queue_norm=4, p99_objective_s=2.0)
+    assert replica_pressure(snap(p99=3.0), lat) == 1.5
+    assert replica_pressure(snap(p99=3.0), POL) == 0.0   # objective off
+
+
+def test_pick_scale_down_target_retires_least_loaded():
+    snaps = [snap(addr="a", open_q=3), snap(addr="b", open_q=0, budget=0.1),
+             snap(addr="c", open_q=0, budget=0.6)]
+    assert pick_scale_down_target(snaps, POL) == "b"
+    assert pick_scale_down_target([], POL) is None
+
+
+class StubSupervisor:
+    def __init__(self, active=1):
+        self.active = active
+        self.ups = 0
+        self.downs = []
+
+    def addresses(self):
+        return []
+
+    def active_count(self):
+        return self.active
+
+    def scale_up(self):
+        self.ups += 1
+        self.active += 1
+
+    def scale_down(self, addr=None):
+        self.downs.append(addr)
+        self.active -= 1
+        return 0
+
+
+def test_controller_tick_actuates_and_counts(monkeypatch):
+    conf = TpuConf({**BASE_CONF,
+                    "spark.rapids.tpu.serving.fleet.scaleUpStableTicks": "1",
+                    "spark.rapids.tpu.serving.fleet."
+                    "scaleDownStableTicks": "1",
+                    "spark.rapids.tpu.serving.fleet."
+                    "scaleUpCooldownSeconds": "0",
+                    "spark.rapids.tpu.serving.fleet."
+                    "scaleDownCooldownSeconds": "0"})
+    sup = StubSupervisor(active=2)
+    ctl = FleetController(conf, sup)
+    u0 = um.SERVING_METRICS[um.SERVING_SCALE_UPS].value
+    d0 = um.SERVING_METRICS[um.SERVING_SCALE_DOWNS].value
+    monkeypatch.setattr(ctl, "collect",
+                        lambda: [snap(addr="a", budget=0.95)])
+    d = ctl.tick(now=100.0)
+    assert d.action == +1 and sup.ups == 1
+    assert um.SERVING_METRICS[um.SERVING_SCALE_UPS].value - u0 == 1
+    monkeypatch.setattr(ctl, "collect",
+                        lambda: [snap(addr="a", budget=0.01)])
+    d = ctl.tick(now=200.0)
+    assert d.action == -1 and sup.downs == ["a"]
+    assert um.SERVING_METRICS[um.SERVING_SCALE_DOWNS].value - d0 == 1
+    assert ctl.last_decision is d
+
+
+# ==================================================== overload shedding
+
+def test_overloaded_error_is_retryable_and_roundtrips_the_codec():
+    e = OverloadedError("queue full", retry_after_s=0.75)
+    assert classification_for(e) == RETRYABLE and is_retryable(e)
+    payload = encode_error(e)
+    assert payload["code"] == "OVERLOADED"
+    back = decode_error(payload)
+    assert isinstance(back, OverloadedError)
+    assert back.retry_after_s == 0.75
+    q = decode_error(encode_error(QuotaExceededError("cap", 0.5)))
+    assert isinstance(q, QuotaExceededError) and q.retry_after_s == 0.5
+    assert is_retryable(q)
+
+
+def test_scheduler_sheds_at_tenant_queue_bound_front_door_only():
+    sess = make_session({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.serving.maxQueuedPerTenant": "1",
+        "spark.rapids.tpu.serving.stats.sampleIntervalSeconds": "0"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    queued = sess.submit(small_df(sess))        # tenant queue now at bound
+    s0 = um.SERVING_METRICS[um.SERVING_SHEDS].value
+    with pytest.raises(OverloadedError) as ei:
+        sess.submit(small_df(sess, seed=1))
+    assert ei.value.retry_after_s > 0
+    assert um.SERVING_METRICS[um.SERVING_SHEDS].value - s0 == 1
+    # sheds happen at the front door ONLY: everything admitted completes
+    release.set()
+    assert blocker.result(timeout=120) is not None
+    assert queued.result(timeout=120) is not None
+    # with the pressure gone, new submissions are admitted again
+    assert sess.submit(small_df(sess, seed=2)).result(timeout=120) is not None
+    sess.scheduler.shutdown()
+
+
+def test_shed_retry_after_scales_with_queue_depth():
+    sess = make_session({
+        "spark.rapids.tpu.serving.overload.retryAfterSeconds": "0.2"})
+    sched = sess.scheduler
+    assert sched.shed_retry_after(0) >= 0.2
+    assert (sched.shed_retry_after(2 * sched.max_concurrent)
+            > sched.shed_retry_after(0)), \
+        "a deeper queue must hint a longer retry-after"
+    sched.shutdown()
+
+
+def _serve(extra_conf=None, n=4000):
+    sess = TpuSession({**BASE_CONF, **(extra_conf or {})})
+    rng = np.random.default_rng(7)
+    df = sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 8, n).astype("int64"),
+        "v": rng.random(n)})).repartition(2)
+    df.createOrReplaceTempView("t")
+    server = QueryServer(sess)
+    host, port = server.address
+    return sess, server, f"{host}:{port}"
+
+
+def test_wire_overload_rejection_structured_with_retry_after():
+    """A saturated replica sheds over the wire: the client raises the
+    decoded OverloadedError (pinned submit), the hint rides the blob,
+    and admitted queries keep completing underneath."""
+    sess, server, addr = _serve({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.serving.maxQueuedPerTenant": "1",
+        "spark.rapids.tpu.serving.overload.retryAfterSeconds": "0.1"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    queued = sess.submit(small_df(sess))        # fill the tenant queue
+    client = QueryServiceClient([addr], TpuConf({**BASE_CONF, **FAST_DIAL}))
+    s0 = um.SERVING_METRICS[um.SERVING_SHEDS].value
+    try:
+        with pytest.raises(OverloadedError) as ei:
+            client.submit(FILTER_SQL, replica=0)
+        assert ei.value.retry_after_s > 0
+        assert um.SERVING_METRICS[um.SERVING_SHEDS].value - s0 >= 1
+        # never a timeout or opaque wire error: the shed is structured
+        release.set()
+        assert blocker.result(timeout=120) is not None
+        assert queued.result(timeout=120) is not None
+        # pressure gone: the same client's next submit is served
+        got = client.submit(FILTER_SQL, replica=0).result()
+        assert got.equals(sess.sql(FILTER_SQL).collect())
+    finally:
+        client.close()
+        sess.scheduler.drain(timeout=60)
+        server.shutdown()
+        sess.scheduler.shutdown()
+
+
+def test_client_honors_retry_after_hint_on_unpinned_submit():
+    """An unpinned submit that finds EVERY replica shedding sleeps the
+    max(hint, deterministic backoff) between passes and retries — it
+    raises only after serving.overload.clientRetries extra passes."""
+    sess, server, addr = _serve({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1",
+        "spark.rapids.tpu.serving.maxQueuedPerTenant": "1",
+        "spark.rapids.tpu.serving.overload.retryAfterSeconds": "0.15"})
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    queued = sess.submit(small_df(sess))
+    client = QueryServiceClient(
+        [addr], TpuConf({**BASE_CONF, **FAST_DIAL,
+                         "spark.rapids.tpu.serving.overload."
+                         "clientRetries": "2"}))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(OverloadedError):
+            client.submit(FILTER_SQL)
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.25, \
+            f"two retry passes must honor ~2x the 0.15s hint, got {elapsed}"
+    finally:
+        release.set()
+        blocker.result(timeout=120)
+        queued.result(timeout=120)
+        client.close()
+        sess.scheduler.drain(timeout=60)
+        server.shutdown()
+        sess.scheduler.shutdown()
+
+
+def test_per_client_quota_rejects_structured_and_counts():
+    sess, server, addr = _serve({
+        "spark.rapids.tpu.serving.quota.maxConcurrentPerClient": "1"})
+    client = QueryServiceClient([addr], TpuConf({**BASE_CONF, **FAST_DIAL}))
+    q0 = um.SERVING_METRICS[um.SERVING_QUOTA_REJECTIONS].value
+    try:
+        first = client.submit(FILTER_SQL, replica=0)    # holds the quota
+        with pytest.raises(QuotaExceededError) as ei:
+            client.submit(FILTER_SQL, replica=0)
+        assert ei.value.retry_after_s > 0
+        assert (um.SERVING_METRICS[um.SERVING_QUOTA_REJECTIONS].value
+                - q0 == 1)
+        # draining the first stream frees the quota for the next submit
+        ref = first.result()
+        assert client.submit(FILTER_SQL, replica=0).result().equals(ref)
+    finally:
+        client.close()
+        sess.scheduler.drain(timeout=60)
+        server.shutdown()
+        sess.scheduler.shutdown()
+
+
+def test_unrequested_server_cancel_is_replica_loss_not_query_failure():
+    """A server-side cancellation the client never asked for (peer-lost /
+    shutdown cleanup racing the stream) surfaces RETRYABLE — replica
+    loss, eligible for failover — while a cancellation the handle itself
+    sent stays terminal (non-retryable)."""
+    sess, server, addr = _serve({
+        "spark.rapids.tpu.serving.maxConcurrentQueries": "1"})
+    client = QueryServiceClient([addr], TpuConf({**BASE_CONF, **FAST_DIAL}))
+    started, release = threading.Event(), threading.Event()
+    blocker = sess.submit(blocking_udf_df(sess, started, release))
+    assert started.wait(60)
+    try:
+        h = client.submit(FILTER_SQL, replica=0)        # parked QUEUED
+        server._queries[h.query_id].handle.cancel()     # cleanup, not us
+        release.set()
+        with pytest.raises(WireQueryError) as ei:
+            h.result()
+        assert ei.value.retryable, \
+            "an unrequested cancellation must be retryable replica loss"
+        assert ei.value.wire_code == "QUERY_CANCELLED"
+
+        blocker.result(timeout=120)
+        started.clear(); release.clear()
+        blocker = sess.submit(blocking_udf_df(sess, started, release))
+        assert started.wait(60)
+        h2 = client.submit(FILTER_SQL, replica=0)
+        h2._cancel_sent = True          # as if the handle sent a cancel
+        server._queries[h2.query_id].handle.cancel()
+        release.set()
+        with pytest.raises(WireQueryError) as ei2:
+            h2.result()
+        assert not ei2.value.retryable, \
+            "a cancellation this handle requested is terminal"
+        assert ei2.value.wire_code == "QUERY_CANCELLED"
+    finally:
+        release.set()
+        blocker.result(timeout=120)
+        client.close()
+        sess.scheduler.drain(timeout=60)
+        server.shutdown()
+        sess.scheduler.shutdown()
+
+
+# ================================================== serve_stats staleness
+
+def test_snapshot_age_is_the_pre_call_age_not_the_inline_sample():
+    sess = make_session({
+        "spark.rapids.tpu.serving.stats.sampleIntervalSeconds": "0"})
+    sched = sess.scheduler
+    w = ServeStatsWindow(window_s=60)
+    assert w.age_s() is None
+    first = w.snapshot(sched)
+    assert first["age_s"] is None, "no prior sample: age must be None"
+    time.sleep(0.25)
+    second = w.snapshot(sched)
+    # the inline sample refreshed the series, but the STAMP is the age
+    # the series had when the request arrived — a dead sampler shows
+    assert second["age_s"] >= 0.2
+    assert w.age_s() < 0.2          # ...while the series itself is fresh
+    sched.shutdown()
+
+
+def test_background_sampler_keeps_series_fresh_and_stops_on_shutdown():
+    sess = make_session({
+        "spark.rapids.tpu.serving.stats.sampleIntervalSeconds": "0.05"})
+    sched = sess.scheduler
+    sched.start_stats_sampler()
+    deadline = time.time() + 10
+    while sched.serve_stats.age_s() is None:
+        assert time.time() < deadline, "sampler never ticked"
+        time.sleep(0.02)
+    time.sleep(0.3)
+    snap = sched.serve_stats.snapshot(sched)
+    assert snap["age_s"] is not None and snap["age_s"] < 5.0
+    assert len(snap["series"]) >= 3, "periodic tick must append samples"
+    sched.shutdown()
+    t = sched._sampler
+    if t is not None:
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+
+# ===================================================== fleet convergence
+
+class InProcReplica:
+    """In-process replica behind the supervisor's process contract:
+    terminate() drains gracefully, kill() is SIGKILL (the transport
+    stops heartbeating but the 'process' stays alive — the wedged path
+    until the supervisor kills it for real)."""
+
+    def __init__(self, conf, table):
+        self.sess = TpuSession(conf)
+        df = self.sess.create_dataframe(table).repartition(2)
+        df.createOrReplaceTempView("t")
+        self.server = QueryServer(self.sess)
+        host, port = self.server.address
+        self.addr = f"{host}:{port}"
+        self._exited = False
+
+    def poll(self):
+        return 0 if self._exited else None
+
+    def terminate(self):
+        def run():
+            self.server.drain()
+            deadline = time.time() + 30
+            while not self.server.drained() and time.time() < deadline:
+                time.sleep(0.05)
+            self.server.shutdown()
+            self.sess.scheduler.shutdown(wait=False)
+            self._exited = True
+        threading.Thread(target=run, daemon=True).start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.sess.scheduler.shutdown(wait=False)
+        self._exited = True
+
+    def wedge(self):
+        """Stop heartbeating while staying 'alive': the missed-heartbeat
+        death path, not the process-exit one."""
+        t = self.server.transport
+        (getattr(t, "_inner", None) or t).kill()
+
+
+@pytest.mark.slow
+def test_supervised_fleet_recovers_from_wedged_replica(tmp_path):
+    """Chaos convergence: wedge one of two supervised replicas — the
+    supervisor detects the missed heartbeats, kills and restarts it
+    within the backoff bound, the registry re-discovers it, and client
+    queries complete bit-identically with zero visible errors."""
+    reg = str(tmp_path / "reg")
+    rng = np.random.default_rng(7)
+    table = pa.table({"k": rng.integers(0, 8, 4000).astype("int64"),
+                      "v": rng.random(4000)})
+    fleet_conf = {
+        **BASE_CONF,
+        "spark.rapids.tpu.serving.net.registryDir": reg,
+        "spark.rapids.tpu.serving.health.heartbeatSeconds": "0.05",
+        "spark.rapids.tpu.serving.health.livenessWindowSeconds": "0.4",
+    }
+    replicas = []
+
+    def spawn(slot_index):
+        r = InProcReplica(fleet_conf, table)
+        replicas.append(r)
+        return r
+
+    sup = ReplicaSupervisor(TpuConf({**fleet_conf, **SUP_CONF, **{
+        "spark.rapids.tpu.serving.fleet.restartBackoffMs": "20"}}),
+        spawn=spawn)
+    client = QueryServiceClient(
+        registry_dir=reg,
+        conf=TpuConf({**BASE_CONF, **FAST_DIAL,
+                      "spark.rapids.tpu.serving.health."
+                      "probeIntervalSeconds": "0"}))
+    try:
+        sup.start(2)
+        ref = replicas[0].sess.sql(FILTER_SQL).collect()
+        assert client.submit(FILTER_SQL).result().equals(ref)
+        # wedge replica 0: alive, not heartbeating
+        replicas[0].wedge()
+        with sup._lock:     # skip the startup grace deterministically
+            sup._slots[0].started_at -= 10.0
+        deadline = time.time() + 30
+        while len(replicas) < 3:
+            assert time.time() < deadline, "supervisor never restarted"
+            sup.tick()
+            time.sleep(0.05)
+        assert replicas[0]._exited, "wedged replica must be killed"
+        assert sup.fleet_stats()["states"] == {"UP": 2}
+        # the fleet serves correct, bit-identical results throughout;
+        # a pass that races discovery retries — but never sees a wrong
+        # or opaque terminal error
+        deadline = time.time() + 30
+        while True:
+            try:
+                assert client.submit(FILTER_SQL).result().equals(ref)
+                break
+            except (WireQueryError, OverloadedError):
+                assert time.time() < deadline, "fleet never converged"
+                time.sleep(0.1)
+    finally:
+        client.close()
+        sup.stop()
+        for r in replicas:
+            if not r._exited:
+                r.kill()
