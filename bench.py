@@ -161,6 +161,9 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
     # ---- out-of-core degradation (ample vs 1/4 budget) ----------------------
     out_of_core = _bench_out_of_core(table, conf, scale)
 
+    # ---- statistics-driven adaptive execution (skew-split OFF vs ON) --------
+    adaptive = _bench_adaptive(conf, scale)
+
     # ---- structured tracing: disabled cost + span coverage ------------------
     observability = _bench_observability(table, conf, iters)
 
@@ -210,6 +213,7 @@ def _bench_tpch_q1(scale: float, iters: int) -> dict:
             "concurrent": concurrent,
             "serving_net": serving_net,
             "out_of_core": out_of_core,
+            "adaptive": adaptive,
             "observability": observability,
             "mesh": mesh_section,
             "end_to_end_collect_s": round(e2e_s, 4),
@@ -723,6 +727,122 @@ def _bench_out_of_core(table, conf: dict, scale: float) -> dict:
         assert out[name]["spill_partitions"] >= 2, out[name]
     DeviceManager.shutdown()
     return out
+
+
+def _bench_adaptive(conf: dict, scale: float) -> dict:
+    """Statistics-driven adaptive execution v2 (ROADMAP item 2): a
+    Zipf-skewed equi-join + group-by under a constrained device budget,
+    adaptive OFF vs ON. OFF pays grace recursion on the hot partition —
+    the hot KEY is indivisible for key-hash splitting, so recursion burns
+    depth without relief; ON's skew-split slices the MAP axis (the only
+    axis that can divide a single giant key) and the observed-statistics
+    grace fanout keeps the fitting sub-joins single-pass. Asserts
+    bit-identical results; ci/nightly.sh gates speedup_x >= 1.5. Also
+    reports the re-fusion stage count and the dynamic broadcast-switch
+    count on their canonical probe queries."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.api import TpuSession, functions as F
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    from spark_rapids_tpu.testing import assert_tables_equal
+
+    n = 60_000
+    rng = np.random.default_rng(20)
+    z = np.minimum(rng.zipf(1.3, n), 1000).astype(np.int64)
+    fact = pa.table({"k": z, "v": np.arange(n, dtype=np.int64)})
+    dims = pa.table({"k": np.arange(1, 1001, dtype=np.int64),
+                     "w": rng.integers(0, 100, 1000).astype(np.int64)})
+    hot_bytes = int(float((z == 1).mean()) * n * 16)
+
+    pool = 256 << 10
+    base = {**conf,
+            "spark.rapids.tpu.sql.scanCache.enabled": "false",
+            "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+            "spark.rapids.tpu.memory.tpu.poolSizeBytes": str(pool),
+            "spark.rapids.tpu.memory.host.spillStorageSize": str(8 << 20)}
+    adaptive = {**base,
+                "spark.rapids.tpu.sql.adaptive.enabled": "true",
+                "spark.rapids.tpu.sql.adaptive."
+                "skewedPartitionThreshold.bytes": str(hot_bytes // 4),
+                "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor": "2.0",
+                "spark.rapids.tpu.sql.adaptive."
+                "advisoryPartitionSizeInBytes": str(max(hot_bytes // 8,
+                                                        4096))}
+
+    def q(s):
+        lt = s.create_dataframe(fact).repartition(8).repartition(6, "k")
+        rt = s.create_dataframe(dims).repartition(3).repartition(6, "k")
+        return (lt.join(rt, "k").groupBy("k")
+                .agg(F.count().alias("n"), F.sum("v").alias("sv")))
+
+    def run(run_conf):
+        DeviceManager.shutdown()
+        s = TpuSession(run_conf)
+        df = q(s)
+        df.collect()                     # warm programs
+        t0 = time.perf_counter()
+        out = df.collect()
+        dt = time.perf_counter() - t0
+        return out, dt, s
+
+    out_off, off_s, s_off = run(base)
+    out_on, on_s, s_on = run(adaptive)
+    assert "skew-split" in s_on.last_plan.tree_string()
+    cols = sorted(out_on.column_names)
+    order = [(c, "ascending") for c in cols]
+    assert_tables_equal(out_off.select(cols).sort_by(order),
+                        out_on.select(cols).sort_by(order))
+    ad = s_on.last_metrics.get("adaptive", {})
+    mm_off = s_off.last_metrics.get("memory", {})
+    mm_on = s_on.last_metrics.get("memory", {})
+
+    # re-fusion probe: a lone filter above a coalesced reader becomes a
+    # fused stage only the post-AQE pass can build
+    DeviceManager.shutdown()
+    s_rf = TpuSession({**conf,
+                       "spark.rapids.tpu.sql.adaptive.enabled": "true"})
+    t7 = pa.table({"k": pa.array(np.arange(3000) % 7, type=pa.int64()),
+                   "v": pa.array(np.arange(3000), type=pa.int64())})
+    (s_rf.create_dataframe(t7).repartition(6, "k")
+     .filter(F.col("v") > 10).collect())
+    refused = s_rf.last_metrics.get("adaptive", {}).get(
+        "adaptive.refused_stages", 0)
+    assert refused >= 1, s_rf.last_plan.tree_string()
+
+    # broadcast-switch probe: build side observed under the threshold only
+    # after its filter ran (estimates cannot see the selectivity)
+    DeviceManager.shutdown()
+    s_bc = TpuSession({**conf,
+                       "spark.rapids.tpu.sql.adaptive.enabled": "true",
+                       "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes":
+                           "1000"})
+    lt = s_bc.create_dataframe(t7).repartition(4, "k")
+    rt = (s_bc.create_dataframe(t7).filter(F.col("v") < 30)
+          .repartition(3, "k"))
+    lt.join(rt, "k").collect()
+    switches = s_bc.last_metrics.get("adaptive", {}).get(
+        "adaptive.broadcast_switches", 0)
+    DeviceManager.shutdown()
+
+    return {
+        "rows": n,
+        "hot_partition_bytes": hot_bytes,
+        "device_pool_bytes": pool,
+        "skewed_join_off_s": round(off_s, 3),
+        "skewed_join_on_s": round(on_s, 3),
+        # adaptive ON vs OFF on the skewed join (>1 = adaptive faster);
+        # nightly gates this at >= 1.5
+        "speedup_x": round(off_s / max(on_s, 1e-9), 3),
+        "bit_identical": True,
+        "skew_splits": ad.get("adaptive.skew_splits", 0),
+        "coalesced_partitions": ad.get("adaptive.coalesced_partitions", 0),
+        "refused_stages": refused,
+        "broadcast_switches": switches,
+        "spill_partitions_off": mm_off.get("memory.spill_partitions", 0),
+        "spill_partitions_on": mm_on.get("memory.spill_partitions", 0),
+        "recursion_depth_off": mm_off.get("memory.recursion_depth_peak", 0),
+        "recursion_depth_on": mm_on.get("memory.recursion_depth_peak", 0),
+    }
 
 
 def _bench_observability(table, conf: dict, iters: int) -> dict:

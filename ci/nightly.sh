@@ -638,6 +638,21 @@ for qname in ("q1", "q3_shaped"):
     assert sec["quarter_budget_rows_per_sec"] > 0, sec
 assert (ooc["q1"]["bytes_spilled_to_host"]
         + ooc["q3_shaped"]["bytes_spilled_to_host"]) > 0, ooc
+ad = out["breakdown"]["adaptive"]
+for key in ("skewed_join_off_s", "skewed_join_on_s", "speedup_x",
+            "bit_identical", "skew_splits", "coalesced_partitions",
+            "refused_stages", "broadcast_switches"):
+    assert key in ad, f"missing adaptive breakdown key {key}: {ad}"
+# adaptive-v2 acceptance (ROADMAP item 2): the Zipf-skewed join under a
+# constrained budget runs >= 1.5x faster with skew-split + observed-size
+# grace fanout ON, bit-identical; the skew split, post-AQE re-fusion and
+# dynamic broadcast switch each fired on their probe queries
+assert ad["bit_identical"] is True, ad
+assert ad["speedup_x"] >= 1.5, ad
+assert ad["skew_splits"] >= 1, ad
+assert ad["coalesced_partitions"] >= 1, ad
+assert ad["refused_stages"] >= 1, ad
+assert ad["broadcast_switches"] >= 1, ad
 obs = out["breakdown"]["observability"]
 for key in ("q1_warm_off_s", "q1_warm_on_s", "tracing_on_overhead_x",
             "disabled_hook_ns", "tracing_off_overhead_pct", "spans_total",
@@ -717,6 +732,9 @@ print("bench smoke OK:", {k: pipe[k] for k in
       {"out_of_core_q1": {k: ooc["q1"][k] for k in
                           ("spill_partitions", "recursion_depth_peak",
                            "quarter_vs_ample_x")}},
+      {"adaptive": {k: ad[k] for k in
+                    ("speedup_x", "skew_splits", "coalesced_partitions",
+                     "refused_stages", "broadcast_switches")}},
       {"observability": {k: obs[k] for k in
                          ("tracing_on_overhead_x",
                           "tracing_off_overhead_pct", "spans_total")}},

@@ -588,6 +588,8 @@ class DataFrame:
         from spark_rapids_tpu.utils import tracing as _tracing
         from spark_rapids_tpu.utils.metrics import (NamedRange,
                                                     action_depth_scope,
+                                                    adaptive_delta,
+                                                    adaptive_snapshot,
                                                     memory_delta,
                                                     memory_snapshot,
                                                     recompute_delta,
@@ -606,6 +608,7 @@ class DataFrame:
         memory_before = memory_snapshot()
         serving_before = serving_snapshot()
         recompute_before = recompute_snapshot()
+        adaptive_before = adaptive_snapshot()
         import time as _time
         # stable node ordinals: the span/EXPLAIN-ANALYZE key (pre-order,
         # matching the f"{i}:{name}" keys of session.last_metrics)
@@ -755,6 +758,10 @@ class DataFrame:
                 # recomputes the cluster driver ran (and escalations to the
                 # failover path) while this action was collecting
                 snap["shuffle"] = recompute_delta(recompute_before)
+                # adaptive story: runtime rewrites this action's AQE pass
+                # applied (skew splits, coalesced partitions, broadcast
+                # switches, re-fused stages)
+                snap["adaptive"] = adaptive_delta(adaptive_before)
                 if query is not None:
                     query.record_exec_metrics(snap)
                 self.session.last_metrics = snap

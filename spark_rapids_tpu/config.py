@@ -170,6 +170,56 @@ ADAPTIVE_ADVISORY_PARTITION_BYTES = _conf(
     "(spark.sql.adaptive.advisoryPartitionSizeInBytes role).",
     checker=_positive("advisoryPartitionSizeInBytes"))
 
+ADAPTIVE_SKEW_SPLIT_ENABLED = _conf(
+    "sql.adaptive.skewSplit.enabled", bool, True,
+    "Skew-split readers under AQE (spark.sql.adaptive.skewJoin.enabled role): "
+    "a reduce partition observed larger than skewedPartitionFactor x the "
+    "median splits into map-id slices (PartialReducerPartitionSpec "
+    "semantics); the consuming shuffled hash join reads the other side's "
+    "whole partition once per slice, so the union of the per-slice joins is "
+    "the unsplit join bit-identically up to row order. Hash aggregates over "
+    "a skewed exchange re-partition by group key instead "
+    "(split-then-reaggregate via the out-of-core grace machinery).")
+
+ADAPTIVE_SKEW_FACTOR = _conf(
+    "sql.adaptive.skewedPartitionFactor", float, 5.0,
+    "A reduce partition is skewed when its observed size exceeds this factor "
+    "times the median partition size of its shuffle "
+    "(spark.sql.adaptive.skewJoin.skewedPartitionFactor role).",
+    checker=_positive("skewedPartitionFactor"))
+
+ADAPTIVE_SKEW_THRESHOLD_BYTES = _conf(
+    "sql.adaptive.skewedPartitionThreshold.bytes", int, 64 * 1024 * 1024,
+    "Minimum observed partition size for skew handling to engage — partitions "
+    "under this are never split however lopsided the shuffle "
+    "(spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes role).",
+    checker=_positive("skewedPartitionThreshold.bytes"))
+
+ADAPTIVE_REFUSION_ENABLED = _conf(
+    "sql.adaptive.refusion.enabled", bool, True,
+    "Re-run the whole-stage fusion pass over the AQE-rewritten plan so "
+    "fusible chains the rewrite created (e.g. the CoalesceBatches inserted "
+    "above a coalesced shuffle reader, under a not-yet-fused device op) "
+    "compile as one program. Programs keep the normal expression-signature "
+    "cache keys, so identical rewritten chains share compilations and "
+    "distinct ones never collide.")
+
+ADAPTIVE_COST_MODEL_ENABLED = _conf(
+    "sql.adaptive.costModel.enabled", bool, False,
+    "Cost-based CPU-vs-TPU placement (generalizing the static float-agg "
+    "fallback): at plan time, operators whose estimated input is under "
+    "costModel.minDeviceRows stay on the CPU engine — device dispatch and "
+    "compile overhead dominates tiny inputs; under AQE, shuffled hash joins "
+    "whose OBSERVED inputs are under the threshold are re-placed on the CPU "
+    "engine even when the estimates said otherwise.")
+
+ADAPTIVE_COST_MODEL_MIN_DEVICE_ROWS = _conf(
+    "sql.adaptive.costModel.minDeviceRows", int, 4096,
+    "Row-count threshold for the adaptive cost model: operators with fewer "
+    "(estimated or observed) input rows than this run on the CPU engine when "
+    "sql.adaptive.costModel.enabled is on.",
+    checker=_positive("costModel.minDeviceRows"))
+
 BROADCAST_JOIN_THRESHOLD = _conf(
     "sql.broadcastJoinThreshold.bytes", int, 10 * 1024 * 1024,
     "Maximum estimated build-side size for a join to use the broadcast hash "

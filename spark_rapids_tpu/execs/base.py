@@ -128,6 +128,13 @@ class PhysicalExec:
     #: runtime pressure (memory/grace.py).
     grace_partitions: int = 0
 
+    #: adaptive-rewrite provenance (plan/adaptive.py): a short description of
+    #: the runtime decision that produced this node ("coalesced 32→4",
+    #: "skew-split p7×5", "broadcast-switch", "placement=cpu", "re-fused"),
+    #: rendered as ``[adaptive: …]`` in plan display so estimate drift and
+    #: rewrite behavior are visible per node
+    adaptive_tag: str = ""
+
     #: stable node ordinal within one executed plan (pre-order, stamped by
     #: the action driver before execution): the span key EXPLAIN ANALYZE
     #: and the trace export join on — the reference keys per-exec metrics
@@ -187,6 +194,8 @@ class PhysicalExec:
         if self.placement is not None:
             from spark_rapids_tpu.parallel.placement import placement_label
             tag = f" @{placement_label(self.placement)}"
+        if self.adaptive_tag:
+            tag += f" [adaptive: {self.adaptive_tag}]"
         if analyze:
             tag += _tracing.analyze_annotation(self)
         lines = ["  " * indent + f"{self.name} [{self.output}]{tag}"]

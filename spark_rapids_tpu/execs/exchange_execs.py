@@ -416,6 +416,72 @@ def _sample_rows(colvs: List[ColV], num_rows: int, k: int) -> List[ColV]:
     return [bk.take_colv(np, v, idx) for v in colvs]
 
 
+# ------------------------------------------------------------------ stage stats
+#: k-minimum-values sketch width: 64 smallest distinct key hashes bound the
+#: per-column distinct estimate's error around 1/sqrt(k) ~ 12% — plenty for
+#: the order-of-magnitude placement/fanout decisions AQE makes from it
+_KMV_K = 64
+
+
+def _kmv_merge(pool: "np.ndarray", hashes: "np.ndarray") -> "np.ndarray":
+    """Fold new uint32 hash values into a k-minimum-values pool: the
+    ``_KMV_K`` smallest DISTINCT hashes seen so far (sorted ascending)."""
+    if hashes.size == 0:
+        return pool
+    # dedup BEFORE truncating: the k smallest VALUES of a skewed batch are
+    # copies of one heavy-hitter hash, which would evict every other
+    # distinct hash from the pool and collapse the estimate
+    return np.unique(np.concatenate([pool, np.unique(hashes)]))[:_KMV_K]
+
+
+def _kmv_estimate(pool: "np.ndarray") -> int:
+    """Distinct-count estimate from a KMV pool: with the pool unfull every
+    distinct hash was kept (the estimate is exact up to hash collisions);
+    full, the classic (k-1) / kth-minimum density estimator applies."""
+    if pool.size < _KMV_K:
+        return int(pool.size)
+    kth = int(pool[_KMV_K - 1])
+    return int((_KMV_K - 1) * (1 << 32) / max(kth, 1))
+
+
+@dataclass(frozen=True)
+class StageStats:
+    """Observed statistics of one materialized shuffle map stage (the
+    MapOutputStatistics analog, widened): exact per-reduce-partition row
+    counts, the per-partition byte sizes AQE plans against (rows x static
+    row width — the same MapStatus convention ``map_output_stats`` uses),
+    and a cheap KMV distinct estimate per hash-partitioning key column.
+    Attached to the executed exchange; surfaced through EXPLAIN ANALYZE
+    and the ``adaptive`` metrics section."""
+    partition_rows: Tuple[int, ...]
+    partition_bytes: Tuple[int, ...]
+    #: distinct-count estimate per partitioning key column (hash
+    #: partitioning only; empty otherwise)
+    key_distinct: Tuple[int, ...]
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.partition_rows)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.partition_bytes)
+
+    @property
+    def median_bytes(self) -> int:
+        sizes = sorted(self.partition_bytes)
+        return sizes[len(sizes) // 2] if sizes else 0
+
+    def describe(self) -> str:
+        nz = [s for s in self.partition_bytes if s]
+        out = (f"parts={len(self.partition_bytes)} rows={self.total_rows} "
+               f"bytes={self.total_bytes}"
+               + (f" max={max(nz)} median={self.median_bytes}" if nz else ""))
+        if self.key_distinct:
+            out += " ndv~" + "/".join(str(d) for d in self.key_distinct)
+        return out
+
+
 # ------------------------------------------------------------------ exec base
 class ShuffleExchangeExecBase(PhysicalExec):
     def size_estimate(self):
@@ -430,6 +496,12 @@ class ShuffleExchangeExecBase(PhysicalExec):
         #: rows written per reduce partition, filled by _run_map (the
         #: MapStatus sizes that drive AQE decisions)
         self._part_rows: Dict[int, int] = {}
+        #: rows per (map partition, reduce partition) — the map-axis
+        #: resolution skew-split readers slice on (PartialReducerSpec)
+        self._map_part_rows: Dict[Tuple[int, int], int] = {}
+        #: KMV pool per hash-partitioning key column (sorted uint32
+        #: ndarrays), folded at map time; None until the map side ran
+        self._key_sketches: Optional[List["np.ndarray"]] = None
 
     def __getstate__(self):
         # cluster tasks receive pickled exchanges; map state is per-process
@@ -437,6 +509,8 @@ class ShuffleExchangeExecBase(PhysicalExec):
         state["_lock"] = None
         state["_map_done"] = False
         state["_part_rows"] = {}
+        state["_map_part_rows"] = {}
+        state["_key_sketches"] = None
         return state
 
     def __setstate__(self, state):
@@ -475,6 +549,86 @@ class ShuffleExchangeExecBase(PhysicalExec):
         return [self._part_rows.get(p, 0) * width
                 for p in range(self.num_partitions)]
 
+    def stage_stats(self, ctx: Optional[ExecContext] = None
+                    ) -> Optional[StageStats]:
+        """The executed stage's observed statistics, or None when the map
+        side has not run (and no ctx was given to force it)."""
+        if not self._map_done:
+            if ctx is None:
+                return None
+            self._ensure_map(ctx)
+        from spark_rapids_tpu.execs.cpu_execs import _row_width
+        width = _row_width(self.output)
+        rows = tuple(self._part_rows.get(p, 0)
+                     for p in range(self.num_partitions))
+        ndv = tuple(_kmv_estimate(pool) for pool in (self._key_sketches or ()))
+        return StageStats(rows, tuple(r * width for r in rows), ndv)
+
+    def _sketch_keys(self, xp, ectx: EvalCtx, num_rows: int) -> None:
+        """Fold one batch's key-column hashes into the per-column KMV pools
+        (hash partitioning only). Under the device xp the per-batch cost is
+        an eager elementwise hash + top-k sort; only the k smallest hash
+        VALUES ever download (bounded, _KMV_K uint32s per column per batch)."""
+        part = self.partitioning
+        if not isinstance(part, HashPartitioning) or num_rows <= 0:
+            return
+        if self._key_sketches is None:
+            self._key_sketches = [np.zeros(0, dtype=np.uint32)
+                                  for _ in part.keys]
+        for ki, e in enumerate(part.keys):
+            v = e.eval(ectx)
+            ch = _column_hash(xp, v)
+            if ch.ndim == 0:        # scalar key (literal): one value
+                ch = xp.broadcast_to(ch, (1,))
+            valid = v.validity
+            if getattr(valid, "ndim", 1) == 0:
+                valid = xp.broadcast_to(valid, ch.shape)
+            ch = xp.where(valid, ch, _H_NULL)[:num_rows]
+            if xp is not np:
+                k = min(_KMV_K, int(ch.shape[0]))
+                # bounded download: only the k smallest DISTINCT hash
+                # values leave the device, never key data (same discipline
+                # as the range bounds sample in _device_bounds). unique
+                # sorts then truncates to k; the pad repeats ch[0], which
+                # the host-side merge collapses
+                ch = np.asarray(jnp.unique(ch, size=k, fill_value=ch[0]))
+            self._key_sketches[ki] = _kmv_merge(self._key_sketches[ki],
+                                                np.asarray(ch))
+
+    def map_slices(self, pid: int, num_slices: int) -> List[Tuple[int, ...]]:
+        """Contiguous map-id groups covering reduce partition ``pid``,
+        balanced by observed per-map-task row counts — the slice axis of a
+        PartialReducerSpec (Spark's ShufflePartitionsUtil map-range split).
+        Returns fewer than ``num_slices`` groups when the map side has too
+        few contributing tasks to split that fine."""
+        contrib = sorted((m, r) for (m, p), r in self._map_part_rows.items()
+                         if p == pid and r > 0)
+        if not contrib:
+            return []
+        total = sum(r for _, r in contrib)
+        num_slices = max(1, min(num_slices, len(contrib)))
+        target = total / num_slices
+        slices: List[Tuple[int, ...]] = []
+        group: List[int] = []
+        acc = 0
+        for m, r in contrib:
+            group.append(m)
+            acc += r
+            if acc >= target * (len(slices) + 1) and \
+                    len(slices) + 1 < num_slices:
+                slices.append(tuple(group))
+                group = []
+        if group:
+            slices.append(tuple(group))
+        return slices
+
+    def execute_partial(self, ctx: ExecContext,
+                        map_ids: Tuple[int, ...]) -> Iterator:
+        """Read ONE reduce partition (``ctx.partition_id``) restricted to
+        the given map tasks' output — the PartialReducerPartitionSpec read
+        path. Engine subclasses override."""
+        raise NotImplementedError(self.name)
+
 
 def _child_contexts(child: PhysicalExec, ctx: ExecContext) -> Iterator[ExecContext]:
     """One ExecContext per partition of ``child`` (map-side / build-side walk)."""
@@ -493,13 +647,24 @@ class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
 
     def execute(self, ctx: ExecContext) -> Iterator[HostBatch]:
         self._ensure_map(ctx)
-        for hb in self._parts.get(ctx.partition_id, []):
+        for _map_p, hb in self._parts.get(ctx.partition_id, []):
             self.count_output(hb.num_rows)
             yield hb
 
+    def execute_partial(self, ctx: ExecContext,
+                        map_ids: Tuple[int, ...]) -> Iterator[HostBatch]:
+        self._ensure_map(ctx)
+        wanted = set(map_ids)
+        for map_p, hb in self._parts.get(ctx.partition_id, []):
+            if map_p in wanted:
+                self.count_output(hb.num_rows)
+                yield hb
+
     def _run_map(self, ctx: ExecContext) -> None:
         n = self.partitioning.num_partitions
-        self._parts: Dict[int, List[HostBatch]] = {}
+        #: reduce pid -> [(map partition, batch)]: the map id rides along so
+        #: partial-reducer reads can slice one reduce partition by map task
+        self._parts: Dict[int, List[Tuple[int, HostBatch]]] = {}
         if ctx.cleanups is not None:
             # release the shuffled copy when the action finishes (the exec tree
             # outlives the action via session.last_plan)
@@ -534,6 +699,7 @@ class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
             ectx = EvalCtx(np, colvs, cap, ctx.string_max_bytes)
             with np.errstate(invalid="ignore", over="ignore"):
                 pids = _compute_pids(np, part, ectx, cap, offset, bounds)
+                self._sketch_keys(np, ectx, cap)
             sorted_cols, counts = split_by_pid(np, colvs, pids, hb.num_rows, n)
             offsets = np.concatenate([[0], np.cumsum(counts)])
             for j in range(n):
@@ -548,12 +714,16 @@ class CpuShuffleExchangeExec(ShuffleExchangeExecBase):
                              if v.lengths is not None else None))
                        for v in sorted_cols]
                 self._parts.setdefault(j, []).append(
-                    _colvs_to_host(self.output, sub, cnt))
+                    (map_p, _colvs_to_host(self.output, sub, cnt)))
                 self._part_rows[j] = self._part_rows.get(j, 0) + cnt
+                self._map_part_rows[(map_p, j)] = \
+                    self._map_part_rows.get((map_p, j), 0) + cnt
 
     def _release(self) -> None:
         self._parts = {}
         self._part_rows = {}
+        self._map_part_rows = {}
+        self._key_sketches = None
         self._map_done = False
 
 
@@ -623,10 +793,24 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
     is_device = True
 
     def execute(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        return self._read_partition(ctx, None)
+
+    def execute_partial(self, ctx: ExecContext,
+                        map_ids: Tuple[int, ...]) -> Iterator[DeviceBatch]:
+        return self._read_partition(ctx, set(map_ids))
+
+    def _read_partition(self, ctx: ExecContext,
+                        map_filter) -> Iterator[DeviceBatch]:
+        """One reduce partition's cached blocks, optionally restricted to a
+        set of map tasks (the PartialReducerPartitionSpec read: blocks are
+        keyed (shuffle, map, partition), so a map-axis slice is a filter —
+        no data moves or re-splits)."""
         self._ensure_map(ctx)
         env = _local_shuffle_env(ctx)
         for block in env.shuffle_catalog.blocks_for_partition(
                 self._shuffle_id, ctx.partition_id):
+            if map_filter is not None and block.map_id not in map_filter:
+                continue
             for buf, _meta in env.shuffle_catalog.acquire_buffers(block):
                 try:
                     batch = buf.get_batch()
@@ -686,7 +870,14 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
         if ctx.cleanups is not None:
             ctx.cleanups.append(
                 lambda: env.shuffle_catalog.remove_shuffle(sid))
+        sketch = isinstance(self.partitioning, HashPartitioning)
         for map_p, j, sub in self.iter_map_pieces(ctx):
+            if sketch and sub.num_rows > 0:
+                colvs = [ColV(c.dtype, c.data, c.validity, c.lengths)
+                         for c in sub.columns]
+                self._sketch_keys(
+                    jnp, EvalCtx(jnp, colvs, sub.capacity,
+                                 ctx.string_max_bytes), sub.num_rows)
             sub = uniform_string_batch(sub)
             layout = DevicePackLayout.for_batch_shape(
                 sub.schema, sub.capacity, batch_string_max(sub))
@@ -694,6 +885,8 @@ class TpuShuffleExchangeExec(ShuffleExchangeExecBase):
             env.shuffle_catalog.add_batch(
                 ShuffleBlockId(sid, map_p, j), sub, meta)
             self._part_rows[j] = self._part_rows.get(j, 0) + sub.num_rows
+            self._map_part_rows[(map_p, j)] = \
+                self._map_part_rows.get((map_p, j), 0) + sub.num_rows
 
     def _split_batch(self, ctx, part, db: DeviceBatch, offset: int, n: int,
                      bounds):

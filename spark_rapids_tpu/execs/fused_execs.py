@@ -114,12 +114,15 @@ class FusedStageExec(PhysicalExec):
             tag = f" @{placement_label(self.placement)}"
         lines = []
         for i, (name, schema) in enumerate(self.fused_ops):
-            # observed stats attach to the stage HEAD (the fused interior
-            # never materializes, so per-interior-op rows do not exist)
+            # observed stats and the adaptive tag attach to the stage HEAD
+            # (the fused interior never materializes, so per-interior-op
+            # rows do not exist)
             obs = _tracing.analyze_annotation(self) if analyze and i == 0 \
                 else ""
+            atag = (f" [adaptive: {self.adaptive_tag}]"
+                    if self.adaptive_tag and i == 0 else "")
             lines.append("  " * (indent + i)
-                         + f"*({self.stage_id}) {name} [{schema}]{tag}{obs}")
+                         + f"*({self.stage_id}) {name} [{schema}]{tag}{atag}{obs}")
         lines.append(self.children[0].tree_string(
             indent + len(self.fused_ops), analyze=analyze))
         return "\n".join(lines)
@@ -265,6 +268,8 @@ class FusedAggregateStageExec(te.TpuHashAggregateExec):
         if self.placement is not None:
             from spark_rapids_tpu.parallel.placement import placement_label
             tag = f" @{placement_label(self.placement)}"
+        if self.adaptive_tag:
+            tag += f" [adaptive: {self.adaptive_tag}]"
         if analyze:
             tag += _tracing.analyze_annotation(self)
         # the folded ops are NOT rendered (their expressions live inside the

@@ -231,11 +231,49 @@ class GraceController:
         return limit is not None and staged_bytes * self.factor > limit
 
     def _initial_partitions(self) -> int:
+        """Fanout priority: the force conf, then the OBSERVED working set
+        when this operator's shuffle inputs materialized (statistics beat
+        both the plan-time hint and the static fanout — ROADMAP item 2),
+        then the plan-time footprint hint, then the configured default."""
         if self.force:
             return max(2, min(self.force, self.max_partitions))
+        obs = self._observed_partitions()
+        if obs is not None:
+            return obs
         if self.hint:
             return max(2, min(self.hint, self.max_partitions))
         return max(2, min(self.fanout, self.max_partitions))
+
+    def _observed_partitions(self) -> Optional[int]:
+        """Partition count from observed upstream StageStats: the operator's
+        working-set factor over the bytes its inputs ACTUALLY materialized,
+        sized against the same budget choose_partitions uses at plan time.
+        None (fall back to hint/fanout) when any input stage has not run or
+        there is no device budget to size against."""
+        if self.store is None or self.store.budget_bytes is None:
+            return None
+        from spark_rapids_tpu.plan.footprint import (choose_partitions,
+                                                     observed_input_bytes)
+        obs = observed_input_bytes(self.exec, self.ctx.partition_id)
+        if obs is None:
+            return None
+        budget = self.faults.clamp_budget(self.kind, self.store.budget_bytes)
+        return choose_partitions(int(obs * self.factor), budget,
+                                 self.ctx.conf)
+
+    def _observed_fits(self) -> bool:
+        """True when THIS partition's observed working set fits the budget
+        with the same 2x slack choose_partitions provisions — runtime
+        statistics then overrule a stale plan-time hint and keep the
+        single-pass path. Callers fall through to the pressure-monitored
+        staging loop, never a blind inline, so an input that still
+        outgrows the budget degrades reactively instead of fatally."""
+        limit = self.threshold_bytes()
+        if limit is None:
+            return False
+        from spark_rapids_tpu.plan.footprint import observed_input_bytes
+        obs = observed_input_bytes(self.exec, self.ctx.partition_id)
+        return obs is not None and 2 * int(obs * self.factor) <= limit
 
     def _record_pressure(self) -> None:
         um.MEMORY_METRICS[um.MEM_PRESSURE_EVENTS].add(1)
@@ -251,8 +289,19 @@ class GraceController:
         when everything stayed under budget — the caller runs its
         unchanged single-pass path — or ``("partitioned", parts)`` after a
         plan hint, force conf, or runtime pressure flipped to grace mode."""
-        if self.force or self.hint:
+        if self.force:
             return self._partition_or_inline([], source, key_exprs, orders)
+        if self.hint:
+            # prime one batch (an upstream shuffle materializes its whole
+            # map side at first next()), then let observed statistics
+            # overrule the plan-time hint when this partition's real input
+            # fits — continuing into the monitored staging loop below
+            first = next(iter(source), None)
+            primed = [] if first is None else [first]
+            if not self._observed_fits():
+                return self._partition_or_inline(primed, source, key_exprs,
+                                                 orders)
+            source = itertools.chain(primed, source)
         staged: List[DeviceBatch] = []
         total = 0
         triggered = False
@@ -278,6 +327,14 @@ class GraceController:
         the sort path means the WHOLE stream had no live rows — fall back
         inline on the (all-empty) staged list so the operator still emits
         its empty-input shape."""
+        if not staged and not self.force:
+            # prime ONE batch before sizing the fanout: pulling it runs an
+            # upstream shuffle's whole map side (the exchange materializes
+            # at first next()), so the observed-statistics path can size
+            # against real input bytes instead of the plan-time hint
+            first = next(iter(source), None)
+            if first is not None:
+                staged = [first]
         n = self._initial_partitions()
         parts = self.partition(itertools.chain(staged, source), key_exprs,
                                depth=0, orders=orders, n=n)
@@ -291,11 +348,26 @@ class GraceController:
         """Two-sided staging for the join: the working set is BOTH sides,
         so pressure while staging either side partitions both (same n,
         same depth salt — matching keys land in matching partitions)."""
-        n = self._initial_partitions()
         if self.force or self.hint:
-            lp = self.partition(left, left_keys, depth=0, n=n)
-            rp = self.partition(right, right_keys, depth=0, n=n)
-            return "partitioned", (lp, rp)
+            if not self.force:
+                # prime one batch per side before sizing the fanout: both
+                # input shuffles materialize, so the observed-statistics
+                # path sees real sizes (see stage())
+                for src_name in ("left", "right"):
+                    src = left if src_name == "left" else right
+                    b = next(iter(src), None)
+                    primed = [] if b is None else [b]
+                    if src_name == "left":
+                        left = itertools.chain(primed, left)
+                    else:
+                        right = itertools.chain(primed, right)
+            if self.force or not self._observed_fits():
+                n = self._initial_partitions()
+                lp = self.partition(left, left_keys, depth=0, n=n)
+                rp = self.partition(right, right_keys, depth=0, n=n)
+                return "partitioned", (lp, rp)
+            # observed statistics overruled the hint: fall through to the
+            # monitored staging loop (reactive pressure still partitions)
         staged_l: List[DeviceBatch] = []
         staged_r: List[DeviceBatch] = []
         total = 0
@@ -312,8 +384,11 @@ class GraceController:
                 if triggered:
                     break
         if triggered:
-            # partitioning outside the listener scope (see stage())
+            # partitioning outside the listener scope (see stage()); the
+            # fanout is sized HERE — the inputs have materialized, so the
+            # observed-statistics path can see them
             self._record_pressure()
+            n = self._initial_partitions()
             lp = self.partition(itertools.chain(staged_l, left), left_keys,
                                 depth=0, n=n)
             rp = self.partition(itertools.chain(staged_r, right),

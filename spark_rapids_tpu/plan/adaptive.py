@@ -5,43 +5,127 @@ GpuOverrides.scala:1744; GpuTransitionOverrides.optimizeAdaptiveTransitions).
 Spark AQE executes shuffle map stages, reads MapOutputStatistics, and re-plans
 the rest of the query. This engine does the same with in-process stages: every
 exchange's map side runs first (its output is cached/spillable), then the plan
-above it is rewritten using the observed per-partition sizes:
+above it is rewritten using the observed ``StageStats`` (exact per-partition
+rows/bytes plus KMV key-distinct sketches, execs/exchange_execs.py):
 
 - **partition coalescing** — contiguous small reduce partitions are grouped to
   the advisory size and read through a CustomShuffleReader
-  (CoalescedPartitionSpec semantics);
+  (CoalescedPartitionSpec semantics); device readers additionally get a
+  CoalesceBatches above them (the GpuCoalesceBatches-after-shuffle shape) so
+  the kernels downstream see advisory-sized batches, not shuffle fragments;
 - **dynamic broadcast join** — a shuffled hash join whose finished build-side
   shuffle turned out under the broadcast threshold is rewritten to a broadcast
   hash join reading ALL of that shuffle's output once (Spark's
-  DynamicJoinSelection + the reader's all-partition mode).
+  DynamicJoinSelection + the reader's all-partition mode);
+- **skew-split joins** — a reduce partition larger than skewedPartitionFactor
+  × median splits into map-id-axis slices (PartialReducerPartitionSpec
+  semantics): the split side reads each slice as its own join partition while
+  the other side re-reads the matching whole partition per slice, so every
+  (left row, right row) key match still meets exactly once and the result is
+  bit-identical up to row order (OptimizeSkewedJoin);
+- **skew-repartitioned aggregates** — aggregates cannot split on the map axis
+  (a group's rows would land in several slices and aggregate twice), so a
+  skewed aggregate input instead raises the operator's grace-partition hint:
+  the PR 11 grace machinery re-partitions by key hash and re-aggregates
+  (split-then-reaggregate);
+- **post-AQE re-fusion** — the rewrite creates fusible device chains that did
+  not exist at plan time (a lone Filter above an exchange becomes
+  Filter→CoalesceBatches→Reader), so the PR 10 fusion pass re-runs over the
+  rewritten tree; the fused-op composition is the program-cache key input, so
+  re-fused stages compile under their own sound keys (R016);
+- **cost-based placement** — with the cost model enabled, a join whose
+  observed input rows are under costModel.minDeviceRows moves to the CPU
+  engine (download → CpuHashJoin → upload): at that scale the XLA dispatch
+  and transfer overhead exceeds the host hash join.
+
+Every decision stamps an ``adaptive_tag`` on the rewritten node, rendered as
+``[adaptive: …]`` by plan display, and bumps the ``adaptive.*`` counters
+(utils/metrics.py ADAPTIVE_METRIC_NAMES).
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+import dataclasses
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple, Union
 
 from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.execs.base import ExecContext, PhysicalExec
 from spark_rapids_tpu.execs.exchange_execs import (CpuBroadcastExchangeExec,
+                                                   HashPartitioning,
+                                                   RoundRobinPartitioning,
                                                    ShuffleExchangeExecBase,
                                                    SinglePartitioning,
                                                    TpuBroadcastExchangeExec)
+from spark_rapids_tpu.utils import metrics as um
+
+
+@dataclass(frozen=True)
+class PartialReducerSpec:
+    """One map-id-axis slice of a reduce partition (Spark's
+    PartialReducerPartitionSpec): the reader pulls reduce partition ``pid``
+    restricted to the output of map tasks ``map_ids``. The slices of one
+    partition are disjoint and cover it, so a side split this way still
+    reads every row exactly once."""
+    pid: int
+    slice_index: int
+    num_slices: int
+    map_ids: Tuple[int, ...]
+
+    def __str__(self) -> str:
+        return f"p{self.pid}[{self.slice_index + 1}/{self.num_slices}]"
+
+
+#: one consumer partition's read set: whole reduce partitions (ints, possibly
+#: several when coalesced) or a single map-axis slice of one
+ReaderSpec = Tuple[Union[int, PartialReducerSpec], ...]
 
 
 class CustomShuffleReaderExecBase(PhysicalExec):
     """Reads a subset/grouping of an executed exchange's reduce partitions.
-    ``specs[i]`` is the tuple of exchange partition ids consumer partition i
-    reads (coalesced partitions = multi-id tuples; the all-partition single
-    spec is the broadcast-build mode)."""
+    ``specs[i]`` is the tuple of entries consumer partition i reads: exchange
+    partition ids (coalesced partitions = multi-id tuples; the all-partition
+    single spec is the broadcast-build mode) or PartialReducerSpec slices
+    (the skew-split mode)."""
+
+    #: set by the skew-split rewrite on BOTH join-input readers: their specs
+    #: are index-aligned (same key space per consumer partition), so the join
+    #: above runs partition-wise and _restore_requirements must NOT re-wrap
+    #: the inputs in single-partition exchanges
+    aligned_pairwise: bool = False
 
     def __init__(self, exchange: ShuffleExchangeExecBase,
-                 specs: Tuple[Tuple[int, ...], ...]):
+                 specs: Tuple[ReaderSpec, ...]):
         super().__init__((exchange,), exchange.output)
         self.specs = specs
 
     def size_estimate(self):
-        # the exchange's estimate covers ALL partitions; a reader over a
-        # subset is bounded by it (coalesced groups read each id once)
-        return self.children[0].size_estimate()
+        exchange = self.children[0]
+        stats = exchange.stage_stats()
+        if stats is None:
+            # pre-execution the whole exchange's estimate is still the only
+            # upper bound for any subset (each id is read at most once)
+            return exchange.size_estimate()
+        # observed: sum exactly the partitions (or map-axis fractions) this
+        # reader's specs cover, so footprint admission charges rewritten
+        # plans what they actually read
+        return sum(self.observed_spec_bytes(i) for i in range(len(self.specs)))
+
+    def observed_spec_bytes(self, i: int) -> int:
+        """Observed bytes consumer partition ``i`` reads (its spec's whole
+        reduce partitions plus map-axis fractions). Requires the exchange's
+        stage to have run."""
+        exchange = self.children[0]
+        stats = exchange.stage_stats()
+        from spark_rapids_tpu.execs.cpu_execs import _row_width
+        width = _row_width(self.output)
+        rows = 0
+        for entry in self.specs[i]:
+            if isinstance(entry, PartialReducerSpec):
+                rows += sum(exchange._map_part_rows.get((m, entry.pid), 0)
+                            for m in entry.map_ids)
+            else:
+                rows += stats.partition_rows[entry]
+        return rows * width
 
     @property
     def num_partitions(self) -> int:
@@ -49,13 +133,17 @@ class CustomShuffleReaderExecBase(PhysicalExec):
 
     def execute(self, ctx: ExecContext) -> Iterator:
         exchange = self.children[0]
-        for pid in self.specs[ctx.partition_id]:
+        for entry in self.specs[ctx.partition_id]:
+            pid = entry.pid if isinstance(entry, PartialReducerSpec) else entry
             sub = ExecContext(ctx.conf, partition_id=pid,
                               num_partitions=exchange.num_partitions,
                               device_manager=ctx.device_manager,
                               cleanups=ctx.cleanups,
                               placement=ctx.placement)
-            for batch in exchange.execute(sub):
+            it = (exchange.execute_partial(sub, entry.map_ids)
+                  if isinstance(entry, PartialReducerSpec)
+                  else exchange.execute(sub))
+            for batch in it:
                 self.count_output(batch.num_rows)
                 yield batch
 
@@ -69,7 +157,7 @@ class TpuCustomShuffleReaderExec(CustomShuffleReaderExecBase):
 
 
 def _reader_for(exchange: ShuffleExchangeExecBase,
-                specs: Tuple[Tuple[int, ...], ...]) -> CustomShuffleReaderExecBase:
+                specs: Tuple[ReaderSpec, ...]) -> CustomShuffleReaderExecBase:
     cls = (TpuCustomShuffleReaderExec if exchange.is_device
            else CpuCustomShuffleReaderExec)
     return cls(exchange, specs)
@@ -92,10 +180,35 @@ def coalesce_specs(sizes: List[int], target: int) -> Tuple[Tuple[int, ...], ...]
     return tuple(specs) if specs else ((),)
 
 
+def _expr_fingerprint(e):
+    """Structural identity of an expression tree (type + every dataclass
+    field, Expression fields recursively) — the equality the skew-split
+    alignment check needs: two HashPartitionings route a key value to the
+    same reduce partition exactly when their key expressions are
+    structurally identical."""
+    from spark_rapids_tpu.exprs.core import Expression
+    if not dataclasses.is_dataclass(e):
+        return repr(e)
+    out: list = [type(e).__name__]
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expression):
+            out.append((f.name, _expr_fingerprint(v)))
+        elif isinstance(v, tuple):
+            out.append((f.name, tuple(
+                _expr_fingerprint(x) if isinstance(x, Expression) else repr(x)
+                for x in v)))
+        else:
+            out.append((f.name, repr(v)))
+    return tuple(out)
+
+
 def adaptive_rewrite(plan: PhysicalExec, ctx: ExecContext) -> PhysicalExec:
     """Run every shuffle map stage, then re-plan the tree above it using the
     observed statistics. Returns the rewritten plan (the input plan's cached
     exchange outputs are reused, not recomputed)."""
+    from spark_rapids_tpu.execs import cpu_execs as ce
+    from spark_rapids_tpu.execs import tpu_execs as te
     conf = ctx.conf
     threshold = conf.get(cfg.BROADCAST_JOIN_THRESHOLD)
     target = conf.get(cfg.ADAPTIVE_ADVISORY_PARTITION_BYTES)
@@ -105,16 +218,54 @@ def adaptive_rewrite(plan: PhysicalExec, ctx: ExecContext) -> PhysicalExec:
             return node.map_output_stats(ctx)
         return None
 
+    def coalesced_child(c: ShuffleExchangeExecBase,
+                        specs: Tuple[ReaderSpec, ...]) -> PhysicalExec:
+        reader = _reader_for(c, specs)
+        tag = f"coalesced {c.num_partitions}→{len(specs)}"
+        st = c.stage_stats()
+        if st is not None:
+            tag += f" rows={st.total_rows}"
+            est = c.size_estimate()
+            if est is not None:
+                from spark_rapids_tpu.execs.cpu_execs import _row_width
+                tag += f" est~{est // max(_row_width(c.output), 1)}"
+        reader.adaptive_tag = tag
+        um.ADAPTIVE_METRICS[um.ADAPTIVE_COALESCED_PARTITIONS].add(
+            c.num_partitions - len(specs))
+        if c.is_device and isinstance(c.partitioning, (HashPartitioning,
+                                                       RoundRobinPartitioning)):
+            # GpuCoalesceBatches-after-shuffle: concat the group's shuffle
+            # fragments toward the advisory size so downstream kernels run
+            # over few large batches — and so the re-fusion pass below has a
+            # device chain to fuse with whatever sits above the reader
+            return te.TpuCoalesceBatchesExec(reader, target_bytes=target)
+        return reader
+
     def fix(node: PhysicalExec) -> PhysicalExec:
         from spark_rapids_tpu.execs.join_execs import (CpuHashJoinExec,
                                                        TpuShuffledHashJoinExec)
 
-        # ---- dynamic broadcast join (before generic coalescing so the build
-        # side becomes an all-partition reader, not a coalesced one)
         if type(node) in (CpuHashJoinExec, TpuShuffledHashJoinExec):
+            # cost model first: a join too small for the device skips every
+            # other device-side rewrite
+            rewritten = _try_cpu_placement(node, stats, conf)
+            if rewritten is not None:
+                return rewritten
+            # ---- dynamic broadcast join (before generic coalescing so the
+            # build side becomes an all-partition reader, not a coalesced one)
             rewritten = _try_broadcast_switch(node, stats, threshold)
             if rewritten is not None:
                 return rewritten
+            rewritten = _try_skew_split(node, stats, conf, target)
+            if rewritten is not None:
+                return rewritten
+
+        if (isinstance(node, (ce.CpuHashAggregateExec,
+                              te.TpuHashAggregateExec))
+                and getattr(node, "grouping", ())):
+            hinted = _try_skew_repartition(node, stats, conf, target)
+            if hinted is not None:
+                return hinted
 
         # ---- coalesce small partitions under any other parent. A
         # single-partition exchange reads every child partition anyway, so
@@ -130,7 +281,7 @@ def adaptive_rewrite(plan: PhysicalExec, ctx: ExecContext) -> PhysicalExec:
             if sz is not None and c.num_partitions > 1:
                 specs = coalesce_specs(sz, target)
                 if len(specs) < c.num_partitions:
-                    new_children.append(_reader_for(c, specs))
+                    new_children.append(coalesced_child(c, specs))
                     changed = True
                     continue
             new_children.append(c)
@@ -142,8 +293,40 @@ def adaptive_rewrite(plan: PhysicalExec, ctx: ExecContext) -> PhysicalExec:
     if sz is not None and out.num_partitions > 1:
         specs = coalesce_specs(sz, target)
         if len(specs) < out.num_partitions:
-            out = _reader_for(out, specs)
-    return _restore_requirements(out)
+            out = coalesced_child(out, specs)
+    out = _restore_requirements(out)
+    if conf.get(cfg.ADAPTIVE_REFUSION_ENABLED):
+        out = _refuse_stages(out, conf)
+    return out
+
+
+def _refuse_stages(plan: PhysicalExec, conf) -> PhysicalExec:
+    """Post-AQE re-fusion: re-run the PR 10 fusion pass over the rewritten
+    tree. The pass is idempotent over already-fused regions, so only chains
+    the rewrite itself created (reader + CoalesceBatches under a lone
+    project/filter) fuse anew; each one counts into adaptive.refused_stages
+    and is tagged. Program-cache keys stay sound (R016): a fused stage's key
+    derives from its composed expressions, which differ from any plan-time
+    stage exactly because the fused op set differs."""
+    from spark_rapids_tpu.plan.fusion import fuse_stages, fused_stages
+    from collections import Counter
+
+    def sig(n) -> str:
+        return f"{type(n).__name__}:{n.fused_ops!r}"
+
+    before = Counter(sig(n) for n in fused_stages(plan))
+    refused = fuse_stages(plan, conf)   # no-op unless sql.fusion.enabled
+    after = fused_stages(refused)
+    delta = len(after) - sum(before.values())
+    if delta > 0:
+        seen: Counter = Counter()
+        for n in after:
+            seen[sig(n)] += 1
+            if seen[sig(n)] > before.get(sig(n), 0):
+                prior = getattr(n, "adaptive_tag", "")
+                n.adaptive_tag = f"{prior} | re-fused" if prior else "re-fused"
+        um.ADAPTIVE_METRICS[um.ADAPTIVE_REFUSED_STAGES].add(delta)
+    return refused
 
 
 def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
@@ -152,7 +335,8 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
     join now emits the stream side's partitioning, but its parents were
     planned when it emitted one partition — limits, global sorts, aggregates,
     windows, and shuffled-join inputs above it need their single-partition
-    input back."""
+    input back. Skew-split joins are the exception: their aligned readers
+    ARE the required co-partitioning, so they stay multi-partition."""
     from spark_rapids_tpu.execs import cpu_execs as ce
     from spark_rapids_tpu.execs import tpu_execs as te
     from spark_rapids_tpu.execs.exchange_execs import (CpuShuffleExchangeExec,
@@ -179,6 +363,8 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
         """A range exchange — or a reader over one (coalesced groups are
         contiguous, so partition order survives) — already satisfies a global
         sort's distribution the way ensure_requirements planned it."""
+        if isinstance(child, te.TpuCoalesceBatchesExec):
+            child = child.children[0]
         if isinstance(child, CustomShuffleReaderExecBase):
             child = child.children[0]
         return (isinstance(child, ShuffleExchangeExecBase)
@@ -198,6 +384,15 @@ def _restore_requirements(plan: PhysicalExec) -> PhysicalExec:
                 return node.with_children([exchange])
             return node
         if not needs_single_children(node):
+            return node
+        if (type(node) in (CpuHashJoinExec, TpuShuffledHashJoinExec)
+                and len(node.children) == 2
+                and all(getattr(c, "aligned_pairwise", False)
+                        for c in node.children)
+                and node.children[0].num_partitions
+                == node.children[1].num_partitions):
+            # skew-split join: the aligned readers are co-partitioned by the
+            # join keys — the distribution ensure_requirements wanted
             return node
         new_children = [single(c) if c.num_partitions > 1 else c
                         for c in node.children]
@@ -244,7 +439,165 @@ def _try_broadcast_switch(join, stats, threshold: int):
         new_children[1 - bi] = stream
         cls = (TpuBroadcastHashJoinExec if join.is_device
                else CpuBroadcastHashJoinExec)
-        return cls(new_children[0], new_children[1], how, join.left_keys,
-                   join.right_keys, join.output, join.condition,
-                   build_side="left" if bi == 0 else "right")
+        out = cls(new_children[0], new_children[1], how, join.left_keys,
+                  join.right_keys, join.output, join.condition,
+                  build_side="left" if bi == 0 else "right")
+        out.adaptive_tag = f"broadcast-switch build={sum(sz)}B"
+        um.ADAPTIVE_METRICS[um.ADAPTIVE_BROADCAST_SWITCHES].add(1)
+        return out
     return None
+
+
+def _try_cpu_placement(join, stats, conf):
+    """Cost-based placement from OBSERVED rows: a shuffled join whose inputs
+    materialized under costModel.minDeviceRows total rows runs on the CPU
+    engine — download the (tiny) sides, host hash join, upload the result.
+    The observed-statistics generalization of the planner's static
+    estimate-based pass (plan/overrides.apply_cost_model)."""
+    from spark_rapids_tpu.execs.join_execs import (CpuHashJoinExec,
+                                                   TpuShuffledHashJoinExec)
+    from spark_rapids_tpu.execs.tpu_execs import (DeviceToHostExec,
+                                                  HostToDeviceExec)
+    if not conf.get(cfg.ADAPTIVE_COST_MODEL_ENABLED):
+        return None
+    if not isinstance(join, TpuShuffledHashJoinExec):
+        return None
+    rows = 0
+    for c in join.children:
+        ex = _unwrap_single(c)
+        if stats(ex) is None:
+            return None
+        st = ex.stage_stats()
+        if st is None:
+            return None
+        rows += st.total_rows
+    if rows >= conf.get(cfg.ADAPTIVE_COST_MODEL_MIN_DEVICE_ROWS):
+        return None
+    cpu = CpuHashJoinExec(DeviceToHostExec(join.children[0]),
+                          DeviceToHostExec(join.children[1]),
+                          join.how, join.left_keys, join.right_keys,
+                          join.output, join.condition,
+                          build_side=join.build_side)
+    cpu.adaptive_tag = f"placement=cpu rows={rows}"
+    return HostToDeviceExec(cpu)
+
+
+def legal_split_sides(how: str) -> List[int]:
+    """Side indices that may be SKEW-SPLIT on the map axis for this join
+    type: the split side's rows are partitioned across slices (each read
+    once), while the OTHER side is re-read whole per slice — i.e. replicated
+    — so the other side must be a legal broadcast build
+    (execs/join_execs.legal_broadcast_sides, the single source of build-side
+    legality)."""
+    from spark_rapids_tpu.execs.join_execs import legal_broadcast_sides
+    return sorted({1 - bi for bi in legal_broadcast_sides(how)})
+
+
+def _try_skew_split(join, stats, conf, target: int):
+    """OptimizeSkewedJoin: for each skewed reduce partition, split the
+    skewed side into map-id-axis slices and pair every slice with a whole
+    re-read of the matching partition on the other side. Both inputs become
+    index-aligned CustomShuffleReaders and the join runs partition-wise
+    (same ctx flows to both children), replacing one giant straggler
+    partition with several even slices."""
+    if not conf.get(cfg.ADAPTIVE_SKEW_SPLIT_ENABLED):
+        return None
+    factor = conf.get(cfg.ADAPTIVE_SKEW_FACTOR)
+    thresh = conf.get(cfg.ADAPTIVE_SKEW_THRESHOLD_BYTES)
+    split_sides = legal_split_sides(join.how)
+    if not split_sides:
+        return None
+    exchanges = [_unwrap_single(c) for c in join.children]
+    for side, ex in enumerate(exchanges):
+        if not (isinstance(ex, ShuffleExchangeExecBase)
+                and isinstance(ex.partitioning, HashPartitioning)):
+            return None
+    n = exchanges[0].num_partitions
+    if n <= 1 or exchanges[1].num_partitions != n:
+        return None
+    # alignment: each side's shuffle must partition by exactly the join keys
+    # (and the key dtypes must agree across sides — _column_hash is
+    # dtype-family-sensitive), otherwise pid i left ≠ pid i right
+    join_keys = (tuple(join.left_keys), tuple(join.right_keys))
+    for side, ex in enumerate(exchanges):
+        pk = tuple(ex.partitioning.keys)
+        if len(pk) != len(join_keys[side]):
+            return None
+        if tuple(map(_expr_fingerprint, pk)) != tuple(
+                map(_expr_fingerprint, join_keys[side])):
+            return None
+    try:
+        if [k.dtype() for k in join_keys[0]] != \
+                [k.dtype() for k in join_keys[1]]:
+            return None
+    except Exception:
+        return None
+    sizes = [stats(ex) for ex in exchanges]
+    medians = [sorted(sz)[len(sz) // 2] for sz in sizes]
+
+    def skewed(side: int, p: int) -> bool:
+        return (sizes[side][p] > factor * medians[side]
+                and sizes[side][p] > thresh)
+
+    specs: Tuple[List[ReaderSpec], List[ReaderSpec]] = ([], [])
+    split_tags: List[str] = []
+    for p in range(n):
+        cands = [s for s in split_sides if skewed(s, p)]
+        slices: List[Tuple[int, ...]] = []
+        s = -1
+        if cands:
+            s = max(cands, key=lambda c: sizes[c][p])
+            want = max(2, -(-sizes[s][p] // max(target, 1)))
+            slices = exchanges[s].map_slices(p, want)
+        if len(slices) >= 2:
+            for i, map_ids in enumerate(slices):
+                specs[s].append(
+                    (PartialReducerSpec(p, i, len(slices), map_ids),))
+                specs[1 - s].append((p,))
+            split_tags.append(f"p{p}×{len(slices)}")
+        else:
+            specs[0].append((p,))
+            specs[1].append((p,))
+    if not split_tags:
+        return None
+    readers = []
+    for side, ex in enumerate(exchanges):
+        r = _reader_for(ex, tuple(specs[side]))
+        r.aligned_pairwise = True
+        readers.append(r)
+    out = join.with_children(readers)
+    out.adaptive_tag = "skew-split " + " ".join(split_tags)
+    um.ADAPTIVE_METRICS[um.ADAPTIVE_SKEW_SPLITS].add(len(split_tags))
+    return out
+
+
+def _try_skew_repartition(node, stats, conf, target: int):
+    """Skewed aggregate input: map-axis slices would split a group across
+    consumers and aggregate it twice, so instead raise the operator's
+    grace-partition hint — the grace machinery (memory/grace.py) partitions
+    the input by key hash up front and re-aggregates per partition
+    (split-then-reaggregate), bounded like any other grace run."""
+    if not conf.get(cfg.ADAPTIVE_SKEW_SPLIT_ENABLED):
+        return None
+    inner = _unwrap_single(node.children[0])
+    if inner is node.children[0] or not isinstance(inner.partitioning,
+                                                   HashPartitioning):
+        return None
+    sz = stats(inner)
+    if sz is None or len(sz) <= 1:
+        return None
+    factor = conf.get(cfg.ADAPTIVE_SKEW_FACTOR)
+    thresh = conf.get(cfg.ADAPTIVE_SKEW_THRESHOLD_BYTES)
+    median = sorted(sz)[len(sz) // 2]
+    n_skewed = sum(1 for s in sz if s > factor * median and s > thresh)
+    if not n_skewed:
+        return None
+    parts = max(2, -(-sum(sz) // max(target, 1)))
+    parts = min(parts, conf.get(cfg.OOC_MAX_PARTITIONS))
+    if parts <= node.grace_partitions:
+        return None
+    out = node.with_children(list(node.children))
+    out.grace_partitions = parts
+    out.adaptive_tag = f"skew-repartition×{parts}"
+    um.ADAPTIVE_METRICS[um.ADAPTIVE_SKEW_SPLITS].add(n_skewed)
+    return out

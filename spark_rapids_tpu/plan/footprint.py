@@ -61,6 +61,66 @@ def choose_partitions(working_set: int, budget: int, conf: TpuConf) -> int:
     return max(2, min(n, conf.get(cfg.OOC_MAX_PARTITIONS)))
 
 
+def observed_input_bytes(node: PhysicalExec,
+                         partition_id: Optional[int] = None) -> Optional[int]:
+    """OBSERVED input bytes of a working-set operator: the summed StageStats
+    bytes of its materialized shuffle inputs (execs/exchange_execs.py),
+    looking through transitions, coalesce, custom shuffle readers, and the
+    single-partition coalescing exchanges EnsureRequirements inserts. None
+    when any input has no executed stage behind it — callers fall back to
+    the static ``working_set_estimate`` contract. This is how runtime
+    statistics replace the 3× guess (ROADMAP item 2): grace fanout and any
+    future cost decision charge the operator what its inputs actually
+    materialized, not what the planner predicted.
+
+    With ``partition_id`` the charge is scoped to the one consumer
+    partition the caller executes (a grace controller runs per partition):
+    the matching reduce partition of each partition-preserving input.
+    Passing through a single-partition coalescing exchange widens the
+    scope back to everything — its consumer really does read the concat."""
+    from spark_rapids_tpu.execs import tpu_execs as te
+    from spark_rapids_tpu.execs.exchange_execs import (ShuffleExchangeExecBase,
+                                                       SinglePartitioning)
+    from spark_rapids_tpu.plan.adaptive import CustomShuffleReaderExecBase
+    total = 0
+    for child in node.children:
+        c = child
+        pid = partition_id
+        while True:
+            if isinstance(c, (te.HostToDeviceExec, te.DeviceToHostExec,
+                              te.TpuCoalesceBatchesExec)):
+                c = c.children[0]
+                continue
+            if (isinstance(c, ShuffleExchangeExecBase)
+                    and isinstance(c.partitioning, SinglePartitioning)):
+                c = c.children[0]
+                pid = None              # the concat reads every partition
+                continue
+            break
+        if isinstance(c, CustomShuffleReaderExecBase):
+            if not c.children[0]._map_done:
+                return None
+            if pid is not None and 0 <= pid < len(c.specs):
+                est = c.observed_spec_bytes(pid)
+            else:
+                est = c.size_estimate()  # observed when the stage ran
+            if est is None:
+                return None
+            total += est
+            continue
+        if isinstance(c, ShuffleExchangeExecBase):
+            st = c.stage_stats()
+            if st is None:
+                return None
+            if pid is not None and 0 <= pid < len(st.partition_bytes):
+                total += st.partition_bytes[pid]
+            else:
+                total += st.total_bytes
+            continue
+        return None
+    return total
+
+
 def plan_working_set_estimate(plan: PhysicalExec) -> Optional[int]:
     """Peak device working set one action of ``plan`` is predicted to
     need: the max over device operators' declared ``working_set_estimate``

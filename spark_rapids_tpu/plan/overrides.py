@@ -745,6 +745,44 @@ def wrap_exec(exec_node: PhysicalExec, conf: TpuConf) -> ExecMeta:
     return ExecMeta(exec_node, conf, rule)
 
 
+def estimated_rows(exec_node: PhysicalExec) -> Optional[int]:
+    """Row-count estimate from the size contract: ``size_estimate`` over the
+    static row width — the cost model's common currency. The adaptive
+    rewrite substitutes OBSERVED rows from StageStats for the same decision
+    at runtime (plan/adaptive._try_cpu_placement)."""
+    est = exec_node.size_estimate()
+    if est is None:
+        return None
+    from spark_rapids_tpu.columnar.dtypes import row_width
+    return est // max(row_width(exec_node.output), 1)
+
+
+def apply_cost_model(root: "ExecMeta", conf: TpuConf) -> None:
+    """Estimate-driven CPU-vs-TPU placement (the GpuOverrides cost-model
+    role, generalizing the static variableFloatAgg-style fallbacks from
+    capability gates to cost gates; off by default): an operator whose
+    estimated row count is under sql.adaptive.costModel.minDeviceRows
+    stays on the CPU engine — at that scale per-operator XLA dispatch and
+    the transition transfers cost more than the host loop. Unknown
+    estimates never demote (the device is the default placement; only
+    POSITIVE evidence of a tiny input moves work off it)."""
+    if not conf.get(cfg.ADAPTIVE_COST_MODEL_ENABLED):
+        return
+    min_rows = conf.get(cfg.ADAPTIVE_COST_MODEL_MIN_DEVICE_ROWS)
+
+    def visit(m: "ExecMeta") -> None:
+        rows = estimated_rows(m.exec)
+        if rows is not None and rows < min_rows:
+            m.will_not_work(
+                f"cost model: estimated {rows} rows < costModel."
+                f"minDeviceRows={min_rows} — host execution avoids device "
+                f"dispatch overhead at this scale")
+        for c in m.child_metas:
+            visit(c)
+
+    visit(root)
+
+
 # ------------------------------------------------------------------ the pass
 class TpuOverrides:
     """The plan-rewrite rule (GpuOverrides apply analog, GpuOverrides.scala:1754)."""
@@ -758,6 +796,7 @@ class TpuOverrides:
             return plan
         meta = wrap_exec(plan, self.conf)
         meta.tag_for_tpu()
+        apply_cost_model(meta, self.conf)
         _enforce_exchange_reuse(meta)
         lines: List[str] = []
         meta.explain(lines)

@@ -259,6 +259,11 @@ class QueryHandle:
             #: attribution — exact under concurrent out-of-core queries,
             #: unlike the process-global lifetime maximum)
             "recursion_depth_peak": 0,
+            #: THIS query's adaptive-rewrite decisions, accumulated across
+            #: its actions (per-handle attribution of the adaptive.* deltas
+            #: record_exec_metrics receives; utils/metrics.py
+            #: ADAPTIVE_METRIC_NAMES)
+            "adaptive": {},
         }
         #: EXPLAIN ANALYZE text rendered at completion when the query ran
         #: under trace.enabled (the plan itself is dropped at _finish to
@@ -508,6 +513,9 @@ class QueryHandle:
             else:
                 self.exec_metrics.update(
                     {f"a{ordinal}:{k}": v for k, v in snapshot.items()})
+            acc = self.metrics["adaptive"]
+            for k, v in (snapshot.get("adaptive") or {}).items():
+                acc[k] = acc.get(k, 0) + v
 
     # ---- results -----------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -540,6 +548,7 @@ class QueryHandle:
                    "tenant": self.tenant, "state": self.state.value}
             out.update({k: v for k, v in self.metrics.items()})
             out["program_cache"] = dict(self.metrics["program_cache"])
+            out["adaptive"] = dict(self.metrics["adaptive"])
             return out
 
     def __repr__(self) -> str:

@@ -176,6 +176,30 @@ RECOMPUTE_METRIC_NAMES = (
     SHUFFLE_RECOMPUTES, SHUFFLE_RECOMPUTED_MAP_TASKS,
     SHUFFLE_RECOMPUTE_ESCALATIONS)
 
+# Adaptive-execution counters (driver-process-global: plan/adaptive.py's
+# rewrite pass owns every bump — it runs once per action, in the driver,
+# after the shuffle map stages materialized their statistics). The
+# re-planning story in one glance: how many skewed partitions were split
+# into map-id slices (or re-partitioned, for aggregates), how many small
+# reduce partitions folded into coalesced reader groups, how often a
+# shuffled join switched to broadcast from observed sizes, and how many
+# fused stages the post-AQE re-fusion pass created over rewritten regions.
+#: skewed reduce partitions split into PartialReducerSpec slices (joins)
+#: or re-partitioned by group key (aggregates) — one per skewed partition
+ADAPTIVE_SKEW_SPLITS = "adaptive.skew_splits"
+#: reduce partitions removed by AQE coalescing (sum of n_before - n_after
+#: over every coalesced reader the rewrite inserted)
+ADAPTIVE_COALESCED_PARTITIONS = "adaptive.coalesced_partitions"
+#: shuffled hash joins switched to broadcast from observed build sizes
+ADAPTIVE_BROADCAST_SWITCHES = "adaptive.broadcast_switches"
+#: fused stages newly created by the post-AQE re-fusion pass (stages the
+#: plan-time fusion pass could not see because the rewrite created them)
+ADAPTIVE_REFUSED_STAGES = "adaptive.refused_stages"
+
+ADAPTIVE_METRIC_NAMES = (
+    ADAPTIVE_SKEW_SPLITS, ADAPTIVE_COALESCED_PARTITIONS,
+    ADAPTIVE_BROADCAST_SWITCHES, ADAPTIVE_REFUSED_STAGES)
+
 # Per-query serving metrics (QueryHandle.metrics keys, serving/lifecycle.py):
 # unlike the per-operator MetricSets — which live on per-action plan nodes —
 # and the process-global transfer counters, these are scoped to ONE query
@@ -271,6 +295,24 @@ SERVING_METRICS = MetricSet(*SERVING_METRIC_NAMES)
 
 #: driver-global lineage-recompute counters (see RECOMPUTE_METRIC_NAMES)
 RECOMPUTE_METRICS = MetricSet(*RECOMPUTE_METRIC_NAMES)
+
+#: driver-global adaptive-execution counters (see ADAPTIVE_METRIC_NAMES)
+ADAPTIVE_METRICS = MetricSet(*ADAPTIVE_METRIC_NAMES)
+
+
+def adaptive_snapshot() -> Dict[str, float]:
+    """Action-start marker for ``adaptive_delta`` (all counters additive)."""
+    return ADAPTIVE_METRICS.snapshot()
+
+
+def adaptive_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-action adaptive stats: counter deltas since ``before``. Like the
+    recompute section the counters live in the driver process (the AQE
+    rewrite is the only bump site); under concurrent queries a delta can
+    still include an overlapping action's rewrite decisions."""
+    now = ADAPTIVE_METRICS.snapshot()
+    return {name: now[name] - before.get(name, 0)
+            for name in ADAPTIVE_METRIC_NAMES}
 
 
 def recompute_snapshot() -> Dict[str, float]:

@@ -1,0 +1,422 @@
+"""Statistics-driven adaptive execution v2 (docs/adaptive.md).
+
+Covers the PR-20 surface:
+- StageStats at shuffle materialization: exact per-partition rows/bytes and
+  the KMV distinct sketch per hash key column;
+- skew-split readers: PartialReducerSpec map-axis slices, bit-identical
+  (up to row order) across join types, the skewed group-by re-partition;
+- post-AQE re-fusion: the rewritten region re-fuses into ``*(id)`` stages
+  with their own program-cache keys;
+- observed-size grace fanout: recursion depth under a tiny budget with
+  observed statistics never exceeds the estimate-driven run;
+- cost-based placement: plan-time demotion of tiny plans plus the
+  AQE-observed CpuHashJoinExec switch;
+- the adaptive counters in session.last_metrics and QueryHandle snapshots.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import TpuSession, functions as F
+from spark_rapids_tpu.execs.exchange_execs import (ShuffleExchangeExecBase,
+                                                   _kmv_estimate, _kmv_merge)
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.plan.adaptive import (CustomShuffleReaderExecBase,
+                                            PartialReducerSpec,
+                                            legal_split_sides)
+from spark_rapids_tpu.testing import assert_tables_equal
+
+AQE = {"spark.rapids.tpu.sql.adaptive.enabled": "true"}
+
+#: skew knobs scaled to test-size data: tiny skew threshold so the hot
+#: partition trips it, tiny advisory size so the upstream round-robin
+#: exchange keeps multiple map tasks (map_slices needs >1 contributing map)
+SKEW = {**AQE,
+        "spark.rapids.tpu.sql.adaptive.skewedPartitionThreshold.bytes": "64",
+        "spark.rapids.tpu.sql.adaptive.skewedPartitionFactor": "2.0",
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes": "2048"}
+
+
+def walk(node):
+    yield node
+    for c in node.children:
+        yield from walk(c)
+
+
+def skewed_table(n=2000, hot=0.8, seed=7):
+    rng = np.random.default_rng(seed)
+    k = np.where(rng.random(n) < hot, 0, rng.integers(1, 50, n))
+    return pa.table({"k": pa.array(k, type=pa.int64()),
+                     "v": pa.array(np.arange(n), type=pa.int64())})
+
+
+def dim_table(m=50):
+    return pa.table({"k": pa.array(np.arange(m), type=pa.int64()),
+                     "w": pa.array(np.arange(m) * 10, type=pa.int64())})
+
+
+def sort_all(t):
+    cols = sorted(t.column_names)
+    return t.select(cols).sort_by([(c, "ascending") for c in cols])
+
+
+# ------------------------------------------------------------ stage statistics
+def test_stage_stats_rows_bytes_ndv():
+    t = pa.table({"k": pa.array(np.arange(1000) % 7, type=pa.int64()),
+                  "v": pa.array(np.arange(1000), type=pa.int64())})
+    s = TpuSession()
+    s.create_dataframe(t).repartition(4, "k").filter(F.col("v") > 10).collect()
+    ex = [n for n in walk(s.last_plan)
+          if isinstance(n, ShuffleExchangeExecBase)][0]
+    st = ex.stage_stats()
+    assert st is not None
+    assert st.partition_rows and len(st.partition_rows) == 4
+    assert st.total_rows == 1000
+    assert st.total_bytes == sum(st.partition_bytes) > 0
+    # 7 distinct keys < the KMV pool size -> the estimate is exact
+    assert st.key_distinct == (7,)
+    assert "rows=1000" in st.describe()
+
+
+def test_stage_stats_absent_before_run():
+    t = dim_table()
+    s = TpuSession()
+    df = s.create_dataframe(t).repartition(3, "k")
+    plan = df._executed_plan()
+    ex = [n for n in walk(plan) if isinstance(n, ShuffleExchangeExecBase)][0]
+    assert ex.stage_stats() is None
+
+
+def test_kmv_estimator_skew_resistant():
+    """A heavy hitter repeated 10k times must not evict the other distinct
+    hashes from the pool (the dedup-before-truncate regression)."""
+    rng = np.random.default_rng(0)
+    small = np.uint32(1)                    # hot hash, smaller than the rest
+    others = rng.integers(2, 1 << 32, 200, dtype=np.uint64).astype(np.uint32)
+    pool = np.zeros(0, dtype=np.uint32)
+    for _ in range(10):
+        batch = np.concatenate([np.repeat(small, 10000), others])
+        pool = _kmv_merge(pool, batch)
+    est = _kmv_estimate(pool)
+    true_ndv = len(np.unique(others)) + 1
+    assert abs(est - true_ndv) / true_ndv < 0.5, (est, true_ndv)
+
+
+def test_map_slices_cover_contributing_maps():
+    t = skewed_table()
+    s = TpuSession()
+    (s.create_dataframe(t).repartition(8).repartition(6, "k")
+     .filter(F.col("v") >= 0).collect())
+    ex = [n for n in walk(s.last_plan)
+          if isinstance(n, ShuffleExchangeExecBase)
+          and n.num_partitions == 6][0]
+    st = ex.stage_stats()
+    hot = max(range(6), key=lambda p: st.partition_bytes[p])
+    slices = ex.map_slices(hot, 4)
+    assert len(slices) >= 2
+    ids = [m for grp in slices for m in grp]
+    assert len(ids) == len(set(ids))        # disjoint
+    # contiguous ascending: reduce-side concat order is preserved
+    assert ids == sorted(ids)
+
+
+def test_partial_reducer_spec_repr():
+    spec = PartialReducerSpec(pid=7, slice_index=0, num_slices=5,
+                              map_ids=(0, 1))
+    assert str(spec) == "p7[1/5]"
+
+
+# --------------------------------------------------------------- skew splitting
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_skew_split_join_bit_identical(how):
+    def run(conf):
+        s = TpuSession({"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes":
+                            "1", **conf})
+        lt = s.create_dataframe(skewed_table()).repartition(8) \
+              .repartition(6, "k")
+        rt = s.create_dataframe(dim_table()).repartition(4) \
+              .repartition(6, "k")
+        return lt.join(rt, "k", how=how).collect(), s
+
+    on, s_on = run(SKEW)
+    plan = s_on.last_plan.tree_string()
+    assert "skew-split" in plan, plan
+    off, _ = run({})
+    assert_tables_equal(sort_all(off), sort_all(on))
+
+
+def test_skew_split_tag_and_metrics():
+    s = TpuSession({"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+                    **SKEW})
+    lt = s.create_dataframe(skewed_table()).repartition(8).repartition(6, "k")
+    rt = s.create_dataframe(dim_table()).repartition(4).repartition(6, "k")
+    out = lt.join(rt, "k").collect()
+    assert out.num_rows > 0
+    plan = s.last_plan.tree_string()
+    # EXPLAIN contract: [adaptive: skew-split p<pid>x<slices>]
+    assert "[adaptive: skew-split p" in plan, plan
+    adaptive = s.last_metrics["adaptive"]
+    assert adaptive["adaptive.skew_splits"] >= 1, adaptive
+    # the rewritten join reads partial specs on exactly one side
+    readers = [n for n in walk(s.last_plan)
+               if isinstance(n, CustomShuffleReaderExecBase)]
+    partial = [n for n in readers
+               if any(isinstance(e, PartialReducerSpec)
+                      for spec in n.specs for e in spec)]
+    assert partial, plan
+    assert all(r.aligned_pairwise for r in partial)
+
+
+def test_skew_split_disabled_by_conf():
+    s = TpuSession({"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1",
+                    "spark.rapids.tpu.sql.adaptive.skewSplit.enabled":
+                        "false", **SKEW})
+    lt = s.create_dataframe(skewed_table()).repartition(8).repartition(6, "k")
+    rt = s.create_dataframe(dim_table()).repartition(4).repartition(6, "k")
+    lt.join(rt, "k").collect()
+    assert "skew-split" not in s.last_plan.tree_string()
+
+
+def test_legal_split_sides():
+    # the split side must NOT be a side that could have been broadcast
+    # replicated wholesale -- it is the probe/stream side's complement
+    assert legal_split_sides("inner") == [0, 1]
+    assert legal_split_sides("left") == [0]
+    assert legal_split_sides("left_semi") == [0]
+    assert legal_split_sides("left_anti") == [0]
+
+
+def test_skewed_groupby_repartition():
+    rng = np.random.default_rng(3)
+    k = np.where(rng.random(4000) < 0.8, 0, rng.integers(1, 40, 4000))
+    t = pa.table({"k": pa.array(k, type=pa.int64()),
+                  "v": pa.array(np.arange(4000), type=pa.int64())})
+
+    def run(conf):
+        s = TpuSession(conf)
+        out = (s.create_dataframe(t).repartition(8).repartition(6, "k")
+               .groupBy("k").agg(F.count().alias("n"), F.sum("v").alias("sv"))
+               .sort("k").collect())
+        return out, s
+
+    on, s_on = run(SKEW)
+    plan = s_on.last_plan.tree_string()
+    # aggregates must NOT slice the reduce axis (a sliced partition would
+    # double-count groups); they raise the grace fanout instead
+    assert "skew-repartition" in plan, plan
+    assert s_on.last_metrics["adaptive"]["adaptive.skew_splits"] >= 1
+    off, _ = run({})
+    assert_tables_equal(off, on)
+
+
+# ------------------------------------------------------------------- re-fusion
+def t7(n=3000):
+    return pa.table({"k": pa.array(np.arange(n) % 7, type=pa.int64()),
+                     "v": pa.array(np.arange(n), type=pa.int64())})
+
+
+def test_refusion_creates_fused_stage():
+    """A lone Filter above a coalesced device reader is not a fusable chain
+    at plan time; the coalesce batches node the reader inserts makes it one
+    — only the post-AQE re-fusion pass can see it."""
+    from spark_rapids_tpu.serving.program_cache import global_program_cache
+
+    cache = global_program_cache()
+    before_keys = set(cache._programs.keys())
+
+    s = TpuSession(AQE)
+    out = (s.create_dataframe(t7()).repartition(6, "k")
+           .filter(F.col("v") > 10).collect())
+    assert out.num_rows == 3000 - 11
+    plan = s.last_plan.tree_string()
+    assert "*(1)" in plan, plan
+    assert "re-fused" in plan, plan
+    assert s.last_metrics["adaptive"]["adaptive.refused_stages"] >= 1
+    # the re-fused stage compiled under its own program-cache key (R016:
+    # fused signatures key the program, not the pre-AQE plan shape)
+    new_stage_keys = [k for k in cache._programs.keys()
+                     if k not in before_keys and "stage" in k]
+    assert new_stage_keys, sorted(cache._programs.keys() - before_keys)
+
+    # refusion off: same query, no fused stage, identical result
+    s2 = TpuSession({**AQE,
+                     "spark.rapids.tpu.sql.adaptive.refusion.enabled":
+                         "false"})
+    out2 = (s2.create_dataframe(t7()).repartition(6, "k")
+            .filter(F.col("v") > 10).collect())
+    assert "*(" not in s2.last_plan.tree_string()
+    assert_tables_equal(out.sort_by("v"), out2.sort_by("v"))
+
+
+def test_refusion_pipeline_matches_non_aqe():
+    def run(conf):
+        s = TpuSession(conf)
+        return (s.create_dataframe(t7()).repartition(5, "k")
+                .filter(F.col("v") > 100)
+                .select("k", (F.col("v") * 2).alias("v2"))
+                .groupBy("k").agg(F.sum("v2").alias("s"))
+                .sort("k").collect())
+    assert_tables_equal(run({}), run(AQE))
+
+
+# ------------------------------------------------------- observed reader sizes
+def test_reader_size_estimate_uses_observed_stats():
+    """A selective filter upstream of the exchange: the static estimate is
+    the full-table upper bound; the reader's estimate reflects the rows the
+    stage actually materialized."""
+    t = pa.table({"k": pa.array(np.arange(20000) % 7, type=pa.int64()),
+                  "v": pa.array(np.arange(20000), type=pa.int64())})
+    s = TpuSession(AQE)
+    (s.create_dataframe(t).filter(F.col("v") < 20).repartition(4, "k")
+     .filter(F.col("v") >= 0).collect())
+    readers = [n for n in walk(s.last_plan)
+               if isinstance(n, CustomShuffleReaderExecBase)]
+    assert readers, s.last_plan.tree_string()
+    r = readers[0]
+    ex = [n for n in walk(r) if isinstance(n, ShuffleExchangeExecBase)][0]
+    assert r.size_estimate() < ex.size_estimate() / 10
+    # EXPLAIN surfaces observed vs estimated rows on the reader line
+    plan = s.last_plan.tree_string()
+    assert "rows=" in plan and "est~" in plan, plan
+
+
+# ------------------------------------------------------- observed grace fanout
+@pytest.fixture
+def fresh_memory():
+    DeviceManager.shutdown()
+    yield
+    DeviceManager.shutdown()
+
+
+def test_grace_observed_fanout_bounds_recursion(monkeypatch, fresh_memory):
+    """Under a tiny budget the fanout sized from OBSERVED input bytes never
+    recurses deeper than the estimate-driven run, and stays bit-identical
+    (integer aggregates)."""
+    from spark_rapids_tpu.plan import footprint as fp
+
+    TINY = {"spark.rapids.tpu.memory.tpu.poolSizeBytes": str(256 << 10),
+            "spark.rapids.tpu.memory.host.spillStorageSize": str(256 << 10),
+            "spark.rapids.tpu.sql.scanCache.enabled": "false",
+            "spark.rapids.tpu.sql.hasNans": "false"}
+    rng = np.random.default_rng(0)
+    t = pa.table({"k": rng.integers(0, 64, 40000).astype("int64"),
+                  "v": rng.integers(0, 1000, 40000).astype("int64")})
+
+    def q(sess):
+        return (sess.create_dataframe(t).repartition(4, "k").groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.count(F.lit(1)).alias("c")))
+
+    # baseline: observed statistics unavailable -> hint/fanout sizing
+    orig = fp.observed_input_bytes
+    monkeypatch.setattr(fp, "observed_input_bytes",
+                        lambda node, partition_id=None: None)
+    s_est = TpuSession(TINY)
+    ref = q(s_est).collect()
+    depth_est = s_est.last_metrics["memory"]["memory.recursion_depth_peak"]
+    assert depth_est >= 1    # the tiny budget did engage grace
+
+    # observed run: record that the statistics path actually fired
+    DeviceManager.shutdown()
+    fired = []
+
+    def spy(node, partition_id=None):
+        r = orig(node, partition_id)
+        if r is not None:
+            fired.append(r)
+        return r
+
+    monkeypatch.setattr(fp, "observed_input_bytes", spy)
+    s_obs = TpuSession(TINY)
+    got = q(s_obs).collect()
+    depth_obs = s_obs.last_metrics["memory"]["memory.recursion_depth_peak"]
+    assert fired, "observed-statistics fanout never engaged"
+    assert depth_obs <= depth_est, (depth_obs, depth_est)
+    assert_tables_equal(ref, got, ignore_order=True)
+
+
+# --------------------------------------------------------- cost-based placement
+COST = {"spark.rapids.tpu.sql.adaptive.costModel.enabled": "true"}
+
+
+def t13(n):
+    return pa.table({"k": pa.array(np.arange(n) % 13, type=pa.int64()),
+                     "v": pa.array(np.arange(n), type=pa.int64())})
+
+
+def test_cost_model_plan_time_placement():
+    s = TpuSession(COST)
+    out = s.create_dataframe(t13(50)).filter(F.col("v") > 5).collect()
+    assert "CpuFilterExec" in s.last_plan.tree_string()
+    assert out.num_rows == 44
+
+    s2 = TpuSession(COST)
+    out2 = s2.create_dataframe(t13(100000)).filter(F.col("v") > 5).collect()
+    p2 = s2.last_plan.tree_string()
+    assert "TpuFilterExec" in p2 or "*(" in p2, p2
+    assert out2.num_rows == 99994
+
+
+def test_cost_model_off_by_default():
+    s = TpuSession()
+    s.create_dataframe(t13(50)).filter(F.col("v") > 5).collect()
+    assert "CpuFilterExec" not in s.last_plan.tree_string()
+
+
+def test_cost_model_aqe_observed_placement():
+    """Estimates keep the join on-device at plan time (the filter passes
+    its child's upper bound through); the OBSERVED exchange rows are tiny,
+    so only AQE's runtime statistics can legally demote — and must, with
+    the same result as the static plan."""
+    conf = {**COST, **AQE,
+            "spark.rapids.tpu.sql.adaptive.costModel.minDeviceRows": "1000",
+            "spark.rapids.tpu.sql.broadcastJoinThreshold.bytes": "1"}
+
+    def run(c):
+        s = TpuSession(c)
+        lt = (s.create_dataframe(t13(20000)).filter(F.col("v") < 20)
+              .repartition(4, "k"))
+        rt = (s.create_dataframe(t13(20000)).filter(F.col("v") < 10)
+              .repartition(3, "k"))
+        return lt.join(rt, "k").collect(), s
+
+    out, s_aqe = run(conf)
+    plan = s_aqe.last_plan.tree_string()
+    assert "CpuHashJoinExec" in plan, plan
+    assert "[adaptive: placement=cpu rows=" in plan, plan
+    ref, s_ref = run({"spark.rapids.tpu.sql.broadcastJoinThreshold.bytes":
+                          "1"})
+    assert "CpuHashJoinExec" not in s_ref.last_plan.tree_string()
+    assert_tables_equal(sort_all(ref), sort_all(out))
+
+
+# -------------------------------------------------------------- metrics wiring
+def test_adaptive_counters_in_session_metrics():
+    s = TpuSession(AQE)
+    (s.create_dataframe(t7()).repartition(6, "k")
+     .filter(F.col("v") > 10).collect())
+    adaptive = s.last_metrics["adaptive"]
+    for key in ("adaptive.skew_splits", "adaptive.coalesced_partitions",
+                "adaptive.broadcast_switches", "adaptive.refused_stages"):
+        assert key in adaptive, adaptive
+    assert adaptive["adaptive.coalesced_partitions"] >= 1, adaptive
+
+
+def test_adaptive_counters_in_query_handle():
+    s = TpuSession(AQE)
+    df = (s.create_dataframe(t7()).repartition(6, "k")
+          .filter(F.col("v") > 10).select("k"))
+    h = s.submit(df, label="adaptive-metrics")
+    h.result(timeout=120)
+    assert h.exec_metrics["adaptive"]["adaptive.coalesced_partitions"] >= 1
+    snap = h.snapshot()
+    assert snap["adaptive"]["adaptive.coalesced_partitions"] >= 1
+
+
+def test_explain_coalesce_tag_format():
+    s = TpuSession(AQE)
+    (s.create_dataframe(t7()).repartition(6, "k")
+     .filter(F.col("v") > 10).collect())
+    plan = s.last_plan.tree_string()
+    # [adaptive: coalesced 6->N | re-fused] with observed rows
+    assert "[adaptive: coalesced 6→" in plan, plan
+    assert "rows=3000" in plan, plan
